@@ -1,0 +1,27 @@
+"""Repo-root conftest: force tests onto a virtual 8-device CPU mesh.
+
+Two subtleties:
+- XLA_FLAGS must be set before any backend initialises.
+- This image's sitecustomize registers an `axon` TPU-tunnel platform and
+  force-sets jax_platforms="axon,cpu" programmatically, so the JAX_PLATFORMS
+  env var alone is NOT enough — initialising the axon client from tests
+  blocks on the (single-session) TPU tunnel. Override via jax.config so tests
+  never touch the tunnel (SURVEY.md §4: the CPU-mesh simulation stands in for
+  the reference's envtest "real API, fake kubelet" trick — real XLA SPMD
+  partitioning, no TPU hardware).
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

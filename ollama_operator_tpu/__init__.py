@@ -1,0 +1,28 @@
+"""tpu-model-operator: a TPU-native model-serving framework.
+
+Re-provides the full capability surface of the `ollama-operator` reference
+(a K8s operator delegating inference to the ollama/llama.cpp container — see
+/root/reference, SURVEY.md) as a from-scratch JAX/XLA/Pallas stack:
+
+- ``models/``    decoder-only transformer family (llama2/3, mistral, qwen2,
+                 gemma, phi-2, tinyllama) as pure functional JAX.
+- ``ops/``       numerics: RoPE, norms, attention, sampling; Pallas TPU
+                 kernels with pure-JAX fallbacks.
+- ``parallel/``  device mesh, sharding specs, ring attention (sequence
+                 parallelism), multi-host distributed init.
+- ``gguf/``      GGUF parse + dequantization + transcode cache (the
+                 TPU-native replacement for the ollama blob store contents).
+- ``tokenizer/`` SPM-BPE and GPT2-BPE built from GGUF metadata (no
+                 sentencepiece dependency).
+- ``runtime/``   serving engine: jitted prefill/decode, slot KV cache,
+                 continuous batching scheduler.
+- ``server/``    Ollama-compatible HTTP API + OpenAI compat + metrics +
+                 registry.ollama.ai pull client.
+- ``operator/``  the Kubernetes control plane: Model CRD + reconciler
+                 (pure-function workload assembly mirroring the reference's
+                 pkg/model, reconcile ladder mirroring
+                 internal/controller/model_controller.go).
+- ``training/``  LoRA/full fine-tune step used to validate dp/tp/sp sharding.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,1 @@
+from .config import ModelConfig, PRESETS, get_config  # noqa: F401

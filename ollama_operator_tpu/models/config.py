@@ -1,0 +1,137 @@
+"""Model architecture configs for the decoder family.
+
+The reference operator never describes architectures — it delegates them to
+the GGUF metadata consumed by llama.cpp inside the ollama image
+(/root/reference/pkg/model/pod.go:11). Here the architecture is a first-class
+config object so the engine can be jit-specialised per model, and so GGUF
+metadata (gguf/reader.py) can be mapped onto it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """Static architecture description. Frozen + hashable → usable as a jit
+    static argument."""
+
+    arch: str = "llama"
+    vocab_size: int = 32000
+    dim: int = 4096                    # model/residual width
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 32               # < n_heads → GQA
+    head_dim: int = 128
+    ffn_dim: int = 11008               # hidden width of the MLP
+    norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    rope_scaling: float = 1.0          # linear position scaling (1.0 = off)
+    rotary_pct: float = 1.0            # phi-2 rotates only part of head_dim
+    max_seq_len: int = 4096
+    sliding_window: int = 0            # 0 = full attention (mistral: 4096)
+    # block structure
+    norm_type: str = "rmsnorm"         # "rmsnorm" | "layernorm"
+    norm_weight_offset: float = 0.0    # gemma: weight stored as (w - 1)
+    mlp_type: str = "gated"            # "gated" (silu/gelu gate*up) | "plain"
+    act: str = "silu"                  # "silu" | "gelu" | "gelu_tanh"
+    parallel_block: bool = False       # phi-2: attn and mlp share the input LN
+    attn_bias: bool = False            # qwen2/phi-2: bias on q/k/v
+    out_bias: bool = False             # phi-2: bias on o/mlp projections
+    tie_embeddings: bool = False       # share tok_emb and lm_head
+    emb_scale: bool = False            # gemma: scale embeddings by sqrt(dim)
+    logit_softcap: float = 0.0         # gemma2: tanh soft-capping of logits
+    attn_softcap: float = 0.0          # gemma2: tanh soft-capping of scores
+    qk_norm: bool = False              # qwen3/llama4-style per-head RMS on q,k
+
+    @property
+    def q_dim(self) -> int:
+        return self.n_heads * self.head_dim
+
+    @property
+    def kv_dim(self) -> int:
+        return self.n_kv_heads * self.head_dim
+
+    @property
+    def rotary_dim(self) -> int:
+        rd = int(self.head_dim * self.rotary_pct)
+        return rd - rd % 2
+
+    @property
+    def n_params(self) -> int:
+        """Approximate parameter count (for sizing / logs)."""
+        d, f, l, v = self.dim, self.ffn_dim, self.n_layers, self.vocab_size
+        attn = d * self.q_dim + 2 * d * self.kv_dim + self.q_dim * d
+        mlp = 3 * d * f if self.mlp_type == "gated" else 2 * d * f
+        emb = v * d * (1 if self.tie_embeddings else 2)
+        return l * (attn + mlp) + emb
+
+    def validate(self) -> "ModelConfig":
+        assert self.n_heads % self.n_kv_heads == 0, "GQA requires n_heads % n_kv_heads == 0"
+        assert self.norm_type in ("rmsnorm", "layernorm")
+        assert self.mlp_type in ("gated", "plain")
+        assert self.act in ("silu", "gelu", "gelu_tanh")
+        return self
+
+
+def _mk(**kw) -> ModelConfig:
+    return ModelConfig(**kw).validate()
+
+
+# --- presets -----------------------------------------------------------------
+# Dims cross-checked against the public GGUF metadata of the ollama library
+# images listed in the reference README model table (/root/reference/README.md).
+
+PRESETS = {
+    # tiny config for unit tests / CI (CPU mesh)
+    "tiny": _mk(arch="llama", vocab_size=256, dim=64, n_layers=2, n_heads=4,
+                n_kv_heads=2, head_dim=16, ffn_dim=128, max_seq_len=128),
+    "tinyllama": _mk(arch="llama", vocab_size=32000, dim=2048, n_layers=22,
+                     n_heads=32, n_kv_heads=4, head_dim=64, ffn_dim=5632,
+                     max_seq_len=2048),
+    "phi": _mk(arch="phi2", vocab_size=51200, dim=2560, n_layers=32,
+               n_heads=32, n_kv_heads=32, head_dim=80, ffn_dim=10240,
+               norm_type="layernorm", mlp_type="plain", act="gelu_tanh",
+               parallel_block=True, attn_bias=True, out_bias=True,
+               rotary_pct=0.4, max_seq_len=2048),
+    "llama2": _mk(arch="llama", vocab_size=32000, dim=4096, n_layers=32,
+                  n_heads=32, n_kv_heads=32, head_dim=128, ffn_dim=11008,
+                  max_seq_len=4096),
+    "llama2:13b": _mk(arch="llama", vocab_size=32000, dim=5120, n_layers=40,
+                      n_heads=40, n_kv_heads=40, head_dim=128, ffn_dim=13824,
+                      max_seq_len=4096),
+    "llama2:70b": _mk(arch="llama", vocab_size=32000, dim=8192, n_layers=80,
+                      n_heads=64, n_kv_heads=8, head_dim=128, ffn_dim=28672,
+                      max_seq_len=4096),
+    "llama3": _mk(arch="llama", vocab_size=128256, dim=4096, n_layers=32,
+                  n_heads=32, n_kv_heads=8, head_dim=128, ffn_dim=14336,
+                  rope_theta=500000.0, max_seq_len=8192),
+    "llama3:70b": _mk(arch="llama", vocab_size=128256, dim=8192, n_layers=80,
+                      n_heads=64, n_kv_heads=8, head_dim=128, ffn_dim=28672,
+                      rope_theta=500000.0, max_seq_len=8192),
+    "mistral": _mk(arch="llama", vocab_size=32000, dim=4096, n_layers=32,
+                   n_heads=32, n_kv_heads=8, head_dim=128, ffn_dim=14336,
+                   sliding_window=4096, max_seq_len=32768),
+    "qwen2": _mk(arch="llama", vocab_size=152064, dim=3584, n_layers=28,
+                 n_heads=28, n_kv_heads=4, head_dim=128, ffn_dim=18944,
+                 attn_bias=True, rope_theta=1000000.0, max_seq_len=32768),
+    "qwen2:0.5b": _mk(arch="llama", vocab_size=151936, dim=896, n_layers=24,
+                      n_heads=14, n_kv_heads=2, head_dim=64, ffn_dim=4864,
+                      attn_bias=True, tie_embeddings=True,
+                      rope_theta=1000000.0, max_seq_len=32768),
+    "gemma": _mk(arch="llama", vocab_size=256000, dim=3072, n_layers=28,
+                 n_heads=16, n_kv_heads=16, head_dim=256, ffn_dim=24576,
+                 act="gelu_tanh", emb_scale=True, tie_embeddings=True,
+                 norm_weight_offset=1.0, max_seq_len=8192),
+}
+
+
+def get_config(name: str) -> ModelConfig:
+    base = name.split(":")[0]
+    if name in PRESETS:
+        return PRESETS[name]
+    if base in PRESETS:
+        return PRESETS[base]
+    raise KeyError(f"unknown model preset: {name!r}; known: {sorted(PRESETS)}")

@@ -1,0 +1,291 @@
+"""Decoder-only transformer family, pure functional JAX.
+
+This is the TPU-native replacement for the inference engine the reference
+operator delegates to the `ollama/ollama` image (llama.cpp/GGML, see
+/root/reference/pkg/model/pod.go:11 and SURVEY.md §2.2). Design choices are
+XLA-first, not a translation:
+
+- Layer params are **stacked** along a leading ``n_layers`` axis and the
+  forward pass runs ``lax.scan`` over layers → the block is traced/compiled
+  once regardless of depth (fast compiles for 80-layer 70B models).
+- Static shapes everywhere; prefill lengths are bucketed by the engine.
+- GQA is a grouped einsum (ops/attention.py) — K/V are never repeated in HBM.
+- fp32 for softmax/norm accumulation, bf16 (or int8-dequant) for matmuls so
+  the MXU stays fed.
+- KV cache updates are functional; the engine donates cache buffers so XLA
+  aliases them in-place.
+
+Params pytree layout (all leaves jnp arrays; layer leaves stacked on axis 0):
+
+  tok_emb   [V, D]
+  out_norm_w [D] (+ out_norm_b for layernorm archs)
+  lm_head   [D, V]       (absent when cfg.tie_embeddings)
+  lm_head_b [V]          (phi-2 only)
+  layers/
+    attn_norm_w [L, D] (+ attn_norm_b)
+    wq [L, D, H*hd]  wk [L, D, KvH*hd]  wv [L, D, KvH*hd]  wo [L, H*hd, D]
+    (bq, bk, bv, bo optional)
+    mlp_norm_w [L, D] (+ mlp_norm_b; absent when cfg.parallel_block)
+    w_gate [L, D, F] (gated only)  w_up [L, D, F]  w_down [L, F, D]
+    (b_up [L, F], b_down [L, D] optional)
+    q_norm_w / k_norm_w [L, hd] (qk_norm archs)
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.attention import attend, causal_mask, length_mask
+from ..ops.norms import layer_norm, rms_norm
+from ..ops.rope import apply_rope, rope_angles
+from .config import ModelConfig
+
+Params = Dict[str, Any]
+
+
+# --------------------------------------------------------------------------
+# init
+# --------------------------------------------------------------------------
+
+def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
+    """Random init (for tests/benchmarks; real weights come from gguf/)."""
+    L, D, F, V = cfg.n_layers, cfg.dim, cfg.ffn_dim, cfg.vocab_size
+    keys = iter(jax.random.split(key, 32))
+
+    def w(k, shape, scale=0.02):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype)
+
+    layers: Dict[str, jax.Array] = {
+        "attn_norm_w": jnp.ones((L, D), dtype),
+        "wq": w(next(keys), (L, D, cfg.q_dim)),
+        "wk": w(next(keys), (L, D, cfg.kv_dim)),
+        "wv": w(next(keys), (L, D, cfg.kv_dim)),
+        "wo": w(next(keys), (L, cfg.q_dim, D)),
+        "w_up": w(next(keys), (L, D, F)),
+        "w_down": w(next(keys), (L, F, D)),
+    }
+    if cfg.norm_type == "layernorm":
+        layers["attn_norm_b"] = jnp.zeros((L, D), dtype)
+    if not cfg.parallel_block:
+        layers["mlp_norm_w"] = jnp.ones((L, D), dtype)
+        if cfg.norm_type == "layernorm":
+            layers["mlp_norm_b"] = jnp.zeros((L, D), dtype)
+    if cfg.mlp_type == "gated":
+        layers["w_gate"] = w(next(keys), (L, D, F))
+    if cfg.attn_bias:
+        layers["bq"] = jnp.zeros((L, cfg.q_dim), dtype)
+        layers["bk"] = jnp.zeros((L, cfg.kv_dim), dtype)
+        layers["bv"] = jnp.zeros((L, cfg.kv_dim), dtype)
+    if cfg.out_bias:
+        layers["bo"] = jnp.zeros((L, D), dtype)
+        layers["b_up"] = jnp.zeros((L, F), dtype)
+        layers["b_down"] = jnp.zeros((L, D), dtype)
+    if cfg.qk_norm:
+        layers["q_norm_w"] = jnp.ones((L, cfg.head_dim), dtype)
+        layers["k_norm_w"] = jnp.ones((L, cfg.head_dim), dtype)
+
+    params: Params = {
+        "tok_emb": w(next(keys), (V, D)),
+        "out_norm_w": jnp.ones((D,), dtype),
+        "layers": layers,
+    }
+    if cfg.norm_type == "layernorm":
+        params["out_norm_b"] = jnp.zeros((D,), dtype)
+    if not cfg.tie_embeddings:
+        params["lm_head"] = w(next(keys), (D, V))
+    if cfg.out_bias:
+        params["lm_head_b"] = jnp.zeros((V,), dtype)
+    return params
+
+
+# --------------------------------------------------------------------------
+# building blocks
+# --------------------------------------------------------------------------
+
+def _norm(cfg: ModelConfig, x, w, b=None):
+    if cfg.norm_type == "layernorm":
+        return layer_norm(x, w, b, cfg.norm_eps)
+    return rms_norm(x, w, cfg.norm_eps, cfg.norm_weight_offset)
+
+
+def _act(cfg: ModelConfig, x):
+    if cfg.act == "silu":
+        return jax.nn.silu(x)
+    if cfg.act == "gelu":
+        return jax.nn.gelu(x, approximate=False)
+    return jax.nn.gelu(x, approximate=True)
+
+
+def _mlp(cfg: ModelConfig, lp, x):
+    if cfg.mlp_type == "gated":
+        g = _act(cfg, x @ lp["w_gate"])
+        u = x @ lp["w_up"]
+        return (g * u) @ lp["w_down"]
+    u = x @ lp["w_up"]
+    if "b_up" in lp:
+        u = u + lp["b_up"]
+    d = _act(cfg, u) @ lp["w_down"]
+    if "b_down" in lp:
+        d = d + lp["b_down"]
+    return d
+
+
+def _qkv(cfg: ModelConfig, lp, h, cos, sin):
+    B, T, _ = h.shape
+    q = h @ lp["wq"]
+    k = h @ lp["wk"]
+    v = h @ lp["wv"]
+    if "bq" in lp:
+        q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+    q = q.reshape(B, T, cfg.n_heads, cfg.head_dim)
+    k = k.reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+    v = v.reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+    if cfg.qk_norm:
+        q = rms_norm(q, lp["q_norm_w"], cfg.norm_eps)
+        k = rms_norm(k, lp["k_norm_w"], cfg.norm_eps)
+    q = apply_rope(q, cos, sin, cfg.rotary_dim)
+    k = apply_rope(k, cos, sin, cfg.rotary_dim)
+    return q, k, v
+
+
+def _proj_out(lp, attn_out, B, T):
+    o = attn_out.reshape(B, T, -1) @ lp["wo"]
+    if "bo" in lp:
+        o = o + lp["bo"]
+    return o
+
+
+def _block_chunk(cfg: ModelConfig, lp, x, cos, sin, mask, scale):
+    """One layer over a fresh chunk (no prior cache). Returns (x, (k, v))."""
+    B, T, _ = x.shape
+    h = _norm(cfg, x, lp["attn_norm_w"], lp.get("attn_norm_b"))
+    q, k, v = _qkv(cfg, lp, h, cos, sin)
+    attn = attend(q, k, v, mask, scale, cfg.attn_softcap)
+    attn = _proj_out(lp, attn, B, T)
+    if cfg.parallel_block:
+        x = x + attn + _mlp(cfg, lp, h)
+    else:
+        x = x + attn
+        h2 = _norm(cfg, x, lp["mlp_norm_w"], lp.get("mlp_norm_b"))
+        x = x + _mlp(cfg, lp, h2)
+    return x, (k, v)
+
+
+def _block_cached(cfg: ModelConfig, lp, x, cos, sin, k_cache, v_cache,
+                  write_pos, mask, scale):
+    """One layer with a KV cache. ``write_pos`` [B, T] are absolute slots for
+    the new tokens' K/V. Returns (x, k_cache, v_cache) updated."""
+    B, T, _ = x.shape
+    h = _norm(cfg, x, lp["attn_norm_w"], lp.get("attn_norm_b"))
+    q, k, v = _qkv(cfg, lp, h, cos, sin)
+    bidx = jnp.arange(B)[:, None]
+    k_cache = k_cache.at[bidx, write_pos].set(k.astype(k_cache.dtype))
+    v_cache = v_cache.at[bidx, write_pos].set(v.astype(v_cache.dtype))
+    attn = attend(q, k_cache, v_cache, mask, scale, cfg.attn_softcap)
+    attn = _proj_out(lp, attn, B, T)
+    if cfg.parallel_block:
+        x = x + attn + _mlp(cfg, lp, h)
+    else:
+        x = x + attn
+        h2 = _norm(cfg, x, lp["mlp_norm_w"], lp.get("mlp_norm_b"))
+        x = x + _mlp(cfg, lp, h2)
+    return x, k_cache, v_cache
+
+
+def _embed(cfg: ModelConfig, params: Params, tokens):
+    x = params["tok_emb"][tokens]
+    if cfg.emb_scale:
+        x = (x.astype(jnp.float32) * math.sqrt(cfg.dim)).astype(x.dtype)
+    return x
+
+
+def _unembed(cfg: ModelConfig, params: Params, x):
+    x = _norm(cfg, x, params["out_norm_w"], params.get("out_norm_b"))
+    head = params["tok_emb"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("btd,dv->btv", x, head,
+                        preferred_element_type=jnp.float32)
+    if "lm_head_b" in params:
+        logits = logits + params["lm_head_b"].astype(jnp.float32)
+    if cfg.logit_softcap:
+        logits = cfg.logit_softcap * jnp.tanh(logits / cfg.logit_softcap)
+    return logits
+
+
+# --------------------------------------------------------------------------
+# forward passes
+# --------------------------------------------------------------------------
+
+def prefill_chunk(params: Params, cfg: ModelConfig, tokens: jax.Array,
+                  n_valid: Optional[jax.Array] = None
+                  ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Process a fresh chunk at positions [0, T) with no prior cache.
+
+    tokens  [B, T] int32 (right-padded; padding is masked out of attention by
+            the causal structure for queries < n_valid — callers only read
+            logits at n_valid-1).
+    Returns (logits [B, T, V] fp32, k [L, B, T, KvH, hd], v [...]).
+    """
+    B, T = tokens.shape
+    scale = 1.0 / math.sqrt(cfg.head_dim)
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+    cos, sin = rope_angles(positions, cfg.rotary_dim, cfg.rope_theta,
+                           cfg.rope_scaling)
+    mask = causal_mask(T, T, 0, sliding_window=cfg.sliding_window)
+    mask = jnp.broadcast_to(mask, (B, 1, T, T))
+
+    x = _embed(cfg, params, tokens)
+
+    def body(x, lp):
+        x, (k, v) = _block_chunk(cfg, lp, x, cos, sin, mask, scale)
+        return x, (k, v)
+
+    x, (ks, vs) = lax.scan(body, x, params["layers"])
+    logits = _unembed(cfg, params, x)
+    return logits, ks, vs
+
+
+def forward_with_cache(params: Params, cfg: ModelConfig, tokens: jax.Array,
+                       k_cache: jax.Array, v_cache: jax.Array,
+                       lengths: jax.Array
+                       ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Extend sequences that already have ``lengths`` cached tokens.
+
+    tokens   [B, T] — T=1 is the decode step; T>1 is chunked prefill
+             continuation.
+    k_cache  [L, B, S, KvH, hd] (donate for in-place update)
+    lengths  [B] int32 — number of valid cached tokens per slot.
+    Returns (logits [B, T, V], k_cache, v_cache).
+    """
+    B, T = tokens.shape
+    L, _, S, _, _ = k_cache.shape
+    scale = 1.0 / math.sqrt(cfg.head_dim)
+    positions = lengths[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+    cos, sin = rope_angles(positions, cfg.rotary_dim, cfg.rope_theta,
+                           cfg.rope_scaling)
+    # key j (absolute slot) is visible to query at absolute pos p iff j <= p,
+    # within the sliding window; slots beyond the written region are garbage
+    # but satisfy j > p so they are masked.
+    k_pos = jnp.arange(S, dtype=jnp.int32)[None, None, :]
+    q_pos = positions[:, :, None]
+    ok = k_pos <= q_pos
+    if cfg.sliding_window:
+        ok = ok & (k_pos > q_pos - cfg.sliding_window)
+    mask = jnp.where(ok, 0.0, -1e30).astype(jnp.float32)[:, None, :, :]
+
+    x = _embed(cfg, params, tokens)
+
+    def body(x, layer_in):
+        lp, kc, vc = layer_in
+        x, kc, vc = _block_cached(cfg, lp, x, cos, sin, kc, vc, positions,
+                                  mask, scale)
+        return x, (kc, vc)
+
+    x, (k_cache, v_cache) = lax.scan(body, x,
+                                     (params["layers"], k_cache, v_cache))
+    logits = _unembed(cfg, params, x)
+    return logits, k_cache, v_cache

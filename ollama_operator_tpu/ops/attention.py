@@ -1,0 +1,70 @@
+"""Attention cores (pure-JAX reference paths).
+
+These are the semantics-defining implementations; ``ops/pallas`` provides
+TPU-tuned kernels that must match them bit-approximately. GQA is expressed as
+a grouped einsum (no materialised head repeat) so XLA keeps the MXU matmuls
+large and avoids an HBM-resident K/V copy.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30  # large-negative instead of -inf: keeps masked softmax NaN-free
+
+
+def _softcap(scores, cap: float):
+    if cap and cap > 0.0:
+        return cap * jnp.tanh(scores / cap)
+    return scores
+
+
+def attend(q, k, v, mask, scale: float, softcap: float = 0.0):
+    """Grouped-query attention.
+
+    q    [B, T, H, hd]
+    k, v [B, S, KvH, hd]
+    mask [B, 1, T, S] additive (0 or NEG_INF), broadcastable
+    →    [B, T, H, hd]
+    """
+    B, T, H, hd = q.shape
+    KvH = k.shape[2]
+    G = H // KvH
+    qg = q.reshape(B, T, KvH, G, hd)
+    # scores [B, KvH, G, T, S]
+    scores = jnp.einsum("btkgh,bskh->bkgts", qg, k,
+                        preferred_element_type=jnp.float32)
+    scores = scores * scale
+    scores = _softcap(scores, softcap)
+    scores = scores + mask[:, :, None, :, :]
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgts,bskh->btkgh", probs.astype(v.dtype), v)
+    return out.reshape(B, T, H, hd)
+
+
+def causal_mask(T: int, S: int, offset, dtype=jnp.float32,
+                sliding_window: int = 0):
+    """Additive [1, 1, T, S] mask. Query i sits at absolute position
+    offset + i; key j at absolute position j. Supports a sliding window
+    (mistral) when ``sliding_window > 0``."""
+    q_pos = offset + jnp.arange(T)[:, None]
+    k_pos = jnp.arange(S)[None, :]
+    ok = k_pos <= q_pos
+    if sliding_window:
+        ok = ok & (k_pos > q_pos - sliding_window)
+    return jnp.where(ok, 0.0, NEG_INF).astype(dtype)[None, None]
+
+
+def length_mask(lengths, S: int, dtype=jnp.float32, q_pos: Optional[jax.Array] = None,
+                sliding_window: int = 0):
+    """Additive [B, 1, 1, S] mask for decode: key j valid iff j < lengths[b].
+    ``q_pos`` (defaults to lengths-1) enables the sliding window check."""
+    k_pos = jnp.arange(S)[None, :]
+    ok = k_pos < lengths[:, None]
+    if sliding_window:
+        qp = (lengths - 1) if q_pos is None else q_pos
+        ok = ok & (k_pos > qp[:, None] - sliding_window)
+    return jnp.where(ok, 0.0, NEG_INF).astype(dtype)[:, None, None, :]

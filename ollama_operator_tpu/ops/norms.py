@@ -1,0 +1,29 @@
+"""Normalisation ops (fp32 accumulation, bf16 in/out — XLA fuses these into
+the surrounding matmuls, so no Pallas kernel is needed here)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def rms_norm(x, weight, eps: float, weight_offset: float = 0.0):
+    """RMSNorm with fp32 accumulation.
+
+    ``weight_offset=1.0`` implements gemma's convention of storing the scale
+    as (w - 1).
+    """
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    y = xf * lax.rsqrt(var + eps)
+    y = y * (weight_offset + weight.astype(jnp.float32))
+    return y.astype(x.dtype)
+
+
+def layer_norm(x, weight, bias, eps: float):
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+    y = (xf - mean) * lax.rsqrt(var + eps)
+    y = y * weight.astype(jnp.float32) + bias.astype(jnp.float32)
+    return y.astype(x.dtype)

@@ -1,0 +1,42 @@
+"""Rotary position embeddings.
+
+One convention everywhere: **half-split (NeoX/HF) layout** — the head dim is
+split into two halves rotated against each other. GGUF llama-family
+checkpoints store weights for the *interleaved* convention; the transcoder
+(gguf/transcode.py) permutes wq/wk rows at load time so this single
+implementation is correct for every arch. phi-2 style partial rotary is
+supported via ``rotary_dim < head_dim``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_angles(positions, rotary_dim: int, theta: float, scaling: float = 1.0):
+    """positions [..] int32 → (cos, sin) [.., rotary_dim//2] float32."""
+    half = rotary_dim // 2
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    pos = positions.astype(jnp.float32) / scaling
+    angles = pos[..., None] * inv_freq  # [.., half]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x, cos, sin, rotary_dim: int):
+    """x [B, T, H, head_dim]; cos/sin [B, T, rotary_dim//2].
+
+    Rotates the first ``rotary_dim`` channels (half-split), passes the rest
+    through unchanged.
+    """
+    half = rotary_dim // 2
+    x_rot = x[..., :rotary_dim].astype(jnp.float32)
+    x1 = x_rot[..., :half]
+    x2 = x_rot[..., half:]
+    c = cos[:, :, None, :]
+    s = sin[:, :, None, :]
+    r1 = x1 * c - x2 * s
+    r2 = x2 * c + x1 * s
+    out = jnp.concatenate([r1, r2], axis=-1).astype(x.dtype)
+    if rotary_dim == x.shape[-1]:
+        return out
+    return jnp.concatenate([out, x[..., rotary_dim:]], axis=-1)

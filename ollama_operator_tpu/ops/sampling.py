@@ -1,0 +1,121 @@
+"""Token sampling — fully jittable, batched over slots.
+
+Replaces llama.cpp's sampler chain (delegated by the reference via the
+ollama image, /root/reference/pkg/model/pod.go:11) with a vectorised
+implementation: every slot in the decode batch samples in one fused XLA
+program, with per-slot parameters carried as arrays so heterogeneous
+requests share one compiled decode step.
+
+Supported (matching the Ollama API options surface): temperature, top_k,
+top_p, min_p, repeat_penalty (over a token-count buffer), presence/frequency
+penalty, per-slot PRNG seed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    """Per-slot sampling parameters, all shape [B] arrays (jit-friendly)."""
+
+    temperature: jax.Array   # [B] f32; <=0 → greedy
+    top_k: jax.Array         # [B] i32; <=0 → off
+    top_p: jax.Array         # [B] f32; >=1 → off
+    min_p: jax.Array         # [B] f32; <=0 → off
+    repeat_penalty: jax.Array    # [B] f32; 1.0 → off
+    presence_penalty: jax.Array  # [B] f32
+    frequency_penalty: jax.Array  # [B] f32
+
+    @staticmethod
+    def make(B: int, temperature=0.8, top_k=40, top_p=0.9, min_p=0.0,
+             repeat_penalty=1.1, presence_penalty=0.0, frequency_penalty=0.0):
+        f = lambda v: jnp.full((B,), v, jnp.float32)
+        return SamplingParams(
+            temperature=f(temperature), top_k=jnp.full((B,), top_k, jnp.int32),
+            top_p=f(top_p), min_p=f(min_p), repeat_penalty=f(repeat_penalty),
+            presence_penalty=f(presence_penalty),
+            frequency_penalty=f(frequency_penalty))
+
+
+jax.tree_util.register_dataclass(
+    SamplingParams,
+    data_fields=["temperature", "top_k", "top_p", "min_p", "repeat_penalty",
+                 "presence_penalty", "frequency_penalty"],
+    meta_fields=[])
+
+
+def apply_penalties(logits, token_counts, sp: SamplingParams):
+    """logits [B, V] f32; token_counts [B, V] i32 (counts in the window)."""
+    seen = token_counts > 0
+    rp = sp.repeat_penalty[:, None]
+    penalised = jnp.where(logits > 0, logits / rp, logits * rp)
+    logits = jnp.where(seen, penalised, logits)
+    logits = logits - sp.presence_penalty[:, None] * seen.astype(jnp.float32)
+    logits = logits - sp.frequency_penalty[:, None] * token_counts.astype(
+        jnp.float32)
+    return logits
+
+
+def _mask_top_k(logits, top_k):
+    """Vectorised top-k: keep logits >= the k-th largest (per row)."""
+    V = logits.shape[-1]
+    sorted_desc = -jnp.sort(-logits, axis=-1)           # [B, V]
+    k = jnp.clip(top_k, 1, V)
+    kth = jnp.take_along_axis(sorted_desc, (k - 1)[:, None], axis=-1)
+    keep = logits >= kth
+    keep = jnp.where((top_k > 0)[:, None], keep, True)
+    return jnp.where(keep, logits, NEG_INF)
+
+
+def _mask_top_p(logits, top_p):
+    """Nucleus sampling mask over softmax probabilities."""
+    sort_idx = jnp.argsort(-logits, axis=-1)
+    sorted_logits = jnp.take_along_axis(logits, sort_idx, axis=-1)
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # keep tokens until cumulative prob exceeds top_p (always keep the first)
+    keep_sorted = (cum - probs) < top_p[:, None]
+    keep = jnp.zeros_like(keep_sorted).at[
+        jnp.arange(logits.shape[0])[:, None], sort_idx].set(keep_sorted)
+    keep = jnp.where((top_p < 1.0)[:, None], keep, True)
+    return jnp.where(keep, logits, NEG_INF)
+
+
+def _mask_min_p(logits, min_p):
+    probs = jax.nn.softmax(logits, axis=-1)
+    pmax = jnp.max(probs, axis=-1, keepdims=True)
+    keep = probs >= (min_p[:, None] * pmax)
+    keep = jnp.where((min_p > 0.0)[:, None], keep, True)
+    return jnp.where(keep, logits, NEG_INF)
+
+
+def sample(logits, token_counts, sp: SamplingParams, key):
+    """logits [B, V] f32 → tokens [B] i32.
+
+    Greedy where temperature <= 0, otherwise penalised + top-k/p/min-p
+    filtered categorical sampling. ``key`` is either a single PRNG key
+    (shared across the batch) or a [B] array of per-slot keys (each request
+    carries its own seed, per the Ollama API `seed` option).
+    """
+    logits = apply_penalties(logits, token_counts, sp)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    t = jnp.maximum(sp.temperature, 1e-6)[:, None]
+    scaled = logits / t
+    scaled = _mask_top_k(scaled, sp.top_k)
+    scaled = _mask_top_p(scaled, sp.top_p)
+    scaled = _mask_min_p(scaled, sp.min_p)
+    if getattr(key, "ndim", 0) >= 1:  # per-slot keys
+        sampled = jax.vmap(jax.random.categorical)(key, scaled)
+    else:
+        sampled = jax.random.categorical(key, scaled, axis=-1)
+    sampled = sampled.astype(jnp.int32)
+
+    return jnp.where(sp.temperature <= 0.0, greedy, sampled)

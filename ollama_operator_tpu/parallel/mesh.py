@@ -1,0 +1,58 @@
+"""Device mesh construction.
+
+The reference's only parallelism is K8s replica fan-out
+(/root/reference/pkg/model/model.go:72 — spec.replicas → Deployment
+replicas); every other axis here is new TPU-native capability (SURVEY.md
+§2.3). Axis conventions used across the framework:
+
+  dp — data parallel (batch). Maps across slices / DCN, or within a slice.
+  tp — tensor parallel (heads / ffn / vocab). Must ride ICI.
+  sp — sequence parallel (ring attention for long context).
+
+Single-chip and CPU-test configs are just degenerate meshes (1×1×1 or
+8-device CPU meshes via --xla_force_host_platform_device_count=8).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXES = ("dp", "sp", "tp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshPlan:
+    """How to lay devices out. dp is outermost (slowest-varying) so tp stays
+    on physically adjacent devices (ICI); sp sits between."""
+
+    dp: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    @property
+    def n_devices(self) -> int:
+        return self.dp * self.sp * self.tp
+
+    @staticmethod
+    def for_devices(n: int, tp: Optional[int] = None, sp: int = 1) -> "MeshPlan":
+        """Default plan: all tensor-parallel unless told otherwise."""
+        if tp is None:
+            tp = n // sp
+        dp = n // (tp * sp)
+        plan = MeshPlan(dp=dp, sp=sp, tp=tp)
+        assert plan.n_devices == n, f"{plan} does not cover {n} devices"
+        return plan
+
+
+def make_mesh(plan: MeshPlan, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    if devices is None:
+        devices = jax.devices()
+    if len(devices) < plan.n_devices:
+        raise ValueError(f"need {plan.n_devices} devices, have {len(devices)}")
+    arr = np.array(devices[: plan.n_devices]).reshape(plan.dp, plan.sp, plan.tp)
+    return Mesh(arr, AXES)

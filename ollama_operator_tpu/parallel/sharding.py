@@ -1,0 +1,118 @@
+"""Parameter and activation sharding specs (GSPMD / NamedSharding).
+
+Megatron-style tensor parallelism expressed declaratively: column-parallel
+q/k/v/gate/up, row-parallel o/down, vocab-parallel embedding + lm_head. XLA
+inserts the all-reduces (psum over "tp") at the row-parallel boundaries —
+there is no hand-written collective on the dense path (the ring-attention
+path in ring_attention.py is the exception, by design).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.config import ModelConfig
+
+# Specs for stacked layer leaves: leading axis is n_layers (never sharded).
+_LAYER_SPECS: Dict[str, P] = {
+    "attn_norm_w": P(None, None),
+    "attn_norm_b": P(None, None),
+    "mlp_norm_w": P(None, None),
+    "mlp_norm_b": P(None, None),
+    "wq": P(None, None, "tp"),
+    "wk": P(None, None, "tp"),
+    "wv": P(None, None, "tp"),
+    "wo": P(None, "tp", None),
+    "bq": P(None, "tp"),
+    "bk": P(None, "tp"),
+    "bv": P(None, "tp"),
+    "bo": P(None, None),
+    "w_gate": P(None, None, "tp"),
+    "w_up": P(None, None, "tp"),
+    "w_down": P(None, "tp", None),
+    "b_up": P(None, "tp"),
+    "b_down": P(None, None),
+    "q_norm_w": P(None, None),
+    "k_norm_w": P(None, None),
+}
+
+_TOP_SPECS: Dict[str, P] = {
+    "tok_emb": P("tp", None),   # vocab-parallel; XLA all-gathers the lookup
+    "out_norm_w": P(None),
+    "out_norm_b": P(None),
+    "lm_head": P(None, "tp"),
+    "lm_head_b": P("tp"),
+}
+
+
+def resolve_specs(cfg: Optional[ModelConfig], mesh: Optional[Mesh]
+                  ) -> tuple[Dict[str, P], Dict[str, P]]:
+    """(top_specs, layer_specs) adjusted for GQA divisibility.
+
+    With few KV heads (llama2:70b has 8) and a wide tp axis, KV heads may
+    not divide tp; the standard layout then replicates K/V (and their
+    projections) across the extra tp ways — each replica serves its local
+    group of Q heads. Vocab-parallel embedding falls back to replication if
+    the vocab doesn't divide tp.
+    """
+    top, layer = dict(_TOP_SPECS), dict(_LAYER_SPECS)
+    if cfg is None or mesh is None:
+        return top, layer
+    tp = mesh.shape.get("tp", 1)
+    if tp > 1 and cfg.n_kv_heads % tp != 0:
+        layer.update(wk=P(None, None, None), wv=P(None, None, None),
+                     bk=P(None, None), bv=P(None, None))
+    if tp > 1 and cfg.vocab_size % tp != 0:
+        top.update(tok_emb=P(None, None), lm_head=P(None, None),
+                   lm_head_b=P(None))
+    return top, layer
+
+
+def params_pspec_tree(params: Dict[str, Any],
+                      cfg: Optional[ModelConfig] = None,
+                      mesh: Optional[Mesh] = None) -> Dict[str, Any]:
+    top, layer = resolve_specs(cfg, mesh)
+    out: Dict[str, Any] = {}
+    for k, v in params.items():
+        if k == "layers":
+            out[k] = {lk: layer[lk] for lk in v}
+        else:
+            out[k] = top[k]
+    return out
+
+
+def params_sharding_tree(params: Dict[str, Any], mesh: Mesh,
+                         cfg: Optional[ModelConfig] = None) -> Dict[str, Any]:
+    return jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec),
+        params_pspec_tree(params, cfg, mesh),
+        is_leaf=lambda x: isinstance(x, P))
+
+
+def shard_params(params: Dict[str, Any], mesh: Mesh,
+                 cfg: Optional[ModelConfig] = None) -> Dict[str, Any]:
+    """device_put the params pytree with TP/vocab-parallel layout."""
+    shardings = params_sharding_tree(params, mesh, cfg)
+    return jax.device_put(params, shardings)
+
+
+def kv_cache_pspec(cfg: Optional[ModelConfig] = None,
+                   mesh: Optional[Mesh] = None) -> P:
+    """KV cache [L, B, S, KvH, hd]: batch on dp, heads on tp (replicated
+    over tp when KV heads don't divide it — see resolve_specs)."""
+    if cfg is not None and mesh is not None:
+        tp = mesh.shape.get("tp", 1)
+        dp = mesh.shape.get("dp", 1)
+        b = "dp" if dp > 1 else None
+        if tp > 1 and cfg.n_kv_heads % tp != 0:
+            return P(None, b, None, None, None)
+        return P(None, b, None, "tp" if tp > 1 else None, None)
+    return P(None, "dp", None, "tp", None)
+
+
+def act_pspec() -> P:
+    """Activations [B, T, D]: batch on dp."""
+    return P("dp", None, None)

@@ -1,0 +1,1 @@
+from .engine import Engine, EngineConfig  # noqa: F401

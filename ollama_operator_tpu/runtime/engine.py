@@ -1,0 +1,264 @@
+"""The serving engine: jitted prefill/decode over a slot-based KV cache.
+
+This (plus scheduler.py) is the TPU-native replacement for llama.cpp's
+server loop that the reference delegates to the ollama image
+(/root/reference/pkg/model/pod.go:14-66, `ollama serve`). Design:
+
+- **Slots**: a fixed decode batch of ``max_slots`` sequences. Every decode
+  step advances all slots in ONE compiled XLA program (continuous batching —
+  new requests are prefilled into free slots while others keep decoding).
+- **Static shapes**: prefill lengths are padded to power-of-two buckets, so
+  the number of compiled programs is O(log max_seq_len), not O(requests).
+- **Donation**: KV caches and per-slot state are donated into each step, so
+  XLA updates them in place in HBM — no cache copies per token.
+- **Sharding**: params are TP-sharded (parallel/sharding.py), caches sharded
+  [L, B@dp, S, KvH@tp, hd]; the same code runs single-chip (trivial mesh) or
+  over a v5e slice.
+- All sampling is on-device (ops/sampling.py); the only per-step
+  host↔device traffic is the sampled token ids [B] coming back for
+  streaming/stop handling.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.config import ModelConfig
+from ..models import decoder
+from ..ops import sampling
+from ..parallel.sharding import kv_cache_pspec, params_sharding_tree
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    max_slots: int = 8
+    max_seq_len: int = 2048
+    cache_dtype: Any = jnp.bfloat16
+    min_prefill_bucket: int = 64
+    repeat_last_n: int = 64  # Ollama default penalty window (doc only for now)
+
+
+def prefill_buckets(max_seq_len: int, min_bucket: int):
+    b, out = min_bucket, []
+    while b < max_seq_len:
+        out.append(b)
+        b *= 2
+    out.append(max_seq_len)
+    return out
+
+
+@dataclasses.dataclass
+class SlotOptions:
+    """Host-side per-request sampling options (Ollama API options subset)."""
+    temperature: float = 0.8
+    top_k: int = 40
+    top_p: float = 0.9
+    min_p: float = 0.0
+    repeat_penalty: float = 1.1
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
+    seed: int = -1
+
+
+class Engine:
+    """Owns device state and the compiled step functions."""
+
+    def __init__(self, cfg: ModelConfig, params, mesh: Optional[Mesh] = None,
+                 ecfg: EngineConfig = EngineConfig()):
+        self.cfg = cfg
+        self.ecfg = ecfg
+        self.mesh = mesh
+        B, S = ecfg.max_slots, min(ecfg.max_seq_len, cfg.max_seq_len)
+        self.n_slots, self.max_seq = B, S
+        L, KvH, hd = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
+        V = cfg.vocab_size
+
+        if mesh is not None:
+            dp = mesh.shape.get("dp", 1)
+            assert B % dp == 0, f"max_slots {B} must divide dp {dp}"
+            cache_sh = NamedSharding(mesh, kv_cache_pspec(cfg, mesh))
+            slot_sh = NamedSharding(mesh, P("dp" if dp > 1 else None))
+            self._param_sh = params_sharding_tree(params, mesh, cfg)
+            params = jax.device_put(params, self._param_sh)
+        else:
+            cache_sh = slot_sh = None
+            self._param_sh = None
+        self.params = params
+
+        def zeros(shape, dtype, sh):
+            arr = jnp.zeros(shape, dtype)
+            return jax.device_put(arr, sh) if sh is not None else arr
+
+        cache_shape = (L, B, S, KvH, hd)
+        self.k_cache = zeros(cache_shape, ecfg.cache_dtype, cache_sh)
+        self.v_cache = zeros(cache_shape, ecfg.cache_dtype, cache_sh)
+        self.lengths = zeros((B,), jnp.int32, slot_sh)
+        self.counts = zeros((B, V), jnp.int32, slot_sh)
+        self.last_tokens = zeros((B,), jnp.int32, slot_sh)
+        self.active = np.zeros((B,), bool)  # host-side mask
+        self._active_dev = zeros((B,), jnp.int32, slot_sh)
+
+        # per-slot sampling params, host mirror + device arrays
+        self._opts: Dict[int, SlotOptions] = {}
+        self.sp = sampling.SamplingParams.make(B)
+        base = jax.random.key(0)
+        self.keys = jax.vmap(jax.random.fold_in)(
+            jnp.broadcast_to(base, (B,)), jnp.arange(B))
+
+        self._buckets = prefill_buckets(S, ecfg.min_prefill_bucket)
+        self._compile_fns()
+
+    # ------------------------------------------------------------------
+    # compiled programs
+    # ------------------------------------------------------------------
+    def _compile_fns(self):
+        cfg = self.cfg
+
+        @partial(jax.jit, static_argnames=())
+        def _prefill(params, tokens, n_valid, sp_row, key):
+            """B=1 prefill of a padded chunk; returns first sampled token,
+            the chunk K/V, and the prompt token-count row."""
+            logits, ks, vs = decoder.prefill_chunk(params, cfg, tokens)
+            last = jax.lax.dynamic_index_in_dim(
+                logits[0], n_valid - 1, axis=0, keepdims=False)
+            T = tokens.shape[1]
+            valid = (jnp.arange(T) < n_valid).astype(jnp.int32)
+            counts_row = jnp.zeros((cfg.vocab_size,), jnp.int32
+                                   ).at[tokens[0]].add(valid)
+            tok = sampling.sample(last[None], counts_row[None], sp_row,
+                                  key[None])[0]
+            counts_row = counts_row.at[tok].add(1)
+            return tok, ks, vs, counts_row
+
+        @partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4))
+        def _insert(k_cache, v_cache, lengths, counts, last_tokens,
+                    ks, vs, slot, n_valid, tok, counts_row):
+            k_cache = jax.lax.dynamic_update_slice(
+                k_cache, ks.astype(k_cache.dtype), (0, slot, 0, 0, 0))
+            v_cache = jax.lax.dynamic_update_slice(
+                v_cache, vs.astype(v_cache.dtype), (0, slot, 0, 0, 0))
+            lengths = lengths.at[slot].set(n_valid)
+            counts = counts.at[slot].set(counts_row)
+            last_tokens = last_tokens.at[slot].set(tok)
+            return k_cache, v_cache, lengths, counts, last_tokens
+
+        @partial(jax.jit, donate_argnums=(1, 2, 3, 4, 5, 7))
+        def _decode(params, k_cache, v_cache, lengths, counts, last_tokens,
+                    sp, keys, active):
+            logits, k_cache, v_cache = decoder.forward_with_cache(
+                params, cfg, last_tokens[:, None], k_cache, v_cache, lengths)
+            step_keys = jax.vmap(jax.random.fold_in)(keys, lengths)
+            toks = sampling.sample(logits[:, 0], counts, sp, step_keys)
+            B = toks.shape[0]
+            counts = counts.at[jnp.arange(B), toks].add(active)
+            lengths = lengths + active
+            last_tokens = jnp.where(active == 1, toks, last_tokens)
+            return toks, k_cache, v_cache, lengths, counts, last_tokens, keys
+
+        @partial(jax.jit, donate_argnums=(0, 1, 2))
+        def _release(lengths, counts, last_tokens, slot):
+            lengths = lengths.at[slot].set(0)
+            counts = counts.at[slot].set(0)
+            last_tokens = last_tokens.at[slot].set(0)
+            return lengths, counts, last_tokens
+
+        self._prefill_fn = _prefill
+        self._insert_fn = _insert
+        self._decode_fn = _decode
+        self._release_fn = _release
+
+    # ------------------------------------------------------------------
+    # host API
+    # ------------------------------------------------------------------
+    def free_slots(self):
+        return [i for i in range(self.n_slots) if not self.active[i]]
+
+    def bucket_for(self, n: int) -> int:
+        for b in self._buckets:
+            if n <= b:
+                return b
+        raise ValueError(f"prompt of {n} tokens exceeds max_seq_len "
+                         f"{self.max_seq}")
+
+    def _sp_row(self, o: SlotOptions):
+        return sampling.SamplingParams(
+            temperature=jnp.array([o.temperature], jnp.float32),
+            top_k=jnp.array([o.top_k], jnp.int32),
+            top_p=jnp.array([o.top_p], jnp.float32),
+            min_p=jnp.array([o.min_p], jnp.float32),
+            repeat_penalty=jnp.array([o.repeat_penalty], jnp.float32),
+            presence_penalty=jnp.array([o.presence_penalty], jnp.float32),
+            frequency_penalty=jnp.array([o.frequency_penalty], jnp.float32))
+
+    def _rebuild_sp(self):
+        opts = [self._opts.get(i, SlotOptions()) for i in range(self.n_slots)]
+        self.sp = sampling.SamplingParams(
+            temperature=jnp.array([o.temperature for o in opts], jnp.float32),
+            top_k=jnp.array([o.top_k for o in opts], jnp.int32),
+            top_p=jnp.array([o.top_p for o in opts], jnp.float32),
+            min_p=jnp.array([o.min_p for o in opts], jnp.float32),
+            repeat_penalty=jnp.array(
+                [o.repeat_penalty for o in opts], jnp.float32),
+            presence_penalty=jnp.array(
+                [o.presence_penalty for o in opts], jnp.float32),
+            frequency_penalty=jnp.array(
+                [o.frequency_penalty for o in opts], jnp.float32))
+
+    def admit(self, slot: int, prompt: np.ndarray,
+              opts: SlotOptions = SlotOptions()) -> int:
+        """Prefill ``prompt`` into ``slot``; returns the first sampled token."""
+        assert not self.active[slot], f"slot {slot} busy"
+        n = int(prompt.shape[0])
+        if n >= self.max_seq:
+            raise ValueError(f"prompt too long: {n} >= {self.max_seq}")
+        bucket = self.bucket_for(n)
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :n] = prompt
+        seed = opts.seed if opts.seed >= 0 else (hash((slot, n)) & 0x7FFFFFFF)
+        key = jax.random.key(seed)
+        self.keys = self.keys.at[slot].set(key)
+        tok, ks, vs, counts_row = self._prefill_fn(
+            self.params, jnp.asarray(tokens), jnp.int32(n),
+            self._sp_row(opts), key)
+        (self.k_cache, self.v_cache, self.lengths, self.counts,
+         self.last_tokens) = self._insert_fn(
+            self.k_cache, self.v_cache, self.lengths, self.counts,
+            self.last_tokens, ks[:, :, :], vs[:, :, :], jnp.int32(slot),
+            jnp.int32(n), tok, counts_row)
+        self.active[slot] = True
+        self._opts[slot] = opts
+        self._rebuild_sp()
+        self._active_dev = jnp.asarray(self.active.astype(np.int32))
+        return int(tok)
+
+    def decode(self) -> np.ndarray:
+        """One decode step for every slot; returns sampled tokens [B] (only
+        entries where self.active were valid at call time)."""
+        (toks, self.k_cache, self.v_cache, self.lengths, self.counts,
+         self.last_tokens, self.keys) = self._decode_fn(
+            self.params, self.k_cache, self.v_cache, self.lengths,
+            self.counts, self.last_tokens, self.sp, self.keys,
+            self._active_dev)
+        return np.asarray(toks)
+
+    def release(self, slot: int):
+        self.active[slot] = False
+        self._opts.pop(slot, None)
+        self.lengths, self.counts, self.last_tokens = self._release_fn(
+            self.lengths, self.counts, self.last_tokens, jnp.int32(slot))
+        self._active_dev = jnp.asarray(self.active.astype(np.int32))
+
+    def slot_length(self, slot: int) -> int:
+        return int(np.asarray(self.lengths)[slot])
+
+    @property
+    def kv_bytes(self) -> int:
+        return 2 * int(np.prod(self.k_cache.shape)) * self.k_cache.dtype.itemsize

@@ -1,0 +1,69 @@
+"""Sampler semantics: masks, penalties, greedy/seeded behaviour."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ollama_operator_tpu.ops import sampling
+
+
+def mk_sp(B, **kw):
+    return sampling.SamplingParams.make(B, **kw)
+
+
+def test_greedy_when_temperature_zero():
+    logits = jnp.array([[0.1, 2.0, -1.0, 0.5]])
+    sp = mk_sp(1, temperature=0.0, repeat_penalty=1.0)
+    tok = sampling.sample(logits, jnp.zeros((1, 4), jnp.int32), sp,
+                          jax.random.key(0))
+    assert int(tok[0]) == 1
+
+
+def test_top_k_restricts_support():
+    logits = jnp.array([[0.0, 5.0, 4.0, -2.0, 1.0]])
+    sp = mk_sp(1, temperature=1.0, top_k=2, top_p=1.0, repeat_penalty=1.0)
+    counts = jnp.zeros((1, 5), jnp.int32)
+    seen = set()
+    for i in range(50):
+        tok = sampling.sample(logits, counts, sp, jax.random.key(i))
+        seen.add(int(tok[0]))
+    assert seen <= {1, 2}
+
+
+def test_top_p_keeps_head_of_distribution():
+    # one dominant token (p≈0.99) → top_p=0.5 must always pick it
+    logits = jnp.array([[10.0, 1.0, 0.0, -1.0]])
+    sp = mk_sp(1, temperature=1.0, top_k=0, top_p=0.5, repeat_penalty=1.0)
+    counts = jnp.zeros((1, 4), jnp.int32)
+    for i in range(20):
+        tok = sampling.sample(logits, counts, sp, jax.random.key(i))
+        assert int(tok[0]) == 0
+
+
+def test_repeat_penalty_discourages_seen_tokens():
+    logits = jnp.array([[2.0, 1.9]])
+    counts = jnp.array([[5, 0]], jnp.int32)  # token 0 was generated already
+    sp = mk_sp(1, temperature=0.0, repeat_penalty=2.0)
+    tok = sampling.sample(logits, counts, sp, jax.random.key(0))
+    assert int(tok[0]) == 1  # 2.0/2.0 = 1.0 < 1.9
+
+
+def test_per_slot_seeds_reproducible():
+    logits = jnp.tile(jnp.array([[0.0, 0.1, 0.2, 0.3]]), (2, 1))
+    sp = mk_sp(2, temperature=1.0, top_k=0, top_p=1.0, repeat_penalty=1.0)
+    counts = jnp.zeros((2, 4), jnp.int32)
+    keys = jnp.stack([jax.random.key(7), jax.random.key(7)])
+    t1 = sampling.sample(logits, counts, sp, keys)
+    t2 = sampling.sample(logits, counts, sp, keys)
+    np.testing.assert_array_equal(np.asarray(t1), np.asarray(t2))
+    assert int(t1[0]) == int(t1[1])  # same seed, same logits → same token
+
+
+def test_frequency_and_presence_penalty():
+    logits = jnp.array([[1.0, 0.9]])
+    counts = jnp.array([[3, 0]], jnp.int32)
+    sp = sampling.SamplingParams.make(1, temperature=0.0, repeat_penalty=1.0,
+                                      presence_penalty=0.05,
+                                      frequency_penalty=0.05)
+    tok = sampling.sample(logits, counts, sp, jax.random.key(0))
+    assert int(tok[0]) == 1  # 1.0 - 0.05 - 3*0.05 = 0.8 < 0.9
