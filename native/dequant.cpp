@@ -1,0 +1,196 @@
+// Native dequantisation kernels for GGUF block formats.
+//
+// The TPU-native analog of the C++ weight-loading path the reference
+// delegates to (llama.cpp inside the ollama image — SURVEY.md §2.2): the
+// transcode step (GGUF → bf16) is host-side and bandwidth-bound, so the hot
+// formats get vectorisable C++ loops here. Exposed with a plain C ABI and
+// loaded from Python via ctypes (gguf/native.py); gguf/dequant.py holds the
+// semantic reference implementations these must match bit-for-bit (checked
+// in tests/test_native.py).
+//
+// Build: g++ -O3 -march=native -shared -fPIC -o libtpuop_dequant.so dequant.cpp
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+// f16 -> f32 without F16C dependence: table-free bit manipulation
+inline float f16_to_f32(uint16_t h) {
+    uint32_t sign = (uint32_t)(h & 0x8000) << 16;
+    uint32_t exp = (h >> 10) & 0x1F;
+    uint32_t mant = h & 0x3FF;
+    uint32_t bits;
+    if (exp == 0) {
+        if (mant == 0) {
+            bits = sign;
+        } else {  // subnormal: normalise
+            int e = -1;
+            uint32_t m = mant;
+            do { m <<= 1; e++; } while (!(m & 0x400));
+            bits = sign | ((uint32_t)(127 - 15 - e) << 23) | ((m & 0x3FF) << 13);
+        }
+    } else if (exp == 31) {
+        bits = sign | 0x7F800000u | (mant << 13);
+    } else {
+        bits = sign | ((exp - 15 + 127) << 23) | (mant << 13);
+    }
+    float f;
+    std::memcpy(&f, &bits, 4);
+    return f;
+}
+
+}  // namespace
+
+extern "C" {
+
+void dq_f16(const uint8_t* raw, float* out, int64_t n) {
+    const uint16_t* h = reinterpret_cast<const uint16_t*>(raw);
+    for (int64_t i = 0; i < n; i++) out[i] = f16_to_f32(h[i]);
+}
+
+void dq_bf16(const uint8_t* raw, float* out, int64_t n) {
+    const uint16_t* h = reinterpret_cast<const uint16_t*>(raw);
+    for (int64_t i = 0; i < n; i++) {
+        uint32_t bits = (uint32_t)h[i] << 16;
+        std::memcpy(&out[i], &bits, 4);
+    }
+}
+
+// Q4_0: 18-byte blocks of 32: f16 d | 16 nibble bytes. x = (q - 8) d
+void dq_q4_0(const uint8_t* raw, float* out, int64_t n_blocks) {
+    for (int64_t b = 0; b < n_blocks; b++) {
+        const uint8_t* p = raw + b * 18;
+        float d = f16_to_f32(*(const uint16_t*)p);
+        const uint8_t* qs = p + 2;
+        float* y = out + b * 32;
+        for (int i = 0; i < 16; i++) {
+            y[i] = ((int)(qs[i] & 0xF) - 8) * d;
+            y[i + 16] = ((int)(qs[i] >> 4) - 8) * d;
+        }
+    }
+}
+
+// Q8_0: 34-byte blocks of 32: f16 d | 32 int8. x = q d
+void dq_q8_0(const uint8_t* raw, float* out, int64_t n_blocks) {
+    for (int64_t b = 0; b < n_blocks; b++) {
+        const uint8_t* p = raw + b * 34;
+        float d = f16_to_f32(*(const uint16_t*)p);
+        const int8_t* qs = reinterpret_cast<const int8_t*>(p + 2);
+        float* y = out + b * 32;
+        for (int i = 0; i < 32; i++) y[i] = qs[i] * d;
+    }
+}
+
+static inline void get_scale_min_k4(int j, const uint8_t* s, uint8_t* sc,
+                                    uint8_t* mn) {
+    if (j < 4) {
+        *sc = s[j] & 63;
+        *mn = s[j + 4] & 63;
+    } else {
+        *sc = (s[j + 4] & 0xF) | ((s[j - 4] >> 6) << 4);
+        *mn = (s[j + 4] >> 4) | ((s[j] >> 6) << 4);
+    }
+}
+
+// Q4_K: 144-byte super-blocks of 256
+void dq_q4_k(const uint8_t* raw, float* out, int64_t n_blocks) {
+    for (int64_t b = 0; b < n_blocks; b++) {
+        const uint8_t* p = raw + b * 144;
+        float d = f16_to_f32(*(const uint16_t*)p);
+        float dmin = f16_to_f32(*(const uint16_t*)(p + 2));
+        const uint8_t* scales = p + 4;
+        const uint8_t* q = p + 16;
+        float* y = out + b * 256;
+        int is = 0;
+        for (int j = 0; j < 256; j += 64) {
+            uint8_t sc, mn;
+            get_scale_min_k4(is, scales, &sc, &mn);
+            float d1 = d * sc, m1 = dmin * mn;
+            get_scale_min_k4(is + 1, scales, &sc, &mn);
+            float d2 = d * sc, m2 = dmin * mn;
+            for (int l = 0; l < 32; l++) *y++ = d1 * (q[l] & 0xF) - m1;
+            for (int l = 0; l < 32; l++) *y++ = d2 * (q[l] >> 4) - m2;
+            q += 32;
+            is += 2;
+        }
+    }
+}
+
+// Q5_K: 176-byte super-blocks of 256
+void dq_q5_k(const uint8_t* raw, float* out, int64_t n_blocks) {
+    for (int64_t b = 0; b < n_blocks; b++) {
+        const uint8_t* p = raw + b * 176;
+        float d = f16_to_f32(*(const uint16_t*)p);
+        float dmin = f16_to_f32(*(const uint16_t*)(p + 2));
+        const uint8_t* scales = p + 4;
+        const uint8_t* qh = p + 16;
+        const uint8_t* ql = p + 48;
+        float* y = out + b * 256;
+        int is = 0;
+        uint8_t u1 = 1, u2 = 2;
+        for (int j = 0; j < 256; j += 64) {
+            uint8_t sc, mn;
+            get_scale_min_k4(is, scales, &sc, &mn);
+            float d1 = d * sc, m1 = dmin * mn;
+            get_scale_min_k4(is + 1, scales, &sc, &mn);
+            float d2 = d * sc, m2 = dmin * mn;
+            for (int l = 0; l < 32; l++)
+                *y++ = d1 * ((ql[l] & 0xF) + ((qh[l] & u1) ? 16 : 0)) - m1;
+            for (int l = 0; l < 32; l++)
+                *y++ = d2 * ((ql[l] >> 4) + ((qh[l] & u2) ? 16 : 0)) - m2;
+            ql += 32;
+            is += 2;
+            u1 <<= 2;
+            u2 <<= 2;
+        }
+    }
+}
+
+// Q6_K: 210-byte super-blocks of 256
+void dq_q6_k(const uint8_t* raw, float* out, int64_t n_blocks) {
+    for (int64_t b = 0; b < n_blocks; b++) {
+        const uint8_t* p = raw + b * 210;
+        const uint8_t* ql = p;
+        const uint8_t* qh = p + 128;
+        const int8_t* sc = reinterpret_cast<const int8_t*>(p + 192);
+        float d = f16_to_f32(*(const uint16_t*)(p + 208));
+        float* y = out + b * 256;
+        for (int n = 0; n < 2; n++) {
+            for (int l = 0; l < 32; l++) {
+                int is = l / 16;
+                int q1 = (int)((ql[l] & 0xF) | (((qh[l] >> 0) & 3) << 4)) - 32;
+                int q2 = (int)((ql[l + 32] & 0xF) | (((qh[l] >> 2) & 3) << 4)) - 32;
+                int q3 = (int)((ql[l] >> 4) | (((qh[l] >> 4) & 3) << 4)) - 32;
+                int q4 = (int)((ql[l + 32] >> 4) | (((qh[l] >> 6) & 3) << 4)) - 32;
+                y[l] = d * sc[is] * q1;
+                y[l + 32] = d * sc[is + 2] * q2;
+                y[l + 64] = d * sc[is + 4] * q3;
+                y[l + 96] = d * sc[is + 6] * q4;
+            }
+            y += 128;
+            ql += 64;
+            qh += 32;
+            sc += 8;
+        }
+    }
+}
+
+// f32 -> bf16 (round-to-nearest-even), for the transcode output path.
+// NaNs are passed through truncated (quiet bit forced) instead of rounded —
+// adding the RNE bias to a NaN payload could carry into the exponent and
+// produce Inf.
+void f32_to_bf16(const float* in, uint16_t* out, int64_t n) {
+    for (int64_t i = 0; i < n; i++) {
+        uint32_t bits;
+        std::memcpy(&bits, &in[i], 4);
+        if ((bits & 0x7F800000u) == 0x7F800000u && (bits & 0x007FFFFFu)) {
+            out[i] = (uint16_t)((bits >> 16) | 0x0040);  // quiet NaN
+            continue;
+        }
+        uint32_t rounding = 0x7FFF + ((bits >> 16) & 1);
+        out[i] = (uint16_t)((bits + rounding) >> 16);
+    }
+}
+
+}  // extern "C"

@@ -1,0 +1,2 @@
+from .reader import GGUFFile, GGUFTensor  # noqa: F401
+from . import dequant  # noqa: F401

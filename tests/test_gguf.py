@@ -1,0 +1,262 @@
+"""GGUF container + dequantisation tests.
+
+The k-quant vectorised kernels are checked against straight scalar
+transliterations of the ggml per-block loops (independent implementation of
+the same layout), and the legacy formats against quantise→dequantise round
+trips.
+"""
+
+import numpy as np
+import pytest
+
+from ollama_operator_tpu.gguf import dequant as DQ
+from ollama_operator_tpu.gguf import reader as R
+from ollama_operator_tpu.gguf import writer as W
+
+rng = np.random.default_rng(0)
+
+
+# ---------------------------------------------------------------------------
+# scalar references (per-block loops, mirroring ggml's dequantize_row_*)
+# ---------------------------------------------------------------------------
+
+def ref_q2_k(raw):
+    out = []
+    for blk in raw.reshape(-1, 84):
+        scales = blk[:16]
+        qs = blk[16:80]
+        d = np.frombuffer(blk[80:82].tobytes(), np.float16)[0].astype(np.float32)
+        dmin = np.frombuffer(blk[82:84].tobytes(), np.float16)[0].astype(np.float32)
+        y = np.zeros(256, np.float32)
+        i = 0
+        is_ = 0
+        for n in (0, 128):
+            q = qs[n // 4: n // 4 + 32]
+            for shift in (0, 2, 4, 6):
+                for half in range(2):
+                    sc = scales[is_]; is_ += 1
+                    for l in range(16):
+                        qv = (q[half * 16 + l] >> shift) & 3
+                        y[i] = d * (sc & 0xF) * qv - dmin * (sc >> 4)
+                        i += 1
+        out.append(y)
+    return np.concatenate(out)
+
+
+def ref_q3_k(raw):
+    out = []
+    for blk in raw.reshape(-1, 110):
+        hmask = blk[:32]
+        qs = blk[32:96]
+        sb = blk[96:108]
+        d = np.frombuffer(blk[108:110].tobytes(), np.float16)[0].astype(np.float32)
+        aux = np.frombuffer(sb.tobytes(), np.uint32).copy()
+        k1, k2 = 0x03030303, 0x0F0F0F0F
+        tmp = int(aux[2])
+        a = np.zeros(4, np.uint32)
+        a[0] = (int(aux[0]) & k2) | (((tmp >> 0) & k1) << 4)
+        a[1] = (int(aux[1]) & k2) | (((tmp >> 2) & k1) << 4)
+        a[2] = ((int(aux[0]) >> 4) & k2) | (((tmp >> 4) & k1) << 4)
+        a[3] = ((int(aux[1]) >> 4) & k2) | (((tmp >> 6) & k1) << 4)
+        scales = a.view(np.int8).astype(np.int32) - 32
+        y = np.zeros(256, np.float32)
+        i = 0
+        is_ = 0
+        m = 1
+        for n in (0, 128):
+            q = qs[n // 4: n // 4 + 32]
+            for shift in (0, 2, 4, 6):
+                for half in range(2):
+                    sc = scales[is_]; is_ += 1
+                    for l in range(16):
+                        ll = half * 16 + l
+                        qv = int((q[ll] >> shift) & 3) - (0 if (hmask[ll] & m) else 4)
+                        y[i] = d * sc * qv
+                        i += 1
+                m <<= 1
+        out.append(y)
+    return np.concatenate(out)
+
+
+def _gsm(j, sb):
+    if j < 4:
+        return sb[j] & 63, sb[j + 4] & 63
+    return ((sb[j + 4] & 0xF) | ((sb[j - 4] >> 6) << 4),
+            (sb[j + 4] >> 4) | ((sb[j] >> 6) << 4))
+
+
+def ref_q4_k(raw):
+    out = []
+    for blk in raw.reshape(-1, 144):
+        d = np.frombuffer(blk[0:2].tobytes(), np.float16)[0].astype(np.float32)
+        dmin = np.frombuffer(blk[2:4].tobytes(), np.float16)[0].astype(np.float32)
+        sb = blk[4:16]
+        qs = blk[16:]
+        y = np.zeros(256, np.float32)
+        i = 0
+        is_ = 0
+        qoff = 0
+        for j in range(0, 256, 64):
+            sc1, m1 = _gsm(is_, sb)
+            sc2, m2 = _gsm(is_ + 1, sb)
+            for l in range(32):
+                y[i] = d * sc1 * (qs[qoff + l] & 0xF) - dmin * m1; i += 1
+            for l in range(32):
+                y[i] = d * sc2 * (qs[qoff + l] >> 4) - dmin * m2; i += 1
+            qoff += 32
+            is_ += 2
+        out.append(y)
+    return np.concatenate(out)
+
+
+def ref_q5_k(raw):
+    out = []
+    for blk in raw.reshape(-1, 176):
+        d = np.frombuffer(blk[0:2].tobytes(), np.float16)[0].astype(np.float32)
+        dmin = np.frombuffer(blk[2:4].tobytes(), np.float16)[0].astype(np.float32)
+        sb = blk[4:16]
+        qh = blk[16:48]
+        ql = blk[48:]
+        y = np.zeros(256, np.float32)
+        i = 0
+        is_ = 0
+        qoff = 0
+        u1, u2 = 1, 2
+        for j in range(0, 256, 64):
+            sc1, m1 = _gsm(is_, sb)
+            sc2, m2 = _gsm(is_ + 1, sb)
+            for l in range(32):
+                q = (ql[qoff + l] & 0xF) + (16 if (qh[l] & u1) else 0)
+                y[i] = d * sc1 * q - dmin * m1; i += 1
+            for l in range(32):
+                q = (ql[qoff + l] >> 4) + (16 if (qh[l] & u2) else 0)
+                y[i] = d * sc2 * q - dmin * m2; i += 1
+            qoff += 32
+            is_ += 2
+            u1 <<= 2
+            u2 <<= 2
+        out.append(y)
+    return np.concatenate(out)
+
+
+def ref_q6_k(raw):
+    out = []
+    for blk in raw.reshape(-1, 210):
+        ql = blk[:128]
+        qh = blk[128:192]
+        scales = blk[192:208].view(np.int8)
+        d = np.frombuffer(blk[208:210].tobytes(), np.float16)[0].astype(np.float32)
+        y = np.zeros(256, np.float32)
+        yo, lo, ho, so = 0, 0, 0, 0
+        for n in (0, 128):
+            for l in range(32):
+                is_ = l // 16
+                q1 = int((ql[lo + l] & 0xF) | (((qh[ho + l] >> 0) & 3) << 4)) - 32
+                q2 = int((ql[lo + l + 32] & 0xF) | (((qh[ho + l] >> 2) & 3) << 4)) - 32
+                q3 = int((ql[lo + l] >> 4) | (((qh[ho + l] >> 4) & 3) << 4)) - 32
+                q4 = int((ql[lo + l + 32] >> 4) | (((qh[ho + l] >> 6) & 3) << 4)) - 32
+                y[yo + l] = d * scales[so + is_] * q1
+                y[yo + l + 32] = d * scales[so + is_ + 2] * q2
+                y[yo + l + 64] = d * scales[so + is_ + 4] * q3
+                y[yo + l + 96] = d * scales[so + is_ + 6] * q4
+            yo += 128
+            lo += 64
+            ho += 32
+            so += 8
+        out.append(y)
+    return np.concatenate(out)
+
+
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("fn_vec,fn_ref,block_bytes", [
+    (DQ.dq_q2_k, ref_q2_k, 84),
+    (DQ.dq_q3_k, ref_q3_k, 110),
+    (DQ.dq_q4_k, ref_q4_k, 144),
+    (DQ.dq_q5_k, ref_q5_k, 176),
+    (DQ.dq_q6_k, ref_q6_k, 210),
+])
+def test_kquant_vectorised_matches_scalar(fn_vec, fn_ref, block_bytes):
+    raw = rng.integers(0, 256, size=4 * block_bytes, dtype=np.uint8)
+    # avoid inf/NaN from random f16 scale bytes: zero the exponent top bits
+    # of d/dmin candidates is fiddly; instead accept inf-free check by
+    # filtering non-finite lanes identically in both impls
+    v = fn_vec(raw)
+    r = fn_ref(raw)
+    mask = np.isfinite(r)
+    np.testing.assert_allclose(v[mask], r[mask], rtol=1e-5, atol=1e-5)
+    assert (np.isfinite(v) == mask).all()
+
+
+def test_q8_0_roundtrip():
+    x = rng.standard_normal(32 * 64).astype(np.float32)
+    raw = np.frombuffer(W.quantize_q8_0(x), np.uint8)
+    y = DQ.dq_q8_0(raw)
+    err = np.abs(x - y).max() / np.abs(x).max()
+    assert err < 0.01
+
+
+def test_q4_0_roundtrip():
+    x = rng.standard_normal(32 * 64).astype(np.float32)
+    raw = np.frombuffer(W.quantize_q4_0(x), np.uint8)
+    y = DQ.dq_q4_0(raw)
+    err = np.abs(x - y).mean() / np.abs(x).mean()
+    assert err < 0.2  # 4-bit is lossy
+
+
+def test_q5_0_layout():
+    """Hand-built block: d=1.0, all nibbles + high bits set to known values."""
+    d = np.float16(1.0).tobytes()
+    qh = (0b10101010101010101010101010101010).to_bytes(4, "little")
+    qs = bytes([0x21] * 16)  # low nibble 1, high nibble 2
+    raw = np.frombuffer(d + qh + qs, np.uint8)
+    y = DQ.dq_q5_0(raw)
+    # elem 0: q = 1 | (bit0=0)<<4 = 1 → 1-16 = -15
+    assert y[0] == -15.0
+    # elem 1: q = 1 | (bit1=1)<<4 = 17 → 1
+    assert y[1] == 1.0
+    # elem 16: q = 2 | (bit16=0)<<4 → 2-16 = -14
+    assert y[16] == -14.0
+    assert y[17] == 2.0 - 16.0 + 16.0  # bit17=1 → 18-16 = 2
+
+
+def test_writer_reader_roundtrip(tmp_path):
+    path = str(tmp_path / "t.gguf")
+    w = W.GGUFWriter(path)
+    w.add_meta("general.architecture", "llama")
+    w.add_meta("llama.block_count", 2)
+    w.add_meta("llama.rope.freq_base", 10000.0)
+    w.add_meta("tokenizer.ggml.tokens", ["<s>", "</s>", "hello"])
+    w.add_meta("tokenizer.ggml.scores", [0.0, -1.0, -2.0])
+    w.add_meta("tokenizer.ggml.bos_token_id", 0)
+    w.add_meta("some.flag", True)
+    a = rng.standard_normal((8, 16)).astype(np.float32)
+    b = rng.standard_normal((4, 32)).astype(np.float32)
+    w.add_tensor_f32("a.weight", a)
+    w.add_tensor_f16("b.weight", b)
+    qx = rng.standard_normal(64).astype(np.float32)
+    w.add_tensor_raw("c.weight", (2, 32), R.GGML_Q8_0, W.quantize_q8_0(qx))
+    w.write()
+
+    with R.GGUFFile(path) as f:
+        assert f.arch == "llama"
+        assert f.field("block_count") == 2
+        assert f.field("rope.freq_base") == pytest.approx(10000.0)
+        assert f.metadata["tokenizer.ggml.tokens"] == ["<s>", "</s>", "hello"]
+        assert f.metadata["some.flag"] is True
+        ta = f.tensors["a.weight"]
+        assert ta.shape == (8, 16)
+        np.testing.assert_array_equal(
+            DQ.dequantize_tensor(f, ta), a)
+        tb = f.tensors["b.weight"]
+        np.testing.assert_allclose(
+            DQ.dequantize_tensor(f, tb), b, atol=1e-3)
+        tc = f.tensors["c.weight"]
+        yc = DQ.dequantize_tensor(f, tc)
+        assert yc.shape == (2, 32)
+        assert np.abs(yc.reshape(-1) - qx).max() < 0.05
+
+
+def test_unsupported_type_raises():
+    with pytest.raises(NotImplementedError):
+        DQ.dequantize(np.zeros(16, np.uint8), 99, (16,))

@@ -1,0 +1,55 @@
+"""Native (C++) dequant kernels must match the numpy reference bit-for-bit
+on finite values."""
+
+import numpy as np
+import pytest
+
+from ollama_operator_tpu.gguf import dequant as DQ
+from ollama_operator_tpu.gguf import native as N
+from ollama_operator_tpu.gguf import reader as R
+
+rng = np.random.default_rng(11)
+
+pytestmark = pytest.mark.skipif(N.load() is None,
+                                reason="no C++ toolchain available")
+
+
+@pytest.mark.parametrize("ggml_type,numpy_fn,block_bytes", [
+    (R.GGML_Q4_0, DQ.dq_q4_0, 18),
+    (R.GGML_Q8_0, DQ.dq_q8_0, 34),
+    (R.GGML_Q4_K, DQ.dq_q4_k, 144),
+    (R.GGML_Q5_K, DQ.dq_q5_k, 176),
+    (R.GGML_Q6_K, DQ.dq_q6_k, 210),
+])
+def test_native_matches_numpy(ggml_type, numpy_fn, block_bytes):
+    raw = rng.integers(0, 256, size=8 * block_bytes, dtype=np.uint8)
+    ref = numpy_fn(raw)
+    out = N.native_dequantize(raw, ggml_type)
+    assert out is not None
+    mask = np.isfinite(ref)
+    np.testing.assert_array_equal(out[mask], ref[mask])
+    assert (np.isfinite(out) == mask).all()
+
+
+def test_native_f16():
+    vals = rng.standard_normal(256).astype(np.float16)
+    raw = vals.view(np.uint8)
+    out = N.native_dequantize(np.ascontiguousarray(raw), R.GGML_F16)
+    np.testing.assert_array_equal(out, vals.astype(np.float32))
+
+
+def test_native_bf16_roundtrip():
+    lib = N.load()
+    x = rng.standard_normal(1024).astype(np.float32)
+    out = np.empty(1024, np.uint16)
+    lib.f32_to_bf16(x, out, 1024)
+    import ml_dtypes
+    ref = x.astype(ml_dtypes.bfloat16).view(np.uint16)
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_install_speeds_up_dispatch():
+    assert N.install()
+    raw = rng.integers(0, 256, size=4 * 144, dtype=np.uint8)
+    y = DQ.dequantize(raw, R.GGML_Q4_K, (4, 256))
+    assert y.shape == (4, 256)

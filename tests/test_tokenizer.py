@@ -1,0 +1,116 @@
+"""Tokenizer tests over synthetic SPM and GPT-2 vocabularies."""
+
+from ollama_operator_tpu.tokenizer import Tokenizer, StreamDecoder
+from ollama_operator_tpu.tokenizer.tokenizer import (
+    TT_BYTE, TT_CONTROL, TT_NORMAL, _BYTE_ENC)
+
+
+def spm_tok(extra_tokens=(), extra_scores=(), **kw):
+    tokens = ["<unk>", "<s>", "</s>", "▁", "a", "b", "c", "ab", "▁a", "bc"]
+    scores = [0.0, 0.0, 0.0, -1.0, -2.0, -2.0, -2.0, -0.5, -0.4, -0.6]
+    types = [2, 3, 3] + [TT_NORMAL] * 7
+    tokens += list(extra_tokens)
+    scores += list(extra_scores)
+    types += [TT_BYTE] * len(extra_tokens)
+    return Tokenizer("llama", tokens, scores, types, bos_id=1, eos_id=2, **kw)
+
+
+def test_spm_basic_merge():
+    t = spm_tok()
+    # " a bc" → ▁a ▁ b c → merges: "▁a"(-0.4), "bc"(-0.6)
+    ids = t.encode("a bc")
+    assert ids[0] == 1  # bos
+    assert ids[1:] == [t.vocab["▁a"], t.vocab["▁"], t.vocab["bc"]]
+
+
+def test_spm_merge_order_prefers_higher_score():
+    t = spm_tok()
+    # "ab" alone (after prefix "▁ab"): ▁,a,b → "▁a" (-0.4) beats "ab" (-0.5)
+    ids = t.encode("ab")
+    assert ids[1:] == [t.vocab["▁a"], t.vocab["b"]]
+
+
+def test_spm_byte_fallback():
+    byte_toks = [f"<0x{b:02X}>" for b in range(256)]
+    t = spm_tok(extra_tokens=byte_toks, extra_scores=[0.0] * 256)
+    ids = t.encode("é", add_bos=False)  # é = 0xC3 0xA9, not in vocab
+    assert [t.tokens[i] for i in ids[-2:]] == ["<0xC3>", "<0xA9>"]
+    assert t.decode(ids) == " é"  # add_space_prefix
+
+
+def test_spm_decode_roundtrip():
+    t = spm_tok()
+    ids = t.encode("a bc ab", add_bos=False)
+    assert t.decode(ids) == " a bc ab"
+
+
+def test_special_token_parsing():
+    tokens = ["<unk>", "<s>", "</s>", "▁", "h", "i", "<|eot|>"]
+    scores = [0.0] * 7
+    types = [2, 3, 3, 1, 1, 1, TT_CONTROL]
+    t = Tokenizer("llama", tokens, scores, types, bos_id=1, eos_id=2)
+    ids = t.encode("hi<|eot|>", add_bos=False)
+    assert ids[-1] == 6
+    assert 6 not in t.encode("hi<|eot|>", add_bos=False,
+                             parse_special=False)
+
+
+def gpt2_tok():
+    # byte-level pieces for h,e,l,o + merges up to "hello"
+    base = [_BYTE_ENC[ord(c)] for c in "helo "]
+    pieces = base + ["he", "ll", "hell", "hello", "<|end|>"]
+    merges = [f"{_BYTE_ENC[ord('h')]} {_BYTE_ENC[ord('e')]}",
+              f"{_BYTE_ENC[ord('l')]} {_BYTE_ENC[ord('l')]}",
+              "he ll", "hell " + _BYTE_ENC[ord('o')]]
+    types = [TT_NORMAL] * (len(pieces) - 1) + [TT_CONTROL]
+    return Tokenizer("gpt2", pieces, None, types, merges=merges,
+                     bos_id=-1, eos_id=len(pieces) - 1, add_bos=False)
+
+
+def test_gpt2_bpe_merges():
+    t = gpt2_tok()
+    ids = t.encode("hello")
+    assert [t.tokens[i] for i in ids] == ["hello"]
+    assert t.decode(ids) == "hello"
+
+
+def test_gpt2_partial_merge_and_unknown_bytes():
+    t = gpt2_tok()
+    ids = t.encode("hell")
+    assert [t.tokens[i] for i in ids] == ["hell"]
+    ids2 = t.encode("ho")  # no merge for "ho"
+    assert len(ids2) == 2
+    assert t.decode(ids2) == "ho"
+
+
+def test_stream_decoder_utf8_boundary():
+    byte_toks = [f"<0x{b:02X}>" for b in range(256)]
+    t = spm_tok(extra_tokens=byte_toks, extra_scores=[0.0] * 256)
+    sd = StreamDecoder(t)
+    id_c3 = t.vocab["<0xC3>"]
+    id_a9 = t.vocab["<0xA9>"]
+    assert sd.feed(id_c3) == ""       # incomplete utf-8 held back
+    assert sd.feed(id_a9) == "é"
+    assert sd.feed(t.vocab["a"]) == "a"
+    assert sd.flush() == ""
+
+
+def test_eog_detection():
+    t = spm_tok()
+    assert t.is_eog(2)
+    assert not t.is_eog(4)
+
+
+def test_from_gguf_metadata():
+    md = {
+        "tokenizer.ggml.model": "llama",
+        "tokenizer.ggml.tokens": ["<unk>", "<s>", "</s>", "▁", "x"],
+        "tokenizer.ggml.scores": [0.0, 0.0, 0.0, -1.0, -2.0],
+        "tokenizer.ggml.token_type": [2, 3, 3, 1, 1],
+        "tokenizer.ggml.bos_token_id": 1,
+        "tokenizer.ggml.eos_token_id": 2,
+        "tokenizer.ggml.add_bos_token": True,
+    }
+    t = Tokenizer.from_gguf_metadata(md)
+    assert t.bos_id == 1 and t.eos_id == 2 and t.n_vocab == 5
+    assert t.encode("x")[0] == 1

@@ -1,0 +1,171 @@
+"""GGUF→params transcoding: name mapping, transposes, rope-layout fix,
+store cache round trip, and end-to-end logits equivalence."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ollama_operator_tpu.gguf import transcode as TC
+from ollama_operator_tpu.gguf import writer as W
+from ollama_operator_tpu.gguf.reader import GGUFFile
+from ollama_operator_tpu.models import config as cfglib
+from ollama_operator_tpu.models import decoder
+from ollama_operator_tpu.ops.rope import apply_rope, rope_angles
+
+rng = np.random.default_rng(7)
+
+
+def permute_to_interleaved(w_out_in: np.ndarray, n_heads: int) -> np.ndarray:
+    """Inverse of transcode._unpermute_rope (HF→Meta style permute)."""
+    out, inn = w_out_in.shape
+    hd = out // n_heads
+    return (w_out_in.reshape(n_heads, 2, hd // 2, inn)
+            .transpose(0, 2, 1, 3).reshape(out, inn))
+
+
+def interleaved_rope(x: np.ndarray, positions: np.ndarray,
+                     theta: float) -> np.ndarray:
+    """Reference rope in the Meta/llama.cpp 'NORM' convention: rotation i
+    acts on channel pair (2i, 2i+1). x [T, H, hd]."""
+    T, H, hd = x.shape
+    half = hd // 2
+    inv = 1.0 / (theta ** (np.arange(half) / half))
+    ang = positions[:, None] * inv  # [T, half]
+    cos, sin = np.cos(ang), np.sin(ang)
+    out = x.copy()
+    x1 = x[..., 0::2]
+    x2 = x[..., 1::2]
+    out[..., 0::2] = x1 * cos[:, None, :] - x2 * sin[:, None, :]
+    out[..., 1::2] = x2 * cos[:, None, :] + x1 * sin[:, None, :]
+    return out
+
+
+def test_rope_unpermute_preserves_attention_scores():
+    """half-split rope on unpermuted weights ≡ interleaved rope on original
+    weights, as far as attention scores are concerned."""
+    D, H, hd, T = 32, 4, 16, 5
+    theta = 10000.0
+    wq_gguf = rng.standard_normal((H * hd, D)).astype(np.float32)
+    wk_gguf = rng.standard_normal((H * hd, D)).astype(np.float32)
+    x = rng.standard_normal((T, D)).astype(np.float32)
+    pos = np.arange(T).astype(np.float32)
+
+    # reference path (llama.cpp semantics)
+    q_ref = (x @ wq_gguf.T).reshape(T, H, hd)
+    k_ref = (x @ wk_gguf.T).reshape(T, H, hd)
+    q_ref = interleaved_rope(q_ref, pos, theta)
+    k_ref = interleaved_rope(k_ref, pos, theta)
+    scores_ref = np.einsum("thd,shd->hts", q_ref, k_ref)
+
+    # our path
+    wq = TC._unpermute_rope(wq_gguf, H).T
+    wk = TC._unpermute_rope(wk_gguf, H).T
+    q = (x @ wq).reshape(1, T, H, hd)
+    k = (x @ wk).reshape(1, T, H, hd)
+    cos, sin = rope_angles(jnp.asarray(pos[None]), hd, theta)
+    q2 = np.asarray(apply_rope(jnp.asarray(q), cos, sin, hd))[0]
+    k2 = np.asarray(apply_rope(jnp.asarray(k), cos, sin, hd))[0]
+    scores = np.einsum("thd,shd->hts", q2, k2)
+
+    np.testing.assert_allclose(scores, scores_ref, rtol=1e-4, atol=1e-4)
+
+
+def write_tiny_llama_gguf(path: str, cfg, params):
+    """Export decoder params as a llama.cpp-convention GGUF (transposed,
+    q/k re-permuted to the interleaved layout)."""
+    w = W.GGUFWriter(path)
+    w.add_meta("general.architecture", "llama")
+    w.add_meta("llama.block_count", cfg.n_layers)
+    w.add_meta("llama.embedding_length", cfg.dim)
+    w.add_meta("llama.attention.head_count", cfg.n_heads)
+    w.add_meta("llama.attention.head_count_kv", cfg.n_kv_heads)
+    w.add_meta("llama.attention.key_length", cfg.head_dim)
+    w.add_meta("llama.feed_forward_length", cfg.ffn_dim)
+    w.add_meta("llama.context_length", cfg.max_seq_len)
+    w.add_meta("llama.rope.freq_base", cfg.rope_theta)
+    w.add_meta("llama.attention.layer_norm_rms_epsilon", cfg.norm_eps)
+    toks = [f"t{i}" for i in range(cfg.vocab_size)]
+    w.add_meta("tokenizer.ggml.model", "llama")
+    w.add_meta("tokenizer.ggml.tokens", toks)
+    w.add_meta("tokenizer.ggml.scores", [0.0] * cfg.vocab_size)
+    w.add_meta("tokenizer.ggml.token_type", [1] * cfg.vocab_size)
+
+    P = lambda a: np.ascontiguousarray(np.asarray(a, np.float32))
+    w.add_tensor_f32("token_embd.weight", P(params["tok_emb"]))
+    w.add_tensor_f32("output_norm.weight", P(params["out_norm_w"]))
+    w.add_tensor_f32("output.weight", P(params["lm_head"]).T)
+    lp = params["layers"]
+    for i in range(cfg.n_layers):
+        pre = f"blk.{i}."
+        w.add_tensor_f32(pre + "attn_norm.weight", P(lp["attn_norm_w"][i]))
+        w.add_tensor_f32(pre + "attn_q.weight", permute_to_interleaved(
+            P(lp["wq"][i]).T, cfg.n_heads))
+        w.add_tensor_f32(pre + "attn_k.weight", permute_to_interleaved(
+            P(lp["wk"][i]).T, cfg.n_kv_heads))
+        w.add_tensor_f32(pre + "attn_v.weight", P(lp["wv"][i]).T)
+        w.add_tensor_f32(pre + "attn_output.weight", P(lp["wo"][i]).T)
+        w.add_tensor_f32(pre + "ffn_norm.weight", P(lp["mlp_norm_w"][i]))
+        w.add_tensor_f32(pre + "ffn_gate.weight", P(lp["w_gate"][i]).T)
+        w.add_tensor_f32(pre + "ffn_up.weight", P(lp["w_up"][i]).T)
+        w.add_tensor_f32(pre + "ffn_down.weight", P(lp["w_down"][i]).T)
+    w.write()
+
+
+def test_gguf_roundtrip_logits_match(tmp_path):
+    """Params → GGUF (llama.cpp layout) → transcode → identical logits."""
+    cfg = cfglib.PRESETS["tiny"]
+    params = decoder.init_params(cfg, jax.random.PRNGKey(3), dtype=jnp.float32)
+    path = str(tmp_path / "tiny.gguf")
+    write_tiny_llama_gguf(path, cfg, params)
+
+    with GGUFFile(path) as f:
+        cfg2 = TC.config_from_gguf(f)
+        assert cfg2.dim == cfg.dim
+        assert cfg2.n_kv_heads == cfg.n_kv_heads
+        assert cfg2.head_dim == cfg.head_dim
+        params2 = TC.load_params(f, cfg2, dtype=np.float32)
+
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (1, 9)))
+    ref, _, _ = decoder.prefill_chunk(params, cfg, tokens)
+    p2 = jax.tree_util.tree_map(jnp.asarray, params2)
+    out, _, _ = decoder.prefill_chunk(p2, cfg2, tokens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_store_cache_roundtrip(tmp_path):
+    cfg = cfglib.PRESETS["tiny"]
+    params = decoder.init_params(cfg, jax.random.PRNGKey(5), dtype=jnp.float32)
+    gguf_path = str(tmp_path / "m.gguf")
+    write_tiny_llama_gguf(gguf_path, cfg, params)
+
+    cache = str(tmp_path / "cache")
+    digest = TC.content_fingerprint(gguf_path)
+    cfg1, params1, tok1 = TC.load_model(gguf_path, cache_dir=cache,
+                                        dtype=np.float32)
+    # second load must come from the store (delete the gguf to prove it;
+    # pass the digest explicitly as a registry-driven caller would)
+    import os
+    os.remove(gguf_path)
+    cfg2, params2, tok2 = TC.load_model(gguf_path, cache_dir=cache,
+                                        dtype=np.float32, digest=digest)
+    assert cfg1 == cfg2
+    assert tok1["tokenizer.ggml.model"] == "llama"
+    for (k1, v1), (k2, v2) in zip(
+            sorted(TC._flatten(params1)), sorted(TC._flatten(params2))):
+        assert k1 == k2
+        np.testing.assert_array_equal(np.asarray(v1), np.asarray(v2))
+
+
+def test_bf16_transcode(tmp_path):
+    import ml_dtypes
+    cfg = cfglib.PRESETS["tiny"]
+    params = decoder.init_params(cfg, jax.random.PRNGKey(5), dtype=jnp.float32)
+    gguf_path = str(tmp_path / "m.gguf")
+    write_tiny_llama_gguf(gguf_path, cfg, params)
+    cfg1, params1, _ = TC.load_model(gguf_path,
+                                     cache_dir=str(tmp_path / "c"),
+                                     dtype=ml_dtypes.bfloat16)
+    assert params1["tok_emb"].dtype == ml_dtypes.bfloat16
+    x = jnp.asarray(params1["tok_emb"])
+    assert x.dtype == jnp.bfloat16
