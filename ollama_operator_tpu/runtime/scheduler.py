@@ -1,0 +1,207 @@
+"""Continuous-batching scheduler: the host-side loop around the engine.
+
+This is the serving loop of the model server — the piece the reference gets
+from `ollama serve` inside the delegated container
+(/root/reference/pkg/model/pod.go:14-66). One daemon thread owns the engine:
+
+  admit waiting requests into free slots (prefill) → one decode step for all
+  active slots → fan tokens out to per-request queues → retire finished
+  slots → repeat; park when idle.
+
+Requests are token-in/token-out here; text concerns (detokenisation, stop
+strings, templates) live a layer up in server/. Cancellation is cooperative:
+the slot is released on the next loop iteration.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from .engine import Engine, SlotOptions
+
+
+@dataclasses.dataclass
+class RequestStats:
+    n_prompt: int = 0
+    n_generated: int = 0
+    t_submit: float = 0.0
+    t_admitted: float = 0.0
+    t_first_token: float = 0.0
+    t_done: float = 0.0
+
+    @property
+    def ttft_s(self) -> float:
+        return max(self.t_first_token - self.t_submit, 0.0)
+
+    @property
+    def decode_tok_s(self) -> float:
+        dur = self.t_done - self.t_first_token
+        if dur <= 0 or self.n_generated <= 1:
+            return 0.0
+        return (self.n_generated - 1) / dur
+
+
+class Request:
+    _ids = iter(range(1, 1 << 62))
+    _ids_lock = threading.Lock()
+
+    def __init__(self, prompt_ids: Sequence[int], opts: SlotOptions,
+                 max_tokens: int, eog_ids: frozenset):
+        with Request._ids_lock:
+            self.id = next(Request._ids)
+        self.prompt_ids = np.asarray(prompt_ids, np.int32)
+        self.opts = opts
+        self.max_tokens = max_tokens
+        self.eog_ids = eog_ids
+        self.out: queue.Queue = queue.Queue()
+        self.cancelled = threading.Event()
+        self.stats = RequestStats(n_prompt=len(self.prompt_ids),
+                                  t_submit=time.monotonic())
+        self.slot: Optional[int] = None
+        self.error: Optional[str] = None
+
+    def cancel(self):
+        self.cancelled.set()
+
+    def tokens(self) -> Iterator[int]:
+        """Blocking iterator over generated token ids."""
+        while True:
+            kind, payload = self.out.get()
+            if kind == "token":
+                yield payload
+            elif kind == "done":
+                return
+            else:  # error
+                raise RuntimeError(payload)
+
+
+class Scheduler:
+    def __init__(self, engine: Engine, max_queue: int = 256):
+        self.engine = engine
+        self._waiting: queue.Queue = queue.Queue(maxsize=max_queue)
+        self._running: List[Optional[Request]] = [None] * engine.n_slots
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self.total_generated = 0
+        self.total_prompt = 0
+        self.finished: List[RequestStats] = []  # ring of recent stats
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="tpu-scheduler")
+        self._thread.start()
+
+    # ------------------------------------------------------------------
+    def submit(self, prompt_ids: Sequence[int],
+               opts: SlotOptions = SlotOptions(),
+               max_tokens: int = 128,
+               eog_ids: frozenset = frozenset()) -> Request:
+        if len(prompt_ids) >= self.engine.max_seq:
+            raise ValueError(
+                f"prompt of {len(prompt_ids)} tokens exceeds context window "
+                f"{self.engine.max_seq}")
+        req = Request(prompt_ids, opts, max_tokens, eog_ids)
+        self._waiting.put(req)
+        self._wake.set()
+        return req
+
+    def shutdown(self):
+        self._stop.set()
+        self._wake.set()
+        self._thread.join(timeout=10)
+        # drain everything still attached so no caller blocks forever on
+        # req.tokens() after an unload (model swap, server shutdown)
+        for slot, req in enumerate(self._running):
+            if req is not None:
+                self._running[slot] = None
+                req.stats.t_done = time.monotonic()
+                req.out.put(("done", "unloaded"))
+        while True:
+            try:
+                req = self._waiting.get_nowait()
+            except queue.Empty:
+                break
+            req.out.put(("done", "unloaded"))
+
+    @property
+    def n_active(self) -> int:
+        return sum(r is not None for r in self._running)
+
+    # ------------------------------------------------------------------
+    def _finish(self, slot: int, req: Request, reason: str):
+        self.engine.release(slot)
+        self._running[slot] = None
+        req.stats.t_done = time.monotonic()
+        with self._lock:
+            self.finished.append(req.stats)
+            if len(self.finished) > 512:
+                self.finished = self.finished[-256:]
+        req.out.put(("done", reason))
+
+    def _emit(self, req: Request, tid: int) -> bool:
+        """Queue one token; returns False if the request just finished."""
+        now = time.monotonic()
+        if req.stats.n_generated == 0:
+            req.stats.t_first_token = now
+        if tid in req.eog_ids:
+            return False
+        req.stats.n_generated += 1
+        self.total_generated += 1
+        req.out.put(("token", tid))
+        return req.stats.n_generated < req.max_tokens
+
+    def _admit_waiting(self):
+        free = self.engine.free_slots()
+        while free:
+            try:
+                req = self._waiting.get_nowait()
+            except queue.Empty:
+                return
+            if req.cancelled.is_set():
+                req.out.put(("done", "cancelled"))
+                continue
+            slot = free.pop(0)
+            try:
+                first = self.engine.admit(slot, req.prompt_ids, req.opts)
+            except Exception as e:  # surfacing engine errors to the caller
+                req.error = str(e)
+                req.out.put(("error", str(e)))
+                continue
+            req.slot = slot
+            req.stats.t_admitted = time.monotonic()
+            self.total_prompt += req.stats.n_prompt
+            self._running[slot] = req
+            if not self._emit(req, first):
+                self._finish(slot, req, "stop")
+
+    def _loop(self):
+        while not self._stop.is_set():
+            self._admit_waiting()
+            active = [(s, r) for s, r in enumerate(self._running)
+                      if r is not None]
+            if not active:
+                self._wake.wait(timeout=0.05)
+                self._wake.clear()
+                continue
+            # drop cancelled before paying for a step
+            for slot, req in active:
+                if req.cancelled.is_set():
+                    self._finish(slot, req, "cancelled")
+            if self.n_active == 0:
+                continue
+            toks = self.engine.decode()
+            for slot, req in enumerate(list(self._running)):
+                if req is None:
+                    continue
+                if not self._emit(req, int(toks[slot])):
+                    self._finish(slot, req, "stop")
+                # host-side length tracking (no device sync): the cache holds
+                # the prompt plus one entry per decode step taken so far
+                elif (req.stats.n_prompt + req.stats.n_generated
+                      >= self.engine.max_seq - 1):
+                    self._finish(slot, req, "length")

@@ -1,0 +1,285 @@
+"""LoadedModel: one resident model = engine + scheduler + tokenizer +
+prompt template + default options.
+
+This is the text-level API the HTTP layer (server/app.py) calls — the
+equivalent of the model-serving half of `ollama serve` in the container the
+reference launches per model Deployment (/root/reference/pkg/model/model.go:39,
+pod.go:14). Handles prompt templating, stop-sequence holdback, and
+per-request option merging; everything below it is token-level.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.config import ModelConfig
+from ..server.metrics import GLOBAL as METRICS
+from ..server.template import DEFAULT_TEMPLATE, Template
+from ..tokenizer import StreamDecoder, Tokenizer
+from .engine import Engine, EngineConfig, SlotOptions
+from .scheduler import Scheduler
+
+
+@dataclasses.dataclass
+class GenerateResult:
+    text: str = ""
+    prompt_tokens: int = 0
+    generated_tokens: int = 0
+    ttft_s: float = 0.0
+    total_s: float = 0.0
+    done_reason: str = "stop"
+    context: List[int] = dataclasses.field(default_factory=list)
+
+
+def merge_options(defaults: Dict, request: Optional[Dict]
+                  ) -> Tuple[SlotOptions, int, List[str]]:
+    """(modelfile params, request options) → (SlotOptions, num_predict, stop)."""
+    o = dict(defaults or {})
+    o.update(request or {})
+    stop = o.get("stop") or []  # tolerate explicit null
+    if isinstance(stop, str):
+        stop = [stop]
+    so = SlotOptions(
+        temperature=float(o.get("temperature", 0.8)),
+        top_k=int(o.get("top_k", 40)),
+        top_p=float(o.get("top_p", 0.9)),
+        min_p=float(o.get("min_p", 0.0)),
+        repeat_penalty=float(o.get("repeat_penalty", 1.1)),
+        presence_penalty=float(o.get("presence_penalty", 0.0)),
+        frequency_penalty=float(o.get("frequency_penalty", 0.0)),
+        seed=int(o.get("seed", -1)))
+    num_predict = int(o.get("num_predict", 128))
+    if num_predict < 0:
+        num_predict = 1 << 30  # -1 = unlimited (bounded by context)
+    return so, num_predict, list(stop)
+
+
+class StopMatcher:
+    """Streaming stop-sequence matcher with holdback of partial matches."""
+
+    def __init__(self, stops: Sequence[str]):
+        self.stops = [s for s in stops if s]
+        self.buf = ""
+        self.hit = False
+
+    def feed(self, piece: str) -> str:
+        if self.hit:
+            return ""
+        if not self.stops:
+            return piece
+        self.buf += piece
+        # full match?
+        cut = None
+        for s in self.stops:
+            idx = self.buf.find(s)
+            if idx >= 0 and (cut is None or idx < cut):
+                cut = idx
+        if cut is not None:
+            out, self.buf = self.buf[:cut], ""
+            self.hit = True
+            return out
+        # hold back the longest tail that could begin a stop string
+        hold = 0
+        for s in self.stops:
+            for k in range(min(len(s) - 1, len(self.buf)), 0, -1):
+                if self.buf.endswith(s[:k]):
+                    hold = max(hold, k)
+                    break
+        if hold:
+            out, self.buf = self.buf[:-hold], self.buf[-hold:]
+            return out
+        out, self.buf = self.buf, ""
+        return out
+
+    def flush(self) -> str:
+        out, self.buf = self.buf, ""
+        return "" if self.hit else out
+
+
+class LoadedModel:
+    def __init__(self, name: str, cfg: ModelConfig, params, tokenizer: Tokenizer,
+                 template: Optional[str] = None,
+                 system: Optional[str] = None,
+                 default_params: Optional[Dict] = None,
+                 mesh=None, ecfg: Optional[EngineConfig] = None,
+                 digest: str = ""):
+        self.name = name
+        self.cfg = cfg
+        self.digest = digest
+        self.tokenizer = tokenizer
+        self.template = Template(template or DEFAULT_TEMPLATE)
+        self.system = system
+        self.default_params = default_params or {}
+        self.loaded_at = time.time()
+        self.ecfg = ecfg or EngineConfig()
+        self.engine = Engine(cfg, params, mesh=mesh, ecfg=self.ecfg)
+        self.scheduler = Scheduler(self.engine)
+        self._embed_fn = None
+        self._embed_lock = threading.Lock()
+        METRICS.gauge_fn("tpu_model_active_slots",
+                         lambda: self.scheduler.n_active)
+        METRICS.gauge_fn("tpu_model_queue_depth",
+                         lambda: self.scheduler._waiting.qsize())
+
+    # ------------------------------------------------------------------
+    def render_prompt(self, prompt: str, system: Optional[str] = None,
+                      template: Optional[str] = None) -> str:
+        tpl = Template(template) if template else self.template
+        return tpl.render(prompt=prompt,
+                          system=system if system is not None else
+                          (self.system or ""))
+
+    def render_chat(self, messages: List[Dict],
+                    template: Optional[str] = None) -> str:
+        """Render a messages list. Templates that iterate .Messages get them
+        directly; legacy system/prompt templates get a flattened view."""
+        tpl = Template(template) if template else self.template
+        system = self.system or ""
+        sys_parts = [m["content"] for m in messages
+                     if m.get("role") == "system"]
+        if sys_parts:
+            system = "\n".join(([system] if system else []) + sys_parts)
+        msgs = [{"Role": m.get("role", "user"),
+                 "Content": m.get("content", "")}
+                for m in messages if m.get("role") != "system"]
+        if ".Messages" in tpl.src:
+            if system:
+                msgs = [{"Role": "system", "Content": system}] + msgs
+            return tpl.render(messages=msgs, system=system, prompt="")
+        prompt = msgs[-1]["Content"] if msgs else ""
+        return tpl.render(system=system, prompt=prompt)
+
+    # ------------------------------------------------------------------
+    def generate_stream(self, prompt_text: str,
+                        options: Optional[Dict] = None,
+                        context: Optional[List[int]] = None,
+                        raw: bool = False,
+                        cancel_event: Optional[threading.Event] = None
+                        ) -> Iterator[Tuple[str, Optional[GenerateResult]]]:
+        """Yields (text_piece, None)… then ("", final GenerateResult)."""
+        so, num_predict, stops = merge_options(self.default_params, options)
+        t0 = time.monotonic()
+        ids = list(context or [])
+        # BOS only at the start of a fresh sequence (continuations carry it)
+        ids += self.tokenizer.encode(
+            prompt_text, add_bos=(not ids) and self.tokenizer.add_bos)
+        max_new = min(num_predict, self.engine.max_seq - len(ids) - 1)
+        if max_new < 1:
+            raise ValueError(
+                f"prompt of {len(ids)} tokens leaves no room to generate "
+                f"within the {self.engine.max_seq}-token context")
+        req = self.scheduler.submit(ids, so, max_new,
+                                    eog_ids=frozenset(self.tokenizer.eog_ids))
+        sd = StreamDecoder(self.tokenizer)
+        sm = StopMatcher(stops)
+        result = GenerateResult(prompt_tokens=len(ids))
+        all_ids: List[int] = []
+        finished = False
+        try:
+            for tid in req.tokens():
+                if cancel_event is not None and cancel_event.is_set():
+                    req.cancel()
+                all_ids.append(tid)
+                piece = sm.feed(sd.feed(tid))
+                if piece:
+                    result.text += piece
+                    yield piece, None
+                if sm.hit:
+                    req.cancel()
+                    break
+            finished = True
+        finally:
+            # generator closed early (client disconnect → GeneratorExit):
+            # free the decode slot instead of burning it to max_tokens
+            if not finished:
+                req.cancel()
+        tail = sm.feed(sd.flush()) + sm.flush()
+        if tail:
+            result.text += tail
+            yield tail, None
+        st = req.stats
+        result.generated_tokens = st.n_generated
+        result.ttft_s = st.ttft_s
+        result.total_s = time.monotonic() - t0
+        result.done_reason = "stop" if sm.hit or st.n_generated < max_new \
+            else "length"
+        result.context = ids + all_ids
+        METRICS.inc("tpu_model_requests_total")
+        METRICS.inc("tpu_model_generated_tokens_total", st.n_generated)
+        METRICS.inc("tpu_model_prompt_tokens_total", len(ids))
+        METRICS.observe("tpu_model_ttft_seconds", st.ttft_s)
+        if st.decode_tok_s > 0:
+            METRICS.observe("tpu_model_decode_tokens_per_second",
+                            st.decode_tok_s)
+        yield "", result
+
+    def generate(self, prompt_text: str, options: Optional[Dict] = None,
+                 raw: bool = False) -> GenerateResult:
+        final = None
+        for _piece, res in self.generate_stream(prompt_text, options,
+                                                raw=raw):
+            if res is not None:
+                final = res
+        return final
+
+    # ------------------------------------------------------------------
+    def embed(self, texts: List[str]) -> np.ndarray:
+        """Mean-pooled final hidden states (ollama /api/embeddings)."""
+        from ..models import decoder as D
+
+        with self._embed_lock:
+            if self._embed_fn is None:
+                cfg = self.cfg
+
+                def _embed(params, tokens, n_valid):
+                    x = D._embed(cfg, params, tokens)
+                    import jax.numpy as jnp
+                    from jax import lax
+                    from ..ops.attention import causal_mask
+                    import math
+                    B, T = tokens.shape
+                    scale = 1.0 / math.sqrt(cfg.head_dim)
+                    from ..ops.rope import rope_angles
+                    positions = jnp.broadcast_to(
+                        jnp.arange(T, dtype=jnp.int32), (B, T))
+                    cos, sin = rope_angles(positions, cfg.rotary_dim,
+                                           cfg.rope_theta, cfg.rope_scaling)
+                    mask = causal_mask(T, T, 0,
+                                       sliding_window=cfg.sliding_window)
+                    mask = jnp.broadcast_to(mask, (B, 1, T, T))
+
+                    def body(x, lp):
+                        x, kv = D._block_chunk(cfg, lp, x, cos, sin, mask,
+                                               scale)
+                        return x, None
+
+                    x, _ = lax.scan(body, x, params["layers"])
+                    x = D._norm(cfg, x, params["out_norm_w"],
+                                params.get("out_norm_b"))
+                    valid = (jnp.arange(T)[None, :] < n_valid[:, None]
+                             ).astype(x.dtype)
+                    pooled = (x * valid[:, :, None]).sum(1) / jnp.maximum(
+                        valid.sum(1, keepdims=True), 1)
+                    return pooled.astype(jnp.float32)
+
+                self._embed_fn = jax.jit(_embed)
+        outs = []
+        for t in texts:
+            ids = self.tokenizer.encode(t)
+            T = max(16, 1 << (len(ids) - 1).bit_length())
+            toks = np.zeros((1, T), np.int32)
+            toks[0, :len(ids)] = ids
+            out = self._embed_fn(self.engine.params, jnp.asarray(toks),
+                                 jnp.asarray([len(ids)], np.int32))
+            outs.append(np.asarray(out)[0])
+        return np.stack(outs)
+
+    def unload(self):
+        self.scheduler.shutdown()

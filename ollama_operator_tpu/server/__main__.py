@@ -1,0 +1,86 @@
+"""CLI entry: `python -m ollama_operator_tpu.server`.
+
+Runs either role from the reference's architecture:
+- model server (per-model Deployment pods, pod.go:14): --preload <model>
+- store server (image-store StatefulSet, image_store.go:126): --store-only —
+  serves /api/pull into the shared store and the model-management API, no
+  engine.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser("tpu-ollama-server")
+    p.add_argument("--host", default=os.environ.get("OLLAMA_HOST_BIND",
+                                                    "0.0.0.0"))
+    p.add_argument("--port", type=int,
+                   default=int(os.environ.get("OLLAMA_PORT", "11434")))
+    p.add_argument("--store", default=os.environ.get(
+        "OLLAMA_MODELS", os.path.expanduser("~/.ollama/models")),
+        help="blob store root (the shared PVC mount)")
+    p.add_argument("--cache", default=os.environ.get("TPU_WEIGHT_CACHE"),
+                   help="transcoded-weights cache dir")
+    p.add_argument("--preload", default=os.environ.get("TPU_PRELOAD_MODEL"),
+                   help="model to load at startup")
+    p.add_argument("--store-only", action="store_true",
+                   default=os.environ.get("TPU_STORE_ONLY") == "1",
+                   help="registry/store mode: no inference engine")
+    p.add_argument("--dtype", default=os.environ.get("TPU_ENGINE_DTYPE",
+                                                     "bfloat16"),
+                   choices=["bfloat16", "float32"])
+    p.add_argument("--max-slots", type=int,
+                   default=int(os.environ.get("TPU_MAX_SLOTS", "8")))
+    p.add_argument("--max-seq-len", type=int,
+                   default=int(os.environ.get("TPU_MAX_SEQ_LEN", "4096")))
+    p.add_argument("--tp", type=int,
+                   default=int(os.environ.get("TPU_TENSOR_PARALLEL", "0")),
+                   help="tensor-parallel ways (0 = all local devices)")
+    p.add_argument("--profile-port", type=int,
+                   default=int(os.environ.get("TPU_PROFILE_PORT", "0")),
+                   help="jax.profiler server port (0 = off)")
+    args = p.parse_args(argv)
+
+    from ..runtime.engine import EngineConfig
+    from .app import ModelManager, serve
+
+    mesh = None
+    if not args.store_only:
+        import jax
+        if args.profile_port:
+            jax.profiler.start_server(args.profile_port)
+        devices = jax.devices()
+        tp = args.tp or len(devices)
+        if tp > 1:
+            from ..parallel import MeshPlan, make_mesh
+            mesh = make_mesh(MeshPlan.for_devices(len(devices), tp=tp))
+        print(f"devices: {devices}, tensor-parallel: {tp}", file=sys.stderr)
+
+    ecfg = EngineConfig(max_slots=args.max_slots,
+                        max_seq_len=args.max_seq_len)
+    manager = ModelManager(args.store, cache_dir=args.cache, mesh=mesh,
+                           ecfg=ecfg, engine_dtype=args.dtype,
+                           serve_models=not args.store_only)
+    if args.preload and not args.store_only:
+        print(f"preloading {args.preload}...", file=sys.stderr)
+        manager.load(args.preload)
+        print("preload done", file=sys.stderr)
+
+    httpd = serve(manager, args.host, args.port)
+    print(f"listening on {args.host}:{args.port}", file=sys.stderr)
+    # block the signals before sigwait — delivery to the default disposition
+    # would otherwise race the wait and skip the graceful shutdown
+    signal.pthread_sigmask(signal.SIG_BLOCK,
+                           [signal.SIGINT, signal.SIGTERM])
+    stop = signal.sigwait([signal.SIGINT, signal.SIGTERM])
+    print(f"signal {stop}, shutting down", file=sys.stderr)
+    httpd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

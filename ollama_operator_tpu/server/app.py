@@ -1,0 +1,722 @@
+"""Model manager + Ollama-compatible HTTP server (stdlib, threaded).
+
+This is the API surface the reference's probes and clients rely on
+(/root/reference/pkg/model/pod.go:41-64 probes /api/tags;
+docs/pages/en/guide/getting-started.md:129-150 uses /api/generate and
+/v1/chat/completions) — served by a JAX/TPU engine instead of llama.cpp:
+
+  GET  /                      liveness banner
+  GET  /api/version
+  GET  /api/tags              local model list
+  POST /api/pull              streaming pull progress (NDJSON)
+  POST /api/generate          streaming generation (NDJSON)
+  POST /api/chat              chat-templated generation (NDJSON)
+  POST /api/show              modelfile/template/params/details
+  POST /api/create            build a model from a Modelfile
+  POST /api/copy, /api/delete, GET /api/ps
+  POST /api/embeddings, /api/embed
+  POST /v1/chat/completions, /v1/completions, GET /v1/models   (OpenAI)
+  GET  /metrics               Prometheus (tok/s, TTFT — SURVEY.md §5 gap)
+  GET  /healthz, /readyz
+
+One model is resident at a time (each Model CR gets its own Deployment in
+the operator design, mirroring the reference's per-model pods); naming a
+different model swaps it in under a lock.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from datetime import datetime, timezone
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+
+from .. import __version__
+from ..gguf.reader import GGUFFile
+from ..gguf.transcode import load_model as transcode_load
+from ..runtime.engine import EngineConfig
+from ..runtime.service import LoadedModel
+from ..tokenizer import Tokenizer
+from .metrics import GLOBAL as METRICS
+from .modelfile import Modelfile, parse_modelfile, params_json
+from .names import ModelName
+from .registry import (MT_LICENSE, MT_MODEL, MT_PARAMS, MT_SYSTEM,
+                       MT_TEMPLATE, ModelStore, RegistryClient, RegistryError)
+
+
+def _now_iso() -> str:
+    return datetime.now(timezone.utc).isoformat()
+
+
+def _fmt_params(n: int) -> str:
+    if n >= 1e9:
+        return f"{n / 1e9:.1f}B"
+    return f"{n / 1e6:.0f}M"
+
+
+class ApiError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+
+
+class ModelManager:
+    """Owns the blob store, registry client, and the resident model."""
+
+    def __init__(self, store_root: str, cache_dir: Optional[str] = None,
+                 mesh=None, ecfg: Optional[EngineConfig] = None,
+                 engine_dtype="bfloat16", serve_models: bool = True):
+        self.store = ModelStore(store_root)
+        self.client = RegistryClient(self.store)
+        self.mesh = mesh
+        self.ecfg = ecfg
+        self.cache_dir = cache_dir
+        self.engine_dtype = engine_dtype
+        self.serve_models = serve_models  # store-only mode serves pulls only
+        self.loaded: Optional[LoadedModel] = None
+        self._lock = threading.Lock()
+        self.start_time = time.time()
+
+    # ------------------------------------------------------------------
+    def model_details(self, name: ModelName) -> Dict:
+        out = {"format": "gguf", "family": "", "families": None,
+               "parameter_size": "", "quantization_level": ""}
+        try:
+            layers = self.store.model_layers(name)
+            path = layers.get(MT_MODEL)
+            if path:
+                with GGUFFile(path) as f:
+                    out["family"] = f.arch
+                    out["families"] = [f.arch]
+                    cnt = f.metadata.get("general.parameter_count")
+                    if cnt:
+                        out["parameter_size"] = _fmt_params(int(cnt))
+                    ft = f.metadata.get("general.file_type")
+                    ftypes = {0: "F32", 1: "F16", 2: "Q4_0", 3: "Q4_1",
+                              7: "Q8_0", 8: "Q5_0", 9: "Q5_1", 10: "Q2_K",
+                              11: "Q3_K_S", 12: "Q3_K_M", 13: "Q3_K_L",
+                              14: "Q4_K_S", 15: "Q4_K_M", 16: "Q5_K_S",
+                              17: "Q5_K_M", 18: "Q6_K"}
+                    if ft is not None:
+                        out["quantization_level"] = ftypes.get(ft, str(ft))
+        except (RegistryError, OSError, ValueError):
+            pass
+        return out
+
+    def list_models(self):
+        models = []
+        for m in self.store.list_models():
+            name: ModelName = m["name"]
+            digest = (m["manifest"].get("config", {}) or {}).get("digest", "")
+            models.append({
+                "name": name.short, "model": name.short,
+                "modified_at": datetime.fromtimestamp(
+                    m["modified_at"], timezone.utc).isoformat(),
+                "size": m["size"],
+                "digest": digest.replace("sha256:", ""),
+                "details": self.model_details(name),
+            })
+        return models
+
+    def _read_layer_text(self, layers: Dict[str, str], mt: str
+                         ) -> Optional[str]:
+        path = layers.get(mt)
+        if not path:
+            return None
+        try:
+            with open(path, "r", errors="replace") as f:
+                return f.read()
+        except OSError:
+            return None
+
+    def load(self, ref: str) -> LoadedModel:
+        if not self.serve_models:
+            raise ApiError(503, "this instance is a model store; it serves "
+                                "pulls, not inference")
+        name = ModelName.parse(ref)
+        with self._lock:
+            if self.loaded is not None and self.loaded.name == name.short:
+                return self.loaded
+            layers = self.store.model_layers(name)  # raises if absent
+            gguf_path = layers.get(MT_MODEL)
+            if not gguf_path:
+                raise ApiError(500, f"model {name.short} has no model layer")
+            if self.loaded is not None:
+                self.loaded.unload()
+                self.loaded = None
+            digest = self.store.model_digest(name) or ""
+            import ml_dtypes
+            dt = {"bfloat16": ml_dtypes.bfloat16,
+                  "float32": np.float32}[self.engine_dtype]
+            cfg, params, tok_md = transcode_load(
+                gguf_path, cache_dir=self.cache_dir, dtype=dt,
+                digest=digest.replace("sha256:", "")[:24] or None)
+            import jax.numpy as jnp
+            import jax
+            params = jax.tree_util.tree_map(jnp.asarray, params)
+            tokenizer = Tokenizer.from_gguf_metadata(tok_md)
+            template = self._read_layer_text(layers, MT_TEMPLATE)
+            system = self._read_layer_text(layers, MT_SYSTEM)
+            params_raw = self._read_layer_text(layers, MT_PARAMS)
+            default_params = json.loads(params_raw) if params_raw else {}
+            ecfg = self.ecfg or EngineConfig(
+                max_seq_len=min(cfg.max_seq_len,
+                                int(default_params.get("num_ctx", 4096))))
+            self.loaded = LoadedModel(
+                name.short, cfg, params, tokenizer, template=template,
+                system=system, default_params=default_params,
+                mesh=self.mesh, ecfg=ecfg, digest=digest)
+            return self.loaded
+
+    def require_loaded(self, ref: str) -> LoadedModel:
+        try:
+            return self.load(ref)
+        except RegistryError as e:
+            raise ApiError(404, str(e)) from e
+
+    def ps(self):
+        out = []
+        with self._lock:
+            lm = self.loaded
+        if lm is not None:
+            out.append({
+                "name": lm.name, "model": lm.name,
+                "size": int(lm.cfg.n_params * 2),
+                "digest": lm.digest.replace("sha256:", ""),
+                "details": {"format": "gguf", "family": lm.cfg.arch,
+                            "parameter_size": _fmt_params(lm.cfg.n_params)},
+                "expires_at": "0001-01-01T00:00:00Z",
+                "size_vram": 0,
+            })
+        return out
+
+    # -- model management ----------------------------------------------
+    def show(self, ref: str) -> Dict:
+        name = ModelName.parse(ref)
+        manifest = self.store.read_manifest(name)
+        if manifest is None:
+            raise ApiError(404, f"model {name.short!r} not found")
+        layers = self.store.model_layers(name)
+        template = self._read_layer_text(layers, MT_TEMPLATE) or ""
+        system = self._read_layer_text(layers, MT_SYSTEM) or ""
+        params_raw = self._read_layer_text(layers, MT_PARAMS)
+        lic = self._read_layer_text(layers, MT_LICENSE) or ""
+        mf = Modelfile(from_=name.short, template=template or None,
+                       system=system or None)
+        parameters = ""
+        if params_raw:
+            try:
+                pj = json.loads(params_raw)
+                mf.parameters = pj
+                parameters = "\n".join(
+                    f"{k:30s} {item}" for k, v in sorted(pj.items())
+                    for item in (v if isinstance(v, list) else [v]))
+            except json.JSONDecodeError:
+                pass
+        info = {}
+        path = layers.get(MT_MODEL)
+        if path:
+            try:
+                with GGUFFile(path) as f:
+                    info = {k: v for k, v in f.metadata.items()
+                            if not isinstance(v, list) or len(v) < 64}
+            except (OSError, ValueError):
+                pass
+        return {"modelfile": mf.render(), "parameters": parameters,
+                "template": template, "system": system, "license": lic,
+                "details": self.model_details(name), "model_info": info}
+
+    def copy(self, src: str, dst: str):
+        sname, dname = ModelName.parse(src), ModelName.parse(dst)
+        manifest = self.store.read_manifest(sname)
+        if manifest is None:
+            raise ApiError(404, f"model {sname.short!r} not found")
+        self.store.write_manifest(dname, manifest)
+
+    def delete(self, ref: str):
+        name = ModelName.parse(ref)
+        if not self.store.delete_model(name):
+            raise ApiError(404, f"model {name.short!r} not found")
+        with self._lock:
+            if self.loaded is not None and self.loaded.name == name.short:
+                self.loaded.unload()
+                self.loaded = None
+
+    def create(self, ref: str, modelfile_text: str,
+               progress=None) -> None:
+        mf = parse_modelfile(modelfile_text)
+        if not mf.from_:
+            raise ApiError(400, "Modelfile needs a FROM line")
+        name = ModelName.parse(ref)
+        layers = []
+        # FROM: local model name or a GGUF file path
+        base = ModelName.parse(mf.from_)
+        base_manifest = self.store.read_manifest(base)
+        if base_manifest is not None:
+            for layer in base_manifest.get("layers", []):
+                if layer["mediaType"] == MT_MODEL:
+                    layers.append(layer)
+        else:
+            import os
+            if not os.path.exists(mf.from_):
+                raise ApiError(400, f"FROM {mf.from_!r}: not a local model "
+                                    "or file")
+            if progress:
+                progress("importing model blob", 0, 0)
+            entry = self.store.add_blob_file(mf.from_)
+            layers.append({"mediaType": MT_MODEL, **entry})
+        if mf.template:
+            layers.append({"mediaType": MT_TEMPLATE,
+                           **self.store.add_blob(mf.template.encode())})
+        if mf.system:
+            layers.append({"mediaType": MT_SYSTEM,
+                           **self.store.add_blob(mf.system.encode())})
+        if mf.parameters:
+            layers.append({"mediaType": MT_PARAMS,
+                           **self.store.add_blob(params_json(mf).encode())})
+        if mf.license:
+            layers.append({"mediaType": MT_LICENSE,
+                           **self.store.add_blob(mf.license.encode())})
+        config = self.store.add_blob(json.dumps(
+            {"model_format": "gguf"}).encode())
+        manifest = {
+            "schemaVersion": 2,
+            "mediaType": "application/vnd.docker.distribution.manifest.v2+json",
+            "config": {"mediaType": "application/vnd.docker.container.image.v1+json",
+                       **config},
+            "layers": layers,
+        }
+        self.store.write_manifest(name, manifest)
+        if progress:
+            progress("success", 0, 0)
+
+
+# ---------------------------------------------------------------------------
+# HTTP layer
+# ---------------------------------------------------------------------------
+
+class Handler(BaseHTTPRequestHandler):
+    manager: ModelManager = None  # set by serve()
+    protocol_version = "HTTP/1.1"
+    server_version = "tpu-ollama/" + __version__
+
+    # -- helpers --------------------------------------------------------
+    def log_message(self, fmt, *args):  # quiet by default
+        pass
+
+    def _json_body(self) -> Dict:
+        n = int(self.headers.get("Content-Length") or 0)
+        if n == 0:
+            return {}
+        try:
+            return json.loads(self.rfile.read(n) or b"{}")
+        except json.JSONDecodeError as e:
+            raise ApiError(400, f"invalid json: {e}") from e
+
+    def _send_json(self, obj, status=200):
+        body = json.dumps(obj).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_text(self, text: str, status=200,
+                   ctype="text/plain; charset=utf-8"):
+        body = text.encode()
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _start_stream(self, ctype="application/x-ndjson"):
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        self._streaming = True
+
+    def _chunk(self, data: bytes):
+        self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+        self.wfile.flush()
+
+    def _end_stream(self):
+        self.wfile.write(b"0\r\n\r\n")
+        self.wfile.flush()
+        self._streaming = False
+
+    def _send_error(self, message: str, status: int):
+        """Error that is safe both before and after a stream started: once
+        chunked headers are out, a second status line would corrupt the
+        framing — emit the error as a final chunk instead."""
+        if getattr(self, "_streaming", False):
+            try:
+                self._stream_json({"error": message})
+                self._end_stream()
+            except (BrokenPipeError, ConnectionResetError):
+                pass
+        else:
+            self._send_json({"error": message}, status)
+
+    def _stream_json(self, obj):
+        self._chunk(json.dumps(obj).encode() + b"\n")
+
+    # -- routing --------------------------------------------------------
+    def do_GET(self):
+        try:
+            path = self.path.split("?")[0]
+            if path == "/":
+                self._send_text("Ollama is running")
+            elif path == "/api/version":
+                self._send_json({"version": __version__})
+            elif path == "/api/tags":
+                self._send_json({"models": self.manager.list_models()})
+            elif path == "/api/ps":
+                self._send_json({"models": self.manager.ps()})
+            elif path == "/v1/models":
+                models = [{"id": m["name"], "object": "model",
+                           "created": 0, "owned_by": "library"}
+                          for m in self.manager.list_models()]
+                self._send_json({"object": "list", "data": models})
+            elif path == "/metrics":
+                self._send_text(METRICS.render(),
+                                ctype="text/plain; version=0.0.4")
+            elif path in ("/healthz", "/livez"):
+                self._send_text("ok")
+            elif path == "/readyz":
+                self._send_text("ok")
+            else:
+                self._send_json({"error": "not found"}, 404)
+        except ApiError as e:
+            self._send_json({"error": str(e)}, e.status)
+        except BrokenPipeError:
+            pass
+        except Exception as e:  # noqa: BLE001
+            self._send_json({"error": f"internal: {e}"}, 500)
+
+    def do_DELETE(self):
+        try:
+            if self.path.split("?")[0] == "/api/delete":
+                body = self._json_body()
+                self.manager.delete(body.get("name") or body.get("model", ""))
+                self._send_json({})
+            else:
+                self._send_json({"error": "not found"}, 404)
+        except ApiError as e:
+            self._send_json({"error": str(e)}, e.status)
+        except Exception as e:  # noqa: BLE001
+            self._send_json({"error": f"internal: {e}"}, 500)
+
+    def do_HEAD(self):
+        if self.path.split("?")[0] == "/":
+            self.send_response(200)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+        else:
+            self.send_response(404)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+    def do_POST(self):
+        path = self.path.split("?")[0]
+        try:
+            body = self._json_body()
+            route = {
+                "/api/generate": self._api_generate,
+                "/api/chat": self._api_chat,
+                "/api/pull": self._api_pull,
+                "/api/push": self._api_push,
+                "/api/create": self._api_create,
+                "/api/show": self._api_show,
+                "/api/copy": self._api_copy,
+                "/api/delete": self._api_delete,
+                "/api/embeddings": self._api_embeddings,
+                "/api/embed": self._api_embed,
+                "/v1/chat/completions": self._oai_chat,
+                "/v1/completions": self._oai_completions,
+            }.get(path)
+            if route is None:
+                self._send_json({"error": "not found"}, 404)
+                return
+            route(body)
+        except ApiError as e:
+            self._send_error(str(e), e.status)
+        except RegistryError as e:
+            self._send_error(str(e), 500)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        except Exception as e:  # noqa: BLE001
+            import traceback
+            traceback.print_exc()
+            self._send_error(f"internal: {e}", 500)
+
+    # -- Ollama endpoints ----------------------------------------------
+    def _model_arg(self, body) -> str:
+        model = body.get("model") or body.get("name")
+        if not model:
+            raise ApiError(400, "missing 'model'")
+        return model
+
+    def _api_generate(self, body: Dict):
+        model = self._model_arg(body)
+        lm = self.manager.require_loaded(model)
+        stream = body.get("stream", True)
+        prompt = body.get("prompt", "")
+        raw = bool(body.get("raw", False))
+        if not prompt and not body.get("context"):
+            # empty generate is ollama's "load the model" ping
+            self._send_json({"model": model, "created_at": _now_iso(),
+                             "response": "", "done": True,
+                             "done_reason": "load"})
+            return
+        text_prompt = prompt if raw else lm.render_prompt(
+            prompt, system=body.get("system"), template=body.get("template"))
+        gen = lm.generate_stream(text_prompt, options=body.get("options"),
+                                 context=body.get("context"), raw=raw)
+        if stream:
+            self._start_stream()
+            for piece, final in gen:
+                if final is None:
+                    self._stream_json({"model": model,
+                                       "created_at": _now_iso(),
+                                       "response": piece, "done": False})
+                else:
+                    self._stream_json(self._final_chunk(model, final, body))
+            self._end_stream()
+        else:
+            final = None
+            for _piece, f in gen:
+                if f is not None:
+                    final = f
+            out = self._final_chunk(model, final, body)
+            out["response"] = final.text
+            self._send_json(out)
+
+    def _final_chunk(self, model: str, res, body: Dict) -> Dict:
+        out = {
+            "model": model, "created_at": _now_iso(), "response": "",
+            "done": True, "done_reason": res.done_reason,
+            "total_duration": int(res.total_s * 1e9),
+            "load_duration": 0,
+            "prompt_eval_count": res.prompt_tokens,
+            "prompt_eval_duration": int(res.ttft_s * 1e9),
+            "eval_count": res.generated_tokens,
+            "eval_duration": int(max(res.total_s - res.ttft_s, 0.0) * 1e9),
+        }
+        if body.get("context") is not None or not body.get("raw"):
+            out["context"] = res.context
+        return out
+
+    def _api_chat(self, body: Dict):
+        model = self._model_arg(body)
+        lm = self.manager.require_loaded(model)
+        messages = body.get("messages", [])
+        stream = body.get("stream", True)
+        prompt = lm.render_chat(messages, template=body.get("template"))
+        gen = lm.generate_stream(prompt, options=body.get("options"))
+        if stream:
+            self._start_stream()
+            for piece, final in gen:
+                if final is None:
+                    self._stream_json({
+                        "model": model, "created_at": _now_iso(),
+                        "message": {"role": "assistant", "content": piece},
+                        "done": False})
+                else:
+                    out = self._final_chunk(model, final, body)
+                    out.pop("response", None)
+                    out.pop("context", None)
+                    out["message"] = {"role": "assistant", "content": ""}
+                    self._stream_json(out)
+            self._end_stream()
+        else:
+            final = None
+            for _p, f in gen:
+                if f is not None:
+                    final = f
+            out = self._final_chunk(model, final, body)
+            out.pop("response", None)
+            out.pop("context", None)
+            out["message"] = {"role": "assistant", "content": final.text}
+            self._send_json(out)
+
+    def _api_pull(self, body: Dict):
+        model = self._model_arg(body)
+        stream = body.get("stream", True)
+        if stream:
+            self._start_stream()
+
+            def progress(status, completed, total):
+                msg = {"status": status}
+                if total:
+                    msg["total"] = total
+                    msg["completed"] = completed
+                    if status.startswith("pulling sha") or "sha" in status:
+                        msg["digest"] = status.replace("pulling ", "")
+                self._stream_json(msg)
+
+            try:
+                self.manager.client.pull(model, progress)
+            except RegistryError as e:
+                self._stream_json({"error": str(e)})
+            self._end_stream()
+        else:
+            self.manager.client.pull(model)
+            self._send_json({"status": "success"})
+
+    def _api_push(self, body: Dict):
+        raise ApiError(501, "push not implemented")
+
+    def _api_create(self, body: Dict):
+        model = self._model_arg(body)
+        modelfile_text = body.get("modelfile", "")
+        if not modelfile_text and body.get("from"):
+            modelfile_text = f"FROM {body['from']}"
+        stream = body.get("stream", True)
+        if stream:
+            self._start_stream()
+
+            def progress(status, *_):
+                self._stream_json({"status": status})
+
+            try:
+                self.manager.create(model, modelfile_text, progress)
+            except ApiError as e:
+                self._stream_json({"error": str(e)})
+            self._end_stream()
+        else:
+            self.manager.create(model, modelfile_text)
+            self._send_json({"status": "success"})
+
+    def _api_show(self, body: Dict):
+        self._send_json(self.manager.show(self._model_arg(body)))
+
+    def _api_copy(self, body: Dict):
+        src, dst = body.get("source"), body.get("destination")
+        if not src or not dst:
+            raise ApiError(400, "need 'source' and 'destination'")
+        self.manager.copy(src, dst)
+        self._send_json({})
+
+    def _api_delete(self, body: Dict):
+        self.manager.delete(self._model_arg(body))
+        self._send_json({})
+
+    def _api_embeddings(self, body: Dict):
+        lm = self.manager.require_loaded(self._model_arg(body))
+        prompt = body.get("prompt", "")
+        emb = lm.embed([prompt])[0]
+        self._send_json({"embedding": [float(x) for x in emb]})
+
+    def _api_embed(self, body: Dict):
+        lm = self.manager.require_loaded(self._model_arg(body))
+        inp = body.get("input", "")
+        texts = [inp] if isinstance(inp, str) else list(inp)
+        embs = lm.embed(texts)
+        self._send_json({
+            "model": body.get("model"), "object": "list",
+            "embeddings": [[float(x) for x in e] for e in embs]})
+
+    # -- OpenAI compatibility ------------------------------------------
+    def _oai_chat(self, body: Dict):
+        model = self._model_arg(body)
+        lm = self.manager.require_loaded(model)
+        messages = body.get("messages", [])
+        options = {}
+        for src, dst in (("temperature", "temperature"), ("top_p", "top_p"),
+                         ("seed", "seed"),
+                         ("frequency_penalty", "frequency_penalty"),
+                         ("presence_penalty", "presence_penalty")):
+            if body.get(src) is not None:
+                options[dst] = body[src]
+        if body.get("max_tokens") is not None:
+            options["num_predict"] = body["max_tokens"]
+        if body.get("stop"):
+            options["stop"] = body["stop"]
+        prompt = lm.render_chat(messages)
+        rid = f"chatcmpl-{int(time.time() * 1000)}"
+        created = int(time.time())
+        gen = lm.generate_stream(prompt, options=options)
+        if body.get("stream"):
+            self._start_stream(ctype="text/event-stream")
+            self._chunk(self._sse({
+                "id": rid, "object": "chat.completion.chunk",
+                "created": created, "model": model,
+                "choices": [{"index": 0,
+                             "delta": {"role": "assistant", "content": ""},
+                             "finish_reason": None}]}))
+            final = None
+            for piece, f in gen:
+                if f is None:
+                    self._chunk(self._sse({
+                        "id": rid, "object": "chat.completion.chunk",
+                        "created": created, "model": model,
+                        "choices": [{"index": 0,
+                                     "delta": {"content": piece},
+                                     "finish_reason": None}]}))
+                else:
+                    final = f
+            self._chunk(self._sse({
+                "id": rid, "object": "chat.completion.chunk",
+                "created": created, "model": model,
+                "choices": [{"index": 0, "delta": {},
+                             "finish_reason": final.done_reason}]}))
+            self._chunk(b"data: [DONE]\n\n")
+            self._end_stream()
+        else:
+            final = None
+            for _p, f in gen:
+                if f is not None:
+                    final = f
+            self._send_json({
+                "id": rid, "object": "chat.completion", "created": created,
+                "model": model,
+                "choices": [{"index": 0,
+                             "message": {"role": "assistant",
+                                         "content": final.text},
+                             "finish_reason": final.done_reason}],
+                "usage": {"prompt_tokens": final.prompt_tokens,
+                          "completion_tokens": final.generated_tokens,
+                          "total_tokens": final.prompt_tokens +
+                          final.generated_tokens}})
+
+    def _oai_completions(self, body: Dict):
+        model = self._model_arg(body)
+        lm = self.manager.require_loaded(model)
+        options = {}
+        if body.get("max_tokens") is not None:
+            options["num_predict"] = body["max_tokens"]
+        if body.get("temperature") is not None:
+            options["temperature"] = body["temperature"]
+        if body.get("stop"):
+            options["stop"] = body["stop"]
+        final = lm.generate(body.get("prompt", ""), options=options)
+        self._send_json({
+            "id": f"cmpl-{int(time.time() * 1000)}",
+            "object": "text_completion", "created": int(time.time()),
+            "model": model,
+            "choices": [{"index": 0, "text": final.text,
+                         "finish_reason": final.done_reason}],
+            "usage": {"prompt_tokens": final.prompt_tokens,
+                      "completion_tokens": final.generated_tokens,
+                      "total_tokens": final.prompt_tokens +
+                      final.generated_tokens}})
+
+    @staticmethod
+    def _sse(obj) -> bytes:
+        return b"data: " + json.dumps(obj).encode() + b"\n\n"
+
+
+def serve(manager: ModelManager, host: str = "0.0.0.0", port: int = 11434
+          ) -> ThreadingHTTPServer:
+    handler = type("BoundHandler", (Handler,), {"manager": manager})
+    httpd = ThreadingHTTPServer((host, port), handler)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True,
+                         name="http-server")
+    t.start()
+    return httpd

@@ -1,0 +1,127 @@
+"""Prometheus text-format metrics for the model server.
+
+The reference exposes only controller-runtime metrics on the manager
+(/root/reference/cmd/main.go:61,100-104) and has **no model-server metrics at
+all** (SURVEY.md §5). These are the serving metrics the BASELINE target is
+measured by: output tok/s and TTFT, plus queue/slot gauges. Scraped at
+/metrics on the model server, optionally via a ServiceMonitor like the
+reference's (deploy/monitor.yaml).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Tuple
+
+
+class Histogram:
+    DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                       5.0, 10.0, 30.0, 60.0)
+
+    def __init__(self, buckets=DEFAULT_BUCKETS):
+        self.buckets = tuple(buckets)
+        self.counts = [0] * (len(self.buckets) + 1)
+        self.total = 0.0
+        self.n = 0
+
+    def observe(self, v: float):
+        self.total += v
+        self.n += 1
+        for i, b in enumerate(self.buckets):
+            if v <= b:
+                self.counts[i] += 1
+                return
+        self.counts[-1] += 1
+
+    def render(self, name: str, labels: str = "") -> List[str]:
+        out = []
+        cum = 0
+        lab = labels[:-1] + "," if labels else "{"
+        for b, c in zip(self.buckets, self.counts):
+            cum += c
+            out.append(f'{name}_bucket{lab}le="{b}"}} {cum}')
+        cum += self.counts[-1]
+        out.append(f'{name}_bucket{lab}le="+Inf"}} {cum}')
+        out.append(f"{name}_sum{labels} {self.total}")
+        out.append(f"{name}_count{labels} {self.n}")
+        return out
+
+
+class Metrics:
+    """Tiny registry: counters, gauges (callables), histograms."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[Tuple[str, str], float] = {}
+        self._gauges: Dict[Tuple[str, str], object] = {}
+        self._hists: Dict[Tuple[str, str], Histogram] = {}
+        self._help: Dict[str, str] = {}
+
+    def _key(self, name, labels):
+        return (name, labels)
+
+    def describe(self, name: str, help_: str):
+        self._help[name] = help_
+
+    def inc(self, name: str, value: float = 1.0, labels: str = ""):
+        with self._lock:
+            k = self._key(name, labels)
+            self._counters[k] = self._counters.get(k, 0.0) + value
+
+    def gauge_fn(self, name: str, fn, labels: str = ""):
+        with self._lock:
+            self._gauges[self._key(name, labels)] = fn
+
+    def observe(self, name: str, v: float, labels: str = ""):
+        with self._lock:
+            k = self._key(name, labels)
+            if k not in self._hists:
+                self._hists[k] = Histogram()
+            self._hists[k].observe(v)
+
+    def render(self) -> str:
+        with self._lock:
+            lines: List[str] = []
+            seen = set()
+
+            def header(name, mtype):
+                if name not in seen:
+                    seen.add(name)
+                    if name in self._help:
+                        lines.append(f"# HELP {name} {self._help[name]}")
+                    lines.append(f"# TYPE {name} {mtype}")
+
+            for (name, labels), v in sorted(self._counters.items()):
+                header(name, "counter")
+                lines.append(f"{name}{labels} {v}")
+            for (name, labels), fn in sorted(self._gauges.items()):
+                header(name, "gauge")
+                try:
+                    lines.append(f"{name}{labels} {float(fn())}")
+                except Exception:
+                    pass
+            for (name, labels), h in sorted(self._hists.items()):
+                header(name, "histogram")
+                lines.extend(h.render(name, labels))
+            return "\n".join(lines) + "\n"
+
+
+GLOBAL = Metrics()
+GLOBAL.describe("tpu_model_generated_tokens_total",
+                "Output tokens generated across all requests")
+GLOBAL.describe("tpu_model_prompt_tokens_total", "Prompt tokens prefilled")
+GLOBAL.describe("tpu_model_requests_total", "Completed generate requests")
+GLOBAL.describe("tpu_model_ttft_seconds", "Time to first token")
+GLOBAL.describe("tpu_model_decode_tokens_per_second",
+                "Per-request steady-state decode rate")
+GLOBAL.describe("tpu_model_active_slots", "Busy decode slots")
+GLOBAL.describe("tpu_model_queue_depth", "Requests waiting for a slot")
+
+
+class Stopwatch:
+    def __init__(self):
+        self.t0 = time.monotonic()
+
+    def elapsed(self):
+        return time.monotonic() - self.t0

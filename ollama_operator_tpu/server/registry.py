@@ -1,0 +1,281 @@
+"""Ollama registry client + local model store.
+
+Re-provides what the reference delegates to `ollama pull` run against the
+shared store server (/root/reference/pkg/model/pod.go:68-83 — the puller
+init-container; docs/pages/en/references/architectural-design.md explains
+the store exists because model images are OCI manifests with non-runnable
+contentTypes). This client speaks that protocol natively:
+
+  GET  /v2/<ns>/<name>/manifests/<tag>   (docker manifest v2 JSON)
+  GET  /v2/<ns>/<name>/blobs/<digest>    (content-addressed layers)
+
+Layer mediaTypes: application/vnd.ollama.image.{model,template,system,
+params,license,adapter} — the model layer is the GGUF file.
+
+On-disk layout mirrors ollama's so the cache semantics match the reference's
+shared PVC (pull once, every replica mmap-shares):
+
+  <root>/blobs/sha256-<hex>
+  <root>/manifests/<registry>/<ns>/<name>/<tag>
+
+Downloads stream to a unique .partial file and are verified against the
+digest before being atomically published; interrupted pulls resume via HTTP
+Range.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import urllib.error
+import urllib.request
+from typing import Callable, Dict, List, Optional
+
+from .names import ModelName
+
+MT_MODEL = "application/vnd.ollama.image.model"
+MT_TEMPLATE = "application/vnd.ollama.image.template"
+MT_SYSTEM = "application/vnd.ollama.image.system"
+MT_PARAMS = "application/vnd.ollama.image.params"
+MT_LICENSE = "application/vnd.ollama.image.license"
+MT_ADAPTER = "application/vnd.ollama.image.adapter"
+MANIFEST_ACCEPT = ("application/vnd.docker.distribution.manifest.v2+json, "
+                   "application/vnd.oci.image.manifest.v1+json")
+
+ProgressCb = Callable[[str, int, int], None]  # (status, completed, total)
+
+
+class RegistryError(RuntimeError):
+    pass
+
+
+class ModelStore:
+    """Local content-addressed store of model blobs + manifests."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(os.path.join(root, "blobs"), exist_ok=True)
+        os.makedirs(os.path.join(root, "manifests"), exist_ok=True)
+
+    # -- paths ------------------------------------------------------------
+    def blob_path(self, digest: str) -> str:
+        return os.path.join(self.root, "blobs", digest.replace(":", "-"))
+
+    def manifest_path(self, name: ModelName) -> str:
+        return os.path.join(self.root, "manifests", name.registry_host,
+                            name.namespace, name.name, name.tag)
+
+    def has_blob(self, digest: str) -> bool:
+        return os.path.exists(self.blob_path(digest))
+
+    # -- manifests --------------------------------------------------------
+    def write_manifest(self, name: ModelName, manifest: dict):
+        path = self.manifest_path(name)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + f".tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(manifest, f)
+        os.replace(tmp, path)
+
+    def read_manifest(self, name: ModelName) -> Optional[dict]:
+        try:
+            with open(self.manifest_path(name)) as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return None
+
+    def delete_model(self, name: ModelName) -> bool:
+        path = self.manifest_path(name)
+        if not os.path.exists(path):
+            return False
+        os.remove(path)
+        self.gc()
+        return True
+
+    def list_models(self) -> List[dict]:
+        out = []
+        mroot = os.path.join(self.root, "manifests")
+        for dirpath, _dirs, files in os.walk(mroot):
+            for tag in files:
+                if tag.startswith("."):
+                    continue
+                rel = os.path.relpath(os.path.join(dirpath, tag), mroot)
+                parts = rel.split(os.sep)
+                if len(parts) < 4:
+                    continue
+                # registry / <namespace…> / name / tag — the namespace may
+                # span several path segments
+                reg, ns, nm, tg = (parts[0], "/".join(parts[1:-2]),
+                                   parts[-2], parts[-1])
+                name = ModelName(reg, ns, nm, tg)
+                try:
+                    with open(os.path.join(dirpath, tag)) as f:
+                        manifest = json.load(f)
+                except (OSError, json.JSONDecodeError):
+                    continue
+                size = sum(l.get("size", 0)
+                           for l in manifest.get("layers", []))
+                out.append({"name": name, "manifest": manifest,
+                            "size": size,
+                            "modified_at": os.path.getmtime(
+                                os.path.join(dirpath, tag))})
+        return out
+
+    def gc(self):
+        """Delete blobs referenced by no manifest (ollama's prune)."""
+        referenced = set()
+        for m in self.list_models():
+            cfg = m["manifest"].get("config", {})
+            if cfg.get("digest"):
+                referenced.add(cfg["digest"].replace(":", "-"))
+            for layer in m["manifest"].get("layers", []):
+                referenced.add(layer["digest"].replace(":", "-"))
+        bdir = os.path.join(self.root, "blobs")
+        for b in os.listdir(bdir):
+            if b not in referenced and ".partial" not in b:
+                os.remove(os.path.join(bdir, b))
+
+    # -- model assembly ---------------------------------------------------
+    def model_layers(self, name: ModelName) -> Dict[str, str]:
+        """mediaType → blob path for a pulled model."""
+        manifest = self.read_manifest(name)
+        if manifest is None:
+            raise RegistryError(f"model {name.short} not found locally")
+        out = {}
+        for layer in manifest.get("layers", []):
+            out[layer["mediaType"]] = self.blob_path(layer["digest"])
+        return out
+
+    def model_digest(self, name: ModelName, media_type: str = MT_MODEL
+                     ) -> Optional[str]:
+        manifest = self.read_manifest(name)
+        if manifest is None:
+            return None
+        for layer in manifest.get("layers", []):
+            if layer["mediaType"] == media_type:
+                return layer["digest"]
+        return None
+
+    # -- local create (for /api/create without a registry) ---------------
+    def add_blob(self, data: bytes) -> dict:
+        digest = "sha256:" + hashlib.sha256(data).hexdigest()
+        path = self.blob_path(digest)
+        if not os.path.exists(path):
+            tmp = path + f".partial.{os.getpid()}"
+            with open(tmp, "wb") as f:
+                f.write(data)
+            os.replace(tmp, path)
+        return {"digest": digest, "size": len(data)}
+
+    def add_blob_file(self, src: str) -> dict:
+        h = hashlib.sha256()
+        size = 0
+        with open(src, "rb") as f:
+            while chunk := f.read(1 << 20):
+                h.update(chunk)
+                size += len(chunk)
+        digest = "sha256:" + h.hexdigest()
+        path = self.blob_path(digest)
+        if not os.path.exists(path):
+            tmp = path + f".partial.{os.getpid()}"
+            shutil.copyfile(src, tmp)
+            os.replace(tmp, path)
+        return {"digest": digest, "size": size}
+
+
+class RegistryClient:
+    def __init__(self, store: ModelStore, timeout: float = 60.0):
+        self.store = store
+        self.timeout = timeout
+
+    def _open(self, url: str, headers: Dict[str, str]):
+        req = urllib.request.Request(url, headers=headers)
+        return urllib.request.urlopen(req, timeout=self.timeout)
+
+    def fetch_manifest(self, name: ModelName) -> dict:
+        try:
+            with self._open(name.manifest_url(),
+                            {"Accept": MANIFEST_ACCEPT}) as r:
+                return json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                raise RegistryError(
+                    f"model {name.short!r} not found in registry") from e
+            raise RegistryError(f"manifest fetch failed: {e}") from e
+        except urllib.error.URLError as e:
+            raise RegistryError(f"registry unreachable: {e}") from e
+
+    def _pull_blob(self, name: ModelName, digest: str, size: int,
+                   progress: Optional[ProgressCb], status: str):
+        path = self.store.blob_path(digest)
+        if os.path.exists(path):
+            if progress:
+                progress(status, size, size)
+            return
+        # each attempt writes its own .partial.<suffix>; to resume, claim an
+        # abandoned partial by atomic rename (only one concurrent puller can
+        # win the claim, the rest start fresh — no interleaved writes)
+        partial = path + f".partial.{os.getpid()}.{os.urandom(3).hex()}"
+        have = 0
+        import glob as _glob
+        for cand in _glob.glob(path + ".partial*"):
+            try:
+                os.replace(cand, partial)
+                have = os.path.getsize(partial)
+                break
+            except OSError:
+                continue
+        headers: Dict[str, str] = {}
+        mode = "wb"
+        if 0 < have < size:
+            headers["Range"] = f"bytes={have}-"
+            mode = "ab"
+        h = hashlib.sha256()
+        try:
+            with self._open(name.blob_url(digest), headers) as r:
+                if mode == "ab" and r.status != 206:
+                    mode, have = "wb", 0  # server ignored Range
+                with open(partial, mode) as f:
+                    done = have
+                    while chunk := r.read(1 << 20):
+                        f.write(chunk)
+                        done += len(chunk)
+                        if progress:
+                            progress(status, done, size)
+        except urllib.error.URLError as e:
+            raise RegistryError(f"blob pull failed: {e}") from e
+        # verify the whole file (including any resumed prefix)
+        with open(partial, "rb") as f:
+            while chunk := f.read(1 << 20):
+                h.update(chunk)
+        actual = "sha256:" + h.hexdigest()
+        if actual != digest:
+            os.remove(partial)
+            raise RegistryError(
+                f"digest mismatch for {digest}: got {actual}")
+        os.replace(partial, path)
+
+    def pull(self, ref: str, progress: Optional[ProgressCb] = None) -> ModelName:
+        """Pull a model by name into the store. Idempotent; resumes."""
+        name = ModelName.parse(ref)
+        if progress:
+            progress("pulling manifest", 0, 0)
+        manifest = self.fetch_manifest(name)
+        layers = list(manifest.get("layers", []))
+        cfg = manifest.get("config")
+        if cfg:
+            layers.append(cfg)
+        for layer in layers:
+            self._pull_blob(name, layer["digest"], layer.get("size", 0),
+                            progress, f"pulling {layer['digest'][7:19]}")
+        if progress:
+            progress("writing manifest", 0, 0)
+        self.store.write_manifest(name, manifest)
+        if progress:
+            progress("success", 0, 0)
+        return name
+
+    def push(self, ref: str, progress: Optional[ProgressCb] = None):
+        raise RegistryError("push is not implemented yet")

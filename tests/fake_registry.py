@@ -1,0 +1,109 @@
+"""In-process fake ollama registry for tests — the analog of the reference's
+envtest trick (real protocol, no external service)."""
+
+import hashlib
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ollama_operator_tpu.server.registry import (
+    MT_MODEL, MT_PARAMS, MT_SYSTEM, MT_TEMPLATE)
+
+
+class FakeRegistry:
+    def __init__(self):
+        self.blobs = {}        # digest -> bytes
+        self.manifests = {}    # (ns, name, tag) -> manifest dict
+        self.requests = []     # log of (method, path, headers)
+        self.httpd = None
+        self.port = None
+
+    def add_blob(self, data: bytes) -> dict:
+        digest = "sha256:" + hashlib.sha256(data).hexdigest()
+        self.blobs[digest] = data
+        return {"digest": digest, "size": len(data)}
+
+    def add_model(self, ns: str, name: str, tag: str, gguf_bytes: bytes,
+                  template: str = None, params: dict = None,
+                  system: str = None):
+        layers = [{"mediaType": MT_MODEL, **self.add_blob(gguf_bytes)}]
+        if template:
+            layers.append({"mediaType": MT_TEMPLATE,
+                           **self.add_blob(template.encode())})
+        if system:
+            layers.append({"mediaType": MT_SYSTEM,
+                           **self.add_blob(system.encode())})
+        if params:
+            layers.append({"mediaType": MT_PARAMS,
+                           **self.add_blob(json.dumps(params).encode())})
+        config = self.add_blob(json.dumps({"model_format": "gguf"}).encode())
+        self.manifests[(ns, name, tag)] = {
+            "schemaVersion": 2,
+            "mediaType": "application/vnd.docker.distribution.manifest.v2+json",
+            "config": {"mediaType":
+                       "application/vnd.docker.container.image.v1+json",
+                       **config},
+            "layers": layers,
+        }
+
+    def start(self):
+        reg = self
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                reg.requests.append(("GET", self.path,
+                                     dict(self.headers)))
+                parts = self.path.strip("/").split("/")
+                # /v2/<ns>/<name>/manifests/<tag>
+                if len(parts) >= 5 and parts[0] == "v2" and \
+                        parts[-2] == "manifests":
+                    key = ("/".join(parts[1:-2]), )  # ns may contain /
+                    ns = "/".join(parts[1:-3])
+                    name, tag = parts[-3], parts[-1]
+                    m = reg.manifests.get((ns, name, tag))
+                    if m is None:
+                        self.send_response(404)
+                        self.end_headers()
+                        return
+                    body = json.dumps(m).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", m["mediaType"])
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if len(parts) >= 5 and parts[0] == "v2" and \
+                        parts[-2] == "blobs":
+                    digest = parts[-1]
+                    data = reg.blobs.get(digest)
+                    if data is None:
+                        self.send_response(404)
+                        self.end_headers()
+                        return
+                    rng = self.headers.get("Range")
+                    if rng and rng.startswith("bytes="):
+                        start = int(rng[6:].split("-")[0])
+                        chunk = data[start:]
+                        self.send_response(206)
+                    else:
+                        chunk = data
+                        self.send_response(200)
+                    self.send_header("Content-Length", str(len(chunk)))
+                    self.end_headers()
+                    self.wfile.write(chunk)
+                    return
+                self.send_response(404)
+                self.end_headers()
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.port = self.httpd.server_address[1]
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+        return f"http://127.0.0.1:{self.port}"
+
+    def stop(self):
+        if self.httpd:
+            self.httpd.shutdown()

@@ -1,0 +1,44 @@
+from ollama_operator_tpu.server.modelfile import parse_modelfile
+
+
+def test_basic_modelfile():
+    mf = parse_modelfile("""
+# a comment
+FROM llama2
+PARAMETER temperature 0.7
+PARAMETER top_k 50
+PARAMETER stop "<|im_end|>"
+PARAMETER stop "</s>"
+SYSTEM You are helpful.
+""")
+    assert mf.from_ == "llama2"
+    assert mf.parameters["temperature"] == 0.7
+    assert mf.parameters["top_k"] == 50
+    assert mf.parameters["stop"] == ["<|im_end|>", "</s>"]
+    assert mf.system == "You are helpful."
+
+
+def test_triple_quoted_template():
+    mf = parse_modelfile('FROM m\nTEMPLATE """{{ .System }}\n'
+                         '{{ .Prompt }}"""\n')
+    assert mf.template == "{{ .System }}\n{{ .Prompt }}"
+
+
+def test_single_line_triple_quote():
+    mf = parse_modelfile('FROM m\nSYSTEM """all on one line"""')
+    assert mf.system == "all on one line"
+
+
+def test_message_commands():
+    mf = parse_modelfile('FROM m\nMESSAGE user hello\nMESSAGE assistant hi')
+    assert mf.messages == [("user", "hello"), ("assistant", "hi")]
+
+
+def test_render_roundtrip():
+    mf = parse_modelfile("FROM base\nPARAMETER temperature 0.1\n"
+                         'SYSTEM """s"""')
+    text = mf.render()
+    mf2 = parse_modelfile(text)
+    assert mf2.from_ == "base"
+    assert mf2.parameters["temperature"] == 0.1
+    assert mf2.system == "s"

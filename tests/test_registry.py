@@ -1,0 +1,113 @@
+"""Registry client + blob store against the fake registry."""
+
+import os
+
+import pytest
+
+from ollama_operator_tpu.server.names import ModelName
+from ollama_operator_tpu.server.registry import (
+    MT_MODEL, ModelStore, RegistryClient, RegistryError)
+
+from fake_registry import FakeRegistry
+
+
+def test_name_parsing():
+    n = ModelName.parse("phi")
+    assert (n.registry, n.namespace, n.name, n.tag) == (
+        "registry.ollama.ai", "library", "phi", "latest")
+    assert n.short == "phi:latest"
+    n2 = ModelName.parse("myuser/mymodel:7b")
+    assert n2.namespace == "myuser" and n2.tag == "7b"
+    n3 = ModelName.parse("http://127.0.0.1:5000/library/m:t")
+    assert n3.base_url == "http://127.0.0.1:5000"
+    assert n3.manifest_url() == "http://127.0.0.1:5000/v2/library/m/manifests/t"
+
+
+@pytest.fixture()
+def registry():
+    r = FakeRegistry()
+    url = r.start()
+    yield r, url
+    r.stop()
+
+
+def test_pull_and_list(tmp_path, registry):
+    reg, url = registry
+    reg.add_model("library", "m", "latest", b"GGUF-bytes-here" * 100,
+                  template="{{ .Prompt }}", params={"temperature": 0.5})
+    store = ModelStore(str(tmp_path))
+    client = RegistryClient(store)
+    name = client.pull(f"{url}/library/m:latest")
+    assert store.read_manifest(name) is not None
+    layers = store.model_layers(name)
+    assert MT_MODEL in layers
+    with open(layers[MT_MODEL], "rb") as f:
+        assert f.read() == b"GGUF-bytes-here" * 100
+    models = store.list_models()
+    assert len(models) == 1
+
+
+def test_pull_is_idempotent(tmp_path, registry):
+    reg, url = registry
+    reg.add_model("library", "m", "latest", b"x" * 1000)
+    store = ModelStore(str(tmp_path))
+    client = RegistryClient(store)
+    client.pull(f"{url}/library/m:latest")
+    n_before = len([r for r in reg.requests if "blobs" in r[1]])
+    client.pull(f"{url}/library/m:latest")
+    n_after = len([r for r in reg.requests if "blobs" in r[1]])
+    assert n_after == n_before  # cached blobs are not re-fetched
+
+
+def test_pull_resumes_partial(tmp_path, registry):
+    reg, url = registry
+    data = b"z" * 5000
+    entry = reg.add_model("library", "m", "latest", data)
+    store = ModelStore(str(tmp_path))
+    client = RegistryClient(store)
+    # simulate an interrupted download
+    import hashlib
+    digest = "sha256:" + hashlib.sha256(data).hexdigest()
+    partial = store.blob_path(digest) + ".partial"
+    with open(partial, "wb") as f:
+        f.write(data[:2000])
+    client.pull(f"{url}/library/m:latest")
+    with open(store.blob_path(digest), "rb") as f:
+        assert f.read() == data
+    range_reqs = [r for r in reg.requests
+                  if r[2].get("Range") == "bytes=2000-"]
+    assert range_reqs, "client did not resume with a Range request"
+
+
+def test_digest_verification(tmp_path, registry):
+    reg, url = registry
+    reg.add_model("library", "m", "latest", b"good")
+    # corrupt the stored blob server-side
+    for d in list(reg.blobs):
+        if reg.blobs[d] == b"good":
+            reg.blobs[d] = b"evil"
+    store = ModelStore(str(tmp_path))
+    client = RegistryClient(store)
+    with pytest.raises(RegistryError, match="digest mismatch"):
+        client.pull(f"{url}/library/m:latest")
+
+
+def test_missing_model_404(tmp_path, registry):
+    reg, url = registry
+    store = ModelStore(str(tmp_path))
+    client = RegistryClient(store)
+    with pytest.raises(RegistryError, match="not found"):
+        client.pull(f"{url}/library/nope:latest")
+
+
+def test_delete_and_gc(tmp_path, registry):
+    reg, url = registry
+    reg.add_model("library", "m", "latest", b"blobdata")
+    store = ModelStore(str(tmp_path))
+    client = RegistryClient(store)
+    name = client.pull(f"{url}/library/m:latest")
+    blob_dir = os.path.join(str(tmp_path), "blobs")
+    assert len(os.listdir(blob_dir)) > 0
+    assert store.delete_model(name)
+    assert len(os.listdir(blob_dir)) == 0  # gc removed unreferenced blobs
+    assert not store.delete_model(name)

@@ -1,0 +1,83 @@
+"""Go-template subset renderer against real ollama model templates."""
+
+import pytest
+
+from ollama_operator_tpu.server.template import Template, TemplateError
+
+LLAMA2 = ("[INST] {{ if .System }}<<SYS>>{{ .System }}<</SYS>>\n\n"
+          "{{ end }}{{ .Prompt }} [/INST]")
+CHATML = ("{{ if .System }}<|im_start|>system\n{{ .System }}<|im_end|>\n"
+          "{{ end }}{{ if .Prompt }}<|im_start|>user\n{{ .Prompt }}"
+          "<|im_end|>\n{{ end }}<|im_start|>assistant\n")
+MESSAGES = ("{{- range .Messages }}<|start|>{{ .Role }}\n"
+            "{{ .Content }}<|end|>\n{{ end }}<|start|>assistant\n")
+
+
+def test_llama2_with_system():
+    out = Template(LLAMA2).render(system="be nice", prompt="hi")
+    assert out == "[INST] <<SYS>>be nice<</SYS>>\n\nhi [/INST]"
+
+
+def test_llama2_without_system():
+    out = Template(LLAMA2).render(system="", prompt="hi")
+    assert out == "[INST] hi [/INST]"
+
+
+def test_chatml():
+    out = Template(CHATML).render(system="sys", prompt="question")
+    assert out == ("<|im_start|>system\nsys<|im_end|>\n"
+                   "<|im_start|>user\nquestion<|im_end|>\n"
+                   "<|im_start|>assistant\n")
+
+
+def test_range_messages():
+    msgs = [{"Role": "user", "Content": "a"},
+            {"Role": "assistant", "Content": "b"}]
+    out = Template(MESSAGES).render(messages=msgs)
+    assert out == ("<|start|>user\na<|end|>\n<|start|>assistant\nb<|end|>\n"
+                   "<|start|>assistant\n")
+
+
+def test_eq_and_nested_if():
+    tpl = Template('{{ range .Messages }}{{ if eq .Role "user" }}U:'
+                   '{{ .Content }};{{ else }}A:{{ .Content }};{{ end }}'
+                   '{{ end }}')
+    out = tpl.render(messages=[{"Role": "user", "Content": "x"},
+                               {"Role": "assistant", "Content": "y"}])
+    assert out == "U:x;A:y;"
+
+
+def test_trim_markers():
+    tpl = Template("a\n{{- if true }}b{{ end }}  \n{{- .X }}")
+    assert tpl.render(x="c") == "ab  \nc" or tpl.render(x="c") == "abc"
+
+
+def test_lowercase_context_keys_work():
+    assert Template("{{ .Prompt }}").render(prompt="p") == "p"
+
+
+def test_unsupported_function_raises():
+    with pytest.raises(TemplateError):
+        Template('{{ slice .X 1 }}').render(x=[1, 2])
+
+
+def test_else_if_chain():
+    tpl = Template('{{ if .A }}a{{ else if .B }}b{{ else }}c{{ end }}')
+    assert tpl.render(a=True, b=False) == "a"
+    assert tpl.render(a=False, b=True) == "b"
+    assert tpl.render(a=False, b=False) == "c"
+
+
+def test_else_if_chain_three_deep():
+    tpl = Template('{{ if eq .R "u" }}U{{ else if eq .R "a" }}A'
+                   '{{ else if eq .R "s" }}S{{ else }}?{{ end }}')
+    assert tpl.render(r="u") == "U"
+    assert tpl.render(r="a") == "A"
+    assert tpl.render(r="s") == "S"
+    assert tpl.render(r="x") == "?"
+
+
+def test_string_literal_and_ne():
+    tpl = Template('{{ if ne .A "z" }}ok{{ end }}')
+    assert tpl.render(a="q") == "ok"
+    assert tpl.render(a="z") == ""
