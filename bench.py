@@ -1,0 +1,148 @@
+"""Headline benchmark: aggregate decode throughput through the real Engine.
+
+Measures the serving path of BASELINE.md's ladder (config 1 model: phi 2.7B,
+the reference's sample CR `config/samples/ollama_v1_model.yaml` image) —
+continuous-batching decode tok/s plus p50 TTFT — on whatever accelerator is
+attached (one real TPU chip under the driver; CPU elsewhere). Prints ONE
+JSON line:
+
+  {"metric": ..., "value": N, "unit": "tok/s", "vs_baseline": N, ...}
+
+vs_baseline is the ratio against the earliest recorded BENCH_r*.json in the
+repo root (the reference publishes no numbers — BASELINE.md — so round 1
+self-baselines at 1.0 and later rounds are measured against it).
+
+Env knobs: BENCH_MODEL (preset name), BENCH_SLOTS, BENCH_STEPS, BENCH_SEQ,
+BENCH_PROMPT (prompt token count).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import sys
+import time
+
+import numpy as np
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def load_baseline(metric: str) -> float | None:
+    runs = []
+    for path in glob.glob(os.path.join(os.path.dirname(__file__) or ".",
+                                       "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        if rec.get("metric") == metric and isinstance(
+                rec.get("value"), (int, float)):
+            runs.append((int(m.group(1)), float(rec["value"])))
+    if not runs:
+        return None
+    return min(runs)[1]
+
+
+def main() -> None:
+    import jax
+
+    # sitecustomize force-sets jax_platforms="axon,cpu"; honor an explicit
+    # JAX_PLATFORMS env override (CPU smoke runs) the same way conftest does.
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    from ollama_operator_tpu.models import decoder
+    from ollama_operator_tpu.models.config import get_config
+    from ollama_operator_tpu.parallel.mesh import MeshPlan, make_mesh
+    from ollama_operator_tpu.runtime.engine import Engine, EngineConfig
+
+    model = os.environ.get("BENCH_MODEL", "phi")
+    slots = int(os.environ.get("BENCH_SLOTS", "8"))
+    steps = int(os.environ.get("BENCH_STEPS", "64"))
+    seq = int(os.environ.get("BENCH_SEQ", "1024"))
+    prompt_len = int(os.environ.get("BENCH_PROMPT", "128"))
+
+    devs = jax.devices()
+    log(f"bench: model={model} slots={slots} steps={steps} seq={seq} "
+        f"devices={[d.platform for d in devs]}")
+
+    cfg = get_config(model)
+    t0 = time.perf_counter()
+    params = decoder.init_params(cfg, jax.random.key(0))
+    jax.block_until_ready(params)
+    log(f"params init ({cfg.n_params/1e9:.2f}B) in "
+        f"{time.perf_counter()-t0:.1f}s")
+
+    mesh = None
+    if len(devs) > 1:
+        tp = 1
+        while (tp * 2 <= len(devs) and cfg.n_heads % (tp * 2) == 0
+               and len(devs) % (tp * 2) == 0):
+            tp *= 2
+        mesh = make_mesh(MeshPlan.for_devices(len(devs), tp=tp))
+        log(f"mesh: {dict(mesh.shape)}")
+
+    eng = Engine(cfg, params, mesh=mesh,
+                 ecfg=EngineConfig(max_slots=slots, max_seq_len=seq))
+
+    rng = np.random.default_rng(0)
+    prompts = rng.integers(1, cfg.vocab_size, size=(slots, prompt_len),
+                           endpoint=False).astype(np.int32)
+
+    # TTFT: prompt admission → first sampled token back on host, per slot.
+    # First admit pays compile; measure it separately, then re-admit.
+    t0 = time.perf_counter()
+    eng.admit(0, prompts[0])
+    compile_s = time.perf_counter() - t0
+    log(f"prefill compile+run: {compile_s:.1f}s")
+    eng.release(0)
+
+    ttfts = []
+    for s in range(slots):
+        t0 = time.perf_counter()
+        eng.admit(s, prompts[s])
+        ttfts.append(time.perf_counter() - t0)
+    ttft_p50_ms = float(np.median(ttfts) * 1e3)
+
+    t0 = time.perf_counter()
+    eng.decode()
+    decode_compile_s = time.perf_counter() - t0
+    log(f"decode compile+run: {decode_compile_s:.1f}s")
+    for _ in range(3):
+        eng.decode()
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        toks = eng.decode()
+    toks = np.asarray(toks)  # host sync happens every step inside decode()
+    dt = time.perf_counter() - t0
+    tok_s = steps * slots / dt
+    per_step_ms = dt / steps * 1e3
+
+    metric = f"{model}_decode_tok_s_b{slots}"
+    baseline = load_baseline(metric)
+    vs = tok_s / baseline if baseline else 1.0
+    print(json.dumps({
+        "metric": metric,
+        "value": round(tok_s, 2),
+        "unit": "tok/s",
+        "vs_baseline": round(vs, 3),
+        "ttft_p50_ms": round(ttft_p50_ms, 1),
+        "decode_step_ms": round(per_step_ms, 2),
+        "slots": slots,
+        "platform": devs[0].platform,
+        "n_devices": len(devs),
+    }))
+
+
+if __name__ == "__main__":
+    main()
