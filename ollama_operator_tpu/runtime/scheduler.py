@@ -17,13 +17,23 @@ from __future__ import annotations
 
 import dataclasses
 import queue
+import sys
 import threading
 import time
+import traceback
 from typing import Iterator, List, Optional, Sequence
 
 import numpy as np
 
 from .engine import Engine, SlotOptions
+
+
+class SchedulerBusy(RuntimeError):
+    """Raised by submit() when the waiting queue is full (backpressure)."""
+
+
+class SchedulerBroken(RuntimeError):
+    """Raised by submit() after repeated engine failures wedged the loop."""
 
 
 @dataclasses.dataclass
@@ -89,6 +99,8 @@ class Scheduler:
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._lock = threading.Lock()
+        self.broken = False
+        self._consecutive_failures = 0
         self.total_generated = 0
         self.total_prompt = 0
         self.finished: List[RequestStats] = []  # ring of recent stats
@@ -106,7 +118,19 @@ class Scheduler:
                 f"prompt of {len(prompt_ids)} tokens exceeds context window "
                 f"{self.engine.max_seq}")
         req = Request(prompt_ids, opts, max_tokens, eog_ids)
-        self._waiting.put(req)
+        # broken-check + enqueue under the lock: the failure path flips
+        # `broken` and drains under the same lock, so a request can never
+        # slip into the queue after the final drain (its reader would hang)
+        with self._lock:
+            if self.broken:
+                raise SchedulerBroken(
+                    "scheduler stopped after repeated engine failures")
+            try:
+                self._waiting.put_nowait(req)
+            except queue.Full:
+                raise SchedulerBusy(
+                    f"request queue full ({self._waiting.maxsize} waiting)"
+                ) from None
         self._wake.set()
         return req
 
@@ -181,27 +205,64 @@ class Scheduler:
 
     def _loop(self):
         while not self._stop.is_set():
-            self._admit_waiting()
-            active = [(s, r) for s, r in enumerate(self._running)
-                      if r is not None]
-            if not active:
-                self._wake.wait(timeout=0.05)
-                self._wake.clear()
+            try:
+                self._step()
+            except Exception as e:  # noqa: BLE001 — a decode error must not
+                # kill the daemon thread: that would leave every in-flight
+                # tokens() reader blocked forever while /healthz stays green.
+                traceback.print_exc(file=sys.stderr)
+                self._fail_running(str(e))
+                self._consecutive_failures += 1
+                if self._consecutive_failures >= 3:
+                    with self._lock:
+                        self.broken = True
+                        self._drain_waiting(("error", f"engine failed: {e}"))
+                    return
+
+    def _fail_running(self, message: str):
+        for slot, req in enumerate(self._running):
+            if req is None:
                 continue
-            # drop cancelled before paying for a step
-            for slot, req in active:
-                if req.cancelled.is_set():
-                    self._finish(slot, req, "cancelled")
-            if self.n_active == 0:
+            self._running[slot] = None
+            req.error = message
+            req.stats.t_done = time.monotonic()
+            req.out.put(("error", message))
+            try:
+                self.engine.release(slot)
+            except Exception:  # noqa: BLE001 — best-effort slot reset
+                pass
+
+    def _drain_waiting(self, msg):
+        while True:
+            try:
+                req = self._waiting.get_nowait()
+            except queue.Empty:
+                return
+            req.out.put(msg)
+
+    def _step(self):
+        self._admit_waiting()
+        active = [(s, r) for s, r in enumerate(self._running)
+                  if r is not None]
+        if not active:
+            self._wake.wait(timeout=0.05)
+            self._wake.clear()
+            return
+        # drop cancelled before paying for a step
+        for slot, req in active:
+            if req.cancelled.is_set():
+                self._finish(slot, req, "cancelled")
+        if self.n_active == 0:
+            return
+        toks = self.engine.decode()
+        self._consecutive_failures = 0
+        for slot, req in enumerate(list(self._running)):
+            if req is None:
                 continue
-            toks = self.engine.decode()
-            for slot, req in enumerate(list(self._running)):
-                if req is None:
-                    continue
-                if not self._emit(req, int(toks[slot])):
-                    self._finish(slot, req, "stop")
-                # host-side length tracking (no device sync): the cache holds
-                # the prompt plus one entry per decode step taken so far
-                elif (req.stats.n_prompt + req.stats.n_generated
-                      >= self.engine.max_seq - 1):
-                    self._finish(slot, req, "length")
+            if not self._emit(req, int(toks[slot])):
+                self._finish(slot, req, "stop")
+            # host-side length tracking (no device sync): the cache holds
+            # the prompt plus one entry per decode step taken so far
+            elif (req.stats.n_prompt + req.stats.n_generated
+                  >= self.engine.max_seq - 1):
+                self._finish(slot, req, "length")

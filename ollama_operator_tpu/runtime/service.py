@@ -13,6 +13,7 @@ from __future__ import annotations
 import dataclasses
 import threading
 import time
+import weakref
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import jax
@@ -123,10 +124,15 @@ class LoadedModel:
         self.scheduler = Scheduler(self.engine)
         self._embed_fn = None
         self._embed_lock = threading.Lock()
+        # weakrefs: a registered gauge must not keep the engine (and its
+        # multi-GB params) alive after unload()
+        wself = weakref.ref(self)
         METRICS.gauge_fn("tpu_model_active_slots",
-                         lambda: self.scheduler.n_active)
+                         lambda: (lm := wself()) is not None
+                         and lm.scheduler.n_active or 0)
         METRICS.gauge_fn("tpu_model_queue_depth",
-                         lambda: self.scheduler._waiting.qsize())
+                         lambda: (lm := wself()) is not None
+                         and lm.scheduler._waiting.qsize() or 0)
 
     # ------------------------------------------------------------------
     def render_prompt(self, prompt: str, system: Optional[str] = None,
@@ -283,3 +289,5 @@ class LoadedModel:
 
     def unload(self):
         self.scheduler.shutdown()
+        METRICS.remove_gauge("tpu_model_active_slots")
+        METRICS.remove_gauge("tpu_model_queue_depth")

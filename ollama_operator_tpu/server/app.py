@@ -26,6 +26,7 @@ different model swaps it in under a lock.
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import threading
 import time
@@ -39,6 +40,7 @@ from .. import __version__
 from ..gguf.reader import GGUFFile
 from ..gguf.transcode import load_model as transcode_load
 from ..runtime.engine import EngineConfig
+from ..runtime.scheduler import SchedulerBroken, SchedulerBusy
 from ..runtime.service import LoadedModel
 from ..tokenizer import Tokenizer
 from .metrics import GLOBAL as METRICS
@@ -145,24 +147,26 @@ class ModelManager:
             gguf_path = layers.get(MT_MODEL)
             if not gguf_path:
                 raise ApiError(500, f"model {name.short} has no model layer")
-            if self.loaded is not None:
-                self.loaded.unload()
-                self.loaded = None
             digest = self.store.model_digest(name) or ""
             import ml_dtypes
             dt = {"bfloat16": ml_dtypes.bfloat16,
                   "float32": np.float32}[self.engine_dtype]
+            # parse/transcode the new model (host memory) BEFORE tearing the
+            # old one down: a corrupt pull must not leave the server empty
             cfg, params, tok_md = transcode_load(
                 gguf_path, cache_dir=self.cache_dir, dtype=dt,
                 digest=digest.replace("sha256:", "")[:24] or None)
-            import jax.numpy as jnp
-            import jax
-            params = jax.tree_util.tree_map(jnp.asarray, params)
             tokenizer = Tokenizer.from_gguf_metadata(tok_md)
             template = self._read_layer_text(layers, MT_TEMPLATE)
             system = self._read_layer_text(layers, MT_SYSTEM)
             params_raw = self._read_layer_text(layers, MT_PARAMS)
             default_params = json.loads(params_raw) if params_raw else {}
+            if self.loaded is not None:
+                self.loaded.unload()
+                self.loaded = None
+            import jax.numpy as jnp
+            import jax
+            params = jax.tree_util.tree_map(jnp.asarray, params)
             ecfg = self.ecfg or EngineConfig(
                 max_seq_len=min(cfg.max_seq_len,
                                 int(default_params.get("num_ctx", 4096))))
@@ -253,12 +257,30 @@ class ModelManager:
             raise ApiError(400, "Modelfile needs a FROM line")
         name = ModelName.parse(ref)
         layers = []
+        base_params: Dict = {}
         # FROM: local model name or a GGUF file path
         base = ModelName.parse(mf.from_)
         base_manifest = self.store.read_manifest(base)
         if base_manifest is not None:
+            # inherit every base layer the Modelfile doesn't override (ollama
+            # keeps base template/system/params on create); params merge
+            overridden = set()
+            if mf.template:
+                overridden.add(MT_TEMPLATE)
+            if mf.system:
+                overridden.add(MT_SYSTEM)
+            if mf.license:
+                overridden.add(MT_LICENSE)
             for layer in base_manifest.get("layers", []):
-                if layer["mediaType"] == MT_MODEL:
+                mt = layer["mediaType"]
+                if mt == MT_PARAMS:
+                    try:
+                        with open(self.store.blob_path(layer["digest"])) as f:
+                            base_params = json.load(f)
+                    except (OSError, json.JSONDecodeError):
+                        pass
+                    continue  # re-emitted (possibly merged) below
+                if mt not in overridden:
                     layers.append(layer)
         else:
             import os
@@ -275,9 +297,13 @@ class ModelManager:
         if mf.system:
             layers.append({"mediaType": MT_SYSTEM,
                            **self.store.add_blob(mf.system.encode())})
-        if mf.parameters:
+        if mf.parameters or base_params:
+            merged = dict(base_params)
+            merged.update(mf.parameters or {})
+            mf_merged = dataclasses.replace(mf, parameters=merged)
             layers.append({"mediaType": MT_PARAMS,
-                           **self.store.add_blob(params_json(mf).encode())})
+                           **self.store.add_blob(
+                               params_json(mf_merged).encode())})
         if mf.license:
             layers.append({"mediaType": MT_LICENSE,
                            **self.store.add_blob(mf.license.encode())})
@@ -340,6 +366,7 @@ class Handler(BaseHTTPRequestHandler):
         self.send_header("Transfer-Encoding", "chunked")
         self.end_headers()
         self._streaming = True
+        self._stream_ctype = ctype
 
     def _chunk(self, data: bytes):
         self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
@@ -356,7 +383,14 @@ class Handler(BaseHTTPRequestHandler):
         framing — emit the error as a final chunk instead."""
         if getattr(self, "_streaming", False):
             try:
-                self._stream_json({"error": message})
+                if getattr(self, "_stream_ctype", "") == "text/event-stream":
+                    # keep SSE framing: a bare JSON line mid-stream is
+                    # dropped by OpenAI SDKs and the missing [DONE] hangs them
+                    self._chunk(self._sse({"error": {
+                        "message": message, "type": "server_error"}}))
+                    self._chunk(b"data: [DONE]\n\n")
+                else:
+                    self._stream_json({"error": message})
                 self._end_stream()
             except (BrokenPipeError, ConnectionResetError):
                 pass
@@ -389,7 +423,11 @@ class Handler(BaseHTTPRequestHandler):
             elif path in ("/healthz", "/livez"):
                 self._send_text("ok")
             elif path == "/readyz":
-                self._send_text("ok")
+                lm = self.manager.loaded
+                if lm is not None and lm.scheduler.broken:
+                    self._send_text("engine failed", status=503)
+                else:
+                    self._send_text("ok")
             else:
                 self._send_json({"error": "not found"}, 404)
         except ApiError as e:
@@ -446,6 +484,10 @@ class Handler(BaseHTTPRequestHandler):
             route(body)
         except ApiError as e:
             self._send_error(str(e), e.status)
+        except SchedulerBusy as e:
+            self._send_error(str(e), 503)
+        except SchedulerBroken as e:
+            self._send_error(str(e), 500)
         except RegistryError as e:
             self._send_error(str(e), 500)
         except (BrokenPipeError, ConnectionResetError):
@@ -551,13 +593,13 @@ class Handler(BaseHTTPRequestHandler):
         if stream:
             self._start_stream()
 
-            def progress(status, completed, total):
+            def progress(status, completed, total, digest=None):
                 msg = {"status": status}
                 if total:
                     msg["total"] = total
                     msg["completed"] = completed
-                    if status.startswith("pulling sha") or "sha" in status:
-                        msg["digest"] = status.replace("pulling ", "")
+                if digest:
+                    msg["digest"] = digest
                 self._stream_json(msg)
 
             try:

@@ -73,6 +73,10 @@ class Metrics:
         with self._lock:
             self._gauges[self._key(name, labels)] = fn
 
+    def remove_gauge(self, name: str, labels: str = ""):
+        with self._lock:
+            self._gauges.pop(self._key(name, labels), None)
+
     def observe(self, name: str, v: float, labels: str = ""):
         with self._lock:
             k = self._key(name, labels)

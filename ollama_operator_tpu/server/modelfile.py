@@ -89,6 +89,8 @@ def parse_modelfile(text: str) -> Modelfile:
                         return "\n".join(parts)
                     parts.append(ln)
                 return "\n".join(parts)
+        if len(v) >= 2 and v[0] == v[-1] and v[0] in "\"'":
+            return v[1:-1]
         return v
 
     while i < len(lines):

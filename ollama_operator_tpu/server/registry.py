@@ -29,6 +29,8 @@ import hashlib
 import json
 import os
 import shutil
+import threading
+import time
 import urllib.error
 import urllib.request
 from typing import Callable, Dict, List, Optional
@@ -44,7 +46,9 @@ MT_ADAPTER = "application/vnd.ollama.image.adapter"
 MANIFEST_ACCEPT = ("application/vnd.docker.distribution.manifest.v2+json, "
                    "application/vnd.oci.image.manifest.v1+json")
 
-ProgressCb = Callable[[str, int, int], None]  # (status, completed, total)
+# (status, completed, total, digest=None) — digest set on blob progress so
+# clients (the ollama CLI keys per-layer progress bars on it) can track layers
+ProgressCb = Callable[..., None]
 
 
 class RegistryError(RuntimeError):
@@ -133,9 +137,18 @@ class ModelStore:
             for layer in m["manifest"].get("layers", []):
                 referenced.add(layer["digest"].replace(":", "-"))
         bdir = os.path.join(self.root, "blobs")
+        now = time.time()
         for b in os.listdir(bdir):
-            if b not in referenced and ".partial" not in b:
-                os.remove(os.path.join(bdir, b))
+            p = os.path.join(bdir, b)
+            if ".partial" in b:
+                # abandoned downloads (live writers keep mtime fresh)
+                try:
+                    if now - os.path.getmtime(p) >= 3600:
+                        os.remove(p)
+                except OSError:
+                    pass
+            elif b not in referenced:
+                os.remove(p)
 
     # -- model assembly ---------------------------------------------------
     def model_layers(self, name: ModelName) -> Dict[str, str]:
@@ -189,6 +202,14 @@ class RegistryClient:
     def __init__(self, store: ModelStore, timeout: float = 60.0):
         self.store = store
         self.timeout = timeout
+        # serialise same-digest downloads within this process; the .partial
+        # claim-by-rename below only guards against *other* processes
+        self._blob_locks: Dict[str, threading.Lock] = {}
+        self._blob_locks_guard = threading.Lock()
+
+    def _blob_lock(self, digest: str) -> threading.Lock:
+        with self._blob_locks_guard:
+            return self._blob_locks.setdefault(digest, threading.Lock())
 
     def _open(self, url: str, headers: Dict[str, str]):
         req = urllib.request.Request(url, headers=headers)
@@ -209,19 +230,46 @@ class RegistryClient:
 
     def _pull_blob(self, name: ModelName, digest: str, size: int,
                    progress: Optional[ProgressCb], status: str):
+        with self._blob_lock(digest):
+            self._pull_blob_locked(name, digest, size, progress, status)
+
+    @staticmethod
+    def _cleanup_stale_partials(path: str):
+        """Remove abandoned .partial files once the blob is installed.
+
+        Only stale ones (>60s mtime): a fresh partial may belong to a live
+        writer in another process, whose in-flight fd must not be yanked."""
+        import glob as _glob
+        now = time.time()
+        for cand in _glob.glob(path + ".partial*"):
+            try:
+                if now - os.path.getmtime(cand) >= 60:
+                    os.remove(cand)
+            except OSError:
+                continue
+
+    def _pull_blob_locked(self, name: ModelName, digest: str, size: int,
+                          progress: Optional[ProgressCb], status: str):
         path = self.store.blob_path(digest)
         if os.path.exists(path):
+            self._cleanup_stale_partials(path)
             if progress:
-                progress(status, size, size)
+                progress(status, size, size, digest=digest)
             return
         # each attempt writes its own .partial.<suffix>; to resume, claim an
-        # abandoned partial by atomic rename (only one concurrent puller can
-        # win the claim, the rest start fresh — no interleaved writes)
+        # abandoned partial by atomic rename. Only partials whose mtime is
+        # stale are claimed: an active writer (another process; same-process
+        # writers are excluded by _blob_lock) touches its file continuously,
+        # and renaming a live partial would not stop the writer's open fd —
+        # both would append to one inode and corrupt the blob.
         partial = path + f".partial.{os.getpid()}.{os.urandom(3).hex()}"
         have = 0
         import glob as _glob
+        now = time.time()
         for cand in _glob.glob(path + ".partial*"):
             try:
+                if now - os.path.getmtime(cand) < 60:
+                    continue
                 os.replace(cand, partial)
                 have = os.path.getsize(partial)
                 break
@@ -243,7 +291,7 @@ class RegistryClient:
                         f.write(chunk)
                         done += len(chunk)
                         if progress:
-                            progress(status, done, size)
+                            progress(status, done, size, digest=digest)
         except urllib.error.URLError as e:
             raise RegistryError(f"blob pull failed: {e}") from e
         # verify the whole file (including any resumed prefix)
@@ -256,6 +304,7 @@ class RegistryClient:
             raise RegistryError(
                 f"digest mismatch for {digest}: got {actual}")
         os.replace(partial, path)
+        self._cleanup_stale_partials(path)
 
     def pull(self, ref: str, progress: Optional[ProgressCb] = None) -> ModelName:
         """Pull a model by name into the store. Idempotent; resumes."""

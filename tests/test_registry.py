@@ -71,6 +71,10 @@ def test_pull_resumes_partial(tmp_path, registry):
     partial = store.blob_path(digest) + ".partial"
     with open(partial, "wb") as f:
         f.write(data[:2000])
+    # abandoned partials are only claimed once stale (a live writer keeps
+    # mtime fresh); backdate to simulate a crashed puller
+    import os as _os
+    _os.utime(partial, (1, 1))
     client.pull(f"{url}/library/m:latest")
     with open(store.blob_path(digest), "rb") as f:
         assert f.read() == data

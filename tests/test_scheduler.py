@@ -103,3 +103,77 @@ def test_oversized_prompt_rejected():
             pass
     finally:
         sched.shutdown()
+
+
+def test_engine_failure_fails_requests_not_thread():
+    """A decode exception must surface to callers, not kill the loop."""
+    cfg, params, eng, sched = make_stack(slots=2)
+    try:
+        calls = {"n": 0}
+        real_decode = eng.decode
+
+        def flaky_decode():
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("injected XLA error")
+            return real_decode()
+
+        eng.decode = flaky_decode
+        r1 = sched.submit(np.array([1, 2], np.int32), GREEDY, max_tokens=4)
+        try:
+            toks = list(r1.tokens())
+            # token stream may complete if the error hit after its tokens
+            assert len(toks) <= 4
+        except RuntimeError as e:
+            assert "injected" in str(e)
+        assert sched._thread.is_alive()
+        assert not sched.broken
+        # the loop recovered: a fresh request completes normally
+        r2 = sched.submit(np.array([3, 4], np.int32), GREEDY, max_tokens=3)
+        assert len(list(r2.tokens())) == 3
+    finally:
+        sched.shutdown()
+
+
+def test_repeated_engine_failures_mark_broken():
+    cfg, params, eng, sched = make_stack(slots=1)
+    try:
+        def always_fail():
+            raise RuntimeError("dead engine")
+
+        eng.decode = always_fail
+        import pytest
+        from ollama_operator_tpu.runtime.scheduler import (SchedulerBroken,
+                                                           SchedulerBusy)
+        for _ in range(3):
+            r = sched.submit(np.array([1, 2], np.int32), GREEDY, max_tokens=4)
+            with pytest.raises(RuntimeError):
+                list(r.tokens())
+        deadline = time.monotonic() + 5
+        while not sched.broken and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert sched.broken
+        with pytest.raises(SchedulerBroken):
+            sched.submit(np.array([1], np.int32), GREEDY, max_tokens=1)
+    finally:
+        sched.shutdown()
+
+
+def test_queue_full_raises_busy():
+    cfg, params, eng, sched = make_stack(slots=1)
+    sched._waiting.maxsize = 2
+    import pytest
+    from ollama_operator_tpu.runtime.scheduler import SchedulerBusy
+    try:
+        # occupy the slot with a long request, then overfill the queue
+        r0 = sched.submit(np.array([1, 2], np.int32), GREEDY, max_tokens=40)
+        time.sleep(0.2)  # let it get admitted
+        held = [sched.submit(np.array([3], np.int32), GREEDY, max_tokens=1)
+                for _ in range(2)]
+        with pytest.raises(SchedulerBusy):
+            sched.submit(np.array([4], np.int32), GREEDY, max_tokens=1)
+        r0.cancel()
+        for r in held:
+            list(r.tokens())
+    finally:
+        sched.shutdown()

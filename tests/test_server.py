@@ -218,3 +218,36 @@ def test_stop_sequences(stack):
                           "temperature": 0.0}})
     assert "t" not in r["response"]
     assert r["done_reason"] == "stop"
+
+
+def test_pull_progress_carries_digest(stack):
+    model_ref = f"{stack['registry_url']}/library/tiny:latest"
+    lines = post(stack["base"], "/api/pull", {"model": model_ref},
+                 stream=True)
+    with_digest = [l for l in lines if l.get("digest")]
+    assert with_digest, "blob progress events must carry the layer digest"
+    assert all(l["digest"].startswith("sha256:") for l in with_digest)
+
+
+def test_create_inherits_base_layers(stack):
+    """FROM <local model> keeps the base template/params (ollama semantics)."""
+    base_name = _model_name(stack)
+    post(stack["base"], "/api/create",
+         {"name": "derived", "stream": False,
+          "modelfile": f"FROM {base_name}\nSYSTEM \"be terse\""})
+    show = post(stack["base"], "/api/show", {"name": "derived"})
+    # template inherited from the base model, system overridden
+    assert show["template"] == "{{ .System }}|{{ .Prompt }}"
+    assert show["system"] == "be terse"
+    assert "temperature" in show["parameters"]
+    # params merge: new PARAMETER wins, base keys survive
+    post(stack["base"], "/api/create",
+         {"name": "derived2", "stream": False,
+          "modelfile": f"FROM {base_name}\nPARAMETER temperature 0.5"})
+    show2 = post(stack["base"], "/api/show", {"name": "derived2"})
+    assert "0.5" in show2["parameters"]
+    assert "num_predict" in show2["parameters"]
+
+
+def test_readyz(stack):
+    assert get(stack["base"], "/readyz") == "ok"
