@@ -1,0 +1,16 @@
+"""TPU-native control plane: the operator half of the framework.
+
+Mirrors the reference's Go kubebuilder operator (SURVEY.md §1 L1–L4:
+/root/reference/internal/controller/model_controller.go,
+/root/reference/pkg/model/*, /root/reference/cmd/main.go) as a Python
+manager process speaking to the apiserver through a minimal stdlib REST
+client — same CRD group (`ollama.ayaka.io/v1`, kind `Model`) so existing
+Model CRs apply unchanged, plus TPU extension fields (runtime/topology/
+contextLength/sharding) the delegated-to-llama.cpp reference never needed.
+"""
+
+from .types import (  # noqa: F401
+    GROUP, VERSION, API_VERSION, KIND, PLURAL,
+    CONDITION_AVAILABLE, CONDITION_PROGRESSING, CONDITION_REPLICA_FAILURE,
+    CONDITION_UNKNOWN, ModelSpecView,
+)

@@ -1,0 +1,51 @@
+"""Operator entrypoint: `python -m ollama_operator_tpu.operator`.
+
+Flag surface mirrors the reference manager (/root/reference/cmd/main.go:
+61-74): health/metrics bind addresses and --leader-elect, plus
+--server-image (the TPU runtime image the workloads run, analogous to the
+reference's hardcoded OllamaBaseImage pin at pkg/model/pod.go:11 but
+overridable like its kustomize image pin).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import signal
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser("tpu-ollama-operator")
+    p.add_argument("--health-probe-bind-address", default=":8081")
+    p.add_argument("--leader-elect", action="store_true")
+    p.add_argument("--namespace", default=os.environ.get("WATCH_NAMESPACE"),
+                   help="restrict to one namespace (default: all)")
+    p.add_argument("--server-image", default=None)
+    p.add_argument("--kube-url", default=None,
+                   help="apiserver URL (default: in-cluster config)")
+    p.add_argument("--workers", type=int, default=2)
+    args = p.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s %(message)s")
+
+    from .client import KubeClient
+    from .manager import Manager
+
+    client = (KubeClient(args.kube_url) if args.kube_url
+              else KubeClient.in_cluster())
+    host, _, port = args.health_probe_bind_address.rpartition(":")
+    mgr = Manager(client, namespace=args.namespace,
+                  server_image=args.server_image,
+                  leader_elect=args.leader_elect,
+                  health_addr=(host or "0.0.0.0", int(port)))
+    mgr.start(workers=args.workers)
+    signal.pthread_sigmask(signal.SIG_BLOCK, [signal.SIGINT, signal.SIGTERM])
+    signal.sigwait([signal.SIGINT, signal.SIGTERM])
+    mgr.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,256 @@
+"""Minimal Kubernetes REST client over the stdlib (no kubernetes pip dep).
+
+The reference gets this layer for free from controller-runtime's
+`client.Client` (typed CRUD + caching informers). Here it is explicit: a
+thin JSON-over-HTTPS client speaking the apiserver's REST conventions —
+enough for the reconciler's ensure/poll ladder (get/create/update/patch/
+status/list/watch/events). In-cluster config comes from the serviceaccount
+token exactly like client-go's rest.InClusterConfig.
+
+Objects are plain dicts with apiVersion/kind; group→path mapping is
+computed (`/api/v1` for core, `/apis/<group>/<version>` otherwise) and
+kind→plural comes from a small table covering every kind the operator
+touches plus a `<lower>s` fallback.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import ssl
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+PLURALS = {
+    "Model": "models",
+    "Deployment": "deployments",
+    "StatefulSet": "statefulsets",
+    "Service": "services",
+    "PersistentVolumeClaim": "persistentvolumeclaims",
+    "PersistentVolume": "persistentvolumes",
+    "Pod": "pods",
+    "Event": "events",
+    "Lease": "leases",
+    "Namespace": "namespaces",
+    "StorageClass": "storageclasses",
+    "Endpoints": "endpoints",
+}
+
+CLUSTER_SCOPED = {"PersistentVolume", "Namespace", "StorageClass"}
+
+
+class ApiError(Exception):
+    def __init__(self, status: int, message: str = ""):
+        super().__init__(f"apiserver {status}: {message}")
+        self.status = status
+        self.message = message
+
+
+class NotFound(ApiError):
+    pass
+
+
+class Conflict(ApiError):
+    """409 — resourceVersion conflict or AlreadyExists on create."""
+
+
+def _raise_for(status: int, body: str) -> None:
+    msg = body
+    try:
+        msg = json.loads(body).get("message", body)
+    except (json.JSONDecodeError, AttributeError):
+        pass
+    if status == 404:
+        raise NotFound(status, msg)
+    if status == 409:
+        raise Conflict(status, msg)
+    raise ApiError(status, msg)
+
+
+def resource_path(api_version: str, kind: str, namespace: Optional[str],
+                  name: Optional[str] = None,
+                  subresource: Optional[str] = None) -> str:
+    if "/" in api_version:
+        group, version = api_version.split("/", 1)
+        base = f"/apis/{group}/{version}"
+    else:
+        base = f"/api/{api_version}"
+    plural = PLURALS.get(kind, kind.lower() + "s")
+    parts = [base]
+    if namespace and kind not in CLUSTER_SCOPED:
+        parts += ["namespaces", namespace]
+    parts.append(plural)
+    if name:
+        parts.append(name)
+    if subresource:
+        parts.append(subresource)
+    return "/".join(parts)
+
+
+class KubeClient:
+    """Direct apiserver client. Thread-safe (no shared mutable state beyond
+    the opener)."""
+
+    def __init__(self, base_url: str, token: Optional[str] = None,
+                 ca_file: Optional[str] = None, verify: bool = True,
+                 timeout: float = 30.0):
+        self.base_url = base_url.rstrip("/")
+        self.token = token
+        self.timeout = timeout
+        if base_url.startswith("https"):
+            if ca_file and verify:
+                self._ctx: Optional[ssl.SSLContext] = \
+                    ssl.create_default_context(cafile=ca_file)
+            elif not verify:
+                self._ctx = ssl._create_unverified_context()  # tests only
+            else:
+                self._ctx = ssl.create_default_context()
+        else:
+            self._ctx = None
+
+    @classmethod
+    def in_cluster(cls) -> "KubeClient":
+        host = os.environ["KUBERNETES_SERVICE_HOST"]
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        with open(f"{SA_DIR}/token") as f:
+            token = f.read().strip()
+        return cls(f"https://{host}:{port}", token=token,
+                   ca_file=f"{SA_DIR}/ca.crt")
+
+    # --- raw ------------------------------------------------------------
+    def _request(self, method: str, path: str,
+                 body: Optional[Dict[str, Any]] = None,
+                 query: Optional[Dict[str, str]] = None,
+                 timeout: Optional[float] = None) -> Tuple[int, str]:
+        url = self.base_url + path
+        if query:
+            from urllib.parse import urlencode
+            url += "?" + urlencode(query)
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Accept", "application/json")
+        if data is not None:
+            req.add_header("Content-Type", "application/json")
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=timeout or self.timeout,
+                    context=self._ctx) as resp:
+                return resp.status, resp.read().decode()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read().decode()
+
+    def _json(self, method: str, path: str,
+              body: Optional[Dict[str, Any]] = None,
+              query: Optional[Dict[str, str]] = None) -> Dict[str, Any]:
+        status, text = self._request(method, path, body, query)
+        if status >= 400:
+            _raise_for(status, text)
+        return json.loads(text) if text else {}
+
+    # --- typed CRUD -----------------------------------------------------
+    def get(self, api_version: str, kind: str, namespace: Optional[str],
+            name: str) -> Optional[Dict[str, Any]]:
+        try:
+            return self._json(
+                "GET", resource_path(api_version, kind, namespace, name))
+        except NotFound:
+            return None
+
+    def create(self, obj: Dict[str, Any]) -> Dict[str, Any]:
+        ns = (obj.get("metadata") or {}).get("namespace")
+        return self._json(
+            "POST", resource_path(obj["apiVersion"], obj["kind"], ns), obj)
+
+    def update(self, obj: Dict[str, Any]) -> Dict[str, Any]:
+        meta = obj.get("metadata") or {}
+        return self._json(
+            "PUT", resource_path(obj["apiVersion"], obj["kind"],
+                                 meta.get("namespace"), meta["name"]), obj)
+
+    def update_status(self, obj: Dict[str, Any]) -> Dict[str, Any]:
+        meta = obj.get("metadata") or {}
+        return self._json(
+            "PUT", resource_path(obj["apiVersion"], obj["kind"],
+                                 meta.get("namespace"), meta["name"],
+                                 "status"), obj)
+
+    def delete(self, api_version: str, kind: str, namespace: Optional[str],
+               name: str) -> None:
+        try:
+            self._json("DELETE",
+                       resource_path(api_version, kind, namespace, name))
+        except NotFound:
+            pass
+
+    def list(self, api_version: str, kind: str,
+             namespace: Optional[str] = None,
+             label_selector: Optional[str] = None) -> List[Dict[str, Any]]:
+        query = {}
+        if label_selector:
+            query["labelSelector"] = label_selector
+        out = self._json(
+            "GET", resource_path(api_version, kind, namespace), query=query)
+        return out.get("items", [])
+
+    # --- watch ----------------------------------------------------------
+    def watch(self, api_version: str, kind: str,
+              namespace: Optional[str] = None,
+              resource_version: Optional[str] = None,
+              timeout_seconds: int = 300,
+              stop: Optional[threading.Event] = None,
+              ) -> Iterator[Dict[str, Any]]:
+        """Yield watch events ({type, object}) until the server closes the
+        stream or `stop` is set. Caller re-invokes with the last seen
+        resourceVersion (manager.py handles 410 Gone by relisting)."""
+        from urllib.parse import urlencode
+        query = {"watch": "true", "timeoutSeconds": str(timeout_seconds)}
+        if resource_version:
+            query["resourceVersion"] = resource_version
+        url = (self.base_url + resource_path(api_version, kind, namespace)
+               + "?" + urlencode(query))
+        req = urllib.request.Request(url)
+        req.add_header("Accept", "application/json")
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=timeout_seconds + 15,
+                    context=self._ctx) as resp:
+                for line in resp:
+                    if stop is not None and stop.is_set():
+                        return
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        evt = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    if evt.get("type") == "ERROR":
+                        code = (evt.get("object") or {}).get("code", 500)
+                        _raise_for(code, json.dumps(evt.get("object", {})))
+                    yield evt
+        except urllib.error.HTTPError as e:
+            _raise_for(e.code, e.read().decode())
+        except (TimeoutError, ConnectionError, urllib.error.URLError):
+            return  # caller restarts the watch
+
+
+def retry_on_conflict(fn: Callable[[], Any], attempts: int = 5,
+                      backoff: float = 0.05) -> Any:
+    """controller-runtime refetches on 409 inside client.Update retries;
+    same idea for our read-modify-write status updates."""
+    for i in range(attempts):
+        try:
+            return fn()
+        except Conflict:
+            if i == attempts - 1:
+                raise
+            time.sleep(backoff * (2 ** i))

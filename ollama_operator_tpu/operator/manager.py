@@ -1,0 +1,354 @@
+"""Manager process: watch → workqueue → reconcile, plus the runtime-host
+concerns the reference gets from controller-runtime (/root/reference/cmd/
+main.go:54-150): leader election, healthz/readyz, metrics.
+
+Differences from the reference worth knowing:
+- The reference watches ONLY Model CRs (model_controller.go:172-176), so
+  drift in owned Deployments is corrected only on Model events/requeues
+  (SURVEY.md §3.1 note). We additionally watch owned workloads by label
+  and map them back to their Model — drift heals promptly.
+- Leader election uses a coordination.k8s.io/v1 Lease directly (client-go's
+  leaselock under resourcelock, same semantics, id default
+  `300b498d.ayaka.io` kept for drop-in parity with cmd/main.go:108).
+- The workqueue enforces single-reconcile-per-key with dedupe and
+  rate-limited requeue — the controller-runtime concurrency model the
+  whole reconciler assumes.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import logging
+import os
+import socket
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from .client import ApiError, Conflict, KubeClient, NotFound
+from .reconciler import ModelReconciler, Result
+from .recorder import Recorder
+from .types import API_VERSION, KIND
+
+log = logging.getLogger("manager")
+
+LEASE_NAME = "300b498d.ayaka.io"  # cmd/main.go:108's election id
+
+
+class WorkQueue:
+    """Deduping delay queue of (namespace, name) keys."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._heap: list = []          # (ready_at, seq, key)
+        self._pending: Dict[Tuple[str, str], float] = {}
+        self._seq = itertools.count()
+        self._shutdown = False
+
+    def add(self, key: Tuple[str, str], delay: float = 0.0) -> None:
+        ready = time.monotonic() + delay
+        with self._cond:
+            cur = self._pending.get(key)
+            if cur is not None and cur <= ready:
+                return  # already queued sooner
+            self._pending[key] = ready
+            heapq.heappush(self._heap, (ready, next(self._seq), key))
+            self._cond.notify()
+
+    def get(self, timeout: Optional[float] = None
+            ) -> Optional[Tuple[str, str]]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while True:
+                if self._shutdown:
+                    return None
+                now = time.monotonic()
+                while self._heap:
+                    ready, _, key = self._heap[0]
+                    if self._pending.get(key) != ready:
+                        heapq.heappop(self._heap)  # superseded entry
+                        continue
+                    break
+                if self._heap:
+                    ready, _, key = self._heap[0]
+                    if ready <= now:
+                        heapq.heappop(self._heap)
+                        del self._pending[key]
+                        return key
+                    wait = ready - now
+                else:
+                    wait = None
+                if deadline is not None:
+                    remain = deadline - now
+                    if remain <= 0:
+                        return None
+                    wait = remain if wait is None else min(wait, remain)
+                self._cond.wait(wait)
+
+    def shutdown(self) -> None:
+        with self._cond:
+            self._shutdown = True
+            self._cond.notify_all()
+
+
+class LeaderElector:
+    """Lease-based leader election (coordination.k8s.io/v1)."""
+
+    def __init__(self, client: KubeClient, namespace: str,
+                 identity: Optional[str] = None,
+                 lease_name: str = LEASE_NAME,
+                 lease_seconds: int = 15, retry_period: float = 2.0):
+        self.c = client
+        self.ns = namespace
+        self.id = identity or f"{socket.gethostname()}_{os.getpid()}"
+        self.name = lease_name
+        self.lease_seconds = lease_seconds
+        self.retry = retry_period
+        self.is_leader = threading.Event()
+        self._stop = threading.Event()
+
+    def _try_acquire(self) -> bool:
+        import datetime
+        now = datetime.datetime.now(datetime.timezone.utc)
+        now_s = now.strftime("%Y-%m-%dT%H:%M:%S.%f0Z")
+        lease = self.c.get("coordination.k8s.io/v1", "Lease", self.ns,
+                           self.name)
+        if lease is None:
+            lease = {
+                "apiVersion": "coordination.k8s.io/v1", "kind": "Lease",
+                "metadata": {"name": self.name, "namespace": self.ns},
+                "spec": {"holderIdentity": self.id,
+                         "leaseDurationSeconds": self.lease_seconds,
+                         "acquireTime": now_s, "renewTime": now_s,
+                         "leaseTransitions": 0},
+            }
+            try:
+                self.c.create(lease)
+                return True
+            except (Conflict, ApiError):
+                return False
+        spec = lease.get("spec") or {}
+        holder = spec.get("holderIdentity")
+        renew = spec.get("renewTime")
+        expired = True
+        if renew:
+            try:
+                t = datetime.datetime.strptime(
+                    renew[:26].rstrip("Z"), "%Y-%m-%dT%H:%M:%S.%f"
+                ).replace(tzinfo=datetime.timezone.utc)
+                expired = (now - t).total_seconds() > \
+                    spec.get("leaseDurationSeconds", self.lease_seconds)
+            except ValueError:
+                pass
+        if holder == self.id or not holder or expired:
+            if holder != self.id:
+                spec["leaseTransitions"] = \
+                    int(spec.get("leaseTransitions") or 0) + 1
+                spec["acquireTime"] = now_s
+            spec["holderIdentity"] = self.id
+            spec["renewTime"] = now_s
+            spec["leaseDurationSeconds"] = self.lease_seconds
+            lease["spec"] = spec
+            try:
+                self.c.update(lease)
+                return True
+            except (Conflict, ApiError):
+                return False
+        return False
+
+    def run(self) -> None:
+        while not self._stop.is_set():
+            if self._try_acquire():
+                self.is_leader.set()
+                self._stop.wait(self.lease_seconds / 3)
+            else:
+                if self.is_leader.is_set():
+                    log.warning("lost leadership")
+                self.is_leader.clear()
+                self._stop.wait(self.retry)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+class Manager:
+    def __init__(self, client: KubeClient, namespace: Optional[str] = None,
+                 server_image: Optional[str] = None,
+                 leader_elect: bool = False,
+                 health_addr: Tuple[str, int] = ("0.0.0.0", 8081),
+                 resync_seconds: float = 300.0):
+        from .pod import SERVER_BASE_IMAGE
+        self.c = client
+        self.ns = namespace  # None = all namespaces
+        self.queue = WorkQueue()
+        self.recorder = Recorder(client)
+        self.reconciler = ModelReconciler(
+            client, self.recorder,
+            server_image=server_image or os.environ.get(
+                "TPU_SERVER_IMAGE", SERVER_BASE_IMAGE))
+        self.leader_elect = leader_elect
+        self.health_addr = health_addr
+        self.resync = resync_seconds
+        self._stop = threading.Event()
+        self._threads: list = []
+        self._elector: Optional[LeaderElector] = None
+        self.reconcile_total = 0
+        self.reconcile_errors = 0
+
+    # --- watch loops ----------------------------------------------------
+    def _watch_models(self) -> None:
+        rv: Optional[str] = None
+        while not self._stop.is_set():
+            try:
+                if rv is None:
+                    items = self.c.list(API_VERSION, KIND, self.ns)
+                    for m in items:
+                        meta = m.get("metadata") or {}
+                        self.queue.add((meta.get("namespace", "default"),
+                                        meta.get("name", "")))
+                    rv = ""  # watch from now
+                for evt in self.c.watch(API_VERSION, KIND, self.ns,
+                                        resource_version=rv or None,
+                                        stop=self._stop):
+                    obj = evt.get("object") or {}
+                    meta = obj.get("metadata") or {}
+                    rv = meta.get("resourceVersion") or rv
+                    if meta.get("name"):
+                        self.queue.add((meta.get("namespace", "default"),
+                                        meta["name"]))
+            except ApiError as e:
+                if e.status == 410:  # Gone: relist
+                    rv = None
+                else:
+                    log.warning("model watch error: %s", e)
+                    self._stop.wait(2)
+            except Exception as e:  # noqa: BLE001 — watch must survive
+                log.warning("model watch error: %s", e)
+                self._stop.wait(2)
+
+    def _watch_workloads(self) -> None:
+        """Map owned Deployment/StatefulSet events back to their Model so
+        workload drift heals without waiting for resync (closes the
+        reference's watch gap, SURVEY.md §3.1)."""
+        while not self._stop.is_set():
+            try:
+                for evt in self.c.watch("apps/v1", "Deployment", self.ns,
+                                        stop=self._stop):
+                    self._enqueue_owner(evt.get("object") or {})
+            except Exception as e:  # noqa: BLE001
+                log.debug("workload watch error: %s", e)
+                self._stop.wait(5)
+
+    def _enqueue_owner(self, obj: Dict[str, Any]) -> None:
+        meta = obj.get("metadata") or {}
+        for ref in meta.get("ownerReferences") or []:
+            if ref.get("apiVersion") == API_VERSION and \
+                    ref.get("kind") == KIND:
+                self.queue.add((meta.get("namespace", "default"),
+                                ref.get("name", "")))
+
+    def _resync_loop(self) -> None:
+        while not self._stop.wait(self.resync):
+            try:
+                for m in self.c.list(API_VERSION, KIND, self.ns):
+                    meta = m.get("metadata") or {}
+                    self.queue.add((meta.get("namespace", "default"),
+                                    meta.get("name", "")))
+            except Exception as e:  # noqa: BLE001
+                log.warning("resync list failed: %s", e)
+
+    # --- reconcile workers ----------------------------------------------
+    def _worker(self) -> None:
+        backoff: Dict[Tuple[str, str], float] = {}
+        while not self._stop.is_set():
+            key = self.queue.get(timeout=0.5)
+            if key is None:
+                continue
+            if self._elector and not self._elector.is_leader.is_set():
+                self.queue.add(key, delay=2.0)
+                continue
+            self.reconcile_total += 1
+            try:
+                res: Result = self.reconciler.reconcile(*key)
+                backoff.pop(key, None)
+                if res.requeue_after is not None:
+                    self.queue.add(key, delay=res.requeue_after)
+            except NotFound:
+                backoff.pop(key, None)
+            except Exception as e:  # noqa: BLE001 — keep the loop alive
+                self.reconcile_errors += 1
+                delay = min(backoff.get(key, 0.5) * 2, 60.0)
+                backoff[key] = delay
+                log.exception("reconcile %s failed (requeue %.1fs): %s",
+                              key, delay, e)
+                self.queue.add(key, delay=delay)
+
+    # --- health/metrics endpoint ----------------------------------------
+    def _health_server(self) -> ThreadingHTTPServer:
+        mgr = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def do_GET(self):
+                if self.path in ("/healthz", "/readyz"):
+                    body, code = b"ok", 200
+                elif self.path == "/metrics":
+                    lines = [
+                        "# TYPE controller_reconcile_total counter",
+                        f"controller_reconcile_total {mgr.reconcile_total}",
+                        "# TYPE controller_reconcile_errors_total counter",
+                        "controller_reconcile_errors_total "
+                        f"{mgr.reconcile_errors}",
+                        "# TYPE leader_election_master_status gauge",
+                        "leader_election_master_status "
+                        f"{int(not mgr._elector or mgr._elector.is_leader.is_set())}",
+                    ]
+                    body, code = ("\n".join(lines) + "\n").encode(), 200
+                else:
+                    body, code = b"not found", 404
+                self.send_response(code)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        httpd = ThreadingHTTPServer(self.health_addr, Handler)
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        return httpd
+
+    # --- lifecycle ------------------------------------------------------
+    def start(self, workers: int = 2, serve_health: bool = True) -> None:
+        if self.leader_elect:
+            self._elector = LeaderElector(
+                self.c, self.ns or os.environ.get("POD_NAMESPACE", "default"))
+            self._spawn(self._elector.run)
+        self._httpd = self._health_server() if serve_health else None
+        self._spawn(self._watch_models)
+        self._spawn(self._watch_workloads)
+        self._spawn(self._resync_loop)
+        for _ in range(workers):
+            self._spawn(self._worker)
+
+    def _spawn(self, fn: Callable[[], None]) -> None:
+        t = threading.Thread(target=fn, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.queue.shutdown()
+        if self._elector:
+            self._elector.stop()
+        if getattr(self, "_httpd", None):
+            self._httpd.shutdown()
+
+    def wait(self) -> None:
+        try:
+            while not self._stop.is_set():
+                time.sleep(1)
+        except KeyboardInterrupt:
+            self.stop()

@@ -1,0 +1,152 @@
+"""Container factories for model-serving pods.
+
+The reference builds two containers (/root/reference/pkg/model/pod.go):
+`NewOllamaServerContainer` — the `ollama/ollama` image running `serve` with
+the blob PVC mounted, /api/tags probes with FailureThreshold 2500 — and
+`NewOllamaPullerContainer` — `ollama pull <image>` pointed at the store
+Service. Same roles here, but the server image is the TPU runtime
+(JAX/XLA engine + Ollama-compatible HTTP front) and the server container
+additionally carries TPU resources/topology selectors and the
+jax.distributed env for multi-host slices (no reference analog —
+SURVEY.md §7 hard part 3).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from .types import TpuPlacement
+
+# Default runtime image; pinned per-release by kustomize exactly like the
+# reference pins ghcr.io/nekomeowww/ollama-operator
+# (/root/reference/config/manager/kustomization.yaml:5-8).
+SERVER_BASE_IMAGE = "ghcr.io/ollama-operator-tpu/tpu-runtime"
+
+STORE_MOUNT = "/root/.ollama"
+CACHE_SUBPATH = "tpu-cache"  # transcoded-weights cache inside the same PVC
+VOLUME_NAME = "image-storage"
+PORT = 11434
+
+# The reference tolerates hours of model loading before probes fail
+# (pod.go:50,62: FailureThreshold 2500 × 10s). Transcode+shard of a 70B is
+# minutes, not hours, but a cold pull still dominates — keep the window.
+PROBE_FAILURE_THRESHOLD = 2500
+
+
+def _probe(path: str, initial_delay: int = 5) -> Dict[str, Any]:
+    return {
+        "httpGet": {"path": path, "port": PORT},
+        "initialDelaySeconds": initial_delay,
+        "periodSeconds": 10,
+        "failureThreshold": PROBE_FAILURE_THRESHOLD,
+    }
+
+
+def new_server_container(
+    *,
+    read_only: bool,
+    image: str = SERVER_BASE_IMAGE,
+    model: Optional[str] = None,
+    store_only: bool = False,
+    placement: Optional[TpuPlacement] = None,
+    context_length: Optional[int] = None,
+    quantization: Optional[str] = None,
+    tp: int = 0,
+    extra_env: Optional[List[Dict[str, Any]]] = None,
+) -> Dict[str, Any]:
+    """The serving container (pod.go:14-66 equivalent).
+
+    read_only mirrors the reference's store-vs-model mount split: the store
+    StatefulSet mounts the PVC RW (image_store.go:169), model pods RO
+    (model.go:97). The transcoded-weights cache needs RW, so model pods get
+    a separate subPath mount for it (cache writes are content-addressed and
+    concurrent-safe, gguf/store.py).
+    """
+    env = [
+        {"name": "OLLAMA_HOST_BIND", "value": "0.0.0.0"},
+        {"name": "OLLAMA_MODELS", "value": f"{STORE_MOUNT}/models"},
+        {"name": "TPU_WEIGHT_CACHE", "value": f"{STORE_MOUNT}/{CACHE_SUBPATH}"},
+    ]
+    if store_only:
+        env.append({"name": "TPU_STORE_ONLY", "value": "1"})
+    if model:
+        env.append({"name": "TPU_PRELOAD_MODEL", "value": model})
+    if context_length:
+        env.append({"name": "TPU_MAX_SEQ_LEN", "value": str(context_length)})
+    if quantization:
+        env.append({"name": "TPU_ENGINE_QUANT", "value": quantization})
+    if tp:
+        env.append({"name": "TPU_TENSOR_PARALLEL", "value": str(tp)})
+    env.extend(extra_env or [])
+
+    mounts = [{
+        "name": VOLUME_NAME,
+        "mountPath": STORE_MOUNT,
+        "readOnly": not store_only and read_only,
+    }]
+    if read_only and not store_only:
+        # RW cache mount layered over the RO blob mount (same PVC).
+        mounts.append({
+            "name": VOLUME_NAME,
+            "mountPath": f"{STORE_MOUNT}/{CACHE_SUBPATH}",
+            "subPath": CACHE_SUBPATH,
+            "readOnly": False,
+        })
+
+    container: Dict[str, Any] = {
+        "name": "server",
+        "image": image,
+        "args": ["serve"],
+        "env": env,
+        "ports": [{"name": "http", "containerPort": PORT, "protocol": "TCP"}],
+        "volumeMounts": mounts,
+        "readinessProbe": _probe("/api/tags"),
+        "livenessProbe": _probe("/livez"),
+    }
+    if placement is not None:
+        container["resources"] = {
+            "requests": {"google.com/tpu": str(placement.chips_per_host)},
+            "limits": {"google.com/tpu": str(placement.chips_per_host)},
+        }
+    return container
+
+
+def new_puller_container(
+    *,
+    image: str,
+    namespace: str,
+    server_image: str = SERVER_BASE_IMAGE,
+) -> Dict[str, Any]:
+    """Init container pulling through the store (pod.go:68-83 equivalent):
+    OLLAMA_HOST points at the store Service, so the *store* downloads into
+    the shared PVC and every model pod on the cluster reuses the blobs."""
+    from .workload import IMAGE_STORE_SERVICE
+    return {
+        "name": "ollama-image-pull",
+        "image": server_image,
+        "args": ["pull", image],
+        "env": [{
+            "name": "OLLAMA_HOST",
+            "value": f"{IMAGE_STORE_SERVICE}.{namespace}",
+        }],
+    }
+
+
+def multihost_env(headless_service: str, namespace: str, hosts: int,
+                  chips_per_host: int) -> List[Dict[str, Any]]:
+    """jax.distributed env for a multi-host slice StatefulSet.
+
+    Pod ordinal = process index (parsed from the pod hostname by
+    parallel/distributed.py), pod-0's stable DNS name = coordinator.
+    The reference has no analog — its replicas are independent servers
+    (SURVEY.md §2.3); this is what makes one *sharded model* span hosts.
+    """
+    return [
+        {"name": "TPU_DIST_HOSTS", "value": str(hosts)},
+        {"name": "TPU_DIST_CHIPS_PER_HOST", "value": str(chips_per_host)},
+        {"name": "TPU_DIST_COORDINATOR",
+         "value": f"$(TPU_DIST_STS_NAME)-0.{headless_service}"
+                  f".{namespace}.svc:8476"},
+        {"name": "TPU_DIST_POD_NAME",
+         "valueFrom": {"fieldRef": {"fieldPath": "metadata.name"}}},
+    ]

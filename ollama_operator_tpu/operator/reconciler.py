@@ -1,0 +1,265 @@
+"""The Model control loop.
+
+Reproduces the reference reconciler's ensure/poll ladder
+(/root/reference/internal/controller/model_controller.go:61-169, traced in
+SURVEY.md §3.2): condition gating → image-store ensure/poll → workload
+ensure/update/poll → service ensure/poll → status replica mirror →
+Available. Requeue cadence matches: 1s after first Progressing, 5s for
+every not-ready poll.
+
+Deliberate behavior fixes over the reference (SURVEY.md §2.1 gaps):
+- conditions are ADDITIVE (the reference replaces the whole array so only
+  one condition ever exists, model_controller.go:192-199); the current
+  condition is kept at index 0 so the reference's printcolumn
+  `.status.conditions[0].type` still shows the live state;
+- ReplicaFailure is actually set (declared-but-never-produced there);
+- Available is cleared back to Progressing if replicas later fail;
+- spec.image changes are reconciled (workload.update_model_workload).
+
+TPU addition: multi-host placements (tpu.topology with >1 host) get a
+StatefulSet + headless rendezvous Service instead of a Deployment — one
+replica group is ONE jax.distributed world serving a sharded model.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime
+import logging
+from typing import Any, Dict, Optional
+
+from . import workload
+from .client import KubeClient, NotFound
+from .pod import SERVER_BASE_IMAGE
+from .recorder import Recorder
+from .types import (API_VERSION, CONDITION_AVAILABLE, CONDITION_PROGRESSING,
+                    CONDITION_REPLICA_FAILURE, KIND, ModelSpecView)
+
+log = logging.getLogger("reconciler")
+
+
+@dataclasses.dataclass(frozen=True)
+class Result:
+    requeue_after: Optional[float] = None  # seconds; None = done
+
+    @property
+    def done(self) -> bool:
+        return self.requeue_after is None
+
+
+DONE = Result()
+POLL = Result(requeue_after=5.0)     # model_controller.go:101 et al.
+KICKOFF = Result(requeue_after=1.0)  # model_controller.go:78
+
+
+def _now() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%SZ")
+
+
+# --- condition helpers ------------------------------------------------------
+
+def get_condition(model: Dict[str, Any], type_: str) -> Optional[Dict]:
+    for c in (model.get("status") or {}).get("conditions") or []:
+        if c.get("type") == type_:
+            return c
+    return None
+
+
+def is_condition_true(model: Dict[str, Any], type_: str) -> bool:
+    c = get_condition(model, type_)
+    return bool(c and c.get("status") == "True")
+
+
+def set_condition(model: Dict[str, Any], type_: str, status: str,
+                  reason: str, message: str = "") -> bool:
+    """Upsert; move the asserted condition to index 0 (printcolumn compat).
+    Returns True if anything changed."""
+    status_obj = model.setdefault("status", {})
+    conds = status_obj.setdefault("conditions", [])
+    cur = get_condition(model, type_)
+    now = _now()
+    if cur is None:
+        cur = {"type": type_, "status": status, "reason": reason,
+               "message": message, "lastUpdateTime": now,
+               "lastTransitionTime": now}
+        # index 0 is reserved for the live (True) condition so the
+        # reference's printcolumn `.status.conditions[0].type` stays honest
+        if status == "True":
+            conds.insert(0, cur)
+        else:
+            conds.append(cur)
+        return True
+    changed = False
+    if cur.get("status") != status:
+        cur["status"] = status
+        cur["lastTransitionTime"] = now
+        changed = True
+    if cur.get("reason") != reason or cur.get("message") != message:
+        cur["reason"], cur["message"] = reason, message
+        changed = True
+    if changed:
+        cur["lastUpdateTime"] = now
+    if status == "True" and conds and conds[0] is not cur:
+        conds.remove(cur)
+        conds.insert(0, cur)
+        changed = True
+    return changed
+
+
+class ModelReconciler:
+    """One reconciler instance serves all Models (controller-runtime's
+    single-reconcile-per-key concurrency model is enforced by the manager's
+    workqueue, manager.py)."""
+
+    def __init__(self, client: KubeClient, recorder: Recorder,
+                 server_image: str = SERVER_BASE_IMAGE):
+        self.c = client
+        self.rec = recorder
+        self.server_image = server_image
+
+    # --- status writers -------------------------------------------------
+    def _write_status(self, model: Dict[str, Any]) -> Dict[str, Any]:
+        """Status update with refetch-on-conflict (controller-runtime's
+        client.Status().Update + RetryOnConflict idiom)."""
+        from .client import Conflict
+        for _ in range(4):
+            try:
+                return self.c.update_status(model)
+            except Conflict:
+                spec = ModelSpecView(model)
+                fresh = self.c.get(API_VERSION, KIND, spec.namespace,
+                                   spec.name)
+                if fresh is None:
+                    return model
+                model["metadata"]["resourceVersion"] = \
+                    (fresh["metadata"] or {}).get("resourceVersion")
+            except NotFound:
+                return model
+        return model
+
+    def set_progressing(self, model: Dict[str, Any], reason: str,
+                        message: str = "") -> None:
+        c1 = set_condition(model, CONDITION_PROGRESSING, "True", reason,
+                           message)
+        c2 = set_condition(model, CONDITION_AVAILABLE, "False", reason, "")
+        if c1 or c2:
+            self._write_status(model)
+
+    def set_available(self, model: Dict[str, Any]) -> None:
+        c1 = set_condition(model, CONDITION_AVAILABLE, "True",
+                           "ModelAvailable", "model is ready to serve")
+        c2 = set_condition(model, CONDITION_PROGRESSING, "False",
+                           "ModelAvailable", "")
+        c3 = set_condition(model, CONDITION_REPLICA_FAILURE, "False",
+                           "ModelAvailable", "")
+        if c1 or c2 or c3:
+            self._write_status(model)
+            self.rec.event(model, "Normal", "ModelAvailable",
+                           "model is available")
+
+    def set_replica_failure(self, model: Dict[str, Any], message: str) -> None:
+        c1 = set_condition(model, CONDITION_REPLICA_FAILURE, "True",
+                           "WorkloadReplicaFailure", message)
+        c2 = set_condition(model, CONDITION_AVAILABLE, "False",
+                           "WorkloadReplicaFailure", message)
+        if c1 or c2:
+            self._write_status(model)
+            self.rec.event(model, "Warning", "ReplicaFailure", message)
+
+    # --- the ladder -----------------------------------------------------
+    def reconcile(self, namespace: str, name: str) -> Result:
+        model = self.c.get(API_VERSION, KIND, namespace, name)
+        if model is None:
+            return DONE  # deleted; GC cascades via ownerReferences
+        spec = ModelSpecView(model)
+        if not spec.image:
+            self.set_progressing(model, "InvalidSpec", "spec.image is empty")
+            return DONE
+
+        if not is_condition_true(model, CONDITION_AVAILABLE) and \
+                not is_condition_true(model, CONDITION_PROGRESSING):
+            self.set_progressing(model, "ModelCreating",
+                                 f"provisioning {spec.image}")
+            self.rec.event(model, "Normal", "ModelCreating",
+                           f"provisioning {spec.image}")
+            return KICKOFF
+
+        # 1) shared image store (PVC + store server + Service)
+        workload.ensure_image_store(self.c, self.rec, model, spec,
+                                    self.server_image)
+        if not workload.is_statefulset_ready(self.c, namespace,
+                                             workload.IMAGE_STORE_NAME):
+            self.set_progressing(model, "ImageStoreNotReady",
+                                 "waiting for image store")
+            return POLL
+        if not workload.is_service_ready(self.c, namespace,
+                                         workload.IMAGE_STORE_SERVICE):
+            return POLL
+
+        # 2) model workload (Deployment, or StatefulSet for multi-host)
+        placement = spec.tpu_placement()
+        multi_host = placement is not None and placement.multi_host
+        app = workload.model_app_name(name)
+        if multi_host:
+            want = workload.build_model_statefulset(model, self.server_image)
+            workload._ensure(self.c, workload.build_headless_service(model))
+        else:
+            want = workload.build_model_deployment(model, self.server_image)
+        cur = self.c.get("apps/v1", want["kind"], namespace, app)
+        if cur is None:
+            self.c.create(want)
+            self.rec.event(model, "Normal", "WorkloadCreated",
+                           f"created {want['kind']} {app}")
+            self.set_progressing(model, "WorkloadCreated",
+                                 f"waiting for {app}")
+            return POLL
+        if workload.update_model_workload(self.c, self.rec, model, cur, want):
+            return POLL
+
+        # replica failure surfacing (the reference never does this)
+        failure = workload.deployment_replica_failure(cur)
+        if failure:
+            self.set_replica_failure(model, failure)
+            return POLL
+
+        want_ready = placement.hosts if multi_host else spec.replicas
+        if multi_host:
+            ready = workload.is_statefulset_ready(self.c, namespace, app,
+                                                  want=want_ready)
+        else:
+            ready = workload.is_deployment_ready(self.c, namespace, app,
+                                                 want=want_ready)
+        if not ready:
+            self.set_progressing(model, "WorkloadNotReady",
+                                 f"waiting for {app} readiness")
+            return POLL
+
+        # 3) serving Service
+        svc = workload.build_model_service(model)
+        if self.c.get("v1", "Service", namespace, app) is None:
+            self.c.create(svc)
+            self.rec.event(model, "Normal", "ServiceCreated",
+                           f"created service {app}")
+            return POLL
+        if not workload.is_service_ready(self.c, namespace, app):
+            return POLL
+
+        # 4) status replica mirror (model_controller.go:240-273)
+        cur = self.c.get("apps/v1", want["kind"], namespace, app) or cur
+        st = cur.get("status") or {}
+        mirrored = {
+            "replicas": int(st.get("replicas") or 0),
+            "readyReplicas": int(st.get("readyReplicas") or 0),
+            "availableReplicas": int(st.get("availableReplicas") or 0),
+            "unavailableReplicas": int(st.get("unavailableReplicas") or 0),
+        }
+        status_obj = model.setdefault("status", {})
+        if any(status_obj.get(k) != v for k, v in mirrored.items()):
+            status_obj.update(mirrored)
+            self._write_status(model)
+            return POLL
+
+        # 5) available — and *stay* correct if replicas later fail
+        self.set_available(model)
+        return DONE

@@ -1,0 +1,93 @@
+"""Kubernetes Event recorder bound to one object.
+
+Equivalent of the reference's `WrappedRecorder[T]`
+(/root/reference/pkg/model/recorder.go:8-32) minus client-go's event
+aggregation: we dedupe by (reason, message) within a short window and bump
+`count` instead, which is what the aggregator does for the single-object
+case. Events are the reference's primary user-facing progress channel
+(SURVEY.md §5) — kept that way here.
+"""
+
+from __future__ import annotations
+
+import datetime
+import hashlib
+import threading
+import time
+from typing import Any, Dict
+
+from .client import ApiError, KubeClient
+
+
+def _now() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%SZ")
+
+
+class Recorder:
+    def __init__(self, client: KubeClient, component: str = "model-controller"):
+        self._c = client
+        self._component = component
+        self._lock = threading.Lock()
+        self._recent: Dict[str, float] = {}  # event name -> last emit time
+
+    def event(self, obj: Dict[str, Any], type_: str, reason: str,
+              message: str) -> None:
+        meta = obj.get("metadata") or {}
+        ns = meta.get("namespace", "default")
+        key = hashlib.sha1(
+            f"{ns}/{meta.get('name')}/{reason}/{message}".encode()
+        ).hexdigest()[:16]
+        name = f"{meta.get('name')}.{key}"
+        now = time.time()
+        with self._lock:
+            recent = self._recent.get(name, 0)
+            self._recent[name] = now
+            if len(self._recent) > 1024:  # bound the dedupe table
+                cutoff = now - 600
+                self._recent = {k: v for k, v in self._recent.items()
+                                if v > cutoff}
+        ev = {
+            "apiVersion": "v1",
+            "kind": "Event",
+            "metadata": {"name": name, "namespace": ns},
+            "involvedObject": {
+                "apiVersion": obj.get("apiVersion"),
+                "kind": obj.get("kind"),
+                "name": meta.get("name"),
+                "namespace": ns,
+                "uid": meta.get("uid"),
+            },
+            "type": type_,
+            "reason": reason,
+            "message": message,
+            "source": {"component": self._component},
+            "firstTimestamp": _now(),
+            "lastTimestamp": _now(),
+            "count": 1,
+        }
+        try:
+            if now - recent < 600:
+                cur = self._c.get("v1", "Event", ns, name)
+                if cur is not None:
+                    cur["count"] = int(cur.get("count", 1)) + 1
+                    cur["lastTimestamp"] = _now()
+                    self._c.update(cur)
+                    return
+            self._c.create(ev)
+        except ApiError:
+            pass  # events are best-effort, like client-go's recorder
+
+    def eventf(self, obj: Dict[str, Any], type_: str, reason: str,
+               fmt: str, *args: Any) -> None:
+        self.event(obj, type_, reason, fmt % args if args else fmt)
+
+
+class NullRecorder(Recorder):
+    """For unit tests of pure builders."""
+
+    def __init__(self):  # noqa: D107 — no client
+        self._events = []
+
+    def event(self, obj, type_, reason, message):  # noqa: D102
+        self._events.append((type_, reason, message))

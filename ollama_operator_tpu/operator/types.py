@@ -1,0 +1,179 @@
+"""Model CRD schema: group/kind constants, spec accessors, condition types.
+
+The reference declares these as Go structs (`ModelSpec`/`ModelStatus`,
+/root/reference/api/v1/model_types.go:35-139) compiled into a CRD by
+controller-gen. Here the schema lives in `config/crd/` (hand-maintained
+OpenAPI, built into dist/install.yaml by hack/build_installer.py) and this
+module gives typed *views* over the plain-dict objects the stdlib client
+returns — no codegen, no deepcopy layer (dicts are copied by the client
+boundary instead of zz_generated.deepcopy.go).
+
+Reference-compatible fields: replicas, image, imagePullPolicy,
+imagePullSecrets, storageClassName, persistentVolumeClaim,
+persistentVolume.accessMode (model_types.go:41-76). TPU extensions (all
+optional, absent = reference behavior on CPU): runtime, tpu.topology,
+tpu.accelerator, contextLength, sharding.{tp,sp,dp}, quantization.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+GROUP = "ollama.ayaka.io"
+VERSION = "v1"
+API_VERSION = f"{GROUP}/{VERSION}"
+KIND = "Model"
+PLURAL = "models"
+
+# Condition types — the same vocabulary as model_types.go:84-97, but unlike
+# the reference (which replaces the whole array, model_controller.go:192-199)
+# our conditions are additive and ReplicaFailure is actually produced
+# (SURVEY.md §2.1 "spec-surface vs. behavior gaps").
+CONDITION_UNKNOWN = "Unknown"
+CONDITION_AVAILABLE = "Available"
+CONDITION_PROGRESSING = "Progressing"
+CONDITION_REPLICA_FAILURE = "ReplicaFailure"
+
+# TPU topology catalog: name -> (hosts, chips_per_host, gke topology label).
+# v5e host = 4 chips (v5litepod); one entry per ladder config in BASELINE.md.
+TPU_TOPOLOGIES: Dict[str, tuple] = {
+    "v5e-1": (1, 1, "1x1"),
+    "v5e-4": (1, 4, "2x2"),
+    "v5e-8": (2, 4, "2x4"),
+    "v5e-16": (4, 4, "4x4"),
+    "v5e-32": (8, 4, "4x8"),
+    "v5e-64": (16, 4, "8x8"),
+    "v5e-128": (32, 4, "8x16"),
+    "v5e-256": (64, 4, "16x16"),
+}
+
+# GKE nodeSelector values per topology family (cloud.google.com/gke-tpu-*).
+GKE_ACCELERATOR = {"v5e": "tpu-v5-lite-podslice"}
+
+
+@dataclasses.dataclass(frozen=True)
+class TpuPlacement:
+    """Resolved hardware placement for one Model."""
+
+    topology: str
+    hosts: int
+    chips_per_host: int
+    accelerator: str
+    gke_topology: str = "1x1"
+
+    @property
+    def chips(self) -> int:
+        return self.hosts * self.chips_per_host
+
+    @property
+    def multi_host(self) -> bool:
+        return self.hosts > 1
+
+
+class ModelSpecView:
+    """Read-only accessor over a Model object dict with defaulting."""
+
+    def __init__(self, model: Dict[str, Any]):
+        self._m = model or {}
+        self._spec = self._m.get("spec") or {}
+
+    # --- metadata -------------------------------------------------------
+    @property
+    def name(self) -> str:
+        return (self._m.get("metadata") or {}).get("name", "")
+
+    @property
+    def namespace(self) -> str:
+        return (self._m.get("metadata") or {}).get("namespace", "default")
+
+    @property
+    def uid(self) -> Optional[str]:
+        return (self._m.get("metadata") or {}).get("uid")
+
+    # --- reference-compatible spec fields -------------------------------
+    @property
+    def image(self) -> str:
+        return self._spec.get("image", "")
+
+    @property
+    def replicas(self) -> int:
+        r = self._spec.get("replicas")
+        return 1 if r is None else int(r)
+
+    @property
+    def image_pull_policy(self) -> Optional[str]:
+        return self._spec.get("imagePullPolicy")
+
+    @property
+    def image_pull_secrets(self) -> List[Dict[str, Any]]:
+        return self._spec.get("imagePullSecrets") or []
+
+    @property
+    def storage_class_name(self) -> Optional[str]:
+        return self._spec.get("storageClassName")
+
+    @property
+    def persistent_volume_claim(self) -> Optional[Dict[str, Any]]:
+        return self._spec.get("persistentVolumeClaim")
+
+    @property
+    def pv_access_mode(self) -> Optional[str]:
+        pv = self._spec.get("persistentVolume") or {}
+        return pv.get("accessMode")
+
+    # --- TPU extensions -------------------------------------------------
+    @property
+    def runtime(self) -> str:
+        """`tpu` (default) or `cpu` (kind e2e / dev clusters)."""
+        return self._spec.get("runtime") or "tpu"
+
+    @property
+    def context_length(self) -> Optional[int]:
+        v = self._spec.get("contextLength")
+        return None if v is None else int(v)
+
+    @property
+    def quantization(self) -> Optional[str]:
+        return self._spec.get("quantization")
+
+    @property
+    def sharding(self) -> Dict[str, int]:
+        """Explicit mesh override {tp,sp,dp}; empty = auto from topology."""
+        return {k: int(v) for k, v in (self._spec.get("sharding") or {}).items()}
+
+    @property
+    def server_image(self) -> Optional[str]:
+        """Override for the runtime container image (spec.serverImage)."""
+        return self._spec.get("serverImage")
+
+    def tpu_placement(self) -> Optional[TpuPlacement]:
+        if self.runtime != "tpu":
+            return None
+        tpu = self._spec.get("tpu") or {}
+        topology = tpu.get("topology") or "v5e-1"
+        if topology not in TPU_TOPOLOGIES:
+            raise ValueError(
+                f"unknown tpu.topology {topology!r}; "
+                f"known: {sorted(TPU_TOPOLOGIES)}")
+        hosts, cph, gke = TPU_TOPOLOGIES[topology]
+        family = topology.split("-")[0]
+        accelerator = tpu.get("accelerator") or GKE_ACCELERATOR.get(
+            family, GKE_ACCELERATOR["v5e"])
+        return TpuPlacement(topology=topology, hosts=hosts,
+                            chips_per_host=cph, accelerator=accelerator,
+                            gke_topology=gke)
+
+
+def owner_reference(model: Dict[str, Any], controller: bool = True
+                    ) -> Dict[str, Any]:
+    """OwnerReference back to the Model CR (model.go:63-69 equivalent)."""
+    meta = model.get("metadata") or {}
+    return {
+        "apiVersion": API_VERSION,
+        "kind": KIND,
+        "name": meta.get("name"),
+        "uid": meta.get("uid"),
+        "controller": controller,
+        "blockOwnerDeletion": True,
+    }

@@ -1,0 +1,371 @@
+"""Workload assembly: pure functions that build & poll K8s objects.
+
+The reference's L1 (/root/reference/pkg/model/image_store.go, model.go):
+a namespace-singleton image-store trio (PVC + StatefulSet running the
+store server + ClusterIP Service) shared by all models, and a per-model
+Deployment (puller init container + server container, PVC mounted RO) +
+Service. Same shape here, with TPU additions:
+
+- single-host placements stay a Deployment (replica fan-out = dp, exactly
+  the reference's only parallelism, SURVEY.md §2.3);
+- multi-host slices become a StatefulSet + headless Service per replica
+  group, because jax.distributed needs stable per-process identities and a
+  coordinator address — pods of one group form ONE sharded model server.
+
+Deliberate fixes over the reference (SURVEY.md §2.1 gap list): spec.image
+changes ARE reconciled (update_deployment syncs the puller arg + preload
+env, not just replicas); imagePullPolicy/imagePullSecrets are honored;
+per-model storage knobs apply to the store PVC as before.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, List, Optional
+
+from . import pod as podf
+from .client import Conflict, KubeClient
+from .recorder import Recorder
+from .types import ModelSpecView, TpuPlacement, owner_reference
+
+IMAGE_STORE_NAME = "ollama-models-store"
+IMAGE_STORE_PVC = "ollama-models-store-pvc"
+IMAGE_STORE_SERVICE = IMAGE_STORE_NAME
+DEFAULT_STORE_SIZE = "100Gi"  # image_store.go:77 hardcodes the same
+
+
+def model_app_name(name: str) -> str:
+    """model.go:20-22 — the `ollama-model-<name>` convention."""
+    return f"ollama-model-{name}"
+
+
+def headless_service_name(name: str) -> str:
+    return f"{model_app_name(name)}-hosts"
+
+
+# ---------------------------------------------------------------------------
+# image store (namespace singleton): PVC + StatefulSet + Service
+# ---------------------------------------------------------------------------
+
+def build_store_pvc(namespace: str, spec: ModelSpecView) -> Dict[str, Any]:
+    pvc_spec: Dict[str, Any] = {
+        # RWX so every model pod on every node mounts the same blobs;
+        # overridable via spec.persistentVolume.accessMode
+        # (image_store.go:62-65).
+        "accessModes": [spec.pv_access_mode or "ReadWriteMany"],
+        "resources": {"requests": {"storage": DEFAULT_STORE_SIZE}},
+    }
+    if spec.storage_class_name:
+        pvc_spec["storageClassName"] = spec.storage_class_name
+    if spec.persistent_volume_claim:
+        # spec.persistentVolumeClaim points at a pre-provisioned claim; the
+        # reference forwards its claimName via the volume instead of
+        # creating — handled in volumes() below.
+        pass
+    return {
+        "apiVersion": "v1",
+        "kind": "PersistentVolumeClaim",
+        "metadata": {"name": IMAGE_STORE_PVC, "namespace": namespace},
+        "spec": pvc_spec,
+    }
+
+
+def _store_volume(spec: ModelSpecView) -> Dict[str, Any]:
+    claim = IMAGE_STORE_PVC
+    if spec.persistent_volume_claim:
+        claim = spec.persistent_volume_claim.get("claimName", claim)
+    return {
+        "name": podf.VOLUME_NAME,
+        "persistentVolumeClaim": {"claimName": claim},
+    }
+
+
+def build_store_statefulset(namespace: str, spec: ModelSpecView,
+                            server_image: str) -> Dict[str, Any]:
+    labels = {"app": IMAGE_STORE_NAME}
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "StatefulSet",
+        "metadata": {"name": IMAGE_STORE_NAME, "namespace": namespace,
+                     "labels": dict(labels)},
+        "spec": {
+            "serviceName": IMAGE_STORE_SERVICE,
+            "replicas": 1,
+            "selector": {"matchLabels": dict(labels)},
+            "template": {
+                "metadata": {"labels": dict(labels)},
+                "spec": {
+                    "restartPolicy": "Always",
+                    "containers": [podf.new_server_container(
+                        read_only=False, image=server_image,
+                        store_only=True)],
+                    "volumes": [_store_volume(spec)],
+                },
+            },
+        },
+    }
+
+
+def build_store_service(namespace: str) -> Dict[str, Any]:
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {"name": IMAGE_STORE_SERVICE, "namespace": namespace},
+        "spec": {
+            "type": "ClusterIP",
+            "selector": {"app": IMAGE_STORE_NAME},
+            "ports": [{"name": "http", "port": podf.PORT,
+                       "targetPort": podf.PORT, "protocol": "TCP"}],
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# per-model workload
+# ---------------------------------------------------------------------------
+
+def _pod_template(model: Dict[str, Any], spec: ModelSpecView,
+                  server_image: str,
+                  placement: Optional[TpuPlacement],
+                  multihost_sts: Optional[str] = None) -> Dict[str, Any]:
+    name = spec.name
+    labels = {"app": model_app_name(name)}
+    server = podf.new_server_container(
+        read_only=True, image=server_image, model=spec.image,
+        placement=placement, context_length=spec.context_length,
+        quantization=spec.quantization,
+        tp=spec.sharding.get("tp", 0),
+        extra_env=(
+            [{"name": "TPU_DIST_STS_NAME", "value": multihost_sts}]
+            + podf.multihost_env(headless_service_name(name),
+                                 spec.namespace, placement.hosts,
+                                 placement.chips_per_host)
+            if multihost_sts and placement else None),
+    )
+    if spec.image_pull_policy:  # honored, unlike the reference (§2.1 gaps)
+        server["imagePullPolicy"] = spec.image_pull_policy
+    puller = podf.new_puller_container(
+        image=spec.image, namespace=spec.namespace, server_image=server_image)
+    if spec.image_pull_policy:
+        puller["imagePullPolicy"] = spec.image_pull_policy
+
+    pod_spec: Dict[str, Any] = {
+        "initContainers": [puller],
+        "containers": [server],
+        "volumes": [_store_volume(spec)],
+    }
+    if spec.image_pull_secrets:
+        pod_spec["imagePullSecrets"] = copy.deepcopy(spec.image_pull_secrets)
+    if placement is not None:
+        pod_spec["nodeSelector"] = {
+            "cloud.google.com/gke-tpu-accelerator": placement.accelerator,
+            "cloud.google.com/gke-tpu-topology": placement.gke_topology,
+        }
+        pod_spec["tolerations"] = [{
+            "key": "google.com/tpu", "operator": "Exists",
+            "effect": "NoSchedule"}]
+    return {"metadata": {"labels": labels}, "spec": pod_spec}
+
+
+def build_model_deployment(model: Dict[str, Any],
+                           server_image: str = podf.SERVER_BASE_IMAGE
+                           ) -> Dict[str, Any]:
+    """Single-host serving: Deployment with spec.replicas fan-out
+    (model.go:39-115 equivalent — each replica an independent server, the
+    Service load-balances)."""
+    spec = ModelSpecView(model)
+    placement = spec.tpu_placement()
+    app = model_app_name(spec.name)
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {
+            "name": app, "namespace": spec.namespace,
+            "labels": {"app": app},
+            "ownerReferences": [owner_reference(model)],
+        },
+        "spec": {
+            "replicas": spec.replicas,
+            "selector": {"matchLabels": {"app": app}},
+            "template": _pod_template(model, spec, server_image, placement),
+        },
+    }
+
+
+def build_model_statefulset(model: Dict[str, Any],
+                            server_image: str = podf.SERVER_BASE_IMAGE
+                            ) -> Dict[str, Any]:
+    """Multi-host slice: ONE replica group = `hosts` pods with stable ids;
+    `spec.replicas` scales whole groups via `hosts × replicas` pods where
+    each group of `hosts` ordinals is one jax.distributed world. Round 1
+    supports replicas=1 (one sharded server); the scheduler-level fan-out
+    of groups is a documented TODO in the reconciler."""
+    spec = ModelSpecView(model)
+    placement = spec.tpu_placement()
+    assert placement is not None and placement.multi_host
+    app = model_app_name(spec.name)
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "StatefulSet",
+        "metadata": {
+            "name": app, "namespace": spec.namespace,
+            "labels": {"app": app},
+            "ownerReferences": [owner_reference(model)],
+        },
+        "spec": {
+            "serviceName": headless_service_name(spec.name),
+            "replicas": placement.hosts,
+            "podManagementPolicy": "Parallel",  # all hosts must start to
+            # rendezvous — ordered startup would deadlock jax.distributed
+            "selector": {"matchLabels": {"app": app}},
+            "template": _pod_template(model, spec, server_image, placement,
+                                      multihost_sts=app),
+        },
+    }
+
+
+def build_headless_service(model: Dict[str, Any]) -> Dict[str, Any]:
+    """Stable DNS for multi-host rendezvous (`<sts>-0.<svc>.<ns>.svc`)."""
+    spec = ModelSpecView(model)
+    app = model_app_name(spec.name)
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {
+            "name": headless_service_name(spec.name),
+            "namespace": spec.namespace,
+            "ownerReferences": [owner_reference(model)],
+        },
+        "spec": {
+            "clusterIP": "None",
+            "publishNotReadyAddresses": True,  # coordinator DNS must
+            # resolve before readiness (rendezvous happens pre-Ready)
+            "selector": {"app": app},
+            "ports": [{"name": "dist", "port": 8476, "protocol": "TCP"}],
+        },
+    }
+
+
+def build_model_service(model: Dict[str, Any]) -> Dict[str, Any]:
+    """ClusterIP LB over serving pods (model.go:203-256 equivalent). For
+    multi-host, only host-0 carries the `serving` role label so requests
+    land on the process that owns the HTTP front."""
+    spec = ModelSpecView(model)
+    app = model_app_name(spec.name)
+    placement = spec.tpu_placement()
+    selector = {"app": app}
+    if placement is not None and placement.multi_host:
+        selector["apps.kubernetes.io/pod-index"] = "0"
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {
+            "name": app, "namespace": spec.namespace,
+            # the reference owner-refs the Service to the Deployment
+            # (model.go:225-231); we owner-ref the Model so a CR delete
+            # cascades everything in one sweep — same end state.
+            "ownerReferences": [owner_reference(model)],
+        },
+        "spec": {
+            "type": "ClusterIP",
+            "selector": selector,
+            "ports": [{"name": "http", "port": podf.PORT,
+                       "targetPort": podf.PORT, "protocol": "TCP"}],
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# ensure / poll — the reconciler's verbs (create-if-absent + readiness)
+# ---------------------------------------------------------------------------
+
+def _ensure(c: KubeClient, obj: Dict[str, Any]) -> Dict[str, Any]:
+    meta = obj["metadata"]
+    cur = c.get(obj["apiVersion"], obj["kind"], meta.get("namespace"),
+                meta["name"])
+    if cur is not None:
+        return cur
+    try:
+        return c.create(obj)
+    except Conflict:
+        return c.get(obj["apiVersion"], obj["kind"], meta.get("namespace"),
+                     meta["name"]) or obj
+
+
+def ensure_image_store(c: KubeClient, rec: Recorder, model: Dict[str, Any],
+                       spec: ModelSpecView, server_image: str) -> None:
+    """PVC → StatefulSet → Service (image_store.go:41,126,239 ladder)."""
+    ns = spec.namespace
+    if c.get("v1", "PersistentVolumeClaim", ns, IMAGE_STORE_PVC) is None \
+            and not spec.persistent_volume_claim:
+        c.create(build_store_pvc(ns, spec))
+        rec.event(model, "Normal", "ImageStorePVCCreated",
+                  f"created {IMAGE_STORE_PVC} in {ns}")
+    if c.get("apps/v1", "StatefulSet", ns, IMAGE_STORE_NAME) is None:
+        _ensure(c, build_store_statefulset(ns, spec, server_image))
+        rec.event(model, "Normal", "ImageStoreStatefulSetCreated",
+                  f"created {IMAGE_STORE_NAME} in {ns}")
+    if c.get("v1", "Service", ns, IMAGE_STORE_SERVICE) is None:
+        _ensure(c, build_store_service(ns))
+        rec.event(model, "Normal", "ImageStoreServiceCreated",
+                  f"created {IMAGE_STORE_SERVICE} in {ns}")
+
+
+def is_statefulset_ready(c: KubeClient, namespace: str, name: str,
+                         want: int = 1) -> bool:
+    sts = c.get("apps/v1", "StatefulSet", namespace, name)
+    if sts is None:
+        return False
+    return int((sts.get("status") or {}).get("readyReplicas") or 0) >= want
+
+
+def is_service_ready(c: KubeClient, namespace: str, name: str) -> bool:
+    svc = c.get("v1", "Service", namespace, name)
+    if svc is None:
+        return False
+    s = svc.get("spec") or {}
+    return bool(s.get("clusterIP"))  # "None" (headless) is also ready
+
+
+def is_deployment_ready(c: KubeClient, namespace: str, name: str,
+                        want: int) -> bool:
+    dep = c.get("apps/v1", "Deployment", namespace, name)
+    if dep is None:
+        return False
+    return int((dep.get("status") or {}).get("readyReplicas") or 0) >= want
+
+
+def deployment_replica_failure(dep: Dict[str, Any]) -> Optional[str]:
+    """Surface apps/v1 ReplicaFailure (the reference declares the condition
+    type but never sets it — model_types.go:96, SURVEY.md §2.1)."""
+    for cond in (dep.get("status") or {}).get("conditions") or []:
+        if cond.get("type") == "ReplicaFailure" and \
+                cond.get("status") == "True":
+            return cond.get("message") or cond.get("reason") or "ReplicaFailure"
+    return None
+
+
+def update_model_workload(c: KubeClient, rec: Recorder, model: Dict[str, Any],
+                          cur: Dict[str, Any], want: Dict[str, Any]) -> bool:
+    """Sync mutable fields of the existing workload: replicas AND the
+    serving image/model (the reference only syncs replicas,
+    model.go:149-186 — image drift is a known gap we close). Returns True
+    if an update was written (caller requeues)."""
+    changed = False
+    cs, ws = cur.get("spec") or {}, want["spec"]
+    if cs.get("replicas") != ws.get("replicas"):
+        cs["replicas"] = ws["replicas"]
+        changed = True
+    cur_tpl = (cs.get("template") or {}).get("spec") or {}
+    want_tpl = ws["template"]["spec"]
+    for field in ("initContainers", "containers", "nodeSelector",
+                  "tolerations", "imagePullSecrets"):
+        if field in want_tpl and cur_tpl.get(field) != want_tpl[field]:
+            cur_tpl[field] = want_tpl[field]
+            changed = True
+    if changed:
+        cur["spec"] = cs
+        c.update(cur)
+        rec.event(model, "Normal", "WorkloadUpdated",
+                  f"synced {cur['kind']} {cur['metadata']['name']}")
+    return changed

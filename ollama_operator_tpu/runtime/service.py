@@ -39,6 +39,36 @@ class GenerateResult:
     context: List[int] = dataclasses.field(default_factory=list)
 
 
+class _OwnedStream:
+    """Iterator that owns its scheduler slot: with eager submission, the
+    request exists before the caller ever iterates, so a drop before the
+    first next() (e.g. client socket died while writing response headers)
+    must still cancel the request — a generator's finally can't cover that
+    window because an unstarted generator never entered its try block."""
+
+    def __init__(self, it, req):
+        self._it, self._req = it, req
+        self._started = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        self._started = True
+        return next(self._it)
+
+    def close(self):
+        if not self._started:
+            self._req.cancel()  # idempotent event-set
+        self._it.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — never raise from GC
+            pass
+
+
 def merge_options(defaults: Dict, request: Optional[Dict]
                   ) -> Tuple[SlotOptions, int, List[str]]:
     """(modelfile params, request options) → (SlotOptions, num_predict, stop)."""
@@ -169,7 +199,13 @@ class LoadedModel:
                         raw: bool = False,
                         cancel_event: Optional[threading.Event] = None
                         ) -> Iterator[Tuple[str, Optional[GenerateResult]]]:
-        """Yields (text_piece, None)… then ("", final GenerateResult)."""
+        """Yields (text_piece, None)… then ("", final GenerateResult).
+
+        Option parsing, tokenization, and scheduler admission run eagerly
+        at call time — NOT on first next() — so SchedulerBusy/Broken and
+        bad-request errors surface before the HTTP layer commits a 200 +
+        chunked headers (a mid-stream error chunk can't carry the 503 that
+        load balancers key backpressure on)."""
         so, num_predict, stops = merge_options(self.default_params, options)
         t0 = time.monotonic()
         ids = list(context or [])
@@ -183,6 +219,11 @@ class LoadedModel:
                 f"within the {self.engine.max_seq}-token context")
         req = self.scheduler.submit(ids, so, max_new,
                                     eog_ids=frozenset(self.tokenizer.eog_ids))
+        return _OwnedStream(
+            self._stream(req, stops, ids, max_new, t0, cancel_event), req)
+
+    def _stream(self, req, stops, ids, max_new, t0, cancel_event
+                ) -> Iterator[Tuple[str, Optional[GenerateResult]]]:
         sd = StreamDecoder(self.tokenizer)
         sm = StopMatcher(stops)
         result = GenerateResult(prompt_tokens=len(ids))

@@ -52,6 +52,10 @@ def main(argv=None):
     mesh = None
     if not args.store_only:
         import jax
+        # honor an explicit JAX_PLATFORMS (e.g. cpu for kind/e2e pods) even
+        # where a sitecustomize force-sets the platform list programmatically
+        if os.environ.get("JAX_PLATFORMS"):
+            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
         if args.profile_port:
             jax.profiler.start_server(args.profile_port)
         devices = jax.devices()

@@ -142,7 +142,13 @@ class ModelManager:
         name = ModelName.parse(ref)
         with self._lock:
             if self.loaded is not None and self.loaded.name == name.short:
-                return self.loaded
+                if not self.loaded.scheduler.broken:
+                    return self.loaded
+                # broken scheduler (decode-loop gave up after repeated
+                # failures): tear down and fall through to a fresh load so
+                # a transient TPU/XLA fault doesn't wedge the pod forever
+                self.loaded.unload()
+                self.loaded = None
             layers = self.store.model_layers(name)  # raises if absent
             gguf_path = layers.get(MT_MODEL)
             if not gguf_path:
@@ -420,9 +426,12 @@ class Handler(BaseHTTPRequestHandler):
             elif path == "/metrics":
                 self._send_text(METRICS.render(),
                                 ctype="text/plain; version=0.0.4")
-            elif path in ("/healthz", "/livez"):
+            elif path == "/healthz":
                 self._send_text("ok")
-            elif path == "/readyz":
+            elif path in ("/readyz", "/livez"):
+                # livez fails too: a broken scheduler self-heals on the next
+                # load(), but an idle pod would otherwise stay wedged with
+                # no probe ever restarting it
                 lm = self.manager.loaded
                 if lm is not None and lm.scheduler.broken:
                     self._send_text("engine failed", status=503)

@@ -198,6 +198,14 @@ class ModelStore:
         return {"digest": digest, "size": size}
 
 
+# An in-flight writer may legitimately go quiet for a full network read
+# timeout (RegistryClient timeout=60s) without touching its .partial, so the
+# abandoned-partial threshold must exceed that with wide margin — claiming or
+# deleting a LIVE partial splits one inode between two writers and corrupts
+# the blob.
+PARTIAL_STALE_S = 600.0
+
+
 class RegistryClient:
     def __init__(self, store: ModelStore, timeout: float = 60.0):
         self.store = store
@@ -237,13 +245,14 @@ class RegistryClient:
     def _cleanup_stale_partials(path: str):
         """Remove abandoned .partial files once the blob is installed.
 
-        Only stale ones (>60s mtime): a fresh partial may belong to a live
-        writer in another process, whose in-flight fd must not be yanked."""
+        Only stale ones (mtime older than PARTIAL_STALE_S): a fresh partial
+        may belong to a live writer in another process, whose in-flight fd
+        must not be yanked."""
         import glob as _glob
         now = time.time()
         for cand in _glob.glob(path + ".partial*"):
             try:
-                if now - os.path.getmtime(cand) >= 60:
+                if now - os.path.getmtime(cand) >= PARTIAL_STALE_S:
                     os.remove(cand)
             except OSError:
                 continue
@@ -268,7 +277,7 @@ class RegistryClient:
         now = time.time()
         for cand in _glob.glob(path + ".partial*"):
             try:
-                if now - os.path.getmtime(cand) < 60:
+                if now - os.path.getmtime(cand) < PARTIAL_STALE_S:
                     continue
                 os.replace(cand, partial)
                 have = os.path.getsize(partial)

@@ -1,0 +1,296 @@
+"""In-process fake Kubernetes apiserver — the envtest stand-in.
+
+The reference's integration tier boots a real apiserver+etcd via envtest
+(/root/reference/internal/controller/suite_test.go:52-84): real object
+CRUD, no kubelet, so nothing ever becomes Ready on its own. Same model
+here: `FakeKube` implements the KubeClient interface over a dict store
+with resourceVersion bumping, status-subresource separation, label
+selectors and watch streams; tests flip workload readiness by writing
+status, exactly the role kubelet plays in a real cluster.
+
+`serve_http(fake)` additionally exposes it over real HTTP speaking the
+apiserver's REST/watch wire format so the stdlib KubeClient itself is
+under test (URL construction, error mapping, watch framing).
+"""
+
+from __future__ import annotations
+
+import copy
+import itertools
+import json
+import queue
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from ollama_operator_tpu.operator.client import (PLURALS, ApiError, Conflict,
+                                                 NotFound)
+
+
+def _key(api_version: str, kind: str, namespace: Optional[str], name: str
+         ) -> Tuple[str, str, str, str]:
+    return (api_version, kind, namespace or "", name)
+
+
+class FakeKube:
+    """Duck-typed KubeClient: same methods, in-memory store."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._store: Dict[Tuple, Dict[str, Any]] = {}
+        self._rv = itertools.count(1)
+        self._watchers: List[Tuple[Tuple[str, str, str], queue.Queue]] = []
+        self.create_log: List[Tuple[str, str]] = []  # (kind, name) order
+
+    # --- internals ------------------------------------------------------
+    def _bump(self, obj: Dict[str, Any]) -> None:
+        obj.setdefault("metadata", {})["resourceVersion"] = str(next(self._rv))
+
+    def _notify(self, type_: str, obj: Dict[str, Any]) -> None:
+        meta = obj.get("metadata") or {}
+        topic = (obj.get("apiVersion", ""), obj.get("kind", ""),
+                 meta.get("namespace", ""))
+        for (t, q) in list(self._watchers):
+            if t[0] == topic[0] and t[1] == topic[1] and \
+                    (not t[2] or t[2] == topic[2]):
+                q.put({"type": type_, "object": copy.deepcopy(obj)})
+
+    # --- KubeClient interface -------------------------------------------
+    def get(self, api_version, kind, namespace, name):
+        with self._lock:
+            obj = self._store.get(_key(api_version, kind, namespace, name))
+            return copy.deepcopy(obj) if obj else None
+
+    def create(self, obj):
+        obj = copy.deepcopy(obj)
+        meta = obj.setdefault("metadata", {})
+        k = _key(obj["apiVersion"], obj["kind"], meta.get("namespace"),
+                 meta["name"])
+        with self._lock:
+            if k in self._store:
+                raise Conflict(409, f"{obj['kind']} {meta['name']} exists")
+            meta.setdefault("uid", f"uid-{next(self._rv)}")
+            self._bump(obj)
+            obj.setdefault("status", {})
+            self._store[k] = copy.deepcopy(obj)
+            self.create_log.append((obj["kind"], meta["name"]))
+            self._notify("ADDED", obj)
+            return copy.deepcopy(obj)
+
+    def update(self, obj):
+        obj = copy.deepcopy(obj)
+        meta = obj.get("metadata") or {}
+        k = _key(obj["apiVersion"], obj["kind"], meta.get("namespace"),
+                 meta["name"])
+        with self._lock:
+            cur = self._store.get(k)
+            if cur is None:
+                raise NotFound(404, f"{obj['kind']} {meta['name']}")
+            sent = meta.get("resourceVersion")
+            if sent and sent != cur["metadata"].get("resourceVersion"):
+                raise Conflict(409, "resourceVersion mismatch")
+            obj["status"] = cur.get("status", {})  # spec update only
+            self._bump(obj)
+            self._store[k] = copy.deepcopy(obj)
+            self._notify("MODIFIED", obj)
+            return copy.deepcopy(obj)
+
+    def update_status(self, obj):
+        obj = copy.deepcopy(obj)
+        meta = obj.get("metadata") or {}
+        k = _key(obj["apiVersion"], obj["kind"], meta.get("namespace"),
+                 meta["name"])
+        with self._lock:
+            cur = self._store.get(k)
+            if cur is None:
+                raise NotFound(404, f"{obj['kind']} {meta['name']}")
+            sent = meta.get("resourceVersion")
+            if sent and sent != cur["metadata"].get("resourceVersion"):
+                raise Conflict(409, "resourceVersion mismatch")
+            cur["status"] = copy.deepcopy(obj.get("status", {}))
+            self._bump(cur)
+            self._notify("MODIFIED", cur)
+            return copy.deepcopy(cur)
+
+    def set_status(self, api_version, kind, namespace, name, status):
+        """Test hook: play kubelet (mark workloads ready, etc.)."""
+        with self._lock:
+            cur = self._store[_key(api_version, kind, namespace, name)]
+            cur.setdefault("status", {}).update(status)
+            self._bump(cur)
+            self._notify("MODIFIED", cur)
+
+    def delete(self, api_version, kind, namespace, name):
+        with self._lock:
+            obj = self._store.pop(_key(api_version, kind, namespace, name),
+                                  None)
+            if obj is not None:
+                self._notify("DELETED", obj)
+
+    def list(self, api_version, kind, namespace=None, label_selector=None):
+        sel = {}
+        if label_selector:
+            for part in label_selector.split(","):
+                k, _, v = part.partition("=")
+                sel[k] = v
+        with self._lock:
+            out = []
+            for (av, kd, ns, _), obj in self._store.items():
+                if av != api_version or kd != kind:
+                    continue
+                if namespace and ns != namespace:
+                    continue
+                labels = (obj.get("metadata") or {}).get("labels") or {}
+                if any(labels.get(k) != v for k, v in sel.items()):
+                    continue
+                out.append(copy.deepcopy(obj))
+            return out
+
+    def watch(self, api_version, kind, namespace=None, resource_version=None,
+              timeout_seconds=300, stop=None):
+        q: queue.Queue = queue.Queue()
+        topic = (api_version, kind, namespace or "")
+        with self._lock:
+            self._watchers.append((topic, q))
+        try:
+            while stop is None or not stop.is_set():
+                try:
+                    yield q.get(timeout=0.2)
+                except queue.Empty:
+                    if stop is None:
+                        return
+        finally:
+            with self._lock:
+                try:
+                    self._watchers.remove((topic, q))
+                except ValueError:
+                    pass
+
+
+# ---------------------------------------------------------------------------
+# HTTP facade: the apiserver wire format over the fake store
+# ---------------------------------------------------------------------------
+
+def _parse_path(path: str):
+    """/api/v1/... or /apis/<group>/<version>/... →
+    (api_version, plural, namespace, name, subresource)"""
+    parts = [p for p in path.split("/") if p]
+    if not parts:
+        raise NotFound(404, path)
+    if parts[0] == "api":
+        api_version, rest = parts[1], parts[2:]
+    elif parts[0] == "apis":
+        api_version, rest = f"{parts[1]}/{parts[2]}", parts[3:]
+    else:
+        raise NotFound(404, path)
+    namespace = None
+    if rest and rest[0] == "namespaces" and len(rest) > 1:
+        namespace, rest = rest[1], rest[2:]
+    plural = rest[0] if rest else ""
+    name = rest[1] if len(rest) > 1 else None
+    sub = rest[2] if len(rest) > 2 else None
+    return api_version, plural, namespace, name, sub
+
+
+_KIND_BY_PLURAL = {v: k for k, v in PLURALS.items()}
+
+
+def serve_http(fake: FakeKube) -> ThreadingHTTPServer:
+    """Expose the fake over HTTP on an ephemeral localhost port."""
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def _send(self, code: int, body: Dict[str, Any]) -> None:
+            data = json.dumps(body).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def _error(self, e: ApiError) -> None:
+            self._send(e.status, {"kind": "Status", "code": e.status,
+                                  "message": e.message})
+
+        def _body(self) -> Dict[str, Any]:
+            n = int(self.headers.get("Content-Length") or 0)
+            return json.loads(self.rfile.read(n)) if n else {}
+
+        def do_GET(self):
+            url = urlparse(self.path)
+            q = parse_qs(url.query)
+            try:
+                api_version, plural, ns, name, _ = _parse_path(url.path)
+                kind = _KIND_BY_PLURAL.get(plural, plural.rstrip("s").title())
+                if q.get("watch") == ["true"]:
+                    return self._watch(api_version, kind, ns)
+                if name:
+                    obj = fake.get(api_version, kind, ns, name)
+                    if obj is None:
+                        raise NotFound(404, f"{kind} {name}")
+                    return self._send(200, obj)
+                sel = (q.get("labelSelector") or [None])[0]
+                items = fake.list(api_version, kind, ns, sel)
+                return self._send(200, {"kind": f"{kind}List",
+                                        "items": items})
+            except ApiError as e:
+                return self._error(e)
+
+        def _watch(self, api_version, kind, ns):
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            stop = threading.Event()
+            try:
+                for evt in fake.watch(api_version, kind, ns, stop=stop):
+                    data = (json.dumps(evt) + "\n").encode()
+                    self.wfile.write(f"{len(data):x}\r\n".encode() + data
+                                     + b"\r\n")
+                    self.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError):
+                pass
+            finally:
+                stop.set()
+                try:
+                    self.wfile.write(b"0\r\n\r\n")
+                except OSError:
+                    pass
+
+        def do_POST(self):
+            try:
+                obj = self._body()
+                return self._send(201, fake.create(obj))
+            except ApiError as e:
+                return self._error(e)
+
+        def do_PUT(self):
+            url = urlparse(self.path)
+            try:
+                _, _, _, _, sub = _parse_path(url.path)
+                obj = self._body()
+                if sub == "status":
+                    return self._send(200, fake.update_status(obj))
+                return self._send(200, fake.update(obj))
+            except ApiError as e:
+                return self._error(e)
+
+        def do_DELETE(self):
+            url = urlparse(self.path)
+            try:
+                api_version, plural, ns, name, _ = _parse_path(url.path)
+                kind = _KIND_BY_PLURAL.get(plural, plural.rstrip("s").title())
+                fake.delete(api_version, kind, ns, name)
+                return self._send(200, {"kind": "Status", "status": "Success"})
+            except ApiError as e:
+                return self._error(e)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    return httpd

@@ -1,0 +1,240 @@
+"""Manager + HTTP-client tests.
+
+Tier (b) of SURVEY.md §4's pyramid: the stdlib KubeClient speaks to the
+fake apiserver over REAL HTTP (wire format, error mapping, chunked watch),
+and the Manager's watch→queue→reconcile loop drives a Model to Available
+end-to-end, with a kubelet-player thread flipping readiness — the closest
+analog to envtest's "real API, fake kubelet" the reference relies on.
+"""
+
+import threading
+import time
+
+import pytest
+
+from ollama_operator_tpu.operator import workload
+from ollama_operator_tpu.operator.client import Conflict, KubeClient, NotFound
+from ollama_operator_tpu.operator.manager import (LeaderElector, Manager,
+                                                  WorkQueue)
+from ollama_operator_tpu.operator.reconciler import is_condition_true
+from ollama_operator_tpu.operator.types import API_VERSION, KIND
+
+from fake_kube import FakeKube, serve_http
+
+
+@pytest.fixture()
+def fake():
+    return FakeKube()
+
+
+@pytest.fixture()
+def http_client(fake):
+    httpd = serve_http(fake)
+    addr = f"http://127.0.0.1:{httpd.server_address[1]}"
+    yield KubeClient(addr, timeout=5)
+    httpd.shutdown()
+
+
+def model_obj(name="phi", **spec):
+    spec.setdefault("image", "phi")
+    spec.setdefault("runtime", "cpu")
+    return {"apiVersion": API_VERSION, "kind": KIND,
+            "metadata": {"name": name, "namespace": "default"},
+            "spec": spec}
+
+
+class TestHttpClient:
+    def test_crud_roundtrip(self, http_client):
+        created = http_client.create(model_obj())
+        assert created["metadata"]["resourceVersion"]
+        got = http_client.get(API_VERSION, KIND, "default", "phi")
+        assert got["spec"]["image"] == "phi"
+        got["spec"]["replicas"] = 2
+        updated = http_client.update(got)
+        assert updated["spec"]["replicas"] == 2
+        assert http_client.get(API_VERSION, KIND, "default", "ghost") is None
+        http_client.delete(API_VERSION, KIND, "default", "phi")
+        assert http_client.get(API_VERSION, KIND, "default", "phi") is None
+
+    def test_status_subresource_is_separate(self, http_client):
+        http_client.create(model_obj())
+        m = http_client.get(API_VERSION, KIND, "default", "phi")
+        m["status"] = {"replicas": 3}
+        http_client.update_status(m)
+        # spec update must not clobber status, and vice versa
+        m = http_client.get(API_VERSION, KIND, "default", "phi")
+        m["spec"]["replicas"] = 5
+        http_client.update(m)
+        m = http_client.get(API_VERSION, KIND, "default", "phi")
+        assert m["status"]["replicas"] == 3 and m["spec"]["replicas"] == 5
+
+    def test_conflict_and_duplicate_create(self, http_client):
+        http_client.create(model_obj())
+        with pytest.raises(Conflict):
+            http_client.create(model_obj())
+        stale = http_client.get(API_VERSION, KIND, "default", "phi")
+        fresh = http_client.get(API_VERSION, KIND, "default", "phi")
+        fresh["spec"]["replicas"] = 2
+        http_client.update(fresh)
+        stale["spec"]["replicas"] = 9
+        with pytest.raises(Conflict):
+            http_client.update(stale)
+
+    def test_list_with_label_selector(self, http_client, fake):
+        a = model_obj("a")
+        a["metadata"]["labels"] = {"tier": "prod"}
+        http_client.create(a)
+        http_client.create(model_obj("b"))
+        items = http_client.list(API_VERSION, KIND, "default",
+                                 label_selector="tier=prod")
+        assert [i["metadata"]["name"] for i in items] == ["a"]
+
+    def test_watch_streams_events(self, http_client, fake):
+        stop = threading.Event()
+        seen = []
+
+        def consume():
+            for evt in http_client.watch(API_VERSION, KIND, "default",
+                                         stop=stop):
+                seen.append((evt["type"],
+                             evt["object"]["metadata"]["name"]))
+                if len(seen) >= 2:
+                    return
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        time.sleep(0.3)  # watcher registers
+        fake.create(model_obj("w1"))
+        fake.create(model_obj("w2"))
+        t.join(timeout=5)
+        stop.set()
+        assert ("ADDED", "w1") in seen and ("ADDED", "w2") in seen
+
+
+class TestWorkQueue:
+    def test_dedupe(self):
+        q = WorkQueue()
+        q.add(("ns", "a"))
+        q.add(("ns", "a"))
+        q.add(("ns", "b"))
+        assert q.get(timeout=1) == ("ns", "a")
+        assert q.get(timeout=1) == ("ns", "b")
+        assert q.get(timeout=0.1) is None
+
+    def test_delay_ordering_and_supersede(self):
+        q = WorkQueue()
+        q.add(("ns", "slow"), delay=5.0)
+        q.add(("ns", "fast"), delay=0.0)
+        assert q.get(timeout=1) == ("ns", "fast")
+        q.add(("ns", "slow"), delay=0.0)  # sooner wins
+        assert q.get(timeout=1) == ("ns", "slow")
+        assert q.get(timeout=0.1) is None
+
+
+class TestLeaderElection:
+    def test_single_holder(self, fake):
+        a = LeaderElector(fake, "default", identity="a", lease_seconds=2)
+        b = LeaderElector(fake, "default", identity="b", lease_seconds=2)
+        assert a._try_acquire() is True
+        assert b._try_acquire() is False
+        lease = fake.get("coordination.k8s.io/v1", "Lease", "default",
+                         a.name)
+        assert lease["spec"]["holderIdentity"] == "a"
+
+    def test_takeover_after_expiry(self, fake):
+        a = LeaderElector(fake, "default", identity="a", lease_seconds=1)
+        assert a._try_acquire()
+        lease = fake.get("coordination.k8s.io/v1", "Lease", "default",
+                         a.name)
+        lease["spec"]["renewTime"] = "2000-01-01T00:00:00.0000000Z"
+        fake.update(lease)
+        b = LeaderElector(fake, "default", identity="b", lease_seconds=1)
+        assert b._try_acquire() is True
+
+
+def play_kubelet(fake, stop):
+    """Flip readiness of everything the reconciler creates."""
+    while not stop.is_set():
+        for sts in fake.list("apps/v1", "StatefulSet", "default"):
+            n = sts["spec"].get("replicas", 1)
+            if (sts.get("status") or {}).get("readyReplicas") != n:
+                fake.set_status("apps/v1", "StatefulSet", "default",
+                                sts["metadata"]["name"],
+                                {"readyReplicas": n, "replicas": n})
+        for dep in fake.list("apps/v1", "Deployment", "default"):
+            n = dep["spec"].get("replicas", 1)
+            if (dep.get("status") or {}).get("readyReplicas") != n:
+                fake.set_status("apps/v1", "Deployment", "default",
+                                dep["metadata"]["name"],
+                                {"replicas": n, "readyReplicas": n,
+                                 "availableReplicas": n})
+        for svc in fake.list("v1", "Service", "default"):
+            if not svc["spec"].get("clusterIP"):
+                svc["spec"]["clusterIP"] = "10.0.0.9"
+                try:
+                    fake.update(svc)
+                except Conflict:
+                    pass
+        stop.wait(0.05)
+
+
+class TestManagerEndToEnd:
+    def test_watch_to_available(self, fake):
+        mgr = Manager(fake, namespace="default", server_image="img:t")
+        # shrink poll delays so the test runs fast
+        import ollama_operator_tpu.operator.reconciler as r
+        stop = threading.Event()
+        kubelet = threading.Thread(target=play_kubelet, args=(fake, stop),
+                                   daemon=True)
+        kubelet.start()
+        mgr.start(workers=2, serve_health=False)
+        try:
+            fake.create(model_obj("e2e"))
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                m = fake.get(API_VERSION, KIND, "default", "e2e")
+                if m and is_condition_true(m, "Available"):
+                    break
+                time.sleep(0.1)
+            else:
+                raise AssertionError("model never became Available")
+            dep = fake.get("apps/v1", "Deployment", "default",
+                           "ollama-model-e2e")
+            assert dep is not None
+            assert fake.get("v1", "Service", "default",
+                            "ollama-model-e2e") is not None
+        finally:
+            stop.set()
+            mgr.stop()
+
+    def test_workload_drift_heals(self, fake):
+        mgr = Manager(fake, namespace="default", server_image="img:t")
+        stop = threading.Event()
+        kubelet = threading.Thread(target=play_kubelet, args=(fake, stop),
+                                   daemon=True)
+        kubelet.start()
+        mgr.start(workers=2, serve_health=False)
+        try:
+            fake.create(model_obj("drift"))
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                m = fake.get(API_VERSION, KIND, "default", "drift")
+                if m and is_condition_true(m, "Available"):
+                    break
+                time.sleep(0.1)
+            # sabotage the deployment: wrong replica count
+            dep = fake.get("apps/v1", "Deployment", "default",
+                           "ollama-model-drift")
+            dep["spec"]["replicas"] = 7
+            fake.update(dep)  # owned-workload watch maps back to the Model
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                dep = fake.get("apps/v1", "Deployment", "default",
+                               "ollama-model-drift")
+                if dep["spec"]["replicas"] == 1:
+                    break
+                time.sleep(0.1)
+            assert dep["spec"]["replicas"] == 1
+        finally:
+            stop.set()
+            mgr.stop()

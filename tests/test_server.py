@@ -6,6 +6,7 @@ reference's probes and clients depend on (/api/tags probe at pod.go:44,
 generate/chat/OpenAI from the getting-started docs)."""
 
 import json
+import urllib.error
 import urllib.request
 
 import jax
@@ -251,3 +252,52 @@ def test_create_inherits_base_layers(stack):
 
 def test_readyz(stack):
     assert get(stack["base"], "/readyz") == "ok"
+
+
+def test_streaming_backpressure_is_http_503(stack):
+    """Scheduler admission must happen BEFORE chunked headers: a full queue
+    on a stream=true request has to surface as a real HTTP 503 (what load
+    balancers key on), not an error chunk inside a 200 stream."""
+    from ollama_operator_tpu.runtime.scheduler import SchedulerBusy
+
+    lm = stack["manager"].require_loaded(_model_name(stack))
+    orig = lm.scheduler.submit
+
+    def full_submit(*a, **k):
+        raise SchedulerBusy("queue full")
+
+    lm.scheduler.submit = full_submit
+    try:
+        req = urllib.request.Request(
+            stack["base"] + "/api/generate",
+            data=json.dumps({"model": _model_name(stack), "prompt": "x",
+                             "stream": True}).encode(),
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        assert ei.value.code == 503
+    finally:
+        lm.scheduler.submit = orig
+
+
+def test_broken_scheduler_reloads_on_next_request(stack):
+    """A wedged decode loop must not zombie the pod: load() tears down a
+    broken scheduler and brings up a fresh engine for the same model."""
+    mgr = stack["manager"]
+    name = _model_name(stack)
+    lm = mgr.require_loaded(name)
+    lm.scheduler.broken = True
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        get(stack["base"], "/readyz")
+    assert ei.value.code == 503
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        get(stack["base"], "/livez")
+    assert ei.value.code == 503
+    lm2 = mgr.require_loaded(name)
+    assert lm2 is not lm
+    assert not lm2.scheduler.broken
+    # and it actually serves
+    r = post(stack["base"], "/api/generate",
+             {"model": name, "prompt": "t1", "stream": False,
+              "options": {"num_predict": 2}})
+    assert r["done"] is True
