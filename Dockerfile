@@ -1,0 +1,39 @@
+# TPU runtime image: engine + server + operator in one image (the
+# reference ships two images — the Go manager and the delegated
+# ollama/ollama runtime; here one image plays both roles, selected by the
+# entrypoint arg vocabulary: serve / pull / operator).
+#
+# Build args let CI pin the JAX stack; the TPU libtpu wheel comes from the
+# jax[tpu] extra and is only resolvable on TPU VMs / with the libtpu
+# release index, hence the BACKEND switch (cpu image for kind e2e).
+ARG PYTHON_VERSION=3.12
+FROM python:${PYTHON_VERSION}-slim AS base
+
+ARG BACKEND=tpu
+RUN apt-get update && apt-get install -y --no-install-recommends \
+      g++ make && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /app
+COPY ollama_operator_tpu/ ollama_operator_tpu/
+COPY native/ native/
+COPY hack/entrypoint.sh /usr/local/bin/entrypoint.sh
+RUN chmod +x /usr/local/bin/entrypoint.sh
+
+RUN pip install --no-cache-dir numpy ml_dtypes einops && \
+    if [ "$BACKEND" = "tpu" ]; then \
+      pip install --no-cache-dir "jax[tpu]" \
+        -f https://storage.googleapis.com/jax-releases/libtpu_releases.html; \
+    else \
+      pip install --no-cache-dir jax; \
+    fi
+
+# native dequant kernels (ctypes-loaded from native/build/; gguf/native.py
+# also builds lazily at runtime if this layer is skipped)
+RUN mkdir -p native/build && \
+    g++ -O3 -march=native -shared -fPIC -o \
+      native/build/libtpuop_dequant.so native/dequant.cpp || true
+
+ENV PYTHONUNBUFFERED=1
+EXPOSE 11434
+ENTRYPOINT ["/usr/local/bin/entrypoint.sh"]
+CMD ["serve"]

@@ -1,0 +1,66 @@
+# Build/test/deploy targets mirroring the reference's kubebuilder Makefile
+# surface (/root/reference/Makefile) where each has a meaning here.
+IMG ?= ghcr.io/ollama-operator-tpu/tpu-runtime:latest
+BACKEND ?= tpu
+PY ?= python
+
+.PHONY: all test test-fast lint native bench docker-build docker-build-cpu \
+        build-installer install uninstall deploy undeploy kind-e2e clean
+
+all: test build-installer
+
+##@ Development
+
+test:  ## full suite on the 8-device CPU mesh (conftest.py sets XLA flags)
+	$(PY) -m pytest tests/ -q
+
+test-fast:  ## operator + serving tiers only (no engine compiles)
+	$(PY) -m pytest tests/test_operator_*.py tests/test_registry.py \
+	  tests/test_modelfile.py tests/test_template.py -q
+
+lint:
+	$(PY) -m pyflakes ollama_operator_tpu tests 2>/dev/null || \
+	  $(PY) -m py_compile $$(git ls-files '*.py')
+
+native:  ## build the C++ dequant library
+	mkdir -p native/build
+	g++ -O3 -march=native -shared -fPIC \
+	  -o native/build/libtpuop_dequant.so native/dequant.cpp
+
+bench:  ## headline decode-throughput benchmark (one JSON line)
+	$(PY) bench.py
+
+##@ Build
+
+docker-build:
+	docker build --build-arg BACKEND=$(BACKEND) -t $(IMG) .
+
+docker-build-cpu:
+	docker build --build-arg BACKEND=cpu -t $(IMG) .
+
+build-installer:  ## dist/install.yaml (single-file apply, ref Makefile:117)
+	$(PY) hack/build_installer.py --image $(IMG)
+
+##@ Deployment
+
+install:  ## CRDs only
+	kubectl apply -f config/crd/ollama.ayaka.io_models.yaml
+
+uninstall:
+	kubectl delete -f config/crd/ollama.ayaka.io_models.yaml
+
+deploy: build-installer
+	kubectl apply -f dist/install.yaml
+
+undeploy:
+	kubectl delete -f dist/install.yaml
+
+kind-e2e:  ## CPU-backend image into a kind cluster (ref test-e2e analog)
+	kind create cluster --config hack/kind-config.yaml || true
+	$(MAKE) docker-build-cpu
+	kind load docker-image $(IMG)
+	$(MAKE) deploy
+	kubectl apply -f config/samples/ollama_v1_model.yaml
+
+clean:
+	rm -rf native/build dist/install.yaml

@@ -1,0 +1,119 @@
+#!/usr/bin/env python3
+"""Assemble dist/install.yaml from config/ (the reference builds its 588-line
+installer with `kustomize build config/default`, Makefile:117-121; this is
+the same single-file-apply UX without the kustomize dependency).
+
+Applies the reference's kustomize-equivalent transforms: `ollama-operator-`
+name prefix on operator-owned objects, namespace rewrite system →
+ollama-operator-system, RBAC subject/roleRef re-pointing, and optional image
+pin via --image.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PREFIX = "ollama-operator-"
+NAMESPACE = "ollama-operator-system"
+
+SOURCES = [
+    "config/crd/ollama.ayaka.io_models.yaml",
+    "config/rbac/role.yaml",
+    "config/manager/manager.yaml",
+]
+
+# objects whose metadata.name gets the prefix (CRD name must stay the
+# group-qualified plural; sample CRs are not part of the installer)
+PREFIXED_KINDS = {"ClusterRole", "Role", "ServiceAccount", "Deployment",
+                  "Namespace"}
+
+
+def split_docs(text: str):
+    for doc in re.split(r"^---\s*$", text, flags=re.M):
+        if doc.strip():
+            yield doc
+
+
+def get_field(doc: str, path: str):
+    """Tiny YAML field reader for the few top-level fields we transform."""
+    m = re.search(rf"^{path}:\s*(\S+)\s*$", doc, flags=re.M)
+    return m.group(1) if m else None
+
+
+def transform(doc: str, image: str | None) -> str:
+    kind = get_field(doc, "kind")
+    # namespace rewrite first (applies to metadata + rolebinding subjects)
+    doc = doc.replace("namespace: system", f"namespace: {NAMESPACE}")
+    if kind in PREFIXED_KINDS:
+        m = re.search(r"^metadata:\n((?:  .*\n)*)", doc, flags=re.M)
+        if m:
+            block = m.group(0)
+            new_block = re.sub(r"^(  name: )(?!ollama-operator-)(\S+)",
+                               rf"\g<1>{PREFIX}\g<2>", block, count=1,
+                               flags=re.M)
+            doc = doc.replace(block, new_block, 1)
+    if kind == "Namespace":
+        doc = re.sub(r"^(  name: )\S+$", rf"\g<1>{NAMESPACE}", doc,
+                     count=1, flags=re.M)
+    if image and kind == "Deployment":
+        doc = re.sub(r"image: \S+", f"image: {image}", doc, count=1)
+    return doc
+
+
+def build(image: str | None = None) -> str:
+    docs = []
+    for src in SOURCES:
+        with open(os.path.join(ROOT, src)) as f:
+            for doc in split_docs(f.read()):
+                docs.append(transform(doc.strip("\n"), image))
+    # bindings are generated, not stored: they must reference the prefixed
+    # names and final namespace
+    docs.append(f"""apiVersion: rbac.authorization.k8s.io/v1
+kind: ClusterRoleBinding
+metadata:
+  name: {PREFIX}manager-rolebinding
+roleRef:
+  apiGroup: rbac.authorization.k8s.io
+  kind: ClusterRole
+  name: {PREFIX}manager-role
+subjects:
+  - kind: ServiceAccount
+    name: {PREFIX}controller-manager
+    namespace: {NAMESPACE}""")
+    docs.append(f"""apiVersion: rbac.authorization.k8s.io/v1
+kind: RoleBinding
+metadata:
+  name: {PREFIX}leader-election-rolebinding
+  namespace: {NAMESPACE}
+roleRef:
+  apiGroup: rbac.authorization.k8s.io
+  kind: Role
+  name: {PREFIX}leader-election-role
+subjects:
+  - kind: ServiceAccount
+    name: {PREFIX}controller-manager
+    namespace: {NAMESPACE}""")
+    return "---\n".join(d.rstrip() + "\n" for d in docs)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--image", default=None, help="pin the manager image")
+    p.add_argument("-o", "--output",
+                   default=os.path.join(ROOT, "dist", "install.yaml"))
+    args = p.parse_args()
+    out = build(args.image)
+    os.makedirs(os.path.dirname(args.output), exist_ok=True)
+    with open(args.output, "w") as f:
+        f.write(out)
+    print(f"wrote {args.output} ({len(out.splitlines())} lines)",
+          file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

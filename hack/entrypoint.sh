@@ -1,0 +1,22 @@
+#!/bin/sh
+# Runtime-image entrypoint: dispatch the container arg vocabulary the
+# operator's pod factories emit (serve / pull <image> / operator …) onto
+# the Python modules — the same role the `ollama` binary's subcommands play
+# in the reference's containers (/root/reference/pkg/model/pod.go:18,71).
+set -e
+cmd="$1"
+[ $# -gt 0 ] && shift
+case "$cmd" in
+  serve|"")
+    exec python -m ollama_operator_tpu.server "$@"
+    ;;
+  pull)
+    exec python -m ollama_operator_tpu.server.pull "$@"
+    ;;
+  operator)
+    exec python -m ollama_operator_tpu.operator "$@"
+    ;;
+  *)
+    exec "$cmd" "$@"
+    ;;
+esac

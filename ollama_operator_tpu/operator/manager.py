@@ -38,18 +38,28 @@ LEASE_NAME = "300b498d.ayaka.io"  # cmd/main.go:108's election id
 
 
 class WorkQueue:
-    """Deduping delay queue of (namespace, name) keys."""
+    """Deduping delay queue of (namespace, name) keys with
+    single-processor-per-key semantics (controller-runtime's workqueue
+    processing/dirty sets): a key handed to a worker is *processing*; an
+    add() arriving meanwhile marks it *dirty* instead of re-queueing, and
+    done() re-queues dirty keys — so two workers can never reconcile one
+    Model concurrently."""
 
     def __init__(self):
         self._cond = threading.Condition()
         self._heap: list = []          # (ready_at, seq, key)
         self._pending: Dict[Tuple[str, str], float] = {}
+        self._processing: set = set()
+        self._dirty: set = set()
         self._seq = itertools.count()
         self._shutdown = False
 
     def add(self, key: Tuple[str, str], delay: float = 0.0) -> None:
         ready = time.monotonic() + delay
         with self._cond:
+            if key in self._processing:
+                self._dirty.add(key)
+                return
             cur = self._pending.get(key)
             if cur is not None and cur <= ready:
                 return  # already queued sooner
@@ -59,6 +69,8 @@ class WorkQueue:
 
     def get(self, timeout: Optional[float] = None
             ) -> Optional[Tuple[str, str]]:
+        """Pop a ready key and mark it processing; callers MUST call
+        done(key) when finished with it."""
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._cond:
             while True:
@@ -76,6 +88,7 @@ class WorkQueue:
                     if ready <= now:
                         heapq.heappop(self._heap)
                         del self._pending[key]
+                        self._processing.add(key)
                         return key
                     wait = ready - now
                 else:
@@ -86,6 +99,18 @@ class WorkQueue:
                         return None
                     wait = remain if wait is None else min(wait, remain)
                 self._cond.wait(wait)
+
+    def done(self, key: Tuple[str, str], requeue_after: float = -1.0) -> None:
+        """Finish processing. requeue_after >= 0 schedules the next run;
+        a dirty mark (event during processing) requeues immediately."""
+        with self._cond:
+            self._processing.discard(key)
+            dirty = key in self._dirty
+            self._dirty.discard(key)
+        if dirty:
+            self.add(key)
+        elif requeue_after >= 0:
+            self.add(key, delay=requeue_after)
 
     def shutdown(self) -> None:
         with self._cond:
@@ -228,17 +253,18 @@ class Manager:
                 log.warning("model watch error: %s", e)
                 self._stop.wait(2)
 
-    def _watch_workloads(self) -> None:
-        """Map owned Deployment/StatefulSet events back to their Model so
-        workload drift heals without waiting for resync (closes the
-        reference's watch gap, SURVEY.md §3.1)."""
+    def _watch_workloads(self, kind: str) -> None:
+        """Map owned workload events back to their Model so drift heals
+        without waiting for resync (closes the reference's watch gap,
+        SURVEY.md §3.1). One loop per kind: Deployments (single-host) and
+        StatefulSets (multi-host slices + the image store)."""
         while not self._stop.is_set():
             try:
-                for evt in self.c.watch("apps/v1", "Deployment", self.ns,
+                for evt in self.c.watch("apps/v1", kind, self.ns,
                                         stop=self._stop):
                     self._enqueue_owner(evt.get("object") or {})
             except Exception as e:  # noqa: BLE001
-                log.debug("workload watch error: %s", e)
+                log.debug("%s watch error: %s", kind, e)
                 self._stop.wait(5)
 
     def _enqueue_owner(self, obj: Dict[str, Any]) -> None:
@@ -267,23 +293,25 @@ class Manager:
             if key is None:
                 continue
             if self._elector and not self._elector.is_leader.is_set():
-                self.queue.add(key, delay=2.0)
+                self.queue.done(key, requeue_after=2.0)
                 continue
             self.reconcile_total += 1
             try:
                 res: Result = self.reconciler.reconcile(*key)
                 backoff.pop(key, None)
-                if res.requeue_after is not None:
-                    self.queue.add(key, delay=res.requeue_after)
+                self.queue.done(key, requeue_after=(
+                    res.requeue_after if res.requeue_after is not None
+                    else -1.0))
             except NotFound:
                 backoff.pop(key, None)
+                self.queue.done(key)
             except Exception as e:  # noqa: BLE001 — keep the loop alive
                 self.reconcile_errors += 1
                 delay = min(backoff.get(key, 0.5) * 2, 60.0)
                 backoff[key] = delay
                 log.exception("reconcile %s failed (requeue %.1fs): %s",
                               key, delay, e)
-                self.queue.add(key, delay=delay)
+                self.queue.done(key, requeue_after=delay)
 
     # --- health/metrics endpoint ----------------------------------------
     def _health_server(self) -> ThreadingHTTPServer:
@@ -328,7 +356,8 @@ class Manager:
             self._spawn(self._elector.run)
         self._httpd = self._health_server() if serve_health else None
         self._spawn(self._watch_models)
-        self._spawn(self._watch_workloads)
+        self._spawn(lambda: self._watch_workloads("Deployment"))
+        self._spawn(lambda: self._watch_workloads("StatefulSet"))
         self._spawn(self._resync_loop)
         for _ in range(workers):
             self._spawn(self._worker)

@@ -33,12 +33,14 @@ PORT = 11434
 PROBE_FAILURE_THRESHOLD = 2500
 
 
-def _probe(path: str, initial_delay: int = 5) -> Dict[str, Any]:
+def _probe(path: str, initial_delay: int = 5,
+           failure_threshold: int = PROBE_FAILURE_THRESHOLD
+           ) -> Dict[str, Any]:
     return {
         "httpGet": {"path": path, "port": PORT},
         "initialDelaySeconds": initial_delay,
         "periodSeconds": 10,
-        "failureThreshold": PROBE_FAILURE_THRESHOLD,
+        "failureThreshold": failure_threshold,
     }
 
 
@@ -100,8 +102,13 @@ def new_server_container(
         "env": env,
         "ports": [{"name": "http", "containerPort": PORT, "protocol": "TCP"}],
         "volumeMounts": mounts,
+        # startup gates liveness through the hours-long pull/transcode
+        # window (the reference piles its 2500-failure tolerance onto both
+        # probes, pod.go:50,62); once serving, a wedged engine should be
+        # restarted in ~30s, not 7h, so liveness itself fails fast.
+        "startupProbe": _probe("/healthz"),
         "readinessProbe": _probe("/api/tags"),
-        "livenessProbe": _probe("/livez"),
+        "livenessProbe": _probe("/livez", failure_threshold=3),
     }
     if placement is not None:
         container["resources"] = {

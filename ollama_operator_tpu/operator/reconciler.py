@@ -201,11 +201,13 @@ class ModelReconciler:
         placement = spec.tpu_placement()
         multi_host = placement is not None and placement.multi_host
         app = workload.model_app_name(name)
+        image = spec.server_image or self.server_image  # per-CR pin wins
         if multi_host:
-            want = workload.build_model_statefulset(model, self.server_image)
+            want = workload.build_model_statefulset(model, image)
             workload._ensure(self.c, workload.build_headless_service(model))
         else:
-            want = workload.build_model_deployment(model, self.server_image)
+            want = workload.build_model_deployment(model, image)
+        workload.stamp_spec_hash(want)
         cur = self.c.get("apps/v1", want["kind"], namespace, app)
         if cur is None:
             self.c.create(want)

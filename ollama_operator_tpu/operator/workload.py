@@ -345,24 +345,48 @@ def deployment_replica_failure(dep: Dict[str, Any]) -> Optional[str]:
     return None
 
 
+SPEC_HASH_ANNOTATION = "ollama.ayaka.io/spec-hash"
+
+
+def spec_hash(want: Dict[str, Any]) -> str:
+    """Stable digest of the pod template we intend. Drift detection
+    compares this recorded intent against the new intent — never the live
+    object's template, because the apiserver enriches live templates with
+    defaulted fields (imagePullPolicy, probe timeouts, …) that would read
+    as spurious drift on every reconcile."""
+    import hashlib
+    import json as _json
+    payload = _json.dumps(want["spec"]["template"], sort_keys=True,
+                          separators=(",", ":"))
+    return hashlib.sha256(payload.encode()).hexdigest()[:16]
+
+
+def stamp_spec_hash(want: Dict[str, Any]) -> Dict[str, Any]:
+    want["metadata"].setdefault("annotations", {})[SPEC_HASH_ANNOTATION] = \
+        spec_hash(want)
+    return want
+
+
 def update_model_workload(c: KubeClient, rec: Recorder, model: Dict[str, Any],
                           cur: Dict[str, Any], want: Dict[str, Any]) -> bool:
-    """Sync mutable fields of the existing workload: replicas AND the
-    serving image/model (the reference only syncs replicas,
-    model.go:149-186 — image drift is a known gap we close). Returns True
-    if an update was written (caller requeues)."""
+    """Sync mutable fields of the existing workload: replicas AND the pod
+    template (the reference only syncs replicas, model.go:149-186 — image
+    drift is a known gap we close). Template changes are detected via the
+    recorded spec-hash annotation (see spec_hash). Returns True if an
+    update was written (caller requeues)."""
     changed = False
     cs, ws = cur.get("spec") or {}, want["spec"]
     if cs.get("replicas") != ws.get("replicas"):
         cs["replicas"] = ws["replicas"]
         changed = True
-    cur_tpl = (cs.get("template") or {}).get("spec") or {}
-    want_tpl = ws["template"]["spec"]
-    for field in ("initContainers", "containers", "nodeSelector",
-                  "tolerations", "imagePullSecrets"):
-        if field in want_tpl and cur_tpl.get(field) != want_tpl[field]:
-            cur_tpl[field] = want_tpl[field]
-            changed = True
+    want_hash = spec_hash(want)
+    cur_hash = ((cur.get("metadata") or {}).get("annotations") or {}
+                ).get(SPEC_HASH_ANNOTATION)
+    if cur_hash != want_hash:
+        cs["template"] = want["spec"]["template"]
+        cur.setdefault("metadata", {}).setdefault(
+            "annotations", {})[SPEC_HASH_ANNOTATION] = want_hash
+        changed = True
     if changed:
         cur["spec"] = cs
         c.update(cur)

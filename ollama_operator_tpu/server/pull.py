@@ -58,6 +58,22 @@ def pull(model: str, host: str, retries: int = 1080,
                     if evt.get("status") == "success":
                         ok = True
                 return 0 if ok else 1
+        except urllib.error.HTTPError as e:
+            # a definitive HTTP response is not "store unreachable": 4xx is
+            # a permanent error (bad model ref) — exit so the failure shows
+            # up in pod status; 5xx may be store startup/backpressure
+            if e.code < 500:
+                print(f"pull failed: HTTP {e.code}: "
+                      f"{e.read().decode(errors='replace')[:500]}",
+                      file=sys.stderr)
+                return 1
+            if attempt >= retries:
+                print(f"pull: giving up after {attempt} attempts: {e}",
+                      file=sys.stderr)
+                return 1
+            print(f"pull: store returned {e.code}; retry {attempt} in "
+                  f"{retry_delay:.0f}s", file=sys.stderr)
+            time.sleep(retry_delay)
         except (urllib.error.URLError, ConnectionError, TimeoutError, OSError) as e:
             if attempt >= retries:
                 print(f"pull: giving up after {attempt} attempts: {e}",

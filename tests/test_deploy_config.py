@@ -1,0 +1,131 @@
+"""Deploy-config validation: CRD schema ↔ operator objects ↔ installer.
+
+The reference trusts controller-gen to keep the CRD schema and Go types in
+sync; with a hand-maintained schema that invariant needs a test — every
+spec field the operator reads must be declared in the CRD schema (and vice
+versa), sample CRs must validate, and the installer bundle must be
+self-consistent (RBAC subjects point at objects it creates, image pinned).
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+yaml = pytest.importorskip("yaml")
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load(path):
+    with open(os.path.join(ROOT, path)) as f:
+        return list(yaml.safe_load_all(f))
+
+
+@pytest.fixture(scope="module")
+def crd():
+    (doc,) = load("config/crd/ollama.ayaka.io_models.yaml")
+    return doc
+
+
+@pytest.fixture(scope="module")
+def spec_schema(crd):
+    v1 = next(v for v in crd["spec"]["versions"] if v["name"] == "v1")
+    return v1["schema"]["openAPIV3Schema"]["properties"]["spec"]
+
+
+class TestCrdSchema:
+    def test_identity_matches_reference(self, crd):
+        assert crd["metadata"]["name"] == "models.ollama.ayaka.io"
+        assert crd["spec"]["group"] == "ollama.ayaka.io"
+        assert crd["spec"]["names"]["kind"] == "Model"
+        v1 = next(v for v in crd["spec"]["versions"] if v["name"] == "v1")
+        assert v1["storage"] and v1["served"]
+        assert v1["subresources"] == {"status": {}}
+        cols = {c["jsonPath"] for c in v1["additionalPrinterColumns"]}
+        # the reference's printcolumns (crd.yaml:17-23) survive
+        assert ".spec.image" in cols
+        assert ".status.conditions[0].type" in cols
+
+    def test_schema_covers_every_field_the_operator_reads(self, spec_schema):
+        """ModelSpecView's accessors define what the operator consumes;
+        each must be declared (else the apiserver silently prunes it)."""
+        declared = set(spec_schema["properties"])
+        consumed = {"image", "replicas", "imagePullPolicy",
+                    "imagePullSecrets", "storageClassName",
+                    "persistentVolumeClaim", "persistentVolume",
+                    "runtime", "tpu", "contextLength", "sharding",
+                    "quantization", "serverImage"}
+        missing = consumed - declared
+        assert not missing, f"CRD schema missing: {missing}"
+        assert spec_schema["required"] == ["image"]
+
+    def test_topologies_in_schema_docs_match_catalog(self, spec_schema):
+        from ollama_operator_tpu.operator.types import TPU_TOPOLOGIES
+        desc = spec_schema["properties"]["tpu"]["properties"][
+            "topology"]["description"]
+        for t in ("v5e-1", "v5e-4", "v5e-16"):
+            assert t in TPU_TOPOLOGIES and t in desc
+
+    def test_samples_validate_against_schema(self, spec_schema):
+        from ollama_operator_tpu.operator.types import ModelSpecView
+        for doc in load("config/samples/ollama_v1_model.yaml"):
+            declared = set(spec_schema["properties"])
+            assert set(doc["spec"]) <= declared, doc["metadata"]["name"]
+            view = ModelSpecView(doc)
+            assert view.image
+            view.tpu_placement()  # raises on an unknown topology
+
+
+class TestInstaller:
+    @pytest.fixture(scope="class")
+    def bundle(self, tmp_path_factory):
+        out = tmp_path_factory.mktemp("dist") / "install.yaml"
+        subprocess.run(
+            [sys.executable, os.path.join(ROOT, "hack/build_installer.py"),
+             "--image", "example.com/runtime:v9", "-o", str(out)],
+            check=True, capture_output=True)
+        with open(out) as f:
+            return list(yaml.safe_load_all(f))
+
+    def test_bundle_contents(self, bundle):
+        kinds = [(d["kind"], d["metadata"]["name"]) for d in bundle]
+        assert ("CustomResourceDefinition", "models.ollama.ayaka.io") in kinds
+        assert ("Namespace", "ollama-operator-system") in kinds
+        assert ("Deployment", "ollama-operator-controller-manager") in kinds
+
+    def test_rbac_subjects_resolve(self, bundle):
+        by_kind = {}
+        for d in bundle:
+            by_kind.setdefault(d["kind"], []).append(d)
+        sas = {(d["metadata"]["name"], d["metadata"].get("namespace"))
+               for d in by_kind["ServiceAccount"]}
+        for b in by_kind["ClusterRoleBinding"] + by_kind["RoleBinding"]:
+            for s in b["subjects"]:
+                assert (s["name"], s.get("namespace")) in sas, b
+            roles = {d["metadata"]["name"]
+                     for d in by_kind.get(b["roleRef"]["kind"], [])}
+            assert b["roleRef"]["name"] in roles, b
+
+    def test_image_is_pinned(self, bundle):
+        dep = next(d for d in bundle if d["kind"] == "Deployment")
+        c = dep["spec"]["template"]["spec"]["containers"][0]
+        assert c["image"] == "example.com/runtime:v9"
+        assert c["args"][0] == "operator"
+
+    def test_manager_rbac_covers_reconciler_verbs(self, bundle):
+        """Every (group, resource) the reconciler touches is granted."""
+        role = next(d for d in bundle if d["kind"] == "ClusterRole")
+        granted = set()
+        for rule in role["rules"]:
+            for g in rule["apiGroups"]:
+                for r in rule["resources"]:
+                    granted.add((g, r))
+        needed = [("ollama.ayaka.io", "models"),
+                  ("ollama.ayaka.io", "models/status"),
+                  ("apps", "deployments"), ("apps", "statefulsets"),
+                  ("", "services"), ("", "persistentvolumeclaims"),
+                  ("", "events")]
+        for pair in needed:
+            assert pair in granted, pair

@@ -238,3 +238,60 @@ class TestManagerEndToEnd:
         finally:
             stop.set()
             mgr.stop()
+
+
+class TestWorkQueueProcessing:
+    def test_no_concurrent_processing_of_same_key(self):
+        q = WorkQueue()
+        q.add(("ns", "a"))
+        key = q.get(timeout=1)
+        assert key == ("ns", "a")
+        # event arrives while a worker holds the key: must NOT hand it to
+        # a second worker — marked dirty instead
+        q.add(("ns", "a"))
+        assert q.get(timeout=0.1) is None
+        q.done(key)  # dirty → immediate requeue
+        assert q.get(timeout=1) == ("ns", "a")
+        q.done(("ns", "a"))
+        assert q.get(timeout=0.1) is None
+
+    def test_done_with_requeue_after(self):
+        q = WorkQueue()
+        q.add(("ns", "a"))
+        key = q.get(timeout=1)
+        q.done(key, requeue_after=0.05)
+        assert q.get(timeout=1) == ("ns", "a")
+
+
+class TestServerImageOverride:
+    def test_spec_server_image_wins(self, fake):
+        from ollama_operator_tpu.operator.reconciler import ModelReconciler
+        from ollama_operator_tpu.operator.recorder import NullRecorder
+        rec = ModelReconciler(fake, NullRecorder(),
+                              server_image="operator-default:1")
+        obj = model_obj("pinned")
+        obj["spec"]["serverImage"] = "user/runtime:pin"
+        fake.create(obj)
+        for _ in range(12):
+            rec.reconcile("default", "pinned")
+            for sts in fake.list("apps/v1", "StatefulSet", "default"):
+                fake.set_status("apps/v1", "StatefulSet", "default",
+                                sts["metadata"]["name"],
+                                {"readyReplicas":
+                                 sts["spec"].get("replicas", 1)})
+            for svc in fake.list("v1", "Service", "default"):
+                if not svc["spec"].get("clusterIP"):
+                    svc["spec"]["clusterIP"] = "10.1.1.1"
+                    fake.update(svc)
+            dep = fake.get("apps/v1", "Deployment", "default",
+                           "ollama-model-pinned")
+            if dep:
+                break
+        tpl = dep["spec"]["template"]["spec"]
+        assert tpl["containers"][0]["image"] == "user/runtime:pin"
+        assert tpl["initContainers"][0]["image"] == "user/runtime:pin"
+        # the shared store keeps the operator image (it serves all models)
+        sts = fake.get("apps/v1", "StatefulSet", "default",
+                       "ollama-models-store")
+        assert sts["spec"]["template"]["spec"]["containers"][0][
+            "image"] == "operator-default:1"

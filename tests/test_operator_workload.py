@@ -160,3 +160,59 @@ class TestSpecView:
 
     def test_cpu_runtime_no_placement(self):
         assert ModelSpecView(model_obj(runtime="cpu")).tpu_placement() is None
+
+
+class TestDriftDetection:
+    """update_model_workload must not see apiserver defaulting as drift
+    (a real apiserver enriches live pod templates with defaulted fields),
+    but must catch real template changes via the spec-hash annotation."""
+
+    def _mk(self):
+        from ollama_operator_tpu.operator.recorder import NullRecorder
+        from fake_kube import FakeKube
+        kube = FakeKube()
+        m = model_obj(runtime="cpu")
+        want = workload.build_model_deployment(m, "img:1")
+        workload.stamp_spec_hash(want)
+        kube.create(want)
+        return kube, m, want
+
+    def test_apiserver_defaulting_is_not_drift(self):
+        from ollama_operator_tpu.operator.recorder import NullRecorder
+        kube, m, want = self._mk()
+        cur = kube.get("apps/v1", "Deployment", "default", "ollama-model-phi")
+        # simulate apiserver defaulting on the live object
+        tpl = cur["spec"]["template"]["spec"]
+        tpl["dnsPolicy"] = "ClusterFirst"
+        for c in tpl["containers"]:
+            c["terminationMessagePath"] = "/dev/termination-log"
+            c.setdefault("resources", {})
+        kube.update(cur)
+        cur = kube.get("apps/v1", "Deployment", "default", "ollama-model-phi")
+        rec = NullRecorder()
+        assert workload.update_model_workload(kube, rec, m, cur, want) is False
+        assert rec._events == []
+
+    def test_real_template_change_is_drift(self):
+        from ollama_operator_tpu.operator.recorder import NullRecorder
+        kube, m, _ = self._mk()
+        m2 = model_obj(runtime="cpu", image="phi:v2")
+        want2 = workload.build_model_deployment(m2, "img:1")
+        workload.stamp_spec_hash(want2)
+        cur = kube.get("apps/v1", "Deployment", "default", "ollama-model-phi")
+        assert workload.update_model_workload(
+            kube, NullRecorder(), m2, cur, want2) is True
+        cur = kube.get("apps/v1", "Deployment", "default", "ollama-model-phi")
+        assert cur["spec"]["template"]["spec"]["initContainers"][0][
+            "args"] == ["pull", "phi:v2"]
+        assert cur["metadata"]["annotations"][
+            workload.SPEC_HASH_ANNOTATION] == workload.spec_hash(want2)
+
+
+class TestProbes:
+    def test_liveness_fails_fast_startup_tolerates_load(self):
+        dep = workload.build_model_deployment(model_obj(runtime="cpu"))
+        server = dep["spec"]["template"]["spec"]["containers"][0]
+        assert server["startupProbe"]["failureThreshold"] == 2500
+        assert server["livenessProbe"]["failureThreshold"] == 3
+        assert server["livenessProbe"]["httpGet"]["path"] == "/livez"
