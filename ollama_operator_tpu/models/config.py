@@ -45,6 +45,7 @@ class ModelConfig:
     logit_softcap: float = 0.0         # gemma2: tanh soft-capping of logits
     attn_softcap: float = 0.0          # gemma2: tanh soft-capping of scores
     qk_norm: bool = False              # qwen3/llama4-style per-head RMS on q,k
+    kernels: str = "auto"              # attention impl: auto|pallas|xla|interpret
 
     @property
     def q_dim(self) -> int:
@@ -73,6 +74,7 @@ class ModelConfig:
         assert self.norm_type in ("rmsnorm", "layernorm")
         assert self.mlp_type in ("gated", "plain")
         assert self.act in ("silu", "gelu", "gelu_tanh")
+        assert self.kernels in ("auto", "pallas", "xla", "interpret")
         return self
 
 

@@ -40,7 +40,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..ops.attention import attend, causal_mask, length_mask
+from ..ops.attention import cached_attention, causal_mask, chunk_attention
 from ..ops.norms import layer_norm, rms_norm
 from ..ops.rope import apply_rope, rope_angles
 from .config import ModelConfig
@@ -161,11 +161,14 @@ def _proj_out(lp, attn_out, B, T):
 
 
 def _block_chunk(cfg: ModelConfig, lp, x, cos, sin, mask, scale):
-    """One layer over a fresh chunk (no prior cache). Returns (x, (k, v))."""
+    """One layer over a fresh chunk (no prior cache). Returns
+    (x, (k, v)) with K/V head-first [B, KvH, T, hd] — the cache layout."""
     B, T, _ = x.shape
     h = _norm(cfg, x, lp["attn_norm_w"], lp.get("attn_norm_b"))
     q, k, v = _qkv(cfg, lp, h, cos, sin)
-    attn = attend(q, k, v, mask, scale, cfg.attn_softcap)
+    k = k.transpose(0, 2, 1, 3)
+    v = v.transpose(0, 2, 1, 3)
+    attn = chunk_attention(cfg, q, k, v, mask, scale)
     attn = _proj_out(lp, attn, B, T)
     if cfg.parallel_block:
         x = x + attn + _mlp(cfg, lp, h)
@@ -178,15 +181,21 @@ def _block_chunk(cfg: ModelConfig, lp, x, cos, sin, mask, scale):
 
 def _block_cached(cfg: ModelConfig, lp, x, cos, sin, k_cache, v_cache,
                   write_pos, mask, scale):
-    """One layer with a KV cache. ``write_pos`` [B, T] are absolute slots for
-    the new tokens' K/V. Returns (x, k_cache, v_cache) updated."""
+    """One layer with a head-first KV cache [B, KvH, S, hd]. ``write_pos``
+    [B, T] are absolute slots for the new tokens' K/V. Returns
+    (x, k_cache, v_cache) updated."""
     B, T, _ = x.shape
     h = _norm(cfg, x, lp["attn_norm_w"], lp.get("attn_norm_b"))
     q, k, v = _qkv(cfg, lp, h, cos, sin)
-    bidx = jnp.arange(B)[:, None]
-    k_cache = k_cache.at[bidx, write_pos].set(k.astype(k_cache.dtype))
-    v_cache = v_cache.at[bidx, write_pos].set(v.astype(v_cache.dtype))
-    attn = attend(q, k_cache, v_cache, mask, scale, cfg.attn_softcap)
+    k = k.transpose(0, 2, 1, 3)                       # [B, KvH, T, hd]
+    v = v.transpose(0, 2, 1, 3)
+    KvH = k.shape[1]
+    bidx = jnp.arange(B)[:, None, None]
+    hidx = jnp.arange(KvH)[None, :, None]
+    pidx = write_pos[:, None, :]
+    k_cache = k_cache.at[bidx, hidx, pidx].set(k.astype(k_cache.dtype))
+    v_cache = v_cache.at[bidx, hidx, pidx].set(v.astype(v_cache.dtype))
+    attn = cached_attention(cfg, q, k_cache, v_cache, mask, write_pos, scale)
     attn = _proj_out(lp, attn, B, T)
     if cfg.parallel_block:
         x = x + attn + _mlp(cfg, lp, h)
@@ -228,7 +237,8 @@ def prefill_chunk(params: Params, cfg: ModelConfig, tokens: jax.Array,
     tokens  [B, T] int32 (right-padded; padding is masked out of attention by
             the causal structure for queries < n_valid — callers only read
             logits at n_valid-1).
-    Returns (logits [B, T, V] fp32, k [L, B, T, KvH, hd], v [...]).
+    Returns (logits [B, T, V] fp32, k [L, B, KvH, T, hd], v [...]) — K/V
+    head-first, matching the cache layout.
     """
     B, T = tokens.shape
     scale = 1.0 / math.sqrt(cfg.head_dim)
@@ -257,12 +267,12 @@ def forward_with_cache(params: Params, cfg: ModelConfig, tokens: jax.Array,
 
     tokens   [B, T] — T=1 is the decode step; T>1 is chunked prefill
              continuation.
-    k_cache  [L, B, S, KvH, hd] (donate for in-place update)
+    k_cache  [L, B, KvH, S, hd] head-first (donate for in-place update)
     lengths  [B] int32 — number of valid cached tokens per slot.
     Returns (logits [B, T, V], k_cache, v_cache).
     """
     B, T = tokens.shape
-    L, _, S, _, _ = k_cache.shape
+    L, _, _, S, _ = k_cache.shape
     scale = 1.0 / math.sqrt(cfg.head_dim)
     positions = lengths[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
     cos, sin = rope_angles(positions, cfg.rotary_dim, cfg.rope_theta,

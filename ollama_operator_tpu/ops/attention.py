@@ -8,6 +8,7 @@ large and avoids an HBM-resident K/V copy.
 
 from __future__ import annotations
 
+import os
 from typing import Optional
 
 import jax
@@ -16,10 +17,14 @@ import jax.numpy as jnp
 NEG_INF = -1e30  # large-negative instead of -inf: keeps masked softmax NaN-free
 
 
-def _softcap(scores, cap: float):
+def softcap_scores(scores, cap: float):
+    """Gemma2-style tanh soft-capping (no-op when cap <= 0)."""
     if cap and cap > 0.0:
         return cap * jnp.tanh(scores / cap)
     return scores
+
+
+_softcap = softcap_scores
 
 
 def attend(q, k, v, mask, scale: float, softcap: float = 0.0):
@@ -42,6 +47,29 @@ def attend(q, k, v, mask, scale: float, softcap: float = 0.0):
     scores = scores + mask[:, :, None, :, :]
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bkgts,bskh->btkgh", probs.astype(v.dtype), v)
+    return out.reshape(B, T, H, hd)
+
+
+def attend_hf(q, k, v, mask, scale: float, softcap: float = 0.0):
+    """Grouped-query attention with **head-first** K/V — the serving
+    layout: the KV cache keeps (seq, head_dim) as its trailing dims so the
+    pallas kernels tile it directly and XLA reads it without relayout.
+
+    q    [B, T, H, hd]
+    k, v [B, KvH, S, hd]
+    mask [B, 1, T, S] additive, broadcastable
+    →    [B, T, H, hd]
+    """
+    B, T, H, hd = q.shape
+    KvH = k.shape[1]
+    G = H // KvH
+    qg = q.reshape(B, T, KvH, G, hd)
+    scores = jnp.einsum("btkgh,bksh->bkgts", qg, k,
+                        preferred_element_type=jnp.float32)
+    scores = _softcap(scores * scale, softcap)
+    scores = scores + mask[:, :, None, :, :]
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgts,bksh->btkgh", probs.astype(v.dtype), v)
     return out.reshape(B, T, H, hd)
 
 
@@ -68,3 +96,59 @@ def length_mask(lengths, S: int, dtype=jnp.float32, q_pos: Optional[jax.Array] =
         qp = (lengths - 1) if q_pos is None else q_pos
         ok = ok & (k_pos > qp[:, None] - sliding_window)
     return jnp.where(ok, 0.0, NEG_INF).astype(dtype)[:, None, None, :]
+
+
+# ---------------------------------------------------------------------------
+# kernel dispatch (ModelConfig.kernels: auto | pallas | xla | interpret)
+# ---------------------------------------------------------------------------
+
+KERNEL_MODES = ("auto", "pallas", "xla", "interpret")
+
+
+def resolve_kernels(kernels: str) -> str:
+    """Trace-time kernel choice. ``auto`` → pallas on TPU backends, XLA
+    elsewhere. The OLLAMA_TPU_KERNELS env var overrides only the ``auto``
+    choice — an explicit config (e.g. the Engine's multi-device XLA guard,
+    since pallas_call is opaque to GSPMD) always wins."""
+    env = os.environ.get("OLLAMA_TPU_KERNELS", "")
+    if env:
+        if env not in KERNEL_MODES:
+            raise ValueError(
+                f"OLLAMA_TPU_KERNELS={env!r}; expected one of {KERNEL_MODES}")
+        if kernels == "auto":
+            kernels = env
+    if kernels == "auto":
+        kernels = "pallas" if jax.default_backend() == "tpu" else "xla"
+    return kernels
+
+
+def chunk_attention(cfg, q, k, v, mask, scale: float):
+    """Prefill attention over a fresh chunk (chunk-local causal semantics,
+    the mask callers build via ``causal_mask(T, T, 0)``). K/V are
+    head-first [B, KvH, T, hd]. Routes to the pallas flash kernel when
+    enabled and tileable, else the einsum path."""
+    mode = resolve_kernels(cfg.kernels)
+    if mode in ("pallas", "interpret"):
+        from .pallas import flash_prefill
+        out = flash_prefill(q, k, v, scale, cfg.attn_softcap,
+                            cfg.sliding_window,
+                            interpret=(mode == "interpret"))
+        if out is not None:
+            return out
+    return attend_hf(q, k, v, mask, scale, cfg.attn_softcap)
+
+
+def cached_attention(cfg, q, k_cache, v_cache, mask, q_pos, scale: float):
+    """Attention against the head-first slot KV cache [B, KvH, S, hd].
+    ``q_pos`` [B, T] are the new tokens' absolute positions (the T=1 decode
+    step routes to the pallas kernel, which skips unread cache blocks; T>1
+    continuations use the masked einsum path)."""
+    mode = resolve_kernels(cfg.kernels)
+    if mode in ("pallas", "interpret") and q.shape[1] == 1:
+        from .pallas import decode_attention
+        out = decode_attention(q, k_cache, v_cache, q_pos[:, 0], scale,
+                               cfg.attn_softcap, cfg.sliding_window,
+                               interpret=(mode == "interpret"))
+        if out is not None:
+            return out
+    return attend_hf(q, k_cache, v_cache, mask, scale, cfg.attn_softcap)

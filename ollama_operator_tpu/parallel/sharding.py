@@ -101,16 +101,17 @@ def shard_params(params: Dict[str, Any], mesh: Mesh,
 
 def kv_cache_pspec(cfg: Optional[ModelConfig] = None,
                    mesh: Optional[Mesh] = None) -> P:
-    """KV cache [L, B, S, KvH, hd]: batch on dp, heads on tp (replicated
-    over tp when KV heads don't divide it — see resolve_specs)."""
+    """KV cache [L, B, KvH, S, hd] (head-first): batch on dp, heads on tp
+    (replicated over tp when KV heads don't divide it — see
+    resolve_specs)."""
     if cfg is not None and mesh is not None:
         tp = mesh.shape.get("tp", 1)
         dp = mesh.shape.get("dp", 1)
         b = "dp" if dp > 1 else None
         if tp > 1 and cfg.n_kv_heads % tp != 0:
             return P(None, b, None, None, None)
-        return P(None, b, None, "tp" if tp > 1 else None, None)
-    return P(None, "dp", None, "tp", None)
+        return P(None, b, "tp" if tp > 1 else None, None, None)
+    return P(None, "dp", "tp", None, None)
 
 
 def act_pspec() -> P:

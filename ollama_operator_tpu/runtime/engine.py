@@ -12,8 +12,9 @@ server loop that the reference delegates to the ollama image
 - **Donation**: KV caches and per-slot state are donated into each step, so
   XLA updates them in place in HBM — no cache copies per token.
 - **Sharding**: params are TP-sharded (parallel/sharding.py), caches sharded
-  [L, B@dp, S, KvH@tp, hd]; the same code runs single-chip (trivial mesh) or
-  over a v5e slice.
+  [L, B@dp, KvH@tp, S, hd] (head-first so the pallas kernels read (S, hd)
+  tiles directly); the same code runs single-chip (trivial mesh) or over a
+  v5e slice.
 - All sampling is on-device (ops/sampling.py); the only per-step
   host↔device traffic is the sampled token ids [B] coming back for
   streaming/stop handling.
@@ -73,6 +74,11 @@ class Engine:
 
     def __init__(self, cfg: ModelConfig, params, mesh: Optional[Mesh] = None,
                  ecfg: EngineConfig = EngineConfig()):
+        # pallas_call is opaque to GSPMD — on a >1-device mesh XLA would
+        # all-gather its operands. Until the step runs under shard_map,
+        # auto-resolve to the XLA attention path whenever a real mesh is up.
+        if cfg.kernels == "auto" and mesh is not None and mesh.size > 1:
+            cfg = dataclasses.replace(cfg, kernels="xla")
         self.cfg = cfg
         self.ecfg = ecfg
         self.mesh = mesh
@@ -97,7 +103,7 @@ class Engine:
             arr = jnp.zeros(shape, dtype)
             return jax.device_put(arr, sh) if sh is not None else arr
 
-        cache_shape = (L, B, S, KvH, hd)
+        cache_shape = (L, B, KvH, S, hd)  # head-first: (S, hd) tiles
         self.k_cache = zeros(cache_shape, ecfg.cache_dtype, cache_sh)
         self.v_cache = zeros(cache_shape, ecfg.cache_dtype, cache_sh)
         self.lengths = zeros((B,), jnp.int32, slot_sh)

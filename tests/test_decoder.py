@@ -17,7 +17,8 @@ def tiny(**kw):
 
 
 def make_cache(cfg, B, S, dtype=F32):
-    shape = (cfg.n_layers, B, S, cfg.n_kv_heads, cfg.head_dim)
+    # head-first layout [L, B, KvH, S, hd] (models/decoder.py)
+    shape = (cfg.n_layers, B, cfg.n_kv_heads, S, cfg.head_dim)
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
 
 
@@ -50,8 +51,8 @@ def test_prefill_decode_equivalence(name, kw):
     logits_p, ks, vs = decoder.prefill_chunk(params, cfg, tokens[:, :split])
     S = 32
     k_cache, v_cache = make_cache(cfg, B, S)
-    k_cache = k_cache.at[:, :, :split].set(ks)
-    v_cache = v_cache.at[:, :, :split].set(vs)
+    k_cache = k_cache.at[:, :, :, :split].set(ks)
+    v_cache = v_cache.at[:, :, :, :split].set(vs)
     lengths = jnp.full((B,), split, jnp.int32)
 
     np.testing.assert_allclose(np.asarray(logits_p),
@@ -79,8 +80,8 @@ def test_chunked_prefill_matches_full():
 
     _, ks, vs = decoder.prefill_chunk(params, cfg, tokens[:, :8])
     k_cache, v_cache = make_cache(cfg, B, 32)
-    k_cache = k_cache.at[:, :, :8].set(ks)
-    v_cache = v_cache.at[:, :, :8].set(vs)
+    k_cache = k_cache.at[:, :, :, :8].set(ks)
+    v_cache = v_cache.at[:, :, :, :8].set(vs)
     logits2, _, _ = decoder.forward_with_cache(
         params, cfg, tokens[:, 8:], k_cache, v_cache,
         jnp.full((B,), 8, jnp.int32))
@@ -104,10 +105,10 @@ def test_ragged_batch_decode():
     k_cache, v_cache = make_cache(cfg, 2, S)
     _, ka, va = decoder.prefill_chunk(params, cfg, t_a[:, :9])
     _, kb, vb = decoder.prefill_chunk(params, cfg, t_b[:, :5])
-    k_cache = k_cache.at[:, 0:1, :9].set(ka)
-    v_cache = v_cache.at[:, 0:1, :9].set(va)
-    k_cache = k_cache.at[:, 1:2, :5].set(kb)
-    v_cache = v_cache.at[:, 1:2, :5].set(vb)
+    k_cache = k_cache.at[:, 0:1, :, :9].set(ka)
+    v_cache = v_cache.at[:, 0:1, :, :9].set(va)
+    k_cache = k_cache.at[:, 1:2, :, :5].set(kb)
+    v_cache = v_cache.at[:, 1:2, :, :5].set(vb)
     lengths = jnp.array([9, 5], jnp.int32)
     step_tokens = jnp.stack([t_a[0, 9], t_b[0, 5]])[:, None]
     logits, _, _ = decoder.forward_with_cache(params, cfg, step_tokens,

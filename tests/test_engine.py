@@ -17,9 +17,9 @@ def greedy_reference(params, cfg, prompt, n_steps):
     tokens = jnp.asarray(prompt, jnp.int32)[None]
     logits, ks, vs = decoder.prefill_chunk(params, cfg, tokens)
     S = 128
-    shape = (cfg.n_layers, 1, S, cfg.n_kv_heads, cfg.head_dim)
-    k_cache = jnp.zeros(shape, F32).at[:, :, :tokens.shape[1]].set(ks)
-    v_cache = jnp.zeros(shape, F32).at[:, :, :tokens.shape[1]].set(vs)
+    shape = (cfg.n_layers, 1, cfg.n_kv_heads, S, cfg.head_dim)
+    k_cache = jnp.zeros(shape, F32).at[:, :, :, :tokens.shape[1]].set(ks)
+    v_cache = jnp.zeros(shape, F32).at[:, :, :, :tokens.shape[1]].set(vs)
     lengths = jnp.array([tokens.shape[1]], jnp.int32)
     out = [int(jnp.argmax(logits[0, -1]))]
     tok = jnp.array([[out[0]]], jnp.int32)

@@ -47,7 +47,7 @@ def test_dp_tp_sharded_decode_matches_single_device():
     cfg = tiny()
     params = decoder.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
     B, S = 4, 16
-    shape = (cfg.n_layers, B, S, cfg.n_kv_heads, cfg.head_dim)
+    shape = (cfg.n_layers, B, cfg.n_kv_heads, S, cfg.head_dim)
     k_cache = jax.random.normal(jax.random.PRNGKey(2), shape, jnp.float32)
     v_cache = jax.random.normal(jax.random.PRNGKey(3), shape, jnp.float32)
     lengths = jnp.array([3, 5, 0, 7], jnp.int32)
