@@ -160,50 +160,61 @@ def _proj_out(lp, attn_out, B, T):
     return o
 
 
-def _block_chunk(cfg: ModelConfig, lp, x, cos, sin, mask, scale):
+def _residual(cfg: ModelConfig, lp, x, h, attn):
+    if cfg.parallel_block:
+        return x + attn + _mlp(cfg, lp, h)
+    x = x + attn
+    h2 = _norm(cfg, x, lp["mlp_norm_w"], lp.get("mlp_norm_b"))
+    return x + _mlp(cfg, lp, h2)
+
+
+def _block_chunk(cfg: ModelConfig, lp, x, cos, sin, mask, scale,
+                 attn_fn=None):
     """One layer over a fresh chunk (no prior cache). Returns
-    (x, (k, v)) with K/V head-first [B, KvH, T, hd] — the cache layout."""
+    (x, (k, v)) with K/V head-first [B, KvH, T, hd] — the cache layout.
+    ``attn_fn(q, k, v)`` overrides the attention core (the sequence-parallel
+    path injects ring attention here; mask is unused then)."""
     B, T, _ = x.shape
     h = _norm(cfg, x, lp["attn_norm_w"], lp.get("attn_norm_b"))
     q, k, v = _qkv(cfg, lp, h, cos, sin)
     k = k.transpose(0, 2, 1, 3)
     v = v.transpose(0, 2, 1, 3)
-    attn = chunk_attention(cfg, q, k, v, mask, scale)
-    attn = _proj_out(lp, attn, B, T)
-    if cfg.parallel_block:
-        x = x + attn + _mlp(cfg, lp, h)
+    if attn_fn is None:
+        attn = chunk_attention(cfg, q, k, v, mask, scale)
     else:
-        x = x + attn
-        h2 = _norm(cfg, x, lp["mlp_norm_w"], lp.get("mlp_norm_b"))
-        x = x + _mlp(cfg, lp, h2)
-    return x, (k, v)
+        attn = attn_fn(q, k, v)
+    attn = _proj_out(lp, attn, B, T)
+    return _residual(cfg, lp, x, h, attn), (k, v)
 
 
 def _block_cached(cfg: ModelConfig, lp, x, cos, sin, k_cache, v_cache,
-                  write_pos, mask, scale):
+                  write_pos, mask, scale, attn_fn=None, write_fn=None):
     """One layer with a head-first KV cache [B, KvH, S, hd]. ``write_pos``
     [B, T] are absolute slots for the new tokens' K/V. Returns
-    (x, k_cache, v_cache) updated."""
+    (x, k_cache, v_cache) updated. ``write_fn(kc, vc, k, v, pos)`` /
+    ``attn_fn(q, kc, vc, pos)`` override the cache write and attention core
+    (the sequence-parallel path injects shard-local variants)."""
     B, T, _ = x.shape
     h = _norm(cfg, x, lp["attn_norm_w"], lp.get("attn_norm_b"))
     q, k, v = _qkv(cfg, lp, h, cos, sin)
     k = k.transpose(0, 2, 1, 3)                       # [B, KvH, T, hd]
     v = v.transpose(0, 2, 1, 3)
-    KvH = k.shape[1]
-    bidx = jnp.arange(B)[:, None, None]
-    hidx = jnp.arange(KvH)[None, :, None]
-    pidx = write_pos[:, None, :]
-    k_cache = k_cache.at[bidx, hidx, pidx].set(k.astype(k_cache.dtype))
-    v_cache = v_cache.at[bidx, hidx, pidx].set(v.astype(v_cache.dtype))
-    attn = cached_attention(cfg, q, k_cache, v_cache, mask, write_pos, scale)
-    attn = _proj_out(lp, attn, B, T)
-    if cfg.parallel_block:
-        x = x + attn + _mlp(cfg, lp, h)
+    if write_fn is None:
+        KvH = k.shape[1]
+        bidx = jnp.arange(B)[:, None, None]
+        hidx = jnp.arange(KvH)[None, :, None]
+        pidx = write_pos[:, None, :]
+        k_cache = k_cache.at[bidx, hidx, pidx].set(k.astype(k_cache.dtype))
+        v_cache = v_cache.at[bidx, hidx, pidx].set(v.astype(v_cache.dtype))
     else:
-        x = x + attn
-        h2 = _norm(cfg, x, lp["mlp_norm_w"], lp.get("mlp_norm_b"))
-        x = x + _mlp(cfg, lp, h2)
-    return x, k_cache, v_cache
+        k_cache, v_cache = write_fn(k_cache, v_cache, k, v, write_pos)
+    if attn_fn is None:
+        attn = cached_attention(cfg, q, k_cache, v_cache, mask, write_pos,
+                                scale)
+    else:
+        attn = attn_fn(q, k_cache, v_cache, write_pos)
+    attn = _proj_out(lp, attn, B, T)
+    return _residual(cfg, lp, x, h, attn), k_cache, v_cache
 
 
 def _embed(cfg: ModelConfig, params: Params, tokens):

@@ -86,18 +86,6 @@ def causal_mask(T: int, S: int, offset, dtype=jnp.float32,
     return jnp.where(ok, 0.0, NEG_INF).astype(dtype)[None, None]
 
 
-def length_mask(lengths, S: int, dtype=jnp.float32, q_pos: Optional[jax.Array] = None,
-                sliding_window: int = 0):
-    """Additive [B, 1, 1, S] mask for decode: key j valid iff j < lengths[b].
-    ``q_pos`` (defaults to lengths-1) enables the sliding window check."""
-    k_pos = jnp.arange(S)[None, :]
-    ok = k_pos < lengths[:, None]
-    if sliding_window:
-        qp = (lengths - 1) if q_pos is None else q_pos
-        ok = ok & (k_pos > qp[:, None] - sliding_window)
-    return jnp.where(ok, 0.0, NEG_INF).astype(dtype)[:, None, None, :]
-
-
 # ---------------------------------------------------------------------------
 # kernel dispatch (ModelConfig.kernels: auto | pallas | xla | interpret)
 # ---------------------------------------------------------------------------

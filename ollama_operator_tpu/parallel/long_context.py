@@ -1,0 +1,127 @@
+"""Sequence-parallel decoder forwards (long-context serving).
+
+`shard_map` wrappers around the decoder's building blocks that shard the
+sequence axis over the mesh's ``sp`` axis: prefill runs ring attention
+(K/V chunks rotating over ICI, parallel/ring_attention.py) and decode runs
+against a sequence-sharded KV cache with an exact flash-partial combine.
+The wrappers are manual over ``sp`` ONLY — dp/tp stay GSPMD-auto, so the
+closed-over params keep their Megatron TP sharding (parallel/sharding.py)
+and XLA still inserts the tp all-reduces inside the manual region.
+
+This is a new capability over the reference, whose context length is
+whatever llama.cpp defaults to inside the delegated image (SURVEY.md §5):
+here a Model CR's ``contextLength`` can exceed one chip's HBM and the cache
+spans the slice.
+
+Semantics match models/decoder.py exactly (tests/test_ring_attention.py
+asserts logits and caches agree with the dense single-device path).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..models.config import ModelConfig
+from ..models.decoder import (Params, _block_cached, _block_chunk, _embed,
+                              _unembed)
+from ..ops.rope import rope_angles
+from .ring_attention import (ring_attention, sp_cache_write,
+                             sp_decode_attention)
+
+SP_AXIS = "sp"
+
+
+def prefill_chunk_sp(params: Params, cfg: ModelConfig, tokens: jax.Array,
+                     mesh: Mesh) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Sequence-parallel twin of ``decoder.prefill_chunk``.
+
+    tokens [B, T] with T divisible by mesh sp; returns (logits [B,T,V] fp32,
+    k [L,B,KvH,T,hd], v [...]) — logits and K/V sharded over ``sp`` along
+    their sequence axis.
+    """
+    sp = mesh.shape[SP_AXIS]
+    B, T = tokens.shape
+    assert T % sp == 0, f"prefill length {T} must divide sp={sp}"
+    scale = 1.0 / math.sqrt(cfg.head_dim)
+
+    def inner(tokens):
+        my = lax.axis_index(SP_AXIS)
+        Bc, Tc = tokens.shape
+        positions = my * Tc + jnp.arange(Tc, dtype=jnp.int32)
+        positions = jnp.broadcast_to(positions[None], (Bc, Tc))
+        cos, sin = rope_angles(positions, cfg.rotary_dim, cfg.rope_theta,
+                               cfg.rope_scaling)
+        x = _embed(cfg, params, tokens)
+
+        def attn_fn(q, k, v):
+            return ring_attention(q, k, v, scale, SP_AXIS, cfg.attn_softcap,
+                                  cfg.sliding_window)
+
+        def body(x, lp):
+            return _block_chunk(cfg, lp, x, cos, sin, None, scale,
+                                attn_fn=attn_fn)
+
+        x, (ks, vs) = lax.scan(body, x, params["layers"])
+        logits = _unembed(cfg, params, x)
+        return logits, ks, vs
+
+    seq_spec = P(None, None, None, SP_AXIS, None)   # [L,B,KvH,T@sp,hd]
+    return jax.shard_map(
+        inner, mesh=mesh,
+        in_specs=P(None, SP_AXIS),
+        out_specs=(P(None, SP_AXIS, None), seq_spec, seq_spec),
+        axis_names={SP_AXIS})(tokens)
+
+
+def forward_with_cache_sp(params: Params, cfg: ModelConfig,
+                          tokens: jax.Array, k_cache: jax.Array,
+                          v_cache: jax.Array, lengths: jax.Array,
+                          mesh: Mesh
+                          ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Sequence-parallel twin of ``decoder.forward_with_cache``.
+
+    k_cache/v_cache [L,B,KvH,S,hd] sharded over ``sp`` along S. The fresh
+    tokens' compute is replicated across sp (decode is memory-bound; sp
+    exists for HBM capacity) — only the cache reads/writes are sharded.
+    Returns (logits [B,T,V] replicated, k_cache, v_cache).
+    """
+    scale = 1.0 / math.sqrt(cfg.head_dim)
+
+    def inner(tokens, k_cache, v_cache, lengths):
+        B, T = tokens.shape
+        positions = lengths[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+        cos, sin = rope_angles(positions, cfg.rotary_dim, cfg.rope_theta,
+                               cfg.rope_scaling)
+        x = _embed(cfg, params, tokens)
+
+        def attn_fn(q, kc, vc, pos):
+            return sp_decode_attention(q, kc, vc, pos, scale, SP_AXIS,
+                                       cfg.attn_softcap, cfg.sliding_window)
+
+        def write_fn(kc, vc, k, v, pos):
+            return sp_cache_write(kc, vc, k, v, pos, SP_AXIS)
+
+        def body(x, layer_in):
+            lp, kc, vc = layer_in
+            x, kc, vc = _block_cached(cfg, lp, x, cos, sin, kc, vc,
+                                      positions, None, scale,
+                                      attn_fn=attn_fn, write_fn=write_fn)
+            return x, (kc, vc)
+
+        x, (k_cache, v_cache) = lax.scan(
+            body, x, (params["layers"], k_cache, v_cache))
+        logits = _unembed(cfg, params, x)
+        return logits, k_cache, v_cache
+
+    cache_spec = P(None, None, None, SP_AXIS, None)
+    return jax.shard_map(
+        inner, mesh=mesh,
+        in_specs=(P(None, None), cache_spec, cache_spec, P(None)),
+        out_specs=(P(None, None, None), cache_spec, cache_spec),
+        axis_names={SP_AXIS})(tokens, k_cache, v_cache, lengths)

@@ -102,16 +102,18 @@ def shard_params(params: Dict[str, Any], mesh: Mesh,
 def kv_cache_pspec(cfg: Optional[ModelConfig] = None,
                    mesh: Optional[Mesh] = None) -> P:
     """KV cache [L, B, KvH, S, hd] (head-first): batch on dp, heads on tp
-    (replicated over tp when KV heads don't divide it — see
-    resolve_specs)."""
+    (replicated over tp when KV heads don't divide it — see resolve_specs),
+    sequence on sp when the mesh has a sequence-parallel axis (long-context
+    mode, parallel/long_context.py)."""
     if cfg is not None and mesh is not None:
         tp = mesh.shape.get("tp", 1)
         dp = mesh.shape.get("dp", 1)
+        sp = mesh.shape.get("sp", 1)
         b = "dp" if dp > 1 else None
-        if tp > 1 and cfg.n_kv_heads % tp != 0:
-            return P(None, b, None, None, None)
-        return P(None, b, "tp" if tp > 1 else None, None, None)
-    return P(None, "dp", "tp", None, None)
+        s = "sp" if sp > 1 else None
+        h = "tp" if (tp > 1 and cfg.n_kv_heads % tp == 0) else None
+        return P(None, b, h, s, None)
+    return P(None, "dp", "tp", "sp", None)
 
 
 def act_pspec() -> P:

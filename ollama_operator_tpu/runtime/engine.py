@@ -87,6 +87,13 @@ class Engine:
         L, KvH, hd = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
         V = cfg.vocab_size
 
+        self.sp_size = mesh.shape.get("sp", 1) if mesh is not None else 1
+        if self.sp_size > 1:
+            assert self.sp_size & (self.sp_size - 1) == 0, (
+                f"sp={self.sp_size} must be a power of two (prefill buckets "
+                f"are; each bucket must shard evenly over sp)")
+            assert S % self.sp_size == 0, (
+                f"max_seq_len {S} must be divisible by sp={self.sp_size}")
         if mesh is not None:
             dp = mesh.shape.get("dp", 1)
             assert B % dp == 0, f"max_slots {B} must divide dp {dp}"
@@ -119,7 +126,11 @@ class Engine:
         self.keys = jax.vmap(jax.random.fold_in)(
             jnp.broadcast_to(base, (B,)), jnp.arange(B))
 
-        self._buckets = prefill_buckets(S, ecfg.min_prefill_bucket)
+        # SP prefill shards the chunk over sp — every bucket must divide it
+        # (both are powers of two, so raising the floor suffices; the last
+        # bucket is S itself, asserted divisible above).
+        self._buckets = prefill_buckets(
+            S, max(ecfg.min_prefill_bucket, self.sp_size))
         self._compile_fns()
 
     # ------------------------------------------------------------------
@@ -128,11 +139,22 @@ class Engine:
     def _compile_fns(self):
         cfg = self.cfg
 
+        if self.sp_size > 1:
+            from ..parallel import long_context
+            mesh = self.mesh
+            prefill_impl = partial(long_context.prefill_chunk_sp, cfg=cfg,
+                                   mesh=mesh)
+            step_impl = partial(long_context.forward_with_cache_sp, cfg=cfg,
+                                mesh=mesh)
+        else:
+            prefill_impl = partial(decoder.prefill_chunk, cfg=cfg)
+            step_impl = partial(decoder.forward_with_cache, cfg=cfg)
+
         @partial(jax.jit, static_argnames=())
         def _prefill(params, tokens, n_valid, sp_row, key):
             """B=1 prefill of a padded chunk; returns first sampled token,
             the chunk K/V, and the prompt token-count row."""
-            logits, ks, vs = decoder.prefill_chunk(params, cfg, tokens)
+            logits, ks, vs = prefill_impl(params, tokens=tokens)
             last = jax.lax.dynamic_index_in_dim(
                 logits[0], n_valid - 1, axis=0, keepdims=False)
             T = tokens.shape[1]
@@ -159,8 +181,9 @@ class Engine:
         @partial(jax.jit, donate_argnums=(1, 2, 3, 4, 5, 7))
         def _decode(params, k_cache, v_cache, lengths, counts, last_tokens,
                     sp, keys, active):
-            logits, k_cache, v_cache = decoder.forward_with_cache(
-                params, cfg, last_tokens[:, None], k_cache, v_cache, lengths)
+            logits, k_cache, v_cache = step_impl(
+                params, tokens=last_tokens[:, None], k_cache=k_cache,
+                v_cache=v_cache, lengths=lengths)
             step_keys = jax.vmap(jax.random.fold_in)(keys, lengths)
             toks = sampling.sample(logits[:, 0], counts, sp, step_keys)
             B = toks.shape[0]

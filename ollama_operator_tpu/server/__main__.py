@@ -41,6 +41,10 @@ def main(argv=None):
     p.add_argument("--tp", type=int,
                    default=int(os.environ.get("TPU_TENSOR_PARALLEL", "0")),
                    help="tensor-parallel ways (0 = all local devices)")
+    p.add_argument("--sp", type=int,
+                   default=int(os.environ.get("TPU_SEQUENCE_PARALLEL", "1")),
+                   help="sequence-parallel ways (ring attention + "
+                        "sequence-sharded KV cache for long context)")
     p.add_argument("--profile-port", type=int,
                    default=int(os.environ.get("TPU_PROFILE_PORT", "0")),
                    help="jax.profiler server port (0 = off)")
@@ -59,11 +63,13 @@ def main(argv=None):
         if args.profile_port:
             jax.profiler.start_server(args.profile_port)
         devices = jax.devices()
-        tp = args.tp or len(devices)
-        if tp > 1:
+        sp = max(1, args.sp)
+        tp = args.tp or len(devices) // sp
+        if tp * sp > 1:
             from ..parallel import MeshPlan, make_mesh
-            mesh = make_mesh(MeshPlan.for_devices(len(devices), tp=tp))
-        print(f"devices: {devices}, tensor-parallel: {tp}", file=sys.stderr)
+            mesh = make_mesh(MeshPlan.for_devices(len(devices), tp=tp, sp=sp))
+        print(f"devices: {devices}, tensor-parallel: {tp}, "
+              f"sequence-parallel: {sp}", file=sys.stderr)
 
     ecfg = EngineConfig(max_slots=args.max_slots,
                         max_seq_len=args.max_seq_len)
