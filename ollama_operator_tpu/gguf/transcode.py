@@ -63,6 +63,10 @@ def config_from_gguf(f: GGUFFile) -> ModelConfig:
     eps = f.field("attention.layer_norm_rms_epsilon")
     if eps is not None:
         base["norm_eps"] = float(eps)
+    n_exp = int(f.field("expert_count", 0) or 0)
+    if n_exp:  # mixtral family (GGUF arch is still "llama")
+        base["n_experts"] = n_exp
+        base["n_experts_used"] = int(f.field("expert_used_count", 2))
 
     if arch in ("llama", "mistral"):
         cfg = ModelConfig(arch="llama", **base)
@@ -157,9 +161,10 @@ def load_params(f: GGUFFile, cfg: Optional[ModelConfig] = None,
     layers: Dict[str, Any] = {
         "attn_norm_w": stack("blk.{}.attn_norm.weight"),
         "wo": stack("blk.{}.attn_output.weight", T_),
-        "w_up": stack("blk.{}.ffn_up.weight", T_),
-        "w_down": stack("blk.{}.ffn_down.weight", T_),
     }
+    if not cfg.n_experts:
+        layers["w_up"] = stack("blk.{}.ffn_up.weight", T_)
+        layers["w_down"] = stack("blk.{}.ffn_down.weight", T_)
     if "blk.0.attn_qkv.weight" in f.tensors:  # fused qkv (phi2)
         q_dim, kv_dim = cfg.q_dim, cfg.kv_dim
         wq, wk, wv, bq, bk, bv = [], [], [], [], [], []
@@ -196,7 +201,29 @@ def load_params(f: GGUFFile, cfg: Optional[ModelConfig] = None,
         layers["mlp_norm_w"] = stack("blk.{}.ffn_norm.weight")
         if cfg.norm_type == "layernorm":
             layers["mlp_norm_b"] = stack("blk.{}.ffn_norm.bias")
-    if cfg.mlp_type == "gated":
+    if cfg.n_experts:
+        # mixtral: router ffn_gate_inp [E, D] → [D, E]; merged expert
+        # tensors ffn_{gate,up}_exps [E, F, D] → [E, D, F] and
+        # ffn_down_exps [E, D, F] → [E, F, D] (per-expert transpose to
+        # [in, out], matching the dense path's x @ w convention)
+        eT = lambda a: a.transpose(0, 2, 1)
+        layers["router"] = stack("blk.{}.ffn_gate_inp.weight", T_)
+        if "blk.0.ffn_gate_exps.weight" in f.tensors:
+            layers["we_gate"] = stack("blk.{}.ffn_gate_exps.weight", eT)
+            layers["we_up"] = stack("blk.{}.ffn_up_exps.weight", eT)
+            layers["we_down"] = stack("blk.{}.ffn_down_exps.weight", eT)
+        else:  # legacy per-expert split tensors (pre-merge GGUFs)
+            def stack_experts(fmt: str):
+                out = []
+                for i in range(L):
+                    es = [cast(_dq(f, fmt.format(i, e)).T)
+                          for e in range(cfg.n_experts)]
+                    out.append(np.stack(es))
+                return np.stack(out)
+            layers["we_gate"] = stack_experts("blk.{}.ffn_gate.{}.weight")
+            layers["we_up"] = stack_experts("blk.{}.ffn_up.{}.weight")
+            layers["we_down"] = stack_experts("blk.{}.ffn_down.{}.weight")
+    elif cfg.mlp_type == "gated":
         layers["w_gate"] = stack("blk.{}.ffn_gate.weight", T_)
     if cfg.out_bias:
         layers["bo"] = stack("blk.{}.attn_output.bias")

@@ -45,6 +45,10 @@ class ModelConfig:
     logit_softcap: float = 0.0         # gemma2: tanh soft-capping of logits
     attn_softcap: float = 0.0          # gemma2: tanh soft-capping of scores
     qk_norm: bool = False              # qwen3/llama4-style per-head RMS on q,k
+    # mixture-of-experts (mixtral family); 0 experts = dense MLP
+    n_experts: int = 0                 # total routed experts per layer
+    n_experts_used: int = 2            # top-k experts per token
+    moe_impl: str = "auto"             # auto|einsum|scan (models/decoder.py)
     kernels: str = "auto"              # attention impl: auto|pallas|xla|interpret
 
     @property
@@ -66,6 +70,8 @@ class ModelConfig:
         d, f, l, v = self.dim, self.ffn_dim, self.n_layers, self.vocab_size
         attn = d * self.q_dim + 2 * d * self.kv_dim + self.q_dim * d
         mlp = 3 * d * f if self.mlp_type == "gated" else 2 * d * f
+        if self.n_experts:
+            mlp = self.n_experts * mlp + d * self.n_experts
         emb = v * d * (1 if self.tie_embeddings else 2)
         return l * (attn + mlp) + emb
 
@@ -75,6 +81,10 @@ class ModelConfig:
         assert self.mlp_type in ("gated", "plain")
         assert self.act in ("silu", "gelu", "gelu_tanh")
         assert self.kernels in ("auto", "pallas", "xla", "interpret")
+        assert self.moe_impl in ("auto", "einsum", "scan")
+        if self.n_experts:
+            assert self.mlp_type == "gated", "MoE is gated-MLP only"
+            assert 0 < self.n_experts_used <= self.n_experts
         return self
 
 
@@ -127,6 +137,23 @@ PRESETS = {
                  n_heads=16, n_kv_heads=16, head_dim=256, ffn_dim=24576,
                  act="gelu_tanh", emb_scale=True, tie_embeddings=True,
                  norm_weight_offset=1.0, max_seq_len=8192),
+    # mixture-of-experts family (sparse MoE; expert-parallel over "ep")
+    "tiny-moe": _mk(arch="llama", vocab_size=256, dim=64, n_layers=2,
+                    n_heads=4, n_kv_heads=2, head_dim=16, ffn_dim=128,
+                    n_experts=4, n_experts_used=2, max_seq_len=128),
+    "mixtral": _mk(arch="llama", vocab_size=32000, dim=4096, n_layers=32,
+                   n_heads=32, n_kv_heads=8, head_dim=128, ffn_dim=14336,
+                   n_experts=8, n_experts_used=2, rope_theta=1000000.0,
+                   max_seq_len=32768),
+    "mixtral:8x22b": _mk(arch="llama", vocab_size=32768, dim=6144,
+                         n_layers=56, n_heads=48, n_kv_heads=8, head_dim=128,
+                         ffn_dim=16384, n_experts=8, n_experts_used=2,
+                         rope_theta=1000000.0, max_seq_len=65536),
+    "dolphin-mixtral": _mk(arch="llama", vocab_size=32002, dim=4096,
+                           n_layers=32, n_heads=32, n_kv_heads=8,
+                           head_dim=128, ffn_dim=14336, n_experts=8,
+                           n_experts_used=2, rope_theta=1000000.0,
+                           max_seq_len=32768),
 }
 
 

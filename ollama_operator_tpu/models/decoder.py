@@ -29,6 +29,9 @@ Params pytree layout (all leaves jnp arrays; layer leaves stacked on axis 0):
     w_gate [L, D, F] (gated only)  w_up [L, D, F]  w_down [L, F, D]
     (b_up [L, F], b_down [L, D] optional)
     q_norm_w / k_norm_w [L, hd] (qk_norm archs)
+    MoE archs (cfg.n_experts > 0, mixtral family) replace w_gate/w_up/w_down:
+    router [L, D, E]
+    we_gate [L, E, D, F]  we_up [L, E, D, F]  we_down [L, E, F, D]
 """
 
 from __future__ import annotations
@@ -66,16 +69,23 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
         "wk": w(next(keys), (L, D, cfg.kv_dim)),
         "wv": w(next(keys), (L, D, cfg.kv_dim)),
         "wo": w(next(keys), (L, cfg.q_dim, D)),
-        "w_up": w(next(keys), (L, D, F)),
-        "w_down": w(next(keys), (L, F, D)),
     }
+    if cfg.n_experts:
+        E = cfg.n_experts
+        layers["router"] = w(next(keys), (L, D, E))
+        layers["we_gate"] = w(next(keys), (L, E, D, F))
+        layers["we_up"] = w(next(keys), (L, E, D, F))
+        layers["we_down"] = w(next(keys), (L, E, F, D))
+    else:
+        layers["w_up"] = w(next(keys), (L, D, F))
+        layers["w_down"] = w(next(keys), (L, F, D))
     if cfg.norm_type == "layernorm":
         layers["attn_norm_b"] = jnp.zeros((L, D), dtype)
     if not cfg.parallel_block:
         layers["mlp_norm_w"] = jnp.ones((L, D), dtype)
         if cfg.norm_type == "layernorm":
             layers["mlp_norm_b"] = jnp.zeros((L, D), dtype)
-    if cfg.mlp_type == "gated":
+    if cfg.mlp_type == "gated" and not cfg.n_experts:
         layers["w_gate"] = w(next(keys), (L, D, F))
     if cfg.attn_bias:
         layers["bq"] = jnp.zeros((L, cfg.q_dim), dtype)
@@ -121,7 +131,62 @@ def _act(cfg: ModelConfig, x):
     return jax.nn.gelu(x, approximate=True)
 
 
+def _moe_gates(cfg: ModelConfig, lp, xf):
+    """Router: top-k softmax gates scattered to a dense [N, E] fp32 matrix
+    (zeros for unselected experts). Softmax over the selected logits ==
+    full softmax renormalised over the top-k (mixtral convention)."""
+    logits = (xf @ lp["router"]).astype(jnp.float32)        # [N, E]
+    topw, topi = lax.top_k(logits, cfg.n_experts_used)      # [N, k]
+    topw = jax.nn.softmax(topw, axis=-1)
+    N = xf.shape[0]
+    gates = jnp.zeros((N, cfg.n_experts), jnp.float32)
+    return gates.at[jnp.arange(N)[:, None], topi].set(topw)
+
+
+def _moe_mlp(cfg: ModelConfig, lp, x):
+    """Sparse-MoE gated MLP (mixtral family), exact (no token dropping).
+
+    Every expert computes over all tokens and the combine applies the gate
+    (zero for unselected) — on TPU decode this costs nothing extra where it
+    matters: the step is weights-bandwidth-bound and all E experts' weights
+    stream from HBM regardless once the batch covers them. Two layouts:
+
+    - "einsum": experts batched on a leading E axis. Under GSPMD with
+      we_* sharded on the "ep" mesh axis (parallel/sharding.py) each device
+      computes only its resident experts and XLA reduces the combine over
+      ep — expert parallelism with no hand-written collective.
+    - "scan": lax.scan over experts, [N, F] working set — memory-light for
+      long single-device prefill where the einsum's [E, N, F] intermediate
+      would spike HBM.
+
+    "auto" picks einsum for small token counts (decode / short chunks) and
+    scan beyond that.
+    """
+    B, T, D = x.shape
+    xf = x.reshape(B * T, D)
+    gates = _moe_gates(cfg, lp, xf)                          # [N, E] fp32
+    impl = cfg.moe_impl
+    if impl == "auto":
+        impl = "einsum" if B * T <= 256 else "scan"
+    if impl == "einsum":
+        h = jnp.einsum("nd,edf->enf", xf, lp["we_gate"])
+        u = jnp.einsum("nd,edf->enf", xf, lp["we_up"])
+        o = jnp.einsum("enf,efd->end", _act(cfg, h) * u, lp["we_down"])
+        y = jnp.einsum("ne,end->nd", gates, o.astype(jnp.float32))
+    else:
+        def body(acc, ew):
+            wg, wu, wd, g = ew                   # [D,F] [D,F] [F,D] [N]
+            he = _act(cfg, xf @ wg) * (xf @ wu)
+            return acc + g[:, None] * (he @ wd).astype(jnp.float32), None
+        acc0 = jnp.zeros((B * T, D), jnp.float32)
+        y, _ = lax.scan(body, acc0, (lp["we_gate"], lp["we_up"],
+                                     lp["we_down"], gates.T))
+    return y.astype(x.dtype).reshape(B, T, D)
+
+
 def _mlp(cfg: ModelConfig, lp, x):
+    if cfg.n_experts:
+        return _moe_mlp(cfg, lp, x)
     if cfg.mlp_type == "gated":
         g = _act(cfg, x @ lp["w_gate"])
         u = x @ lp["w_up"]

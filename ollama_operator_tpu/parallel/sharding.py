@@ -37,6 +37,11 @@ _LAYER_SPECS: Dict[str, P] = {
     "b_down": P(None, None),
     "q_norm_w": P(None, None),
     "k_norm_w": P(None, None),
+    # MoE (mixtral family): experts on "ep", per-expert Megatron TP on "tp"
+    "router": P(None, None, None),
+    "we_gate": P(None, "ep", None, "tp"),
+    "we_up": P(None, "ep", None, "tp"),
+    "we_down": P(None, "ep", "tp", None),
 }
 
 _TOP_SPECS: Dict[str, P] = {
@@ -68,6 +73,11 @@ def resolve_specs(cfg: Optional[ModelConfig], mesh: Optional[Mesh]
     if tp > 1 and cfg.vocab_size % tp != 0:
         top.update(tok_emb=P(None, None), lm_head=P(None, None),
                    lm_head_b=P(None))
+    ep = mesh.shape.get("ep", 1)
+    if ep > 1 and cfg.n_experts % ep != 0:
+        layer.update(we_gate=P(None, None, None, "tp"),
+                     we_up=P(None, None, None, "tp"),
+                     we_down=P(None, None, "tp", None))
     return top, layer
 
 

@@ -79,6 +79,15 @@ class Engine:
         # auto-resolve to the XLA attention path whenever a real mesh is up.
         if cfg.kernels == "auto" and mesh is not None and mesh.size > 1:
             cfg = dataclasses.replace(cfg, kernels="xla")
+        # expert-parallel meshes must take the einsum MoE path: the scan
+        # impl slices the expert axis per step, which under GSPMD would
+        # all-gather every ep-sharded expert weight onto every device.
+        # (When experts don't divide ep, sharding.py replicates them and
+        # scan stays fine — mirror that divisibility rule here.)
+        if (cfg.n_experts and cfg.moe_impl == "auto" and mesh is not None
+                and mesh.shape.get("ep", 1) > 1
+                and cfg.n_experts % mesh.shape["ep"] == 0):
+            cfg = dataclasses.replace(cfg, moe_impl="einsum")
         self.cfg = cfg
         self.ecfg = ecfg
         self.mesh = mesh

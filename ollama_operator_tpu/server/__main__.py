@@ -45,6 +45,10 @@ def main(argv=None):
                    default=int(os.environ.get("TPU_SEQUENCE_PARALLEL", "1")),
                    help="sequence-parallel ways (ring attention + "
                         "sequence-sharded KV cache for long context)")
+    p.add_argument("--ep", type=int,
+                   default=int(os.environ.get("TPU_EXPERT_PARALLEL", "1")),
+                   help="expert-parallel ways (MoE experts sharded over "
+                        "the ep mesh axis; >1 only helps MoE archs)")
     p.add_argument("--profile-port", type=int,
                    default=int(os.environ.get("TPU_PROFILE_PORT", "0")),
                    help="jax.profiler server port (0 = off)")
@@ -64,12 +68,18 @@ def main(argv=None):
             jax.profiler.start_server(args.profile_port)
         devices = jax.devices()
         sp = max(1, args.sp)
-        tp = args.tp or len(devices) // sp
-        if tp * sp > 1:
+        ep = max(1, args.ep)
+        tp = args.tp or len(devices) // (sp * ep)
+        if tp < 1 or len(devices) % (tp * sp * ep) != 0:
+            p.error(f"parallelism plan tp={args.tp or 'auto'} sp={sp} "
+                    f"ep={ep} does not fit {len(devices)} devices")
+        if tp * sp * ep > 1:
             from ..parallel import MeshPlan, make_mesh
-            mesh = make_mesh(MeshPlan.for_devices(len(devices), tp=tp, sp=sp))
+            mesh = make_mesh(MeshPlan.for_devices(len(devices), tp=tp,
+                                                  sp=sp, ep=ep))
         print(f"devices: {devices}, tensor-parallel: {tp}, "
-              f"sequence-parallel: {sp}", file=sys.stderr)
+              f"sequence-parallel: {sp}, expert-parallel: {ep}",
+              file=sys.stderr)
 
     ecfg = EngineConfig(max_slots=args.max_slots,
                         max_seq_len=args.max_seq_len)

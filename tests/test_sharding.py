@@ -22,7 +22,7 @@ def tiny():
 
 def test_mesh_construction():
     mesh = make_mesh(MeshPlan(dp=2, sp=1, tp=4))
-    assert mesh.shape == {"dp": 2, "sp": 1, "tp": 4}
+    assert mesh.shape == {"dp": 2, "pp": 1, "sp": 1, "ep": 1, "tp": 4}
 
 
 def test_tp_sharded_prefill_matches_single_device():

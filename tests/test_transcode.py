@@ -70,9 +70,11 @@ def test_rope_unpermute_preserves_attention_scores():
     np.testing.assert_allclose(scores, scores_ref, rtol=1e-4, atol=1e-4)
 
 
-def write_tiny_llama_gguf(path: str, cfg, params):
+def write_tiny_llama_gguf(path: str, cfg, params, moe_merged=None):
     """Export decoder params as a llama.cpp-convention GGUF (transposed,
-    q/k re-permuted to the interleaved layout)."""
+    q/k re-permuted to the interleaved layout). For MoE configs pass
+    moe_merged=True (merged ffn_*_exps tensors) or False (legacy
+    per-expert split tensors)."""
     w = W.GGUFWriter(path)
     w.add_meta("general.architecture", "llama")
     w.add_meta("llama.block_count", cfg.n_layers)
@@ -84,6 +86,9 @@ def write_tiny_llama_gguf(path: str, cfg, params):
     w.add_meta("llama.context_length", cfg.max_seq_len)
     w.add_meta("llama.rope.freq_base", cfg.rope_theta)
     w.add_meta("llama.attention.layer_norm_rms_epsilon", cfg.norm_eps)
+    if cfg.n_experts:
+        w.add_meta("llama.expert_count", cfg.n_experts)
+        w.add_meta("llama.expert_used_count", cfg.n_experts_used)
     toks = [f"t{i}" for i in range(cfg.vocab_size)]
     w.add_meta("tokenizer.ggml.model", "llama")
     w.add_meta("tokenizer.ggml.tokens", toks)
@@ -105,9 +110,29 @@ def write_tiny_llama_gguf(path: str, cfg, params):
         w.add_tensor_f32(pre + "attn_v.weight", P(lp["wv"][i]).T)
         w.add_tensor_f32(pre + "attn_output.weight", P(lp["wo"][i]).T)
         w.add_tensor_f32(pre + "ffn_norm.weight", P(lp["mlp_norm_w"][i]))
-        w.add_tensor_f32(pre + "ffn_gate.weight", P(lp["w_gate"][i]).T)
-        w.add_tensor_f32(pre + "ffn_up.weight", P(lp["w_up"][i]).T)
-        w.add_tensor_f32(pre + "ffn_down.weight", P(lp["w_down"][i]).T)
+        if cfg.n_experts:
+            w.add_tensor_f32(pre + "ffn_gate_inp.weight",
+                             P(lp["router"][i]).T)
+            if moe_merged:
+                # ggml layout [E, F, D] (row-major) gate/up, [E, D, F] down
+                w.add_tensor_f32(pre + "ffn_gate_exps.weight",
+                                 P(lp["we_gate"][i]).transpose(0, 2, 1))
+                w.add_tensor_f32(pre + "ffn_up_exps.weight",
+                                 P(lp["we_up"][i]).transpose(0, 2, 1))
+                w.add_tensor_f32(pre + "ffn_down_exps.weight",
+                                 P(lp["we_down"][i]).transpose(0, 2, 1))
+            else:
+                for e in range(cfg.n_experts):
+                    w.add_tensor_f32(pre + f"ffn_gate.{e}.weight",
+                                     P(lp["we_gate"][i, e]).T)
+                    w.add_tensor_f32(pre + f"ffn_up.{e}.weight",
+                                     P(lp["we_up"][i, e]).T)
+                    w.add_tensor_f32(pre + f"ffn_down.{e}.weight",
+                                     P(lp["we_down"][i, e]).T)
+        else:
+            w.add_tensor_f32(pre + "ffn_gate.weight", P(lp["w_gate"][i]).T)
+            w.add_tensor_f32(pre + "ffn_up.weight", P(lp["w_up"][i]).T)
+            w.add_tensor_f32(pre + "ffn_down.weight", P(lp["w_down"][i]).T)
     w.write()
 
 
