@@ -135,7 +135,8 @@ def _moe_gates(cfg: ModelConfig, lp, xf):
     """Router: top-k softmax gates scattered to a dense [N, E] fp32 matrix
     (zeros for unselected experts). Softmax over the selected logits ==
     full softmax renormalised over the top-k (mixtral convention)."""
-    logits = (xf @ lp["router"]).astype(jnp.float32)        # [N, E]
+    logits = jnp.einsum("nd,de->ne", xf, lp["router"],
+                        preferred_element_type=jnp.float32)  # [N, E] fp32
     topw, topi = lax.top_k(logits, cfg.n_experts_used)      # [N, k]
     topw = jax.nn.softmax(topw, axis=-1)
     N = xf.shape[0]

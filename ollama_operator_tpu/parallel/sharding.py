@@ -81,6 +81,26 @@ def resolve_specs(cfg: Optional[ModelConfig], mesh: Optional[Mesh]
     return top, layer
 
 
+def experts_ep_sharded(cfg: Optional[ModelConfig], mesh: Optional[Mesh]
+                       ) -> bool:
+    """True iff resolve_specs places the expert axis on "ep" for this mesh
+    (the single source of truth for the divisibility fallback above)."""
+    if cfg is None or mesh is None or not cfg.n_experts:
+        return False
+    ep = mesh.shape.get("ep", 1)
+    return ep > 1 and cfg.n_experts % ep == 0
+
+
+def resolve_moe_impl(cfg: ModelConfig, mesh: Optional[Mesh]) -> str:
+    """The MoE impl an "auto" config must use on this mesh: the einsum
+    layout whenever the experts are actually ep-sharded — the scan layout
+    slices the expert axis per step, which under GSPMD would all-gather
+    every ep-sharded expert weight onto every device."""
+    if cfg.moe_impl == "auto" and experts_ep_sharded(cfg, mesh):
+        return "einsum"
+    return cfg.moe_impl
+
+
 def params_pspec_tree(params: Dict[str, Any],
                       cfg: Optional[ModelConfig] = None,
                       mesh: Optional[Mesh] = None) -> Dict[str, Any]:

@@ -35,7 +35,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..models.config import ModelConfig
 from ..models import decoder
 from ..ops import sampling
-from ..parallel.sharding import kv_cache_pspec, params_sharding_tree
+from ..parallel.sharding import (kv_cache_pspec, params_sharding_tree,
+                                 resolve_moe_impl)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -79,15 +80,9 @@ class Engine:
         # auto-resolve to the XLA attention path whenever a real mesh is up.
         if cfg.kernels == "auto" and mesh is not None and mesh.size > 1:
             cfg = dataclasses.replace(cfg, kernels="xla")
-        # expert-parallel meshes must take the einsum MoE path: the scan
-        # impl slices the expert axis per step, which under GSPMD would
-        # all-gather every ep-sharded expert weight onto every device.
-        # (When experts don't divide ep, sharding.py replicates them and
-        # scan stays fine — mirror that divisibility rule here.)
-        if (cfg.n_experts and cfg.moe_impl == "auto" and mesh is not None
-                and mesh.shape.get("ep", 1) > 1
-                and cfg.n_experts % mesh.shape["ep"] == 0):
-            cfg = dataclasses.replace(cfg, moe_impl="einsum")
+        if cfg.n_experts:
+            cfg = dataclasses.replace(
+                cfg, moe_impl=resolve_moe_impl(cfg, mesh))
         self.cfg = cfg
         self.ecfg = ecfg
         self.mesh = mesh
