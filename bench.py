@@ -66,6 +66,7 @@ def main() -> None:
     from ollama_operator_tpu.runtime.engine import Engine, EngineConfig
 
     model = os.environ.get("BENCH_MODEL", "phi")
+    dtype = os.environ.get("BENCH_DTYPE", "int8")
     slots = int(os.environ.get("BENCH_SLOTS", "8"))
     steps = int(os.environ.get("BENCH_STEPS", "64"))
     seq = int(os.environ.get("BENCH_SEQ", "1024"))
@@ -79,7 +80,17 @@ def main() -> None:
     t0 = time.perf_counter()
     params = decoder.init_params(cfg, jax.random.key(0))
     jax.block_until_ready(params)
-    log(f"params init ({cfg.n_params/1e9:.2f}B) in "
+    if dtype == "int8":
+        if cfg.n_experts:
+            dtype = "bfloat16"   # MoE expert stacks serve dense this round
+        else:
+            # weight-only int8 serving (ops/quant.py): the production
+            # default — decode is HBM-bound, so halving weight bytes
+            # cuts the weight-streaming share of the step
+            from ollama_operator_tpu.ops.quant import quantize_params
+            params = quantize_params(params)   # on-device, jitted
+            jax.block_until_ready(params)
+    log(f"params init ({cfg.n_params/1e9:.2f}B, serve dtype={dtype}) in "
         f"{time.perf_counter()-t0:.1f}s")
 
     mesh = None
@@ -91,8 +102,10 @@ def main() -> None:
         mesh = make_mesh(MeshPlan.for_devices(len(devs), tp=tp))
         log(f"mesh: {dict(mesh.shape)}")
 
+    chunk = int(os.environ.get("BENCH_DECODE_CHUNK", "8"))
     eng = Engine(cfg, params, mesh=mesh,
-                 ecfg=EngineConfig(max_slots=slots, max_seq_len=seq))
+                 ecfg=EngineConfig(max_slots=slots, max_seq_len=seq,
+                                   decode_chunk=chunk))
 
     rng = np.random.default_rng(0)
     prompts = rng.integers(1, cfg.vocab_size, size=(slots, prompt_len),
@@ -114,19 +127,19 @@ def main() -> None:
     ttft_p50_ms = float(np.median(ttfts) * 1e3)
 
     t0 = time.perf_counter()
-    eng.decode()
+    eng.decode_n()
     decode_compile_s = time.perf_counter() - t0
-    log(f"decode compile+run: {decode_compile_s:.1f}s")
-    for _ in range(3):
-        eng.decode()
+    log(f"decode compile+run: {decode_compile_s:.1f}s (chunk={chunk})")
+    eng.decode_n()
 
+    calls = max(1, steps // chunk)
     t0 = time.perf_counter()
-    for _ in range(steps):
-        toks = eng.decode()
-    toks = np.asarray(toks)  # host sync happens every step inside decode()
+    for _ in range(calls):
+        toks = eng.decode_n()   # [chunk, B], one dispatch+sync per call
     dt = time.perf_counter() - t0
-    tok_s = steps * slots / dt
-    per_step_ms = dt / steps * 1e3
+    n_steps = calls * chunk
+    tok_s = n_steps * slots / dt
+    per_step_ms = dt / n_steps * 1e3
 
     metric = f"{model}_decode_tok_s_b{slots}"
     baseline = load_baseline(metric)
@@ -140,6 +153,7 @@ def main() -> None:
         "decode_step_ms": round(per_step_ms, 2),
         "slots": slots,
         "platform": devs[0].platform,
+        "dtype": dtype,
         "n_devices": len(devs),
     }))
 

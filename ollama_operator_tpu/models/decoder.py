@@ -43,12 +43,21 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..ops.attention import cached_attention, causal_mask, chunk_attention
+from ..ops import quant as Q
+from ..ops.attention import (cached_attention, causal_mask, chunk_attention,
+                             resolve_kernels)
 from ..ops.norms import layer_norm, rms_norm
 from ..ops.rope import apply_rope, rope_angles
 from .config import ModelConfig
 
 Params = Dict[str, Any]
+
+
+def _mm(cfg: ModelConfig, x, w, out_dtype=None):
+    """Linear against a dense array or an int8 quantized dict leaf
+    (ops/quant.py); the pallas fused path follows the attention kernels
+    switch so it never runs inside a GSPMD mesh program."""
+    return Q.matmul(x, w, out_dtype, kernels=resolve_kernels(cfg.kernels))
 
 
 # --------------------------------------------------------------------------
@@ -189,13 +198,13 @@ def _mlp(cfg: ModelConfig, lp, x):
     if cfg.n_experts:
         return _moe_mlp(cfg, lp, x)
     if cfg.mlp_type == "gated":
-        g = _act(cfg, x @ lp["w_gate"])
-        u = x @ lp["w_up"]
-        return (g * u) @ lp["w_down"]
-    u = x @ lp["w_up"]
+        g = _act(cfg, _mm(cfg, x, lp["w_gate"]))
+        u = _mm(cfg, x, lp["w_up"])
+        return _mm(cfg, g * u, lp["w_down"])
+    u = _mm(cfg, x, lp["w_up"])
     if "b_up" in lp:
         u = u + lp["b_up"]
-    d = _act(cfg, u) @ lp["w_down"]
+    d = _mm(cfg, _act(cfg, u), lp["w_down"])
     if "b_down" in lp:
         d = d + lp["b_down"]
     return d
@@ -203,9 +212,9 @@ def _mlp(cfg: ModelConfig, lp, x):
 
 def _qkv(cfg: ModelConfig, lp, h, cos, sin):
     B, T, _ = h.shape
-    q = h @ lp["wq"]
-    k = h @ lp["wk"]
-    v = h @ lp["wv"]
+    q = _mm(cfg, h, lp["wq"])
+    k = _mm(cfg, h, lp["wk"])
+    v = _mm(cfg, h, lp["wv"])
     if "bq" in lp:
         q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
     q = q.reshape(B, T, cfg.n_heads, cfg.head_dim)
@@ -219,8 +228,8 @@ def _qkv(cfg: ModelConfig, lp, h, cos, sin):
     return q, k, v
 
 
-def _proj_out(lp, attn_out, B, T):
-    o = attn_out.reshape(B, T, -1) @ lp["wo"]
+def _proj_out(cfg, lp, attn_out, B, T):
+    o = _mm(cfg, attn_out.reshape(B, T, -1), lp["wo"])
     if "bo" in lp:
         o = o + lp["bo"]
     return o
@@ -249,7 +258,7 @@ def _block_chunk(cfg: ModelConfig, lp, x, cos, sin, mask, scale,
         attn = chunk_attention(cfg, q, k, v, mask, scale)
     else:
         attn = attn_fn(q, k, v)
-    attn = _proj_out(lp, attn, B, T)
+    attn = _proj_out(cfg, lp, attn, B, T)
     return _residual(cfg, lp, x, h, attn), (k, v)
 
 
@@ -279,7 +288,7 @@ def _block_cached(cfg: ModelConfig, lp, x, cos, sin, k_cache, v_cache,
                                 scale)
     else:
         attn = attn_fn(q, k_cache, v_cache, write_pos)
-    attn = _proj_out(lp, attn, B, T)
+    attn = _proj_out(cfg, lp, attn, B, T)
     return _residual(cfg, lp, x, h, attn), k_cache, v_cache
 
 
@@ -292,9 +301,12 @@ def _embed(cfg: ModelConfig, params: Params, tokens):
 
 def _unembed(cfg: ModelConfig, params: Params, x):
     x = _norm(cfg, x, params["out_norm_w"], params.get("out_norm_b"))
-    head = params["tok_emb"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = jnp.einsum("btd,dv->btv", x, head,
-                        preferred_element_type=jnp.float32)
+    if not cfg.tie_embeddings and Q.is_quantized(params["lm_head"]):
+        logits = _mm(cfg, x, params["lm_head"], out_dtype=jnp.float32)
+    else:
+        head = params["tok_emb"].T if cfg.tie_embeddings else params["lm_head"]
+        logits = jnp.einsum("btd,dv->btv", x, head,
+                            preferred_element_type=jnp.float32)
     if "lm_head_b" in params:
         logits = logits + params["lm_head_b"].astype(jnp.float32)
     if cfg.logit_softcap:

@@ -1,0 +1,96 @@
+"""Fused dequant-matmul Pallas kernel (weight-only int8, W8A16).
+
+Decode matmuls are HBM-bound: the win is streaming int8 weight tiles
+(half the bytes of bf16) into VMEM and dequantizing in-register right
+before the MXU dot — the bf16 weight tensor never exists in HBM. The
+XLA grouped-einsum path (ops/quant.qmm) is the portable fallback; this
+kernel is the single-chip fast path, dispatched through the same
+kernels switch as the flash-attention kernels (ops/attention.py).
+
+Grid (oi, ki), ki innermost: each step loads an (bk, bo) int8 tile plus
+its (bk/g, bo) scales, dequantizes to one bf16 tile in VMEM, and
+accumulates x_tile @ w_tile into an f32 scratch that persists across ki.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..quant import GROUP, qmm
+
+_BLOCKS = (512, 256, 128, 64, 32)
+
+
+def _pick(n: int, cap: int, multiple: int = 1):
+    for b in _BLOCKS:
+        if b <= cap and n % b == 0 and b % multiple == 0:
+            return b
+    return None
+
+
+def _kernel(x_ref, q_ref, s_ref, o_ref, acc_ref, *, nk: int, g: int):
+    ki = pl.program_id(1)
+
+    @pl.when(ki == 0)
+    def init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    xb = x_ref[...]                                   # [B, bk] bf16
+    qb = q_ref[...]                                   # [bk, bo] int8
+    sb = s_ref[...]                                   # [bk/g, bo] f32
+    bk, bo = qb.shape
+    w = qb.astype(jnp.float32).reshape(bk // g, g, bo) * sb[:, None, :]
+    w = w.reshape(bk, bo)
+    acc_ref[...] += jax.lax.dot_general(
+        xb.astype(jnp.float32), w, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def flush():
+        o_ref[...] = acc_ref[...]
+
+
+def qmm_pallas(x: jax.Array, q: jax.Array, s: jax.Array,
+               interpret: bool = False) -> jax.Array:
+    """x [B, K] @ dequant(q [K, O], s [K/g, O]) → [B, O] f32.
+
+    Falls back to the XLA grouped path when the shapes don't tile cleanly
+    (odd dims, tiny K/O) — callers never need to care.
+    """
+    B, K = x.shape
+    K2, O = q.shape
+    G = s.shape[0]
+    g = K // G
+    bk = _pick(K, 512, multiple=g) if g in (16, 32, 64, 128) else None
+    bo = _pick(O, 512)
+    lanes_ok = interpret or (O % 128 == 0 and bo is not None and
+                             bo % 128 == 0)
+    if bk is None or bo is None or not lanes_ok:
+        return qmm(x, {"q": q, "s": s}, out_dtype=jnp.float32)
+
+    Bp = max(8, B)
+    if Bp != B:
+        x = jnp.pad(x, ((0, Bp - B), (0, 0)))
+    nk = K // bk
+
+    out = pl.pallas_call(
+        functools.partial(_kernel, nk=nk, g=g),
+        grid=(O // bo, nk),
+        in_specs=[
+            pl.BlockSpec((Bp, bk), lambda oi, ki: (0, ki)),
+            pl.BlockSpec((bk, bo), lambda oi, ki: (ki, oi)),
+            pl.BlockSpec((bk // g, bo), lambda oi, ki: (ki, oi)),
+        ],
+        out_specs=pl.BlockSpec((Bp, bo), lambda oi, ki: (0, oi)),
+        out_shape=jax.ShapeDtypeStruct((Bp, O), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((Bp, bo), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(x, q, s.astype(jnp.float32))
+    return out[:B]

@@ -1,0 +1,162 @@
+"""Weight-only int8 quantization for serving (W8A16-style).
+
+The reference serves GGUF-quantized weights through llama.cpp's CPU/GPU
+dequant kernels inside the delegated ollama image (SURVEY.md §2.2). The
+TPU-native equivalent keeps weights **quantized in HBM** and dequantizes on
+the fly inside the matmul — decode is HBM-bandwidth-bound, so halving the
+weight bytes roughly doubles decode throughput and is what lets llama2:70b
+fit comfortably across a v5e-16 (BASELINE.md north star).
+
+Representation: a quantized linear is a dict leaf in the params pytree —
+
+    {"q": int8 [..., K, O],  "s": f32 [..., K/g, O]}
+
+symmetric, group-wise along the contracted (input) axis with group size
+``g`` = 32, llama.cpp's q8_0 block size — so transcoding q8_0 weights onto
+this grid adds (almost) no error beyond the original quantization, and
+finer GGUF grids (q4_*) are strictly refined by it.
+
+Two matmul paths:
+- ``qmm``: pure-XLA grouped partial einsum — correct on any backend and
+  under GSPMD (the int8→bf16 convert fuses into the dot's operand stream).
+- ``ops/pallas/quant.py``: fused dequant-matmul kernel for single-chip
+  decode, dispatched via the same kernels switch as attention.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+GROUP = 32
+
+# matmul leaves worth quantizing (the big projections). tok_emb stays dense
+# (it is a gather, not a matmul); MoE expert stacks stay dense this round.
+QUANT_LAYER_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+QUANT_TOP_KEYS = ("lm_head",)
+
+
+def is_quantized(w: Any) -> bool:
+    return isinstance(w, dict) and "q" in w and "s" in w
+
+
+def quantize_groupwise(w, group: int = GROUP) -> Dict[str, Any]:
+    """Symmetric int8 per ``group`` along the second-to-last (input) axis.
+
+    w [..., K, O] float → {"q" int8 [..., K, O], "s" f32 [..., K/g, O]}.
+    jax arrays quantize on-device (jitted — milliseconds even for 70B
+    leaves); numpy stays on host for the memory-bounded transcode path.
+    """
+    if isinstance(w, jax.Array):
+        return _quantize_jax(w, group)
+    w = np.asarray(w, np.float32)
+    *lead, K, O = w.shape
+    assert K % group == 0, f"in-dim {K} must divide group {group}"
+    wr = w.reshape(*lead, K // group, group, O)
+    amax = np.abs(wr).max(axis=-2, keepdims=True)          # [..., K/g, 1, O]
+    s = (amax / 127.0).astype(np.float32)
+    q = np.rint(np.where(s > 0, wr / np.maximum(s, 1e-30), 0.0))
+    q = np.clip(q, -127, 127).astype(np.int8)
+    return {"q": q.reshape(*lead, K, O), "s": s[..., 0, :]}
+
+
+@jax.jit
+def _quantize_jax_impl(w, group: int = GROUP):
+    *lead, K, O = w.shape
+    wr = w.astype(jnp.float32).reshape(*lead, K // group, group, O)
+    amax = jnp.max(jnp.abs(wr), axis=-2, keepdims=True)
+    s = amax / 127.0
+    q = jnp.round(jnp.where(s > 0, wr / jnp.maximum(s, 1e-30), 0.0))
+    q = jnp.clip(q, -127, 127).astype(jnp.int8)
+    return {"q": q.reshape(*lead, K, O), "s": s[..., 0, :]}
+
+
+def _quantize_jax(w: jax.Array, group: int = GROUP) -> Dict[str, Any]:
+    assert w.shape[-2] % group == 0
+    assert group == GROUP, "jit path is specialised to the default group"
+    return _quantize_jax_impl(w)
+
+
+def dequantize_groupwise(qw: Dict[str, Any]) -> jnp.ndarray:
+    """Reference inverse of quantize_groupwise (f32)."""
+    q, s = jnp.asarray(qw["q"]), jnp.asarray(qw["s"])
+    *lead, K, O = q.shape
+    G = s.shape[-2]
+    qr = q.reshape(*lead, G, K // G, O).astype(jnp.float32)
+    return (qr * s[..., :, None, :]).reshape(*lead, K, O)
+
+
+def qmm(x: jax.Array, qw: Dict[str, Any],
+        out_dtype: Optional[Any] = None) -> jax.Array:
+    """x [..., K] @ dequant(qw [K, O]) with group-wise scales.
+
+    Grouped partial formulation so the scale multiply stays outside the
+    inner dot (XLA fuses the int8→bf16 convert into the dot's read stream;
+    the [..., K/g, O] partial contracts immediately):
+
+        y[.., o] = Σ_G s[G, o] · Σ_{k∈G} x[.., k] · q[k, o]
+    """
+    q, s = qw["q"], qw["s"]
+    K, O = q.shape
+    G = s.shape[0]
+    g = K // G
+    xr = x.reshape(*x.shape[:-1], G, g)
+    qr = q.reshape(G, g, O)
+    partial = jnp.einsum("...Gg,Ggo->...Go", xr, qr.astype(x.dtype),
+                         preferred_element_type=jnp.float32)
+    y = jnp.einsum("...Go,Go->...o", partial, s)
+    return y.astype(out_dtype or x.dtype)
+
+
+def matmul(x: jax.Array, w: Any, out_dtype: Optional[Any] = None,
+           kernels: str = "xla") -> jax.Array:
+    """Unified linear: dense jnp array or quantized dict weight.
+
+    ``kernels`` follows ops/attention.resolve_kernels semantics — "pallas"
+    routes 2D-reshapeable quantized matmuls through the fused kernel.
+    """
+    if not is_quantized(w):
+        y = x @ w
+        return y.astype(out_dtype) if out_dtype is not None else y
+    if kernels in ("pallas", "interpret"):
+        from .pallas.quant import qmm_pallas
+        lead = x.shape[:-1]
+        x2 = x.reshape(-1, x.shape[-1])
+        y = qmm_pallas(x2, w["q"], w["s"], interpret=(kernels == "interpret"))
+        return y.reshape(*lead, -1).astype(out_dtype or x.dtype)
+    return qmm(x, w, out_dtype)
+
+
+def quantize_params(params: Dict[str, Any], group: int = GROUP,
+                    keys_layer=QUANT_LAYER_KEYS, keys_top=QUANT_TOP_KEYS
+                    ) -> Dict[str, Any]:
+    """Convert the big matmul leaves of a decoder param tree to int8.
+
+    Works on numpy (host) or jax (on-device) arrays; stacked [L, ...]
+    layer leaves quantize along their input axis, which is second-to-last
+    either way.
+    """
+    out: Dict[str, Any] = {}
+    for k, v in params.items():
+        if k == "layers":
+            out[k] = {
+                lk: (quantize_groupwise(lv, group)
+                     if lk in keys_layer else lv)
+                for lk, lv in v.items()
+            }
+        elif k in keys_top:
+            out[k] = quantize_groupwise(v, group)
+        else:
+            out[k] = v
+    return out
+
+
+def quantized_bytes(params: Dict[str, Any]) -> int:
+    """HBM footprint of a (possibly partly quantized) param tree."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(params):
+        total += leaf.size * leaf.dtype.itemsize
+    return total

@@ -50,7 +50,7 @@ def split_stages(layer_params, pp: int):
     """Reshape every stacked layer leaf [L, ...] → [pp, L/pp, ...]."""
     def r(a):
         L = a.shape[0]
-        assert L % pp == 0, f"n_layers {L} must divide pp={pp}"
+        assert L % pp == 0, f"pp={pp} must divide n_layers {L}"
         return a.reshape(pp, L // pp, *a.shape[1:])
     return jax.tree_util.tree_map(r, layer_params)
 
@@ -80,7 +80,7 @@ def forward_with_cache_pp(params: Params, cfg: ModelConfig,
     B, T = tokens.shape
     L = cfg.n_layers
     M = n_microbatches or pp
-    assert B % M == 0, f"batch {B} must divide microbatches {M}"
+    assert B % M == 0, f"microbatches {M} must divide batch {B}"
     assert M >= pp, f"need at least pp={pp} microbatches, got {M}"
     b = B // M
     Lpp = L // pp

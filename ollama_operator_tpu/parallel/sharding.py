@@ -101,6 +101,22 @@ def resolve_moe_impl(cfg: ModelConfig, mesh: Optional[Mesh]) -> str:
     return cfg.moe_impl
 
 
+def _leaf_spec(spec: P, v: Any, mesh: Optional[Mesh]):
+    """A quantized dict leaf {"q", "s"} shares its dense spec: q has the
+    dense shape and the group axis of s is K/g at the same position, so the
+    same PartitionSpec usually partitions both. When a scale dim is too
+    small to divide its mesh axis (tiny K/g), that axis replicates for s
+    only — XLA still partials the dot over the sharded q rows."""
+    if not (isinstance(v, dict) and "q" in v and "s" in v):
+        return spec
+    s_shape = v["s"].shape
+    s_spec = []
+    for i, ax in enumerate(spec):
+        size = mesh.shape.get(ax, 1) if (mesh is not None and ax) else 1
+        s_spec.append(ax if ax and s_shape[i] % size == 0 else None)
+    return {"q": spec, "s": P(*s_spec)}
+
+
 def params_pspec_tree(params: Dict[str, Any],
                       cfg: Optional[ModelConfig] = None,
                       mesh: Optional[Mesh] = None) -> Dict[str, Any]:
@@ -108,9 +124,10 @@ def params_pspec_tree(params: Dict[str, Any],
     out: Dict[str, Any] = {}
     for k, v in params.items():
         if k == "layers":
-            out[k] = {lk: layer[lk] for lk in v}
+            out[k] = {lk: _leaf_spec(layer[lk], lv, mesh)
+                      for lk, lv in v.items()}
         else:
-            out[k] = top[k]
+            out[k] = _leaf_spec(top[k], v, mesh)
     return out
 
 

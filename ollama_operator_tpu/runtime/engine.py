@@ -46,6 +46,11 @@ class EngineConfig:
     cache_dtype: Any = jnp.bfloat16
     min_prefill_bucket: int = 64
     repeat_last_n: int = 64  # Ollama default penalty window (doc only for now)
+    # decode steps per host round-trip: a lax.scan of this many steps runs
+    # as ONE device program, so dispatch/sync latency (large under the
+    # remote-TPU tunnel; nonzero everywhere) amortises across the chunk.
+    # Streaming granularity and admission latency grow with it.
+    decode_chunk: int = 8
 
 
 def prefill_buckets(max_seq_len: int, min_bucket: int):
@@ -182,9 +187,8 @@ class Engine:
             last_tokens = last_tokens.at[slot].set(tok)
             return k_cache, v_cache, lengths, counts, last_tokens
 
-        @partial(jax.jit, donate_argnums=(1, 2, 3, 4, 5, 7))
-        def _decode(params, k_cache, v_cache, lengths, counts, last_tokens,
-                    sp, keys, active):
+        def _decode_body(params, k_cache, v_cache, lengths, counts,
+                         last_tokens, sp, keys, active):
             logits, k_cache, v_cache = step_impl(
                 params, tokens=last_tokens[:, None], k_cache=k_cache,
                 v_cache=v_cache, lengths=lengths)
@@ -194,7 +198,37 @@ class Engine:
             counts = counts.at[jnp.arange(B), toks].add(active)
             lengths = lengths + active
             last_tokens = jnp.where(active == 1, toks, last_tokens)
+            return toks, k_cache, v_cache, lengths, counts, last_tokens
+
+        @partial(jax.jit, donate_argnums=(1, 2, 3, 4, 5, 7))
+        def _decode(params, k_cache, v_cache, lengths, counts, last_tokens,
+                    sp, keys, active):
+            (toks, k_cache, v_cache, lengths, counts,
+             last_tokens) = _decode_body(params, k_cache, v_cache, lengths,
+                                         counts, last_tokens, sp, keys,
+                                         active)
             return toks, k_cache, v_cache, lengths, counts, last_tokens, keys
+
+        @partial(jax.jit, static_argnums=(9,),
+                 donate_argnums=(1, 2, 3, 4, 5, 7))
+        def _decode_n(params, k_cache, v_cache, lengths, counts, last_tokens,
+                      sp, keys, active, n):
+            """n decode steps as ONE device program (lax.scan) — a single
+            dispatch + host sync per n tokens per slot."""
+            def step(carry, _):
+                k_cache, v_cache, lengths, counts, last_tokens = carry
+                (toks, k_cache, v_cache, lengths, counts,
+                 last_tokens) = _decode_body(params, k_cache, v_cache,
+                                             lengths, counts, last_tokens,
+                                             sp, keys, active)
+                return (k_cache, v_cache, lengths, counts,
+                        last_tokens), toks
+
+            carry = (k_cache, v_cache, lengths, counts, last_tokens)
+            carry, toks_n = jax.lax.scan(step, carry, None, length=n)
+            k_cache, v_cache, lengths, counts, last_tokens = carry
+            return (toks_n, k_cache, v_cache, lengths, counts, last_tokens,
+                    keys)
 
         @partial(jax.jit, donate_argnums=(0, 1, 2))
         def _release(lengths, counts, last_tokens, slot):
@@ -206,6 +240,7 @@ class Engine:
         self._prefill_fn = _prefill
         self._insert_fn = _insert
         self._decode_fn = _decode
+        self._decode_n_fn = _decode_n
         self._release_fn = _release
 
     # ------------------------------------------------------------------
@@ -281,6 +316,23 @@ class Engine:
             self.counts, self.last_tokens, self.sp, self.keys,
             self._active_dev)
         return np.asarray(toks)
+
+    def decode_n(self, n: Optional[int] = None) -> np.ndarray:
+        """n decode steps in one device program; returns tokens [n, B].
+
+        One dispatch + one host sync per call — the per-step host
+        round-trip (expensive under a remote-TPU tunnel) amortises over
+        the chunk. Chunk semantics are identical to n decode() calls.
+        """
+        n = n or self.ecfg.decode_chunk
+        if n == 1:
+            return self.decode()[None]
+        (toks_n, self.k_cache, self.v_cache, self.lengths, self.counts,
+         self.last_tokens, self.keys) = self._decode_n_fn(
+            self.params, self.k_cache, self.v_cache, self.lengths,
+            self.counts, self.last_tokens, self.sp, self.keys,
+            self._active_dev, n)
+        return np.asarray(toks_n)
 
     def release(self, slot: int):
         self.active[slot] = False

@@ -254,15 +254,24 @@ class Scheduler:
                 self._finish(slot, req, "cancelled")
         if self.n_active == 0:
             return
-        toks = self.engine.decode()
+        # chunked decode: ecfg.decode_chunk steps per device round-trip.
+        # A slot that stops mid-chunk has its remaining rows discarded
+        # (_running[slot] goes None); the over-decoded cache entries are
+        # zeroed by release().
+        toks_n = self.engine.decode_n()
         self._consecutive_failures = 0
-        for slot, req in enumerate(list(self._running)):
-            if req is None:
-                continue
-            if not self._emit(req, int(toks[slot])):
-                self._finish(slot, req, "stop")
-            # host-side length tracking (no device sync): the cache holds
-            # the prompt plus one entry per decode step taken so far
-            elif (req.stats.n_prompt + req.stats.n_generated
-                  >= self.engine.max_seq - 1):
-                self._finish(slot, req, "length")
+        for row in np.asarray(toks_n):
+            any_running = False
+            for slot, req in enumerate(list(self._running)):
+                if req is None:
+                    continue
+                any_running = True
+                if not self._emit(req, int(row[slot])):
+                    self._finish(slot, req, "stop")
+                # host-side length tracking (no device sync): the cache
+                # holds the prompt plus one entry per decode step so far
+                elif (req.stats.n_prompt + req.stats.n_generated
+                      >= self.engine.max_seq - 1):
+                    self._finish(slot, req, "length")
+            if not any_running:
+                break

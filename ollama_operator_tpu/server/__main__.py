@@ -33,7 +33,7 @@ def main(argv=None):
                    help="registry/store mode: no inference engine")
     p.add_argument("--dtype", default=os.environ.get("TPU_ENGINE_DTYPE",
                                                      "bfloat16"),
-                   choices=["bfloat16", "float32"])
+                   choices=["bfloat16", "float32", "int8"])
     p.add_argument("--max-slots", type=int,
                    default=int(os.environ.get("TPU_MAX_SLOTS", "8")))
     p.add_argument("--max-seq-len", type=int,

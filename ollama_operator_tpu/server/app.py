@@ -155,7 +155,7 @@ class ModelManager:
                 raise ApiError(500, f"model {name.short} has no model layer")
             digest = self.store.model_digest(name) or ""
             import ml_dtypes
-            dt = {"bfloat16": ml_dtypes.bfloat16,
+            dt = {"bfloat16": ml_dtypes.bfloat16, "int8": ml_dtypes.bfloat16,
                   "float32": np.float32}[self.engine_dtype]
             # parse/transcode the new model (host memory) BEFORE tearing the
             # old one down: a corrupt pull must not leave the server empty
@@ -172,6 +172,11 @@ class ModelManager:
                 self.loaded = None
             import jax.numpy as jnp
             import jax
+            if self.engine_dtype == "int8":
+                # weight-only quantization: int8 weights stay quantized in
+                # HBM; dequant fuses into the matmuls (ops/quant.py)
+                from ..ops.quant import quantize_params
+                params = quantize_params(params)
             params = jax.tree_util.tree_map(jnp.asarray, params)
             ecfg = self.ecfg or EngineConfig(
                 max_seq_len=min(cfg.max_seq_len,

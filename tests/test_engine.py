@@ -114,3 +114,28 @@ def test_prompt_too_long_rejected():
         assert False, "expected ValueError"
     except ValueError:
         pass
+
+
+def test_decode_n_matches_single_steps():
+    """decode_n(k) must produce exactly the tokens of k decode() calls."""
+    import jax.numpy as jnp
+    from ollama_operator_tpu.models import config as cfglib
+    from ollama_operator_tpu.models import decoder as dec
+    from ollama_operator_tpu.runtime.engine import (Engine, EngineConfig,
+                                                    SlotOptions)
+    cfg = cfglib.PRESETS["tiny"]
+    params = dec.init_params(cfg, jax.random.PRNGKey(4), dtype=jnp.float32)
+    ecfg = EngineConfig(max_slots=2, max_seq_len=64, min_prefill_bucket=8,
+                        cache_dtype=jnp.float32)
+    prompt = np.arange(1, 10, dtype=np.int32)
+    opts = SlotOptions(temperature=0.7, seed=123)
+
+    e1 = Engine(cfg, params, ecfg=ecfg)
+    e1.admit(0, prompt, opts)
+    singles = [int(e1.decode()[0]) for _ in range(6)]
+
+    e2 = Engine(cfg, params, ecfg=ecfg)
+    e2.admit(0, prompt, opts)
+    chunk = e2.decode_n(6)
+    assert chunk.shape == (6, 2)
+    assert [int(t[0]) for t in chunk] == singles

@@ -110,15 +110,15 @@ def test_engine_failure_fails_requests_not_thread():
     cfg, params, eng, sched = make_stack(slots=2)
     try:
         calls = {"n": 0}
-        real_decode = eng.decode
+        real_decode_n = eng.decode_n
 
-        def flaky_decode():
+        def flaky_decode_n(n=None):
             calls["n"] += 1
             if calls["n"] == 1:
                 raise RuntimeError("injected XLA error")
-            return real_decode()
+            return real_decode_n(n)
 
-        eng.decode = flaky_decode
+        eng.decode_n = flaky_decode_n
         r1 = sched.submit(np.array([1, 2], np.int32), GREEDY, max_tokens=4)
         try:
             toks = list(r1.tokens())
@@ -138,10 +138,10 @@ def test_engine_failure_fails_requests_not_thread():
 def test_repeated_engine_failures_mark_broken():
     cfg, params, eng, sched = make_stack(slots=1)
     try:
-        def always_fail():
+        def always_fail(n=None):
             raise RuntimeError("dead engine")
 
-        eng.decode = always_fail
+        eng.decode_n = always_fail
         import pytest
         from ollama_operator_tpu.runtime.scheduler import (SchedulerBroken,
                                                            SchedulerBusy)
