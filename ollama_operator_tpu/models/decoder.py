@@ -44,8 +44,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..ops import quant as Q
-from ..ops.attention import (cached_attention, causal_mask, chunk_attention,
-                             resolve_kernels)
+from ..ops.attention import cached_attention, causal_mask, chunk_attention
 from ..ops.norms import layer_norm, rms_norm
 from ..ops.rope import apply_rope, rope_angles
 from .config import ModelConfig
@@ -55,9 +54,12 @@ Params = Dict[str, Any]
 
 def _mm(cfg: ModelConfig, x, w, out_dtype=None):
     """Linear against a dense array or an int8 quantized dict leaf
-    (ops/quant.py); the pallas fused path follows the attention kernels
-    switch so it never runs inside a GSPMD mesh program."""
-    return Q.matmul(x, w, out_dtype, kernels=resolve_kernels(cfg.kernels))
+    (ops/quant.py). The XLA grouped path wins on v5e for full-model decode
+    (the fused pallas kernel measured slower: 137 vs 147 tok/s on phi), so
+    "auto" resolves to XLA here; an explicit kernels="pallas"/"interpret"
+    config still routes through the kernel."""
+    mode = cfg.kernels if cfg.kernels in ("pallas", "interpret") else "xla"
+    return Q.matmul(x, w, out_dtype, kernels=mode)
 
 
 # --------------------------------------------------------------------------
@@ -263,12 +265,16 @@ def _block_chunk(cfg: ModelConfig, lp, x, cos, sin, mask, scale,
 
 
 def _block_cached(cfg: ModelConfig, lp, x, cos, sin, k_cache, v_cache,
-                  write_pos, mask, scale, attn_fn=None, write_fn=None):
+                  write_pos, mask, scale, attn_fn=None, write_fn=None,
+                  attn_len: Optional[int] = None):
     """One layer with a head-first KV cache [B, KvH, S, hd]. ``write_pos``
     [B, T] are absolute slots for the new tokens' K/V. Returns
     (x, k_cache, v_cache) updated. ``write_fn(kc, vc, k, v, pos)`` /
     ``attn_fn(q, kc, vc, pos)`` override the cache write and attention core
-    (the sequence-parallel path injects shard-local variants)."""
+    (the sequence-parallel path injects shard-local variants). ``attn_len``
+    statically truncates the attended cache prefix (see forward_with_cache)
+    — the slice fuses into the attention reads, so slots beyond it cost no
+    HBM traffic."""
     B, T, _ = x.shape
     h = _norm(cfg, x, lp["attn_norm_w"], lp.get("attn_norm_b"))
     q, k, v = _qkv(cfg, lp, h, cos, sin)
@@ -284,7 +290,11 @@ def _block_cached(cfg: ModelConfig, lp, x, cos, sin, k_cache, v_cache,
     else:
         k_cache, v_cache = write_fn(k_cache, v_cache, k, v, write_pos)
     if attn_fn is None:
-        attn = cached_attention(cfg, q, k_cache, v_cache, mask, write_pos,
+        kc_view, vc_view = k_cache, v_cache
+        if attn_len is not None and attn_len < k_cache.shape[2]:
+            kc_view = k_cache[:, :, :attn_len, :]
+            vc_view = v_cache[:, :, :attn_len, :]
+        attn = cached_attention(cfg, q, kc_view, vc_view, mask, write_pos,
                                 scale)
     else:
         attn = attn_fn(q, k_cache, v_cache, write_pos)
@@ -350,7 +360,8 @@ def prefill_chunk(params: Params, cfg: ModelConfig, tokens: jax.Array,
 
 def forward_with_cache(params: Params, cfg: ModelConfig, tokens: jax.Array,
                        k_cache: jax.Array, v_cache: jax.Array,
-                       lengths: jax.Array
+                       lengths: jax.Array,
+                       attn_len: Optional[int] = None
                        ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Extend sequences that already have ``lengths`` cached tokens.
 
@@ -358,10 +369,16 @@ def forward_with_cache(params: Params, cfg: ModelConfig, tokens: jax.Array,
              continuation.
     k_cache  [L, B, KvH, S, hd] head-first (donate for in-place update)
     lengths  [B] int32 — number of valid cached tokens per slot.
+    attn_len — static attention window: keys are read only from cache
+             slots [0, attn_len). Decode is cache-bandwidth-bound, so the
+             engine buckets this to the live prefix instead of streaming
+             all S slots every step. Requires max(lengths) + T <= attn_len
+             (new K/V land below it); None = S.
     Returns (logits [B, T, V], k_cache, v_cache).
     """
     B, T = tokens.shape
     L, _, _, S, _ = k_cache.shape
+    A = S if attn_len is None else min(attn_len, S)
     scale = 1.0 / math.sqrt(cfg.head_dim)
     positions = lengths[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
     cos, sin = rope_angles(positions, cfg.rotary_dim, cfg.rope_theta,
@@ -369,7 +386,7 @@ def forward_with_cache(params: Params, cfg: ModelConfig, tokens: jax.Array,
     # key j (absolute slot) is visible to query at absolute pos p iff j <= p,
     # within the sliding window; slots beyond the written region are garbage
     # but satisfy j > p so they are masked.
-    k_pos = jnp.arange(S, dtype=jnp.int32)[None, None, :]
+    k_pos = jnp.arange(A, dtype=jnp.int32)[None, None, :]
     q_pos = positions[:, :, None]
     ok = k_pos <= q_pos
     if cfg.sliding_window:
@@ -381,7 +398,7 @@ def forward_with_cache(params: Params, cfg: ModelConfig, tokens: jax.Array,
     def body(x, layer_in):
         lp, kc, vc = layer_in
         x, kc, vc = _block_cached(cfg, lp, x, cos, sin, kc, vc, positions,
-                                  mask, scale)
+                                  mask, scale, attn_len=A)
         return x, (kc, vc)
 
     x, (k_cache, v_cache) = lax.scan(body, x,

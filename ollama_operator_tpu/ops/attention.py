@@ -132,7 +132,15 @@ def cached_attention(cfg, q, k_cache, v_cache, mask, q_pos, scale: float):
     step routes to the pallas kernel, which skips unread cache blocks; T>1
     continuations use the masked einsum path)."""
     mode = resolve_kernels(cfg.kernels)
-    if mode in ("pallas", "interpret") and q.shape[1] == 1:
+    # MHA (G == 1) maps badly onto the decode kernel's (B, KvH, nk) grid —
+    # B×KvH tiny 8-row programs lose to one big XLA einsum (measured on
+    # v5e: phi 128 vs 147 tok/s) — so "auto"-resolved pallas skips it; an
+    # explicit pallas choice (config or OLLAMA_TPU_KERNELS) still forces it.
+    explicit_pallas = (cfg.kernels == "pallas"
+                       or os.environ.get("OLLAMA_TPU_KERNELS") == "pallas")
+    gqa_ok = q.shape[2] > k_cache.shape[1] or explicit_pallas
+    if (mode in ("pallas", "interpret") and q.shape[1] == 1
+            and (gqa_ok or mode == "interpret")):
         from .pallas import decode_attention
         out = decode_attention(q, k_cache, v_cache, q_pos[:, 0], scale,
                                cfg.attn_softcap, cfg.sliding_window,

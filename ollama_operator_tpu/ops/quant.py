@@ -52,15 +52,29 @@ def quantize_groupwise(w, group: int = GROUP) -> Dict[str, Any]:
     """
     if isinstance(w, jax.Array):
         return _quantize_jax(w, group)
-    w = np.asarray(w, np.float32)
+    w = np.asarray(w)
     *lead, K, O = w.shape
-    assert K % group == 0, f"in-dim {K} must divide group {group}"
-    wr = w.reshape(*lead, K // group, group, O)
-    amax = np.abs(wr).max(axis=-2, keepdims=True)          # [..., K/g, 1, O]
+    assert K % group == 0, f"group {group} must divide in-dim {K}"
+    if lead:
+        # stacked [L, ...] leaves quantize one slice at a time — the f32
+        # temporaries below are per-slice, so peak host RAM stays one
+        # layer, not 3x the whole (potentially 70B-scale) leaf
+        q = np.empty(w.shape, np.int8)
+        s = np.empty((*lead, K // group, O), np.float32)
+        flat_w = w.reshape(-1, K, O)
+        flat_q = q.reshape(-1, K, O)
+        flat_s = s.reshape(-1, K // group, O)
+        for i in range(flat_w.shape[0]):
+            sl = quantize_groupwise(flat_w[i], group)
+            flat_q[i], flat_s[i] = sl["q"], sl["s"]
+        return {"q": q, "s": s}
+    w = np.asarray(w, np.float32)
+    wr = w.reshape(K // group, group, O)
+    amax = np.abs(wr).max(axis=-2, keepdims=True)          # [K/g, 1, O]
     s = (amax / 127.0).astype(np.float32)
     q = np.rint(np.where(s > 0, wr / np.maximum(s, 1e-30), 0.0))
     q = np.clip(q, -127, 127).astype(np.int8)
-    return {"q": q.reshape(*lead, K, O), "s": s[..., 0, :]}
+    return {"q": q.reshape(K, O), "s": s[:, 0, :]}
 
 
 @jax.jit

@@ -63,59 +63,60 @@ def apply_penalties(logits, token_counts, sp: SamplingParams):
     return logits
 
 
-def _mask_top_k(logits, top_k):
-    """Vectorised top-k: keep logits >= the k-th largest (per row)."""
-    V = logits.shape[-1]
-    sorted_desc = -jnp.sort(-logits, axis=-1)           # [B, V]
-    k = jnp.clip(top_k, 1, V)
-    kth = jnp.take_along_axis(sorted_desc, (k - 1)[:, None], axis=-1)
-    keep = logits >= kth
-    keep = jnp.where((top_k > 0)[:, None], keep, True)
-    return jnp.where(keep, logits, NEG_INF)
+N_CANDIDATES = 1024
 
 
-def _mask_top_p(logits, top_p):
-    """Nucleus sampling mask over softmax probabilities."""
-    sort_idx = jnp.argsort(-logits, axis=-1)
-    sorted_logits = jnp.take_along_axis(logits, sort_idx, axis=-1)
-    probs = jax.nn.softmax(sorted_logits, axis=-1)
-    cum = jnp.cumsum(probs, axis=-1)
-    # keep tokens until cumulative prob exceeds top_p (always keep the first)
-    keep_sorted = (cum - probs) < top_p[:, None]
-    keep = jnp.zeros_like(keep_sorted).at[
-        jnp.arange(logits.shape[0])[:, None], sort_idx].set(keep_sorted)
-    keep = jnp.where((top_p < 1.0)[:, None], keep, True)
-    return jnp.where(keep, logits, NEG_INF)
-
-
-def _mask_min_p(logits, min_p):
-    probs = jax.nn.softmax(logits, axis=-1)
-    pmax = jnp.max(probs, axis=-1, keepdims=True)
-    keep = probs >= (min_p[:, None] * pmax)
-    keep = jnp.where((min_p > 0.0)[:, None], keep, True)
-    return jnp.where(keep, logits, NEG_INF)
-
-
-def sample(logits, token_counts, sp: SamplingParams, key):
+def sample(logits, token_counts, sp: SamplingParams, key,
+           n_candidates: int = N_CANDIDATES):
     """logits [B, V] f32 → tokens [B] i32.
 
     Greedy where temperature <= 0, otherwise penalised + top-k/p/min-p
     filtered categorical sampling. ``key`` is either a single PRNG key
     (shared across the batch) or a [B] array of per-slot keys (each request
     carries its own seed, per the Ollama API `seed` option).
+
+    The filters run in a compressed top-``n_candidates`` space: ONE
+    ``lax.top_k`` replaces the two full [B, V] sorts the masks would
+    otherwise need (a large share of the decode step at 50k+ vocabs), and
+    since candidates come out sorted the top-p cumsum needs no further
+    sort. ``top_k`` is effectively capped at n_candidates, and top-p mass
+    beyond the top-1024 logits is treated as zero — both far outside any
+    practical sampling configuration (Ollama defaults: top_k=40).
     """
     logits = apply_penalties(logits, token_counts, sp)
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
+    B, V = logits.shape
+    C = min(V, n_candidates)
+    vals, cand = jax.lax.top_k(logits, C)           # [B, C], sorted desc
     t = jnp.maximum(sp.temperature, 1e-6)[:, None]
-    scaled = logits / t
-    scaled = _mask_top_k(scaled, sp.top_k)
-    scaled = _mask_top_p(scaled, sp.top_p)
-    scaled = _mask_min_p(scaled, sp.min_p)
+    scaled = vals / t
+
+    # top-k: the k-th largest is simply column k-1 of the sorted values
+    k = jnp.clip(sp.top_k, 1, C)
+    kth = jnp.take_along_axis(scaled, (k - 1)[:, None], axis=-1)
+    keep = scaled >= kth
+    keep = jnp.where((sp.top_k > 0)[:, None], keep, True)
+    scaled = jnp.where(keep, scaled, NEG_INF)
+
+    # top-p over the (sorted) candidate probabilities
+    probs = jax.nn.softmax(scaled, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = (cum - probs) < sp.top_p[:, None]        # always keeps the first
+    keep = jnp.where((sp.top_p < 1.0)[:, None], keep, True)
+    scaled = jnp.where(keep, scaled, NEG_INF)
+
+    # min-p relative to the max candidate probability
+    probs = jax.nn.softmax(scaled, axis=-1)
+    keep = probs >= (sp.min_p[:, None] * probs[:, :1])
+    keep = jnp.where((sp.min_p > 0.0)[:, None], keep, True)
+    scaled = jnp.where(keep, scaled, NEG_INF)
+
     if getattr(key, "ndim", 0) >= 1:  # per-slot keys
-        sampled = jax.vmap(jax.random.categorical)(key, scaled)
+        ci = jax.vmap(jax.random.categorical)(key, scaled)
     else:
-        sampled = jax.random.categorical(key, scaled, axis=-1)
+        ci = jax.random.categorical(key, scaled, axis=-1)
+    sampled = jnp.take_along_axis(cand, ci[:, None], axis=-1)[:, 0]
     sampled = sampled.astype(jnp.int32)
 
     return jnp.where(sp.temperature <= 0.0, greedy, sampled)

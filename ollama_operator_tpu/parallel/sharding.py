@@ -107,7 +107,8 @@ def _leaf_spec(spec: P, v: Any, mesh: Optional[Mesh]):
     same PartitionSpec usually partitions both. When a scale dim is too
     small to divide its mesh axis (tiny K/g), that axis replicates for s
     only — XLA still partials the dot over the sharded q rows."""
-    if not (isinstance(v, dict) and "q" in v and "s" in v):
+    from ..ops.quant import is_quantized
+    if not is_quantized(v):
         return spec
     s_shape = v["s"].shape
     s_spec = []

@@ -113,6 +113,7 @@ class Engine:
         else:
             cache_sh = slot_sh = None
             self._param_sh = None
+        self._cache_sh, self._slot_sh = cache_sh, slot_sh
         self.params = params
 
         def zeros(shape, dtype, sh):
@@ -127,6 +128,9 @@ class Engine:
         self.last_tokens = zeros((B,), jnp.int32, slot_sh)
         self.active = np.zeros((B,), bool)  # host-side mask
         self._active_dev = zeros((B,), jnp.int32, slot_sh)
+        # host mirror of per-slot lengths — lets decode_n pick the static
+        # attention bucket without a device sync
+        self._host_lengths = np.zeros((B,), np.int64)
 
         # per-slot sampling params, host mirror + device arrays
         self._opts: Dict[int, SlotOptions] = {}
@@ -147,22 +151,40 @@ class Engine:
     # ------------------------------------------------------------------
     def _compile_fns(self):
         cfg = self.cfg
+        cache_sh, slot_sh = self._cache_sh, self._slot_sh
+
+        def pin(k_cache, v_cache, lengths, counts, last_tokens):
+            """Pin slot-state outputs to their canonical shardings — the
+            AOT-compiled decode executables require the state sharding to
+            be IDENTICAL across admits (GSPMD would otherwise pick a fresh
+            output sharding per program and the exec call would reject)."""
+            if slot_sh is None:
+                return k_cache, v_cache, lengths, counts, last_tokens
+            wsc = jax.lax.with_sharding_constraint
+            return (wsc(k_cache, cache_sh), wsc(v_cache, cache_sh),
+                    wsc(lengths, slot_sh), wsc(counts, slot_sh),
+                    wsc(last_tokens, slot_sh))
 
         if self.sp_size > 1:
             from ..parallel import long_context
             mesh = self.mesh
             prefill_impl = partial(long_context.prefill_chunk_sp, cfg=cfg,
                                    mesh=mesh)
+            # the sp cache is sequence-sharded; bucketing would cut across
+            # shards, so the sp path always attends its full local prefix
             step_impl = partial(long_context.forward_with_cache_sp, cfg=cfg,
                                 mesh=mesh)
+            self._bucketed_attn = False
         else:
             prefill_impl = partial(decoder.prefill_chunk, cfg=cfg)
             step_impl = partial(decoder.forward_with_cache, cfg=cfg)
+            self._bucketed_attn = True
 
-        @partial(jax.jit, static_argnames=())
-        def _prefill(params, tokens, n_valid, sp_row, key):
-            """B=1 prefill of a padded chunk; returns first sampled token,
-            the chunk K/V, and the prompt token-count row."""
+        @partial(jax.jit, donate_argnums=(1, 2, 3, 4, 5))
+        def _admit(params, k_cache, v_cache, lengths, counts, last_tokens,
+                   tokens, slot, n_valid, sp_row, key):
+            """Prefill a padded B=1 chunk AND insert it into the slot state
+            — one device program, one host round-trip per admission."""
             logits, ks, vs = prefill_impl(params, tokens=tokens)
             last = jax.lax.dynamic_index_in_dim(
                 logits[0], n_valid - 1, axis=0, keepdims=False)
@@ -173,11 +195,6 @@ class Engine:
             tok = sampling.sample(last[None], counts_row[None], sp_row,
                                   key[None])[0]
             counts_row = counts_row.at[tok].add(1)
-            return tok, ks, vs, counts_row
-
-        @partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4))
-        def _insert(k_cache, v_cache, lengths, counts, last_tokens,
-                    ks, vs, slot, n_valid, tok, counts_row):
             k_cache = jax.lax.dynamic_update_slice(
                 k_cache, ks.astype(k_cache.dtype), (0, slot, 0, 0, 0))
             v_cache = jax.lax.dynamic_update_slice(
@@ -185,20 +202,24 @@ class Engine:
             lengths = lengths.at[slot].set(n_valid)
             counts = counts.at[slot].set(counts_row)
             last_tokens = last_tokens.at[slot].set(tok)
-            return k_cache, v_cache, lengths, counts, last_tokens
+            return (tok, *pin(k_cache, v_cache, lengths, counts,
+                              last_tokens))
 
         def _decode_body(params, k_cache, v_cache, lengths, counts,
-                         last_tokens, sp, keys, active):
+                         last_tokens, sp, keys, active, attn_len=None):
+            kw = {"attn_len": attn_len} if (attn_len is not None
+                                            and self._bucketed_attn) else {}
             logits, k_cache, v_cache = step_impl(
                 params, tokens=last_tokens[:, None], k_cache=k_cache,
-                v_cache=v_cache, lengths=lengths)
+                v_cache=v_cache, lengths=lengths, **kw)
             step_keys = jax.vmap(jax.random.fold_in)(keys, lengths)
             toks = sampling.sample(logits[:, 0], counts, sp, step_keys)
             B = toks.shape[0]
             counts = counts.at[jnp.arange(B), toks].add(active)
             lengths = lengths + active
             last_tokens = jnp.where(active == 1, toks, last_tokens)
-            return toks, k_cache, v_cache, lengths, counts, last_tokens
+            return (toks, *pin(k_cache, v_cache, lengths, counts,
+                               last_tokens))
 
         @partial(jax.jit, donate_argnums=(1, 2, 3, 4, 5, 7))
         def _decode(params, k_cache, v_cache, lengths, counts, last_tokens,
@@ -209,18 +230,21 @@ class Engine:
                                          active)
             return toks, k_cache, v_cache, lengths, counts, last_tokens, keys
 
-        @partial(jax.jit, static_argnums=(9,),
+        @partial(jax.jit, static_argnums=(9, 10),
                  donate_argnums=(1, 2, 3, 4, 5, 7))
         def _decode_n(params, k_cache, v_cache, lengths, counts, last_tokens,
-                      sp, keys, active, n):
+                      sp, keys, active, n, attn_len):
             """n decode steps as ONE device program (lax.scan) — a single
-            dispatch + host sync per n tokens per slot."""
+            dispatch + host sync per n tokens per slot. ``attn_len`` is the
+            static attended-cache prefix (decode traffic scales with it,
+            not with max_seq_len)."""
             def step(carry, _):
                 k_cache, v_cache, lengths, counts, last_tokens = carry
                 (toks, k_cache, v_cache, lengths, counts,
                  last_tokens) = _decode_body(params, k_cache, v_cache,
                                              lengths, counts, last_tokens,
-                                             sp, keys, active)
+                                             sp, keys, active,
+                                             attn_len=attn_len)
                 return (k_cache, v_cache, lengths, counts,
                         last_tokens), toks
 
@@ -237,11 +261,13 @@ class Engine:
             last_tokens = last_tokens.at[slot].set(0)
             return lengths, counts, last_tokens
 
-        self._prefill_fn = _prefill
-        self._insert_fn = _insert
+        self._admit_fn = _admit
         self._decode_fn = _decode
         self._decode_n_fn = _decode_n
         self._release_fn = _release
+        # AOT-compiled decode_n executables keyed by (n, attn_bucket) — a
+        # bucket crossing must swap programs, never recompile mid-serving
+        self._decode_execs: Dict[Any, Any] = {}
 
     # ------------------------------------------------------------------
     # host API
@@ -293,19 +319,29 @@ class Engine:
         seed = opts.seed if opts.seed >= 0 else (hash((slot, n)) & 0x7FFFFFFF)
         key = jax.random.key(seed)
         self.keys = self.keys.at[slot].set(key)
-        tok, ks, vs, counts_row = self._prefill_fn(
-            self.params, jnp.asarray(tokens), jnp.int32(n),
-            self._sp_row(opts), key)
-        (self.k_cache, self.v_cache, self.lengths, self.counts,
-         self.last_tokens) = self._insert_fn(
-            self.k_cache, self.v_cache, self.lengths, self.counts,
-            self.last_tokens, ks[:, :, :], vs[:, :, :], jnp.int32(slot),
-            jnp.int32(n), tok, counts_row)
+        (tok, self.k_cache, self.v_cache, self.lengths, self.counts,
+         self.last_tokens) = self._admit_fn(
+            self.params, self.k_cache, self.v_cache, self.lengths,
+            self.counts, self.last_tokens, jnp.asarray(tokens),
+            jnp.int32(slot), jnp.int32(n), self._sp_row(opts), key)
         self.active[slot] = True
+        self._host_lengths[slot] = n
         self._opts[slot] = opts
         self._rebuild_sp()
         self._active_dev = jnp.asarray(self.active.astype(np.int32))
         return int(tok)
+
+    def _attn_bucket(self, n: int) -> int:
+        """Static attended-prefix length covering every active slot for the
+        next ``n`` steps: smallest bucket >= max(lengths) + n. Decode cache
+        traffic scales with this, not with max_seq_len."""
+        if not self._bucketed_attn:
+            return self.max_seq
+        need = int(self._host_lengths[self.active].max(initial=0)) + n
+        for b in self._buckets:
+            if need <= b:
+                return b
+        return self.max_seq
 
     def decode(self) -> np.ndarray:
         """One decode step for every slot; returns sampled tokens [B] (only
@@ -315,7 +351,29 @@ class Engine:
             self.params, self.k_cache, self.v_cache, self.lengths,
             self.counts, self.last_tokens, self.sp, self.keys,
             self._active_dev)
+        self._host_lengths[self.active] += 1
         return np.asarray(toks)
+
+    def _decode_n_exec(self, n: int, attn_len: int):
+        key = (n, attn_len)
+        exe = self._decode_execs.get(key)
+        if exe is None:
+            exe = self._decode_n_fn.lower(
+                self.params, self.k_cache, self.v_cache, self.lengths,
+                self.counts, self.last_tokens, self.sp, self.keys,
+                self._active_dev, n, attn_len).compile()
+            self._decode_execs[key] = exe
+        return exe
+
+    def warm_buckets(self, n: Optional[int] = None):
+        """AOT-compile the chunked decode program for every attention
+        bucket, so serving never pays a compile at a bucket crossing.
+        Non-bucketed paths (sp meshes) only ever run at max_seq — one
+        program, not a duplicate per bucket."""
+        n = n or self.ecfg.decode_chunk
+        buckets = self._buckets if self._bucketed_attn else [self.max_seq]
+        for b in buckets:
+            self._decode_n_exec(n, b)
 
     def decode_n(self, n: Optional[int] = None) -> np.ndarray:
         """n decode steps in one device program; returns tokens [n, B].
@@ -325,17 +383,18 @@ class Engine:
         the chunk. Chunk semantics are identical to n decode() calls.
         """
         n = n or self.ecfg.decode_chunk
-        if n == 1:
-            return self.decode()[None]
+        exe = self._decode_n_exec(n, self._attn_bucket(n))
         (toks_n, self.k_cache, self.v_cache, self.lengths, self.counts,
-         self.last_tokens, self.keys) = self._decode_n_fn(
+         self.last_tokens, self.keys) = exe(
             self.params, self.k_cache, self.v_cache, self.lengths,
             self.counts, self.last_tokens, self.sp, self.keys,
-            self._active_dev, n)
+            self._active_dev)
+        self._host_lengths[self.active] += n
         return np.asarray(toks_n)
 
     def release(self, slot: int):
         self.active[slot] = False
+        self._host_lengths[slot] = 0
         self._opts.pop(slot, None)
         self.lengths, self.counts, self.last_tokens = self._release_fn(
             self.lengths, self.counts, self.last_tokens, jnp.int32(slot))

@@ -151,6 +151,12 @@ class LoadedModel:
         self.loaded_at = time.time()
         self.ecfg = ecfg or EngineConfig()
         self.engine = Engine(cfg, params, mesh=mesh, ecfg=self.ecfg)
+        # AOT-compile every attention-bucket decode program up front —
+        # serving must never pay an XLA compile at a bucket crossing (the
+        # persistent compilation cache makes this near-free on restarts)
+        import os as _os
+        if _os.environ.get("TPU_WARM_BUCKETS", "1") != "0":
+            self.engine.warm_buckets()
         self.scheduler = Scheduler(self.engine)
         self._embed_fn = None
         self._embed_lock = threading.Lock()

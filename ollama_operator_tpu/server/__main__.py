@@ -64,6 +64,14 @@ def main(argv=None):
         # where a sitecustomize force-sets the platform list programmatically
         if os.environ.get("JAX_PLATFORMS"):
             jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+        if args.cache:
+            # persistent XLA compilation cache beside the weight cache: pod
+            # restarts skip the multi-program warm-up compiles
+            xla_cache = os.path.join(args.cache, "xla-cache")
+            os.makedirs(xla_cache, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", xla_cache)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              1.0)
         if args.profile_port:
             jax.profiler.start_server(args.profile_port)
         devices = jax.devices()

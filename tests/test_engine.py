@@ -139,3 +139,32 @@ def test_decode_n_matches_single_steps():
     chunk = e2.decode_n(6)
     assert chunk.shape == (6, 2)
     assert [int(t[0]) for t in chunk] == singles
+
+
+def test_decode_across_attn_bucket_boundary():
+    """Generations crossing a power-of-two attention bucket must be
+    identical to an engine that always attends the full cache."""
+    import jax.numpy as jnp
+    from ollama_operator_tpu.models import config as cfglib
+    from ollama_operator_tpu.models import decoder as dec
+    from ollama_operator_tpu.runtime.engine import (Engine, EngineConfig,
+                                                    SlotOptions)
+    cfg = cfglib.PRESETS["tiny"]
+    params = dec.init_params(cfg, jax.random.PRNGKey(7), dtype=jnp.float32)
+    ecfg = EngineConfig(max_slots=2, max_seq_len=128, min_prefill_bucket=8,
+                        cache_dtype=jnp.float32, decode_chunk=4)
+    opts = SlotOptions(temperature=0.0)
+    prompt = np.arange(1, 7, dtype=np.int32)   # len 6: bucket 8 → 16 → 32
+
+    e1 = Engine(cfg, params, ecfg=ecfg)
+    e1.admit(0, prompt, opts)
+    bucketed = [t for _ in range(7) for t in e1.decode_n()[:, 0]]
+
+    e2 = Engine(cfg, params, ecfg=ecfg)
+    e2._bucketed_attn = False   # always full-cache attention
+    e2.admit(0, prompt, opts)
+    full = [t for _ in range(7) for t in e2.decode_n()[:, 0]]
+
+    assert [int(t) for t in bucketed] == [int(t) for t in full]
+    # crossed at least two bucket boundaries (6 + 28 tokens > 32 > 16 > 8)
+    assert e1._attn_bucket(1) >= 32
