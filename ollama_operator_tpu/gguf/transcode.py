@@ -326,3 +326,94 @@ def load_model(gguf_path: str, cache_dir: Optional[str] = None,
     if not TS.exists(store_path):
         transcode_to_store(gguf_path, store_path, dtype)
     return load_from_store(store_path)
+
+
+# ---------------------------------------------------------------------------
+# vision tower (llava mmproj GGUF, arch "clip")
+# ---------------------------------------------------------------------------
+
+def vision_config_from_gguf(f: GGUFFile):
+    """clip-arch mmproj metadata → models.vision.VisionConfig. The projector
+    output width comes from the mm.2 tensor (the LLM's embedding dim)."""
+    from ..models.vision import VisionConfig
+    g = lambda k, d=None: f.metadata.get("clip.vision." + k, d)
+    mm2 = f.tensors.get("mm.2.weight")
+    proj_dim = int(mm2.shape[0]) if mm2 is not None else int(
+        f.metadata.get("clip.vision.projection_dim", 4096))
+    return VisionConfig(
+        image_size=int(g("image_size", 336)),
+        patch_size=int(g("patch_size", 14)),
+        width=int(g("embedding_length", 1024)),
+        n_layers=int(g("block_count", 24)),
+        n_heads=int(g("attention.head_count", 16)),
+        ffn_dim=int(g("feed_forward_length", 4096)),
+        norm_eps=float(g("attention.layer_norm_epsilon", 1e-5)),
+        proj_dim=proj_dim,
+        # llama.cpp's llava converter trims the skipped final CLIP layer
+        # before export (block_count already reflects the penultimate
+        # selection), so a GGUF-loaded tower runs ALL file layers
+        select_layer=-1,
+    ).validate()
+
+
+def load_vision_params(f: GGUFFile, vcfg=None,
+                       dtype=np.float32) -> Dict[str, Any]:
+    """mmproj tensors → models.vision param tree.
+
+    llama.cpp's clip naming (v.patch_embd, v.blk.N.*, mm.0/mm.2); the two
+    ffn tensors are mapped by SHAPE, not name, because historical mmproj
+    exports disagree on which of ffn_up/ffn_down is the W→F projection.
+    """
+    vcfg = vcfg or vision_config_from_gguf(f)
+    L, W, F = vcfg.n_layers, vcfg.width, vcfg.ffn_dim
+    cast = lambda a: np.ascontiguousarray(a, dtype=dtype)
+
+    pe = _dq(f, "v.patch_embd.weight")          # [W, 3, P, P]
+    params: Dict[str, Any] = {
+        "patch_emb": cast(pe.reshape(W, -1).T),  # → [3*P*P, W], (c,i,j)
+        "class_emb": cast(_dq(f, "v.class_embd")),
+        "pos_emb": cast(_dq(f, "v.position_embd.weight")),
+        "pre_ln_w": cast(_dq(f, "v.pre_ln.weight")),
+        "pre_ln_b": cast(_dq(f, "v.pre_ln.bias")),
+        "mm_0": cast(_dq(f, "mm.0.weight").T),
+        "mm_0_b": cast(_dq(f, "mm.0.bias")),
+        "mm_2": cast(_dq(f, "mm.2.weight").T),
+        "mm_2_b": cast(_dq(f, "mm.2.bias")),
+    }
+
+    def stackv(fmt, post=None):
+        arrs = []
+        for i in range(L):
+            a = _dq(f, fmt.format(i))
+            arrs.append(cast(post(a) if post else a))
+        return np.stack(arrs)
+
+    T_ = lambda a: a.T
+    layers = {
+        "ln1_w": stackv("v.blk.{}.ln1.weight"),
+        "ln1_b": stackv("v.blk.{}.ln1.bias"),
+        "ln2_w": stackv("v.blk.{}.ln2.weight"),
+        "ln2_b": stackv("v.blk.{}.ln2.bias"),
+        "wq": stackv("v.blk.{}.attn_q.weight", T_),
+        "bq": stackv("v.blk.{}.attn_q.bias"),
+        "wk": stackv("v.blk.{}.attn_k.weight", T_),
+        "bk": stackv("v.blk.{}.attn_k.bias"),
+        "wv": stackv("v.blk.{}.attn_v.weight", T_),
+        "bv": stackv("v.blk.{}.attn_v.bias"),
+        "wo": stackv("v.blk.{}.attn_out.weight", T_),
+        "bo": stackv("v.blk.{}.attn_out.bias"),
+    }
+    # ffn tensors by shape: the W→F one is fc1 (our w_up)
+    up0 = _dq(f, "v.blk.0.ffn_up.weight")
+    if up0.shape == (F, W):        # stored [out, in] = [F, W] → fc1
+        layers["w_up"] = stackv("v.blk.{}.ffn_up.weight", T_)
+        layers["b_up"] = stackv("v.blk.{}.ffn_up.bias")
+        layers["w_down"] = stackv("v.blk.{}.ffn_down.weight", T_)
+        layers["b_down"] = stackv("v.blk.{}.ffn_down.bias")
+    else:                           # swapped convention
+        layers["w_up"] = stackv("v.blk.{}.ffn_down.weight", T_)
+        layers["b_up"] = stackv("v.blk.{}.ffn_down.bias")
+        layers["w_down"] = stackv("v.blk.{}.ffn_up.weight", T_)
+        layers["b_down"] = stackv("v.blk.{}.ffn_up.bias")
+    params["layers"] = layers
+    return params

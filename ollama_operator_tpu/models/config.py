@@ -137,6 +137,11 @@ PRESETS = {
                  n_heads=16, n_kv_heads=16, head_dim=256, ffn_dim=24576,
                  act="gelu_tanh", emb_scale=True, tie_embeddings=True,
                  norm_weight_offset=1.0, max_seq_len=8192),
+    # multimodal (vicuna-7b LLM half of llava-1.5; vision tower in
+    # models/vision.py via the mmproj layer)
+    "llava": _mk(arch="llama", vocab_size=32000, dim=4096, n_layers=32,
+                 n_heads=32, n_kv_heads=32, head_dim=128, ffn_dim=11008,
+                 max_seq_len=4096),
     # mixture-of-experts family (sparse MoE; expert-parallel over "ep")
     "tiny-moe": _mk(arch="llama", vocab_size=256, dim=64, n_layers=2,
                     n_heads=4, n_kv_heads=2, head_dim=16, ffn_dim=128,

@@ -290,12 +290,8 @@ def _block_cached(cfg: ModelConfig, lp, x, cos, sin, k_cache, v_cache,
     else:
         k_cache, v_cache = write_fn(k_cache, v_cache, k, v, write_pos)
     if attn_fn is None:
-        kc_view, vc_view = k_cache, v_cache
-        if attn_len is not None and attn_len < k_cache.shape[2]:
-            kc_view = k_cache[:, :, :attn_len, :]
-            vc_view = v_cache[:, :, :attn_len, :]
-        attn = cached_attention(cfg, q, kc_view, vc_view, mask, write_pos,
-                                scale)
+        attn = cached_attention(cfg, q, k_cache, v_cache, mask, write_pos,
+                                scale, attn_len=attn_len)
     else:
         attn = attn_fn(q, k_cache, v_cache, write_pos)
     attn = _proj_out(cfg, lp, attn, B, T)
@@ -329,13 +325,17 @@ def _unembed(cfg: ModelConfig, params: Params, x):
 # --------------------------------------------------------------------------
 
 def prefill_chunk(params: Params, cfg: ModelConfig, tokens: jax.Array,
-                  n_valid: Optional[jax.Array] = None
+                  n_valid: Optional[jax.Array] = None,
+                  inputs_embeds: Optional[jax.Array] = None
                   ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Process a fresh chunk at positions [0, T) with no prior cache.
 
     tokens  [B, T] int32 (right-padded; padding is masked out of attention by
             the causal structure for queries < n_valid — callers only read
             logits at n_valid-1).
+    inputs_embeds — optional [B, T, D] pre-computed embedding sequence
+            (multimodal prompts: image tokens from models/vision.py spliced
+            between text embeddings); replaces the tok_emb lookup.
     Returns (logits [B, T, V] fp32, k [L, B, KvH, T, hd], v [...]) — K/V
     head-first, matching the cache layout.
     """
@@ -347,7 +347,10 @@ def prefill_chunk(params: Params, cfg: ModelConfig, tokens: jax.Array,
     mask = causal_mask(T, T, 0, sliding_window=cfg.sliding_window)
     mask = jnp.broadcast_to(mask, (B, 1, T, T))
 
-    x = _embed(cfg, params, tokens)
+    if inputs_embeds is not None:
+        x = inputs_embeds.astype(params["tok_emb"].dtype)
+    else:
+        x = _embed(cfg, params, tokens)
 
     def body(x, lp):
         x, (k, v) = _block_chunk(cfg, lp, x, cos, sin, mask, scale)

@@ -1,0 +1,179 @@
+"""CLIP-style vision tower + multimodal projector (llava family).
+
+The reference serves llava through the delegated ollama image, whose
+llama.cpp clip encoder (C++) embeds images into the LLM's token space
+(/root/reference/README.md model table lists LLaVA; SURVEY.md §2.2). This
+is the TPU-native equivalent: a pure-JAX pre-LN ViT encoder whose patch
+"convolution" is expressed as a reshape + one matmul (MXU-shaped — a
+P×P/stride-P conv IS a per-patch linear), followed by the llava MLP
+projector into the decoder's embedding width.
+
+llava semantics mirrored from the public llava/clip conventions:
+- 3×336×336 input, CLIP normalization, 14-px patches → 24×24 = 576 tokens
+- features taken from the PENULTIMATE transformer layer (vision_layer -2),
+  class token dropped ("patch" feature select)
+- projector: Linear(vis_width → dim) · GELU · Linear(dim → dim)
+
+Params tree (layer leaves stacked on a leading axis, like the decoder):
+
+  patch_emb [P*P*3, W]  (pixel order (c, i, j) flattened)
+  class_emb [W]
+  pos_emb   [n_pos, W]          (n_pos = 1 + n_patches)
+  pre_ln_w/b [W]
+  layers/
+    ln1_w/b [L, W]   wq/wk/wv/wo [L, W, W]   bq/bk/bv/bo [L, W]
+    ln2_w/b [L, W]   w_up [L, W, F]  b_up [L, F]
+                     w_down [L, F, W]  b_down [L, W]
+  mm_0 [W, D]  mm_0_b [D]  mm_2 [D, D]  mm_2_b [D]   (projector)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+# CLIP preprocessing constants (openai/clip-vit-large-patch14-336)
+CLIP_MEAN = np.array([0.48145466, 0.4578275, 0.40821073], np.float32)
+CLIP_STD = np.array([0.26862954, 0.26130258, 0.27577711], np.float32)
+
+
+@dataclasses.dataclass(frozen=True)
+class VisionConfig:
+    """Static ViT architecture description (CLIP ViT-L/14-336 defaults)."""
+
+    image_size: int = 336
+    patch_size: int = 14
+    width: int = 1024          # vision hidden size
+    n_layers: int = 24         # clip reports 23 used + 1 skipped (select -2)
+    n_heads: int = 16
+    ffn_dim: int = 4096
+    norm_eps: float = 1e-5
+    proj_dim: int = 4096       # LLM embedding width (llava-7b: 4096)
+    select_layer: int = -2     # penultimate-layer features (llava default)
+
+    @property
+    def n_patches_side(self) -> int:
+        return self.image_size // self.patch_size
+
+    @property
+    def n_patches(self) -> int:
+        return self.n_patches_side ** 2
+
+    @property
+    def head_dim(self) -> int:
+        return self.width // self.n_heads
+
+    def validate(self) -> "VisionConfig":
+        assert self.image_size % self.patch_size == 0
+        assert self.width % self.n_heads == 0
+        return self
+
+
+TINY_VISION = VisionConfig(image_size=16, patch_size=8, width=32, n_layers=3,
+                           n_heads=4, ffn_dim=64, proj_dim=64)
+
+
+def init_params(cfg: VisionConfig, key: jax.Array,
+                dtype=jnp.float32) -> Dict[str, Any]:
+    L, W, F, D = cfg.n_layers, cfg.width, cfg.ffn_dim, cfg.proj_dim
+    P = cfg.patch_size
+    keys = iter(jax.random.split(key, 16))
+
+    def w(k, shape, scale=0.02):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype)
+
+    layers = {
+        "ln1_w": jnp.ones((L, W), dtype), "ln1_b": jnp.zeros((L, W), dtype),
+        "ln2_w": jnp.ones((L, W), dtype), "ln2_b": jnp.zeros((L, W), dtype),
+        "wq": w(next(keys), (L, W, W)), "bq": jnp.zeros((L, W), dtype),
+        "wk": w(next(keys), (L, W, W)), "bk": jnp.zeros((L, W), dtype),
+        "wv": w(next(keys), (L, W, W)), "bv": jnp.zeros((L, W), dtype),
+        "wo": w(next(keys), (L, W, W)), "bo": jnp.zeros((L, W), dtype),
+        "w_up": w(next(keys), (L, W, F)), "b_up": jnp.zeros((L, F), dtype),
+        "w_down": w(next(keys), (L, F, W)), "b_down": jnp.zeros((L, W), dtype),
+    }
+    return {
+        "patch_emb": w(next(keys), (P * P * 3, W)),
+        "class_emb": w(next(keys), (W,)),
+        "pos_emb": w(next(keys), (1 + cfg.n_patches, W)),
+        "pre_ln_w": jnp.ones((W,), dtype), "pre_ln_b": jnp.zeros((W,), dtype),
+        "layers": layers,
+        "mm_0": w(next(keys), (W, D)), "mm_0_b": jnp.zeros((D,), dtype),
+        "mm_2": w(next(keys), (D, D)), "mm_2_b": jnp.zeros((D,), dtype),
+    }
+
+
+def _ln(x, w, b, eps):
+    m = jnp.mean(x, axis=-1, keepdims=True)
+    v = jnp.var(x, axis=-1, keepdims=True)
+    return ((x - m) / jnp.sqrt(v + eps)) * w + b
+
+
+def patchify(cfg: VisionConfig, images: jax.Array) -> jax.Array:
+    """images [B, H, W, 3] float → patch pixels [B, N, P*P*3].
+
+    The P×P stride-P conv is exactly a per-patch linear over pixels in
+    (c, i, j) order — one reshape feeds the MXU a single big matmul.
+    """
+    B, H, Wd, C = images.shape
+    P = cfg.patch_size
+    n = cfg.n_patches_side
+    x = images.reshape(B, n, P, n, P, C)
+    x = x.transpose(0, 1, 3, 5, 2, 4)          # [B, n, n, C, P, P]
+    return x.reshape(B, n * n, C * P * P)
+
+
+def encode(cfg: VisionConfig, params: Dict[str, Any], images: jax.Array
+           ) -> jax.Array:
+    """images [B, H, W, 3] (CLIP-normalised floats) → [B, n_patches, D]
+    projected image tokens in the decoder's embedding space."""
+    B = images.shape[0]
+    scale = 1.0 / math.sqrt(cfg.head_dim)
+    x = patchify(cfg, images) @ params["patch_emb"]      # [B, N, W]
+    cls = jnp.broadcast_to(params["class_emb"], (B, 1, cfg.width))
+    x = jnp.concatenate([cls, x], axis=1)                # [B, 1+N, W]
+    x = x + params["pos_emb"][None, : x.shape[1]]
+    x = _ln(x, params["pre_ln_w"], params["pre_ln_b"], cfg.norm_eps)
+
+    n_run = cfg.n_layers + cfg.select_layer + 1 if cfg.select_layer < 0 \
+        else cfg.select_layer
+    lp_all = params["layers"]
+    lp_run = jax.tree_util.tree_map(lambda a: a[:n_run], lp_all)
+
+    def block(x, lp):
+        B_, T, W = x.shape
+        h = _ln(x, lp["ln1_w"], lp["ln1_b"], cfg.norm_eps)
+        q = (h @ lp["wq"] + lp["bq"]).reshape(B_, T, cfg.n_heads, -1)
+        k = (h @ lp["wk"] + lp["bk"]).reshape(B_, T, cfg.n_heads, -1)
+        v = (h @ lp["wv"] + lp["bv"]).reshape(B_, T, cfg.n_heads, -1)
+        s = jnp.einsum("bthd,bshd->bhts", q, k,
+                       preferred_element_type=jnp.float32) * scale
+        p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+        a = jnp.einsum("bhts,bshd->bthd", p, v).reshape(B_, T, W)
+        x = x + (a @ lp["wo"] + lp["bo"])
+        h2 = _ln(x, lp["ln2_w"], lp["ln2_b"], cfg.norm_eps)
+        m = jax.nn.gelu(h2 @ lp["w_up"] + lp["b_up"], approximate=False)
+        x = x + (m @ lp["w_down"] + lp["b_down"])
+        return x, None
+
+    x, _ = lax.scan(block, x, lp_run)
+    feats = x[:, 1:]                                     # drop class token
+    h = jax.nn.gelu(feats @ params["mm_0"] + params["mm_0_b"],
+                    approximate=False)
+    return h @ params["mm_2"] + params["mm_2_b"]         # [B, N, D]
+
+
+def preprocess(img_hwc_u8: np.ndarray, cfg: VisionConfig) -> np.ndarray:
+    """uint8 [H, W, 3] → CLIP-normalised float32 [size, size, 3] (bilinear
+    resize; llava's stock preprocessing is a resize to the square input)."""
+    from PIL import Image
+    im = Image.fromarray(img_hwc_u8, "RGB").resize(
+        (cfg.image_size, cfg.image_size), Image.BICUBIC)
+    x = np.asarray(im, np.float32) / 255.0
+    return (x - CLIP_MEAN) / CLIP_STD

@@ -126,11 +126,17 @@ def chunk_attention(cfg, q, k, v, mask, scale: float):
     return attend_hf(q, k, v, mask, scale, cfg.attn_softcap)
 
 
-def cached_attention(cfg, q, k_cache, v_cache, mask, q_pos, scale: float):
+def cached_attention(cfg, q, k_cache, v_cache, mask, q_pos, scale: float,
+                     attn_len=None):
     """Attention against the head-first slot KV cache [B, KvH, S, hd].
     ``q_pos`` [B, T] are the new tokens' absolute positions (the T=1 decode
     step routes to the pallas kernel, which skips unread cache blocks; T>1
-    continuations use the masked einsum path)."""
+    continuations use the masked einsum path). ``attn_len`` statically
+    bounds the attended prefix: the einsum path slices the cache view (the
+    lazy slice fuses into its reads); the pallas kernel keeps the FULL
+    cache operand — a sliced pallas operand would materialize a copy per
+    layer per step, and its q_pos block clamp already elides the unread
+    blocks' DMAs."""
     mode = resolve_kernels(cfg.kernels)
     # MHA (G == 1) maps badly onto the decode kernel's (B, KvH, nk) grid —
     # B×KvH tiny 8-row programs lose to one big XLA einsum (measured on
@@ -147,4 +153,7 @@ def cached_attention(cfg, q, k_cache, v_cache, mask, q_pos, scale: float):
                                interpret=(mode == "interpret"))
         if out is not None:
             return out
+    if attn_len is not None and attn_len < k_cache.shape[2]:
+        k_cache = k_cache[:, :, :attn_len, :]
+        v_cache = v_cache[:, :, :attn_len, :]
     return attend_hf(q, k_cache, v_cache, mask, scale, cfg.attn_softcap)

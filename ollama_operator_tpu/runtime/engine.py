@@ -205,6 +205,35 @@ class Engine:
             return (tok, *pin(k_cache, v_cache, lengths, counts,
                               last_tokens))
 
+        @partial(jax.jit, donate_argnums=(1, 2, 3, 4, 5))
+        def _admit_embeds(params, k_cache, v_cache, lengths, counts,
+                          last_tokens, tokens, embeds, slot, n_valid, sp_row,
+                          key):
+            """Multimodal admission: like _admit but prefilling from a
+            precomputed [1, T, D] embedding sequence (image tokens spliced
+            into text embeddings); ``tokens`` still feeds the repeat-penalty
+            counts (image positions carry a pad id)."""
+            logits, ks, vs = prefill_impl(params, tokens=tokens,
+                                          inputs_embeds=embeds)
+            last = jax.lax.dynamic_index_in_dim(
+                logits[0], n_valid - 1, axis=0, keepdims=False)
+            T = tokens.shape[1]
+            valid = (jnp.arange(T) < n_valid).astype(jnp.int32)
+            counts_row = jnp.zeros((cfg.vocab_size,), jnp.int32
+                                   ).at[tokens[0]].add(valid)
+            tok = sampling.sample(last[None], counts_row[None], sp_row,
+                                  key[None])[0]
+            counts_row = counts_row.at[tok].add(1)
+            k_cache = jax.lax.dynamic_update_slice(
+                k_cache, ks.astype(k_cache.dtype), (0, slot, 0, 0, 0))
+            v_cache = jax.lax.dynamic_update_slice(
+                v_cache, vs.astype(v_cache.dtype), (0, slot, 0, 0, 0))
+            lengths = lengths.at[slot].set(n_valid)
+            counts = counts.at[slot].set(counts_row)
+            last_tokens = last_tokens.at[slot].set(tok)
+            return (tok, *pin(k_cache, v_cache, lengths, counts,
+                              last_tokens))
+
         def _decode_body(params, k_cache, v_cache, lengths, counts,
                          last_tokens, sp, keys, active, attn_len=None):
             kw = {"attn_len": attn_len} if (attn_len is not None
@@ -262,6 +291,7 @@ class Engine:
             return lengths, counts, last_tokens
 
         self._admit_fn = _admit
+        self._admit_embeds_fn = _admit_embeds
         self._decode_fn = _decode
         self._decode_n_fn = _decode_n
         self._release_fn = _release
@@ -307,8 +337,14 @@ class Engine:
                 [o.frequency_penalty for o in opts], jnp.float32))
 
     def admit(self, slot: int, prompt: np.ndarray,
-              opts: SlotOptions = SlotOptions()) -> int:
-        """Prefill ``prompt`` into ``slot``; returns the first sampled token."""
+              opts: SlotOptions = SlotOptions(),
+              embeds: Optional[np.ndarray] = None) -> int:
+        """Prefill ``prompt`` into ``slot``; returns the first sampled token.
+
+        ``embeds`` [n, D] — optional precomputed embedding sequence for the
+        prompt (multimodal); must match len(prompt), where image positions
+        in ``prompt`` carry a pad token id for the penalty counts.
+        """
         assert not self.active[slot], f"slot {slot} busy"
         n = int(prompt.shape[0])
         if n >= self.max_seq:
@@ -319,11 +355,25 @@ class Engine:
         seed = opts.seed if opts.seed >= 0 else (hash((slot, n)) & 0x7FFFFFFF)
         key = jax.random.key(seed)
         self.keys = self.keys.at[slot].set(key)
-        (tok, self.k_cache, self.v_cache, self.lengths, self.counts,
-         self.last_tokens) = self._admit_fn(
-            self.params, self.k_cache, self.v_cache, self.lengths,
-            self.counts, self.last_tokens, jnp.asarray(tokens),
-            jnp.int32(slot), jnp.int32(n), self._sp_row(opts), key)
+        if embeds is not None:
+            assert embeds.shape[0] == n, "embeds must cover the prompt"
+            if self.sp_size > 1:
+                raise NotImplementedError(
+                    "multimodal prompts on sp meshes not supported yet")
+            emb = np.zeros((1, bucket, embeds.shape[1]), np.float32)
+            emb[0, :n] = embeds
+            (tok, self.k_cache, self.v_cache, self.lengths, self.counts,
+             self.last_tokens) = self._admit_embeds_fn(
+                self.params, self.k_cache, self.v_cache, self.lengths,
+                self.counts, self.last_tokens, jnp.asarray(tokens),
+                jnp.asarray(emb), jnp.int32(slot), jnp.int32(n),
+                self._sp_row(opts), key)
+        else:
+            (tok, self.k_cache, self.v_cache, self.lengths, self.counts,
+             self.last_tokens) = self._admit_fn(
+                self.params, self.k_cache, self.v_cache, self.lengths,
+                self.counts, self.last_tokens, jnp.asarray(tokens),
+                jnp.int32(slot), jnp.int32(n), self._sp_row(opts), key)
         self.active[slot] = True
         self._host_lengths[slot] = n
         self._opts[slot] = opts

@@ -62,10 +62,12 @@ class Request:
     _ids_lock = threading.Lock()
 
     def __init__(self, prompt_ids: Sequence[int], opts: SlotOptions,
-                 max_tokens: int, eog_ids: frozenset):
+                 max_tokens: int, eog_ids: frozenset,
+                 embeds: Optional[np.ndarray] = None):
         with Request._ids_lock:
             self.id = next(Request._ids)
         self.prompt_ids = np.asarray(prompt_ids, np.int32)
+        self.embeds = embeds          # [n_prompt, D] multimodal embeddings
         self.opts = opts
         self.max_tokens = max_tokens
         self.eog_ids = eog_ids
@@ -112,12 +114,13 @@ class Scheduler:
     def submit(self, prompt_ids: Sequence[int],
                opts: SlotOptions = SlotOptions(),
                max_tokens: int = 128,
-               eog_ids: frozenset = frozenset()) -> Request:
+               eog_ids: frozenset = frozenset(),
+               embeds: Optional[np.ndarray] = None) -> Request:
         if len(prompt_ids) >= self.engine.max_seq:
             raise ValueError(
                 f"prompt of {len(prompt_ids)} tokens exceeds context window "
                 f"{self.engine.max_seq}")
-        req = Request(prompt_ids, opts, max_tokens, eog_ids)
+        req = Request(prompt_ids, opts, max_tokens, eog_ids, embeds=embeds)
         # broken-check + enqueue under the lock: the failure path flips
         # `broken` and drains under the same lock, so a request can never
         # slip into the queue after the final drain (its reader would hang)
@@ -191,7 +194,8 @@ class Scheduler:
                 continue
             slot = free.pop(0)
             try:
-                first = self.engine.admit(slot, req.prompt_ids, req.opts)
+                first = self.engine.admit(slot, req.prompt_ids, req.opts,
+                                          embeds=req.embeds)
             except Exception as e:  # surfacing engine errors to the caller
                 req.error = str(e)
                 req.out.put(("error", str(e)))

@@ -140,9 +140,13 @@ class LoadedModel:
                  system: Optional[str] = None,
                  default_params: Optional[Dict] = None,
                  mesh=None, ecfg: Optional[EngineConfig] = None,
-                 digest: str = ""):
+                 digest: str = "", vision: Optional[Tuple] = None):
         self.name = name
         self.cfg = cfg
+        # (VisionConfig, vision params) for multimodal models (llava) —
+        # the mmproj layer the reference delegates to llama.cpp's clip
+        self.vision = vision
+        self._vision_fns = {}
         self.digest = digest
         self.tokenizer = tokenizer
         self.template = Template(template or DEFAULT_TEMPLATE)
@@ -169,6 +173,48 @@ class LoadedModel:
         METRICS.gauge_fn("tpu_model_queue_depth",
                          lambda: (lm := wself()) is not None
                          and lm.scheduler._waiting.qsize() or 0)
+
+    # ------------------------------------------------------------------
+    # multimodal (llava): image bytes → projected embeddings → spliced
+    # prompt embedding sequence handed to the engine's embeds admission
+    # ------------------------------------------------------------------
+    def encode_images(self, images_u8) -> "np.ndarray":
+        """List of uint8 [H, W, 3] arrays → [n_img, n_patches, D]."""
+        from ..models import vision as V
+        import jax
+        vcfg, vparams = self.vision
+        batch = np.stack([V.preprocess(im, vcfg) for im in images_u8])
+        fn = self._vision_fns.get("encode")
+        if fn is None:
+            fn = jax.jit(lambda p, x: V.encode(vcfg, p, x))
+            self._vision_fns["encode"] = fn
+        return np.asarray(fn(vparams, jnp.asarray(batch)))
+
+    def splice_images(self, ids, images_u8):
+        """Text ids + decoded images → (padded_ids, embeds [n, D]).
+
+        Image tokens are inserted after the BOS token (llava convention:
+        image context precedes the instruction); padded_ids carry a pad id
+        at image positions (only the repeat-penalty counts see them).
+        """
+        import jax
+        img = self.encode_images(images_u8)          # [n_img, N, D]
+        n_img, N, D = img.shape
+        fn = self._vision_fns.get("embed_ids")
+        if fn is None:
+            from ..models.decoder import _embed
+            fn = jax.jit(lambda p, t: _embed(self.cfg, p, t))
+            self._vision_fns["embed_ids"] = fn
+        text = np.asarray(fn(self.engine.params,
+                             jnp.asarray(np.asarray(ids, np.int32)[None]))
+                          )[0].astype(np.float32)    # [n_text, D]
+        cut = 1 if (ids and self.tokenizer.add_bos
+                    and ids[0] == self.tokenizer.bos_id) else 0
+        embeds = np.concatenate(
+            [text[:cut]] + [img.reshape(n_img * N, D)] + [text[cut:]], axis=0)
+        pad = [0] * (n_img * N)
+        padded_ids = list(ids[:cut]) + pad + list(ids[cut:])
+        return padded_ids, embeds
 
     # ------------------------------------------------------------------
     def render_prompt(self, prompt: str, system: Optional[str] = None,
@@ -203,7 +249,8 @@ class LoadedModel:
                         options: Optional[Dict] = None,
                         context: Optional[List[int]] = None,
                         raw: bool = False,
-                        cancel_event: Optional[threading.Event] = None
+                        cancel_event: Optional[threading.Event] = None,
+                        images: Optional[List] = None
                         ) -> Iterator[Tuple[str, Optional[GenerateResult]]]:
         """Yields (text_piece, None)… then ("", final GenerateResult).
 
@@ -218,13 +265,21 @@ class LoadedModel:
         # BOS only at the start of a fresh sequence (continuations carry it)
         ids += self.tokenizer.encode(
             prompt_text, add_bos=(not ids) and self.tokenizer.add_bos)
+        embeds = None
+        if images:
+            if self.vision is None:
+                raise ValueError(
+                    f"model {self.name} has no vision projector; it cannot "
+                    f"accept images")
+            ids, embeds = self.splice_images(ids, images)
         max_new = min(num_predict, self.engine.max_seq - len(ids) - 1)
         if max_new < 1:
             raise ValueError(
                 f"prompt of {len(ids)} tokens leaves no room to generate "
                 f"within the {self.engine.max_seq}-token context")
         req = self.scheduler.submit(ids, so, max_new,
-                                    eog_ids=frozenset(self.tokenizer.eog_ids))
+                                    eog_ids=frozenset(self.tokenizer.eog_ids),
+                                    embeds=embeds)
         return _OwnedStream(
             self._stream(req, stops, ids, max_new, t0, cancel_event), req)
 

@@ -46,8 +46,25 @@ from ..tokenizer import Tokenizer
 from .metrics import GLOBAL as METRICS
 from .modelfile import Modelfile, parse_modelfile, params_json
 from .names import ModelName
-from .registry import (MT_LICENSE, MT_MODEL, MT_PARAMS, MT_SYSTEM,
-                       MT_TEMPLATE, ModelStore, RegistryClient, RegistryError)
+from .registry import (MT_LICENSE, MT_MODEL, MT_PARAMS, MT_PROJECTOR,
+                       MT_SYSTEM, MT_TEMPLATE, ModelStore, RegistryClient,
+                       RegistryError)
+
+
+def _decode_images(images):
+    """Ollama API images: list of base64 strings → uint8 [H, W, 3] arrays
+    (PIL handles the container format). None/[] → None."""
+    if not images:
+        return None
+    import base64
+    import io
+    from PIL import Image
+    out = []
+    for b64 in images:
+        raw = base64.b64decode(b64) if isinstance(b64, str) else bytes(b64)
+        im = Image.open(io.BytesIO(raw)).convert("RGB")
+        out.append(np.asarray(im, np.uint8))
+    return out
 
 
 def _now_iso() -> str:
@@ -178,13 +195,24 @@ class ModelManager:
                 from ..ops.quant import quantize_params
                 params = quantize_params(params)
             params = jax.tree_util.tree_map(jnp.asarray, params)
+            vision = None
+            proj_path = layers.get(MT_PROJECTOR)
+            if proj_path:
+                # llava-family mmproj layer: CLIP tower + MLP projector
+                from ..gguf.reader import GGUFFile
+                from ..gguf.transcode import (load_vision_params,
+                                              vision_config_from_gguf)
+                with GGUFFile(proj_path) as vf:
+                    vcfg = vision_config_from_gguf(vf)
+                    vparams = load_vision_params(vf, vcfg)
+                vision = (vcfg, jax.tree_util.tree_map(jnp.asarray, vparams))
             ecfg = self.ecfg or EngineConfig(
                 max_seq_len=min(cfg.max_seq_len,
                                 int(default_params.get("num_ctx", 4096))))
             self.loaded = LoadedModel(
                 name.short, cfg, params, tokenizer, template=template,
                 system=system, default_params=default_params,
-                mesh=self.mesh, ecfg=ecfg, digest=digest)
+                mesh=self.mesh, ecfg=ecfg, digest=digest, vision=vision)
             return self.loaded
 
     def require_loaded(self, ref: str) -> LoadedModel:
@@ -533,7 +561,8 @@ class Handler(BaseHTTPRequestHandler):
         text_prompt = prompt if raw else lm.render_prompt(
             prompt, system=body.get("system"), template=body.get("template"))
         gen = lm.generate_stream(text_prompt, options=body.get("options"),
-                                 context=body.get("context"), raw=raw)
+                                 context=body.get("context"), raw=raw,
+                                 images=_decode_images(body.get("images")))
         if stream:
             self._start_stream()
             for piece, final in gen:
@@ -574,7 +603,11 @@ class Handler(BaseHTTPRequestHandler):
         messages = body.get("messages", [])
         stream = body.get("stream", True)
         prompt = lm.render_chat(messages, template=body.get("template"))
-        gen = lm.generate_stream(prompt, options=body.get("options"))
+        images = []
+        for m in messages:
+            images.extend(m.get("images") or [])
+        gen = lm.generate_stream(prompt, options=body.get("options"),
+                                 images=_decode_images(images))
         if stream:
             self._start_stream()
             for piece, final in gen:

@@ -43,6 +43,7 @@ MT_SYSTEM = "application/vnd.ollama.image.system"
 MT_PARAMS = "application/vnd.ollama.image.params"
 MT_LICENSE = "application/vnd.ollama.image.license"
 MT_ADAPTER = "application/vnd.ollama.image.adapter"
+MT_PROJECTOR = "application/vnd.ollama.image.projector"
 MANIFEST_ACCEPT = ("application/vnd.docker.distribution.manifest.v2+json, "
                    "application/vnd.oci.image.manifest.v1+json")
 

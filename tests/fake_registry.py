@@ -25,8 +25,12 @@ class FakeRegistry:
 
     def add_model(self, ns: str, name: str, tag: str, gguf_bytes: bytes,
                   template: str = None, params: dict = None,
-                  system: str = None):
+                  system: str = None, projector_bytes: bytes = None):
         layers = [{"mediaType": MT_MODEL, **self.add_blob(gguf_bytes)}]
+        if projector_bytes:
+            from ollama_operator_tpu.server.registry import MT_PROJECTOR
+            layers.append({"mediaType": MT_PROJECTOR,
+                           **self.add_blob(projector_bytes)})
         if template:
             layers.append({"mediaType": MT_TEMPLATE,
                            **self.add_blob(template.encode())})

@@ -1,0 +1,227 @@
+"""LLaVA multimodal: vision tower correctness, mmproj GGUF transcode,
+embeds prefill equivalence, engine multimodal admission, and the full
+HTTP path with a base64 image."""
+
+import base64
+import io
+import json
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ollama_operator_tpu.gguf import writer as W
+from ollama_operator_tpu.gguf.reader import GGUFFile
+from ollama_operator_tpu.gguf import transcode as TC
+from ollama_operator_tpu.models import config as cfglib
+from ollama_operator_tpu.models import decoder
+from ollama_operator_tpu.models import vision as V
+from ollama_operator_tpu.runtime.engine import Engine, EngineConfig, SlotOptions
+
+rng = np.random.default_rng(21)
+F32 = jnp.float32
+
+
+def test_patchify_matches_naive():
+    cfg = V.TINY_VISION
+    img = rng.standard_normal((2, 16, 16, 3)).astype(np.float32)
+    got = np.asarray(V.patchify(cfg, jnp.asarray(img)))
+    P, n = cfg.patch_size, cfg.n_patches_side
+    for b in range(2):
+        for pi in range(n):
+            for pj in range(n):
+                patch = img[b, pi * P:(pi + 1) * P, pj * P:(pj + 1) * P, :]
+                want = patch.transpose(2, 0, 1).reshape(-1)  # (c, i, j)
+                np.testing.assert_allclose(got[b, pi * n + pj], want)
+
+
+def test_encode_shape_and_select_layer():
+    cfg = V.TINY_VISION
+    params = V.init_params(cfg, jax.random.PRNGKey(0))
+    img = jnp.asarray(rng.standard_normal((2, 16, 16, 3)), F32)
+    out = V.encode(cfg, params, img)
+    assert out.shape == (2, cfg.n_patches, cfg.proj_dim)
+    # select_layer=-2 must differ from running all layers
+    import dataclasses
+    cfg_all = dataclasses.replace(cfg, select_layer=-1)
+    out_all = V.encode(cfg_all, params, img)
+    assert not np.allclose(np.asarray(out), np.asarray(out_all))
+
+
+def write_tiny_mmproj(path, cfg, params):
+    """Export vision params in llama.cpp clip mmproj conventions."""
+    w = W.GGUFWriter(path)
+    P_ = lambda a: np.ascontiguousarray(np.asarray(a, np.float32))
+    w.add_meta("general.architecture", "clip")
+    w.add_meta("clip.vision.image_size", cfg.image_size)
+    w.add_meta("clip.vision.patch_size", cfg.patch_size)
+    w.add_meta("clip.vision.embedding_length", cfg.width)
+    w.add_meta("clip.vision.feed_forward_length", cfg.ffn_dim)
+    w.add_meta("clip.vision.block_count", cfg.n_layers)
+    w.add_meta("clip.vision.attention.head_count", cfg.n_heads)
+    w.add_meta("clip.vision.attention.layer_norm_epsilon", cfg.norm_eps)
+    Pp = cfg.patch_size
+    w.add_tensor_f32("v.patch_embd.weight",
+                     P_(params["patch_emb"]).T.reshape(cfg.width, 3, Pp, Pp))
+    w.add_tensor_f32("v.class_embd", P_(params["class_emb"]))
+    w.add_tensor_f32("v.position_embd.weight", P_(params["pos_emb"]))
+    w.add_tensor_f32("v.pre_ln.weight", P_(params["pre_ln_w"]))
+    w.add_tensor_f32("v.pre_ln.bias", P_(params["pre_ln_b"]))
+    w.add_tensor_f32("mm.0.weight", P_(params["mm_0"]).T)
+    w.add_tensor_f32("mm.0.bias", P_(params["mm_0_b"]))
+    w.add_tensor_f32("mm.2.weight", P_(params["mm_2"]).T)
+    w.add_tensor_f32("mm.2.bias", P_(params["mm_2_b"]))
+    lp = params["layers"]
+    for i in range(cfg.n_layers):
+        pre = f"v.blk.{i}."
+        w.add_tensor_f32(pre + "ln1.weight", P_(lp["ln1_w"][i]))
+        w.add_tensor_f32(pre + "ln1.bias", P_(lp["ln1_b"][i]))
+        w.add_tensor_f32(pre + "ln2.weight", P_(lp["ln2_w"][i]))
+        w.add_tensor_f32(pre + "ln2.bias", P_(lp["ln2_b"][i]))
+        for nm, key in (("attn_q", "wq"), ("attn_k", "wk"),
+                        ("attn_v", "wv"), ("attn_out", "wo")):
+            w.add_tensor_f32(pre + nm + ".weight", P_(lp[key][i]).T)
+            w.add_tensor_f32(pre + nm + ".bias",
+                             P_(lp["b" + key[1]][i]))
+        w.add_tensor_f32(pre + "ffn_up.weight", P_(lp["w_up"][i]).T)
+        w.add_tensor_f32(pre + "ffn_up.bias", P_(lp["b_up"][i]))
+        w.add_tensor_f32(pre + "ffn_down.weight", P_(lp["w_down"][i]).T)
+        w.add_tensor_f32(pre + "ffn_down.bias", P_(lp["b_down"][i]))
+    w.write()
+
+
+def test_mmproj_gguf_roundtrip(tmp_path):
+    cfg = V.TINY_VISION
+    params = V.init_params(cfg, jax.random.PRNGKey(1))
+    path = str(tmp_path / "mmproj.gguf")
+    write_tiny_mmproj(path, cfg, params)
+    with GGUFFile(path) as f:
+        cfg2 = TC.vision_config_from_gguf(f)
+        assert (cfg2.image_size, cfg2.patch_size, cfg2.width) == (
+            cfg.image_size, cfg.patch_size, cfg.width)
+        # proj_dim falls back to mm.2 out-dim
+        assert cfg2.proj_dim == cfg.proj_dim
+        # mmproj files are pre-trimmed by the llava converter → run all
+        assert cfg2.select_layer == -1
+        p2 = TC.load_vision_params(f, cfg2)
+    img = jnp.asarray(rng.standard_normal((1, 16, 16, 3)), F32)
+    import dataclasses
+    ref = V.encode(dataclasses.replace(cfg, select_layer=-1), params, img)
+    got = V.encode(cfg2, jax.tree_util.tree_map(jnp.asarray, p2), img)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_prefill_inputs_embeds_equivalent():
+    cfg = cfglib.PRESETS["tiny"]
+    params = decoder.init_params(cfg, jax.random.PRNGKey(0), dtype=F32)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 9), 0,
+                                cfg.vocab_size)
+    ref, rk, rv = decoder.prefill_chunk(params, cfg, tokens)
+    embeds = params["tok_emb"][tokens]
+    got, gk, gv = decoder.prefill_chunk(params, cfg, tokens,
+                                        inputs_embeds=embeds)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(gk), np.asarray(rk))
+
+
+def test_engine_admit_embeds_matches_tokens():
+    cfg = cfglib.PRESETS["tiny"]
+    params = decoder.init_params(cfg, jax.random.PRNGKey(2), dtype=F32)
+    ecfg = EngineConfig(max_slots=2, max_seq_len=64, min_prefill_bucket=8,
+                        cache_dtype=F32)
+    opts = SlotOptions(temperature=0.0)
+    prompt = np.asarray(rng.integers(1, cfg.vocab_size, 11), np.int32)
+
+    e1 = Engine(cfg, params, ecfg=ecfg)
+    t1 = [e1.admit(0, prompt, opts)]
+    t1 += [int(t[0]) for t in e1.decode_n(4)]
+
+    e2 = Engine(cfg, params, ecfg=ecfg)
+    embeds = np.asarray(params["tok_emb"])[prompt].astype(np.float32)
+    t2 = [e2.admit(0, prompt, opts, embeds=embeds)]
+    t2 += [int(t[0]) for t in e2.decode_n(4)]
+    assert t1 == t2
+
+
+@pytest.fixture(scope="module")
+def mm_stack(tmp_path_factory):
+    """Tiny llava: tiny llama LLM + tiny vision tower through the full
+    registry → pull → server stack."""
+    import jax.numpy as jnp_
+    from fake_registry import FakeRegistry
+    from test_transcode import write_tiny_llama_gguf
+    from ollama_operator_tpu.runtime.engine import EngineConfig
+    from ollama_operator_tpu.server.app import ModelManager, serve
+
+    tmp = tmp_path_factory.mktemp("mm")
+    cfg = cfglib.PRESETS["tiny"]
+    params = decoder.init_params(cfg, jax.random.PRNGKey(0), dtype=F32)
+    gguf_path = str(tmp / "tiny.gguf")
+    write_tiny_llama_gguf(gguf_path, cfg, params)
+
+    import dataclasses
+    vcfg = dataclasses.replace(V.TINY_VISION, proj_dim=cfg.dim)
+    vparams = V.init_params(vcfg, jax.random.PRNGKey(3))
+    proj_path = str(tmp / "mmproj.gguf")
+    write_tiny_mmproj(proj_path, vcfg, vparams)
+
+    reg = FakeRegistry()
+    url = reg.start()
+    reg.add_model("library", "tinyllava", "latest",
+                  open(gguf_path, "rb").read(),
+                  template="{{ .Prompt }}",
+                  params={"temperature": 0.0, "num_predict": 6},
+                  projector_bytes=open(proj_path, "rb").read())
+    manager = ModelManager(str(tmp / "store"), cache_dir=str(tmp / "cache"),
+                           ecfg=EngineConfig(max_slots=2, max_seq_len=128,
+                                             cache_dtype=jnp_.float32,
+                                             min_prefill_bucket=16),
+                           engine_dtype="float32")
+    httpd = serve(manager, "127.0.0.1", 0)
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    yield {"base": base, "registry_url": url}
+    httpd.shutdown()
+    reg.stop()
+
+
+def _png_b64(arr_u8):
+    from PIL import Image
+    buf = io.BytesIO()
+    Image.fromarray(arr_u8, "RGB").save(buf, "PNG")
+    return base64.b64encode(buf.getvalue()).decode()
+
+
+def test_generate_with_image_e2e(mm_stack):
+    ref = f"{mm_stack['registry_url']}/library/tinyllava:latest"
+    req = urllib.request.Request(
+        mm_stack["base"] + "/api/pull",
+        data=json.dumps({"model": ref, "stream": False}).encode(),
+        headers={"Content-Type": "application/json"})
+    urllib.request.urlopen(req, timeout=300)
+
+    img = rng.integers(0, 255, (20, 20, 3), dtype=np.uint8)
+    body = {"model": ref, "prompt": "describe", "stream": False,
+            "images": [_png_b64(img)],
+            "options": {"temperature": 0, "num_predict": 4}}
+    req = urllib.request.Request(
+        mm_stack["base"] + "/api/generate", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    out = json.loads(urllib.request.urlopen(req, timeout=600).read())
+    assert out["done"]
+    assert out["eval_count"] >= 1
+
+    # the same prompt without the image: the prompt_eval_count difference
+    # must be exactly the image token count (llava counts image tokens)
+    body2 = {"model": ref, "prompt": "describe", "stream": False,
+             "options": {"temperature": 0, "num_predict": 4}}
+    req2 = urllib.request.Request(
+        mm_stack["base"] + "/api/generate", data=json.dumps(body2).encode(),
+        headers={"Content-Type": "application/json"})
+    out2 = json.loads(urllib.request.urlopen(req2, timeout=600).read())
+    n_img_tokens = V.TINY_VISION.n_patches
+    assert (out["prompt_eval_count"] - out2["prompt_eval_count"]
+            == n_img_tokens)
