@@ -170,10 +170,23 @@ def encode(cfg: VisionConfig, params: Dict[str, Any], images: jax.Array
 
 
 def preprocess(img_hwc_u8: np.ndarray, cfg: VisionConfig) -> np.ndarray:
-    """uint8 [H, W, 3] → CLIP-normalised float32 [size, size, 3] (bilinear
-    resize; llava's stock preprocessing is a resize to the square input)."""
+    """uint8 [H, W, 3] → CLIP-normalised float32 [size, size, 3].
+
+    llava-1.5 convention: pad to square with the CLIP mean color (no
+    aspect-ratio distortion), then bicubic-resize to the model's input
+    size — matching llama.cpp's clip preprocessing so identical requests
+    see the same pixels as the reference stack."""
     from PIL import Image
-    im = Image.fromarray(img_hwc_u8, "RGB").resize(
-        (cfg.image_size, cfg.image_size), Image.BICUBIC)
+    h, w = img_hwc_u8.shape[:2]
+    if h != w:
+        side = max(h, w)
+        mean_rgb = tuple(int(round(c * 255)) for c in CLIP_MEAN)
+        canvas = Image.new("RGB", (side, side), mean_rgb)
+        canvas.paste(Image.fromarray(img_hwc_u8, "RGB"),
+                     ((side - w) // 2, (side - h) // 2))
+        im = canvas
+    else:
+        im = Image.fromarray(img_hwc_u8, "RGB")
+    im = im.resize((cfg.image_size, cfg.image_size), Image.BICUBIC)
     x = np.asarray(im, np.float32) / 255.0
     return (x - CLIP_MEAN) / CLIP_STD

@@ -180,18 +180,21 @@ class Engine:
             step_impl = partial(decoder.forward_with_cache, cfg=cfg)
             self._bucketed_attn = True
 
-        @partial(jax.jit, donate_argnums=(1, 2, 3, 4, 5))
-        def _admit(params, k_cache, v_cache, lengths, counts, last_tokens,
-                   tokens, slot, n_valid, sp_row, key):
-            """Prefill a padded B=1 chunk AND insert it into the slot state
-            — one device program, one host round-trip per admission."""
-            logits, ks, vs = prefill_impl(params, tokens=tokens)
+        def _insert_prefilled(k_cache, v_cache, lengths, counts,
+                              last_tokens, logits, ks, vs, tokens, slot,
+                              n_valid, sp_row, key):
+            """Shared admission tail: sample the first token from the
+            prefill logits and install chunk K/V + slot state. Image pad
+            positions carry id == vocab_size, which the scatter-add drops
+            (out of bounds) — image tokens never enter the penalty
+            counts."""
             last = jax.lax.dynamic_index_in_dim(
                 logits[0], n_valid - 1, axis=0, keepdims=False)
             T = tokens.shape[1]
             valid = (jnp.arange(T) < n_valid).astype(jnp.int32)
             counts_row = jnp.zeros((cfg.vocab_size,), jnp.int32
-                                   ).at[tokens[0]].add(valid)
+                                   ).at[tokens[0]].add(valid,
+                                                       mode="drop")
             tok = sampling.sample(last[None], counts_row[None], sp_row,
                                   key[None])[0]
             counts_row = counts_row.at[tok].add(1)
@@ -206,33 +209,29 @@ class Engine:
                               last_tokens))
 
         @partial(jax.jit, donate_argnums=(1, 2, 3, 4, 5))
+        def _admit(params, k_cache, v_cache, lengths, counts, last_tokens,
+                   tokens, slot, n_valid, sp_row, key):
+            """Prefill a padded B=1 chunk AND insert it into the slot state
+            — one device program, one host round-trip per admission."""
+            logits, ks, vs = prefill_impl(params, tokens=tokens)
+            return _insert_prefilled(k_cache, v_cache, lengths, counts,
+                                     last_tokens, logits, ks, vs, tokens,
+                                     slot, n_valid, sp_row, key)
+
+        @partial(jax.jit, donate_argnums=(1, 2, 3, 4, 5))
         def _admit_embeds(params, k_cache, v_cache, lengths, counts,
                           last_tokens, tokens, embeds, slot, n_valid, sp_row,
                           key):
             """Multimodal admission: like _admit but prefilling from a
             precomputed [1, T, D] embedding sequence (image tokens spliced
-            into text embeddings); ``tokens`` still feeds the repeat-penalty
-            counts (image positions carry a pad id)."""
+            into text embeddings); ``tokens`` feeds the penalty counts with
+            id == vocab_size at image positions (dropped by the scatter).
+            The embedding lookup never sees ``tokens``."""
             logits, ks, vs = prefill_impl(params, tokens=tokens,
                                           inputs_embeds=embeds)
-            last = jax.lax.dynamic_index_in_dim(
-                logits[0], n_valid - 1, axis=0, keepdims=False)
-            T = tokens.shape[1]
-            valid = (jnp.arange(T) < n_valid).astype(jnp.int32)
-            counts_row = jnp.zeros((cfg.vocab_size,), jnp.int32
-                                   ).at[tokens[0]].add(valid)
-            tok = sampling.sample(last[None], counts_row[None], sp_row,
-                                  key[None])[0]
-            counts_row = counts_row.at[tok].add(1)
-            k_cache = jax.lax.dynamic_update_slice(
-                k_cache, ks.astype(k_cache.dtype), (0, slot, 0, 0, 0))
-            v_cache = jax.lax.dynamic_update_slice(
-                v_cache, vs.astype(v_cache.dtype), (0, slot, 0, 0, 0))
-            lengths = lengths.at[slot].set(n_valid)
-            counts = counts.at[slot].set(counts_row)
-            last_tokens = last_tokens.at[slot].set(tok)
-            return (tok, *pin(k_cache, v_cache, lengths, counts,
-                              last_tokens))
+            return _insert_prefilled(k_cache, v_cache, lengths, counts,
+                                     last_tokens, logits, ks, vs, tokens,
+                                     slot, n_valid, sp_row, key)
 
         def _decode_body(params, k_cache, v_cache, lengths, counts,
                          last_tokens, sp, keys, active, attn_len=None):

@@ -212,7 +212,9 @@ class LoadedModel:
                     and ids[0] == self.tokenizer.bos_id) else 0
         embeds = np.concatenate(
             [text[:cut]] + [img.reshape(n_img * N, D)] + [text[cut:]], axis=0)
-        pad = [0] * (n_img * N)
+        # pad id == vocab_size: definitively not a real token, and the
+        # engine's penalty-count scatter drops it as out-of-bounds
+        pad = [self.cfg.vocab_size] * (n_img * N)
         padded_ids = list(ids[:cut]) + pad + list(ids[cut:])
         return padded_ids, embeds
 
@@ -266,6 +268,7 @@ class LoadedModel:
         ids += self.tokenizer.encode(
             prompt_text, add_bos=(not ids) and self.tokenizer.add_bos)
         embeds = None
+        context_ids = ids
         if images:
             if self.vision is None:
                 raise ValueError(
@@ -280,14 +283,20 @@ class LoadedModel:
         req = self.scheduler.submit(ids, so, max_new,
                                     eog_ids=frozenset(self.tokenizer.eog_ids),
                                     embeds=embeds)
+        # returned context carries only REAL token ids: a continuation
+        # re-prefills from context without the image, so image pad ids
+        # must not leak into it (they would re-enter as garbage tokens)
         return _OwnedStream(
-            self._stream(req, stops, ids, max_new, t0, cancel_event), req)
+            self._stream(req, stops, context_ids, max_new, t0, cancel_event),
+            req)
 
     def _stream(self, req, stops, ids, max_new, t0, cancel_event
                 ) -> Iterator[Tuple[str, Optional[GenerateResult]]]:
         sd = StreamDecoder(self.tokenizer)
         sm = StopMatcher(stops)
-        result = GenerateResult(prompt_tokens=len(ids))
+        # prompt_eval_count includes image tokens (llava counts them);
+        # ``ids`` here is the context view, which excludes the image pads
+        result = GenerateResult(prompt_tokens=req.stats.n_prompt)
         all_ids: List[int] = []
         finished = False
         try:

@@ -204,7 +204,7 @@ class ModelManager:
                                               vision_config_from_gguf)
                 with GGUFFile(proj_path) as vf:
                     vcfg = vision_config_from_gguf(vf)
-                    vparams = load_vision_params(vf, vcfg)
+                    vparams = load_vision_params(vf, vcfg, dtype=dt)
                 vision = (vcfg, jax.tree_util.tree_map(jnp.asarray, vparams))
             ecfg = self.ecfg or EngineConfig(
                 max_seq_len=min(cfg.max_seq_len,
