@@ -25,6 +25,7 @@ Two matmul paths:
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Any, Dict, Optional
 
 import jax
@@ -77,7 +78,7 @@ def quantize_groupwise(w, group: int = GROUP) -> Dict[str, Any]:
     return {"q": q.reshape(K, O), "s": s[:, 0, :]}
 
 
-@jax.jit
+@partial(jax.jit, donate_argnums=(0,))
 def _quantize_jax_impl(w, group: int = GROUP):
     *lead, K, O = w.shape
     wr = w.astype(jnp.float32).reshape(*lead, K // group, group, O)
@@ -107,16 +108,33 @@ def qmm(x: jax.Array, qw: Dict[str, Any],
         out_dtype: Optional[Any] = None) -> jax.Array:
     """x [..., K] @ dequant(qw [K, O]) with group-wise scales.
 
-    Grouped partial formulation so the scale multiply stays outside the
-    inner dot (XLA fuses the int8→bf16 convert into the dot's read stream;
-    the [..., K/g, O] partial contracts immediately):
+    Two formulations, picked by the (static) token count N = prod(lead):
 
-        y[.., o] = Σ_G s[G, o] · Σ_{k∈G} x[.., k] · q[k, o]
+    - **decode** (N small): grouped partial, keeping the scale multiply
+      outside the inner dot so the int8→bf16 convert fuses into the dot's
+      read stream and the weight is read once at 1 byte/element:
+
+          y[.., o] = Σ_G s[G, o] · Σ_{k∈G} x[.., k] · q[k, o]
+
+      The [N, K/g, O] fp32 partial is tiny for decode batches.
+    - **prefill** (N large): that partial scales as N × weight-bytes×4 —
+      gigabytes per matmul at N=128+ — so dequantize the weight to one
+      [K, O] transient instead and run a single dense dot; prefill is
+      MXU-bound, the extra weight-write bandwidth is noise there.
     """
     q, s = qw["q"], qw["s"]
     K, O = q.shape
     G = s.shape[0]
     g = K // G
+    N = 1
+    for d in x.shape[:-1]:
+        N *= d
+    if N > 16:
+        w = (q.reshape(G, g, O).astype(x.dtype)
+             * s[:, None, :].astype(x.dtype)).reshape(K, O)
+        y = jnp.einsum("...k,ko->...o", x, w,
+                       preferred_element_type=jnp.float32)
+        return y.astype(out_dtype or x.dtype)
     xr = x.reshape(*x.shape[:-1], G, g)
     qr = q.reshape(G, g, O)
     partial = jnp.einsum("...Gg,Ggo->...Go", xr, qr.astype(x.dtype),
@@ -152,17 +170,25 @@ def quantize_params(params: Dict[str, Any], group: int = GROUP,
     Works on numpy (host) or jax (on-device) arrays; stacked [L, ...]
     layer leaves quantize along their input axis, which is second-to-last
     either way.
+
+    On-device (jax) sources are DONATED leaf by leaf — each bf16 leaf's
+    HBM is released as its int8 replacement materialises, so peak memory
+    is the bf16 tree + one leaf, never bf16 + int8 trees together (a 7B
+    bf16 tree alone is 13.4 GB of a v5e chip's 16).
     """
     out: Dict[str, Any] = {}
-    for k, v in params.items():
+    for k in list(params.keys()):
+        v = params[k]
         if k == "layers":
-            out[k] = {
-                lk: (quantize_groupwise(lv, group)
-                     if lk in keys_layer else lv)
-                for lk, lv in v.items()
-            }
+            lo = {}
+            for lk in list(v.keys()):
+                if lk in keys_layer:
+                    lo[lk] = quantize_groupwise(v.pop(lk), group)
+                else:
+                    lo[lk] = v[lk]
+            out[k] = lo
         elif k in keys_top:
-            out[k] = quantize_groupwise(v, group)
+            out[k] = quantize_groupwise(params.pop(k), group)
         else:
             out[k] = v
     return out
