@@ -102,10 +102,13 @@ def main() -> None:
         mesh = make_mesh(MeshPlan.for_devices(len(devs), tp=tp))
         log(f"mesh: {dict(mesh.shape)}")
 
-    chunk = int(os.environ.get("BENCH_DECODE_CHUNK", "8"))
+    chunk = int(os.environ.get("BENCH_DECODE_CHUNK", "16"))
+    from ollama_operator_tpu.runtime.engine import resolve_cache_dtype
+    kv_dtype = resolve_cache_dtype(os.environ.get("BENCH_KV_DTYPE", "int8"))
     eng = Engine(cfg, params, mesh=mesh,
                  ecfg=EngineConfig(max_slots=slots, max_seq_len=seq,
-                                   decode_chunk=chunk))
+                                   decode_chunk=chunk,
+                                   cache_dtype=kv_dtype))
 
     rng = np.random.default_rng(0)
     prompts = rng.integers(1, cfg.vocab_size, size=(slots, prompt_len),

@@ -379,8 +379,10 @@ def forward_with_cache(params: Params, cfg: ModelConfig, tokens: jax.Array,
              (new K/V land below it); None = S.
     Returns (logits [B, T, V], k_cache, v_cache).
     """
+    from ..ops.quant_cache import is_quantized_cache
     B, T = tokens.shape
-    L, _, _, S, _ = k_cache.shape
+    kc_arr = k_cache["q"] if is_quantized_cache(k_cache) else k_cache
+    L, _, _, S, _ = kc_arr.shape
     A = S if attn_len is None else min(attn_len, S)
     scale = 1.0 / math.sqrt(cfg.head_dim)
     positions = lengths[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
@@ -398,10 +400,22 @@ def forward_with_cache(params: Params, cfg: ModelConfig, tokens: jax.Array,
 
     x = _embed(cfg, params, tokens)
 
+    attn_fn = write_fn = None
+    if is_quantized_cache(k_cache):        # int8 KV cache (ops/quant_cache)
+        from ..ops import quant_cache as QC
+
+        def attn_fn(q, kc, vc, pos):       # noqa: F811
+            return QC.attend_hf_q(q, kc, vc, mask, scale, cfg.attn_softcap,
+                                  attn_len=A)
+
+        def write_fn(kc, vc, k, v, pos):   # noqa: F811
+            return QC.cache_write_q(kc, vc, k, v, pos)
+
     def body(x, layer_in):
         lp, kc, vc = layer_in
         x, kc, vc = _block_cached(cfg, lp, x, cos, sin, kc, vc, positions,
-                                  mask, scale, attn_len=A)
+                                  mask, scale, attn_len=A,
+                                  attn_fn=attn_fn, write_fn=write_fn)
         return x, (kc, vc)
 
     x, (k_cache, v_cache) = lax.scan(body, x,

@@ -1,0 +1,91 @@
+"""int8 KV cache: quantized storage + attention over it.
+
+Decode attention traffic is the KV cache itself; storing K/V as int8 with
+one f32 scale per (position, head) halves that traffic and doubles how
+much context fits in HBM — the same lever llama.cpp pulls with its
+quantized KV options inside the reference's delegated container.
+
+Layout mirrors the bf16 cache, plus a scale array one axis short:
+
+    q [.., KvH, S, hd] int8      s [.., KvH, S] f32
+
+The arithmetic stays exact-shaped with the dense path (ops/attention.py
+``attend_hf``): scores pick up the key scale AFTER the q·k dot (the scale
+is per key position, so it factors out), and the value scale folds into
+the probabilities before the p·v dot — dequantized V tensors never
+materialise:
+
+    scores[.., t, j] = (q_t · kq_j) * ks_j
+    out[.., t]       = Σ_j (p_tj * vs_j) · vq_j
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .attention import NEG_INF, softcap_scores
+
+
+def quantize_kv(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """[..., hd] float → (int8 [..., hd], f32 scale [...])."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    s = amax / 127.0
+    q = jnp.round(x.astype(jnp.float32) / jnp.maximum(s[..., None], 1e-30))
+    return jnp.clip(q, -127, 127).astype(jnp.int8), s
+
+
+def cache_write_q(kc: Dict, vc: Dict, k, v, write_pos) -> Tuple[Dict, Dict]:
+    """Quantize fresh K/V [B, KvH, T, hd] and scatter into the slot cache
+    at absolute positions ``write_pos`` [B, T] (same indexing as the dense
+    write in models/decoder._block_cached)."""
+    B, KvH, T, hd = k.shape
+    kq, ks = quantize_kv(k)
+    vq, vs = quantize_kv(v)
+    bidx = jnp.arange(B)[:, None, None]
+    hidx = jnp.arange(KvH)[None, :, None]
+    pidx = write_pos[:, None, :]
+    kc = {"q": kc["q"].at[bidx, hidx, pidx].set(kq),
+          "s": kc["s"].at[bidx, hidx, pidx].set(ks)}
+    vc = {"q": vc["q"].at[bidx, hidx, pidx].set(vq),
+          "s": vc["s"].at[bidx, hidx, pidx].set(vs)}
+    return kc, vc
+
+
+def attend_hf_q(q, kc: Dict, vc: Dict, mask, scale: float,
+                softcap: float = 0.0, attn_len=None, compute_dtype=None):
+    """Grouped-query attention against the quantized head-first cache.
+
+    q [B, T, H, hd]; kc/vc {"q" [B, KvH, S, hd] int8, "s" [B, KvH, S]};
+    mask [B, 1, T, A] additive. → [B, T, H, hd] (q.dtype).
+    """
+    B, T, H, hd = q.shape
+    kq, ks = kc["q"], kc["s"]
+    vq, vs = vc["q"], vc["s"]
+    if attn_len is not None and attn_len < kq.shape[2]:
+        kq, ks = kq[:, :, :attn_len], ks[:, :, :attn_len]
+        vq, vs = vq[:, :, :attn_len], vs[:, :, :attn_len]
+    KvH = kq.shape[1]
+    G = H // KvH
+    dt = compute_dtype or q.dtype
+    qg = q.reshape(B, T, KvH, G, hd)
+    scores = jnp.einsum("btkgh,bksh->bkgts", qg, kq.astype(dt),
+                        preferred_element_type=jnp.float32)
+    scores = scores * ks[:, :, None, None, :]          # key scale, per j
+    scores = softcap_scores(scores * scale, softcap)
+    scores = scores + mask[:, :, None, :, :]
+    probs = jax.nn.softmax(scores, axis=-1)
+    pv = (probs * vs[:, :, None, None, :]).astype(dt)  # value scale into p
+    out = jnp.einsum("bkgts,bksh->btkgh", pv, vq.astype(dt))
+    return out.reshape(B, T, H, hd)
+
+
+def is_quantized_cache(kc) -> bool:
+    return isinstance(kc, dict) and "q" in kc and "s" in kc
+
+
+def empty_cache(L: int, B: int, KvH: int, S: int, hd: int) -> Dict:
+    return {"q": jnp.zeros((L, B, KvH, S, hd), jnp.int8),
+            "s": jnp.zeros((L, B, KvH, S), jnp.float32)}

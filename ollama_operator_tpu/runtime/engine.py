@@ -43,6 +43,9 @@ from ..parallel.sharding import (kv_cache_pspec, params_sharding_tree,
 class EngineConfig:
     max_slots: int = 8
     max_seq_len: int = 2048
+    # jnp.bfloat16 / jnp.float32, or jnp.int8 for the quantized KV cache
+    # (ops/quant_cache.py: int8 entries + per-(position, head) f32 scales —
+    # half the decode cache traffic, double the context per chip)
     cache_dtype: Any = jnp.bfloat16
     min_prefill_bucket: int = 64
     repeat_last_n: int = 64  # Ollama default penalty window (doc only for now)
@@ -51,6 +54,25 @@ class EngineConfig:
     # remote-TPU tunnel; nonzero everywhere) amortises across the chunk.
     # Streaming granularity and admission latency grow with it.
     decode_chunk: int = 8
+
+
+CACHE_DTYPES = {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
+                "int8": jnp.int8}
+
+
+def resolve_cache_dtype(name_or_dtype) -> Any:
+    """Normalise a cache dtype given as a name or jnp dtype; rejects
+    anything outside the supported set (a stray dense-int8 cache would
+    silently truncate K/V to ±1)."""
+    if isinstance(name_or_dtype, str):
+        if name_or_dtype not in CACHE_DTYPES:
+            raise ValueError(f"cache dtype {name_or_dtype!r}; expected one "
+                             f"of {sorted(CACHE_DTYPES)}")
+        return CACHE_DTYPES[name_or_dtype]
+    dt = jnp.dtype(name_or_dtype)
+    assert dt in (jnp.dtype(t) for t in CACHE_DTYPES.values()), (
+        f"unsupported cache dtype {dt}")
+    return {jnp.dtype(v): v for v in CACHE_DTYPES.values()}[dt]
 
 
 def prefill_buckets(max_seq_len: int, min_bucket: int):
@@ -96,7 +118,16 @@ class Engine:
         L, KvH, hd = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
         V = cfg.vocab_size
 
+        cache_dtype = resolve_cache_dtype(ecfg.cache_dtype)
+        if cache_dtype is not ecfg.cache_dtype:
+            ecfg = dataclasses.replace(ecfg, cache_dtype=cache_dtype)
+            self.ecfg = ecfg
+        self.quant_cache = jnp.dtype(cache_dtype) == jnp.dtype(jnp.int8)
         self.sp_size = mesh.shape.get("sp", 1) if mesh is not None else 1
+        if self.quant_cache:
+            assert (mesh is None or mesh.shape.get("sp", 1) == 1), (
+                "int8 KV cache is not supported on sp meshes yet (the "
+                "sequence-parallel attention reads the bf16 layout)")
         if self.sp_size > 1:
             assert self.sp_size & (self.sp_size - 1) == 0, (
                 f"sp={self.sp_size} must be a power of two (prefill buckets "
@@ -121,8 +152,18 @@ class Engine:
             return jax.device_put(arr, sh) if sh is not None else arr
 
         cache_shape = (L, B, KvH, S, hd)  # head-first: (S, hd) tiles
-        self.k_cache = zeros(cache_shape, ecfg.cache_dtype, cache_sh)
-        self.v_cache = zeros(cache_shape, ecfg.cache_dtype, cache_sh)
+        if self.quant_cache:
+            def qzeros(sh):
+                c = {"q": jnp.zeros(cache_shape, jnp.int8),
+                     "s": jnp.zeros(cache_shape[:-1], jnp.float32)}
+                return jax.device_put(c, sh) if sh is not None else c
+            cache_sh = self._quant_cache_sharding(cache_sh)
+            self._cache_sh = cache_sh
+            self.k_cache = qzeros(cache_sh)
+            self.v_cache = qzeros(cache_sh)
+        else:
+            self.k_cache = zeros(cache_shape, ecfg.cache_dtype, cache_sh)
+            self.v_cache = zeros(cache_shape, ecfg.cache_dtype, cache_sh)
         self.lengths = zeros((B,), jnp.int32, slot_sh)
         self.counts = zeros((B, V), jnp.int32, slot_sh)
         self.last_tokens = zeros((B,), jnp.int32, slot_sh)
@@ -145,6 +186,16 @@ class Engine:
         self._buckets = prefill_buckets(
             S, max(ecfg.min_prefill_bucket, self.sp_size))
         self._compile_fns()
+
+    @staticmethod
+    def _quant_cache_sharding(cache_sh):
+        """Sharding tree for the {"q", "s"} cache: q keeps the dense spec,
+        s drops the trailing head_dim axis."""
+        if cache_sh is None:
+            return None
+        spec = cache_sh.spec
+        return {"q": cache_sh,
+                "s": NamedSharding(cache_sh.mesh, P(*spec[:-1]))}
 
     # ------------------------------------------------------------------
     # compiled programs
@@ -198,10 +249,20 @@ class Engine:
             tok = sampling.sample(last[None], counts_row[None], sp_row,
                                   key[None])[0]
             counts_row = counts_row.at[tok].add(1)
-            k_cache = jax.lax.dynamic_update_slice(
-                k_cache, ks.astype(k_cache.dtype), (0, slot, 0, 0, 0))
-            v_cache = jax.lax.dynamic_update_slice(
-                v_cache, vs.astype(v_cache.dtype), (0, slot, 0, 0, 0))
+            if self.quant_cache:
+                from ..ops.quant_cache import quantize_kv
+                kq, ksc = quantize_kv(ks)          # [L,1,KvH,T,hd]
+                vq, vsc = quantize_kv(vs)
+                dus = jax.lax.dynamic_update_slice
+                k_cache = {"q": dus(k_cache["q"], kq, (0, slot, 0, 0, 0)),
+                           "s": dus(k_cache["s"], ksc, (0, slot, 0, 0))}
+                v_cache = {"q": dus(v_cache["q"], vq, (0, slot, 0, 0, 0)),
+                           "s": dus(v_cache["s"], vsc, (0, slot, 0, 0))}
+            else:
+                k_cache = jax.lax.dynamic_update_slice(
+                    k_cache, ks.astype(k_cache.dtype), (0, slot, 0, 0, 0))
+                v_cache = jax.lax.dynamic_update_slice(
+                    v_cache, vs.astype(v_cache.dtype), (0, slot, 0, 0, 0))
             lengths = lengths.at[slot].set(n_valid)
             counts = counts.at[slot].set(counts_row)
             last_tokens = last_tokens.at[slot].set(tok)
@@ -454,4 +515,5 @@ class Engine:
 
     @property
     def kv_bytes(self) -> int:
-        return 2 * int(np.prod(self.k_cache.shape)) * self.k_cache.dtype.itemsize
+        leaves = jax.tree_util.tree_leaves((self.k_cache, self.v_cache))
+        return sum(l.size * l.dtype.itemsize for l in leaves)

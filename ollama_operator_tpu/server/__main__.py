@@ -34,6 +34,11 @@ def main(argv=None):
     p.add_argument("--dtype", default=os.environ.get("TPU_ENGINE_DTYPE",
                                                      "bfloat16"),
                    choices=["bfloat16", "float32", "int8"])
+    p.add_argument("--kv-dtype", default=os.environ.get("TPU_KV_DTYPE",
+                                                        "bfloat16"),
+                   choices=["bfloat16", "float32", "int8"],
+                   help="KV cache storage (int8 = quantized cache: half "
+                        "the decode cache traffic, double the context)")
     p.add_argument("--max-slots", type=int,
                    default=int(os.environ.get("TPU_MAX_SLOTS", "8")))
     p.add_argument("--max-seq-len", type=int,
@@ -89,8 +94,10 @@ def main(argv=None):
               f"sequence-parallel: {sp}, expert-parallel: {ep}",
               file=sys.stderr)
 
+    from ..runtime.engine import resolve_cache_dtype
     ecfg = EngineConfig(max_slots=args.max_slots,
-                        max_seq_len=args.max_seq_len)
+                        max_seq_len=args.max_seq_len,
+                        cache_dtype=resolve_cache_dtype(args.kv_dtype))
     manager = ModelManager(args.store, cache_dir=args.cache, mesh=mesh,
                            ecfg=ecfg, engine_dtype=args.dtype,
                            serve_models=not args.store_only)
