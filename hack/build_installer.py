@@ -23,6 +23,9 @@ NAMESPACE = "ollama-operator-system"
 SOURCES = [
     "config/crd/ollama.ayaka.io_models.yaml",
     "config/rbac/role.yaml",
+    "config/rbac/leader_election_role.yaml",
+    "config/rbac/model_editor_role.yaml",
+    "config/rbac/model_viewer_role.yaml",
     "config/manager/manager.yaml",
 ]
 

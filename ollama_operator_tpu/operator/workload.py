@@ -261,6 +261,9 @@ def build_model_service(model: Dict[str, Any]) -> Dict[str, Any]:
         "kind": "Service",
         "metadata": {
             "name": app, "namespace": spec.namespace,
+            # the managed label is the ServiceMonitor's scrape selector
+            # (config/prometheus/monitor.yaml)
+            "labels": {"app": app, "ollama.ayaka.io/managed": "true"},
             # the reference owner-refs the Service to the Deployment
             # (model.go:225-231); we owner-ref the Model so a CR delete
             # cascades everything in one sweep — same end state.
@@ -284,6 +287,19 @@ def _ensure(c: KubeClient, obj: Dict[str, Any]) -> Dict[str, Any]:
     cur = c.get(obj["apiVersion"], obj["kind"], meta.get("namespace"),
                 meta["name"])
     if cur is not None:
+        # create-if-absent, except labels: sync missing desired labels so
+        # upgrades can retrofit selectors (e.g. the ServiceMonitor scrape
+        # label) onto objects created by older operator versions
+        want = meta.get("labels") or {}
+        have = (cur.get("metadata") or {}).get("labels") or {}
+        missing = {k: v for k, v in want.items() if have.get(k) != v}
+        if missing:
+            cur.setdefault("metadata", {}).setdefault(
+                "labels", {}).update(missing)
+            try:
+                return c.update(cur)
+            except Conflict:
+                return cur
         return cur
     try:
         return c.create(obj)

@@ -48,7 +48,11 @@ class EngineConfig:
     # half the decode cache traffic, double the context per chip)
     cache_dtype: Any = jnp.bfloat16
     min_prefill_bucket: int = 64
-    repeat_last_n: int = 64  # Ollama default penalty window (doc only for now)
+    # penalty window (Ollama repeat_last_n default): repeat/presence/
+    # frequency penalties see only the last N tokens, maintained as a
+    # device-side ring buffer. Engine-global (the ring size is static);
+    # per-request repeat_last_n values are currently ignored.
+    repeat_last_n: int = 64
     # decode steps per host round-trip: a lax.scan of this many steps runs
     # as ONE device program, so dispatch/sync latency (large under the
     # remote-TPU tunnel; nonzero everywhere) amortises across the chunk.
@@ -153,9 +157,10 @@ class Engine:
 
         cache_shape = (L, B, KvH, S, hd)  # head-first: (S, hd) tiles
         if self.quant_cache:
+            from ..ops.quant_cache import empty_cache
+
             def qzeros(sh):
-                c = {"q": jnp.zeros(cache_shape, jnp.int8),
-                     "s": jnp.zeros(cache_shape[:-1], jnp.float32)}
+                c = empty_cache(L, B, KvH, S, hd)
                 return jax.device_put(c, sh) if sh is not None else c
             cache_sh = self._quant_cache_sharding(cache_sh)
             self._cache_sh = cache_sh
@@ -166,6 +171,12 @@ class Engine:
             self.v_cache = zeros(cache_shape, ecfg.cache_dtype, cache_sh)
         self.lengths = zeros((B,), jnp.int32, slot_sh)
         self.counts = zeros((B, V), jnp.int32, slot_sh)
+        # penalty ring: the last repeat_last_n token ids per slot (sentinel
+        # V = "empty"; scatter-drop keeps it out of counts)
+        W = max(1, ecfg.repeat_last_n)
+        self.pring = jax.device_put(
+            jnp.full((B, W), V, jnp.int32), slot_sh) \
+            if slot_sh is not None else jnp.full((B, W), V, jnp.int32)
         self.last_tokens = zeros((B,), jnp.int32, slot_sh)
         self.active = np.zeros((B,), bool)  # host-side mask
         self._active_dev = zeros((B,), jnp.int32, slot_sh)
@@ -231,24 +242,40 @@ class Engine:
             step_impl = partial(decoder.forward_with_cache, cfg=cfg)
             self._bucketed_attn = True
 
+        W = max(1, self.ecfg.repeat_last_n)
+
         def _insert_prefilled(k_cache, v_cache, lengths, counts,
-                              last_tokens, logits, ks, vs, tokens, slot,
-                              n_valid, sp_row, key):
+                              last_tokens, pring, logits, ks, vs, tokens,
+                              slot, n_valid, sp_row, key):
             """Shared admission tail: sample the first token from the
-            prefill logits and install chunk K/V + slot state. Image pad
-            positions carry id == vocab_size, which the scatter-add drops
-            (out of bounds) — image tokens never enter the penalty
-            counts."""
+            prefill logits and install chunk K/V + slot state. Penalty
+            counts see only the LAST repeat_last_n prompt tokens (the
+            ring); image pad positions carry id == vocab_size, which the
+            scatter-add drops (out of bounds) — image tokens never enter
+            the penalty counts."""
             last = jax.lax.dynamic_index_in_dim(
                 logits[0], n_valid - 1, axis=0, keepdims=False)
+            # ring of the last W prompt tokens: absolute positions
+            # n_valid-W .. n_valid-1 land in slots pos % W (each slot
+            # exactly once — no scatter duplicates)
             T = tokens.shape[1]
-            valid = (jnp.arange(T) < n_valid).astype(jnp.int32)
+            pos = n_valid - W + jnp.arange(W, dtype=jnp.int32)
+            in_prompt = pos >= 0
+            vals = jnp.where(
+                in_prompt, tokens[0][jnp.clip(pos, 0, T - 1)],
+                jnp.int32(cfg.vocab_size))
+            ring_row = jnp.full((W,), cfg.vocab_size, jnp.int32
+                                ).at[pos % W].set(vals)
             counts_row = jnp.zeros((cfg.vocab_size,), jnp.int32
-                                   ).at[tokens[0]].add(valid,
-                                                       mode="drop")
+                                   ).at[vals].add(1, mode="drop")
             tok = sampling.sample(last[None], counts_row[None], sp_row,
                                   key[None])[0]
+            # push the first sampled token through the window
+            evict = ring_row[n_valid % W]
+            counts_row = counts_row.at[evict].add(-1, mode="drop")
+            ring_row = ring_row.at[n_valid % W].set(tok)
             counts_row = counts_row.at[tok].add(1)
+            pring = pring.at[slot].set(ring_row)
             if self.quant_cache:
                 from ..ops.quant_cache import quantize_kv
                 kq, ksc = quantize_kv(ks)          # [L,1,KvH,T,hd]
@@ -267,22 +294,22 @@ class Engine:
             counts = counts.at[slot].set(counts_row)
             last_tokens = last_tokens.at[slot].set(tok)
             return (tok, *pin(k_cache, v_cache, lengths, counts,
-                              last_tokens))
+                              last_tokens), pring)
 
-        @partial(jax.jit, donate_argnums=(1, 2, 3, 4, 5))
+        @partial(jax.jit, donate_argnums=(1, 2, 3, 4, 5, 6))
         def _admit(params, k_cache, v_cache, lengths, counts, last_tokens,
-                   tokens, slot, n_valid, sp_row, key):
+                   pring, tokens, slot, n_valid, sp_row, key):
             """Prefill a padded B=1 chunk AND insert it into the slot state
             — one device program, one host round-trip per admission."""
             logits, ks, vs = prefill_impl(params, tokens=tokens)
             return _insert_prefilled(k_cache, v_cache, lengths, counts,
-                                     last_tokens, logits, ks, vs, tokens,
-                                     slot, n_valid, sp_row, key)
+                                     last_tokens, pring, logits, ks, vs,
+                                     tokens, slot, n_valid, sp_row, key)
 
-        @partial(jax.jit, donate_argnums=(1, 2, 3, 4, 5))
+        @partial(jax.jit, donate_argnums=(1, 2, 3, 4, 5, 6))
         def _admit_embeds(params, k_cache, v_cache, lengths, counts,
-                          last_tokens, tokens, embeds, slot, n_valid, sp_row,
-                          key):
+                          last_tokens, pring, tokens, embeds, slot, n_valid,
+                          sp_row, key):
             """Multimodal admission: like _admit but prefilling from a
             precomputed [1, T, D] embedding sequence (image tokens spliced
             into text embeddings); ``tokens`` feeds the penalty counts with
@@ -291,11 +318,12 @@ class Engine:
             logits, ks, vs = prefill_impl(params, tokens=tokens,
                                           inputs_embeds=embeds)
             return _insert_prefilled(k_cache, v_cache, lengths, counts,
-                                     last_tokens, logits, ks, vs, tokens,
-                                     slot, n_valid, sp_row, key)
+                                     last_tokens, pring, logits, ks, vs,
+                                     tokens, slot, n_valid, sp_row, key)
 
         def _decode_body(params, k_cache, v_cache, lengths, counts,
-                         last_tokens, sp, keys, active, attn_len=None):
+                         last_tokens, pring, sp, keys, active,
+                         attn_len=None):
             kw = {"attn_len": attn_len} if (attn_len is not None
                                             and self._bucketed_attn) else {}
             logits, k_cache, v_cache = step_impl(
@@ -304,51 +332,65 @@ class Engine:
             step_keys = jax.vmap(jax.random.fold_in)(keys, lengths)
             toks = sampling.sample(logits[:, 0], counts, sp, step_keys)
             B = toks.shape[0]
-            counts = counts.at[jnp.arange(B), toks].add(active)
+            bi = jnp.arange(B)
+            # penalty window: the NEW token's absolute position is
+            # lengths + 1 (last_tokens sits at lengths); evict whatever
+            # occupied that ring slot W tokens ago, then admit the new
+            # token (inactive slots write the OOB sentinel)
+            slot_pos = (lengths + 1) % W
+            evict = pring[bi, slot_pos]
+            evict = jnp.where(active == 1, evict, jnp.int32(cfg.vocab_size))
+            new = jnp.where(active == 1, toks, jnp.int32(cfg.vocab_size))
+            counts = counts.at[bi, evict].add(-1, mode="drop")
+            counts = counts.at[bi, new].add(1, mode="drop")
+            pring = jnp.where((active == 1)[:, None],
+                              pring.at[bi, slot_pos].set(toks), pring)
             lengths = lengths + active
             last_tokens = jnp.where(active == 1, toks, last_tokens)
             return (toks, *pin(k_cache, v_cache, lengths, counts,
-                               last_tokens))
+                               last_tokens), pring)
 
-        @partial(jax.jit, donate_argnums=(1, 2, 3, 4, 5, 7))
+        @partial(jax.jit, donate_argnums=(1, 2, 3, 4, 5, 6, 8))
         def _decode(params, k_cache, v_cache, lengths, counts, last_tokens,
-                    sp, keys, active):
-            (toks, k_cache, v_cache, lengths, counts,
-             last_tokens) = _decode_body(params, k_cache, v_cache, lengths,
-                                         counts, last_tokens, sp, keys,
-                                         active)
-            return toks, k_cache, v_cache, lengths, counts, last_tokens, keys
+                    pring, sp, keys, active):
+            (toks, k_cache, v_cache, lengths, counts, last_tokens,
+             pring) = _decode_body(params, k_cache, v_cache, lengths,
+                                   counts, last_tokens, pring, sp, keys,
+                                   active)
+            return (toks, k_cache, v_cache, lengths, counts, last_tokens,
+                    pring, keys)
 
-        @partial(jax.jit, static_argnums=(9, 10),
-                 donate_argnums=(1, 2, 3, 4, 5, 7))
+        @partial(jax.jit, static_argnums=(10, 11),
+                 donate_argnums=(1, 2, 3, 4, 5, 6, 8))
         def _decode_n(params, k_cache, v_cache, lengths, counts, last_tokens,
-                      sp, keys, active, n, attn_len):
+                      pring, sp, keys, active, n, attn_len):
             """n decode steps as ONE device program (lax.scan) — a single
             dispatch + host sync per n tokens per slot. ``attn_len`` is the
             static attended-cache prefix (decode traffic scales with it,
             not with max_seq_len)."""
             def step(carry, _):
-                k_cache, v_cache, lengths, counts, last_tokens = carry
-                (toks, k_cache, v_cache, lengths, counts,
-                 last_tokens) = _decode_body(params, k_cache, v_cache,
-                                             lengths, counts, last_tokens,
-                                             sp, keys, active,
-                                             attn_len=attn_len)
-                return (k_cache, v_cache, lengths, counts,
-                        last_tokens), toks
+                (k_cache, v_cache, lengths, counts, last_tokens,
+                 pring) = carry
+                (toks, k_cache, v_cache, lengths, counts, last_tokens,
+                 pring) = _decode_body(params, k_cache, v_cache,
+                                       lengths, counts, last_tokens, pring,
+                                       sp, keys, active, attn_len=attn_len)
+                return (k_cache, v_cache, lengths, counts, last_tokens,
+                        pring), toks
 
-            carry = (k_cache, v_cache, lengths, counts, last_tokens)
+            carry = (k_cache, v_cache, lengths, counts, last_tokens, pring)
             carry, toks_n = jax.lax.scan(step, carry, None, length=n)
-            k_cache, v_cache, lengths, counts, last_tokens = carry
+            (k_cache, v_cache, lengths, counts, last_tokens, pring) = carry
             return (toks_n, k_cache, v_cache, lengths, counts, last_tokens,
-                    keys)
+                    pring, keys)
 
-        @partial(jax.jit, donate_argnums=(0, 1, 2))
-        def _release(lengths, counts, last_tokens, slot):
+        @partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+        def _release(lengths, counts, last_tokens, pring, slot):
             lengths = lengths.at[slot].set(0)
             counts = counts.at[slot].set(0)
             last_tokens = last_tokens.at[slot].set(0)
-            return lengths, counts, last_tokens
+            pring = pring.at[slot].set(cfg.vocab_size)
+            return lengths, counts, last_tokens, pring
 
         self._admit_fn = _admit
         self._admit_embeds_fn = _admit_embeds
@@ -423,17 +465,18 @@ class Engine:
             emb = np.zeros((1, bucket, embeds.shape[1]), np.float32)
             emb[0, :n] = embeds
             (tok, self.k_cache, self.v_cache, self.lengths, self.counts,
-             self.last_tokens) = self._admit_embeds_fn(
+             self.last_tokens, self.pring) = self._admit_embeds_fn(
                 self.params, self.k_cache, self.v_cache, self.lengths,
-                self.counts, self.last_tokens, jnp.asarray(tokens),
-                jnp.asarray(emb), jnp.int32(slot), jnp.int32(n),
-                self._sp_row(opts), key)
+                self.counts, self.last_tokens, self.pring,
+                jnp.asarray(tokens), jnp.asarray(emb), jnp.int32(slot),
+                jnp.int32(n), self._sp_row(opts), key)
         else:
             (tok, self.k_cache, self.v_cache, self.lengths, self.counts,
-             self.last_tokens) = self._admit_fn(
+             self.last_tokens, self.pring) = self._admit_fn(
                 self.params, self.k_cache, self.v_cache, self.lengths,
-                self.counts, self.last_tokens, jnp.asarray(tokens),
-                jnp.int32(slot), jnp.int32(n), self._sp_row(opts), key)
+                self.counts, self.last_tokens, self.pring,
+                jnp.asarray(tokens), jnp.int32(slot), jnp.int32(n),
+                self._sp_row(opts), key)
         self.active[slot] = True
         self._host_lengths[slot] = n
         self._opts[slot] = opts
@@ -457,9 +500,9 @@ class Engine:
         """One decode step for every slot; returns sampled tokens [B] (only
         entries where self.active were valid at call time)."""
         (toks, self.k_cache, self.v_cache, self.lengths, self.counts,
-         self.last_tokens, self.keys) = self._decode_fn(
+         self.last_tokens, self.pring, self.keys) = self._decode_fn(
             self.params, self.k_cache, self.v_cache, self.lengths,
-            self.counts, self.last_tokens, self.sp, self.keys,
+            self.counts, self.last_tokens, self.pring, self.sp, self.keys,
             self._active_dev)
         self._host_lengths[self.active] += 1
         return np.asarray(toks)
@@ -470,8 +513,8 @@ class Engine:
         if exe is None:
             exe = self._decode_n_fn.lower(
                 self.params, self.k_cache, self.v_cache, self.lengths,
-                self.counts, self.last_tokens, self.sp, self.keys,
-                self._active_dev, n, attn_len).compile()
+                self.counts, self.last_tokens, self.pring, self.sp,
+                self.keys, self._active_dev, n, attn_len).compile()
             self._decode_execs[key] = exe
         return exe
 
@@ -495,9 +538,9 @@ class Engine:
         n = n or self.ecfg.decode_chunk
         exe = self._decode_n_exec(n, self._attn_bucket(n))
         (toks_n, self.k_cache, self.v_cache, self.lengths, self.counts,
-         self.last_tokens, self.keys) = exe(
+         self.last_tokens, self.pring, self.keys) = exe(
             self.params, self.k_cache, self.v_cache, self.lengths,
-            self.counts, self.last_tokens, self.sp, self.keys,
+            self.counts, self.last_tokens, self.pring, self.sp, self.keys,
             self._active_dev)
         self._host_lengths[self.active] += n
         return np.asarray(toks_n)
@@ -506,8 +549,10 @@ class Engine:
         self.active[slot] = False
         self._host_lengths[slot] = 0
         self._opts.pop(slot, None)
-        self.lengths, self.counts, self.last_tokens = self._release_fn(
-            self.lengths, self.counts, self.last_tokens, jnp.int32(slot))
+        (self.lengths, self.counts, self.last_tokens,
+         self.pring) = self._release_fn(
+            self.lengths, self.counts, self.last_tokens, self.pring,
+            jnp.int32(slot))
         self._active_dev = jnp.asarray(self.active.astype(np.int32))
 
     def slot_length(self, slot: int) -> int:

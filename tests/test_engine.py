@@ -168,3 +168,40 @@ def test_decode_across_attn_bucket_boundary():
     assert [int(t) for t in bucketed] == [int(t) for t in full]
     # crossed at least two bucket boundaries (6 + 28 tokens > 32 > 16 > 8)
     assert e1._attn_bucket(1) >= 32
+
+
+def test_repeat_last_n_window_evicts():
+    """Penalty counts must cover exactly the last repeat_last_n tokens:
+    after decoding past the window, total counts stay at W (prompt tokens
+    that fell out are no longer penalised — Ollama repeat_last_n)."""
+    import jax.numpy as jnp
+    from ollama_operator_tpu.models import config as cfglib
+    from ollama_operator_tpu.models import decoder as dec
+    from ollama_operator_tpu.runtime.engine import (Engine, EngineConfig,
+                                                    SlotOptions)
+    cfg = cfglib.PRESETS["tiny"]
+    params = dec.init_params(cfg, jax.random.PRNGKey(9), dtype=jnp.float32)
+    W = 8
+    ecfg = EngineConfig(max_slots=2, max_seq_len=64, min_prefill_bucket=8,
+                        cache_dtype=jnp.float32, decode_chunk=4,
+                        repeat_last_n=W)
+    eng = Engine(cfg, params, ecfg=ecfg)
+    r = np.random.default_rng(17)
+    prompt = np.asarray(r.integers(1, cfg.vocab_size, 12), np.int32)
+    eng.admit(0, prompt, SlotOptions(temperature=0.8, seed=3))
+    # after admit: window = last W prompt tokens + 1 sampled = W (ring
+    # wrapped: eviction keeps the total at W)
+    counts0 = np.asarray(eng.counts)[0]
+    assert counts0.sum() == W
+    # the first sampled token must stay in the window for W steps, not be
+    # evicted by the first decode (ring position off-by-one regression)
+    tok0 = int(np.asarray(eng.last_tokens)[0])
+    eng.decode_n(1)
+    assert np.asarray(eng.counts)[0][tok0] >= 1
+    for _ in range(4):
+        eng.decode_n()
+    counts = np.asarray(eng.counts)[0]
+    assert counts.sum() == W          # stable at window size
+    assert (counts >= 0).all()        # eviction never goes negative
+    eng.release(0)
+    assert np.asarray(eng.counts)[0].sum() == 0
