@@ -1,0 +1,66 @@
+"""Multi-host slice initialisation (`jax.distributed`).
+
+The runtime half of the multi-host story: the operator renders per-pod
+identity into env vars (operator/pod.py:multihost_env — coordinator DNS
+from the StatefulSet's pod-0, process index from the pod ordinal) and this
+module consumes them inside the server before any backend touch. After
+``maybe_initialize()``, `jax.devices()` spans every chip in the slice and
+GSPMD treats it as ONE device mesh — collectives ride ICI within a host
+and the inter-host links across; no NCCL/MPI-style backend exists to
+configure (SURVEY.md §2.3: the reference's only inter-pod channel is
+HTTP, because its replicas never share model state).
+
+Env contract (all set by the operator; absent = single-host no-op):
+
+  TPU_DIST_HOSTS            number of processes (StatefulSet replicas)
+  TPU_DIST_CHIPS_PER_HOST   chips each process owns (informational)
+  TPU_DIST_COORDINATOR      host:port of process 0 (stable DNS)
+  TPU_DIST_POD_NAME         this pod's name; trailing "-<ordinal>" is the
+                            process index
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Optional
+
+_initialized = False
+
+
+def process_index_from_pod_name(pod_name: str) -> int:
+    """StatefulSet pods are named <sts>-<ordinal>; the ordinal IS the
+    jax.distributed process id (stable across pod restarts, unlike any
+    registration-order scheme)."""
+    try:
+        return int(pod_name.rsplit("-", 1)[1])
+    except (IndexError, ValueError):
+        raise ValueError(
+            f"pod name {pod_name!r} has no trailing ordinal; multi-host "
+            f"slices must run as a StatefulSet") from None
+
+
+def maybe_initialize(env: Optional[dict] = None) -> bool:
+    """Initialise jax.distributed when the operator's multi-host env is
+    present. Returns True if a multi-host world was joined. Idempotent;
+    single-host pods (no TPU_DIST_HOSTS or hosts == 1) are a no-op."""
+    global _initialized
+    e = env if env is not None else os.environ
+    hosts = int(e.get("TPU_DIST_HOSTS", "1") or "1")
+    if hosts <= 1:
+        return False
+    if _initialized:
+        return True
+    coordinator = e.get("TPU_DIST_COORDINATOR")
+    pod_name = e.get("TPU_DIST_POD_NAME", "")
+    if not coordinator:
+        raise ValueError("TPU_DIST_HOSTS > 1 but TPU_DIST_COORDINATOR "
+                         "is not set (operator/pod.py renders both)")
+    pid = process_index_from_pod_name(pod_name)
+    import jax
+    print(f"jax.distributed: joining {hosts}-process world as {pid} "
+          f"(coordinator {coordinator})", file=sys.stderr, flush=True)
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=hosts, process_id=pid)
+    _initialized = True
+    return True

@@ -69,6 +69,10 @@ def main(argv=None):
         # where a sitecustomize force-sets the platform list programmatically
         if os.environ.get("JAX_PLATFORMS"):
             jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+        # multi-host slice? join the jax.distributed world BEFORE touching
+        # the backend (operator-rendered env; no-op single-host)
+        from ..parallel.distributed import maybe_initialize
+        maybe_initialize()
         if args.cache:
             # persistent XLA compilation cache beside the weight cache: pod
             # restarts skip the multi-program warm-up compiles

@@ -1,0 +1,35 @@
+"""Multi-host init glue: env contract between operator/pod.py and
+parallel/distributed.py (the jax.distributed world wiring)."""
+
+import pytest
+
+from ollama_operator_tpu.parallel import distributed as D
+
+
+def test_process_index_from_pod_name():
+    assert D.process_index_from_pod_name("ollama-model-x-0") == 0
+    assert D.process_index_from_pod_name("ollama-model-llama2-70b-13") == 13
+    with pytest.raises(ValueError):
+        D.process_index_from_pod_name("nodash")
+
+
+def test_single_host_noop():
+    assert D.maybe_initialize({}) is False
+    assert D.maybe_initialize({"TPU_DIST_HOSTS": "1"}) is False
+
+
+def test_missing_coordinator_rejected():
+    with pytest.raises(ValueError, match="COORDINATOR"):
+        D.maybe_initialize({"TPU_DIST_HOSTS": "2",
+                            "TPU_DIST_POD_NAME": "m-1"})
+
+
+def test_operator_env_contract():
+    """The env the operator renders must be exactly what the runtime
+    parses (names + coordinator shape)."""
+    from ollama_operator_tpu.operator import pod as podf
+    env = {e["name"]: e.get("value") for e in podf.multihost_env(
+        "svc-headless", "ns1", hosts=4, chips_per_host=4)}
+    assert env["TPU_DIST_HOSTS"] == "4"
+    assert env["TPU_DIST_COORDINATOR"].endswith(".ns1.svc:8476")
+    assert "TPU_DIST_POD_NAME" in env
