@@ -394,6 +394,7 @@ class Engine:
 
         self._admit_fn = _admit
         self._admit_embeds_fn = _admit_embeds
+        self._admit_execs: Dict[int, Any] = {}
         self._decode_fn = _decode
         self._decode_n_fn = _decode_n
         self._release_fn = _release
@@ -472,7 +473,7 @@ class Engine:
                 jnp.int32(n), self._sp_row(opts), key)
         else:
             (tok, self.k_cache, self.v_cache, self.lengths, self.counts,
-             self.last_tokens, self.pring) = self._admit_fn(
+             self.last_tokens, self.pring) = self._admit_exec(bucket)(
                 self.params, self.k_cache, self.v_cache, self.lengths,
                 self.counts, self.last_tokens, self.pring,
                 jnp.asarray(tokens), jnp.int32(slot), jnp.int32(n),
@@ -518,15 +519,30 @@ class Engine:
             self._decode_execs[key] = exe
         return exe
 
+    def _admit_exec(self, bucket: int):
+        exe = self._admit_execs.get(bucket)
+        if exe is None:
+            tokens = jnp.zeros((1, bucket), jnp.int32)
+            exe = self._admit_fn.lower(
+                self.params, self.k_cache, self.v_cache, self.lengths,
+                self.counts, self.last_tokens, self.pring, tokens,
+                jnp.int32(0), jnp.int32(1),
+                self._sp_row(SlotOptions()), jax.random.key(0)).compile()
+            self._admit_execs[bucket] = exe
+        return exe
+
     def warm_buckets(self, n: Optional[int] = None):
         """AOT-compile the chunked decode program for every attention
-        bucket, so serving never pays a compile at a bucket crossing.
-        Non-bucketed paths (sp meshes) only ever run at max_seq — one
-        program, not a duplicate per bucket."""
+        bucket AND the admission program for every prefill bucket, so
+        serving never pays an XLA compile mid-request. Non-bucketed paths
+        (sp meshes) only ever decode at max_seq — one program, not a
+        duplicate per bucket."""
         n = n or self.ecfg.decode_chunk
         buckets = self._buckets if self._bucketed_attn else [self.max_seq]
         for b in buckets:
             self._decode_n_exec(n, b)
+        for b in self._buckets:
+            self._admit_exec(b)
 
     def decode_n(self, n: Optional[int] = None) -> np.ndarray:
         """n decode steps in one device program; returns tokens [n, B].

@@ -269,9 +269,13 @@ class ModelManager:
                             if not isinstance(v, list) or len(v) < 64}
             except (OSError, ValueError):
                 pass
+        capabilities = ["completion"]
+        if MT_PROJECTOR in layers:
+            capabilities.append("vision")   # llava-family (mmproj layer)
         return {"modelfile": mf.render(), "parameters": parameters,
                 "template": template, "system": system, "license": lic,
-                "details": self.model_details(name), "model_info": info}
+                "details": self.model_details(name), "model_info": info,
+                "capabilities": capabilities}
 
     def copy(self, src: str, dst: str):
         sname, dname = ModelName.parse(src), ModelName.parse(dst)
