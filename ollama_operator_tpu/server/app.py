@@ -523,6 +523,7 @@ class Handler(BaseHTTPRequestHandler):
                 "/api/embed": self._api_embed,
                 "/v1/chat/completions": self._oai_chat,
                 "/v1/completions": self._oai_completions,
+                "/v1/embeddings": self._oai_embeddings,
             }.get(path)
             if route is None:
                 self._send_json({"error": "not found"}, 404)
@@ -777,6 +778,21 @@ class Handler(BaseHTTPRequestHandler):
                           "completion_tokens": final.generated_tokens,
                           "total_tokens": final.prompt_tokens +
                           final.generated_tokens}})
+
+    def _oai_embeddings(self, body: Dict):
+        """OpenAI-compatible embeddings (maps onto LoadedModel.embed)."""
+        lm = self.manager.require_loaded(self._model_arg(body))
+        inp = body.get("input", "")
+        texts = [inp] if isinstance(inp, str) else list(inp)
+        embs = lm.embed(texts)
+        self._send_json({
+            "object": "list",
+            "model": body.get("model"),
+            "data": [{"object": "embedding", "index": i,
+                      "embedding": [float(x) for x in e]}
+                     for i, e in enumerate(embs)],
+            "usage": {"prompt_tokens": 0, "total_tokens": 0},
+        })
 
     def _oai_completions(self, body: Dict):
         model = self._model_arg(body)

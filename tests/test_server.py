@@ -301,3 +301,12 @@ def test_broken_scheduler_reloads_on_next_request(stack):
              {"model": name, "prompt": "t1", "stream": False,
               "options": {"num_predict": 2}})
     assert r["done"] is True
+
+
+def test_v1_embeddings_endpoint(stack):
+    out = post(stack["base"], "/v1/embeddings",
+               {"model": _model_name(stack), "input": ["hello", "world"]})
+    assert out["object"] == "list"
+    assert len(out["data"]) == 2
+    assert out["data"][0]["object"] == "embedding"
+    assert len(out["data"][0]["embedding"]) > 0
