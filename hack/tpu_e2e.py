@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""End-to-end serving verification on the real TPU behind the axon tunnel.
+
+Run as:  env -u PALLAS_AXON_POOL_IPS python hack/tpu_e2e.py
+
+(The launcher must NOT hold the single tunnel session — every python
+interpreter start under PYTHONPATH=/root/.axon_site consumes it — so the
+orchestrator strips the axon env and hands it back to the server child.)
+
+Drives: fake registry -> /api/pull -> GGUF transcode -> int8 weights +
+int8 KV cache engine on the TPU -> /api/generate (greedy tokens must match
+the CPU run) -> /api/show capabilities -> /v1/embeddings.
+"""
+import os, sys, json, time, urllib.request, subprocess, signal, socket
+sys.path.insert(0, "/root/repo"); sys.path.insert(0, "/root/repo/tests")
+# the parent must NOT hold the single-session TPU tunnel: pin it to CPU
+# BEFORE any repo import can transitively pull in jax
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax; jax.config.update("jax_platforms", "cpu")
+from fake_registry import FakeRegistry
+
+# build the tiny gguf in a CPU subprocess so the parent never opens the tunnel
+tmp = "/tmp/verify_tpu_e2e"; os.makedirs(tmp, exist_ok=True)
+subprocess.run([sys.executable, "-c", f"""
+import os, sys
+os.environ['JAX_PLATFORMS'] = 'cpu'
+sys.path.insert(0, '/root/repo'); sys.path.insert(0, '/root/repo/tests')
+import jax; jax.config.update('jax_platforms', 'cpu')
+import jax.numpy as jnp
+from ollama_operator_tpu.models import config as cfglib, decoder
+from test_transcode import write_tiny_llama_gguf
+cfg = cfglib.PRESETS['tiny']
+params = decoder.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+write_tiny_llama_gguf('{tmp}/tiny.gguf', cfg, params)
+"""], check=True)
+
+reg = FakeRegistry(); url = reg.start()
+reg.add_model("library", "tiny", "latest", open(tmp + "/tiny.gguf", "rb").read(),
+              template="{{ .Prompt }}", params={"temperature": 0.0})
+_s = socket.socket(); _s.bind(("127.0.0.1", 0)); PORT = _s.getsockname()[1]; _s.close()
+srv = subprocess.Popen(
+    [sys.executable, "-m", "ollama_operator_tpu.server", "--host", "127.0.0.1",
+     "--port", str(PORT), "--store", tmp + "/store",
+     "--dtype", "int8", "--kv-dtype", "int8", "--max-slots", "4",
+     "--max-seq-len", "256"],
+    env=dict(os.environ, PYTHONPATH="/root/repo:/root/.axon_site",
+             PALLAS_AXON_POOL_IPS="127.0.0.1",
+             PALLAS_AXON_REMOTE_COMPILE="1",
+             JAX_PLATFORMS="axon"), cwd="/root/repo",
+    stdout=open(tmp + "/srv.out", "w"), stderr=open(tmp + "/srv.log", "w"))
+base = f"http://127.0.0.1:{PORT}"
+for _ in range(120):
+    try:
+        urllib.request.urlopen(base + "/api/version", timeout=2); break
+    except Exception: time.sleep(1)
+else: srv.kill(); sys.exit("server never came up")
+
+def post(path, payload, timeout=560):
+    req = urllib.request.Request(base + path, data=json.dumps(payload).encode(),
+                                 headers={"Content-Type": "application/json"})
+    return urllib.request.urlopen(req, timeout=timeout)
+
+ref = url + "/library/tiny:latest"
+print("pull:", post("/api/pull", {"model": ref, "stream": False}).read())
+out = json.loads(post("/api/generate", {
+    "model": ref, "prompt": "x", "stream": False,
+    "options": {"temperature": 0, "num_predict": 8}}).read())
+print("generate:", {k: out.get(k) for k in ("response", "done", "eval_count")})
+show = json.loads(post("/api/show", {"model": ref}).read())
+print("capabilities:", show.get("capabilities"))
+emb = json.loads(post("/v1/embeddings", {"model": ref, "input": "t1"}).read())
+print("v1/embeddings dims:", len(emb["data"][0]["embedding"]))
+srv.send_signal(signal.SIGTERM); srv.wait(20); reg.stop()
+print("TPU-E2E-OK")

@@ -76,7 +76,18 @@ def new_server_container(
     if context_length:
         env.append({"name": "TPU_MAX_SEQ_LEN", "value": str(context_length)})
     if quantization:
-        env.append({"name": "TPU_ENGINE_QUANT", "value": quantization})
+        # CRD quantization -> the server's weight-dtype knob (CRD spells
+        # bf16, the server bfloat16); int8 also turns on the quantized KV
+        # cache (the pairing every int8 config wants: half the weight AND
+        # half the cache traffic)
+        dtype = {"bf16": "bfloat16"}.get(quantization, quantization)
+        env.append({"name": "TPU_ENGINE_DTYPE", "value": dtype})
+        if quantization == "int8":
+            env.append({"name": "TPU_KV_DTYPE", "value": "int8"})
+    if placement is not None:
+        # a TPU pod that silently fell back to CPU must crash, not serve
+        # at 1/100th speed (server __main__ enforces this)
+        env.append({"name": "TPU_EXPECT_PLATFORM", "value": "tpu"})
     if tp:
         env.append({"name": "TPU_TENSOR_PARALLEL", "value": str(tp)})
     env.extend(extra_env or [])

@@ -33,7 +33,7 @@ def main(argv=None):
                    help="registry/store mode: no inference engine")
     p.add_argument("--dtype", default=os.environ.get("TPU_ENGINE_DTYPE",
                                                      "bfloat16"),
-                   choices=["bfloat16", "float32", "int8"])
+                   choices=["bfloat16", "bf16", "float32", "int8"])
     p.add_argument("--kv-dtype", default=os.environ.get("TPU_KV_DTYPE",
                                                         "bfloat16"),
                    choices=["bfloat16", "float32", "int8"],
@@ -84,6 +84,13 @@ def main(argv=None):
         if args.profile_port:
             jax.profiler.start_server(args.profile_port)
         devices = jax.devices()
+        # a TPU pod silently falling back to CPU (tunnel/driver hiccup)
+        # must crash loudly, not serve garbage at 1/100th speed: the
+        # operator sets TPU_EXPECT_PLATFORM=tpu on runtime: tpu pods
+        expect = os.environ.get("TPU_EXPECT_PLATFORM")
+        if expect and jax.default_backend() != expect:
+            p.error(f"expected JAX platform {expect!r} but initialised "
+                    f"{jax.default_backend()!r} (devices: {devices})")
         sp = max(1, args.sp)
         ep = max(1, args.ep)
         tp = args.tp or len(devices) // (sp * ep)
@@ -102,8 +109,9 @@ def main(argv=None):
     ecfg = EngineConfig(max_slots=args.max_slots,
                         max_seq_len=args.max_seq_len,
                         cache_dtype=resolve_cache_dtype(args.kv_dtype))
+    engine_dtype = {"bf16": "bfloat16"}.get(args.dtype, args.dtype)
     manager = ModelManager(args.store, cache_dir=args.cache, mesh=mesh,
-                           ecfg=ecfg, engine_dtype=args.dtype,
+                           ecfg=ecfg, engine_dtype=engine_dtype,
                            serve_models=not args.store_only)
     if args.preload and not args.store_only:
         print(f"preloading {args.preload}...", file=sys.stderr)

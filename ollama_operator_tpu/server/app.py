@@ -174,6 +174,12 @@ class ModelManager:
             import ml_dtypes
             dt = {"bfloat16": ml_dtypes.bfloat16, "int8": ml_dtypes.bfloat16,
                   "float32": np.float32}[self.engine_dtype]
+            import jax
+            if (jax.default_backend() == "cpu"
+                    and dt is ml_dtypes.bfloat16):
+                # this XLA CPU build cannot execute bf16 dots
+                # (DotThunk UNIMPLEMENTED) — CPU serving runs f32
+                dt = np.float32
             # parse/transcode the new model (host memory) BEFORE tearing the
             # old one down: a corrupt pull must not leave the server empty
             cfg, params, tok_md = transcode_load(
@@ -707,11 +713,15 @@ class Handler(BaseHTTPRequestHandler):
         emb = lm.embed([prompt])[0]
         self._send_json({"embedding": [float(x) for x in emb]})
 
-    def _api_embed(self, body: Dict):
+    def _embed_input(self, body: Dict):
+        """Shared input handling for /api/embed and /v1/embeddings."""
         lm = self.manager.require_loaded(self._model_arg(body))
         inp = body.get("input", "")
         texts = [inp] if isinstance(inp, str) else list(inp)
-        embs = lm.embed(texts)
+        return lm.embed(texts)
+
+    def _api_embed(self, body: Dict):
+        embs = self._embed_input(body)
         self._send_json({
             "model": body.get("model"), "object": "list",
             "embeddings": [[float(x) for x in e] for e in embs]})
@@ -781,10 +791,7 @@ class Handler(BaseHTTPRequestHandler):
 
     def _oai_embeddings(self, body: Dict):
         """OpenAI-compatible embeddings (maps onto LoadedModel.embed)."""
-        lm = self.manager.require_loaded(self._model_arg(body))
-        inp = body.get("input", "")
-        texts = [inp] if isinstance(inp, str) else list(inp)
-        embs = lm.embed(texts)
+        embs = self._embed_input(body)
         self._send_json({
             "object": "list",
             "model": body.get("model"),

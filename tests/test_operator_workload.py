@@ -100,7 +100,9 @@ class TestModelDeployment:
         assert server["resources"]["limits"]["google.com/tpu"] == "4"
         env = {e["name"]: e.get("value") for e in server["env"]}
         assert env["TPU_MAX_SEQ_LEN"] == "8192"
-        assert env["TPU_ENGINE_QUANT"] == "int8"
+        assert env["TPU_ENGINE_DTYPE"] == "int8"
+        assert env["TPU_KV_DTYPE"] == "int8"
+        assert env["TPU_EXPECT_PLATFORM"] == "tpu"
         assert env["TPU_TENSOR_PARALLEL"] == "4"
         assert env["TPU_PRELOAD_MODEL"] == "phi"
 
