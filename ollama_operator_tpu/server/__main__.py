@@ -41,6 +41,10 @@ def main(argv=None):
                         "the decode cache traffic, double the context)")
     p.add_argument("--max-slots", type=int,
                    default=int(os.environ.get("TPU_MAX_SLOTS", "8")))
+    p.add_argument("--decode-chunk", type=int,
+                   default=int(os.environ.get("TPU_DECODE_CHUNK", "8")),
+                   help="decode steps per device round-trip (higher = "
+                        "more throughput, chunkier streaming)")
     p.add_argument("--max-seq-len", type=int,
                    default=int(os.environ.get("TPU_MAX_SEQ_LEN", "4096")))
     p.add_argument("--tp", type=int,
@@ -108,6 +112,7 @@ def main(argv=None):
     from ..runtime.engine import resolve_cache_dtype
     ecfg = EngineConfig(max_slots=args.max_slots,
                         max_seq_len=args.max_seq_len,
+                        decode_chunk=max(1, args.decode_chunk),
                         cache_dtype=resolve_cache_dtype(args.kv_dtype))
     engine_dtype = {"bf16": "bfloat16"}.get(args.dtype, args.dtype)
     manager = ModelManager(args.store, cache_dir=args.cache, mesh=mesh,
