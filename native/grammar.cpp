@@ -1,0 +1,255 @@
+// JSON grammar token-mask kernel for constrained decoding.
+//
+// Mirrors the byte-level pushdown automaton in
+// ollama_operator_tpu/ops/constrain.py over the SAME packed state contract:
+//   state = [mode, aux1, aux2, key_flag] ++ stack (1 byte per open container,
+//           CTX_OBJ/CTX_ARR, top = last byte)
+// The hot entry json_fill_mask simulates every vocab token's bytes from the
+// given state and sets one bit per grammar-legal token — vocab × avg-token-
+// bytes PDA steps, microseconds in C++ vs seconds in Python for 100k vocabs.
+// Python owns the per-token advance (one token per decode step) and the
+// per-abstract-state mask cache; equivalence with the Python reference is
+// asserted by tests/test_constrain.py.
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+enum Mode : uint8_t {
+  M_VALUE = 0,
+  M_ARR_FIRST = 1,
+  M_KEY_FIRST = 2,
+  M_KEY = 3,
+  M_COLON = 4,
+  M_STR = 5,
+  M_ESC = 6,
+  M_HEX = 7,
+  M_NUM = 8,
+  M_LIT = 9,
+  M_AFTER = 10,
+};
+
+enum Ctx : uint8_t { CTX_OBJ = 1, CTX_ARR = 2 };
+
+enum NumState : uint8_t {
+  NS_MINUS = 0, NS_ZERO, NS_INT, NS_DOT, NS_FRAC, NS_E, NS_ESIGN, NS_EXP
+};
+
+struct State {
+  uint8_t mode, aux1, aux2, key;
+  // stack: caller-provided prefix + pushes during one token. Capacity is
+  // bounded by the caller: suffix bytes + token bytes.
+  uint8_t* stack;
+  int32_t depth;
+};
+
+inline bool is_ws(uint8_t b) {
+  return b == ' ' || b == '\t' || b == '\n' || b == '\r';
+}
+
+inline bool is_hex(uint8_t b) {
+  return (b >= '0' && b <= '9') || (b >= 'a' && b <= 'f') ||
+         (b >= 'A' && b <= 'F');
+}
+
+inline bool ns_terminal(uint8_t ns) {
+  return ns == NS_ZERO || ns == NS_INT || ns == NS_FRAC || ns == NS_EXP;
+}
+
+const char* kLiterals[3] = {"true", "false", "null"};
+const int kLitLen[3] = {4, 5, 4};
+
+inline bool start_value(State& s, uint8_t b) {
+  switch (b) {
+    case '{':
+      s.stack[s.depth++] = CTX_OBJ;
+      s.mode = M_KEY_FIRST; s.aux1 = s.aux2 = s.key = 0;
+      return true;
+    case '[':
+      s.stack[s.depth++] = CTX_ARR;
+      s.mode = M_ARR_FIRST; s.aux1 = s.aux2 = s.key = 0;
+      return true;
+    case '"':
+      s.mode = M_STR; s.aux1 = s.aux2 = s.key = 0;
+      return true;
+    case '-':
+      s.mode = M_NUM; s.aux1 = NS_MINUS; s.aux2 = s.key = 0;
+      return true;
+    case 't':
+      s.mode = M_LIT; s.aux1 = 0; s.aux2 = 1; s.key = 0;
+      return true;
+    case 'f':
+      s.mode = M_LIT; s.aux1 = 1; s.aux2 = 1; s.key = 0;
+      return true;
+    case 'n':
+      s.mode = M_LIT; s.aux1 = 2; s.aux2 = 1; s.key = 0;
+      return true;
+    default:
+      if (b == '0') {
+        s.mode = M_NUM; s.aux1 = NS_ZERO; s.aux2 = s.key = 0;
+        return true;
+      }
+      if (b >= '1' && b <= '9') {
+        s.mode = M_NUM; s.aux1 = NS_INT; s.aux2 = s.key = 0;
+        return true;
+      }
+      return false;
+  }
+}
+
+inline bool after_value(State& s, uint8_t b) {
+  if (is_ws(b)) { s.mode = M_AFTER; s.aux1 = s.aux2 = s.key = 0; return true; }
+  if (s.depth == 0) return false;
+  uint8_t top = s.stack[s.depth - 1];
+  if (top == CTX_OBJ) {
+    if (b == ',') { s.mode = M_KEY; s.aux1 = s.aux2 = s.key = 0; return true; }
+    if (b == '}') {
+      s.depth--; s.mode = M_AFTER; s.aux1 = s.aux2 = s.key = 0;
+      return true;
+    }
+  } else {
+    if (b == ',') { s.mode = M_VALUE; s.aux1 = s.aux2 = s.key = 0; return true; }
+    if (b == ']') {
+      s.depth--; s.mode = M_AFTER; s.aux1 = s.aux2 = s.key = 0;
+      return true;
+    }
+  }
+  return false;
+}
+
+bool advance(State& s, uint8_t b) {
+  switch (s.mode) {
+    case M_VALUE:
+      if (is_ws(b)) return true;
+      return start_value(s, b);
+    case M_ARR_FIRST:
+      if (is_ws(b)) return true;
+      if (b == ']') {
+        s.depth--; s.mode = M_AFTER; s.aux1 = s.aux2 = s.key = 0;
+        return true;
+      }
+      return start_value(s, b);
+    case M_KEY_FIRST:
+      if (is_ws(b)) return true;
+      if (b == '"') { s.mode = M_STR; s.key = 1; return true; }
+      if (b == '}') {
+        s.depth--; s.mode = M_AFTER; s.aux1 = s.aux2 = s.key = 0;
+        return true;
+      }
+      return false;
+    case M_KEY:
+      if (is_ws(b)) return true;
+      if (b == '"') { s.mode = M_STR; s.key = 1; return true; }
+      return false;
+    case M_COLON:
+      if (is_ws(b)) return true;
+      if (b == ':') { s.mode = M_VALUE; s.aux1 = s.aux2 = s.key = 0; return true; }
+      return false;
+    case M_STR:
+      if (b == '"') {
+        s.mode = s.key ? M_COLON : M_AFTER;
+        s.aux1 = s.aux2 = s.key = 0;
+        return true;
+      }
+      if (b == '\\') { s.mode = M_ESC; return true; }
+      return b >= 0x20;
+    case M_ESC:
+      switch (b) {
+        case '"': case '\\': case '/': case 'b': case 'f':
+        case 'n': case 'r': case 't':
+          s.mode = M_STR;
+          return true;
+        case 'u':
+          s.mode = M_HEX; s.aux1 = 4;
+          return true;
+        default:
+          return false;
+      }
+    case M_HEX:
+      if (!is_hex(b)) return false;
+      if (--s.aux1 == 0) s.mode = M_STR;
+      return true;
+    case M_NUM: {
+      uint8_t ns = s.aux1;
+      if (b >= '0' && b <= '9') {
+        switch (ns) {
+          case NS_MINUS: s.aux1 = (b == '0') ? NS_ZERO : NS_INT; return true;
+          case NS_INT:   return true;
+          case NS_DOT:   s.aux1 = NS_FRAC; return true;
+          case NS_FRAC:  return true;
+          case NS_E: case NS_ESIGN: s.aux1 = NS_EXP; return true;
+          case NS_EXP:   return true;
+          default:       return false;  // NS_ZERO: no leading-zero digits
+        }
+      }
+      if (b == '.' && (ns == NS_ZERO || ns == NS_INT)) {
+        s.aux1 = NS_DOT;
+        return true;
+      }
+      if ((b == 'e' || b == 'E') &&
+          (ns == NS_ZERO || ns == NS_INT || ns == NS_FRAC)) {
+        s.aux1 = NS_E;
+        return true;
+      }
+      if ((b == '+' || b == '-') && ns == NS_E) {
+        s.aux1 = NS_ESIGN;
+        return true;
+      }
+      if (ns_terminal(ns)) return after_value(s, b);
+      return false;
+    }
+    case M_LIT: {
+      const char* lit = kLiterals[s.aux1];
+      int len = kLitLen[s.aux1];
+      if (s.aux2 < len && b == (uint8_t)lit[s.aux2]) {
+        if (++s.aux2 == len) {
+          s.mode = M_AFTER; s.aux1 = s.aux2 = s.key = 0;
+        }
+        return true;
+      }
+      return false;
+    }
+    case M_AFTER:
+      return after_value(s, b);
+    default:
+      return false;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Sets bit `t` of mask_out (packed little-endian uint32 words, caller-zeroed)
+// for every token whose bytes the PDA accepts from `state`. Tokens with no
+// bytes (tok_off[t+1] == tok_off[t]) never match.
+void json_fill_mask(const uint8_t* state, int32_t state_len,
+                    const uint8_t* tok_bytes, const int64_t* tok_off,
+                    int32_t n_tokens, uint32_t* mask_out) {
+  if (state_len < 4) return;
+  int32_t base_depth = state_len - 4;
+  int64_t max_tok = 0;
+  for (int32_t t = 0; t < n_tokens; t++) {
+    int64_t l = tok_off[t + 1] - tok_off[t];
+    if (l > max_tok) max_tok = l;
+  }
+  std::vector<uint8_t> stack(base_depth + max_tok + 1);
+  for (int32_t t = 0; t < n_tokens; t++) {
+    int64_t lo = tok_off[t], hi = tok_off[t + 1];
+    if (hi <= lo) continue;
+    State s;
+    s.mode = state[0]; s.aux1 = state[1]; s.aux2 = state[2]; s.key = state[3];
+    std::memcpy(stack.data(), state + 4, base_depth);
+    s.stack = stack.data();
+    s.depth = base_depth;
+    bool ok = true;
+    for (int64_t i = lo; i < hi; i++) {
+      if (!advance(s, tok_bytes[i])) { ok = false; break; }
+    }
+    if (ok) mask_out[t >> 5] |= (uint32_t)1 << (t & 31);
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,383 @@
+"""Grammar-constrained decoding: the Ollama ``format: "json"`` option.
+
+The reference delegates structured output to llama.cpp's GBNF sampler inside
+the ollama image (/root/reference/pkg/model/pod.go:11; the API field is part
+of the /api/generate surface the reference's probes assume). Here the design
+is TPU-native: sampling stays **on device**, and the grammar contributes one
+packed ``uint32`` bitmask per slot that the jitted decode step unpacks and
+applies to the logits (engine.py). The host advances a byte-level JSON
+pushdown automaton with each sampled token and uploads the next mask — a
+[B, ceil(V/32)] transfer, not a logits download.
+
+Pieces:
+- a byte-level PDA over a *packed state* (``bytes``): mode/aux/key flag +
+  one byte per open container. Pure-Python reference implementation here;
+  ``native/grammar.cpp`` implements the identical contract for the hot
+  mask-fill (vocab × token-bytes simulations per novel state).
+- ``TokenTable``: per-tokenizer concatenated token bytes + offsets, shared
+  mask cache keyed by an *abstract* state (the stack suffix a token of
+  ``max_len`` bytes could possibly touch — exact, see ``_cache_key``).
+- ``JsonConstraint``: per-request PDA state; ``mask_row()`` → packed mask,
+  ``advance(tid)`` → feed the sampled token.
+
+EOS is allowed exactly when the JSON value is complete at depth 0; once
+complete, *only* EOS is allowed, which forces generation to stop instead of
+trailing whitespace forever.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from collections import OrderedDict
+from typing import Iterable, List, Optional, Sequence
+
+import numpy as np
+
+# --- packed PDA state --------------------------------------------------------
+# state = bytes([mode, aux1, aux2, key_flag]) + stack (one byte per open
+# container, CTX_OBJ/CTX_ARR, top of stack = last byte)
+
+M_VALUE = 0       # expecting a value
+M_ARR_FIRST = 1   # expecting a value or ']' (right after '[')
+M_KEY_FIRST = 2   # expecting '"' (object key) or '}' (right after '{')
+M_KEY = 3         # expecting '"' (object key, after ',')
+M_COLON = 4       # expecting ':'
+M_STR = 5         # inside a string (key_flag: 1 = object key)
+M_ESC = 6         # after '\'
+M_HEX = 7         # inside \uXXXX (aux1 = hex digits remaining)
+M_NUM = 8         # inside a number (aux1 = numstate)
+M_LIT = 9         # inside true/false/null (aux1 = literal id, aux2 = pos)
+M_AFTER = 10      # after a complete value
+
+CTX_OBJ, CTX_ARR = 1, 2
+
+NS_MINUS, NS_ZERO, NS_INT, NS_DOT, NS_FRAC, NS_E, NS_ESIGN, NS_EXP = range(8)
+_NS_TERMINAL = frozenset((NS_ZERO, NS_INT, NS_FRAC, NS_EXP))
+
+_LITERALS = (b"true", b"false", b"null")
+_WS = frozenset(b" \t\n\r")
+_HEXD = frozenset(b"0123456789abcdefABCDEF")
+_ESCAPES = frozenset(b'"\\/bfnrt')
+
+INITIAL_STATE = bytes((M_VALUE, 0, 0, 0))
+
+
+def _start_value(b: int, stack: bytes) -> Optional[bytes]:
+    """Value-start byte → new packed state, or None if not a value start.
+
+    Depth is deliberately unbounded (1 byte per open container, and a
+    request can open at most num_predict containers) — a depth cap would
+    make token acceptance depend on the depth itself and break the
+    stack-suffix mask cache (TokenTable._cache_key)."""
+    if b == 0x7B:  # {
+        return bytes((M_KEY_FIRST, 0, 0, 0)) + stack + bytes((CTX_OBJ,))
+    if b == 0x5B:  # [
+        return bytes((M_ARR_FIRST, 0, 0, 0)) + stack + bytes((CTX_ARR,))
+    if b == 0x22:  # "
+        return bytes((M_STR, 0, 0, 0)) + stack
+    if b == 0x2D:  # -
+        return bytes((M_NUM, NS_MINUS, 0, 0)) + stack
+    if b == 0x30:  # 0
+        return bytes((M_NUM, NS_ZERO, 0, 0)) + stack
+    if 0x31 <= b <= 0x39:
+        return bytes((M_NUM, NS_INT, 0, 0)) + stack
+    if b == 0x74:  # t
+        return bytes((M_LIT, 0, 1, 0)) + stack
+    if b == 0x66:  # f
+        return bytes((M_LIT, 1, 1, 0)) + stack
+    if b == 0x6E:  # n
+        return bytes((M_LIT, 2, 1, 0)) + stack
+    return None
+
+
+def _after_value(b: int, stack: bytes) -> Optional[bytes]:
+    """One byte in M_AFTER → new packed state, or None."""
+    if b in _WS:
+        return bytes((M_AFTER, 0, 0, 0)) + stack
+    if not stack:
+        return None
+    top = stack[-1]
+    if top == CTX_OBJ:
+        if b == 0x2C:  # ,
+            return bytes((M_KEY, 0, 0, 0)) + stack
+        if b == 0x7D:  # }
+            return bytes((M_AFTER, 0, 0, 0)) + stack[:-1]
+    else:  # CTX_ARR
+        if b == 0x2C:
+            return bytes((M_VALUE, 0, 0, 0)) + stack
+        if b == 0x5D:  # ]
+            return bytes((M_AFTER, 0, 0, 0)) + stack[:-1]
+    return None
+
+
+def advance_byte(state: bytes, b: int) -> Optional[bytes]:
+    """Feed one byte to the PDA; returns the new packed state or None."""
+    mode, aux1, aux2, key = state[0], state[1], state[2], state[3]
+    stack = state[4:]
+    if mode == M_VALUE:
+        if b in _WS:
+            return state
+        return _start_value(b, stack)
+    if mode == M_ARR_FIRST:
+        if b in _WS:
+            return state
+        if b == 0x5D:  # ]
+            return bytes((M_AFTER, 0, 0, 0)) + stack[:-1]
+        return _start_value(b, stack)
+    if mode == M_KEY_FIRST:
+        if b in _WS:
+            return state
+        if b == 0x22:
+            return bytes((M_STR, 0, 0, 1)) + stack
+        if b == 0x7D:  # }
+            return bytes((M_AFTER, 0, 0, 0)) + stack[:-1]
+        return None
+    if mode == M_KEY:
+        if b in _WS:
+            return state
+        if b == 0x22:
+            return bytes((M_STR, 0, 0, 1)) + stack
+        return None
+    if mode == M_COLON:
+        if b in _WS:
+            return state
+        if b == 0x3A:  # :
+            return bytes((M_VALUE, 0, 0, 0)) + stack
+        return None
+    if mode == M_STR:
+        if b == 0x22:  # closing quote
+            if key:
+                return bytes((M_COLON, 0, 0, 0)) + stack
+            return bytes((M_AFTER, 0, 0, 0)) + stack
+        if b == 0x5C:  # backslash
+            return bytes((M_ESC, 0, 0, key)) + stack
+        if b < 0x20:   # raw control bytes are invalid in JSON strings
+            return None
+        return state
+    if mode == M_ESC:
+        if b in _ESCAPES:
+            return bytes((M_STR, 0, 0, key)) + stack
+        if b == 0x75:  # u
+            return bytes((M_HEX, 4, 0, key)) + stack
+        return None
+    if mode == M_HEX:
+        if b in _HEXD:
+            if aux1 == 1:
+                return bytes((M_STR, 0, 0, key)) + stack
+            return bytes((M_HEX, aux1 - 1, 0, key)) + stack
+        return None
+    if mode == M_NUM:
+        ns = aux1
+        if 0x30 <= b <= 0x39:  # digit
+            nxt = {NS_MINUS: NS_ZERO if b == 0x30 else NS_INT,
+                   NS_INT: NS_INT, NS_DOT: NS_FRAC, NS_FRAC: NS_FRAC,
+                   NS_E: NS_EXP, NS_ESIGN: NS_EXP, NS_EXP: NS_EXP}.get(ns)
+            if ns == NS_ZERO:  # leading zero: no more int digits
+                nxt = None
+            if nxt is None:
+                return None
+            return bytes((M_NUM, nxt, 0, 0)) + stack
+        if b == 0x2E and ns in (NS_ZERO, NS_INT):  # .
+            return bytes((M_NUM, NS_DOT, 0, 0)) + stack
+        if b in (0x65, 0x45) and ns in (NS_ZERO, NS_INT, NS_FRAC):  # e E
+            return bytes((M_NUM, NS_E, 0, 0)) + stack
+        if b in (0x2B, 0x2D) and ns == NS_E:  # + -
+            return bytes((M_NUM, NS_ESIGN, 0, 0)) + stack
+        if ns in _NS_TERMINAL:  # delimiter terminates the number
+            return _after_value(b, stack)
+        return None
+    if mode == M_LIT:
+        lit = _LITERALS[aux1]
+        if aux2 < len(lit) and b == lit[aux2]:
+            if aux2 + 1 == len(lit):
+                return bytes((M_AFTER, 0, 0, 0)) + stack
+            return bytes((M_LIT, aux1, aux2 + 1, 0)) + stack
+        return None
+    if mode == M_AFTER:
+        return _after_value(b, stack)
+    return None
+
+
+def advance_bytes(state: bytes, data: bytes) -> Optional[bytes]:
+    for b in data:
+        state = advance_byte(state, b)
+        if state is None:
+            return None
+    return state
+
+
+def eos_ok(state: bytes) -> bool:
+    """EOS is legal iff a complete JSON value sits at depth 0."""
+    if len(state) > 4:  # open containers
+        return False
+    mode, aux1 = state[0], state[1]
+    return mode == M_AFTER or (mode == M_NUM and aux1 in _NS_TERMINAL)
+
+
+# --- native kernel -----------------------------------------------------------
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "native", "grammar.cpp")
+_BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
+_LIB = os.path.join(_BUILD_DIR, "libtpuop_grammar.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _load_native():
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SRC):
+            return None
+        if (not os.path.exists(_LIB)
+                or os.path.getmtime(_SRC) > os.path.getmtime(_LIB)):
+            os.makedirs(_BUILD_DIR, exist_ok=True)
+            cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+                   "-o", _LIB, _SRC]
+            try:
+                subprocess.run(cmd, check=True, capture_output=True,
+                               timeout=120)
+            except (subprocess.SubprocessError, FileNotFoundError):
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError:
+            return None
+        u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+        i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+        u32p = np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS")
+        lib.json_fill_mask.argtypes = [
+            u8p, ctypes.c_int32, u8p, i64p, ctypes.c_int32, u32p]
+        lib.json_fill_mask.restype = None
+        _lib = lib
+        return _lib
+
+
+# --- token table + constraint ------------------------------------------------
+
+class TokenTable:
+    """Per-tokenizer token byte table + shared mask cache.
+
+    Tokens with empty byte content (control/unknown pieces) are never
+    grammar-legal; EOG ids are OR-ed in by ``mask_for`` when the state
+    accepts end-of-output.
+    """
+
+    def __init__(self, pieces: Sequence[bytes], eog_ids: Iterable[int]):
+        self.pieces: List[bytes] = [bytes(p) for p in pieces]
+        self.n_vocab = len(self.pieces)
+        self.n_words = (self.n_vocab + 31) // 32
+        self.eog_ids = [i for i in eog_ids if 0 <= i < self.n_vocab]
+        self.max_len = max((len(p) for p in self.pieces), default=1)
+        # concatenated layout for the native kernel
+        self._flat = np.frombuffer(
+            b"".join(self.pieces) or b"\0", np.uint8).copy()
+        off = np.zeros(self.n_vocab + 1, np.int64)
+        np.cumsum([len(p) for p in self.pieces], out=off[1:])
+        self._off = off
+        self._eog_packed = np.zeros(self.n_words, np.uint32)
+        for i in self.eog_ids:
+            self._eog_packed[i >> 5] |= np.uint32(1 << (i & 31))
+        # LRU-bounded: abstract states are minted per nesting pattern, so
+        # an adversarial '[{[{[…' stream would otherwise grow this (and
+        # pay a fresh vocab-wide fill) without limit
+        self._cache: OrderedDict = OrderedDict()
+        self._cache_cap = 4096
+        self._cache_lock = threading.Lock()
+        # prime on the constructing (HTTP) thread: builds the native
+        # kernel (a g++ shell-out on first use) and the initial-state
+        # mask so the scheduler loop never stalls on either
+        _load_native()
+        self.mask_for(INITIAL_STATE)
+
+    @classmethod
+    def for_tokenizer(cls, tok) -> "TokenTable":
+        """Build (and cache on the tokenizer) the table for a Tokenizer."""
+        tbl = getattr(tok, "_constrain_table", None)
+        if tbl is None:
+            tbl = cls([tok.piece_bytes(i) for i in range(tok.n_vocab)],
+                      tok.eog_ids)
+            tok._constrain_table = tbl
+        return tbl
+
+    def _cache_key(self, state: bytes) -> bytes:
+        """Abstract state: header + the stack suffix a single token could
+        touch. A token of L bytes pops at most L containers, so a suffix of
+        ``max_len`` container bytes (plus emptiness, which the suffix
+        preserves) fully determines every token's acceptance."""
+        return state[:4] + state[4:][-self.max_len:]
+
+    def mask_for(self, state: bytes) -> np.ndarray:
+        """Packed allowed-token mask [n_words] uint32 for ``state``."""
+        key = self._cache_key(state)
+        with self._cache_lock:
+            m = self._cache.get(key)
+            if m is not None:
+                self._cache.move_to_end(key)
+                return m
+        mask = np.zeros(self.n_words, np.uint32)
+        lib = _load_native()
+        if lib is not None:
+            st = np.frombuffer(key, np.uint8).copy()
+            lib.json_fill_mask(st, np.int32(len(key)), self._flat,
+                               self._off, np.int32(self.n_vocab), mask)
+        else:
+            for tid, piece in enumerate(self.pieces):
+                if piece and advance_bytes(state, piece) is not None:
+                    mask[tid >> 5] |= np.uint32(1 << (tid & 31))
+        if eos_ok(state):
+            if state[0] == M_AFTER:
+                # value definitely closed: only whitespace could follow —
+                # force EOS so the model stops instead of trailing forever
+                mask = self._eog_packed.copy()
+            else:
+                # e.g. a top-level number: legal to extend OR to stop
+                mask = mask | self._eog_packed
+        with self._cache_lock:
+            self._cache[key] = mask
+            self._cache.move_to_end(key)
+            while len(self._cache) > self._cache_cap:
+                self._cache.popitem(last=False)
+        return mask
+
+
+class JsonConstraint:
+    """Per-request JSON grammar state for the engine/scheduler."""
+
+    def __init__(self, table: TokenTable):
+        self.table = table
+        self.state: Optional[bytes] = INITIAL_STATE
+
+    @classmethod
+    def for_tokenizer(cls, tok) -> "JsonConstraint":
+        return cls(TokenTable.for_tokenizer(tok))
+
+    def mask_row(self) -> np.ndarray:
+        assert self.state is not None, "constraint already dead"
+        return self.table.mask_for(self.state)
+
+    def advance(self, tid: int) -> bool:
+        """Feed one sampled token; False if it was grammar-illegal (which
+        a masked sampler should never produce)."""
+        if self.state is None:
+            return False
+        piece = (self.table.pieces[tid]
+                 if 0 <= tid < self.table.n_vocab else b"")
+        if not piece:
+            return False
+        nxt = advance_bytes(self.state, piece)
+        self.state = nxt
+        return nxt is not None
+
+    @property
+    def done(self) -> bool:
+        return self.state is not None and eos_ok(self.state)

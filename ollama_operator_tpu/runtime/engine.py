@@ -79,6 +79,18 @@ def resolve_cache_dtype(name_or_dtype) -> Any:
     return {jnp.dtype(v): v for v in CACHE_DTYPES.values()}[dt]
 
 
+def unpack_mask(mask_bits, V: int):
+    """Packed [..., ceil(V/32)] uint32 → bool [..., V] allowed-token mask.
+
+    The grammar-constrained decode path (ops/constrain.py): the host uploads
+    one packed row per slot and the decode program unpacks it on device —
+    32× less host→device traffic than a dense bool mask, and no logits
+    download (sampling stays on device)."""
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    bits = (mask_bits[..., None] >> shifts) & jnp.uint32(1)
+    return bits.reshape(*mask_bits.shape[:-1], -1)[..., :V] != 0
+
+
 def prefill_buckets(max_seq_len: int, min_bucket: int):
     b, out = min_bucket, []
     while b < max_seq_len:
@@ -178,6 +190,15 @@ class Engine:
             jnp.full((B, W), V, jnp.int32), slot_sh) \
             if slot_sh is not None else jnp.full((B, W), V, jnp.int32)
         self.last_tokens = zeros((B,), jnp.int32, slot_sh)
+        # grammar-constraint state: packed per-slot allowed-token masks
+        # (all-ones + flag 0 = unconstrained; ops/constrain.py fills rows)
+        self.mask_words = (V + 31) // 32
+        self._mask_ones = jnp.full((self.mask_words,), 0xFFFFFFFF, jnp.uint32)
+        ones = jnp.full((B, self.mask_words), 0xFFFFFFFF, jnp.uint32)
+        self.mask_bits = jax.device_put(ones, slot_sh) \
+            if slot_sh is not None else ones
+        self._constrained = np.zeros((B,), bool)
+        self._constr_dev = zeros((B,), jnp.int32, slot_sh)
         self.active = np.zeros((B,), bool)  # host-side mask
         self._active_dev = zeros((B,), jnp.int32, slot_sh)
         # host mirror of per-slot lengths — lets decode_n pick the static
@@ -246,7 +267,7 @@ class Engine:
 
         def _insert_prefilled(k_cache, v_cache, lengths, counts,
                               last_tokens, pring, logits, ks, vs, tokens,
-                              slot, n_valid, sp_row, key):
+                              slot, n_valid, sp_row, key, mask_row, cflag):
             """Shared admission tail: sample the first token from the
             prefill logits and install chunk K/V + slot state. Penalty
             counts see only the LAST repeat_last_n prompt tokens (the
@@ -255,6 +276,9 @@ class Engine:
             the penalty counts."""
             last = jax.lax.dynamic_index_in_dim(
                 logits[0], n_valid - 1, axis=0, keepdims=False)
+            # grammar mask on the first sampled token (format: "json")
+            allowed = unpack_mask(mask_row, cfg.vocab_size)
+            last = jnp.where((cflag == 1) & ~allowed, sampling.NEG_INF, last)
             # ring of the last W prompt tokens: absolute positions
             # n_valid-W .. n_valid-1 land in slots pos % W (each slot
             # exactly once — no scatter duplicates)
@@ -298,18 +322,20 @@ class Engine:
 
         @partial(jax.jit, donate_argnums=(1, 2, 3, 4, 5, 6))
         def _admit(params, k_cache, v_cache, lengths, counts, last_tokens,
-                   pring, tokens, slot, n_valid, sp_row, key):
+                   pring, tokens, slot, n_valid, sp_row, key, mask_row,
+                   cflag):
             """Prefill a padded B=1 chunk AND insert it into the slot state
             — one device program, one host round-trip per admission."""
             logits, ks, vs = prefill_impl(params, tokens=tokens)
             return _insert_prefilled(k_cache, v_cache, lengths, counts,
                                      last_tokens, pring, logits, ks, vs,
-                                     tokens, slot, n_valid, sp_row, key)
+                                     tokens, slot, n_valid, sp_row, key,
+                                     mask_row, cflag)
 
         @partial(jax.jit, donate_argnums=(1, 2, 3, 4, 5, 6))
         def _admit_embeds(params, k_cache, v_cache, lengths, counts,
                           last_tokens, pring, tokens, embeds, slot, n_valid,
-                          sp_row, key):
+                          sp_row, key, mask_row, cflag):
             """Multimodal admission: like _admit but prefilling from a
             precomputed [1, T, D] embedding sequence (image tokens spliced
             into text embeddings); ``tokens`` feeds the penalty counts with
@@ -319,18 +345,23 @@ class Engine:
                                           inputs_embeds=embeds)
             return _insert_prefilled(k_cache, v_cache, lengths, counts,
                                      last_tokens, pring, logits, ks, vs,
-                                     tokens, slot, n_valid, sp_row, key)
+                                     tokens, slot, n_valid, sp_row, key,
+                                     mask_row, cflag)
 
         def _decode_body(params, k_cache, v_cache, lengths, counts,
-                         last_tokens, pring, sp, keys, active,
-                         attn_len=None):
+                         last_tokens, pring, sp, keys, active, mask_bits,
+                         constrained, attn_len=None):
             kw = {"attn_len": attn_len} if (attn_len is not None
                                             and self._bucketed_attn) else {}
             logits, k_cache, v_cache = step_impl(
                 params, tokens=last_tokens[:, None], k_cache=k_cache,
                 v_cache=v_cache, lengths=lengths, **kw)
             step_keys = jax.vmap(jax.random.fold_in)(keys, lengths)
-            toks = sampling.sample(logits[:, 0], counts, sp, step_keys)
+            last = logits[:, 0]
+            allowed = unpack_mask(mask_bits, cfg.vocab_size)
+            last = jnp.where((constrained == 1)[:, None] & ~allowed,
+                             sampling.NEG_INF, last)
+            toks = sampling.sample(last, counts, sp, step_keys)
             B = toks.shape[0]
             bi = jnp.arange(B)
             # penalty window: the NEW token's absolute position is
@@ -352,29 +383,33 @@ class Engine:
 
         @partial(jax.jit, donate_argnums=(1, 2, 3, 4, 5, 6, 8))
         def _decode(params, k_cache, v_cache, lengths, counts, last_tokens,
-                    pring, sp, keys, active):
+                    pring, sp, keys, active, mask_bits, constrained):
             (toks, k_cache, v_cache, lengths, counts, last_tokens,
              pring) = _decode_body(params, k_cache, v_cache, lengths,
                                    counts, last_tokens, pring, sp, keys,
-                                   active)
+                                   active, mask_bits, constrained)
             return (toks, k_cache, v_cache, lengths, counts, last_tokens,
                     pring, keys)
 
-        @partial(jax.jit, static_argnums=(10, 11),
+        @partial(jax.jit, static_argnums=(12, 13),
                  donate_argnums=(1, 2, 3, 4, 5, 6, 8))
         def _decode_n(params, k_cache, v_cache, lengths, counts, last_tokens,
-                      pring, sp, keys, active, n, attn_len):
+                      pring, sp, keys, active, mask_bits, constrained, n,
+                      attn_len):
             """n decode steps as ONE device program (lax.scan) — a single
             dispatch + host sync per n tokens per slot. ``attn_len`` is the
             static attended-cache prefix (decode traffic scales with it,
-            not with max_seq_len)."""
+            not with max_seq_len). The grammar mask is static across the
+            chunk — the scheduler drops to n=1 while any slot is
+            constrained."""
             def step(carry, _):
                 (k_cache, v_cache, lengths, counts, last_tokens,
                  pring) = carry
                 (toks, k_cache, v_cache, lengths, counts, last_tokens,
                  pring) = _decode_body(params, k_cache, v_cache,
                                        lengths, counts, last_tokens, pring,
-                                       sp, keys, active, attn_len=attn_len)
+                                       sp, keys, active, mask_bits,
+                                       constrained, attn_len=attn_len)
                 return (k_cache, v_cache, lengths, counts, last_tokens,
                         pring), toks
 
@@ -392,12 +427,23 @@ class Engine:
             pring = pring.at[slot].set(cfg.vocab_size)
             return lengths, counts, last_tokens, pring
 
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def _set_mask(mask_bits, constr, slot, row, flag):
+            mask_bits = mask_bits.at[slot].set(row)
+            constr = constr.at[slot].set(flag)
+            if slot_sh is not None:
+                wsc = jax.lax.with_sharding_constraint
+                mask_bits = wsc(mask_bits, slot_sh)
+                constr = wsc(constr, slot_sh)
+            return mask_bits, constr
+
         self._admit_fn = _admit
         self._admit_embeds_fn = _admit_embeds
         self._admit_execs: Dict[int, Any] = {}
         self._decode_fn = _decode
         self._decode_n_fn = _decode_n
         self._release_fn = _release
+        self._set_mask_fn = _set_mask
         # AOT-compiled decode_n executables keyed by (n, attn_bucket) — a
         # bucket crossing must swap programs, never recompile mid-serving
         self._decode_execs: Dict[Any, Any] = {}
@@ -441,12 +487,18 @@ class Engine:
 
     def admit(self, slot: int, prompt: np.ndarray,
               opts: SlotOptions = SlotOptions(),
-              embeds: Optional[np.ndarray] = None) -> int:
+              embeds: Optional[np.ndarray] = None,
+              mask_row: Optional[np.ndarray] = None) -> int:
         """Prefill ``prompt`` into ``slot``; returns the first sampled token.
 
         ``embeds`` [n, D] — optional precomputed embedding sequence for the
         prompt (multimodal); must match len(prompt), where image positions
         in ``prompt`` carry a pad token id for the penalty counts.
+
+        ``mask_row`` [mask_words] uint32 — optional packed allowed-token
+        mask applied to the FIRST sampled token (grammar-constrained
+        requests); the caller then keeps per-step masks flowing via
+        ``set_mask``.
         """
         assert not self.active[slot], f"slot {slot} busy"
         n = int(prompt.shape[0])
@@ -458,6 +510,11 @@ class Engine:
         seed = opts.seed if opts.seed >= 0 else (hash((slot, n)) & 0x7FFFFFFF)
         key = jax.random.key(seed)
         self.keys = self.keys.at[slot].set(key)
+        if mask_row is not None:
+            mrow = jnp.asarray(self._pad_mask_row(mask_row))
+            cflag = jnp.int32(1)
+        else:
+            mrow, cflag = self._mask_ones, jnp.int32(0)
         if embeds is not None:
             assert embeds.shape[0] == n, "embeds must cover the prompt"
             if self.sp_size > 1:
@@ -470,14 +527,14 @@ class Engine:
                 self.params, self.k_cache, self.v_cache, self.lengths,
                 self.counts, self.last_tokens, self.pring,
                 jnp.asarray(tokens), jnp.asarray(emb), jnp.int32(slot),
-                jnp.int32(n), self._sp_row(opts), key)
+                jnp.int32(n), self._sp_row(opts), key, mrow, cflag)
         else:
             (tok, self.k_cache, self.v_cache, self.lengths, self.counts,
              self.last_tokens, self.pring) = self._admit_exec(bucket)(
                 self.params, self.k_cache, self.v_cache, self.lengths,
                 self.counts, self.last_tokens, self.pring,
                 jnp.asarray(tokens), jnp.int32(slot), jnp.int32(n),
-                self._sp_row(opts), key)
+                self._sp_row(opts), key, mrow, cflag)
         self.active[slot] = True
         self._host_lengths[slot] = n
         self._opts[slot] = opts
@@ -497,6 +554,39 @@ class Engine:
                 return b
         return self.max_seq
 
+    def _pad_mask_row(self, row) -> np.ndarray:
+        """Zero-pad a packed mask to the engine's width — ids beyond the
+        grammar's token table are unknown to it and stay disallowed."""
+        row = np.asarray(row, np.uint32)
+        if row.shape[0] == self.mask_words:
+            return row
+        assert row.shape[0] < self.mask_words, (
+            f"mask row of {row.shape[0]} words exceeds vocab "
+            f"({self.mask_words} words)")
+        out = np.zeros((self.mask_words,), np.uint32)
+        out[:row.shape[0]] = row
+        return out
+
+    def set_mask(self, slot: int, row: np.ndarray):
+        """Install the packed allowed-token mask for ``slot`` (applies from
+        the next decode step; constrained until release/clear_mask)."""
+        self._constrained[slot] = True
+        self.mask_bits, self._constr_dev = self._set_mask_fn(
+            self.mask_bits, self._constr_dev, jnp.int32(slot),
+            jnp.asarray(self._pad_mask_row(row)), jnp.int32(1))
+
+    def clear_mask(self, slot: int):
+        if not self._constrained[slot]:
+            return
+        self._constrained[slot] = False
+        self.mask_bits, self._constr_dev = self._set_mask_fn(
+            self.mask_bits, self._constr_dev, jnp.int32(slot),
+            self._mask_ones, jnp.int32(0))
+
+    @property
+    def any_constrained(self) -> bool:
+        return bool(self._constrained.any())
+
     def decode(self) -> np.ndarray:
         """One decode step for every slot; returns sampled tokens [B] (only
         entries where self.active were valid at call time)."""
@@ -504,7 +594,7 @@ class Engine:
          self.last_tokens, self.pring, self.keys) = self._decode_fn(
             self.params, self.k_cache, self.v_cache, self.lengths,
             self.counts, self.last_tokens, self.pring, self.sp, self.keys,
-            self._active_dev)
+            self._active_dev, self.mask_bits, self._constr_dev)
         self._host_lengths[self.active] += 1
         return np.asarray(toks)
 
@@ -515,7 +605,8 @@ class Engine:
             exe = self._decode_n_fn.lower(
                 self.params, self.k_cache, self.v_cache, self.lengths,
                 self.counts, self.last_tokens, self.pring, self.sp,
-                self.keys, self._active_dev, n, attn_len).compile()
+                self.keys, self._active_dev, self.mask_bits,
+                self._constr_dev, n, attn_len).compile()
             self._decode_execs[key] = exe
         return exe
 
@@ -527,7 +618,8 @@ class Engine:
                 self.params, self.k_cache, self.v_cache, self.lengths,
                 self.counts, self.last_tokens, self.pring, tokens,
                 jnp.int32(0), jnp.int32(1),
-                self._sp_row(SlotOptions()), jax.random.key(0)).compile()
+                self._sp_row(SlotOptions()), jax.random.key(0),
+                self._mask_ones, jnp.int32(0)).compile()
             self._admit_execs[bucket] = exe
         return exe
 
@@ -541,6 +633,10 @@ class Engine:
         buckets = self._buckets if self._bucketed_attn else [self.max_seq]
         for b in buckets:
             self._decode_n_exec(n, b)
+            if n != 1:
+                # grammar-constrained serving steps one token per dispatch
+                # (scheduler drops to decode_n(1)) — warm those too
+                self._decode_n_exec(1, b)
         for b in self._buckets:
             self._admit_exec(b)
 
@@ -557,11 +653,12 @@ class Engine:
          self.last_tokens, self.pring, self.keys) = exe(
             self.params, self.k_cache, self.v_cache, self.lengths,
             self.counts, self.last_tokens, self.pring, self.sp, self.keys,
-            self._active_dev)
+            self._active_dev, self.mask_bits, self._constr_dev)
         self._host_lengths[self.active] += n
         return np.asarray(toks_n)
 
     def release(self, slot: int):
+        self.clear_mask(slot)
         self.active[slot] = False
         self._host_lengths[slot] = 0
         self._opts.pop(slot, None)

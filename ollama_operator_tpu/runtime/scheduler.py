@@ -63,11 +63,12 @@ class Request:
 
     def __init__(self, prompt_ids: Sequence[int], opts: SlotOptions,
                  max_tokens: int, eog_ids: frozenset,
-                 embeds: Optional[np.ndarray] = None):
+                 embeds: Optional[np.ndarray] = None, constraint=None):
         with Request._ids_lock:
             self.id = next(Request._ids)
         self.prompt_ids = np.asarray(prompt_ids, np.int32)
         self.embeds = embeds          # [n_prompt, D] multimodal embeddings
+        self.constraint = constraint  # ops/constrain.py grammar state
         self.opts = opts
         self.max_tokens = max_tokens
         self.eog_ids = eog_ids
@@ -115,12 +116,14 @@ class Scheduler:
                opts: SlotOptions = SlotOptions(),
                max_tokens: int = 128,
                eog_ids: frozenset = frozenset(),
-               embeds: Optional[np.ndarray] = None) -> Request:
+               embeds: Optional[np.ndarray] = None,
+               constraint=None) -> Request:
         if len(prompt_ids) >= self.engine.max_seq:
             raise ValueError(
                 f"prompt of {len(prompt_ids)} tokens exceeds context window "
                 f"{self.engine.max_seq}")
-        req = Request(prompt_ids, opts, max_tokens, eog_ids, embeds=embeds)
+        req = Request(prompt_ids, opts, max_tokens, eog_ids, embeds=embeds,
+                      constraint=constraint)
         # broken-check + enqueue under the lock: the failure path flips
         # `broken` and drains under the same lock, so a request can never
         # slip into the queue after the final drain (its reader would hang)
@@ -194,8 +197,11 @@ class Scheduler:
                 continue
             slot = free.pop(0)
             try:
+                mask_row = (req.constraint.mask_row()
+                            if req.constraint is not None else None)
                 first = self.engine.admit(slot, req.prompt_ids, req.opts,
-                                          embeds=req.embeds)
+                                          embeds=req.embeds,
+                                          mask_row=mask_row)
             except Exception as e:  # surfacing engine errors to the caller
                 req.error = str(e)
                 req.out.put(("error", str(e)))
@@ -204,8 +210,15 @@ class Scheduler:
             req.stats.t_admitted = time.monotonic()
             self.total_prompt += req.stats.n_prompt
             self._running[slot] = req
-            if not self._emit(req, first):
+            # grammar check before emitting (see _step)
+            if (req.constraint is not None
+                    and first not in req.eog_ids
+                    and not req.constraint.advance(first)):
                 self._finish(slot, req, "stop")
+            elif not self._emit(req, first):
+                self._finish(slot, req, "stop")
+            elif req.constraint is not None:
+                self.engine.set_mask(slot, req.constraint.mask_row())
 
     def _loop(self):
         while not self._stop.is_set():
@@ -261,8 +274,12 @@ class Scheduler:
         # chunked decode: ecfg.decode_chunk steps per device round-trip.
         # A slot that stops mid-chunk has its remaining rows discarded
         # (_running[slot] goes None); the over-decoded cache entries are
-        # zeroed by release().
-        toks_n = self.engine.decode_n()
+        # zeroed by release(). Grammar-constrained slots need a fresh mask
+        # per token, so while any is active the whole batch steps one
+        # token per dispatch — still through the AOT-warmed bucketed
+        # decode_n path (n=1), never the cold unbucketed single-step jit.
+        toks_n = self.engine.decode_n(
+            1 if self.engine.any_constrained else None)
         self._consecutive_failures = 0
         for row in np.asarray(toks_n):
             any_running = False
@@ -270,12 +287,23 @@ class Scheduler:
                 if req is None:
                     continue
                 any_running = True
-                if not self._emit(req, int(row[slot])):
+                tid = int(row[slot])
+                # grammar check BEFORE emitting: a dead-end state (empty
+                # mask → uniform sampling over -inf logits) must not leak
+                # an illegal token into the client's JSON stream
+                if (req.constraint is not None
+                        and tid not in req.eog_ids
+                        and not req.constraint.advance(tid)):
+                    self._finish(slot, req, "stop")
+                    continue
+                if not self._emit(req, tid):
                     self._finish(slot, req, "stop")
                 # host-side length tracking (no device sync): the cache
                 # holds the prompt plus one entry per decode step so far
                 elif (req.stats.n_prompt + req.stats.n_generated
                       >= self.engine.max_seq - 1):
                     self._finish(slot, req, "length")
+                elif req.constraint is not None:
+                    self.engine.set_mask(slot, req.constraint.mask_row())
             if not any_running:
                 break

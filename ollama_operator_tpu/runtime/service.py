@@ -252,9 +252,15 @@ class LoadedModel:
                         context: Optional[List[int]] = None,
                         raw: bool = False,
                         cancel_event: Optional[threading.Event] = None,
-                        images: Optional[List] = None
+                        images: Optional[List] = None,
+                        format: Optional[object] = None
                         ) -> Iterator[Tuple[str, Optional[GenerateResult]]]:
         """Yields (text_piece, None)… then ("", final GenerateResult).
+
+        ``format``: Ollama structured-output field — ``"json"`` (or any
+        JSON-schema dict, honoured as generic JSON mode) turns on
+        grammar-constrained decoding (ops/constrain.py): the output is
+        guaranteed to be a syntactically complete JSON value.
 
         Option parsing, tokenization, and scheduler admission run eagerly
         at call time — NOT on first next() — so SchedulerBusy/Broken and
@@ -280,9 +286,18 @@ class LoadedModel:
             raise ValueError(
                 f"prompt of {len(ids)} tokens leaves no room to generate "
                 f"within the {self.engine.max_seq}-token context")
+        constraint = None
+        if format is not None and format != "":
+            if format == "json" or isinstance(format, dict):
+                from ..ops.constrain import JsonConstraint
+                constraint = JsonConstraint.for_tokenizer(self.tokenizer)
+            else:
+                raise ValueError(
+                    f"unsupported format {format!r}; expected \"json\" or "
+                    f"a JSON schema object")
         req = self.scheduler.submit(ids, so, max_new,
                                     eog_ids=frozenset(self.tokenizer.eog_ids),
-                                    embeds=embeds)
+                                    embeds=embeds, constraint=constraint)
         # returned context carries only REAL token ids: a continuation
         # re-prefills from context without the image, so image pad ids
         # must not leak into it (they would re-enter as garbage tokens)

@@ -537,6 +537,10 @@ class Handler(BaseHTTPRequestHandler):
             route(body)
         except ApiError as e:
             self._send_error(str(e), e.status)
+        except ValueError as e:
+            # request-validation failures from the service layer (bad
+            # format value, prompt too long, images on a text model, …)
+            self._send_error(str(e), 400)
         except SchedulerBusy as e:
             self._send_error(str(e), 503)
         except SchedulerBroken as e:
@@ -573,7 +577,8 @@ class Handler(BaseHTTPRequestHandler):
             prompt, system=body.get("system"), template=body.get("template"))
         gen = lm.generate_stream(text_prompt, options=body.get("options"),
                                  context=body.get("context"), raw=raw,
-                                 images=_decode_images(body.get("images")))
+                                 images=_decode_images(body.get("images")),
+                                 format=body.get("format"))
         if stream:
             self._start_stream()
             for piece, final in gen:
@@ -618,7 +623,8 @@ class Handler(BaseHTTPRequestHandler):
         for m in messages:
             images.extend(m.get("images") or [])
         gen = lm.generate_stream(prompt, options=body.get("options"),
-                                 images=_decode_images(images))
+                                 images=_decode_images(images),
+                                 format=body.get("format"))
         if stream:
             self._start_stream()
             for piece, final in gen:
@@ -745,7 +751,13 @@ class Handler(BaseHTTPRequestHandler):
         prompt = lm.render_chat(messages)
         rid = f"chatcmpl-{int(time.time() * 1000)}"
         created = int(time.time())
-        gen = lm.generate_stream(prompt, options=options)
+        # OpenAI response_format → grammar-constrained JSON decoding
+        rf = body.get("response_format") or {}
+        fmt = None
+        if isinstance(rf, dict) and rf.get("type") in ("json_object",
+                                                       "json_schema"):
+            fmt = "json"
+        gen = lm.generate_stream(prompt, options=options, format=fmt)
         if body.get("stream"):
             self._start_stream(ctype="text/event-stream")
             self._chunk(self._sse({

@@ -1,0 +1,223 @@
+"""Grammar-constrained decoding (format: "json"): the byte-level JSON PDA,
+the packed token masks, the native kernel's equivalence with the Python
+reference, and the engine/scheduler integration (masked on-device sampling
+must only ever emit grammar-legal tokens)."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ollama_operator_tpu.models import config as cfglib
+from ollama_operator_tpu.models import decoder
+from ollama_operator_tpu.ops import constrain as C
+from ollama_operator_tpu.ops.constrain import (
+    INITIAL_STATE, JsonConstraint, TokenTable, advance_bytes, eos_ok)
+from ollama_operator_tpu.runtime.engine import (
+    Engine, EngineConfig, SlotOptions, unpack_mask)
+from ollama_operator_tpu.runtime.scheduler import Scheduler
+
+F32 = jnp.float32
+
+
+# --- PDA ---------------------------------------------------------------------
+
+VALID = [
+    '{}', '[]', '"x"', '0', '-0.5', '1e9', '2E-10', 'true', 'false', 'null',
+    '{"a": 1}', '{"a": {"b": [1, 2, 3]}}', '[{"x": "y\\n"}, null, -3.25]',
+    ' { "k" : [ true , false ] } ', '"\\u00e9\\\\"', '[[[[[]]]]]',
+    '{"a":1,"b":[2,{"c":"d"}],"e":null}', '123.456e+7', '""',
+]
+
+INVALID = [
+    '{,}', '[1,]', "{'a':1}", '{"a" 1}', '{"a":}', '01', '1.', '1e',
+    '+1', 'tru ', '{"a": 1,}', '[1 2]', '"ab\x01c"', '{"a"}', '--1',
+    ']', '}', ',', ':', '{]',
+]
+
+
+@pytest.mark.parametrize("doc", VALID)
+def test_pda_accepts_valid(doc):
+    st = advance_bytes(INITIAL_STATE, doc.encode())
+    assert st is not None
+    assert eos_ok(st), doc
+    json.loads(doc)  # sanity: stdlib agrees it parses
+
+
+@pytest.mark.parametrize("doc", INVALID)
+def test_pda_rejects_invalid(doc):
+    st = advance_bytes(INITIAL_STATE, doc.encode())
+    # either a byte was rejected, or the doc is an incomplete/illegal value
+    assert st is None or not eos_ok(st), doc
+
+
+def test_pda_incomplete_not_eos():
+    for prefix in ['{', '[1,', '"ab', '{"a":', '-', '1e', '[{}']:
+        st = advance_bytes(INITIAL_STATE, prefix.encode())
+        assert st is not None and not eos_ok(st), prefix
+
+
+# --- token table / masks -----------------------------------------------------
+
+EOS = 0
+PIECES = ([b""] +  # id 0: EOS (control tokens have no bytes)
+          [c.encode() for c in '{}[]":,-. \n'] +
+          [str(d).encode() for d in range(10)] +
+          [b"true", b"false", b"null", b'"name"', b'": "', b"},", b'"a',
+           b'b"', b"\\", b"u00", b"12", b"e+", b"ab", b"cd"])
+
+
+def make_table():
+    return TokenTable(PIECES, eog_ids=[EOS])
+
+
+def brute_force_mask(table, state):
+    mask = np.zeros(table.n_words, np.uint32)
+    for tid, piece in enumerate(table.pieces):
+        if piece and advance_bytes(state, piece) is not None:
+            mask[tid >> 5] |= np.uint32(1 << (tid & 31))
+    if eos_ok(state):
+        if state[0] == C.M_AFTER:
+            mask = table._eog_packed.copy()
+        else:
+            mask = mask | table._eog_packed
+    return mask
+
+
+STATES = [INITIAL_STATE] + [
+    advance_bytes(INITIAL_STATE, p.encode()) for p in
+    ['{', '{"a"', '{"a":', '{"a": 1', '{"a": 1,', '[', '[1', '[1,',
+     '"x', '"x\\', '"x\\u0', '12', '12.', '12.5e', 'tr', '{"a": {"b": [',
+     '{"a": [1, {"b": 2}', '3']]
+
+
+@pytest.mark.parametrize("state", STATES, ids=range(len(STATES)))
+def test_mask_matches_brute_force(state):
+    table = make_table()
+    got = table.mask_for(state)
+    np.testing.assert_array_equal(got, brute_force_mask(table, state))
+
+
+def test_native_kernel_matches_python():
+    if C._load_native() is None:
+        pytest.skip("no native grammar kernel (g++ unavailable)")
+    # fresh tables so caches don't mix the two paths
+    native_table = make_table()
+    for state in STATES:
+        native = np.zeros(native_table.n_words, np.uint32)
+        key = native_table._cache_key(state)
+        st = np.frombuffer(key, np.uint8).copy()
+        C._load_native().json_fill_mask(
+            st, np.int32(len(key)), native_table._flat, native_table._off,
+            np.int32(native_table.n_vocab), native)
+        expect = np.zeros(native_table.n_words, np.uint32)
+        for tid, piece in enumerate(native_table.pieces):
+            if piece and advance_bytes(state, piece) is not None:
+                expect[tid >> 5] |= np.uint32(1 << (tid & 31))
+        np.testing.assert_array_equal(native, expect)
+
+
+def test_mask_cache_stack_suffix_is_exact():
+    """Two states that differ only below the reachable stack suffix must
+    (and do) share a mask; states differing within it must not collide."""
+    table = make_table()
+    deep_obj = advance_bytes(INITIAL_STATE, b'{"a":' * 40 + b"[")
+    deeper = advance_bytes(INITIAL_STATE, b'{"a":' * 50 + b"[")
+    assert table._cache_key(deep_obj) == table._cache_key(deeper)
+    in_arr = advance_bytes(INITIAL_STATE, b"[")
+    in_obj_arr = advance_bytes(INITIAL_STATE, b'{"a": [')
+    assert table._cache_key(in_arr) != table._cache_key(in_obj_arr)
+
+
+def test_constraint_lifecycle():
+    table = make_table()
+    c = JsonConstraint(table)
+    tid = PIECES.index(b"{")
+    assert c.advance(tid)
+    assert not c.done
+    assert c.advance(PIECES.index(b"}"))
+    assert c.done
+    # complete object → only EOS remains legal
+    mask = c.mask_row()
+    bits = np.unpackbits(mask.view(np.uint8), bitorder="little")
+    assert bits[EOS] == 1 and bits.sum() == 1
+
+
+def test_unpack_mask_roundtrip():
+    V = 77
+    rng = np.random.default_rng(0)
+    dense = rng.integers(0, 2, V).astype(bool)
+    packed = np.zeros(((V + 31) // 32,), np.uint32)
+    for i in np.nonzero(dense)[0]:
+        packed[i >> 5] |= np.uint32(1 << (i & 31))
+    got = np.asarray(unpack_mask(jnp.asarray(packed[None]), V))[0]
+    np.testing.assert_array_equal(got, dense)
+
+
+# --- engine / scheduler integration ------------------------------------------
+
+def test_scheduler_constrained_decode_emits_json():
+    """End to end on the tiny model: every sampled token must be grammar-
+    legal (valid JSON prefix), and an EOS stop implies a complete value."""
+    cfg = cfglib.PRESETS["tiny"]
+    params = decoder.init_params(cfg, jax.random.PRNGKey(0), dtype=F32)
+    eng = Engine(cfg, params,
+                 ecfg=EngineConfig(max_slots=2, max_seq_len=128,
+                                   cache_dtype=F32, min_prefill_bucket=16))
+    sched = Scheduler(eng)
+    table = make_table()
+    try:
+        outputs = 0
+        for seed in range(4):
+            c = JsonConstraint(table)
+            req = sched.submit(
+                [5, 9, 2], SlotOptions(temperature=0.9, seed=seed,
+                                       repeat_penalty=1.0),
+                max_tokens=100, eog_ids=frozenset([EOS]), constraint=c)
+            toks = list(req.tokens())
+            data = b"".join(table.pieces[t] for t in toks)
+            st = advance_bytes(INITIAL_STATE, data)
+            assert st is not None, (seed, data)
+            if req.stats.n_generated < 100:  # stopped via EOS
+                json.loads(data.decode())
+                outputs += 1
+        assert outputs >= 1  # at least one run must complete a value
+    finally:
+        sched.shutdown()
+
+
+def test_constrained_and_free_slots_coexist():
+    """A constrained slot must not leak its mask into other slots."""
+    cfg = cfglib.PRESETS["tiny"]
+    params = decoder.init_params(cfg, jax.random.PRNGKey(0), dtype=F32)
+    eng = Engine(cfg, params,
+                 ecfg=EngineConfig(max_slots=2, max_seq_len=128,
+                                   cache_dtype=F32, min_prefill_bucket=16))
+    greedy = SlotOptions(temperature=0.0, repeat_penalty=1.0)
+    prompt = np.array([3, 1, 4], np.int32)
+    free_ref = [eng.admit(0, prompt, greedy)]
+    for _ in range(5):
+        free_ref.append(int(eng.decode()[0]))
+    eng.release(0)
+
+    table = make_table()
+    c = JsonConstraint(table)
+    eng2 = Engine(cfg, params,
+                  ecfg=EngineConfig(max_slots=2, max_seq_len=128,
+                                    cache_dtype=F32, min_prefill_bucket=16))
+    got = [eng2.admit(0, prompt, greedy)]
+    # constrained request in the other slot
+    first = eng2.admit(1, np.array([7, 7], np.int32),
+                       SlotOptions(temperature=0.9, seed=1,
+                                   repeat_penalty=1.0),
+                       mask_row=c.mask_row())
+    assert c.advance(first)
+    eng2.set_mask(1, c.mask_row())
+    for _ in range(5):
+        toks = eng2.decode()
+        got.append(int(toks[0]))
+        if c.advance(int(toks[1])):
+            eng2.set_mask(1, c.mask_row())
+    assert got == free_ref

@@ -48,7 +48,8 @@ def stack(tmp_path_factory):
     httpd = serve(manager, "127.0.0.1", 0)
     port = httpd.server_address[1]
     base = f"http://127.0.0.1:{port}"
-    yield {"base": base, "registry_url": url, "manager": manager}
+    yield {"base": base, "registry_url": url, "manager": manager,
+           "registry": reg}
     httpd.shutdown()
     reg.stop()
 
@@ -310,3 +311,52 @@ def test_v1_embeddings_endpoint(stack):
     assert len(out["data"]) == 2
     assert out["data"][0]["object"] == "embedding"
     assert len(out["data"][0]["embedding"]) > 0
+
+
+def test_generate_format_json(stack):
+    """format: "json" over the HTTP surface: pull a tiny model with a
+    JSON-capable vocab, then every generate must emit a valid JSON prefix
+    (a complete value whenever it stopped on EOS)."""
+    import string
+
+    import numpy as np
+
+    from ollama_operator_tpu.ops.constrain import (INITIAL_STATE,
+                                                   advance_bytes, eos_ok)
+    from test_transcode import write_tiny_llama_gguf as write_gguf
+
+    cfg = cfglib.PRESETS["tiny"]
+    params = decoder.init_params(cfg, jax.random.PRNGKey(7),
+                                 dtype=jnp.float32)
+    pieces = ["<unk>", "<s>", "</s>"] + list('{}[]":,-. ') + \
+        [str(d) for d in range(10)] + ["true", "false", "null"] + \
+        list(string.ascii_lowercase)
+    pieces += [f"x{i}" for i in range(cfg.vocab_size - len(pieces))]
+    types = [3, 3, 3] + [1] * (cfg.vocab_size - 3)
+    import tempfile
+    with tempfile.TemporaryDirectory() as td:
+        p = td + "/tinyjson.gguf"
+        write_gguf(p, cfg, params, tokens=pieces, token_types=types,
+                   eos_id=2)
+        with open(p, "rb") as f:
+            blob = f.read()
+    stack["registry"].add_model(
+        "library", "tinyjson", "latest", blob,
+        template="{{ .Prompt }}",
+        params={"temperature": 0.9, "repeat_penalty": 1.0})
+    ref = f"{stack['registry_url']}/library/tinyjson:latest"
+    post(stack["base"], "/api/pull", {"model": ref}, stream=True)
+
+    completed = 0
+    for seed in range(3):
+        r = post(stack["base"], "/api/generate",
+                 {"model": ref, "prompt": "abc", "stream": False,
+                  "format": "json",
+                  "options": {"num_predict": 80, "seed": seed}})
+        data = r["response"].encode()
+        st = advance_bytes(INITIAL_STATE, data)
+        assert st is not None, (seed, data)
+        if r["done_reason"] == "stop":
+            json.loads(r["response"])
+            completed += 1
+    assert completed >= 1

@@ -70,11 +70,14 @@ def test_rope_unpermute_preserves_attention_scores():
     np.testing.assert_allclose(scores, scores_ref, rtol=1e-4, atol=1e-4)
 
 
-def write_tiny_llama_gguf(path: str, cfg, params, moe_merged=None):
+def write_tiny_llama_gguf(path: str, cfg, params, moe_merged=None,
+                          tokens=None, token_types=None, eos_id=None):
     """Export decoder params as a llama.cpp-convention GGUF (transposed,
     q/k re-permuted to the interleaved layout). For MoE configs pass
     moe_merged=True (merged ffn_*_exps tensors) or False (legacy
-    per-expert split tensors)."""
+    per-expert split tensors). ``tokens``/``token_types``/``eos_id``
+    override the default placeholder vocab (e.g. a JSON-capable vocab for
+    format-constrained tests)."""
     w = W.GGUFWriter(path)
     w.add_meta("general.architecture", "llama")
     w.add_meta("llama.block_count", cfg.n_layers)
@@ -89,11 +92,15 @@ def write_tiny_llama_gguf(path: str, cfg, params, moe_merged=None):
     if cfg.n_experts:
         w.add_meta("llama.expert_count", cfg.n_experts)
         w.add_meta("llama.expert_used_count", cfg.n_experts_used)
-    toks = [f"t{i}" for i in range(cfg.vocab_size)]
+    toks = tokens or [f"t{i}" for i in range(cfg.vocab_size)]
+    assert len(toks) == cfg.vocab_size
     w.add_meta("tokenizer.ggml.model", "llama")
     w.add_meta("tokenizer.ggml.tokens", toks)
     w.add_meta("tokenizer.ggml.scores", [0.0] * cfg.vocab_size)
-    w.add_meta("tokenizer.ggml.token_type", [1] * cfg.vocab_size)
+    w.add_meta("tokenizer.ggml.token_type",
+               token_types or [1] * cfg.vocab_size)
+    if eos_id is not None:
+        w.add_meta("tokenizer.ggml.eos_token_id", eos_id)
 
     P = lambda a: np.ascontiguousarray(np.asarray(a, np.float32))
     w.add_tensor_f32("token_embd.weight", P(params["tok_emb"]))
