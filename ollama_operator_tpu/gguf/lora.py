@@ -1,0 +1,147 @@
+"""LoRA adapter merging: the Modelfile ``ADAPTER`` directive.
+
+The reference delegates adapters to llama.cpp inside the ollama image
+(/root/reference/pkg/model/pod.go:11; ADAPTER is part of the Modelfile
+surface the registry serves). llama.cpp applies LoRA at runtime per matmul;
+here the TPU-native choice is to **merge at load time** — W' = W + s·(B@A)
+with s = alpha/rank — so the serving engine runs the exact same fused
+bf16/int8 matmuls with zero per-token overhead, and the transcoded layout
+(transposes + rope unpermute, gguf/transcode.py) is applied once to the
+delta on the host.
+
+Adapter format: a GGUF file (llama.cpp convert_lora_to_gguf convention) with
+``adapter.lora.alpha`` metadata and tensor pairs ``<base>.lora_a`` [r, in] /
+``<base>.lora_b`` [out, r] named after the base-model tensors
+(blk.N.attn_q.weight, …).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from . import dequant as DQ
+from .reader import GGUFFile
+from .transcode import _INTERLEAVED_ROPE_ARCHES, _unpermute_rope
+
+
+def _dq32(f: GGUFFile, name: str) -> np.ndarray:
+    return np.asarray(DQ.dequantize_tensor(f, f.tensors[name]), np.float32)
+
+
+def _targets(cfg):
+    """base GGUF tensor suffix → (param key, delta post-transform).
+
+    post maps the GGUF-layout delta [out, in] into our transposed/unpermuted
+    parameter layout (mirrors load_params, gguf/transcode.py)."""
+    H, KvH = cfg.n_heads, cfg.n_kv_heads
+    T_ = lambda a: a.T
+    return {
+        "attn_q.weight": ("wq", lambda a: _unpermute_rope(a, H).T),
+        "attn_k.weight": ("wk", lambda a: _unpermute_rope(a, KvH).T),
+        "attn_v.weight": ("wv", T_),
+        "attn_output.weight": ("wo", T_),
+        "ffn_up.weight": ("w_up", T_),
+        "ffn_down.weight": ("w_down", T_),
+        "ffn_gate.weight": ("w_gate", T_),
+    }
+
+
+def apply_lora(params: Dict[str, Any], cfg, adapter_path: str
+               ) -> Dict[str, Any]:
+    """Merge a GGUF LoRA adapter into the (numpy, host-side) param tree.
+
+    Returns the same tree with touched tensors replaced (copy-on-write —
+    transcode-cache memmaps are never written through). Raises ValueError
+    for adapters targeting tensors this model doesn't have or that merging
+    doesn't support (MoE expert weights).
+    """
+    with GGUFFile(adapter_path) as f:
+        if f.metadata.get("adapter.type", "lora") != "lora":
+            raise ValueError(f"{adapter_path}: adapter.type "
+                             f"{f.metadata.get('adapter.type')!r} is not "
+                             f"a LoRA adapter")
+        alpha = float(f.metadata.get("adapter.lora.alpha", 16.0))
+        names = [n for n in f.tensors if n.endswith(".lora_a")]
+        if not names:
+            raise ValueError(f"{adapter_path}: no .lora_a tensors — not a "
+                             f"LoRA adapter GGUF")
+        targets = _targets(cfg)
+        # the converter emits q/k in the base arch's layout — llama-family
+        # interleaved rope needs the same unpermute as the base weights
+        if f.arch not in _INTERLEAVED_ROPE_ARCHES:
+            T_ = lambda a: a.T
+            targets["attn_q.weight"] = ("wq", T_)
+            targets["attn_k.weight"] = ("wk", T_)
+        layers = dict(params["layers"])
+        copied = set()
+        top_copied = set()
+        out = dict(params)
+        for name in sorted(names):
+            base = name[: -len(".lora_a")]
+            b_name = base + ".lora_b"
+            if b_name not in f.tensors:
+                raise ValueError(f"{adapter_path}: {name} has no matching "
+                                 f".lora_b")
+            A = _dq32(f, name)       # [r, in]
+            B = _dq32(f, b_name)     # [out, r]
+            if A.shape[0] != B.shape[1]:
+                # tolerate transposed dumps
+                if A.shape[1] == B.shape[1]:
+                    A = A.T
+                elif A.shape[0] == B.shape[0]:
+                    B = B.T
+                else:
+                    raise ValueError(
+                        f"{adapter_path}: rank mismatch {name} {A.shape} "
+                        f"vs {b_name} {B.shape}")
+            rank = A.shape[0]
+            delta = (alpha / rank) * (B @ A)          # [out, in]
+
+            if base == "token_embd.weight":
+                if delta.shape != params["tok_emb"].shape:
+                    raise ValueError(f"{adapter_path}: token_embd delta "
+                                     f"{delta.shape} vs "
+                                     f"{params['tok_emb'].shape}")
+                if "tok_emb" not in top_copied:
+                    out["tok_emb"] = np.array(out["tok_emb"])
+                    top_copied.add("tok_emb")
+                out["tok_emb"] += delta.astype(out["tok_emb"].dtype)
+                continue
+            if base == "output.weight":
+                if "lm_head" not in params:
+                    raise ValueError(f"{adapter_path}: adapter targets "
+                                     f"output.weight but the model ties "
+                                     f"embeddings")
+                if "lm_head" not in top_copied:
+                    out["lm_head"] = np.array(out["lm_head"])
+                    top_copied.add("lm_head")
+                out["lm_head"] += delta.T.astype(out["lm_head"].dtype)
+                continue
+
+            if not base.startswith("blk."):
+                raise ValueError(f"{adapter_path}: unsupported LoRA target "
+                                 f"{base!r}")
+            _, idx, suffix = base.split(".", 2)
+            i = int(idx)
+            tgt = targets.get(suffix)
+            if tgt is None:
+                raise ValueError(f"{adapter_path}: unsupported LoRA target "
+                                 f"{base!r} (MoE expert and bias adapters "
+                                 f"are not mergeable here)")
+            key, post = tgt
+            if key not in layers or layers[key] is None:
+                raise ValueError(f"{adapter_path}: adapter targets {base!r} "
+                                 f"but the model has no {key!r}")
+            if key not in copied:
+                layers[key] = np.array(layers[key])  # [L, in, out] copy
+                copied.add(key)
+            d = post(delta)                           # [in, out]
+            if d.shape != layers[key][i].shape:
+                raise ValueError(f"{adapter_path}: delta for {base} is "
+                                 f"{d.shape}, model expects "
+                                 f"{layers[key][i].shape}")
+            layers[key][i] += d.astype(layers[key].dtype)
+        out["layers"] = layers
+        return out

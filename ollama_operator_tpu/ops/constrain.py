@@ -299,14 +299,19 @@ class TokenTable:
         _load_native()
         self.mask_for(INITIAL_STATE)
 
+    _build_lock = threading.Lock()
+
     @classmethod
     def for_tokenizer(cls, tok) -> "TokenTable":
-        """Build (and cache on the tokenizer) the table for a Tokenizer."""
-        tbl = getattr(tok, "_constrain_table", None)
-        if tbl is None:
-            tbl = cls([tok.piece_bytes(i) for i in range(tok.n_vocab)],
-                      tok.eog_ids)
-            tok._constrain_table = tbl
+        """Build (and cache on the tokenizer) the table for a Tokenizer.
+        Locked: concurrent cold format:"json" requests must not each pay
+        the table build + native-kernel compile + initial mask fill."""
+        with cls._build_lock:
+            tbl = getattr(tok, "_constrain_table", None)
+            if tbl is None:
+                tbl = cls([tok.piece_bytes(i) for i in range(tok.n_vocab)],
+                          tok.eog_ids)
+                tok._constrain_table = tbl
         return tbl
 
     def _cache_key(self, state: bytes) -> bytes:

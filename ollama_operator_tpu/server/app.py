@@ -46,7 +46,8 @@ from ..tokenizer import Tokenizer
 from .metrics import GLOBAL as METRICS
 from .modelfile import Modelfile, parse_modelfile, params_json
 from .names import ModelName
-from .registry import (MT_LICENSE, MT_MODEL, MT_PARAMS, MT_PROJECTOR,
+from .registry import (MT_ADAPTER, MT_LICENSE, MT_MODEL, MT_PARAMS,
+                       MT_PROJECTOR,
                        MT_SYSTEM, MT_TEMPLATE, ModelStore, RegistryClient,
                        RegistryError)
 
@@ -83,12 +84,47 @@ class ApiError(Exception):
         self.status = status
 
 
+def parse_keep_alive(v) -> Optional[float]:
+    """Ollama keep_alive → seconds (None = keep forever).
+
+    Accepts numbers (seconds; negative = forever) and Go-style duration
+    strings ("5m", "1h30m", "300ms", "-1"). 0 means "unload as soon as
+    idle"."""
+    if v is None:
+        raise ValueError("keep_alive is None")
+    if isinstance(v, bool):
+        raise ValueError(f"bad keep_alive {v!r}")
+    if isinstance(v, (int, float)):
+        return None if v < 0 else float(v)
+    s = str(v).strip()
+    if not s:
+        raise ValueError("empty keep_alive")
+    try:
+        n = float(s)
+        return None if n < 0 else n
+    except ValueError:
+        pass
+    import re
+    m = re.fullmatch(r"(-?)((?:\d+(?:\.\d+)?(?:ns|us|µs|ms|s|m|h))+)", s)
+    if not m:
+        raise ValueError(f"bad keep_alive {v!r}")
+    if m.group(1):
+        return None
+    unit_s = {"ns": 1e-9, "us": 1e-6, "µs": 1e-6, "ms": 1e-3,
+              "s": 1.0, "m": 60.0, "h": 3600.0}
+    total = 0.0
+    for num, unit in re.findall(r"(\d+(?:\.\d+)?)(ns|us|µs|ms|s|m|h)", s):
+        total += float(num) * unit_s[unit]
+    return total
+
+
 class ModelManager:
     """Owns the blob store, registry client, and the resident model."""
 
     def __init__(self, store_root: str, cache_dir: Optional[str] = None,
                  mesh=None, ecfg: Optional[EngineConfig] = None,
-                 engine_dtype="bfloat16", serve_models: bool = True):
+                 engine_dtype="bfloat16", serve_models: bool = True,
+                 default_keep_alive=None):
         self.store = ModelStore(store_root)
         self.client = RegistryClient(self.store)
         self.mesh = mesh
@@ -99,6 +135,88 @@ class ModelManager:
         self.loaded: Optional[LoadedModel] = None
         self._lock = threading.Lock()
         self.start_time = time.time()
+        # keep_alive: model idle-unload timer (the reference's engine keeps
+        # this inside `ollama serve`; OLLAMA_KEEP_ALIVE is its env knob)
+        import os
+        raw_ka = (default_keep_alive if default_keep_alive is not None
+                  else (os.environ.get("OLLAMA_KEEP_ALIVE") or "5m"))
+        try:
+            self.default_keep_alive = parse_keep_alive(raw_ka)
+        except ValueError:
+            # a malformed env var must not keep the pod from booting
+            import sys
+            print(f"warning: invalid OLLAMA_KEEP_ALIVE {raw_ka!r}; "
+                  f"using 5m", file=sys.stderr)
+            self.default_keep_alive = 300.0
+        self.expires_at: Optional[float] = None
+        self._last_ka: Optional[float] = self.default_keep_alive
+        self._reaper_stop = threading.Event()
+        if serve_models:
+            self._reaper = threading.Thread(
+                target=self._reap_idle, daemon=True, name="keepalive-reaper")
+            self._reaper.start()
+
+    # ------------------------------------------------------------------
+    def touch(self, keep_alive=None):
+        """Reset the loaded model's idle-unload deadline (called per
+        request; an explicit request keep_alive overrides the default)."""
+        ka = self.default_keep_alive
+        if keep_alive is not None:
+            try:
+                ka = parse_keep_alive(keep_alive)
+            except ValueError:
+                raise ApiError(400, f"invalid keep_alive "
+                                    f"{keep_alive!r}") from None
+        with self._lock:
+            self._last_ka = ka
+            self.expires_at = None if ka is None else time.monotonic() + ka
+
+    def _reap_idle(self):
+        while not self._reaper_stop.wait(1.0):
+            with self._lock:
+                lm = self.loaded
+                exp = self.expires_at
+                if (lm is None or exp is None or time.monotonic() < exp):
+                    continue
+                # only unload a quiet model: active slots / queued requests
+                # push the actual unload past the deadline
+                if (lm.scheduler.n_active > 0
+                        or not lm.scheduler._waiting.empty()):
+                    continue
+                # deadline is armed at request START; a generation longer
+                # than keep_alive must still get its full idle window after
+                # it finishes (stock server re-arms at completion)
+                if self._last_ka is not None and lm.scheduler.finished:
+                    last_done = lm.scheduler.finished[-1].t_done
+                    if time.monotonic() < last_done + self._last_ka:
+                        continue
+                self.loaded = None
+                self.expires_at = None
+            lm.unload()  # outside the lock: shutdown joins the decode loop
+
+    def stop(self, ref: str) -> bool:
+        """keep_alive: 0 with an empty prompt — the `ollama stop` path.
+        Unloads now when idle; with requests in flight it only expires the
+        deadline so the reaper unloads after they drain (stock server never
+        truncates other clients' generations). Returns True if ``ref`` is
+        the resident model."""
+        name = ModelName.parse(ref)
+        with self._lock:
+            lm = self.loaded
+            if lm is None or lm.name != name.short:
+                return False
+            if (lm.scheduler.n_active > 0
+                    or not lm.scheduler._waiting.empty()):
+                self._last_ka = 0.0
+                self.expires_at = time.monotonic()  # reap once drained
+                return True
+            self.loaded = None
+            self.expires_at = None
+        lm.unload()
+        return True
+
+    def shutdown(self):
+        self._reaper_stop.set()
 
     # ------------------------------------------------------------------
     def model_details(self, name: ModelName) -> Dict:
@@ -185,6 +303,16 @@ class ModelManager:
             cfg, params, tok_md = transcode_load(
                 gguf_path, cache_dir=self.cache_dir, dtype=dt,
                 digest=digest.replace("sha256:", "")[:24] or None)
+            adapter_path = layers.get(MT_ADAPTER)
+            if adapter_path:
+                # Modelfile ADAPTER: merge W += (alpha/r)·BA host-side so
+                # serving runs unmodified fused matmuls (gguf/lora.py);
+                # must happen before int8 weight quantization below
+                from ..gguf.lora import apply_lora
+                try:
+                    params = apply_lora(params, cfg, adapter_path)
+                except ValueError as e:
+                    raise ApiError(400, f"adapter: {e}") from e
             tokenizer = Tokenizer.from_gguf_metadata(tok_md)
             template = self._read_layer_text(layers, MT_TEMPLATE)
             system = self._read_layer_text(layers, MT_SYSTEM)
@@ -219,26 +347,58 @@ class ModelManager:
                 name.short, cfg, params, tokenizer, template=template,
                 system=system, default_params=default_params,
                 mesh=self.mesh, ecfg=ecfg, digest=digest, vision=vision)
+            # fresh deadline under this same lock: a stale expiry from the
+            # previous model must never reap the one we just installed
+            self._last_ka = self.default_keep_alive
+            self.expires_at = (None if self.default_keep_alive is None
+                               else time.monotonic() + self.default_keep_alive)
             return self.loaded
 
-    def require_loaded(self, ref: str) -> LoadedModel:
-        try:
-            return self.load(ref)
-        except RegistryError as e:
-            raise ApiError(404, str(e)) from e
+    def require_loaded(self, ref: str, keep_alive=None) -> LoadedModel:
+        ka = self.default_keep_alive
+        if keep_alive is not None:
+            try:
+                ka = parse_keep_alive(keep_alive)
+            except ValueError:
+                raise ApiError(400, f"invalid keep_alive "
+                                    f"{keep_alive!r}") from None
+        for _ in range(3):
+            try:
+                lm = self.load(ref)
+            except RegistryError as e:
+                raise ApiError(404, str(e)) from e
+            # arm the deadline under the same lock the reaper tests — if
+            # the reaper unloaded between load() returning and here, retry
+            # instead of handing out a shut-down scheduler
+            with self._lock:
+                if self.loaded is lm:
+                    self._last_ka = ka
+                    self.expires_at = (None if ka is None
+                                       else time.monotonic() + ka)
+                    return lm
+        raise ApiError(503, f"model {ref!r} kept unloading during load "
+                            f"(keep_alive too short?)")
 
     def ps(self):
         out = []
         with self._lock:
             lm = self.loaded
         if lm is not None:
+            with self._lock:
+                exp = self.expires_at
+            if exp is None:
+                expires = "0001-01-01T00:00:00Z"  # keep_alive < 0: forever
+            else:
+                wall = time.time() + (exp - time.monotonic())
+                expires = datetime.fromtimestamp(
+                    wall, timezone.utc).isoformat()
             out.append({
                 "name": lm.name, "model": lm.name,
                 "size": int(lm.cfg.n_params * 2),
                 "digest": lm.digest.replace("sha256:", ""),
                 "details": {"format": "gguf", "family": lm.cfg.arch,
                             "parameter_size": _fmt_params(lm.cfg.n_params)},
-                "expires_at": "0001-01-01T00:00:00Z",
+                "expires_at": expires,
                 "size_vram": 0,
             })
         return out
@@ -255,7 +415,8 @@ class ModelManager:
         params_raw = self._read_layer_text(layers, MT_PARAMS)
         lic = self._read_layer_text(layers, MT_LICENSE) or ""
         mf = Modelfile(from_=name.short, template=template or None,
-                       system=system or None)
+                       system=system or None,
+                       adapter=layers.get(MT_ADAPTER))
         parameters = ""
         if params_raw:
             try:
@@ -320,6 +481,8 @@ class ModelManager:
                 overridden.add(MT_SYSTEM)
             if mf.license:
                 overridden.add(MT_LICENSE)
+            if mf.adapter:
+                overridden.add(MT_ADAPTER)
             for layer in base_manifest.get("layers", []):
                 mt = layer["mediaType"]
                 if mt == MT_PARAMS:
@@ -356,6 +519,14 @@ class ModelManager:
         if mf.license:
             layers.append({"mediaType": MT_LICENSE,
                            **self.store.add_blob(mf.license.encode())})
+        if mf.adapter:
+            import os
+            if not os.path.exists(mf.adapter):
+                raise ApiError(400, f"ADAPTER {mf.adapter!r}: no such file")
+            if progress:
+                progress("importing adapter", 0, 0)
+            layers.append({"mediaType": MT_ADAPTER,
+                           **self.store.add_blob_file(mf.adapter)})
         config = self.store.add_blob(json.dumps(
             {"model_format": "gguf"}).encode())
         manifest = {
@@ -563,16 +734,25 @@ class Handler(BaseHTTPRequestHandler):
 
     def _api_generate(self, body: Dict):
         model = self._model_arg(body)
-        lm = self.manager.require_loaded(model)
-        stream = body.get("stream", True)
         prompt = body.get("prompt", "")
-        raw = bool(body.get("raw", False))
+        ka = body.get("keep_alive")
         if not prompt and not body.get("context"):
+            if ka is not None and parse_keep_alive(ka) == 0.0:
+                # empty prompt + keep_alive 0 = `ollama stop`
+                self.manager.stop(model)
+                self._send_json({"model": model, "created_at": _now_iso(),
+                                 "response": "", "done": True,
+                                 "done_reason": "unload"})
+                return
             # empty generate is ollama's "load the model" ping
+            self.manager.require_loaded(model, keep_alive=ka)
             self._send_json({"model": model, "created_at": _now_iso(),
                              "response": "", "done": True,
                              "done_reason": "load"})
             return
+        lm = self.manager.require_loaded(model, keep_alive=ka)
+        stream = body.get("stream", True)
+        raw = bool(body.get("raw", False))
         text_prompt = prompt if raw else lm.render_prompt(
             prompt, system=body.get("system"), template=body.get("template"))
         gen = lm.generate_stream(text_prompt, options=body.get("options"),
@@ -615,8 +795,15 @@ class Handler(BaseHTTPRequestHandler):
 
     def _api_chat(self, body: Dict):
         model = self._model_arg(body)
-        lm = self.manager.require_loaded(model)
         messages = body.get("messages", [])
+        ka = body.get("keep_alive")
+        if not messages and ka is not None and parse_keep_alive(ka) == 0.0:
+            self.manager.stop(model)
+            self._send_json({"model": model, "created_at": _now_iso(),
+                             "message": {"role": "assistant", "content": ""},
+                             "done": True, "done_reason": "unload"})
+            return
+        lm = self.manager.require_loaded(model, keep_alive=ka)
         stream = body.get("stream", True)
         prompt = lm.render_chat(messages, template=body.get("template"))
         images = []
@@ -714,14 +901,16 @@ class Handler(BaseHTTPRequestHandler):
         self._send_json({})
 
     def _api_embeddings(self, body: Dict):
-        lm = self.manager.require_loaded(self._model_arg(body))
+        lm = self.manager.require_loaded(self._model_arg(body),
+                                         keep_alive=body.get("keep_alive"))
         prompt = body.get("prompt", "")
         emb = lm.embed([prompt])[0]
         self._send_json({"embedding": [float(x) for x in emb]})
 
     def _embed_input(self, body: Dict):
         """Shared input handling for /api/embed and /v1/embeddings."""
-        lm = self.manager.require_loaded(self._model_arg(body))
+        lm = self.manager.require_loaded(self._model_arg(body),
+                                         keep_alive=body.get("keep_alive"))
         inp = body.get("input", "")
         texts = [inp] if isinstance(inp, str) else list(inp)
         return lm.embed(texts)

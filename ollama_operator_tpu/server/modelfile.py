@@ -38,6 +38,8 @@ class Modelfile:
             out.append(f'TEMPLATE """{self.template}"""')
         if self.system:
             out.append(f'SYSTEM """{self.system}"""')
+        if self.adapter:
+            out.append(f"ADAPTER {self.adapter}")
         if self.license:
             out.append(f'LICENSE """{self.license}"""')
         return "\n".join(out) + "\n"

@@ -1,0 +1,100 @@
+"""keep_alive semantics: duration parsing, the idle-unload reaper, the
+`ollama stop` path (empty prompt + keep_alive 0), and /api/ps expiry."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ollama_operator_tpu.models import config as cfglib
+from ollama_operator_tpu.models import decoder
+from ollama_operator_tpu.runtime.engine import EngineConfig
+from ollama_operator_tpu.server.app import (ApiError, ModelManager,
+                                            parse_keep_alive)
+
+from test_transcode import write_tiny_llama_gguf
+
+
+def test_parse_keep_alive():
+    assert parse_keep_alive(300) == 300.0
+    assert parse_keep_alive(0) == 0.0
+    assert parse_keep_alive(-1) is None
+    assert parse_keep_alive("5m") == 300.0
+    assert parse_keep_alive("1h30m") == 5400.0
+    assert parse_keep_alive("300ms") == pytest.approx(0.3)
+    assert parse_keep_alive("10") == 10.0
+    assert parse_keep_alive("-1") is None
+    assert parse_keep_alive("-5m") is None
+    assert parse_keep_alive("1.5h") == 5400.0
+    for bad in ("", "abc", "5x", None, True):
+        with pytest.raises(ValueError):
+            parse_keep_alive(bad)
+
+
+@pytest.fixture()
+def mgr(tmp_path):
+    cfg = cfglib.PRESETS["tiny"]
+    params = decoder.init_params(cfg, jax.random.PRNGKey(0),
+                                 dtype=jnp.float32)
+    base = str(tmp_path / "base.gguf")
+    write_tiny_llama_gguf(base, cfg, params)
+    m = ModelManager(str(tmp_path / "store"),
+                     cache_dir=str(tmp_path / "cache"),
+                     ecfg=EngineConfig(max_slots=2, max_seq_len=64,
+                                       cache_dtype=jnp.float32,
+                                       min_prefill_bucket=16),
+                     engine_dtype="float32",
+                     default_keep_alive="200ms")
+    m.create("tiny", f"FROM {base}")
+    yield m
+    m.shutdown()
+
+
+def test_idle_reaper_unloads_after_expiry(mgr):
+    lm = mgr.require_loaded("tiny")
+    r = lm.generate("hello", options={"num_predict": 2,
+                                      "temperature": 0.0})
+    assert r.generated_tokens >= 1
+    deadline = time.time() + 15
+    while mgr.loaded is not None and time.time() < deadline:
+        time.sleep(0.2)
+    assert mgr.loaded is None  # reaper fired after the 200ms keep_alive
+    # a new request transparently reloads
+    lm2 = mgr.require_loaded("tiny", keep_alive="1h")
+    assert mgr.loaded is lm2
+    assert mgr.expires_at is not None
+
+
+def test_request_keep_alive_overrides_default(mgr):
+    mgr.require_loaded("tiny", keep_alive="1h")
+    time.sleep(2.5)  # several reaper ticks past the 200ms default
+    assert mgr.loaded is not None
+    # forever
+    mgr.require_loaded("tiny", keep_alive=-1)
+    assert mgr.expires_at is None
+    ps = mgr.ps()
+    assert ps[0]["expires_at"] == "0001-01-01T00:00:00Z"
+    # bad value -> 400
+    with pytest.raises(ApiError):
+        mgr.require_loaded("tiny", keep_alive="banana")
+
+
+def test_stop_unloads_resident_model(mgr):
+    mgr.require_loaded("tiny", keep_alive="1h")
+    assert mgr.stop("nope") is False
+    assert mgr.loaded is not None
+    assert mgr.stop("tiny") is True
+    assert mgr.loaded is None
+    assert mgr.ps() == []
+
+
+def test_ps_reports_future_expiry(mgr):
+    mgr.require_loaded("tiny", keep_alive="1h")
+    ps = mgr.ps()
+    assert len(ps) == 1
+    from datetime import datetime, timezone
+    exp = datetime.fromisoformat(ps[0]["expires_at"])
+    secs = (exp - datetime.now(timezone.utc)).total_seconds()
+    assert 3500 < secs < 3700

@@ -1,0 +1,199 @@
+"""LoRA adapter merging (Modelfile ADAPTER): W' = W + (alpha/r)·BA applied
+at load time in the transcoded layout. Equivalence is checked the
+non-circular way: merging an adapter into the base must load identically to
+a GGUF whose tensors were pre-modified with the same delta in GGUF layout."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from ollama_operator_tpu.gguf.lora import apply_lora
+from ollama_operator_tpu.gguf.reader import GGUFFile
+from ollama_operator_tpu.gguf.transcode import load_params
+from ollama_operator_tpu.gguf import writer as W
+from ollama_operator_tpu.models import config as cfglib
+from ollama_operator_tpu.models import decoder
+
+from test_transcode import write_tiny_llama_gguf
+
+ALPHA, RANK = 8.0, 4
+
+
+def make_rank_r_delta(rng, out, inn):
+    """A delta that IS exactly rank-RANK so the factorisation is exact."""
+    B = rng.standard_normal((out, RANK)).astype(np.float32)
+    A = rng.standard_normal((RANK, inn)).astype(np.float32)
+    return (ALPHA / RANK) * (B @ A), A, B
+
+
+def test_apply_lora_matches_premerged_gguf(tmp_path):
+    cfg = cfglib.PRESETS["tiny"]
+    params = decoder.init_params(cfg, jax.random.PRNGKey(0),
+                                 dtype=jnp.float32)
+    base = str(tmp_path / "base.gguf")
+    write_tiny_llama_gguf(base, cfg, params)
+
+    rng = np.random.default_rng(1)
+    # targets spanning permuted (q/k) and plain (v/o/ffn) layouts + lm_head
+    adapters = {}
+    with GGUFFile(base) as f:
+        shapes = {n: f.tensors[n].shape for n in f.tensors}
+    targets = ["blk.0.attn_q.weight", "blk.1.attn_k.weight",
+               "blk.0.attn_v.weight", "blk.1.attn_output.weight",
+               "blk.0.ffn_up.weight", "blk.1.ffn_gate.weight",
+               "blk.0.ffn_down.weight", "output.weight"]
+    lora_ab = {}
+    for t in targets:
+        out, inn = shapes[t]
+        delta, A, B = make_rank_r_delta(rng, out, inn)
+        adapters[t] = delta
+        lora_ab[t] = (A, B)
+
+    # adapter GGUF with the exact A/B pairs
+    ad_path = str(tmp_path / "adapter.gguf")
+    w = W.GGUFWriter(ad_path)
+    w.add_meta("general.architecture", "llama")
+    w.add_meta("general.type", "adapter")
+    w.add_meta("adapter.type", "lora")
+    w.add_meta("adapter.lora.alpha", ALPHA)
+    for t, (A, B) in lora_ab.items():
+        w.add_tensor_f32(t + ".lora_a", A)
+        w.add_tensor_f32(t + ".lora_b", B)
+    w.write()
+
+    # pre-merged GGUF: same deltas added in raw GGUF layout
+    merged = str(tmp_path / "merged.gguf")
+    with GGUFFile(base) as f:
+        from ollama_operator_tpu.gguf import dequant as DQ
+        mw = W.GGUFWriter(merged)
+        for k, v in f.metadata.items():
+            mw.add_meta(k, v)
+        for name, t in f.tensors.items():
+            arr = DQ.dequantize_tensor(f, t).astype(np.float32)
+            if name in adapters:
+                arr = arr + adapters[name]
+            mw.add_tensor_f32(name, arr.reshape(t.shape))
+        mw.write()
+
+    with GGUFFile(base) as f:
+        base_params = load_params(f, dtype=np.float32)
+    got = apply_lora(base_params, cfg, ad_path)
+    with GGUFFile(merged) as f:
+        expect = load_params(f, dtype=np.float32)
+
+    flat_g, _ = jax.tree_util.tree_flatten_with_path(got)
+    flat_e, _ = jax.tree_util.tree_flatten_with_path(expect)
+    for (pg, g), (pe, e) in zip(flat_g, flat_e):
+        assert pg == pe
+        np.testing.assert_allclose(np.asarray(g), np.asarray(e),
+                                   rtol=2e-4, atol=2e-4, err_msg=str(pg))
+
+
+def test_apply_lora_copy_on_write(tmp_path):
+    """The input tree must not be mutated (transcode-cache memmaps)."""
+    cfg = cfglib.PRESETS["tiny"]
+    params = decoder.init_params(cfg, jax.random.PRNGKey(0),
+                                 dtype=jnp.float32)
+    base = str(tmp_path / "base.gguf")
+    write_tiny_llama_gguf(base, cfg, params)
+    with GGUFFile(base) as f:
+        base_params = load_params(f, dtype=np.float32)
+    before = np.array(base_params["layers"]["wq"])
+
+    rng = np.random.default_rng(2)
+    delta, A, B = make_rank_r_delta(rng, cfg.q_dim, cfg.dim)
+    ad = str(tmp_path / "a.gguf")
+    w = W.GGUFWriter(ad)
+    w.add_meta("general.architecture", "llama")
+    w.add_meta("adapter.type", "lora")
+    w.add_meta("adapter.lora.alpha", ALPHA)
+    w.add_tensor_f32("blk.0.attn_q.weight.lora_a", A)
+    w.add_tensor_f32("blk.0.attn_q.weight.lora_b", B)
+    w.write()
+
+    got = apply_lora(base_params, cfg, ad)
+    np.testing.assert_array_equal(base_params["layers"]["wq"], before)
+    assert not np.allclose(got["layers"]["wq"][0],
+                           base_params["layers"]["wq"][0])
+    # untouched layer shares storage semantics (equal values)
+    np.testing.assert_array_equal(got["layers"]["wq"][1],
+                                  base_params["layers"]["wq"][1])
+
+
+def test_apply_lora_rejects_bad_targets(tmp_path):
+    cfg = cfglib.PRESETS["tiny"]
+    params = decoder.init_params(cfg, jax.random.PRNGKey(0),
+                                 dtype=jnp.float32)
+    base = str(tmp_path / "base.gguf")
+    write_tiny_llama_gguf(base, cfg, params)
+    with GGUFFile(base) as f:
+        base_params = load_params(f, dtype=np.float32)
+
+    ad = str(tmp_path / "bad.gguf")
+    w = W.GGUFWriter(ad)
+    w.add_meta("general.architecture", "llama")
+    w.add_meta("adapter.type", "lora")
+    w.add_meta("adapter.lora.alpha", ALPHA)
+    w.add_tensor_f32("blk.0.ffn_gate_exps.weight.lora_a",
+                     np.zeros((RANK, 8), np.float32))
+    w.add_tensor_f32("blk.0.ffn_gate_exps.weight.lora_b",
+                     np.zeros((8, RANK), np.float32))
+    w.write()
+    with pytest.raises(ValueError, match="unsupported LoRA target"):
+        apply_lora(base_params, cfg, ad)
+
+    notlora = str(tmp_path / "notlora.gguf")
+    w = W.GGUFWriter(notlora)
+    w.add_meta("general.architecture", "llama")
+    w.add_tensor_f32("blk.0.attn_q.weight", np.zeros((4, 4), np.float32))
+    w.write()
+    with pytest.raises(ValueError, match="no .lora_a"):
+        apply_lora(base_params, cfg, notlora)
+
+
+def test_create_with_adapter_serves_merged_weights(tmp_path):
+    """/api/create with ADAPTER → loaded engine params differ from base
+    exactly on the adapted tensor."""
+    import jax.numpy as jnp
+    from ollama_operator_tpu.runtime.engine import EngineConfig
+    from ollama_operator_tpu.server.app import ModelManager
+
+    cfg = cfglib.PRESETS["tiny"]
+    params = decoder.init_params(cfg, jax.random.PRNGKey(0),
+                                 dtype=jnp.float32)
+    base = str(tmp_path / "base.gguf")
+    write_tiny_llama_gguf(base, cfg, params)
+
+    rng = np.random.default_rng(3)
+    delta, A, B = make_rank_r_delta(rng, cfg.q_dim, cfg.dim)
+    ad = str(tmp_path / "ad.gguf")
+    w = W.GGUFWriter(ad)
+    w.add_meta("general.architecture", "llama")
+    w.add_meta("adapter.type", "lora")
+    w.add_meta("adapter.lora.alpha", ALPHA)
+    w.add_tensor_f32("blk.0.attn_q.weight.lora_a", A)
+    w.add_tensor_f32("blk.0.attn_q.weight.lora_b", B)
+    w.write()
+
+    mgr = ModelManager(str(tmp_path / "store"),
+                       cache_dir=str(tmp_path / "cache"),
+                       ecfg=EngineConfig(max_slots=2, max_seq_len=64,
+                                         cache_dtype=jnp.float32,
+                                         min_prefill_bucket=16),
+                       engine_dtype="float32")
+    mgr.create("tinybase", f"FROM {base}")
+    mgr.create("tinylora", f"FROM tinybase\nADAPTER {ad}")
+    show = mgr.show("tinylora")
+    assert "ADAPTER" in show["modelfile"]
+
+    lm_base = mgr.load("tinybase")
+    wq_base = np.array(lm_base.engine.params["layers"]["wq"])
+    lm_lora = mgr.load("tinylora")
+    wq_lora = np.array(lm_lora.engine.params["layers"]["wq"])
+    assert not np.allclose(wq_base[0], wq_lora[0])
+    np.testing.assert_array_equal(wq_base[1], wq_lora[1])
+    r = lm_lora.generate("hello", options={"num_predict": 3,
+                                           "temperature": 0.0})
+    assert r.generated_tokens >= 1
+    lm_lora.unload()

@@ -360,3 +360,23 @@ def test_generate_format_json(stack):
             json.loads(r["response"])
             completed += 1
     assert completed >= 1
+
+
+def test_keep_alive_zero_unloads(stack):
+    """Empty prompt + keep_alive 0 is the `ollama stop` path; the model
+    must leave /api/ps and reload on the next request."""
+    name = _model_name(stack)
+    post(stack["base"], "/api/pull", {"model": name}, stream=True)
+    post(stack["base"], "/api/generate",
+         {"model": name, "prompt": "t1", "stream": False,
+          "options": {"num_predict": 2}})
+    assert len(json.loads(get(stack["base"], "/api/ps"))["models"]) == 1
+    r = post(stack["base"], "/api/generate",
+             {"model": name, "prompt": "", "keep_alive": 0})
+    assert r["done_reason"] == "unload"
+    assert json.loads(get(stack["base"], "/api/ps"))["models"] == []
+    # transparent reload
+    r = post(stack["base"], "/api/generate",
+             {"model": name, "prompt": "t1", "stream": False,
+              "options": {"num_predict": 2}})
+    assert r["done"] is True
