@@ -419,6 +419,50 @@ class Engine:
             return (toks_n, k_cache, v_cache, lengths, counts, last_tokens,
                     pring, keys)
 
+        @partial(jax.jit, donate_argnums=(1, 2, 3, 4, 5, 6))
+        def _extend(params, k_cache, v_cache, lengths, counts, last_tokens,
+                    pring, tokens, ring_row, counts_row, slot, start, n_new,
+                    sp_row, key, mask_row, cflag):
+            """Prefix-cache continuation: prefill only the tail of a prompt
+            whose first ``start`` tokens are already in ``slot``'s KV cache
+            (a parked conversation). ``ring_row``/``counts_row`` are the
+            penalty window over the FULL continuation prompt, prebuilt on
+            the host (the parked window may belong to a divergent suffix).
+            Dense bf16/f32 caches only (no quant/sp — the scheduler gates).
+            The slot cache is sliced/written at full S and the tail attends
+            all S key slots; bucketing both to the live prefix (programs
+            keyed by (tail, attn) bucket pairs) would cut the admission's
+            HBM traffic further at the cost of a quadratic warm-up set.
+            """
+            L, _, KvH, S, hd = k_cache.shape
+            kc_s = jax.lax.dynamic_slice(
+                k_cache, (0, slot, 0, 0, 0), (L, 1, KvH, S, hd))
+            vc_s = jax.lax.dynamic_slice(
+                v_cache, (0, slot, 0, 0, 0), (L, 1, KvH, S, hd))
+            logits, kc_s, vc_s = decoder.forward_with_cache(
+                params, cfg, tokens, kc_s, vc_s, start[None])
+            k_cache = jax.lax.dynamic_update_slice(k_cache, kc_s,
+                                                   (0, slot, 0, 0, 0))
+            v_cache = jax.lax.dynamic_update_slice(v_cache, vc_s,
+                                                   (0, slot, 0, 0, 0))
+            last = jax.lax.dynamic_index_in_dim(
+                logits[0], n_new - 1, axis=0, keepdims=False)
+            allowed = unpack_mask(mask_row, cfg.vocab_size)
+            last = jnp.where((cflag == 1) & ~allowed, sampling.NEG_INF, last)
+            tok = sampling.sample(last[None], counts_row[None], sp_row,
+                                  key[None])[0]
+            total = start + n_new
+            evict = ring_row[total % W]
+            counts_row = counts_row.at[evict].add(-1, mode="drop")
+            ring_row = ring_row.at[total % W].set(tok)
+            counts_row = counts_row.at[tok].add(1)
+            pring = pring.at[slot].set(ring_row)
+            lengths = lengths.at[slot].set(total)
+            counts = counts.at[slot].set(counts_row)
+            last_tokens = last_tokens.at[slot].set(tok)
+            return (tok, *pin(k_cache, v_cache, lengths, counts,
+                              last_tokens), pring)
+
         @partial(jax.jit, donate_argnums=(0, 1, 2, 3))
         def _release(lengths, counts, last_tokens, pring, slot):
             lengths = lengths.at[slot].set(0)
@@ -440,6 +484,8 @@ class Engine:
         self._admit_fn = _admit
         self._admit_embeds_fn = _admit_embeds
         self._admit_execs: Dict[int, Any] = {}
+        self._extend_fn = _extend
+        self._extend_execs: Dict[int, Any] = {}
         self._decode_fn = _decode
         self._decode_n_fn = _decode_n
         self._release_fn = _release
@@ -485,6 +531,28 @@ class Engine:
             frequency_penalty=jnp.array(
                 [o.frequency_penalty for o in opts], jnp.float32))
 
+    def _prep_slot(self, slot: int, opts: SlotOptions, seq_len: int,
+                   mask_row: Optional[np.ndarray]):
+        """Shared admission setup: install the slot PRNG key, resolve the
+        optional grammar mask. Returns (key, mask_row_dev, cflag)."""
+        seed = (opts.seed if opts.seed >= 0
+                else (hash((slot, seq_len)) & 0x7FFFFFFF))
+        key = jax.random.key(seed)
+        self.keys = self.keys.at[slot].set(key)
+        if mask_row is not None:
+            return key, jnp.asarray(self._pad_mask_row(mask_row)), \
+                jnp.int32(1)
+        return key, self._mask_ones, jnp.int32(0)
+
+    def _commit_slot(self, slot: int, n_total: int, opts: SlotOptions):
+        """Shared admission tail: mark the slot live and rebuild batched
+        sampling params."""
+        self.active[slot] = True
+        self._host_lengths[slot] = n_total
+        self._opts[slot] = opts
+        self._rebuild_sp()
+        self._active_dev = jnp.asarray(self.active.astype(np.int32))
+
     def admit(self, slot: int, prompt: np.ndarray,
               opts: SlotOptions = SlotOptions(),
               embeds: Optional[np.ndarray] = None,
@@ -507,14 +575,7 @@ class Engine:
         bucket = self.bucket_for(n)
         tokens = np.zeros((1, bucket), np.int32)
         tokens[0, :n] = prompt
-        seed = opts.seed if opts.seed >= 0 else (hash((slot, n)) & 0x7FFFFFFF)
-        key = jax.random.key(seed)
-        self.keys = self.keys.at[slot].set(key)
-        if mask_row is not None:
-            mrow = jnp.asarray(self._pad_mask_row(mask_row))
-            cflag = jnp.int32(1)
-        else:
-            mrow, cflag = self._mask_ones, jnp.int32(0)
+        key, mrow, cflag = self._prep_slot(slot, opts, n, mask_row)
         if embeds is not None:
             assert embeds.shape[0] == n, "embeds must cover the prompt"
             if self.sp_size > 1:
@@ -535,11 +596,75 @@ class Engine:
                 self.counts, self.last_tokens, self.pring,
                 jnp.asarray(tokens), jnp.int32(slot), jnp.int32(n),
                 self._sp_row(opts), key, mrow, cflag)
-        self.active[slot] = True
-        self._host_lengths[slot] = n
-        self._opts[slot] = opts
-        self._rebuild_sp()
-        self._active_dev = jnp.asarray(self.active.astype(np.int32))
+        self._commit_slot(slot, n, opts)
+        return int(tok)
+
+    @property
+    def supports_extend(self) -> bool:
+        """Prefix-cache continuation works on the dense bucketed cache
+        (quant int8 caches and sp sequence-sharded caches would need their
+        own slice/write variants)."""
+        return not self.quant_cache and self.sp_size == 1
+
+    def _extend_exec(self, bucket: int):
+        exe = self._extend_execs.get(bucket)
+        if exe is None:
+            tokens = jnp.zeros((1, bucket), jnp.int32)
+            W = max(1, self.ecfg.repeat_last_n)
+            exe = self._extend_fn.lower(
+                self.params, self.k_cache, self.v_cache, self.lengths,
+                self.counts, self.last_tokens, self.pring, tokens,
+                jnp.zeros((W,), jnp.int32), jnp.zeros(
+                    (self.cfg.vocab_size,), jnp.int32),
+                jnp.int32(0), jnp.int32(1), jnp.int32(1),
+                self._sp_row(SlotOptions()), jax.random.key(0),
+                self._mask_ones, jnp.int32(0)).compile()
+            self._extend_execs[bucket] = exe
+        return exe
+
+    def extend(self, slot: int, full_ids: np.ndarray, start: int,
+               opts: SlotOptions = SlotOptions(),
+               mask_row: Optional[np.ndarray] = None) -> int:
+        """Admit ``full_ids`` into ``slot`` reusing its cached first
+        ``start`` positions (prefix cache); prefills only the tail.
+        Returns the first sampled token. The caller guarantees the slot's
+        cache holds K/V for ``full_ids[:start]`` (a parked sequence whose
+        ids share that prefix — stale entries at positions >= start are
+        never attended: masking is position-based and the tail overwrites
+        them)."""
+        assert self.supports_extend, "extend() on quant/sp cache"
+        assert not self.active[slot], f"slot {slot} busy"
+        full_ids = np.asarray(full_ids, np.int32)
+        n_total = int(full_ids.shape[0])
+        n_new = n_total - start
+        assert 0 < n_new, f"nothing to prefill (start={start})"
+        if n_total >= self.max_seq:
+            raise ValueError(f"prompt too long: {n_total} >= {self.max_seq}")
+        bucket = self.bucket_for(n_new)
+        if start + bucket > self.max_seq:
+            raise ValueError(
+                f"tail bucket {bucket} does not fit above {start}")
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :n_new] = full_ids[start:]
+        # penalty window over the full continuation prompt (host-built:
+        # the parked ring may describe a divergent suffix)
+        W = max(1, self.ecfg.repeat_last_n)
+        V = self.cfg.vocab_size
+        ring = np.full((W,), V, np.int32)
+        window = full_ids[max(0, n_total - W):]
+        pos = np.arange(n_total - len(window), n_total)
+        ring[pos % W] = window
+        counts_row = np.zeros((V,), np.int32)
+        np.add.at(counts_row, window, 1)
+        key, mrow, cflag = self._prep_slot(slot, opts, n_total, mask_row)
+        (tok, self.k_cache, self.v_cache, self.lengths, self.counts,
+         self.last_tokens, self.pring) = self._extend_exec(bucket)(
+            self.params, self.k_cache, self.v_cache, self.lengths,
+            self.counts, self.last_tokens, self.pring,
+            jnp.asarray(tokens), jnp.asarray(ring), jnp.asarray(counts_row),
+            jnp.int32(slot), jnp.int32(start), jnp.int32(n_new),
+            self._sp_row(opts), key, mrow, cflag)
+        self._commit_slot(slot, n_total, opts)
         return int(tok)
 
     def _attn_bucket(self, n: int) -> int:
@@ -639,6 +764,12 @@ class Engine:
                 self._decode_n_exec(1, b)
         for b in self._buckets:
             self._admit_exec(b)
+        if self.supports_extend:
+            # the max_seq tail bucket is unreachable: extend requires
+            # start >= 1 and start + bucket <= max_seq
+            for b in self._buckets:
+                if b < self.max_seq:
+                    self._extend_exec(b)
 
     def decode_n(self, n: Optional[int] = None) -> np.ndarray:
         """n decode steps in one device program; returns tokens [n, B].
@@ -657,16 +788,21 @@ class Engine:
         self._host_lengths[self.active] += n
         return np.asarray(toks_n)
 
-    def release(self, slot: int):
+    def release(self, slot: int, park: bool = False):
+        """Free ``slot``. With ``park=True`` the KV cache and slot state
+        are left in place so a later ``extend`` can reuse the prefix (the
+        slot still counts as free and may be overwritten by any admit)."""
         self.clear_mask(slot)
         self.active[slot] = False
-        self._host_lengths[slot] = 0
         self._opts.pop(slot, None)
+        self._active_dev = jnp.asarray(self.active.astype(np.int32))
+        if park and self.supports_extend:
+            return
+        self._host_lengths[slot] = 0
         (self.lengths, self.counts, self.last_tokens,
          self.pring) = self._release_fn(
             self.lengths, self.counts, self.last_tokens, self.pring,
             jnp.int32(slot))
-        self._active_dev = jnp.asarray(self.active.astype(np.int32))
 
     def slot_length(self, slot: int) -> int:
         return int(np.asarray(self.lengths)[slot])

@@ -40,6 +40,7 @@ class SchedulerBroken(RuntimeError):
 class RequestStats:
     n_prompt: int = 0
     n_generated: int = 0
+    n_reused: int = 0       # prompt tokens served from the prefix cache
     t_submit: float = 0.0
     t_admitted: float = 0.0
     t_first_token: float = 0.0
@@ -78,6 +79,9 @@ class Request:
                                   t_submit=time.monotonic())
         self.slot: Optional[int] = None
         self.error: Optional[str] = None
+        # every sampled token (incl. EOG), for parking the slot's KV as a
+        # reusable prefix after the request finishes
+        self.all_tokens: List[int] = []
 
     def cancel(self):
         self.cancelled.set()
@@ -95,10 +99,19 @@ class Request:
 
 
 class Scheduler:
+    # a parked prefix must beat this many cached tokens to be worth an
+    # extend over a fresh admit (tiny reuses still pay a full slice+write)
+    MIN_PREFIX_REUSE = 16
+
     def __init__(self, engine: Engine, max_queue: int = 256):
         self.engine = engine
         self._waiting: queue.Queue = queue.Queue(maxsize=max_queue)
         self._running: List[Optional[Request]] = [None] * engine.n_slots
+        # slot → token ids (prompt + generated) still resident in its KV
+        # cache; candidates for prefix-cache reuse (ollama keeps the same
+        # conversation hot in a llama.cpp slot; here any shared prefix —
+        # system prompt, earlier chat turns — is reusable)
+        self._parked: dict = {}
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._lock = threading.Lock()
@@ -164,7 +177,16 @@ class Scheduler:
 
     # ------------------------------------------------------------------
     def _finish(self, slot: int, req: Request, reason: str):
-        self.engine.release(slot)
+        # the LAST sampled token was never fed back through the model, so
+        # its K/V is not (reliably) in the cache — park everything before it
+        parkable = (list(req.prompt_ids) + req.all_tokens)[:-1]
+        park = (self.engine.supports_extend and req.embeds is None
+                and reason in ("stop", "length") and len(parkable) > 0)
+        self.engine.release(slot, park=park)
+        if park:
+            self._parked[slot] = parkable
+        else:
+            self._parked.pop(slot, None)
         self._running[slot] = None
         req.stats.t_done = time.monotonic()
         with self._lock:
@@ -178,12 +200,36 @@ class Scheduler:
         now = time.monotonic()
         if req.stats.n_generated == 0:
             req.stats.t_first_token = now
+        req.all_tokens.append(tid)  # EOG included: it sits in the KV cache
         if tid in req.eog_ids:
             return False
         req.stats.n_generated += 1
         self.total_generated += 1
         req.out.put(("token", tid))
         return req.stats.n_generated < req.max_tokens
+
+    def _best_prefix(self, req: Request):
+        """(slot, reuse_len) of the parked slot sharing the longest token
+        prefix with the request, or (None, 0). At least one tail token must
+        remain to prefill (the parked last position has no cached logits),
+        and the tail's bucket must fit above the reused prefix."""
+        if req.embeds is not None or not self.engine.supports_extend:
+            return None, 0
+        ids = req.prompt_ids
+        best, best_m = None, 0
+        for slot, parked in self._parked.items():
+            k = min(len(parked), len(ids) - 1)
+            m = 0
+            while m < k and parked[m] == ids[m]:
+                m += 1
+            if m > best_m:
+                best, best_m = slot, m
+        if best is None or best_m < self.MIN_PREFIX_REUSE:
+            return None, 0
+        tail_bucket = self.engine.bucket_for(len(ids) - best_m)
+        if best_m + tail_bucket > self.engine.max_seq:
+            return None, 0
+        return best, best_m
 
     def _admit_waiting(self):
         free = self.engine.free_slots()
@@ -195,13 +241,29 @@ class Scheduler:
             if req.cancelled.is_set():
                 req.out.put(("done", "cancelled"))
                 continue
-            slot = free.pop(0)
+            reuse_slot, reuse_len = self._best_prefix(req)
+            if reuse_slot is not None:
+                slot = reuse_slot
+                free.remove(slot)
+            else:
+                # prefer slots without a parked prefix: keep reusable
+                # caches alive as long as slots allow
+                slot = next((s for s in free if s not in self._parked),
+                            free[0])
+                free.remove(slot)
             try:
                 mask_row = (req.constraint.mask_row()
                             if req.constraint is not None else None)
-                first = self.engine.admit(slot, req.prompt_ids, req.opts,
-                                          embeds=req.embeds,
-                                          mask_row=mask_row)
+                if reuse_slot is not None:
+                    first = self.engine.extend(slot, req.prompt_ids,
+                                               reuse_len, req.opts,
+                                               mask_row=mask_row)
+                    req.stats.n_reused = reuse_len
+                else:
+                    first = self.engine.admit(slot, req.prompt_ids,
+                                              req.opts, embeds=req.embeds,
+                                              mask_row=mask_row)
+                self._parked.pop(slot, None)  # cache now owned by req
             except Exception as e:  # surfacing engine errors to the caller
                 req.error = str(e)
                 req.out.put(("error", str(e)))

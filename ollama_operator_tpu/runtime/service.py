@@ -346,6 +346,9 @@ class LoadedModel:
         METRICS.inc("tpu_model_requests_total")
         METRICS.inc("tpu_model_generated_tokens_total", st.n_generated)
         METRICS.inc("tpu_model_prompt_tokens_total", len(ids))
+        if st.n_reused:
+            # prompt tokens whose K/V came from a parked prefix (no prefill)
+            METRICS.inc("tpu_model_prefix_reused_tokens_total", st.n_reused)
         METRICS.observe("tpu_model_ttft_seconds", st.ttft_s)
         if st.decode_tok_s > 0:
             METRICS.observe("tpu_model_decode_tokens_per_second",

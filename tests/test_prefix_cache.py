@@ -1,0 +1,190 @@
+"""Prefix-cache continuation (engine.extend + scheduler parking): reusing a
+parked slot's KV for a shared prompt prefix must be bit-identical to a fresh
+full prefill — including the repeat-penalty window, which is rebuilt for the
+continuation sequence."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ollama_operator_tpu.models import config as cfglib
+from ollama_operator_tpu.models import decoder
+from ollama_operator_tpu.runtime.engine import Engine, EngineConfig, SlotOptions
+from ollama_operator_tpu.runtime.scheduler import Scheduler
+
+F32 = jnp.float32
+GREEDY = SlotOptions(temperature=0.0, repeat_penalty=1.0)
+GREEDY_PEN = SlotOptions(temperature=0.0, repeat_penalty=1.3,
+                         presence_penalty=0.2)
+
+
+def make_engine(cfg, params, slots=4):
+    return Engine(cfg, params,
+                  ecfg=EngineConfig(max_slots=slots, max_seq_len=128,
+                                    cache_dtype=F32, min_prefill_bucket=16,
+                                    repeat_last_n=8))
+
+
+def run_fresh(eng, prompt, opts, n_steps):
+    slot = eng.free_slots()[0]
+    got = [eng.admit(slot, np.asarray(prompt, np.int32), opts)]
+    for _ in range(n_steps):
+        got.append(int(eng.decode()[slot]))
+    eng.release(slot)
+    return got
+
+
+def test_extend_matches_fresh_prefill():
+    cfg = cfglib.PRESETS["tiny"]
+    params = decoder.init_params(cfg, jax.random.PRNGKey(0), dtype=F32)
+    eng = make_engine(cfg, params)
+
+    p1 = list(np.random.default_rng(0).integers(1, 250, 24))
+    first = eng.admit(0, np.asarray(p1, np.int32), GREEDY)
+    gen = [first] + [int(eng.decode()[0]) for _ in range(4)]
+    eng.release(0, park=True)
+    parked_ids = p1 + gen
+
+    # continuation: full conversation + a new turn
+    new_prompt = parked_ids + [7, 13, 52]
+    got = [eng.extend(0, np.asarray(new_prompt, np.int32),
+                      start=len(parked_ids), opts=GREEDY)]
+    for _ in range(5):
+        got.append(int(eng.decode()[0]))
+    eng.release(0)
+
+    ref = run_fresh(make_engine(cfg, params), new_prompt, GREEDY, 5)
+    assert got == ref
+
+
+def test_extend_partial_divergent_prefix():
+    """Reuse only the common prefix of a parked conversation that then
+    diverged — stale cache entries beyond the prefix must not leak."""
+    cfg = cfglib.PRESETS["tiny"]
+    params = decoder.init_params(cfg, jax.random.PRNGKey(0), dtype=F32)
+    eng = make_engine(cfg, params)
+
+    shared = list(np.random.default_rng(1).integers(1, 250, 20))
+    p1 = shared + [3, 5, 7]
+    eng.admit(1, np.asarray(p1, np.int32), GREEDY)
+    for _ in range(3):
+        eng.decode()
+    eng.release(1, park=True)
+
+    new_prompt = shared + [9, 11]  # diverges after the shared prefix
+    got = [eng.extend(1, np.asarray(new_prompt, np.int32),
+                      start=len(shared), opts=GREEDY)]
+    for _ in range(4):
+        got.append(int(eng.decode()[1]))
+    eng.release(1)
+
+    ref = run_fresh(make_engine(cfg, params), new_prompt, GREEDY, 4)
+    assert got == ref
+
+
+def test_extend_rebuilds_penalty_window():
+    """With repeat/presence penalties on, the extension's ring must cover
+    the continuation prompt, not the parked sequence's divergent tail."""
+    cfg = cfglib.PRESETS["tiny"]
+    params = decoder.init_params(cfg, jax.random.PRNGKey(0), dtype=F32)
+    eng = make_engine(cfg, params)
+
+    shared = list(np.random.default_rng(2).integers(1, 250, 18))
+    eng.admit(0, np.asarray(shared + [101, 102, 103], np.int32), GREEDY_PEN)
+    for _ in range(3):
+        eng.decode()
+    eng.release(0, park=True)
+
+    new_prompt = shared + [44, 45, 46, 47]
+    got = [eng.extend(0, np.asarray(new_prompt, np.int32),
+                      start=len(shared), opts=GREEDY_PEN)]
+    for _ in range(6):
+        got.append(int(eng.decode()[0]))
+    eng.release(0)
+
+    ref = run_fresh(make_engine(cfg, params), new_prompt, GREEDY_PEN, 6)
+    assert got == ref
+
+
+def test_scheduler_parks_and_reuses():
+    cfg = cfglib.PRESETS["tiny"]
+    params = decoder.init_params(cfg, jax.random.PRNGKey(0), dtype=F32)
+    eng = make_engine(cfg, params, slots=2)
+    sched = Scheduler(eng)
+    try:
+        p1 = list(np.random.default_rng(3).integers(1, 250, 20))
+        r1 = sched.submit(p1, GREEDY, max_tokens=4)
+        out1 = list(r1.tokens())
+        assert r1.stats.n_reused == 0
+
+        # conversation continuation: old prompt + old output + new turn
+        p2 = p1 + out1 + [17, 23]
+        r2 = sched.submit(p2, GREEDY, max_tokens=4)
+        out2 = list(r2.tokens())
+        assert r2.stats.n_reused >= len(p1)
+
+        # a fresh scheduler with no cache must produce the same stream
+        eng_ref = make_engine(cfg, params, slots=2)
+        sched_ref = Scheduler(eng_ref)
+        try:
+            rr = sched_ref.submit(p2, GREEDY, max_tokens=4)
+            assert list(rr.tokens()) == out2
+        finally:
+            sched_ref.shutdown()
+    finally:
+        sched.shutdown()
+
+
+def test_scheduler_short_prompts_skip_reuse():
+    """Prefixes below MIN_PREFIX_REUSE go through the normal admit path."""
+    cfg = cfglib.PRESETS["tiny"]
+    params = decoder.init_params(cfg, jax.random.PRNGKey(0), dtype=F32)
+    eng = make_engine(cfg, params, slots=2)
+    sched = Scheduler(eng)
+    try:
+        r1 = sched.submit([5, 9, 2], GREEDY, max_tokens=3)
+        list(r1.tokens())
+        r2 = sched.submit([5, 9, 2, 4], GREEDY, max_tokens=3)
+        list(r2.tokens())
+        assert r2.stats.n_reused == 0
+    finally:
+        sched.shutdown()
+
+
+def test_parked_prefix_excludes_unfed_last_token():
+    """With decode_chunk=1 every sampled token sits on the final chunk row,
+    so the last token's K/V is never written; parking must exclude it or
+    continuations would attend a stale cache position."""
+    cfg = cfglib.PRESETS["tiny"]
+    params = decoder.init_params(cfg, jax.random.PRNGKey(0), dtype=F32)
+    eng = Engine(cfg, params,
+                 ecfg=EngineConfig(max_slots=2, max_seq_len=128,
+                                   cache_dtype=F32, min_prefill_bucket=16,
+                                   repeat_last_n=8, decode_chunk=1))
+    sched = Scheduler(eng)
+    try:
+        p1 = list(np.random.default_rng(5).integers(1, 250, 20))
+        r1 = sched.submit(p1, GREEDY, max_tokens=4)
+        out1 = list(r1.tokens())
+        parked = sched._parked.get(r1.slot)
+        assert parked is not None
+        assert len(parked) < len(p1) + len(out1) + 1  # last token dropped
+
+        p2 = p1 + out1 + [17, 23]
+        r2 = sched.submit(p2, GREEDY, max_tokens=4)
+        out2 = list(r2.tokens())
+        assert r2.stats.n_reused >= len(p1)
+
+        eng_ref = Engine(cfg, params,
+                         ecfg=EngineConfig(max_slots=2, max_seq_len=128,
+                                           cache_dtype=F32,
+                                           min_prefill_bucket=16,
+                                           repeat_last_n=8, decode_chunk=1))
+        sched_ref = Scheduler(eng_ref)
+        try:
+            rr = sched_ref.submit(p2, GREEDY, max_tokens=4)
+            assert list(rr.tokens()) == out2
+        finally:
+            sched_ref.shutdown()
+    finally:
+        sched.shutdown()
