@@ -265,20 +265,42 @@ class Engine:
 
         W = max(1, self.ecfg.repeat_last_n)
 
+        def _sample_install(lengths, counts, last_tokens, pring, logits,
+                            ring_row, counts_row, slot, total, sp_row, key,
+                            mask_row, cflag):
+            """Shared admission tail (fresh prefill AND prefix-cache
+            extend): grammar-mask + sample the first token from ``logits``
+            [T', V] at row total-relative end, push it through the penalty
+            window (``ring_row``/``counts_row`` cover the prompt), install
+            slot state. Returns (tok, lengths, counts, last_tokens, pring).
+
+            The caller passes ``logits`` already indexed to the last valid
+            row ([V])."""
+            last = logits
+            allowed = unpack_mask(mask_row, cfg.vocab_size)
+            last = jnp.where((cflag == 1) & ~allowed, sampling.NEG_INF, last)
+            tok = sampling.sample(last[None], counts_row[None], sp_row,
+                                  key[None])[0]
+            evict = ring_row[total % W]
+            counts_row = counts_row.at[evict].add(-1, mode="drop")
+            ring_row = ring_row.at[total % W].set(tok)
+            counts_row = counts_row.at[tok].add(1)
+            pring = pring.at[slot].set(ring_row)
+            lengths = lengths.at[slot].set(total)
+            counts = counts.at[slot].set(counts_row)
+            last_tokens = last_tokens.at[slot].set(tok)
+            return tok, lengths, counts, last_tokens, pring
+
         def _insert_prefilled(k_cache, v_cache, lengths, counts,
                               last_tokens, pring, logits, ks, vs, tokens,
                               slot, n_valid, sp_row, key, mask_row, cflag):
-            """Shared admission tail: sample the first token from the
-            prefill logits and install chunk K/V + slot state. Penalty
-            counts see only the LAST repeat_last_n prompt tokens (the
-            ring); image pad positions carry id == vocab_size, which the
-            scatter-add drops (out of bounds) — image tokens never enter
-            the penalty counts."""
+            """Fresh-prefill admission: build the penalty window from the
+            LAST repeat_last_n prompt tokens of the device-side chunk
+            (image pad positions carry id == vocab_size, which the
+            scatter-add drops — image tokens never enter the counts),
+            sample, and install chunk K/V + slot state."""
             last = jax.lax.dynamic_index_in_dim(
                 logits[0], n_valid - 1, axis=0, keepdims=False)
-            # grammar mask on the first sampled token (format: "json")
-            allowed = unpack_mask(mask_row, cfg.vocab_size)
-            last = jnp.where((cflag == 1) & ~allowed, sampling.NEG_INF, last)
             # ring of the last W prompt tokens: absolute positions
             # n_valid-W .. n_valid-1 land in slots pos % W (each slot
             # exactly once — no scatter duplicates)
@@ -292,14 +314,9 @@ class Engine:
                                 ).at[pos % W].set(vals)
             counts_row = jnp.zeros((cfg.vocab_size,), jnp.int32
                                    ).at[vals].add(1, mode="drop")
-            tok = sampling.sample(last[None], counts_row[None], sp_row,
-                                  key[None])[0]
-            # push the first sampled token through the window
-            evict = ring_row[n_valid % W]
-            counts_row = counts_row.at[evict].add(-1, mode="drop")
-            ring_row = ring_row.at[n_valid % W].set(tok)
-            counts_row = counts_row.at[tok].add(1)
-            pring = pring.at[slot].set(ring_row)
+            (tok, lengths, counts, last_tokens, pring) = _sample_install(
+                lengths, counts, last_tokens, pring, last, ring_row,
+                counts_row, slot, n_valid, sp_row, key, mask_row, cflag)
             if self.quant_cache:
                 from ..ops.quant_cache import quantize_kv
                 kq, ksc = quantize_kv(ks)          # [L,1,KvH,T,hd]
@@ -314,9 +331,6 @@ class Engine:
                     k_cache, ks.astype(k_cache.dtype), (0, slot, 0, 0, 0))
                 v_cache = jax.lax.dynamic_update_slice(
                     v_cache, vs.astype(v_cache.dtype), (0, slot, 0, 0, 0))
-            lengths = lengths.at[slot].set(n_valid)
-            counts = counts.at[slot].set(counts_row)
-            last_tokens = last_tokens.at[slot].set(tok)
             return (tok, *pin(k_cache, v_cache, lengths, counts,
                               last_tokens), pring)
 
@@ -447,19 +461,10 @@ class Engine:
                                                    (0, slot, 0, 0, 0))
             last = jax.lax.dynamic_index_in_dim(
                 logits[0], n_new - 1, axis=0, keepdims=False)
-            allowed = unpack_mask(mask_row, cfg.vocab_size)
-            last = jnp.where((cflag == 1) & ~allowed, sampling.NEG_INF, last)
-            tok = sampling.sample(last[None], counts_row[None], sp_row,
-                                  key[None])[0]
-            total = start + n_new
-            evict = ring_row[total % W]
-            counts_row = counts_row.at[evict].add(-1, mode="drop")
-            ring_row = ring_row.at[total % W].set(tok)
-            counts_row = counts_row.at[tok].add(1)
-            pring = pring.at[slot].set(ring_row)
-            lengths = lengths.at[slot].set(total)
-            counts = counts.at[slot].set(counts_row)
-            last_tokens = last_tokens.at[slot].set(tok)
+            (tok, lengths, counts, last_tokens, pring) = _sample_install(
+                lengths, counts, last_tokens, pring, last, ring_row,
+                counts_row, slot, start + n_new, sp_row, key, mask_row,
+                cflag)
             return (tok, *pin(k_cache, v_cache, lengths, counts,
                               last_tokens), pring)
 

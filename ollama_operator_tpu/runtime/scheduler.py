@@ -251,6 +251,11 @@ class Scheduler:
                 slot = next((s for s in free if s not in self._parked),
                             free[0])
                 free.remove(slot)
+            # the slot's parked cache is spoken for either way: on success
+            # the request owns it; on failure the slot state is unknown and
+            # must not be offered for reuse again (a stale entry would also
+            # crash the NEXT request's free.remove in this same pass)
+            self._parked.pop(slot, None)
             try:
                 mask_row = (req.constraint.mask_row()
                             if req.constraint is not None else None)
@@ -263,7 +268,6 @@ class Scheduler:
                     first = self.engine.admit(slot, req.prompt_ids,
                                               req.opts, embeds=req.embeds,
                                               mask_row=mask_row)
-                self._parked.pop(slot, None)  # cache now owned by req
             except Exception as e:  # surfacing engine errors to the caller
                 req.error = str(e)
                 req.out.put(("error", str(e)))
