@@ -133,10 +133,11 @@ def cached_attention(cfg, q, k_cache, v_cache, mask, q_pos, scale: float,
     step routes to the pallas kernel, which skips unread cache blocks; T>1
     continuations use the masked einsum path). ``attn_len`` statically
     bounds the attended prefix: the einsum path slices the cache view (the
-    lazy slice fuses into its reads); the pallas kernel keeps the FULL
-    cache operand — a sliced pallas operand would materialize a copy per
-    layer per step, and its q_pos block clamp already elides the unread
-    blocks' DMAs."""
+    lazy slice fuses into its reads). The decode path (forward_with_cache)
+    hands this an A-sized window sliced from the full cache carry, so the
+    pallas kernel's operand is that window — materialized once per layer
+    either way; the kernel's q_pos block clamp still elides unread blocks'
+    DMAs within it."""
     mode = resolve_kernels(cfg.kernels)
     # MHA (G == 1) maps badly onto the decode kernel's (B, KvH, nk) grid —
     # B×KvH tiny 8-row programs lose to one big XLA einsum (measured on

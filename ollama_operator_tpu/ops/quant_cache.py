@@ -37,23 +37,6 @@ def quantize_kv(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
     return jnp.clip(q, -127, 127).astype(jnp.int8), s
 
 
-def cache_write_q(kc: Dict, vc: Dict, k, v, write_pos) -> Tuple[Dict, Dict]:
-    """Quantize fresh K/V [B, KvH, T, hd] and scatter into the slot cache
-    at absolute positions ``write_pos`` [B, T] (same indexing as the dense
-    write in models/decoder._block_cached)."""
-    B, KvH, T, hd = k.shape
-    kq, ks = quantize_kv(k)
-    vq, vs = quantize_kv(v)
-    bidx = jnp.arange(B)[:, None, None]
-    hidx = jnp.arange(KvH)[None, :, None]
-    pidx = write_pos[:, None, :]
-    kc = {"q": kc["q"].at[bidx, hidx, pidx].set(kq),
-          "s": kc["s"].at[bidx, hidx, pidx].set(ks)}
-    vc = {"q": vc["q"].at[bidx, hidx, pidx].set(vq),
-          "s": vc["s"].at[bidx, hidx, pidx].set(vs)}
-    return kc, vc
-
-
 def attend_hf_q(q, kc: Dict, vc: Dict, mask, scale: float,
                 softcap: float = 0.0, attn_len=None, compute_dtype=None):
     """Grouped-query attention against the quantized head-first cache.

@@ -270,12 +270,11 @@ class Engine:
                             mask_row, cflag):
             """Shared admission tail (fresh prefill AND prefix-cache
             extend): grammar-mask + sample the first token from ``logits``
-            [T', V] at row total-relative end, push it through the penalty
-            window (``ring_row``/``counts_row`` cover the prompt), install
-            slot state. Returns (tok, lengths, counts, last_tokens, pring).
-
-            The caller passes ``logits`` already indexed to the last valid
-            row ([V])."""
+            (the [V] row of the last valid prompt position — the caller
+            indexes it), push it through the penalty window
+            (``ring_row``/``counts_row`` cover the prompt), and install
+            slot state. Returns (tok, lengths, counts, last_tokens,
+            pring)."""
             last = logits
             allowed = unpack_mask(mask_row, cfg.vocab_size)
             last = jnp.where((cflag == 1) & ~allowed, sampling.NEG_INF, last)

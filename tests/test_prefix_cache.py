@@ -168,7 +168,8 @@ def test_parked_prefix_excludes_unfed_last_token():
         out1 = list(r1.tokens())
         parked = sched._parked.get(r1.slot)
         assert parked is not None
-        assert len(parked) == len(p1) + len(out1) - 1  # last token dropped
+        # every sampled token (incl. a hypothetical EOG) minus the unfed last
+        assert len(parked) == len(p1) + len(r1.all_tokens) - 1
 
         p2 = p1 + out1 + [17, 23]
         r2 = sched.submit(p2, GREEDY, max_tokens=4)
