@@ -102,7 +102,7 @@ def main() -> None:
         mesh = make_mesh(MeshPlan.for_devices(len(devs), tp=tp))
         log(f"mesh: {dict(mesh.shape)}")
 
-    chunk = int(os.environ.get("BENCH_DECODE_CHUNK", "16"))
+    chunk = int(os.environ.get("BENCH_DECODE_CHUNK", "32"))
     from ollama_operator_tpu.runtime.engine import resolve_cache_dtype
     kv_dtype = resolve_cache_dtype(os.environ.get("BENCH_KV_DTYPE", "int8"))
     eng = Engine(cfg, params, mesh=mesh,

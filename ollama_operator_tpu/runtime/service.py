@@ -227,24 +227,42 @@ class LoadedModel:
                           (self.system or ""))
 
     def render_chat(self, messages: List[Dict],
-                    template: Optional[str] = None) -> str:
+                    template: Optional[str] = None,
+                    tools: Optional[List[Dict]] = None) -> str:
         """Render a messages list. Templates that iterate .Messages get them
-        directly; legacy system/prompt templates get a flattened view."""
+        directly; legacy system/prompt templates get a flattened view.
+
+        ``tools`` (OpenAI wire shape) render through the template's
+        ``.Tools`` (Go-shaped, server/tools.py); a model whose template has
+        no tools section cannot honour them — that's a client error."""
+        from ..server.tools import to_template_tool_calls, to_template_tools
         tpl = Template(template) if template else self.template
+        if tools and ".Tools" not in tpl.src:
+            raise ValueError(
+                f"model {self.name} does not support tools (its template "
+                f"has no .Tools section)")
         system = self.system or ""
         sys_parts = [m["content"] for m in messages
                      if m.get("role") == "system"]
         if sys_parts:
             system = "\n".join(([system] if system else []) + sys_parts)
-        msgs = [{"Role": m.get("role", "user"),
-                 "Content": m.get("content", "")}
-                for m in messages if m.get("role") != "system"]
+        msgs = []
+        for m in messages:
+            if m.get("role") == "system":
+                continue
+            entry = {"Role": m.get("role", "user"),
+                     "Content": m.get("content", "") or ""}
+            if m.get("tool_calls"):
+                entry["ToolCalls"] = to_template_tool_calls(m["tool_calls"])
+            msgs.append(entry)
+        tpl_tools = to_template_tools(tools) if tools else []
         if ".Messages" in tpl.src:
             if system:
                 msgs = [{"Role": "system", "Content": system}] + msgs
-            return tpl.render(messages=msgs, system=system, prompt="")
+            return tpl.render(messages=msgs, system=system, prompt="",
+                              tools=tpl_tools)
         prompt = msgs[-1]["Content"] if msgs else ""
-        return tpl.render(system=system, prompt=prompt)
+        return tpl.render(system=system, prompt=prompt, tools=tpl_tools)
 
     # ------------------------------------------------------------------
     def generate_stream(self, prompt_text: str,

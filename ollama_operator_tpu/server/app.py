@@ -805,14 +805,29 @@ class Handler(BaseHTTPRequestHandler):
             return
         lm = self.manager.require_loaded(model, keep_alive=ka)
         stream = body.get("stream", True)
-        prompt = lm.render_chat(messages, template=body.get("template"))
+        tools = body.get("tools")
+        prompt = lm.render_chat(messages, template=body.get("template"),
+                                tools=tools)
         images = []
         for m in messages:
             images.extend(m.get("images") or [])
         gen = lm.generate_stream(prompt, options=body.get("options"),
                                  images=_decode_images(images),
                                  format=body.get("format"))
-        if stream:
+
+        def chat_message(final) -> Dict:
+            """Assistant message for the completed generation: a JSON tool
+            invocation becomes structured tool_calls (server/tools.py)."""
+            msg = {"role": "assistant", "content": final.text}
+            if tools:
+                from .tools import parse_tool_calls
+                calls = parse_tool_calls(final.text)
+                if calls:
+                    msg = {"role": "assistant", "content": "",
+                           "tool_calls": calls}
+            return msg
+
+        if stream and not tools:
             self._start_stream()
             for piece, final in gen:
                 if final is None:
@@ -835,8 +850,19 @@ class Handler(BaseHTTPRequestHandler):
             out = self._final_chunk(model, final, body)
             out.pop("response", None)
             out.pop("context", None)
-            out["message"] = {"role": "assistant", "content": final.text}
-            self._send_json(out)
+            out["message"] = chat_message(final)
+            if stream:
+                # tool responses stream as ONE message chunk + final (the
+                # invocation can't be parsed until the output completes)
+                self._start_stream()
+                self._stream_json({"model": model, "created_at": _now_iso(),
+                                   "message": out["message"],
+                                   "done": False})
+                out["message"] = {"role": "assistant", "content": ""}
+                self._stream_json(out)
+                self._end_stream()
+            else:
+                self._send_json(out)
 
     def _api_pull(self, body: Dict):
         model = self._model_arg(body)
@@ -863,7 +889,26 @@ class Handler(BaseHTTPRequestHandler):
             self._send_json({"status": "success"})
 
     def _api_push(self, body: Dict):
-        raise ApiError(501, "push not implemented")
+        model = self._model_arg(body)
+        stream = body.get("stream", True)
+        if stream:
+            self._start_stream()
+
+            def progress(status, completed=0, total=0, digest=None):
+                msg = {"status": status}
+                if total:
+                    msg["total"] = total
+                    msg["completed"] = completed
+                self._stream_json(msg)
+
+            try:
+                self.manager.client.push(model, progress)
+            except RegistryError as e:
+                self._stream_json({"error": str(e)})
+            self._end_stream()
+        else:
+            self.manager.client.push(model)
+            self._send_json({"status": "success"})
 
     def _api_create(self, body: Dict):
         model = self._model_arg(body)
@@ -937,7 +982,8 @@ class Handler(BaseHTTPRequestHandler):
             options["num_predict"] = body["max_tokens"]
         if body.get("stop"):
             options["stop"] = body["stop"]
-        prompt = lm.render_chat(messages)
+        tools = body.get("tools")
+        prompt = lm.render_chat(messages, tools=tools)
         rid = f"chatcmpl-{int(time.time() * 1000)}"
         created = int(time.time())
         # OpenAI response_format → grammar-constrained JSON decoding
@@ -947,6 +993,60 @@ class Handler(BaseHTTPRequestHandler):
                                                        "json_schema"):
             fmt = "json"
         gen = lm.generate_stream(prompt, options=options, format=fmt)
+        if tools:
+            # buffer and answer as one completion: tool invocations are
+            # parsed from the full output
+            final = None
+            for _p, f in gen:
+                if f is not None:
+                    final = f
+            from .tools import parse_tool_calls
+            calls = parse_tool_calls(final.text)
+            if calls:
+                msg = {"role": "assistant", "content": None,
+                       "tool_calls": [
+                           {"id": f"call_{rid}_{i}", "type": "function",
+                            "function": {
+                                "name": c["function"]["name"],
+                                "arguments": json.dumps(
+                                    c["function"]["arguments"])}}
+                           for i, c in enumerate(calls)]}
+                finish = "tool_calls"
+            else:
+                msg = {"role": "assistant", "content": final.text}
+                finish = final.done_reason
+            if body.get("stream"):
+                # tool invocations parse only once the output completes:
+                # stream the finished message as one SSE delta + finish
+                self._start_stream(ctype="text/event-stream")
+                delta = dict(msg)
+                if delta.get("tool_calls"):
+                    # SSE deltas carry a per-entry index
+                    delta["tool_calls"] = [dict(tc, index=i) for i, tc in
+                                           enumerate(delta["tool_calls"])]
+                self._chunk(self._sse({
+                    "id": rid, "object": "chat.completion.chunk",
+                    "created": created, "model": model,
+                    "choices": [{"index": 0, "delta": delta,
+                                 "finish_reason": None}]}))
+                self._chunk(self._sse({
+                    "id": rid, "object": "chat.completion.chunk",
+                    "created": created, "model": model,
+                    "choices": [{"index": 0, "delta": {},
+                                 "finish_reason": finish}]}))
+                self._chunk(b"data: [DONE]\n\n")
+                self._end_stream()
+                return
+            self._send_json({
+                "id": rid, "object": "chat.completion", "created": created,
+                "model": model,
+                "choices": [{"index": 0, "message": msg,
+                             "finish_reason": finish}],
+                "usage": {"prompt_tokens": final.prompt_tokens,
+                          "completion_tokens": final.generated_tokens,
+                          "total_tokens": final.prompt_tokens +
+                          final.generated_tokens}})
+            return
         if body.get("stream"):
             self._start_stream(ctype="text/event-stream")
             self._chunk(self._sse({

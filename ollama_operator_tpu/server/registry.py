@@ -44,6 +44,7 @@ MT_PARAMS = "application/vnd.ollama.image.params"
 MT_LICENSE = "application/vnd.ollama.image.license"
 MT_ADAPTER = "application/vnd.ollama.image.adapter"
 MT_PROJECTOR = "application/vnd.ollama.image.projector"
+MANIFEST_MT = "application/vnd.docker.distribution.manifest.v2+json"
 MANIFEST_ACCEPT = ("application/vnd.docker.distribution.manifest.v2+json, "
                    "application/vnd.oci.image.manifest.v1+json")
 
@@ -336,5 +337,114 @@ class RegistryClient:
             progress("success", 0, 0)
         return name
 
+    def _request(self, method: str, url: str, data: Optional[bytes] = None,
+                 headers: Optional[Dict[str, str]] = None):
+        req = urllib.request.Request(url, data=data, method=method,
+                                     headers=headers or {})
+        return urllib.request.urlopen(req, timeout=self.timeout)
+
+    def _blob_exists(self, name: ModelName, digest: str) -> bool:
+        try:
+            with self._request("HEAD", name.blob_url(digest)):
+                return True
+        except urllib.error.HTTPError as e:
+            if e.code in (404, 405):
+                return False
+            raise RegistryError(f"blob HEAD failed: {e}") from e
+        except urllib.error.URLError as e:
+            raise RegistryError(f"registry unreachable: {e}") from e
+
+    def _push_blob(self, name: ModelName, digest: str, path: str,
+                   size: int, progress, label: str):
+        """Docker registry v2 two-step upload: POST an upload session,
+        PUT the bytes at the returned Location with ?digest=. The blob
+        streams from disk (model layers are multi-GB; never buffered
+        whole) with per-chunk progress, mirroring pull."""
+        start_url = (f"{name.base_url}/v2/{name.namespace}/{name.name}"
+                     f"/blobs/uploads/")
+        try:
+            with self._request("POST", start_url, data=b"") as r:
+                loc = r.headers.get("Location", "")
+        except urllib.error.HTTPError as e:
+            raise RegistryError(f"upload start failed: {e}") from e
+        except urllib.error.URLError as e:
+            raise RegistryError(f"registry unreachable: {e}") from e
+        if loc.startswith("/"):
+            loc = name.base_url + loc
+        sep = "&" if "?" in loc else "?"
+        put_url = f"{loc}{sep}digest={digest}"
+
+        client = self
+
+        class _Reader:
+            """File-like body: urllib streams it; read() reports progress."""
+
+            def __init__(self, f):
+                self.f = f
+                self.sent = 0
+
+            def read(self, n=-1):
+                chunk = self.f.read(n if n and n > 0 else 1 << 20)
+                self.sent += len(chunk)
+                if progress and chunk:
+                    progress(label, min(self.sent, size), size)
+                return chunk
+
+            def __len__(self):  # Content-Length for urllib
+                return size
+
+        try:
+            with open(path, "rb") as f:
+                with client._request("PUT", put_url, data=_Reader(f),
+                                     headers={
+                        "Content-Type": "application/octet-stream",
+                        "Content-Length": str(size)}):
+                    pass
+        except urllib.error.HTTPError as e:
+            raise RegistryError(f"blob upload failed: {e}") from e
+        except urllib.error.URLError as e:
+            raise RegistryError(f"registry unreachable: {e}") from e
+        except OSError as e:
+            raise RegistryError(f"local blob {digest} missing: {e}") from e
+
     def push(self, ref: str, progress: Optional[ProgressCb] = None):
-        raise RegistryError("push is not implemented yet")
+        """Push a local model to its registry (docker registry v2 flow:
+        existence HEAD per blob, chunked-session upload, then manifest PUT)
+        — the inverse of ``pull``, same protocol the ollama CLI's
+        `ollama push` speaks against registry.ollama.ai."""
+        name = ModelName.parse(ref)
+        manifest = self.store.read_manifest(name)
+        if manifest is None:
+            raise RegistryError(f"model {name.short!r} not found locally")
+        blobs = list(manifest.get("layers", []))
+        if manifest.get("config"):
+            blobs.append(manifest["config"])
+        for layer in blobs:
+            digest = layer["digest"]
+            size = layer.get("size", 0)
+            label = f"pushing {digest[7:19]}"
+            if progress:
+                progress(label, 0, size)
+            if self._blob_exists(name, digest):
+                if progress:
+                    progress(label, size, size)
+                continue
+            self._push_blob(name, digest, self.store.blob_path(digest),
+                            size, progress, label)
+            if progress:
+                progress(label, size, size)
+        if progress:
+            progress("pushing manifest", 0, 0)
+        body = json.dumps(manifest).encode()
+        try:
+            with self._request("PUT", name.manifest_url(), data=body,
+                               headers={"Content-Type": manifest.get(
+                                   "mediaType", MANIFEST_MT)}):
+                pass
+        except urllib.error.HTTPError as e:
+            raise RegistryError(f"manifest push failed: {e}") from e
+        except urllib.error.URLError as e:
+            raise RegistryError(f"registry unreachable: {e}") from e
+        if progress:
+            progress("success", 0, 0)
+        return name

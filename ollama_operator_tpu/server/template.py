@@ -134,6 +134,9 @@ def _eval(expr: str, dot: Any) -> Any:
             return rest[-1]
         if fn == "not":
             return not _truthy(rest[0])
+        if fn == "json":
+            import json as _json
+            return _json.dumps(rest[0])
         raise TemplateError(f"unsupported template function {fn!r}")
     if expr in ("true", "false"):
         return expr == "true"
@@ -218,7 +221,13 @@ def _render(nodes: List[_Node], dot: Any, out: List[str]):
             out.append(n.s)
         elif isinstance(n, _Emit):
             v = _eval(n.expr, dot)
-            out.append("" if v is None else str(v))
+            if isinstance(v, (dict, list)):
+                # Go renders structs with fmt verbs; models are trained on
+                # JSON tool specs, so emit maps/lists as JSON (tool use)
+                import json as _json
+                out.append(_json.dumps(v))
+            else:
+                out.append("" if v is None else str(v))
         elif isinstance(n, _If):
             if _truthy(_eval(n.expr, dot)):
                 _render(n.body, dot, out)

@@ -102,6 +102,69 @@ class FakeRegistry:
                 self.send_response(404)
                 self.end_headers()
 
+            # --- push support (docker registry v2 upload flow) --------
+            def do_HEAD(self):
+                reg.requests.append(("HEAD", self.path, dict(self.headers)))
+                parts = self.path.strip("/").split("/")
+                if len(parts) >= 5 and parts[0] == "v2" and \
+                        parts[-2] == "blobs":
+                    if parts[-1] in reg.blobs:
+                        self.send_response(200)
+                        self.send_header("Content-Length",
+                                         str(len(reg.blobs[parts[-1]])))
+                        self.end_headers()
+                        return
+                self.send_response(404)
+                self.end_headers()
+
+            def do_POST(self):
+                reg.requests.append(("POST", self.path, dict(self.headers)))
+                parts = self.path.strip("/").split("/")
+                # /v2/<ns>/<name>/blobs/uploads/
+                if len(parts) >= 5 and parts[0] == "v2" and \
+                        parts[-2] == "blobs" or (parts and
+                                                 parts[-1] == "uploads"):
+                    import uuid
+                    loc = self.path.rstrip("/") + "/" + uuid.uuid4().hex
+                    self.send_response(202)
+                    self.send_header("Location", loc)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                self.send_response(404)
+                self.end_headers()
+
+            def do_PUT(self):
+                reg.requests.append(("PUT", self.path, dict(self.headers)))
+                n = int(self.headers.get("Content-Length") or 0)
+                data = self.rfile.read(n) if n else b""
+                parts = self.path.split("?")[0].strip("/").split("/")
+                query = self.path.split("?", 1)[1] if "?" in self.path else ""
+                if "uploads" in parts and "digest=" in query:
+                    digest = [q[7:] for q in query.split("&")
+                              if q.startswith("digest=")][0]
+                    actual = "sha256:" + hashlib.sha256(data).hexdigest()
+                    if digest != actual:
+                        self.send_response(400)
+                        self.end_headers()
+                        return
+                    reg.blobs[digest] = data
+                    self.send_response(201)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                if len(parts) >= 5 and parts[0] == "v2" and \
+                        parts[-2] == "manifests":
+                    ns = "/".join(parts[1:-3])
+                    name, tag = parts[-3], parts[-1]
+                    reg.manifests[(ns, name, tag)] = json.loads(data)
+                    self.send_response(201)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                self.send_response(404)
+                self.end_headers()
+
         self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
         self.port = self.httpd.server_address[1]
         threading.Thread(target=self.httpd.serve_forever,

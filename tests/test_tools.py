@@ -1,0 +1,141 @@
+"""Tool calling: template rendering of .Tools/.ToolCalls, model-output
+parsing into structured tool_calls, and the chat-surface contract."""
+
+import json
+
+import pytest
+
+from ollama_operator_tpu.server.template import Template
+from ollama_operator_tpu.server.tools import (parse_tool_calls,
+                                              to_template_tool_calls,
+                                              to_template_tools)
+
+WEATHER = {
+    "type": "function",
+    "function": {
+        "name": "get_weather",
+        "description": "Get the current weather",
+        "parameters": {
+            "type": "object",
+            "properties": {"city": {"type": "string"}},
+            "required": ["city"],
+        },
+    },
+}
+
+
+# --- parsing -----------------------------------------------------------------
+
+def test_parse_bare_object():
+    out = parse_tool_calls('{"name": "get_weather", "arguments": '
+                           '{"city": "Oslo"}}')
+    assert out == [{"function": {"name": "get_weather",
+                                 "arguments": {"city": "Oslo"}}}]
+
+
+def test_parse_parameters_alias_and_list():
+    out = parse_tool_calls('[{"name": "a", "parameters": {"x": 1}}, '
+                           '{"name": "b", "arguments": {}}]')
+    assert [c["function"]["name"] for c in out] == ["a", "b"]
+    assert out[0]["function"]["arguments"] == {"x": 1}
+
+
+def test_parse_embedded_after_prose():
+    text = ('Sure, let me check that.\n'
+            '{"name": "get_weather", "arguments": {"city": "Bergen"}}')
+    out = parse_tool_calls(text)
+    assert out[0]["function"]["arguments"] == {"city": "Bergen"}
+
+
+def test_parse_rejects_non_tool_output():
+    assert parse_tool_calls("The weather is nice today.") == []
+    assert parse_tool_calls('{"city": "Oslo"}') == []          # no name
+    assert parse_tool_calls('{"name": "x"}') == []             # no args
+    assert parse_tool_calls('{"name": "", "arguments": {}}') == []
+    assert parse_tool_calls("") == []
+
+
+# --- template shapes ---------------------------------------------------------
+
+def test_to_template_tools_shape():
+    """Lowercase wire keys — the template's capitalized field access
+    (.Function.Name) resolves via the engine's lowercase fallback, and
+    json-emission produces model-facing wire JSON."""
+    [t] = to_template_tools([WEATHER])
+    assert t["type"] == "function"
+    assert t["function"]["name"] == "get_weather"
+    assert t["function"]["parameters"]["required"] == ["city"]
+
+
+def test_to_template_tool_calls_parses_string_arguments():
+    [c] = to_template_tool_calls(
+        [{"function": {"name": "f", "arguments": '{"x": 2}'}}])
+    assert c["function"]["arguments"] == {"x": 2}
+
+
+TOOL_TPL = (
+    "{{ if .Tools }}Tools:\n"
+    "{{ range .Tools }}{{ json .Function }}\n{{ end }}{{ end }}"
+    "{{ range .Messages }}[{{ .Role }}] {{ .Content }}"
+    "{{ if .ToolCalls }}{{ range .ToolCalls }}"
+    "<call {{ .Function.Name }} {{ .Function.Arguments }}>"
+    "{{ end }}{{ end }}\n{{ end }}"
+)
+
+
+def test_template_renders_tools_and_calls():
+    tpl = Template(TOOL_TPL)
+    out = tpl.render(
+        tools=to_template_tools([WEATHER]),
+        messages=[
+            {"Role": "user", "Content": "weather in Oslo?"},
+            {"Role": "assistant", "Content": "",
+             "ToolCalls": to_template_tool_calls(
+                 [{"function": {"name": "get_weather",
+                                "arguments": {"city": "Oslo"}}}])},
+            {"Role": "tool", "Content": "12C, sunny"},
+        ])
+    assert '"name": "get_weather"' in out
+    assert '"required": ["city"]' in out         # schema JSON-emitted
+    assert '<call get_weather {"city": "Oslo"}>' in out
+    assert "[tool] 12C, sunny" in out
+
+
+def test_template_json_function():
+    tpl = Template('{{ json . }}')
+    assert tpl.render(**{}) or True  # render of empty dot
+    tpl = Template('{{ json .X }}')
+    assert tpl.render(x=[1, 2]) == "[1, 2]"
+
+
+def test_render_chat_rejects_tools_without_template_support():
+    """A model whose template has no .Tools section can't honour tools."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from ollama_operator_tpu.models import config as cfglib
+    from ollama_operator_tpu.models import decoder
+    from ollama_operator_tpu.runtime.engine import EngineConfig
+    from ollama_operator_tpu.runtime.service import LoadedModel
+    from ollama_operator_tpu.tokenizer import Tokenizer
+
+    cfg = cfglib.PRESETS["tiny"]
+    params = decoder.init_params(cfg, jax.random.PRNGKey(0),
+                                 dtype=jnp.float32)
+    tok = Tokenizer(model="llama",
+                    tokens=[f"t{i}" for i in range(cfg.vocab_size)])
+    lm = LoadedModel("tiny", cfg, params, tok,
+                     template="{{ .System }}|{{ .Prompt }}",
+                     ecfg=EngineConfig(max_slots=2, max_seq_len=64,
+                                       cache_dtype=jnp.float32,
+                                       min_prefill_bucket=16))
+    try:
+        with pytest.raises(ValueError, match="does not support tools"):
+            lm.render_chat([{"role": "user", "content": "hi"}],
+                           tools=[WEATHER])
+        # and with a tools-aware template the same call renders
+        out = lm.render_chat([{"role": "user", "content": "hi"}],
+                             template=TOOL_TPL, tools=[WEATHER])
+        assert "get_weather" in out
+    finally:
+        lm.unload()
