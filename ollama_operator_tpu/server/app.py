@@ -816,14 +816,15 @@ class Handler(BaseHTTPRequestHandler):
                                  format=body.get("format"))
 
         def chat_message(final) -> Dict:
-            """Assistant message for the completed generation: a JSON tool
-            invocation becomes structured tool_calls (server/tools.py)."""
+            """Assistant message for the completed generation: JSON tool
+            invocations become structured tool_calls (server/tools.py);
+            prose around them stays as content."""
             msg = {"role": "assistant", "content": final.text}
             if tools:
-                from .tools import parse_tool_calls
-                calls = parse_tool_calls(final.text)
+                from .tools import split_tool_calls
+                calls, prose = split_tool_calls(final.text)
                 if calls:
-                    msg = {"role": "assistant", "content": "",
+                    msg = {"role": "assistant", "content": prose,
                            "tool_calls": calls}
             return msg
 
@@ -899,6 +900,8 @@ class Handler(BaseHTTPRequestHandler):
                 if total:
                     msg["total"] = total
                     msg["completed"] = completed
+                if digest:
+                    msg["digest"] = digest
                 self._stream_json(msg)
 
             try:
@@ -1000,10 +1003,10 @@ class Handler(BaseHTTPRequestHandler):
             for _p, f in gen:
                 if f is not None:
                     final = f
-            from .tools import parse_tool_calls
-            calls = parse_tool_calls(final.text)
+            from .tools import split_tool_calls
+            calls, prose = split_tool_calls(final.text)
             if calls:
-                msg = {"role": "assistant", "content": None,
+                msg = {"role": "assistant", "content": prose or None,
                        "tool_calls": [
                            {"id": f"call_{rid}_{i}", "type": "function",
                             "function": {

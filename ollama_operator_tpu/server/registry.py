@@ -387,7 +387,7 @@ class RegistryClient:
                 chunk = self.f.read(n if n and n > 0 else 1 << 20)
                 self.sent += len(chunk)
                 if progress and chunk:
-                    progress(label, min(self.sent, size), size)
+                    progress(label, min(self.sent, size), size, digest)
                 return chunk
 
             def __len__(self):  # Content-Length for urllib
@@ -424,15 +424,15 @@ class RegistryClient:
             size = layer.get("size", 0)
             label = f"pushing {digest[7:19]}"
             if progress:
-                progress(label, 0, size)
+                progress(label, 0, size, digest)
             if self._blob_exists(name, digest):
                 if progress:
-                    progress(label, size, size)
+                    progress(label, size, size, digest)
                 continue
             self._push_blob(name, digest, self.store.blob_path(digest),
                             size, progress, label)
             if progress:
-                progress(label, size, size)
+                progress(label, size, size, digest)
         if progress:
             progress("pushing manifest", 0, 0)
         body = json.dumps(manifest).encode()

@@ -68,12 +68,13 @@ def _as_call(obj: Any) -> Optional[Dict]:
 
 
 def _json_candidates(text: str):
-    """Yield decodable JSON values found in ``text``: the whole string
-    first, then brace/bracket-delimited spans after leading prose."""
+    """Yield (value, start, end) for every decodable JSON span in
+    ``text``: the whole string first, then brace/bracket-delimited spans
+    between prose."""
     dec = json.JSONDecoder()
-    s = text.strip()
+    s = text
     try:
-        yield json.loads(s)
+        yield json.loads(s), 0, len(s)
         return
     except json.JSONDecodeError:
         pass
@@ -82,7 +83,7 @@ def _json_candidates(text: str):
         if s[i] in "[{":
             try:
                 val, end = dec.raw_decode(s, i)
-                yield val
+                yield val, i, end
                 i = end
                 continue
             except json.JSONDecodeError:
@@ -90,11 +91,26 @@ def _json_candidates(text: str):
         i += 1
 
 
+def split_tool_calls(text: str):
+    """Model output → (tool calls, remaining prose).
+
+    EVERY JSON span that decodes to tool invocations contributes calls
+    (models emit parallel calls as separate objects); spans that aren't
+    invocations, and all non-JSON text, stay in the prose remainder."""
+    calls: List[Dict] = []
+    keep: List[str] = []
+    pos = 0
+    for val, start, end in _json_candidates(text):
+        items = val if isinstance(val, list) else [val]
+        found = [c for c in (_as_call(x) for x in items) if c]
+        if found and len(found) == len(items):
+            calls.extend(found)
+            keep.append(text[pos:start])
+            pos = end
+    keep.append(text[pos:])
+    return calls, "".join(keep).strip()
+
+
 def parse_tool_calls(text: str) -> List[Dict]:
     """Model output → tool calls ([] when the output is ordinary text)."""
-    for val in _json_candidates(text):
-        items = val if isinstance(val, list) else [val]
-        calls = [c for c in (_as_call(x) for x in items) if c]
-        if calls:
-            return calls
-    return []
+    return split_tool_calls(text)[0]

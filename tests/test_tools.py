@@ -139,3 +139,23 @@ def test_render_chat_rejects_tools_without_template_support():
         assert "get_weather" in out
     finally:
         lm.unload()
+
+
+def test_parse_multiple_separate_objects():
+    """Parallel calls emitted as separate JSON objects all survive."""
+    text = ('{"name": "f", "arguments": {}} and also '
+            '{"name": "g", "arguments": {"x": 1}}')
+    out = parse_tool_calls(text)
+    assert [c["function"]["name"] for c in out] == ["f", "g"]
+
+
+def test_split_keeps_prose_content():
+    from ollama_operator_tpu.server.tools import split_tool_calls
+    calls, prose = split_tool_calls(
+        'Sure, let me check.\n'
+        '{"name": "get_weather", "arguments": {"city": "Bergen"}}\nDone.')
+    assert calls[0]["function"]["name"] == "get_weather"
+    assert "Sure, let me check." in prose and "Done." in prose
+    # ordinary JSON that is NOT an invocation stays in the prose
+    calls, prose = split_tool_calls('The answer is {"city": "Oslo"}.')
+    assert calls == [] and '{"city": "Oslo"}' in prose
