@@ -69,8 +69,14 @@ def apply_lora(params: Dict[str, Any], cfg, adapter_path: str
                              f"LoRA adapter GGUF")
         targets = _targets(cfg)
         # the converter emits q/k in the base arch's layout — llama-family
-        # interleaved rope needs the same unpermute as the base weights
-        if f.arch not in _INTERLEAVED_ROPE_ARCHES:
+        # interleaved rope needs the same unpermute as the base weights.
+        # Adapters often omit general.architecture; fall back to the base
+        # model's RAW GGUF arch (cfg.gguf_arch — qwen2/gemma normalize to
+        # arch="llama" but their weights are NOT interleaved, so cfg.arch
+        # would wrongly unpermute their q/k deltas).
+        arch = (f.metadata.get("general.architecture")
+                or cfg.gguf_arch or cfg.arch)
+        if arch not in _INTERLEAVED_ROPE_ARCHES:
             T_ = lambda a: a.T
             targets["attn_q.weight"] = ("wq", T_)
             targets["attn_k.weight"] = ("wk", T_)

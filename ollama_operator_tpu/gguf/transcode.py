@@ -49,6 +49,7 @@ def config_from_gguf(f: GGUFFile) -> ModelConfig:
     if isinstance(kv, list):
         kv = kv[0]
     base = dict(
+        gguf_arch=arch,   # raw source arch, kept for rope-layout decisions
         vocab_size=len(f.metadata["tokenizer.ggml.tokens"]),
         dim=dim,
         n_layers=int(f.field("block_count")),

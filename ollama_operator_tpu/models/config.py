@@ -19,6 +19,11 @@ class ModelConfig:
     static argument."""
 
     arch: str = "llama"
+    gguf_arch: str = ""                # raw GGUF source arch ("" = native);
+                                       # rope-layout decisions key on this,
+                                       # NOT on the normalized arch (qwen2/
+                                       # gemma map to arch="llama" but are
+                                       # not interleaved-rope)
     vocab_size: int = 32000
     dim: int = 4096                    # model/residual width
     n_layers: int = 32

@@ -240,13 +240,15 @@ def _load_native():
             return None
         if (not os.path.exists(_LIB)
                 or os.path.getmtime(_SRC) > os.path.getmtime(_LIB)):
-            os.makedirs(_BUILD_DIR, exist_ok=True)
             cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
                    "-o", _LIB, _SRC]
             try:
+                # read-only filesystems (hardened pods) must fall back to
+                # the pure-Python mask path, not 500
+                os.makedirs(_BUILD_DIR, exist_ok=True)
                 subprocess.run(cmd, check=True, capture_output=True,
                                timeout=120)
-            except (subprocess.SubprocessError, FileNotFoundError):
+            except (subprocess.SubprocessError, FileNotFoundError, OSError):
                 return None
         try:
             lib = ctypes.CDLL(_LIB)

@@ -1,0 +1,11 @@
+"""Typed request-validation error for the serving stack.
+
+The HTTP layer maps ``BadRequest`` to 400. Internal ``ValueError``s (jax,
+numpy, bugs) are NOT caught as client errors — they surface as 500s, so
+server defects aren't silently reclassified as bad requests (round-1
+advisor finding on server/app.py's blanket ValueError handler).
+"""
+
+
+class BadRequest(ValueError):
+    """The request is malformed or unsatisfiable; client's fault (HTTP 400)."""

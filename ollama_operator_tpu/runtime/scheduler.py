@@ -26,6 +26,7 @@ from typing import Iterator, List, Optional, Sequence
 import numpy as np
 
 from .engine import Engine, SlotOptions
+from .errors import BadRequest
 
 
 class SchedulerBusy(RuntimeError):
@@ -132,7 +133,7 @@ class Scheduler:
                embeds: Optional[np.ndarray] = None,
                constraint=None) -> Request:
         if len(prompt_ids) >= self.engine.max_seq:
-            raise ValueError(
+            raise BadRequest(
                 f"prompt of {len(prompt_ids)} tokens exceeds context window "
                 f"{self.engine.max_seq}")
         req = Request(prompt_ids, opts, max_tokens, eog_ids, embeds=embeds,

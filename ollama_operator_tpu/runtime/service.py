@@ -11,6 +11,7 @@ per-request option merging; everything below it is token-level.
 from __future__ import annotations
 
 import dataclasses
+import sys
 import threading
 import time
 import weakref
@@ -25,6 +26,7 @@ from ..server.metrics import GLOBAL as METRICS
 from ..server.template import DEFAULT_TEMPLATE, Template
 from ..tokenizer import StreamDecoder, Tokenizer
 from .engine import Engine, EngineConfig, SlotOptions
+from .errors import BadRequest
 from .scheduler import Scheduler
 
 
@@ -69,6 +71,9 @@ class _OwnedStream:
             pass
 
 
+_schema_warned = [False]   # once-per-process format-schema downgrade notice
+
+
 def merge_options(defaults: Dict, request: Optional[Dict]
                   ) -> Tuple[SlotOptions, int, List[str]]:
     """(modelfile params, request options) → (SlotOptions, num_predict, stop)."""
@@ -77,16 +82,19 @@ def merge_options(defaults: Dict, request: Optional[Dict]
     stop = o.get("stop") or []  # tolerate explicit null
     if isinstance(stop, str):
         stop = [stop]
-    so = SlotOptions(
-        temperature=float(o.get("temperature", 0.8)),
-        top_k=int(o.get("top_k", 40)),
-        top_p=float(o.get("top_p", 0.9)),
-        min_p=float(o.get("min_p", 0.0)),
-        repeat_penalty=float(o.get("repeat_penalty", 1.1)),
-        presence_penalty=float(o.get("presence_penalty", 0.0)),
-        frequency_penalty=float(o.get("frequency_penalty", 0.0)),
-        seed=int(o.get("seed", -1)))
-    num_predict = int(o.get("num_predict", 128))
+    try:
+        so = SlotOptions(
+            temperature=float(o.get("temperature", 0.8)),
+            top_k=int(o.get("top_k", 40)),
+            top_p=float(o.get("top_p", 0.9)),
+            min_p=float(o.get("min_p", 0.0)),
+            repeat_penalty=float(o.get("repeat_penalty", 1.1)),
+            presence_penalty=float(o.get("presence_penalty", 0.0)),
+            frequency_penalty=float(o.get("frequency_penalty", 0.0)),
+            seed=int(o.get("seed", -1)))
+        num_predict = int(o.get("num_predict", 128))
+    except (TypeError, ValueError) as e:
+        raise BadRequest(f"invalid options: {e}") from e
     if num_predict < 0:
         num_predict = 1 << 30  # -1 = unlimited (bounded by context)
     return so, num_predict, list(stop)
@@ -238,7 +246,7 @@ class LoadedModel:
         from ..server.tools import to_template_tool_calls, to_template_tools
         tpl = Template(template) if template else self.template
         if tools and ".Tools" not in tpl.src:
-            raise ValueError(
+            raise BadRequest(
                 f"model {self.name} does not support tools (its template "
                 f"has no .Tools section)")
         system = self.system or ""
@@ -295,22 +303,30 @@ class LoadedModel:
         context_ids = ids
         if images:
             if self.vision is None:
-                raise ValueError(
+                raise BadRequest(
                     f"model {self.name} has no vision projector; it cannot "
                     f"accept images")
             ids, embeds = self.splice_images(ids, images)
         max_new = min(num_predict, self.engine.max_seq - len(ids) - 1)
         if max_new < 1:
-            raise ValueError(
+            raise BadRequest(
                 f"prompt of {len(ids)} tokens leaves no room to generate "
                 f"within the {self.engine.max_seq}-token context")
         constraint = None
         if format is not None and format != "":
             if format == "json" or isinstance(format, dict):
                 from ..ops.constrain import JsonConstraint
+                if isinstance(format, dict) and not _schema_warned[0]:
+                    # schema-constrained decoding isn't implemented; the
+                    # output is valid JSON but NOT guaranteed to conform.
+                    # Warn once per process — not per request on the hot path.
+                    _schema_warned[0] = True
+                    print("warning: format is a JSON schema; constraining "
+                          "to generic JSON only (schema not enforced)",
+                          file=sys.stderr, flush=True)
                 constraint = JsonConstraint.for_tokenizer(self.tokenizer)
             else:
-                raise ValueError(
+                raise BadRequest(
                     f"unsupported format {format!r}; expected \"json\" or "
                     f"a JSON schema object")
         req = self.scheduler.submit(ids, so, max_new,

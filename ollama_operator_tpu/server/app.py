@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import math
 import threading
 import time
 from datetime import datetime, timezone
@@ -40,6 +41,7 @@ from .. import __version__
 from ..gguf.reader import GGUFFile
 from ..gguf.transcode import load_model as transcode_load
 from ..runtime.engine import EngineConfig
+from ..runtime.errors import BadRequest
 from ..runtime.scheduler import SchedulerBroken, SchedulerBusy
 from ..runtime.service import LoadedModel
 from ..tokenizer import Tokenizer
@@ -62,8 +64,11 @@ def _decode_images(images):
     from PIL import Image
     out = []
     for b64 in images:
-        raw = base64.b64decode(b64) if isinstance(b64, str) else bytes(b64)
-        im = Image.open(io.BytesIO(raw)).convert("RGB")
+        try:
+            raw = base64.b64decode(b64) if isinstance(b64, str) else bytes(b64)
+            im = Image.open(io.BytesIO(raw)).convert("RGB")
+        except Exception as e:
+            raise BadRequest(f"invalid image: {e}") from e
         out.append(np.asarray(im, np.uint8))
     return out
 
@@ -91,23 +96,27 @@ def parse_keep_alive(v) -> Optional[float]:
     strings ("5m", "1h30m", "300ms", "-1"). 0 means "unload as soon as
     idle"."""
     if v is None:
-        raise ValueError("keep_alive is None")
+        raise BadRequest("keep_alive is None")
     if isinstance(v, bool):
-        raise ValueError(f"bad keep_alive {v!r}")
+        raise BadRequest(f"bad keep_alive {v!r}")
     if isinstance(v, (int, float)):
+        if not math.isfinite(v):
+            raise BadRequest(f"bad keep_alive {v!r}")
         return None if v < 0 else float(v)
     s = str(v).strip()
     if not s:
-        raise ValueError("empty keep_alive")
+        raise BadRequest("empty keep_alive")
     try:
         n = float(s)
+        if not math.isfinite(n):
+            raise ValueError
         return None if n < 0 else n
     except ValueError:
         pass
     import re
     m = re.fullmatch(r"(-?)((?:\d+(?:\.\d+)?(?:ns|us|µs|ms|s|m|h))+)", s)
     if not m:
-        raise ValueError(f"bad keep_alive {v!r}")
+        raise BadRequest(f"bad keep_alive {v!r}")
     if m.group(1):
         return None
     unit_s = {"ns": 1e-9, "us": 1e-6, "µs": 1e-6, "ms": 1e-3,
@@ -708,9 +717,11 @@ class Handler(BaseHTTPRequestHandler):
             route(body)
         except ApiError as e:
             self._send_error(str(e), e.status)
-        except ValueError as e:
-            # request-validation failures from the service layer (bad
-            # format value, prompt too long, images on a text model, …)
+        except BadRequest as e:
+            # typed request-validation failures from the service layer (bad
+            # format value, prompt too long, images on a text model, …).
+            # Plain ValueError deliberately falls through to the 500 branch:
+            # an internal jax/numpy ValueError is a server bug, not a 400.
             self._send_error(str(e), 400)
         except SchedulerBusy as e:
             self._send_error(str(e), 503)

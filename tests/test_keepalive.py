@@ -28,7 +28,8 @@ def test_parse_keep_alive():
     assert parse_keep_alive("-1") is None
     assert parse_keep_alive("-5m") is None
     assert parse_keep_alive("1.5h") == 5400.0
-    for bad in ("", "abc", "5x", None, True):
+    for bad in ("", "abc", "5x", None, True,
+                "nan", "inf", float("nan"), float("inf")):
         with pytest.raises(ValueError):
             parse_keep_alive(bad)
 

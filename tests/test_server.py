@@ -434,3 +434,21 @@ def test_chat_tools_surface(stack):
     assert r["message"]["role"] == "assistant"
     # random tiny model output is not a tool invocation → plain content
     assert "tool_calls" not in r["message"] or r["message"]["tool_calls"]
+
+
+def test_bad_request_maps_to_400_not_500(stack):
+    """Typed BadRequest from the service layer → 400; malformed options
+    and undecodable images are the client's fault (round-1 advisor:
+    internal ValueErrors must NOT be reclassified as 400s)."""
+    name = _model_name(stack)
+    post(stack["base"], "/api/pull", {"model": name}, stream=True)
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        post(stack["base"], "/api/generate",
+             {"model": name, "prompt": "hi", "stream": False,
+              "options": {"temperature": "hot"}})
+    assert ei.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        post(stack["base"], "/api/generate",
+             {"model": name, "prompt": "hi", "stream": False,
+              "images": ["!!!-not-an-image"]})
+    assert ei.value.code == 400
