@@ -246,7 +246,7 @@ def _residual(cfg: ModelConfig, lp, x, h, attn):
 
 
 def _block_chunk(cfg: ModelConfig, lp, x, cos, sin, mask, scale,
-                 attn_fn=None):
+                 attn_fn=None, mesh=None):
     """One layer over a fresh chunk (no prior cache). Returns
     (x, (k, v)) with K/V head-first [B, KvH, T, hd] — the cache layout.
     ``attn_fn(q, k, v)`` overrides the attention core (the sequence-parallel
@@ -257,7 +257,7 @@ def _block_chunk(cfg: ModelConfig, lp, x, cos, sin, mask, scale,
     k = k.transpose(0, 2, 1, 3)
     v = v.transpose(0, 2, 1, 3)
     if attn_fn is None:
-        attn = chunk_attention(cfg, q, k, v, mask, scale)
+        attn = chunk_attention(cfg, q, k, v, mask, scale, mesh=mesh)
     else:
         attn = attn_fn(q, k, v)
     attn = _proj_out(cfg, lp, attn, B, T)
@@ -266,7 +266,7 @@ def _block_chunk(cfg: ModelConfig, lp, x, cos, sin, mask, scale,
 
 def _block_cached(cfg: ModelConfig, lp, x, cos, sin, k_cache, v_cache,
                   write_pos, mask, scale, attn_fn=None, write_fn=None,
-                  attn_len: Optional[int] = None):
+                  attn_len: Optional[int] = None, mesh=None):
     """One layer with a head-first KV cache [B, KvH, S, hd]. ``write_pos``
     [B, T] are absolute slots for the new tokens' K/V. Returns
     (x, k_cache, v_cache) updated. ``write_fn(kc, vc, k, v, pos)`` /
@@ -291,7 +291,7 @@ def _block_cached(cfg: ModelConfig, lp, x, cos, sin, k_cache, v_cache,
         k_cache, v_cache = write_fn(k_cache, v_cache, k, v, write_pos)
     if attn_fn is None:
         attn = cached_attention(cfg, q, k_cache, v_cache, mask, write_pos,
-                                scale, attn_len=attn_len)
+                                scale, attn_len=attn_len, mesh=mesh)
     else:
         attn = attn_fn(q, k_cache, v_cache, write_pos)
     attn = _proj_out(cfg, lp, attn, B, T)
@@ -326,8 +326,8 @@ def _unembed(cfg: ModelConfig, params: Params, x):
 
 def prefill_chunk(params: Params, cfg: ModelConfig, tokens: jax.Array,
                   n_valid: Optional[jax.Array] = None,
-                  inputs_embeds: Optional[jax.Array] = None
-                  ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+                  inputs_embeds: Optional[jax.Array] = None,
+                  mesh=None) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Process a fresh chunk at positions [0, T) with no prior cache.
 
     tokens  [B, T] int32 (right-padded; padding is masked out of attention by
@@ -353,7 +353,8 @@ def prefill_chunk(params: Params, cfg: ModelConfig, tokens: jax.Array,
         x = _embed(cfg, params, tokens)
 
     def body(x, lp):
-        x, (k, v) = _block_chunk(cfg, lp, x, cos, sin, mask, scale)
+        x, (k, v) = _block_chunk(cfg, lp, x, cos, sin, mask, scale,
+                                 mesh=mesh)
         return x, (k, v)
 
     x, (ks, vs) = lax.scan(body, x, params["layers"])
@@ -364,8 +365,8 @@ def prefill_chunk(params: Params, cfg: ModelConfig, tokens: jax.Array,
 def forward_with_cache(params: Params, cfg: ModelConfig, tokens: jax.Array,
                        k_cache: jax.Array, v_cache: jax.Array,
                        lengths: jax.Array,
-                       attn_len: Optional[int] = None
-                       ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+                       attn_len: Optional[int] = None,
+                       mesh=None) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Extend sequences that already have ``lengths`` cached tokens.
 
     tokens   [B, T] — T=1 is the decode step; T>1 is chunked prefill
@@ -442,7 +443,7 @@ def forward_with_cache(params: Params, cfg: ModelConfig, tokens: jax.Array,
             kwin = window(kc, i, (1, B, KvH, A, hd))
             vwin = window(vc, i, (1, B, KvH, A, hd))
             attn = cached_attention(cfg, q, kwin, vwin, mask, positions,
-                                    scale, attn_len=A)
+                                    scale, attn_len=A, mesh=mesh)
         attn = _proj_out(cfg, lp, attn, B, T)
         x = _residual(cfg, lp, x, h, attn)
         return (x, kc, vc), None

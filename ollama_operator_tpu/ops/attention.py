@@ -93,11 +93,66 @@ def causal_mask(T: int, S: int, offset, dtype=jnp.float32,
 KERNEL_MODES = ("auto", "pallas", "xla", "interpret")
 
 
+def _mesh_attn_axes(mesh, B: int, H: int, KvH: int):
+    """(batch_axis, head_axis) for a dp/tp-manual ``shard_map`` around the
+    attention kernels, or None when this mesh can't shard them evenly.
+
+    pallas_call is opaque to GSPMD — on a real mesh the kernels must run
+    inside a manual region where each device sees only its local heads /
+    batch rows (attention needs no cross-device traffic along dp or tp:
+    heads and batch entries are independent). sp/pp paths wrap attention
+    themselves (parallel/long_context.py, parallel/pipeline.py) and ep
+    meshes stay on the einsum path (MoE attention operands would be
+    GSPMD-auto along ep inside the manual region — untested; einsum is
+    correct there)."""
+    if mesh is None or mesh.size == 1:
+        return None
+    shape = dict(mesh.shape)
+    if (shape.get("sp", 1) > 1 or shape.get("pp", 1) > 1
+            or shape.get("ep", 1) > 1):
+        return None
+    dp, tp = shape.get("dp", 1), shape.get("tp", 1)
+    if dp * tp != mesh.size:
+        return None
+    if B % dp or H % tp or KvH % tp:
+        return None
+    return ("dp" if dp > 1 else None), ("tp" if tp > 1 else None)
+
+
+def _sharded_kernel_call(mesh, q, KvH: int, tileable, inner, args,
+                         with_pos: bool):
+    """Run a pallas attention kernel inside a dp/tp-manual shard_map.
+
+    ``tileable(H_local, KvH_local)`` re-checks the kernel's bail conditions
+    at per-device shapes BEFORE entering the manual region (a mid-trace
+    None-fallback is impossible inside shard_map). Returns the sharded
+    result, or None when the mesh can't shard or the kernel wouldn't tile —
+    callers then fall back to the einsum path (GSPMD-auto). ``args`` are
+    (q, k, v[, pos]) with k/v head-first; ``with_pos`` appends the [B]
+    q_pos spec."""
+    from jax.sharding import PartitionSpec as P
+    B, _, H, _ = q.shape
+    axes = _mesh_attn_axes(mesh, B, H, KvH)
+    if axes is None:
+        return None
+    tp = mesh.shape.get("tp", 1)
+    if not tileable(H // tp, KvH // tp):
+        return None
+    b_ax, h_ax = axes
+    qspec = P(b_ax, None, h_ax, None)
+    kvspec = P(b_ax, h_ax, None, None)
+    in_specs = (qspec, kvspec, kvspec) + ((P(b_ax),) if with_pos else ())
+    return jax.shard_map(inner, mesh=mesh, in_specs=in_specs,
+                         out_specs=qspec, axis_names={"dp", "tp"},
+                         check_vma=False)(*args)
+
+
 def resolve_kernels(kernels: str) -> str:
     """Trace-time kernel choice. ``auto`` → pallas on TPU backends, XLA
     elsewhere. The OLLAMA_TPU_KERNELS env var overrides only the ``auto``
-    choice — an explicit config (e.g. the Engine's multi-device XLA guard,
-    since pallas_call is opaque to GSPMD) always wins."""
+    choice — an explicit config always wins. (On >1-device meshes the
+    dispatchers below run the kernels inside a dp/tp-manual shard_map;
+    there is no multi-device XLA fallback anymore.)"""
     env = os.environ.get("OLLAMA_TPU_KERNELS", "")
     if env:
         if env not in KERNEL_MODES:
@@ -110,24 +165,39 @@ def resolve_kernels(kernels: str) -> str:
     return kernels
 
 
-def chunk_attention(cfg, q, k, v, mask, scale: float):
+def chunk_attention(cfg, q, k, v, mask, scale: float, mesh=None):
     """Prefill attention over a fresh chunk (chunk-local causal semantics,
     the mask callers build via ``causal_mask(T, T, 0)``). K/V are
     head-first [B, KvH, T, hd]. Routes to the pallas flash kernel when
-    enabled and tileable, else the einsum path."""
+    enabled and tileable, else the einsum path. On a >1-device ``mesh``
+    the kernel runs inside a dp/tp-manual shard_map (each device computes
+    its local heads/batch rows; no collectives — attention is independent
+    along both axes), so GSPMD never sees the opaque pallas_call."""
     mode = resolve_kernels(cfg.kernels)
     if mode in ("pallas", "interpret"):
-        from .pallas import flash_prefill
-        out = flash_prefill(q, k, v, scale, cfg.attn_softcap,
-                            cfg.sliding_window,
-                            interpret=(mode == "interpret"))
+        from .pallas import flash_prefill, prefill_tileable
+        interp = mode == "interpret"
+        T, hd = q.shape[1], q.shape[3]
+
+        def inner(q, k, v):
+            return flash_prefill(q, k, v, scale, cfg.attn_softcap,
+                                 cfg.sliding_window, interpret=interp)
+
+        if mesh is not None and mesh.size > 1:
+            out = _sharded_kernel_call(
+                mesh, q, k.shape[1],
+                lambda h, kvh: prefill_tileable(T, h, kvh, hd, interp),
+                inner, (q, k, v), with_pos=False)
+            # None → mesh not shardable/tileable → einsum (GSPMD-auto)
+        else:
+            out = inner(q, k, v)
         if out is not None:
             return out
     return attend_hf(q, k, v, mask, scale, cfg.attn_softcap)
 
 
 def cached_attention(cfg, q, k_cache, v_cache, mask, q_pos, scale: float,
-                     attn_len=None):
+                     attn_len=None, mesh=None):
     """Attention against the head-first slot KV cache [B, KvH, S, hd].
     ``q_pos`` [B, T] are the new tokens' absolute positions (the T=1 decode
     step routes to the pallas kernel, which skips unread cache blocks; T>1
@@ -137,7 +207,8 @@ def cached_attention(cfg, q, k_cache, v_cache, mask, q_pos, scale: float,
     hands this an A-sized window sliced from the full cache carry, so the
     pallas kernel's operand is that window — materialized once per layer
     either way; the kernel's q_pos block clamp still elides unread blocks'
-    DMAs within it."""
+    DMAs within it. On a >1-device ``mesh`` the kernel runs inside a
+    dp/tp-manual shard_map (see chunk_attention)."""
     mode = resolve_kernels(cfg.kernels)
     # MHA (G == 1) maps badly onto the decode kernel's (B, KvH, nk) grid —
     # B×KvH tiny 8-row programs lose to one big XLA einsum (measured on
@@ -148,10 +219,23 @@ def cached_attention(cfg, q, k_cache, v_cache, mask, q_pos, scale: float,
     gqa_ok = q.shape[2] > k_cache.shape[1] or explicit_pallas
     if (mode in ("pallas", "interpret") and q.shape[1] == 1
             and (gqa_ok or mode == "interpret")):
-        from .pallas import decode_attention
-        out = decode_attention(q, k_cache, v_cache, q_pos[:, 0], scale,
-                               cfg.attn_softcap, cfg.sliding_window,
-                               interpret=(mode == "interpret"))
+        from .pallas import decode_attention, decode_tileable
+        interp = mode == "interpret"
+        hd, S = q.shape[3], k_cache.shape[2]
+
+        def inner(q, k_cache, v_cache, pos):
+            return decode_attention(
+                q, k_cache, v_cache, pos, scale, cfg.attn_softcap,
+                cfg.sliding_window, interpret=interp)
+
+        if mesh is not None and mesh.size > 1:
+            out = _sharded_kernel_call(
+                mesh, q, k_cache.shape[1],
+                lambda h, kvh: decode_tileable(S, h, kvh, hd, interp),
+                inner, (q, k_cache, v_cache, q_pos[:, 0]), with_pos=True)
+            # None → mesh not shardable/tileable → einsum (GSPMD-auto)
+        else:
+            out = inner(q, k_cache, v_cache, q_pos[:, 0])
         if out is not None:
             return out
     if attn_len is not None and attn_len < k_cache.shape[2]:

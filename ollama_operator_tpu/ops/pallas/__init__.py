@@ -13,4 +13,5 @@ are Mosaic/Pallas programs tiled for the MXU with fp32 online-softmax
 accumulation.
 """
 
-from .flash import decode_attention, flash_prefill  # noqa: F401
+from .flash import (decode_attention, decode_tileable,  # noqa: F401
+                    flash_prefill, prefill_tileable)

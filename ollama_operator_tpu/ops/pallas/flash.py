@@ -55,6 +55,23 @@ def _lane_ok(hd: int, interpret: bool) -> bool:
     return interpret or hd % 16 == 0
 
 
+def prefill_tileable(T: int, H: int, KvH: int, hd: int, interpret: bool,
+                     block_q: int = 256, block_k: int = 512) -> bool:
+    """True iff flash_prefill will NOT bail for these (possibly
+    device-local) shapes — checked BEFORE entering a shard_map region,
+    where a mid-trace None-fallback is no longer possible."""
+    return (KvH > 0 and H % KvH == 0 and _lane_ok(hd, interpret)
+            and _pick_block(T, block_q) is not None
+            and _pick_block(T, block_k) is not None)
+
+
+def decode_tileable(S: int, H: int, KvH: int, hd: int, interpret: bool,
+                    block_k: int = 512) -> bool:
+    """True iff decode_attention will NOT bail (see prefill_tileable)."""
+    return (KvH > 0 and H % KvH == 0 and _lane_ok(hd, interpret)
+            and _pick_block(S, block_k) is not None)
+
+
 # ---------------------------------------------------------------------------
 # prefill: causal self-attention over a fresh chunk (positions [0, T))
 # ---------------------------------------------------------------------------
@@ -122,12 +139,10 @@ def flash_prefill(q, k, v, scale: float, softcap: float = 0.0,
     """
     B, T, H, hd = q.shape
     KvH = k.shape[1]
-    if H % KvH or not _lane_ok(hd, interpret):
+    if not prefill_tileable(T, H, KvH, hd, interpret, block_q, block_k):
         return None
     bq = _pick_block(T, block_q)
     bk = _pick_block(T, block_k)
-    if bq is None or bk is None:
-        return None
     G = H // KvH
     nq, nk = T // bq, T // bk
     q_hf = q.transpose(0, 2, 1, 3)                            # [B, H, T, hd]
@@ -230,11 +245,9 @@ def decode_attention(q, k_cache, v_cache, q_pos, scale: float,
     """
     B, T, H, hd = q.shape
     KvH, S = k_cache.shape[1], k_cache.shape[2]
-    if T != 1 or H % KvH or not _lane_ok(hd, interpret):
+    if T != 1 or not decode_tileable(S, H, KvH, hd, interpret, block_k):
         return None
     bk = _pick_block(S, block_k)
-    if bk is None:
-        return None
     G = H // KvH
     Gp = max(8, -(-G // 8) * 8)            # pad group to a sublane multiple
     nk = S // bk

@@ -424,9 +424,13 @@ class LoadedModel:
                                        sliding_window=cfg.sliding_window)
                     mask = jnp.broadcast_to(mask, (B, 1, T, T))
 
+                    mesh = self.engine.mesh
+
                     def body(x, lp):
+                        # mesh keeps pallas inside the shard_map dispatch
+                        # on >1-device meshes (GSPMD can't see pallas_call)
                         x, kv = D._block_chunk(cfg, lp, x, cos, sin, mask,
-                                               scale)
+                                               scale, mesh=mesh)
                         return x, None
 
                     x, _ = lax.scan(body, x, params["layers"])
