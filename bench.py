@@ -196,10 +196,14 @@ def main() -> None:
     chunk = int(os.environ.get("BENCH_DECODE_CHUNK", "32"))
     from ollama_operator_tpu.runtime.engine import resolve_cache_dtype
     kv_dtype = resolve_cache_dtype(os.environ.get("BENCH_KV_DTYPE", "int8"))
+    paged = os.environ.get("BENCH_PAGED", "") == "1"
     eng = Engine(cfg, params, mesh=mesh,
-                 ecfg=EngineConfig(max_slots=slots, max_seq_len=seq,
-                                   decode_chunk=chunk,
-                                   cache_dtype=kv_dtype))
+                 ecfg=EngineConfig(
+                     max_slots=slots, max_seq_len=seq, decode_chunk=chunk,
+                     cache_dtype=kv_dtype, paged=paged,
+                     page_size=int(os.environ.get("BENCH_PAGE_SIZE", "64")),
+                     n_pages=int(os.environ.get("BENCH_N_PAGES", "0"))
+                     or None))
 
     rng = np.random.default_rng(0)
     prompts = rng.integers(1, cfg.vocab_size, size=(slots, prompt_len),
@@ -248,6 +252,7 @@ def main() -> None:
         "slots": slots,
         "platform": devs[0].platform,
         "dtype": dtype,
+        "paged": paged,
         "n_devices": len(devs),
     }))
 

@@ -44,7 +44,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..ops import quant as Q
-from ..ops.attention import cached_attention, causal_mask, chunk_attention
+from ..ops.attention import (attend_hf, cached_attention, causal_mask,
+                             chunk_attention)
 from ..ops.norms import layer_norm, rms_norm
 from ..ops.rope import apply_rope, rope_angles
 from .config import ModelConfig
@@ -453,3 +454,216 @@ def forward_with_cache(params: Params, cfg: ModelConfig, tokens: jax.Array,
         (params["layers"], jnp.arange(cfg.n_layers)))
     logits = _unembed(cfg, params, x)
     return logits, k_cache, v_cache
+
+
+# --------------------------------------------------------------------------
+# paged KV cache (block-table page pool) — SURVEY.md §7 hard-part 2
+# --------------------------------------------------------------------------
+#
+# Pool layout [L, P, KvH, ps, hd] (quant: {"q": int8 pool, "s": [L, P,
+# KvH, ps] f32 scales}); a slot's logical block j lives in physical page
+# table[slot, j] (runtime/paged.py owns allocation; page 0 is the trash
+# page for bucket-padding writes — mirrored constant below to avoid a
+# models → runtime import cycle).
+
+TRASH_PAGE = 0
+
+
+def _paged_scatter(pool, i, vals, pg, off):
+    """Write ``vals`` [B, KvH, T(, hd)] into layer ``i`` of a page pool at
+    (page ``pg``, offset ``off``) per (row, position); pg/off [B, T]."""
+    KvH = vals.shape[1]
+    pgx = pg[:, None, :]                      # [B, 1, T]
+    hx = jnp.arange(KvH)[None, :, None]       # [1, KvH, 1]
+    offx = off[:, None, :]
+    return pool.at[i, pgx, hx, offx].set(vals)
+
+
+def _gather_pages(pool, i, tbl):
+    """Layer ``i`` pages ``tbl`` [B, NA] → contiguous logical view
+    [B, KvH, NA*ps(, hd)] (one XLA gather; only attended pages copied)."""
+    pages = pool[i, tbl]                      # [B, NA, KvH, ps(, hd)]
+    if pages.ndim == 5:
+        B, NA, KvH, ps, hd = pages.shape
+        return pages.transpose(0, 2, 1, 3, 4).reshape(B, KvH, NA * ps, hd)
+    B, NA, KvH, ps = pages.shape
+    return pages.transpose(0, 2, 1, 3).reshape(B, KvH, NA * ps)
+
+
+def paged_insert(cfg: ModelConfig, k_pool, v_pool, ks, vs, table_row,
+                 n_valid):
+    """Insert a fresh B=1 prefill chunk (ks/vs [L, 1, KvH, Tb, hd] from
+    ``prefill_chunk``) into pool pages listed by ``table_row`` [NBLK].
+    Positions >= n_valid scatter their garbage to the trash page, so
+    admissions allocate pages only for real tokens."""
+    quant = isinstance(k_pool, dict)
+    arr = k_pool["q"] if quant else k_pool
+    L, P, KvH, ps, hd = arr.shape
+    Tb = ks.shape[3]
+    t = jnp.arange(Tb, dtype=jnp.int32)
+    pg_row = jnp.where(t < n_valid, table_row[t // ps],
+                       jnp.int32(TRASH_PAGE))
+    off = t % ps
+    lx = jnp.arange(L)[:, None, None]
+    hx = jnp.arange(KvH)[None, :, None]
+    pgx = pg_row[None, None, :]
+    offx = off[None, None, :]
+
+    def put(pool, vals):                      # vals [L, KvH, Tb(, hd)]
+        return pool.at[lx, pgx, hx, offx].set(vals)
+
+    if quant:
+        from ..ops import quant_cache as QC
+        kq, ksc = QC.quantize_kv(ks)
+        vq, vsc = QC.quantize_kv(vs)
+        k_pool = {"q": put(k_pool["q"], kq[:, 0]),
+                  "s": put(k_pool["s"], ksc[:, 0])}
+        v_pool = {"q": put(v_pool["q"], vq[:, 0]),
+                  "s": put(v_pool["s"], vsc[:, 0])}
+    else:
+        k_pool = put(k_pool, ks[:, 0].astype(arr.dtype))
+        v_pool = put(v_pool, vs[:, 0].astype(arr.dtype))
+    return k_pool, v_pool
+
+
+def _paged_kernel_usable(cfg: ModelConfig, mesh, T: int, KvH: int, ps: int,
+                         hd: int) -> bool:
+    """Route T=1 paged decode through the pallas kernel? Unlike the dense
+    path there is no MHA bail-out: the gather fallback copies every
+    attended page per step, so the kernel's direct-DMA path wins for MHA
+    too (the dense einsum the old measurement favoured is not available
+    on a paged pool)."""
+    from ..ops.attention import resolve_kernels
+    from ..ops.pallas.flash import _lane_ok
+    mode = resolve_kernels(cfg.kernels)
+    if mode not in ("pallas", "interpret") or T != 1:
+        return False
+    if cfg.n_heads % KvH or ps % 8 or not _lane_ok(hd, mode == "interpret"):
+        return False
+    if mesh is not None and mesh.size > 1:
+        tp = mesh.shape.get("tp", 1)
+        if tp * 1 != mesh.size:            # engine enforces tp-only meshes
+            return False
+        if cfg.n_heads % tp or KvH % tp:
+            return False
+    return True
+
+
+def _paged_attend(cfg: ModelConfig, q, kp, vp, i, tables, lengths, mask,
+                  scale, attn_blocks: int, mesh, use_kernel: bool):
+    """Attention for one layer of the paged forward: pallas kernel with
+    block-table scalar prefetch (T=1), else gather + einsum."""
+    quant = isinstance(kp, dict)
+    if use_kernel:
+        from ..ops.attention import resolve_kernels
+        from ..ops.pallas.paged import paged_decode_attention
+        interp = resolve_kernels(cfg.kernels) == "interpret"
+        if mesh is not None and mesh.size > 1:
+            from jax.sharding import PartitionSpec as P
+            pool_spec = P(None, None, "tp", None, None)
+            pool_specs = ({"q": pool_spec, "s": P(None, None, "tp", None)}
+                          if quant else pool_spec)
+            qspec = P(None, None, "tp", None)
+
+            def inner(q, kp, vp, i, tables, lengths):
+                return paged_decode_attention(
+                    q, kp, vp, i, tables, lengths, scale, cfg.attn_softcap,
+                    cfg.sliding_window, nblk=attn_blocks, interpret=interp)
+
+            out = jax.shard_map(
+                inner, mesh=mesh,
+                in_specs=(qspec, pool_specs, pool_specs, P(), P(None, None),
+                          P(None)),
+                out_specs=qspec, axis_names={"tp"},
+                check_vma=False)(q, kp, vp, i, tables, lengths)
+        else:
+            out = paged_decode_attention(
+                q, kp, vp, i, tables, lengths, scale, cfg.attn_softcap,
+                cfg.sliding_window, nblk=attn_blocks, interpret=interp)
+        if out is not None:
+            return out
+    tbl = tables[:, :attn_blocks]
+    if quant:
+        from ..ops.quant_cache import attend_hf_q
+        kw = {"q": _gather_pages(kp["q"], i, tbl),
+              "s": _gather_pages(kp["s"], i, tbl)}
+        vw = {"q": _gather_pages(vp["q"], i, tbl),
+              "s": _gather_pages(vp["s"], i, tbl)}
+        return attend_hf_q(q, kw, vw, mask, scale, cfg.attn_softcap)
+    kw = _gather_pages(kp, i, tbl)
+    vw = _gather_pages(vp, i, tbl)
+    return attend_hf(q, kw, vw, mask, scale, cfg.attn_softcap)
+
+
+def forward_with_cache_paged(params: Params, cfg: ModelConfig,
+                             tokens: jax.Array, k_pool, v_pool,
+                             tables: jax.Array, lengths: jax.Array,
+                             attn_blocks: int, mesh=None):
+    """Paged twin of ``forward_with_cache``.
+
+    tokens   [B, T] — T=1 decode (pallas kernel path), T>1 extend tails
+             (gathered einsum path; B=1 there).
+    tables   [B, NBLK] int32 physical page per logical block.
+    lengths  [B] int32 cached tokens per row; new token t of row b is
+             written to page tables[b, (lengths[b]+t)//ps].
+    attn_blocks — static width: blocks attended/gathered (bucket // ps).
+    Returns (logits [B, T, V], k_pool, v_pool).
+    """
+    quant = isinstance(k_pool, dict)
+    k_arr = k_pool["q"] if quant else k_pool
+    L, P, KvH, ps, hd = k_arr.shape
+    B, T = tokens.shape
+    scale = 1.0 / math.sqrt(cfg.head_dim)
+    positions = lengths[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+    cos, sin = rope_angles(positions, cfg.rotary_dim, cfg.rope_theta,
+                           cfg.rope_scaling)
+    S_attn = attn_blocks * ps
+    k_pos = jnp.arange(S_attn, dtype=jnp.int32)[None, None, :]
+    q_pos = positions[:, :, None]
+    ok = k_pos <= q_pos
+    if cfg.sliding_window:
+        ok = ok & (k_pos > q_pos - cfg.sliding_window)
+    mask = jnp.where(ok, 0.0, -1e30).astype(jnp.float32)[:, None, :, :]
+
+    x = _embed(cfg, params, tokens)
+    bi = jnp.arange(B)[:, None]
+    # out-of-table blocks (a slot over-running max_seq) redirect to the
+    # trash page — never clamp into the slot's LAST live page, which
+    # would corrupt resident prefix K/V
+    blk_w = positions // ps
+    NBLK = tables.shape[1]
+    pg_w = jnp.where(blk_w < NBLK,
+                     tables[bi, jnp.minimum(blk_w, NBLK - 1)],
+                     jnp.int32(TRASH_PAGE))
+    off_w = positions % ps
+    use_kernel = _paged_kernel_usable(cfg, mesh, T, KvH, ps, hd)
+
+    def body(carry, layer_in):
+        x, kp, vp = carry
+        lp, i = layer_in
+        h = _norm(cfg, x, lp["attn_norm_w"], lp.get("attn_norm_b"))
+        q, k, v = _qkv(cfg, lp, h, cos, sin)
+        k = k.transpose(0, 2, 1, 3)           # [B, KvH, T, hd]
+        v = v.transpose(0, 2, 1, 3)
+        if quant:
+            from ..ops import quant_cache as QC
+            kq, ksc = QC.quantize_kv(k)
+            vq, vsc = QC.quantize_kv(v)
+            kp = {"q": _paged_scatter(kp["q"], i, kq, pg_w, off_w),
+                  "s": _paged_scatter(kp["s"], i, ksc, pg_w, off_w)}
+            vp = {"q": _paged_scatter(vp["q"], i, vq, pg_w, off_w),
+                  "s": _paged_scatter(vp["s"], i, vsc, pg_w, off_w)}
+        else:
+            kp = _paged_scatter(kp, i, k.astype(k_arr.dtype), pg_w, off_w)
+            vp = _paged_scatter(vp, i, v.astype(k_arr.dtype), pg_w, off_w)
+        attn = _paged_attend(cfg, q, kp, vp, i, tables, lengths, mask,
+                             scale, attn_blocks, mesh, use_kernel)
+        attn = _proj_out(cfg, lp, attn, B, T)
+        x = _residual(cfg, lp, x, h, attn)
+        return (x, kp, vp), None
+
+    (x, k_pool, v_pool), _ = lax.scan(
+        body, (x, k_pool, v_pool),
+        (params["layers"], jnp.arange(cfg.n_layers)))
+    logits = _unembed(cfg, params, x)
+    return logits, k_pool, v_pool

@@ -58,6 +58,16 @@ class EngineConfig:
     # remote-TPU tunnel; nonzero everywhere) amortises across the chunk.
     # Streaming granularity and admission latency grow with it.
     decode_chunk: int = 8
+    # paged KV cache (runtime/paged.py + ops/pallas/paged.py): slots share
+    # a physical page pool instead of each reserving max_seq_len — HBM
+    # scales with live tokens, so max_slots can be 32+ on one chip
+    # (SURVEY.md §7 hard-part 2). Single-device or tp-only meshes.
+    paged: bool = False
+    page_size: int = 64
+    # data pages in the pool (excl. the trash page); None = the dense
+    # equivalent max_slots * max_seq_len / page_size — same HBM ceiling,
+    # but shared, so mixed-length batches fit far more concurrency
+    n_pages: Optional[int] = None
 
 
 CACHE_DTYPES = {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
@@ -150,6 +160,21 @@ class Engine:
                 f"are; each bucket must shard evenly over sp)")
             assert S % self.sp_size == 0, (
                 f"max_seq_len {S} must be divisible by sp={self.sp_size}")
+        self.paged = ecfg.paged
+        if self.paged:
+            assert self.sp_size == 1, (
+                "paged cache: sp meshes keep the dense sequence-sharded "
+                "cache (long_context.py)")
+            if mesh is not None:
+                extra = {ax: sz for ax, sz in dict(mesh.shape).items()
+                         if sz > 1 and ax != "tp"}
+                assert not extra, (
+                    f"paged cache supports single-device or tp-only "
+                    f"meshes; got {extra}")
+            ps = ecfg.page_size
+            assert ps > 0 and ps & (ps - 1) == 0, (
+                f"page_size {ps} must be a power of two")
+            assert S % ps == 0, f"max_seq_len {S} must be divisible by page_size {ps}"
         if mesh is not None:
             dp = mesh.shape.get("dp", 1)
             assert B % dp == 0, f"max_slots {B} must divide dp {dp}"
@@ -167,8 +192,37 @@ class Engine:
             arr = jnp.zeros(shape, dtype)
             return jax.device_put(arr, sh) if sh is not None else arr
 
-        cache_shape = (L, B, KvH, S, hd)  # head-first: (S, hd) tiles
-        if self.quant_cache:
+        if self.paged:
+            from .paged import PageTable
+            ps = ecfg.page_size
+            self._nblk = S // ps
+            n_pages = ecfg.n_pages or (B * S) // ps
+            self._pt = PageTable(B, n_pages + 1, ps, self._nblk)
+            pool_shape = (L, n_pages + 1, KvH, ps, hd)
+            h_ax = ("tp" if (mesh is not None
+                             and mesh.shape.get("tp", 1) > 1
+                             and KvH % mesh.shape["tp"] == 0) else None)
+            pool_sh = (NamedSharding(mesh, P(None, None, h_ax, None, None))
+                       if mesh is not None else None)
+            if self.quant_cache:
+                s_sh = (NamedSharding(mesh, P(None, None, h_ax, None))
+                        if mesh is not None else None)
+                cache_sh = {"q": pool_sh, "s": s_sh}
+                self.k_cache = {
+                    "q": zeros(pool_shape, jnp.int8, pool_sh),
+                    "s": zeros(pool_shape[:-1], jnp.float32, s_sh)}
+                self.v_cache = {
+                    "q": zeros(pool_shape, jnp.int8, pool_sh),
+                    "s": zeros(pool_shape[:-1], jnp.float32, s_sh)}
+            else:
+                cache_sh = pool_sh
+                self.k_cache = zeros(pool_shape, ecfg.cache_dtype, pool_sh)
+                self.v_cache = zeros(pool_shape, ecfg.cache_dtype, pool_sh)
+            self._cache_sh = cache_sh
+            # admission-order stamps for preemption victim choice
+            self._admit_order = np.zeros((B,), np.int64)
+            self._admit_seq = 0
+        elif self.quant_cache:
             from ..ops.quant_cache import empty_cache
 
             def qzeros(sh):
@@ -179,6 +233,7 @@ class Engine:
             self.k_cache = qzeros(cache_sh)
             self.v_cache = qzeros(cache_sh)
         else:
+            cache_shape = (L, B, KvH, S, hd)  # head-first: (S, hd) tiles
             self.k_cache = zeros(cache_shape, ecfg.cache_dtype, cache_sh)
             self.v_cache = zeros(cache_shape, ecfg.cache_dtype, cache_sh)
         self.lengths = zeros((B,), jnp.int32, slot_sh)
@@ -294,7 +349,8 @@ class Engine:
 
         def _insert_prefilled(k_cache, v_cache, lengths, counts,
                               last_tokens, pring, logits, ks, vs, tokens,
-                              slot, n_valid, sp_row, key, mask_row, cflag):
+                              slot, n_valid, sp_row, key, mask_row, cflag,
+                              table_row=None):
             """Fresh-prefill admission: build the penalty window from the
             LAST repeat_last_n prompt tokens of the device-side chunk
             (image pad positions carry id == vocab_size, which the
@@ -318,7 +374,10 @@ class Engine:
             (tok, lengths, counts, last_tokens, pring) = _sample_install(
                 lengths, counts, last_tokens, pring, last, ring_row,
                 counts_row, slot, n_valid, sp_row, key, mask_row, cflag)
-            if self.quant_cache:
+            if self.paged:
+                k_cache, v_cache = decoder.paged_insert(
+                    cfg, k_cache, v_cache, ks, vs, table_row, n_valid)
+            elif self.quant_cache:
                 from ..ops.quant_cache import quantize_kv
                 kq, ksc = quantize_kv(ks)          # [L,1,KvH,T,hd]
                 vq, vsc = quantize_kv(vs)
@@ -338,19 +397,20 @@ class Engine:
         @partial(jax.jit, donate_argnums=(1, 2, 3, 4, 5, 6))
         def _admit(params, k_cache, v_cache, lengths, counts, last_tokens,
                    pring, tokens, slot, n_valid, sp_row, key, mask_row,
-                   cflag):
+                   cflag, table_row=None):
             """Prefill a padded B=1 chunk AND insert it into the slot state
-            — one device program, one host round-trip per admission."""
+            — one device program, one host round-trip per admission.
+            ``table_row`` [NBLK] — the slot's block table (paged mode)."""
             logits, ks, vs = prefill_impl(params, tokens=tokens)
             return _insert_prefilled(k_cache, v_cache, lengths, counts,
                                      last_tokens, pring, logits, ks, vs,
                                      tokens, slot, n_valid, sp_row, key,
-                                     mask_row, cflag)
+                                     mask_row, cflag, table_row)
 
         @partial(jax.jit, donate_argnums=(1, 2, 3, 4, 5, 6))
         def _admit_embeds(params, k_cache, v_cache, lengths, counts,
                           last_tokens, pring, tokens, embeds, slot, n_valid,
-                          sp_row, key, mask_row, cflag):
+                          sp_row, key, mask_row, cflag, table_row=None):
             """Multimodal admission: like _admit but prefilling from a
             precomputed [1, T, D] embedding sequence (image tokens spliced
             into text embeddings); ``tokens`` feeds the penalty counts with
@@ -361,16 +421,24 @@ class Engine:
             return _insert_prefilled(k_cache, v_cache, lengths, counts,
                                      last_tokens, pring, logits, ks, vs,
                                      tokens, slot, n_valid, sp_row, key,
-                                     mask_row, cflag)
+                                     mask_row, cflag, table_row)
 
         def _decode_body(params, k_cache, v_cache, lengths, counts,
                          last_tokens, pring, sp, keys, active, mask_bits,
-                         constrained, attn_len=None):
-            kw = {"attn_len": attn_len} if (attn_len is not None
-                                            and self._bucketed_attn) else {}
-            logits, k_cache, v_cache = step_impl(
-                params, tokens=last_tokens[:, None], k_cache=k_cache,
-                v_cache=v_cache, lengths=lengths, **kw)
+                         constrained, attn_len=None, tables=None):
+            if self.paged:
+                ps = self.ecfg.page_size
+                nblk = -(-(attn_len or self.max_seq) // ps)
+                logits, k_cache, v_cache = decoder.forward_with_cache_paged(
+                    params, cfg, last_tokens[:, None], k_cache, v_cache,
+                    tables, lengths, nblk, mesh=self.mesh)
+            else:
+                kw = {"attn_len": attn_len} if (attn_len is not None
+                                                and self._bucketed_attn) \
+                    else {}
+                logits, k_cache, v_cache = step_impl(
+                    params, tokens=last_tokens[:, None], k_cache=k_cache,
+                    v_cache=v_cache, lengths=lengths, **kw)
             step_keys = jax.vmap(jax.random.fold_in)(keys, lengths)
             last = logits[:, 0]
             allowed = unpack_mask(mask_bits, cfg.vocab_size)
@@ -398,11 +466,13 @@ class Engine:
 
         @partial(jax.jit, donate_argnums=(1, 2, 3, 4, 5, 6, 8))
         def _decode(params, k_cache, v_cache, lengths, counts, last_tokens,
-                    pring, sp, keys, active, mask_bits, constrained):
+                    pring, sp, keys, active, mask_bits, constrained,
+                    tables=None):
             (toks, k_cache, v_cache, lengths, counts, last_tokens,
              pring) = _decode_body(params, k_cache, v_cache, lengths,
                                    counts, last_tokens, pring, sp, keys,
-                                   active, mask_bits, constrained)
+                                   active, mask_bits, constrained,
+                                   tables=tables)
             return (toks, k_cache, v_cache, lengths, counts, last_tokens,
                     pring, keys)
 
@@ -410,13 +480,15 @@ class Engine:
                  donate_argnums=(1, 2, 3, 4, 5, 6, 8))
         def _decode_n(params, k_cache, v_cache, lengths, counts, last_tokens,
                       pring, sp, keys, active, mask_bits, constrained, n,
-                      attn_len):
+                      attn_len, tables=None):
             """n decode steps as ONE device program (lax.scan) — a single
             dispatch + host sync per n tokens per slot. ``attn_len`` is the
             static attended-cache prefix (decode traffic scales with it,
-            not with max_seq_len). The grammar mask is static across the
-            chunk — the scheduler drops to n=1 while any slot is
-            constrained."""
+            not with max_seq_len; in paged mode it only bounds the kernel
+            grid — page DMAs clamp to each slot's own length). The grammar
+            mask is static across the chunk — the scheduler drops to n=1
+            while any slot is constrained. ``tables`` [B, NBLK] (paged):
+            the host grows them to cover lengths + n before dispatch."""
             def step(carry, _):
                 (k_cache, v_cache, lengths, counts, last_tokens,
                  pring) = carry
@@ -424,7 +496,8 @@ class Engine:
                  pring) = _decode_body(params, k_cache, v_cache,
                                        lengths, counts, last_tokens, pring,
                                        sp, keys, active, mask_bits,
-                                       constrained, attn_len=attn_len)
+                                       constrained, attn_len=attn_len,
+                                       tables=tables)
                 return (k_cache, v_cache, lengths, counts, last_tokens,
                         pring), toks
 
@@ -433,6 +506,30 @@ class Engine:
             (k_cache, v_cache, lengths, counts, last_tokens, pring) = carry
             return (toks_n, k_cache, v_cache, lengths, counts, last_tokens,
                     pring, keys)
+
+        @partial(jax.jit, donate_argnums=(1, 2, 3, 4, 5, 6))
+        def _extend_paged(params, k_cache, v_cache, lengths, counts,
+                          last_tokens, pring, tokens, ring_row, counts_row,
+                          slot, start, n_new, table_row, sp_row, key,
+                          mask_row, cflag):
+            """Paged prefix-cache continuation: the reused prefix stays in
+            its pages untouched; the tail prefills through the paged
+            forward (B=1 view, positions offset by ``start``), writing
+            into pages from ``table_row`` — no cache slice/unslice copies,
+            and quantized pools work the same (the paged forward
+            quantizes fresh K/V per layer). Tail bucket-padding beyond
+            n_new lands on unowned table entries, i.e. the trash page."""
+            logits, k_cache, v_cache = decoder.forward_with_cache_paged(
+                params, cfg, tokens, k_cache, v_cache, table_row[None],
+                start[None], self._nblk, mesh=self.mesh)
+            last = jax.lax.dynamic_index_in_dim(
+                logits[0], n_new - 1, axis=0, keepdims=False)
+            (tok, lengths, counts, last_tokens, pring) = _sample_install(
+                lengths, counts, last_tokens, pring, last, ring_row,
+                counts_row, slot, start + n_new, sp_row, key, mask_row,
+                cflag)
+            return (tok, *pin(k_cache, v_cache, lengths, counts,
+                              last_tokens), pring)
 
         @partial(jax.jit, donate_argnums=(1, 2, 3, 4, 5, 6))
         def _extend(params, k_cache, v_cache, lengths, counts, last_tokens,
@@ -491,7 +588,7 @@ class Engine:
         self._admit_fn = _admit
         self._admit_embeds_fn = _admit_embeds
         self._admit_execs: Dict[int, Any] = {}
-        self._extend_fn = _extend
+        self._extend_fn = _extend_paged if self.paged else _extend
         self._extend_execs: Dict[int, Any] = {}
         self._decode_fn = _decode
         self._decode_n_fn = _decode_n
@@ -557,6 +654,9 @@ class Engine:
         self.active[slot] = True
         self._host_lengths[slot] = n_total
         self._opts[slot] = opts
+        if self.paged:
+            self._admit_seq += 1
+            self._admit_order[slot] = self._admit_seq
         self._rebuild_sp()
         self._active_dev = jnp.asarray(self.active.astype(np.int32))
 
@@ -583,6 +683,7 @@ class Engine:
         tokens = np.zeros((1, bucket), np.int32)
         tokens[0, :n] = prompt
         key, mrow, cflag = self._prep_slot(slot, opts, n, mask_row)
+        table_row = self._grow_for_admit(slot, n)
         if embeds is not None:
             assert embeds.shape[0] == n, "embeds must cover the prompt"
             if self.sp_size > 1:
@@ -595,22 +696,46 @@ class Engine:
                 self.params, self.k_cache, self.v_cache, self.lengths,
                 self.counts, self.last_tokens, self.pring,
                 jnp.asarray(tokens), jnp.asarray(emb), jnp.int32(slot),
-                jnp.int32(n), self._sp_row(opts), key, mrow, cflag)
+                jnp.int32(n), self._sp_row(opts), key, mrow, cflag,
+                table_row)
         else:
             (tok, self.k_cache, self.v_cache, self.lengths, self.counts,
              self.last_tokens, self.pring) = self._admit_exec(bucket)(
                 self.params, self.k_cache, self.v_cache, self.lengths,
                 self.counts, self.last_tokens, self.pring,
                 jnp.asarray(tokens), jnp.int32(slot), jnp.int32(n),
-                self._sp_row(opts), key, mrow, cflag)
+                self._sp_row(opts), key, mrow, cflag, table_row)
         self._commit_slot(slot, n, opts)
         return int(tok)
 
+    def _grow_for_admit(self, slot: int, n: int):
+        """Paged admission bookkeeping: drop any pages the slot still owns
+        (a parked prefix being overwritten), allocate pages for the prompt,
+        return the device table row. None in dense mode."""
+        if not self.paged:
+            return None
+        from .paged import PagesExhausted
+        self._pt.release(slot)
+        # availability check includes one decode chunk of headroom (not
+        # allocated — prepare_decode claims it): admitting a request the
+        # very next chunk must preempt would thrash prefill work
+        ahead = min(n + self.ecfg.decode_chunk, self.max_seq)
+        if self._pt.blocks_for(ahead) > self._pt.n_free:
+            raise PagesExhausted(
+                f"prompt of {n} tokens (+1 chunk headroom) needs "
+                f"{self._pt.blocks_for(ahead)} pages; "
+                f"{self._pt.n_free} free")
+        self._pt.grow(slot, n)
+        return jnp.asarray(self._pt.tables[slot])
+
     @property
     def supports_extend(self) -> bool:
-        """Prefix-cache continuation works on the dense bucketed cache
-        (quant int8 caches and sp sequence-sharded caches would need their
-        own slice/write variants)."""
+        """Prefix-cache continuation: any paged pool (incl. int8 — the
+        paged forward quantizes the tail in place), or the dense bucketed
+        bf16/f32 cache. Dense int8 and sp sequence-sharded caches would
+        need their own slice/write variants."""
+        if self.paged:
+            return True
         return not self.quant_cache and self.sp_size == 1
 
     def _extend_exec(self, bucket: int):
@@ -618,14 +743,16 @@ class Engine:
         if exe is None:
             tokens = jnp.zeros((1, bucket), jnp.int32)
             W = max(1, self.ecfg.repeat_last_n)
-            exe = self._extend_fn.lower(
-                self.params, self.k_cache, self.v_cache, self.lengths,
-                self.counts, self.last_tokens, self.pring, tokens,
-                jnp.zeros((W,), jnp.int32), jnp.zeros(
-                    (self.cfg.vocab_size,), jnp.int32),
-                jnp.int32(0), jnp.int32(1), jnp.int32(1),
-                self._sp_row(SlotOptions()), jax.random.key(0),
-                self._mask_ones, jnp.int32(0)).compile()
+            args = [self.params, self.k_cache, self.v_cache, self.lengths,
+                    self.counts, self.last_tokens, self.pring, tokens,
+                    jnp.zeros((W,), jnp.int32), jnp.zeros(
+                        (self.cfg.vocab_size,), jnp.int32),
+                    jnp.int32(0), jnp.int32(1), jnp.int32(1)]
+            if self.paged:
+                args.append(jnp.zeros((self._nblk,), jnp.int32))
+            args += [self._sp_row(SlotOptions()), jax.random.key(0),
+                     self._mask_ones, jnp.int32(0)]
+            exe = self._extend_fn.lower(*args).compile()
             self._extend_execs[bucket] = exe
         return exe
 
@@ -639,7 +766,7 @@ class Engine:
         ids share that prefix — stale entries at positions >= start are
         never attended: masking is position-based and the tail overwrites
         them)."""
-        assert self.supports_extend, "extend() on quant/sp cache"
+        assert self.supports_extend, "extend() on dense-quant/sp cache"
         assert not self.active[slot], f"slot {slot} busy"
         full_ids = np.asarray(full_ids, np.int32)
         n_total = int(full_ids.shape[0])
@@ -649,6 +776,11 @@ class Engine:
             raise ValueError(f"prompt too long: {n_total} >= {self.max_seq}")
         bucket = self.bucket_for(n_new)
         if start + bucket > self.max_seq:
+            # tail positions run to start+bucket: dense writes there
+            # directly; paged padding past the table would clamp into the
+            # slot's LAST live page and corrupt the prefix (the forward
+            # also trash-redirects out-of-table blocks as a second line
+            # of defence)
             raise ValueError(
                 f"tail bucket {bucket} does not fit above {start}")
         tokens = np.zeros((1, bucket), np.int32)
@@ -664,13 +796,24 @@ class Engine:
         counts_row = np.zeros((V,), np.int32)
         np.add.at(counts_row, window, 1)
         key, mrow, cflag = self._prep_slot(slot, opts, n_total, mask_row)
+        args = [self.params, self.k_cache, self.v_cache, self.lengths,
+                self.counts, self.last_tokens, self.pring,
+                jnp.asarray(tokens), jnp.asarray(ring),
+                jnp.asarray(counts_row), jnp.int32(slot), jnp.int32(start),
+                jnp.int32(n_new)]
+        if self.paged:
+            from .paged import PagesExhausted
+            ahead = min(n_total + self.ecfg.decode_chunk, self.max_seq)
+            deficit = (self._pt.blocks_for(ahead)
+                       - self._pt.owned_blocks(slot))
+            if deficit > self._pt.n_free or not self._pt.grow(slot, n_total):
+                raise PagesExhausted(
+                    f"extend to {n_total} tokens (+1 chunk headroom): "
+                    f"{self._pt.n_free} pages free")
+            args.append(jnp.asarray(self._pt.tables[slot]))
+        args += [self._sp_row(opts), key, mrow, cflag]
         (tok, self.k_cache, self.v_cache, self.lengths, self.counts,
-         self.last_tokens, self.pring) = self._extend_exec(bucket)(
-            self.params, self.k_cache, self.v_cache, self.lengths,
-            self.counts, self.last_tokens, self.pring,
-            jnp.asarray(tokens), jnp.asarray(ring), jnp.asarray(counts_row),
-            jnp.int32(slot), jnp.int32(start), jnp.int32(n_new),
-            self._sp_row(opts), key, mrow, cflag)
+         self.last_tokens, self.pring) = self._extend_exec(bucket)(*args)
         self._commit_slot(slot, n_total, opts)
         return int(tok)
 
@@ -719,14 +862,23 @@ class Engine:
     def any_constrained(self) -> bool:
         return bool(self._constrained.any())
 
+    def _tables_dev(self):
+        return jnp.asarray(self._pt.tables) if self.paged else None
+
     def decode(self) -> np.ndarray:
         """One decode step for every slot; returns sampled tokens [B] (only
         entries where self.active were valid at call time)."""
+        if self.paged:
+            victims = self.prepare_decode(1)
+            if victims:
+                from .paged import PagesExhausted
+                raise PagesExhausted(f"pool dry; victims {victims}")
         (toks, self.k_cache, self.v_cache, self.lengths, self.counts,
          self.last_tokens, self.pring, self.keys) = self._decode_fn(
             self.params, self.k_cache, self.v_cache, self.lengths,
             self.counts, self.last_tokens, self.pring, self.sp, self.keys,
-            self._active_dev, self.mask_bits, self._constr_dev)
+            self._active_dev, self.mask_bits, self._constr_dev,
+            self._tables_dev())
         self._host_lengths[self.active] += 1
         return np.asarray(toks)
 
@@ -738,7 +890,8 @@ class Engine:
                 self.params, self.k_cache, self.v_cache, self.lengths,
                 self.counts, self.last_tokens, self.pring, self.sp,
                 self.keys, self._active_dev, self.mask_bits,
-                self._constr_dev, n, attn_len).compile()
+                self._constr_dev, n, attn_len,
+                self._tables_dev()).compile()
             self._decode_execs[key] = exe
         return exe
 
@@ -746,12 +899,14 @@ class Engine:
         exe = self._admit_execs.get(bucket)
         if exe is None:
             tokens = jnp.zeros((1, bucket), jnp.int32)
+            table_row = (jnp.zeros((self._nblk,), jnp.int32)
+                         if self.paged else None)
             exe = self._admit_fn.lower(
                 self.params, self.k_cache, self.v_cache, self.lengths,
                 self.counts, self.last_tokens, self.pring, tokens,
                 jnp.int32(0), jnp.int32(1),
                 self._sp_row(SlotOptions()), jax.random.key(0),
-                self._mask_ones, jnp.int32(0)).compile()
+                self._mask_ones, jnp.int32(0), table_row).compile()
             self._admit_execs[bucket] = exe
         return exe
 
@@ -778,20 +933,68 @@ class Engine:
                 if b < self.max_seq:
                     self._extend_exec(b)
 
+    def prepare_decode(self, n: Optional[int] = None) -> list:
+        """Paged mode: grow every active slot's block table to cover
+        lengths + n upcoming tokens (pages must exist BEFORE the chunk —
+        steps advance device-side with no host round-trip). Grows in
+        admission order, so when the pool runs dry the NEWEST slots fail;
+        returns them (newest first) for the scheduler to preempt/requeue.
+        Engine state is untouched for victims. [] in dense mode."""
+        if not self.paged:
+            return []
+        n = n or self.ecfg.decode_chunk
+        order = sorted((s for s in range(self.n_slots) if self.active[s]),
+                       key=lambda s: self._admit_order[s])
+        # clamp at max_seq: a slot finishing its context within the chunk
+        # over-decodes into its last page (same as the dense cache's
+        # over-decode-then-release semantics), never past the table
+        victims = [s for s in order
+                   if not self._pt.grow(
+                       s, min(int(self._host_lengths[s]) + n, self.max_seq))]
+        victims.reverse()
+        return victims
+
+    def admissible(self, n_tokens: int) -> bool:
+        """Could a prompt of n_tokens EVER be admitted (whole pool free)?
+        Dense mode always True — length limits are checked elsewhere."""
+        if not self.paged:
+            return True
+        ahead = min(n_tokens + self.ecfg.decode_chunk, self.max_seq)
+        return self._pt.blocks_for(ahead) <= self._pt.n_pages - 1
+
+    def free_slot_pages(self, slot: int):
+        """Drop a PARKED (inactive) slot's pages back to the pool — the
+        scheduler evicts prefix caches with this when admissions or decode
+        growth run out of pages."""
+        if self.paged:
+            assert not self.active[slot], "freeing pages of an active slot"
+            self._pt.release(slot)
+
+    @property
+    def free_pages(self) -> int:
+        return self._pt.n_free if self.paged else -1
+
     def decode_n(self, n: Optional[int] = None) -> np.ndarray:
         """n decode steps in one device program; returns tokens [n, B].
 
         One dispatch + one host sync per call — the per-step host
         round-trip (expensive under a remote-TPU tunnel) amortises over
         the chunk. Chunk semantics are identical to n decode() calls.
-        """
+        Paged mode: callers that want preemption-on-pool-dry run
+        ``prepare_decode`` themselves first and requeue the victims; here
+        a dry pool raises (tests/bench size their pools adequately)."""
         n = n or self.ecfg.decode_chunk
+        victims = self.prepare_decode(n)
+        if victims:
+            from .paged import PagesExhausted
+            raise PagesExhausted(f"pool dry; victims {victims}")
         exe = self._decode_n_exec(n, self._attn_bucket(n))
         (toks_n, self.k_cache, self.v_cache, self.lengths, self.counts,
          self.last_tokens, self.pring, self.keys) = exe(
             self.params, self.k_cache, self.v_cache, self.lengths,
             self.counts, self.last_tokens, self.pring, self.sp, self.keys,
-            self._active_dev, self.mask_bits, self._constr_dev)
+            self._active_dev, self.mask_bits, self._constr_dev,
+            self._tables_dev())
         self._host_lengths[self.active] += n
         return np.asarray(toks_n)
 
@@ -804,7 +1007,12 @@ class Engine:
         self._opts.pop(slot, None)
         self._active_dev = jnp.asarray(self.active.astype(np.int32))
         if park and self.supports_extend:
+            # paged: the parked prefix keeps its pages until an admit
+            # overwrites the slot or the scheduler evicts via
+            # free_slot_pages under pool pressure
             return
+        if self.paged:
+            self._pt.release(slot)
         self._host_lengths[slot] = 0
         (self.lengths, self.counts, self.last_tokens,
          self.pring) = self._release_fn(

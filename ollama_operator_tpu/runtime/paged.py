@@ -1,0 +1,84 @@
+"""Host-side page accounting for the paged KV cache.
+
+The device side is a physical page pool ``[L, P, KvH, page_size, hd]``
+(``models/decoder.forward_with_cache_paged`` + the pallas kernel in
+``ops/pallas/paged.py``); this module owns which physical page backs which
+logical block of which slot. Pure host bookkeeping — numpy block tables are
+uploaded per dispatch (a few KB), never read back.
+
+Page 0 is the **trash page**: bucket-padding positions beyond a prompt's
+valid length scatter their garbage K/V there, so admissions only allocate
+pages for real tokens and no masking depends on page contents.
+
+Design notes vs the reference: llama.cpp's unified KV cell pool inside the
+delegated `ollama/ollama` image plays this role
+(/root/reference/pkg/model/pod.go:11); here the allocator is explicit so
+the engine can admit many more concurrent slots than dense max_slots ×
+max_seq_len HBM would allow, and preempt (victim-select) when the pool
+runs dry (SURVEY.md §7 hard-part 2).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+TRASH_PAGE = 0
+
+
+class PagesExhausted(RuntimeError):
+    """No free pages for the requested allocation (caller may preempt)."""
+
+
+class PageTable:
+    """Block tables + free-list for ``n_slots`` sequences over ``n_pages``
+    physical pages of ``page_size`` tokens (page 0 reserved as trash)."""
+
+    def __init__(self, n_slots: int, n_pages: int, page_size: int,
+                 max_blocks: int):
+        assert n_pages > 1, "need at least one non-trash page"
+        self.page_size = page_size
+        self.n_pages = n_pages
+        self.max_blocks = max_blocks
+        # LIFO free list → recently-freed pages are reused first (warm HBM)
+        self._free: List[int] = list(range(n_pages - 1, TRASH_PAGE, -1))
+        self._owned: Dict[int, List[int]] = {s: [] for s in range(n_slots)}
+        self.tables = np.full((n_slots, max_blocks), TRASH_PAGE, np.int32)
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    def blocks_for(self, n_tokens: int) -> int:
+        return -(-n_tokens // self.page_size)
+
+    def grow(self, slot: int, n_tokens: int) -> bool:
+        """Ensure ``slot`` owns pages covering logical positions
+        [0, n_tokens). Returns False (allocating nothing) when the pool
+        can't satisfy it — the caller preempts or queues."""
+        owned = self._owned[slot]
+        need = self.blocks_for(n_tokens) - len(owned)
+        if need <= 0:
+            return True
+        if need > len(self._free):
+            return False
+        if len(owned) + need > self.max_blocks:
+            raise ValueError(
+                f"slot {slot}: {n_tokens} tokens exceed "
+                f"{self.max_blocks} blocks of {self.page_size}")
+        for _ in range(need):
+            pg = self._free.pop()
+            self.tables[slot, len(owned)] = pg
+            owned.append(pg)
+        return True
+
+    def release(self, slot: int):
+        """Free all of ``slot``'s pages (table row resets to trash)."""
+        owned = self._owned[slot]
+        self._free.extend(owned)
+        owned.clear()
+        self.tables[slot, :] = TRASH_PAGE
+
+    def owned_blocks(self, slot: int) -> int:
+        return len(self._owned[slot])

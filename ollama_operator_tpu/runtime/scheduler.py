@@ -27,6 +27,7 @@ import numpy as np
 
 from .engine import Engine, SlotOptions
 from .errors import BadRequest
+from .paged import PagesExhausted
 
 
 class SchedulerBusy(RuntimeError):
@@ -83,6 +84,16 @@ class Request:
         # every sampled token (incl. EOG), for parking the slot's KV as a
         # reusable prefix after the request finishes
         self.all_tokens: List[int] = []
+        # set when the request is preempted (paged pool pressure): the
+        # full prompt + tokens generated so far; re-admission prefills
+        # from here and generation continues seamlessly on the same
+        # output queue
+        self.resume_ids: Optional[np.ndarray] = None
+
+    @property
+    def admit_ids(self) -> np.ndarray:
+        return (self.resume_ids if self.resume_ids is not None
+                else self.prompt_ids)
 
     def cancel(self):
         self.cancelled.set()
@@ -107,6 +118,10 @@ class Scheduler:
     def __init__(self, engine: Engine, max_queue: int = 256):
         self.engine = engine
         self._waiting: queue.Queue = queue.Queue(maxsize=max_queue)
+        # preempted requests (paged pool pressure) re-admit before the
+        # waiting queue — they already hold a place in the line
+        self._preempted: List[Request] = []
+        self.n_preemptions = 0
         self._running: List[Optional[Request]] = [None] * engine.n_slots
         # slot → token ids (prompt + generated) still resident in its KV
         # cache; candidates for prefix-cache reuse (ollama keeps the same
@@ -165,6 +180,9 @@ class Scheduler:
                 self._running[slot] = None
                 req.stats.t_done = time.monotonic()
                 req.out.put(("done", "unloaded"))
+        for req in self._preempted:
+            req.out.put(("done", "unloaded"))
+        self._preempted.clear()
         while True:
             try:
                 req = self._waiting.get_nowait()
@@ -216,7 +234,7 @@ class Scheduler:
         and the tail's bucket must fit above the reused prefix."""
         if req.embeds is not None or not self.engine.supports_extend:
             return None, 0
-        ids = req.prompt_ids
+        ids = req.admit_ids
         best, best_m = None, 0
         for slot, parked in self._parked.items():
             k = min(len(parked), len(ids) - 1)
@@ -232,12 +250,29 @@ class Scheduler:
             return None, 0
         return best, best_m
 
+    def _next_waiting(self) -> Optional[Request]:
+        if self._preempted:
+            return self._preempted.pop(0)
+        try:
+            return self._waiting.get_nowait()
+        except queue.Empty:
+            return None
+
+    def _evict_one_parked(self) -> bool:
+        """Drop one parked prefix cache to return its pages to the pool
+        (paged mode; oldest parked first). False when nothing is parked."""
+        for slot in list(self._parked):
+            if self._running[slot] is None:
+                self._parked.pop(slot)
+                self.engine.free_slot_pages(slot)
+                return True
+        return False
+
     def _admit_waiting(self):
         free = self.engine.free_slots()
         while free:
-            try:
-                req = self._waiting.get_nowait()
-            except queue.Empty:
+            req = self._next_waiting()
+            if req is None:
                 return
             if req.cancelled.is_set():
                 req.out.put(("done", "cancelled"))
@@ -261,21 +296,36 @@ class Scheduler:
                 mask_row = (req.constraint.mask_row()
                             if req.constraint is not None else None)
                 if reuse_slot is not None:
-                    first = self.engine.extend(slot, req.prompt_ids,
+                    first = self.engine.extend(slot, req.admit_ids,
                                                reuse_len, req.opts,
                                                mask_row=mask_row)
                     req.stats.n_reused = reuse_len
                 else:
-                    first = self.engine.admit(slot, req.prompt_ids,
+                    first = self.engine.admit(slot, req.admit_ids,
                                               req.opts, embeds=req.embeds,
                                               mask_row=mask_row)
+            except PagesExhausted as e:
+                # paged pool dry: evict a parked prefix and retry this
+                # request next pass; with nothing to evict it waits for a
+                # finisher (unless it can never fit at all)
+                if not self.engine.admissible(len(req.admit_ids)):
+                    req.error = (f"prompt needs more KV pages than the "
+                                 f"pool has: {e}")
+                    req.out.put(("error", req.error))
+                    continue
+                self._evict_one_parked()
+                self._preempted.insert(0, req)
+                return
             except Exception as e:  # surfacing engine errors to the caller
                 req.error = str(e)
                 req.out.put(("error", str(e)))
                 continue
             req.slot = slot
+            if req.stats.t_admitted == 0:
+                # first admission only — a preempted request re-admitting
+                # must not re-count its prompt in throughput stats
+                self.total_prompt += req.stats.n_prompt
             req.stats.t_admitted = time.monotonic()
-            self.total_prompt += req.stats.n_prompt
             self._running[slot] = req
             # grammar check before emitting (see _step)
             if (req.constraint is not None
@@ -317,12 +367,52 @@ class Scheduler:
                 pass
 
     def _drain_waiting(self, msg):
+        for req in self._preempted:
+            req.out.put(msg)
+        self._preempted.clear()
         while True:
             try:
                 req = self._waiting.get_nowait()
             except queue.Empty:
                 return
             req.out.put(msg)
+
+    def _relieve_pressure(self, n_steps: Optional[int]):
+        """Paged mode: make sure every active slot has pages for the next
+        decode chunk. Pressure relief order: (1) evict parked prefix
+        caches, (2) preempt the newest active requests — their generation
+        state is requeued (resume_ids) and continues on the same output
+        stream after re-admission. Multimodal requests are preempted last
+        (their image embeds cannot be re-prefilled from token ids) and
+        errored if no alternative exists."""
+        while True:
+            victims = self.engine.prepare_decode(n_steps)
+            if not victims:
+                return
+            if self._evict_one_parked():
+                continue
+            cand = [s for s in victims if self._running[s] is not None]
+            if not cand:
+                return  # nothing actionable; decode_n will surface it
+            non_mm = [s for s in cand if self._running[s].embeds is None]
+            slot = (non_mm or cand)[0]
+            req = self._running[slot]
+            self._running[slot] = None
+            self.engine.release(slot)
+            if req.embeds is None:
+                req.resume_ids = np.concatenate(
+                    [req.prompt_ids,
+                     np.asarray(req.all_tokens, np.int32)])
+                req.slot = None
+                self.n_preemptions += 1
+                self._preempted.append(req)
+            else:
+                req.error = ("preempted under KV-pool pressure; multimodal "
+                             "requests cannot resume")
+                req.stats.t_done = time.monotonic()
+                with self._lock:
+                    self.finished.append(req.stats)
+                req.out.put(("error", req.error))
 
     def _step(self):
         self._admit_waiting()
@@ -345,8 +435,11 @@ class Scheduler:
         # per token, so while any is active the whole batch steps one
         # token per dispatch — still through the AOT-warmed bucketed
         # decode_n path (n=1), never the cold unbucketed single-step jit.
-        toks_n = self.engine.decode_n(
-            1 if self.engine.any_constrained else None)
+        n_steps = 1 if self.engine.any_constrained else None
+        self._relieve_pressure(n_steps)
+        if self.n_active == 0:
+            return
+        toks_n = self.engine.decode_n(n_steps)
         self._consecutive_failures = 0
         for row in np.asarray(toks_n):
             any_running = False

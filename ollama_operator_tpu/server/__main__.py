@@ -58,6 +58,17 @@ def main(argv=None):
                    default=int(os.environ.get("TPU_EXPERT_PARALLEL", "1")),
                    help="expert-parallel ways (MoE experts sharded over "
                         "the ep mesh axis; >1 only helps MoE archs)")
+    p.add_argument("--paged", action="store_true",
+                   default=os.environ.get("TPU_PAGED", "") == "1",
+                   help="paged KV cache: slots share a physical page pool "
+                        "so HBM scales with live tokens, not max_slots × "
+                        "max_seq_len (single-device / tp-only meshes)")
+    p.add_argument("--page-size", type=int,
+                   default=int(os.environ.get("TPU_PAGE_SIZE", "64")))
+    p.add_argument("--n-pages", type=int,
+                   default=int(os.environ.get("TPU_N_PAGES", "0")),
+                   help="KV pool pages (0 = dense-equivalent "
+                        "max_slots*max_seq_len/page_size)")
     p.add_argument("--profile-port", type=int,
                    default=int(os.environ.get("TPU_PROFILE_PORT", "0")),
                    help="jax.profiler server port (0 = off)")
@@ -113,7 +124,9 @@ def main(argv=None):
     ecfg = EngineConfig(max_slots=args.max_slots,
                         max_seq_len=args.max_seq_len,
                         decode_chunk=max(1, args.decode_chunk),
-                        cache_dtype=resolve_cache_dtype(args.kv_dtype))
+                        cache_dtype=resolve_cache_dtype(args.kv_dtype),
+                        paged=args.paged, page_size=args.page_size,
+                        n_pages=args.n_pages or None)
     engine_dtype = {"bf16": "bfloat16"}.get(args.dtype, args.dtype)
     manager = ModelManager(args.store, cache_dir=args.cache, mesh=mesh,
                            ecfg=ecfg, engine_dtype=engine_dtype,

@@ -1,0 +1,173 @@
+"""Paged KV cache: engine parity vs the dense cache, page accounting,
+pallas paged-kernel parity (interpret), preemption + requeue.
+
+Round-1 VERDICT weak #3: the dense slot cache reserved max_seq_len per
+slot and capped concurrency at max_slots. The paged pool decouples both —
+these tests pin the invariants (SURVEY.md §7 hard-part 2).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ollama_operator_tpu.models import decoder
+from ollama_operator_tpu.models.config import PRESETS
+from ollama_operator_tpu.runtime.engine import Engine, EngineConfig, SlotOptions
+from ollama_operator_tpu.runtime.paged import PageTable, PagesExhausted
+from ollama_operator_tpu.runtime.scheduler import Scheduler
+
+BASE = PRESETS["tiny"]
+XLA = dataclasses.replace(BASE, kernels="xla")
+INTERP = dataclasses.replace(BASE, kernels="interpret")
+GREEDY = SlotOptions(temperature=0.0)
+DENSE = EngineConfig(max_slots=4, max_seq_len=64, cache_dtype=jnp.float32,
+                     min_prefill_bucket=16)
+PAGED = dataclasses.replace(DENSE, paged=True, page_size=8)
+
+PROMPT = np.array([3, 1, 4, 1, 5, 9, 2, 6], np.int32)
+P2 = np.array([7, 7, 7], np.int32)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return decoder.init_params(BASE, jax.random.key(0), jnp.float32)
+
+
+def _greedy_run(cfg, ecfg, params):
+    eng = Engine(cfg, params, ecfg=ecfg)
+    seq = [eng.admit(0, PROMPT, GREEDY), eng.admit(1, P2, GREEDY)]
+    for _ in range(3):
+        t = eng.decode()
+        seq.extend([int(t[0]), int(t[1])])
+    seq.extend(int(x) for x in eng.decode_n(4)[:, :2].ravel())
+    return seq
+
+
+def test_page_table_accounting():
+    pt = PageTable(n_slots=2, n_pages=5, page_size=8, max_blocks=8)
+    assert pt.n_free == 4                      # page 0 is trash
+    assert pt.grow(0, 17)                      # 3 blocks
+    assert pt.owned_blocks(0) == 3 and pt.n_free == 1
+    assert pt.grow(0, 20)                      # still 3 blocks — no-op
+    assert not pt.grow(1, 17)                  # needs 3, only 1 free
+    assert pt.owned_blocks(1) == 0             # failed grow allocs nothing
+    assert pt.grow(1, 8)
+    pt.release(0)
+    assert pt.n_free == 3
+    assert (pt.tables[0] == 0).all()
+
+
+@pytest.mark.parametrize("kernels,cache_dtype", [
+    ("xla", jnp.float32),
+    ("interpret", jnp.float32),   # pallas paged kernel, interpreted
+    ("xla", jnp.int8),
+    ("interpret", jnp.int8),      # int8 pages + lane-wise scales in-kernel
+])
+def test_paged_engine_matches_dense(params, kernels, cache_dtype):
+    cfg = dataclasses.replace(BASE, kernels=kernels)
+    dense = dataclasses.replace(DENSE, cache_dtype=cache_dtype)
+    paged = dataclasses.replace(PAGED, cache_dtype=cache_dtype)
+    ref = _greedy_run(XLA, dense, params)
+    got = _greedy_run(cfg, paged, params)
+    assert got == ref, (got, ref)
+
+
+def test_paged_pool_smaller_than_dense(params):
+    """A pool far below max_slots*max_seq still serves (HBM decoupling)."""
+    small = dataclasses.replace(PAGED, n_pages=8)   # 64 tokens total
+    ref = _greedy_run(XLA, DENSE, params)
+    assert _greedy_run(XLA, small, params) == ref
+
+
+def test_paged_extend_matches_dense(params):
+    def run(ecfg):
+        eng = Engine(XLA, params, ecfg=ecfg)
+        first = eng.admit(0, PROMPT, GREEDY)
+        toks = [first] + [int(eng.decode()[0]) for _ in range(3)]
+        eng.release(0, park=True)
+        full = np.concatenate([PROMPT, np.asarray(toks[:-1], np.int32),
+                               np.array([11, 12], np.int32)])
+        t2 = eng.extend(0, full, start=len(PROMPT) + 3, opts=GREEDY)
+        return toks, [t2] + [int(eng.decode()[0]) for _ in range(2)]
+
+    assert run(PAGED) == run(DENSE)
+
+
+def test_paged_int8_extend_works(params):
+    """int8 × prefix-cache was mutually exclusive on the dense cache
+    (round-1 weak #4); the paged pool closes the combination."""
+    q_paged = dataclasses.replace(PAGED, cache_dtype=jnp.int8)
+    eng = Engine(XLA, params, ecfg=q_paged)
+    assert eng.supports_extend
+    first = eng.admit(0, PROMPT, GREEDY)
+    toks = [first] + [int(eng.decode()[0]) for _ in range(3)]
+    eng.release(0, park=True)
+    full = np.concatenate([PROMPT, np.asarray(toks[:-1], np.int32),
+                           np.array([11, 12], np.int32)])
+    t2 = eng.extend(0, full, start=len(PROMPT) + 3, opts=GREEDY)
+    out = [t2] + [int(eng.decode()[0]) for _ in range(2)]
+    assert len(out) == 3 and all(isinstance(t, int) for t in out)
+
+
+def test_engine_preemption_victims_newest_first(params):
+    eng = Engine(XLA, params, ecfg=dataclasses.replace(PAGED, n_pages=5))
+    eng.admit(0, PROMPT, GREEDY)
+    eng.admit(1, PROMPT, GREEDY)
+    eng.admit(2, P2, GREEDY)
+    victims = eng.prepare_decode(8)
+    assert victims and victims[0] == 2        # newest admission loses
+    with pytest.raises(PagesExhausted):
+        eng.decode_n(8)
+    for v in victims:
+        eng.release(v)
+    assert eng.prepare_decode(8) == []
+    eng.decode_n(8)                           # survivors keep decoding
+
+
+def test_admission_pages_exhausted(params):
+    eng = Engine(XLA, params, ecfg=dataclasses.replace(PAGED, n_pages=2))
+    eng.admit(0, PROMPT, GREEDY)              # 1 page
+    with pytest.raises(PagesExhausted):
+        eng.admit(1, np.arange(1, 12, dtype=np.int32), GREEDY)  # needs 2
+    assert not eng.admissible(17)             # 3 blocks > 2 total
+
+
+def test_scheduler_preempts_and_resumes(params):
+    """More concurrent work than the pool can hold at once: the scheduler
+    preempts the newest request, requeues it, and EVERY request still
+    finishes with its full token budget on the same output stream."""
+    eng = Engine(XLA, params, ecfg=dataclasses.replace(
+        PAGED, max_slots=3, n_pages=6))
+    sched = Scheduler(eng)
+    try:
+        reqs = [sched.submit(PROMPT + i, max_tokens=12,
+                             opts=SlotOptions(temperature=0.0))
+                for i in range(3)]
+        outs = [list(r.tokens()) for r in reqs]
+        for r, out in zip(reqs, outs):
+            assert r.error is None
+            assert len(out) == 12, (len(out), r.error)
+        # 3 slots × (8 prompt + 12 gen) = 60 tokens > 48 page slots → at
+        # least one preemption (or parked eviction) must have happened
+        assert sched.n_preemptions >= 1
+    finally:
+        sched.shutdown()
+
+
+def test_scheduler_paged_full_flow_no_pressure(params):
+    """Ample pool: paged scheduler behaves exactly like the dense one."""
+    def run(ecfg):
+        eng = Engine(XLA, params, ecfg=ecfg)
+        sched = Scheduler(eng)
+        try:
+            reqs = [sched.submit(PROMPT + i, max_tokens=6,
+                                 opts=SlotOptions(temperature=0.0))
+                    for i in range(4)]
+            return [list(r.tokens()) for r in reqs]
+        finally:
+            sched.shutdown()
+
+    assert run(PAGED) == run(DENSE)
