@@ -38,26 +38,31 @@ SP_AXIS = "sp"
 
 
 def prefill_chunk_sp(params: Params, cfg: ModelConfig, tokens: jax.Array,
-                     mesh: Mesh) -> Tuple[jax.Array, jax.Array, jax.Array]:
+                     mesh: Mesh, inputs_embeds: jax.Array = None
+                     ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Sequence-parallel twin of ``decoder.prefill_chunk``.
 
     tokens [B, T] with T divisible by mesh sp; returns (logits [B,T,V] fp32,
     k [L,B,KvH,T,hd], v [...]) — logits and K/V sharded over ``sp`` along
-    their sequence axis.
+    their sequence axis. ``inputs_embeds`` [B, T, D] (multimodal prompts)
+    replaces the embedding lookup; it shards over sp along T like tokens.
     """
     sp = mesh.shape[SP_AXIS]
     B, T = tokens.shape
     assert T % sp == 0, f"prefill length {T} must divide sp={sp}"
     scale = 1.0 / math.sqrt(cfg.head_dim)
 
-    def inner(tokens):
+    def inner(tokens, inputs_embeds):
         my = lax.axis_index(SP_AXIS)
         Bc, Tc = tokens.shape
         positions = my * Tc + jnp.arange(Tc, dtype=jnp.int32)
         positions = jnp.broadcast_to(positions[None], (Bc, Tc))
         cos, sin = rope_angles(positions, cfg.rotary_dim, cfg.rope_theta,
                                cfg.rope_scaling)
-        x = _embed(cfg, params, tokens)
+        if inputs_embeds is not None:
+            x = inputs_embeds.astype(params["tok_emb"].dtype)
+        else:
+            x = _embed(cfg, params, tokens)
 
         def attn_fn(q, k, v):
             return ring_attention(q, k, v, scale, SP_AXIS, cfg.attn_softcap,
@@ -72,11 +77,12 @@ def prefill_chunk_sp(params: Params, cfg: ModelConfig, tokens: jax.Array,
         return logits, ks, vs
 
     seq_spec = P(None, None, None, SP_AXIS, None)   # [L,B,KvH,T@sp,hd]
+    emb_spec = None if inputs_embeds is None else P(None, SP_AXIS, None)
     return jax.shard_map(
         inner, mesh=mesh,
-        in_specs=P(None, SP_AXIS),
+        in_specs=(P(None, SP_AXIS), emb_spec),
         out_specs=(P(None, SP_AXIS, None), seq_spec, seq_spec),
-        axis_names={SP_AXIS})(tokens)
+        axis_names={SP_AXIS})(tokens, inputs_embeds)
 
 
 def forward_with_cache_sp(params: Params, cfg: ModelConfig,
@@ -86,12 +92,16 @@ def forward_with_cache_sp(params: Params, cfg: ModelConfig,
                           ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Sequence-parallel twin of ``decoder.forward_with_cache``.
 
-    k_cache/v_cache [L,B,KvH,S,hd] sharded over ``sp`` along S. The fresh
-    tokens' compute is replicated across sp (decode is memory-bound; sp
-    exists for HBM capacity) — only the cache reads/writes are sharded.
+    k_cache/v_cache [L,B,KvH,S,hd] sharded over ``sp`` along S — dense, or
+    int8 dicts {"q", "s": [L,B,KvH,S]} (the sp collectives quantize fresh
+    K/V and dequantize via scales folded into scores/probs, closing
+    round-1's int8×sp exclusion). The fresh tokens' compute is replicated
+    across sp (decode is memory-bound; sp exists for HBM capacity) — only
+    the cache reads/writes are sharded.
     Returns (logits [B,T,V] replicated, k_cache, v_cache).
     """
     scale = 1.0 / math.sqrt(cfg.head_dim)
+    quant = isinstance(k_cache, dict)
 
     def inner(tokens, k_cache, v_cache, lengths):
         B, T = tokens.shape
@@ -120,6 +130,9 @@ def forward_with_cache_sp(params: Params, cfg: ModelConfig,
         return logits, k_cache, v_cache
 
     cache_spec = P(None, None, None, SP_AXIS, None)
+    if quant:
+        cache_spec = {"q": cache_spec,
+                      "s": P(None, None, None, SP_AXIS)}
     return jax.shard_map(
         inner, mesh=mesh,
         in_specs=(P(None, None), cache_spec, cache_spec, P(None)),

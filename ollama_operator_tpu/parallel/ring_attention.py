@@ -44,18 +44,26 @@ from ..ops.attention import NEG_INF, softcap_scores
 _FP32 = jnp.float32
 
 
-def _accum(carry, q, k, v, mask, scale: float, softcap: float):
+def _accum(carry, q, k, v, mask, scale: float, softcap: float,
+           k_s=None, v_s=None):
     """One online-softmax accumulation step.
 
     carry: (m [B,KvH,G,T], l [B,KvH,G,T], acc [B,KvH,G,T,hd]) fp32
     q [B,T,H,hd]; k/v head-first [B,KvH,S,hd]; mask [B,T,S] additive fp32.
+    ``k_s``/``v_s`` [B,KvH,S] — per-position dequant scales for int8
+    chunks (ops/quant_cache.py convention: the key scale factors out of
+    the q·k dot onto the scores; the value scale folds into the
+    probabilities — dequantized tensors never materialise).
     """
     m, l, acc = carry
     B, T, H, hd = q.shape
     KvH = k.shape[1]
     G = H // KvH
     qg = q.reshape(B, T, KvH, G, hd)
-    s = jnp.einsum("btkgh,bksh->bkgts", qg, k, preferred_element_type=_FP32)
+    kc = k.astype(q.dtype) if k_s is not None else k
+    s = jnp.einsum("btkgh,bksh->bkgts", qg, kc, preferred_element_type=_FP32)
+    if k_s is not None:
+        s = s * k_s[:, :, None, None, :]
     s = softcap_scores(s * scale, softcap)
     s = s + mask[:, None, None, :, :]
     m_new = jnp.maximum(m, s.max(axis=-1))
@@ -64,8 +72,13 @@ def _accum(carry, q, k, v, mask, scale: float, softcap: float):
     p = jnp.exp(s - m_new[..., None])
     alpha = jnp.exp(m - m_new)
     l = l * alpha + p.sum(axis=-1)
+    if v_s is not None:
+        p = p * v_s[:, :, None, None, :]
+        vc = v.astype(q.dtype)
+    else:
+        vc = v
     acc = acc * alpha[..., None] + jnp.einsum(
-        "bkgts,bksh->bkgth", p.astype(v.dtype), v,
+        "bkgts,bksh->bkgth", p.astype(vc.dtype), vc,
         preferred_element_type=_FP32)
     return m_new, l, acc
 
@@ -148,6 +161,11 @@ def sp_decode_attention(q, k_chunk, v_chunk, q_pos, scale: float,
     """
     my = lax.axis_index(axis_name)
     B, T, H, hd = q.shape
+    quant = isinstance(k_chunk, dict)
+    k_s = k_chunk["s"] if quant else None
+    v_s = v_chunk["s"] if quant else None
+    if quant:
+        k_chunk, v_chunk = k_chunk["q"], v_chunk["q"]
     KvH, Sc = k_chunk.shape[1], k_chunk.shape[2]
     G = H // KvH
 
@@ -162,7 +180,7 @@ def sp_decode_attention(q, k_chunk, v_chunk, q_pos, scale: float,
             jnp.zeros((B, KvH, G, T), _FP32),
             jnp.zeros((B, KvH, G, T, hd), _FP32))
     m_loc, l_loc, acc_loc = _accum(zero, q, k_chunk, v_chunk, mask, scale,
-                                   softcap)
+                                   softcap, k_s=k_s, v_s=v_s)
 
     m_g = lax.pmax(m_loc, axis_name)
     corr = jnp.exp(m_loc - m_g)                                # 0 when local
@@ -178,12 +196,16 @@ def sp_cache_write(k_cache, v_cache, k_new, v_new, write_pos,
     """Write fresh K/V into a sequence-sharded cache chunk.
 
     k_cache/v_cache [B, KvH, Sc, hd] — local chunk (device i owns absolute
-    slots [i·Sc, (i+1)·Sc)); k_new/v_new [B, KvH, T, hd] — replicated
-    across sp; write_pos [B, T] absolute slots. Positions outside the local
-    chunk are dropped (they land on the owning device instead).
+    slots [i·Sc, (i+1)·Sc)), or int8 dicts {"q": entries, "s": [B,KvH,Sc]
+    scales} — fresh K/V is then quantized before the scatter; k_new/v_new
+    [B, KvH, T, hd] replicated across sp; write_pos [B, T] absolute slots.
+    Positions outside the local chunk are dropped (they land on the owning
+    device instead).
     """
     my = lax.axis_index(axis_name)
-    B, KvH, Sc, _ = k_cache.shape
+    quant = isinstance(k_cache, dict)
+    Sc = (k_cache["q"] if quant else k_cache).shape[2]
+    B, KvH = k_new.shape[0], k_new.shape[1]
     local = write_pos - my * Sc                                # [B,T]
     # mode="drop" discards scatters whose local index is outside [0, Sc) —
     # they belong to another shard — but negative indices would wrap
@@ -195,6 +217,19 @@ def sp_cache_write(k_cache, v_cache, k_new, v_new, write_pos,
     bidx = jnp.arange(B)[:, None, None]
     hidx = jnp.arange(KvH)[None, :, None]
     pidx = local[:, None, :]
+    if quant:
+        from ..ops.quant_cache import quantize_kv
+        kq, ks = quantize_kv(k_new)
+        vq, vs = quantize_kv(v_new)
+        k_cache = {"q": k_cache["q"].at[bidx, hidx, pidx].set(
+                       kq, mode="drop"),
+                   "s": k_cache["s"].at[bidx, hidx, pidx].set(
+                       ks, mode="drop")}
+        v_cache = {"q": v_cache["q"].at[bidx, hidx, pidx].set(
+                       vq, mode="drop"),
+                   "s": v_cache["s"].at[bidx, hidx, pidx].set(
+                       vs, mode="drop")}
+        return k_cache, v_cache
     k_cache = k_cache.at[bidx, hidx, pidx].set(
         k_new.astype(k_cache.dtype), mode="drop")
     v_cache = v_cache.at[bidx, hidx, pidx].set(

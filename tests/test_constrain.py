@@ -221,3 +221,49 @@ def test_constrained_and_free_slots_coexist():
         if c.advance(int(toks[1])):
             eng2.set_mask(1, c.mask_row())
     assert got == free_ref
+
+
+def test_constrained_slot_does_not_collapse_batch_throughput():
+    """Round-1 weak #5: one constrained slot used to force the whole batch
+    to n=1 per dispatch. Per-slot step budgets now freeze ONLY the
+    constrained slot after the chunk's first step — the free slot must
+    advance decode_chunk tokens per decode_n() call, and its token stream
+    must be unchanged by the constrained neighbour."""
+    cfg = cfglib.PRESETS["tiny"]
+    params = decoder.init_params(cfg, jax.random.PRNGKey(0), dtype=F32)
+    greedy = SlotOptions(temperature=0.0, repeat_penalty=1.0)
+    prompt = np.array([3, 1, 4, 1, 5], np.int32)
+    chunk = 4
+
+    def make():
+        return Engine(cfg, params,
+                      ecfg=EngineConfig(max_slots=2, max_seq_len=128,
+                                        cache_dtype=F32,
+                                        min_prefill_bucket=16,
+                                        decode_chunk=chunk))
+
+    # reference: free slot alone
+    ref_eng = make()
+    ref = [ref_eng.admit(0, prompt, greedy)]
+    ref.extend(int(t) for t in ref_eng.decode_n(chunk)[:, 0])
+    ref.extend(int(t) for t in ref_eng.decode_n(chunk)[:, 0])
+
+    eng = make()
+    got = [eng.admit(0, prompt, greedy)]
+    table = make_table()
+    c = JsonConstraint(table)
+    eng.admit(1, np.array([7, 7], np.int32),
+              SlotOptions(temperature=0.9, seed=3, repeat_penalty=1.0),
+              mask_row=c.mask_row())
+    eng.set_mask(1, c.mask_row())
+    len0 = eng._host_lengths.copy()
+    for _ in range(2):
+        toks = eng.decode_n(chunk)
+        got.extend(int(t) for t in toks[:, 0])
+        # constrained slot: only row 0 is real; advance its PDA + mask
+        c.advance(int(toks[0, 1]))
+        eng.set_mask(1, c.mask_row())
+    # free slot advanced a full chunk per call, constrained slot 1/call
+    assert eng._host_lengths[0] - len0[0] == 2 * chunk
+    assert eng._host_lengths[1] - len0[1] == 2
+    assert got == ref, (got, ref)
