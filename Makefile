@@ -1,6 +1,6 @@
 # Build/test/deploy targets mirroring the reference's kubebuilder Makefile
 # surface (/root/reference/Makefile) where each has a meaning here.
-IMG ?= ghcr.io/ollama-operator-tpu/tpu-runtime:latest
+IMG ?= ghcr.io/ollama-operator-tpu/tpu-runtime:v0.1.0
 BACKEND ?= tpu
 PY ?= python
 

@@ -16,23 +16,80 @@ import os
 import re
 import sys
 
+import yaml
+
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PREFIX = "ollama-operator-"
-NAMESPACE = "ollama-operator-system"
+OVERLAY = os.path.join(ROOT, "config", "default")
 
-SOURCES = [
-    "config/crd/ollama.ayaka.io_models.yaml",
-    "config/rbac/role.yaml",
-    "config/rbac/leader_election_role.yaml",
-    "config/rbac/model_editor_role.yaml",
-    "config/rbac/model_viewer_role.yaml",
-    "config/manager/manager.yaml",
-]
 
-# objects whose metadata.name gets the prefix (CRD name must stay the
-# group-qualified plural; sample CRs are not part of the installer)
-PREFIXED_KINDS = {"ClusterRole", "Role", "ServiceAccount", "Deployment",
-                  "Namespace"}
+def load_overlay():
+    """namePrefix / namespace / resources / patches from
+    config/default/kustomization.yaml — the single source of deploy
+    config (same file `kustomize build` consumes), so installs are
+    patched there, never in this script."""
+    with open(os.path.join(OVERLAY, "kustomization.yaml")) as f:
+        k = yaml.safe_load(f)
+    resources = [os.path.normpath(os.path.join(OVERLAY, r))
+                 for r in k.get("resources", [])]
+    patches = [os.path.normpath(os.path.join(OVERLAY, p["path"]))
+               for p in k.get("patches", []) if isinstance(p, dict)]
+    return (k.get("namePrefix", ""), k.get("namespace", "default"),
+            resources, patches)
+
+
+PREFIX, NAMESPACE, SOURCES, PATCHES = load_overlay()
+
+# kustomize's prefix transformer applies namePrefix to EVERY kind except
+# CRDs (their name must stay the group-qualified plural) — mirror that
+# exactly so `kustomize build config/default` and this script emit
+# identically-named objects for any resource added to the overlay. The
+# Namespace object is additionally pinned to `namespace:` below.
+UNPREFIXED_KINDS = {"CustomResourceDefinition"}
+
+
+def _merge_named_lists(base: list, patch: list) -> list:
+    """Strategic-merge-lite for k8s object lists keyed by `name`."""
+    out = {e.get("name"): e for e in base}
+    for e in patch:
+        name = e.get("name")
+        if name in out:
+            out[name] = _merge(out[name], e)
+        else:
+            out[name] = e
+    return list(out.values())
+
+
+def _merge(base, patch):
+    """Strategic merge: dicts merge by key, lists of named objects merge
+    by name (containers/env/volumes/volumeMounts), other lists replace."""
+    if isinstance(base, dict) and isinstance(patch, dict):
+        out = dict(base)
+        for k, v in patch.items():
+            out[k] = _merge(base[k], v) if k in base else v
+        return out
+    if (isinstance(base, list) and isinstance(patch, list)
+            and all(isinstance(e, dict) and "name" in e
+                    for e in base + patch)):
+        return _merge_named_lists(base, patch)
+    return patch
+
+
+def apply_patches(doc: str) -> str:
+    """Apply the overlay's strategic-merge patch files to matching
+    (kind, name) documents BEFORE the prefix/namespace transforms (patch
+    metadata uses base names, exactly as kustomize expects)."""
+    obj = yaml.safe_load(doc)
+    if not isinstance(obj, dict):
+        return doc
+    for path in PATCHES:
+        with open(path) as f:
+            patch = yaml.safe_load(f)
+        if (patch.get("kind") == obj.get("kind")
+                and patch.get("metadata", {}).get("name")
+                == obj.get("metadata", {}).get("name")):
+            obj = _merge(obj, patch)
+            doc = yaml.safe_dump(obj, sort_keys=False)
+    return doc
 
 
 def split_docs(text: str):
@@ -51,7 +108,7 @@ def transform(doc: str, image: str | None) -> str:
     kind = get_field(doc, "kind")
     # namespace rewrite first (applies to metadata + rolebinding subjects)
     doc = doc.replace("namespace: system", f"namespace: {NAMESPACE}")
-    if kind in PREFIXED_KINDS:
+    if kind not in UNPREFIXED_KINDS:
         m = re.search(r"^metadata:\n((?:  .*\n)*)", doc, flags=re.M)
         if m:
             block = m.group(0)
@@ -72,7 +129,8 @@ def build(image: str | None = None) -> str:
     for src in SOURCES:
         with open(os.path.join(ROOT, src)) as f:
             for doc in split_docs(f.read()):
-                docs.append(transform(doc.strip("\n"), image))
+                docs.append(transform(apply_patches(doc.strip("\n")),
+                                      image))
     # bindings are generated, not stored: they must reference the prefixed
     # names and final namespace
     docs.append(f"""apiVersion: rbac.authorization.k8s.io/v1

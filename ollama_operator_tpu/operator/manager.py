@@ -216,6 +216,33 @@ class Manager:
         self.leader_elect = leader_elect
         self.health_addr = health_addr
         self.resync = resync_seconds
+        # metrics protection (the reference fronts manager metrics with a
+        # kube-rbac-proxy sidecar, config/default/manager_auth_proxy_patch
+        # .yaml; the native equivalent here is a bearer token mounted from
+        # a Secret — config/default wires METRICS_TOKEN_FILE and the
+        # ServiceMonitor reads the same Secret). Unset = open (dev).
+        self._metrics_token: Optional[str] = None
+        tok_file = os.environ.get("METRICS_TOKEN_FILE")
+        if tok_file:
+            try:
+                with open(tok_file) as f:
+                    self._metrics_token = f.read().strip()
+            except OSError as e:
+                log.warning("METRICS_TOKEN_FILE %r unreadable (%s); "
+                            "/metrics FAILS CLOSED until the Secret "
+                            "exists and the pod restarts", tok_file, e)
+                self._metrics_token = None
+            if not self._metrics_token:
+                # unreadable OR empty: deny-all (an empty token must not
+                # grant access to a bare "Bearer " header)
+                import secrets as _secrets
+                self._metrics_token = _secrets.token_hex(32)
+        elif os.environ.get("METRICS_TOKEN"):
+            self._metrics_token = os.environ["METRICS_TOKEN"].strip()
+            if not self._metrics_token:
+                # whitespace-only token: deny-all, never match "Bearer "
+                import secrets as _secrets
+                self._metrics_token = _secrets.token_hex(32)
         self._stop = threading.Event()
         self._threads: list = []
         self._elector: Optional[LeaderElector] = None
@@ -325,6 +352,20 @@ class Manager:
                 if self.path in ("/healthz", "/readyz"):
                     body, code = b"ok", 200
                 elif self.path == "/metrics":
+                    tok = mgr._metrics_token
+                    if tok is not None:
+                        import hmac
+                        auth = self.headers.get("Authorization", "")
+                        if not (auth.startswith("Bearer ") and
+                                hmac.compare_digest(auth[7:], tok)):
+                            body, code = b"unauthorized", 401
+                            self.send_response(code)
+                            self.send_header("WWW-Authenticate", "Bearer")
+                            self.send_header("Content-Length",
+                                             str(len(body)))
+                            self.end_headers()
+                            self.wfile.write(body)
+                            return
                     lines = [
                         "# TYPE controller_reconcile_total counter",
                         f"controller_reconcile_total {mgr.reconcile_total}",

@@ -1,0 +1,77 @@
+"""Worker process for tests/test_distributed.py's two-process world.
+
+Launched twice (process 0 and 1) with the operator's StatefulSet env
+contract (operator/pod.py:multihost_env); joins a jax.distributed world
+over the CPU backend (2 local devices each → 4 global), runs a
+tensor-parallel sharded forward over the GLOBAL mesh, and dumps the
+replicated logits (process 0) for the parent to compare against a
+single-process reference.
+"""
+
+import json
+import os
+import sys
+
+repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+port, pid, outdir = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+# the operator's env contract (rendered by operator/pod.py:multihost_env)
+os.environ["TPU_DIST_HOSTS"] = "2"
+os.environ["TPU_DIST_CHIPS_PER_HOST"] = "2"
+os.environ["TPU_DIST_COORDINATOR"] = f"127.0.0.1:{port}"
+os.environ["TPU_DIST_POD_NAME"] = f"ollama-model-llama2-{pid}"
+sys.path.insert(0, repo)
+
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from ollama_operator_tpu.parallel import distributed  # noqa: E402
+
+assert distributed.maybe_initialize(), "expected to join a 2-process world"
+assert jax.process_count() == 2, jax.process_count()
+assert jax.process_index() == pid, (jax.process_index(), pid)
+assert len(jax.devices()) == 4, jax.devices()
+assert len(jax.local_devices()) == 2
+
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+from ollama_operator_tpu.models import config as cfglib, decoder  # noqa: E402
+from ollama_operator_tpu.parallel.mesh import MeshPlan, make_mesh  # noqa: E402
+from ollama_operator_tpu.parallel.sharding import params_pspec_tree  # noqa: E402
+
+cfg = cfglib.PRESETS["tiny"]
+params = decoder.init_params(cfg, jax.random.key(0), jnp.float32)
+tokens = np.arange(1, 17, dtype=np.int32).reshape(2, 8) % cfg.vocab_size
+
+mesh = make_mesh(MeshPlan(dp=1, tp=4))   # spans BOTH processes
+pspecs = params_pspec_tree(params, cfg, mesh)
+
+
+def to_global(x, spec):
+    sh = NamedSharding(mesh, spec)
+    x = np.asarray(x)
+    return jax.make_array_from_callback(x.shape, sh, lambda idx: x[idx])
+
+
+gparams = jax.tree.map(to_global, params, pspecs,
+                       is_leaf=lambda s: isinstance(s, P))
+gtokens = to_global(tokens, P(None, None))
+
+rep = NamedSharding(mesh, P())
+fn = jax.jit(lambda p, t: decoder.prefill_chunk(p, cfg, t)[0],
+             out_shardings=rep)
+logits = fn(gparams, gtokens)
+jax.block_until_ready(logits)
+local = np.asarray(logits.addressable_data(0))   # replicated → full array
+
+if pid == 0:
+    np.save(os.path.join(outdir, "logits.npy"), local)
+with open(os.path.join(outdir, f"ok{pid}.json"), "w") as f:
+    json.dump({"processes": jax.process_count(),
+               "devices": len(jax.devices())}, f)
+print(f"worker {pid} done", flush=True)

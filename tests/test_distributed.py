@@ -1,6 +1,9 @@
 """Multi-host init glue: env contract between operator/pod.py and
 parallel/distributed.py (the jax.distributed world wiring)."""
 
+import os
+
+import numpy as np
 import pytest
 
 from ollama_operator_tpu.parallel import distributed as D
@@ -33,3 +36,54 @@ def test_operator_env_contract():
     assert env["TPU_DIST_HOSTS"] == "4"
     assert env["TPU_DIST_COORDINATOR"].endswith(".ns1.svc:8476")
     assert "TPU_DIST_POD_NAME" in env
+
+
+def test_two_process_world_sharded_forward(tmp_path):
+    """SURVEY §7 risk 3 / round-1 weak #8: actually form a two-process
+    jax.distributed world (CPU backend, 2 local devices each) through
+    maybe_initialize + the StatefulSet env contract, run a tp=4 sharded
+    forward over the GLOBAL mesh, and match the single-process logits."""
+    import socket
+    import subprocess
+    import sys as _sys
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    worker = os.path.join(os.path.dirname(__file__), "distributed_worker.py")
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("JAX_", "XLA_", "TPU_DIST"))}
+    procs = [subprocess.Popen(
+                [_sys.executable, worker, str(port), str(i), str(tmp_path)],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True)
+             for i in range(2)]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("distributed workers timed out forming the world")
+        outs.append((p.returncode, out, err))
+    for rc, out, err in outs:
+        assert rc == 0, err[-2000:]
+
+    import json as _json
+    for i in range(2):
+        with open(tmp_path / f"ok{i}.json") as f:
+            info = _json.load(f)
+        assert info == {"processes": 2, "devices": 4}
+
+    # single-process reference (this process: 8-device CPU mesh, no dist)
+    import jax
+    import jax.numpy as jnp
+    from ollama_operator_tpu.models import config as cfglib, decoder
+    cfg = cfglib.PRESETS["tiny"]
+    params = decoder.init_params(cfg, jax.random.key(0), jnp.float32)
+    tokens = np.arange(1, 17, dtype=np.int32).reshape(2, 8) % cfg.vocab_size
+    ref = decoder.prefill_chunk(params, cfg, jnp.asarray(tokens))[0]
+    got = np.load(tmp_path / "logits.npy")
+    np.testing.assert_allclose(got, np.asarray(ref), rtol=2e-4, atol=2e-4)

@@ -295,3 +295,64 @@ class TestServerImageOverride:
                        "ollama-models-store")
         assert sts["spec"]["template"]["spec"]["containers"][0][
             "image"] == "operator-default:1"
+
+
+class TestMetricsAuth:
+    """Bearer-token gate on /metrics (parity with the reference's
+    kube-rbac-proxy guard, config/default/manager_auth_proxy_patch.yaml;
+    here config/default/manager_metrics_auth_patch.yaml wires a Secret
+    into METRICS_TOKEN_FILE and the manager enforces it natively)."""
+
+    def _serve(self, fake, monkeypatch, **env):
+        import urllib.request
+        for k in ("METRICS_TOKEN_FILE", "METRICS_TOKEN"):
+            monkeypatch.delenv(k, raising=False)
+        for k, v in env.items():
+            monkeypatch.setenv(k, v)
+        mgr = Manager(fake, namespace="default", server_image="img:t",
+                      health_addr=("127.0.0.1", 0))
+        httpd = mgr._health_server()
+        port = httpd.server_address[1]
+
+        def get(path, token=None):
+            req = urllib.request.Request(f"http://127.0.0.1:{port}{path}")
+            if token is not None:
+                req.add_header("Authorization", f"Bearer {token}")
+            try:
+                return urllib.request.urlopen(req, timeout=10).status
+            except urllib.error.HTTPError as e:
+                return e.code
+
+        return httpd, get
+
+    def test_open_without_config(self, fake, monkeypatch):
+        httpd, get = self._serve(fake, monkeypatch)
+        try:
+            assert get("/metrics") == 200
+        finally:
+            httpd.shutdown()
+
+    def test_token_required_and_checked(self, fake, monkeypatch, tmp_path):
+        tok = tmp_path / "token"
+        tok.write_text("s3cret\n")
+        httpd, get = self._serve(fake, monkeypatch,
+                                 METRICS_TOKEN_FILE=str(tok))
+        try:
+            assert get("/metrics") == 401
+            assert get("/metrics", token="wrong") == 401
+            assert get("/metrics", token="s3cret") == 200
+            assert get("/healthz") == 200          # probes stay open
+        finally:
+            httpd.shutdown()
+
+    def test_missing_token_file_fails_closed(self, fake, monkeypatch,
+                                             tmp_path):
+        httpd, get = self._serve(
+            fake, monkeypatch,
+            METRICS_TOKEN_FILE=str(tmp_path / "absent"))
+        try:
+            assert get("/metrics") == 401
+            assert get("/metrics", token="") == 401
+            assert get("/healthz") == 200
+        finally:
+            httpd.shutdown()
