@@ -112,7 +112,8 @@ def transform(doc: str, image: str | None) -> str:
         m = re.search(r"^metadata:\n((?:  .*\n)*)", doc, flags=re.M)
         if m:
             block = m.group(0)
-            new_block = re.sub(r"^(  name: )(?!ollama-operator-)(\S+)",
+            new_block = re.sub(
+                rf"^(  name: )(?!{re.escape(PREFIX)})(\S+)",
                                rf"\g<1>{PREFIX}\g<2>", block, count=1,
                                flags=re.M)
             doc = doc.replace(block, new_block, 1)

@@ -357,7 +357,9 @@ class Manager:
                         import hmac
                         auth = self.headers.get("Authorization", "")
                         if not (auth.startswith("Bearer ") and
-                                hmac.compare_digest(auth[7:], tok)):
+                                hmac.compare_digest(
+                                    auth[7:].encode("utf-8", "replace"),
+                                    tok.encode("utf-8"))):
                             body, code = b"unauthorized", 401
                             self.send_response(code)
                             self.send_header("WWW-Authenticate", "Bearer")

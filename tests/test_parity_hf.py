@@ -1,0 +1,260 @@
+"""Cross-implementation parity: our GGUF→transcode→JAX pipeline vs
+HuggingFace transformers' LlamaForCausalLM (torch CPU) on identical
+weights, plus spec-derived dequant goldens.
+
+SURVEY §7 risk 1 / round-1 weak #10: transcode/rope/layout conventions
+were proven only against self-built fixtures. With zero network egress no
+real llama GGUF exists in this image, so the strongest independent anchor
+is transformers itself — the ecosystem-canonical llama implementation the
+GGUF converters start from. The test-side exporter applies llama.cpp's
+documented q/k interleave permutation (convert_hf_to_gguf.py's
+``LlamaModel.permute``), so our transcoder's unpermute is validated
+against the official conversion, not against itself.
+
+The dequant goldens hand-derive expected values from the ggml block-format
+specs with crafted byte patterns — they pin the ABSOLUTE convention, where
+the python↔C++ agreement tests (test_native.py) only pin consistency.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ollama_operator_tpu.gguf import dequant as DQ
+from ollama_operator_tpu.gguf import reader as R
+from ollama_operator_tpu.gguf import writer as W
+from ollama_operator_tpu.gguf.transcode import load_model as transcode_load
+from ollama_operator_tpu.models import decoder
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+# ---------------------------------------------------------------------------
+# HF → GGUF export (test-side, following convert_hf_to_gguf.py conventions)
+# ---------------------------------------------------------------------------
+
+def hf_permute(w: np.ndarray, n_head: int) -> np.ndarray:
+    """llama.cpp's LlamaModel.permute: HF half-split rope layout → the
+    interleaved (Meta) layout GGUF stores. w [out, in]."""
+    out, inn = w.shape
+    return (w.reshape(n_head, 2, out // n_head // 2, inn)
+             .swapaxes(1, 2).reshape(out, inn))
+
+
+def export_hf_to_gguf(path: str, model, hf_cfg, quant=None):
+    """Export a transformers LlamaForCausalLM state dict as a
+    llama-arch GGUF (f32, or q8_0 for the 2D matmul weights)."""
+    sd = {k: v.detach().cpu().numpy().astype(np.float32)
+          for k, v in model.state_dict().items()}
+    H, KvH = hf_cfg.num_attention_heads, hf_cfg.num_key_value_heads
+    w = W.GGUFWriter(path)
+    w.add_meta("general.architecture", "llama")
+    w.add_meta("llama.block_count", hf_cfg.num_hidden_layers)
+    w.add_meta("llama.embedding_length", hf_cfg.hidden_size)
+    w.add_meta("llama.attention.head_count", H)
+    w.add_meta("llama.attention.head_count_kv", KvH)
+    w.add_meta("llama.attention.key_length",
+               hf_cfg.hidden_size // H)
+    w.add_meta("llama.feed_forward_length", hf_cfg.intermediate_size)
+    w.add_meta("llama.context_length", hf_cfg.max_position_embeddings)
+    w.add_meta("llama.rope.freq_base", float(hf_cfg.rope_theta))
+    w.add_meta("llama.attention.layer_norm_rms_epsilon",
+               float(hf_cfg.rms_norm_eps))
+    V = hf_cfg.vocab_size
+    w.add_meta("tokenizer.ggml.model", "llama")
+    w.add_meta("tokenizer.ggml.tokens", [f"t{i}" for i in range(V)])
+    w.add_meta("tokenizer.ggml.scores", [0.0] * V)
+    w.add_meta("tokenizer.ggml.token_type", [1] * V)
+
+    def put(name, arr, quantizable=True):
+        arr = np.ascontiguousarray(arr, np.float32)
+        if quant == "q8_0" and quantizable and arr.ndim == 2:
+            w.add_tensor_raw(name, arr.shape, R.GGML_Q8_0,
+                             W.quantize_q8_0(arr))
+        else:
+            w.add_tensor_f32(name, arr)
+
+    put("token_embd.weight", sd["model.embed_tokens.weight"],
+        quantizable=False)   # embedding gather stays exact
+    put("output_norm.weight", sd["model.norm.weight"])
+    put("output.weight", sd["lm_head.weight"])
+    for i in range(hf_cfg.num_hidden_layers):
+        p, b = f"model.layers.{i}.", f"blk.{i}."
+        put(b + "attn_norm.weight", sd[p + "input_layernorm.weight"])
+        put(b + "attn_q.weight",
+            hf_permute(sd[p + "self_attn.q_proj.weight"], H))
+        put(b + "attn_k.weight",
+            hf_permute(sd[p + "self_attn.k_proj.weight"], KvH))
+        put(b + "attn_v.weight", sd[p + "self_attn.v_proj.weight"])
+        put(b + "attn_output.weight", sd[p + "self_attn.o_proj.weight"])
+        put(b + "ffn_norm.weight",
+            sd[p + "post_attention_layernorm.weight"])
+        put(b + "ffn_gate.weight", sd[p + "mlp.gate_proj.weight"])
+        put(b + "ffn_up.weight", sd[p + "mlp.up_proj.weight"])
+        put(b + "ffn_down.weight", sd[p + "mlp.down_proj.weight"])
+    w.write()
+
+
+def _hf_model():
+    cfg = transformers.LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rms_norm_eps=1e-5, rope_theta=10000.0,
+        attention_bias=False, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    model = transformers.LlamaForCausalLM(cfg)
+    model.eval()
+    return model, cfg
+
+
+IDS = [3, 1, 4, 1, 5, 9, 2, 6, 53, 58, 97, 93]
+
+
+def _our_logits(gguf_path):
+    cfg, params, _ = transcode_load(gguf_path, dtype=np.float32)
+    params = jax.tree.map(jnp.asarray, params)
+    tokens = jnp.asarray(np.array(IDS, np.int32)[None])
+    logits, _, _ = decoder.prefill_chunk(params, cfg, tokens)
+    return np.asarray(logits[0], np.float64)
+
+
+def test_logits_match_transformers_f32(tmp_path):
+    model, hf_cfg = _hf_model()
+    with torch.no_grad():
+        ref = model(torch.tensor([IDS])).logits[0].numpy().astype(np.float64)
+    path = str(tmp_path / "hf.gguf")
+    export_hf_to_gguf(path, model, hf_cfg)
+    got = _our_logits(path)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+    # the match must be meaningful, not all-zeros
+    assert np.abs(ref).max() > 0.1
+
+
+def test_greedy_tokens_match_transformers_q8_0(tmp_path):
+    """q8_0 weights through the real dequant path: quantization noise is
+    identical on both sides only for OUR pipeline, so compare greedy
+    argmax tokens against f32-transformers with the quantized logits —
+    they must agree at every position where the f32 margin dominates the
+    quantization error."""
+    model, hf_cfg = _hf_model()
+    with torch.no_grad():
+        ref_logits = model(torch.tensor([IDS])).logits[0].numpy()
+    path = str(tmp_path / "hf_q8.gguf")
+    export_hf_to_gguf(path, model, hf_cfg, quant="q8_0")
+    got = _our_logits(path)
+    err = np.abs(got - ref_logits).max()
+    top2 = np.sort(ref_logits, axis=-1)
+    margin = top2[:, -1] - top2[:, -2]
+    decisive = margin > 4 * err
+    assert decisive.any()
+    np.testing.assert_array_equal(got.argmax(-1)[decisive],
+                                  ref_logits.argmax(-1)[decisive])
+
+
+# ---------------------------------------------------------------------------
+# spec-derived dequant goldens (hand-crafted blocks, hand-computed values)
+# ---------------------------------------------------------------------------
+
+def _f16_bytes(x: float) -> bytes:
+    return np.float16(x).tobytes()
+
+
+def test_q8_0_golden():
+    # block: f16 d, 32 × int8. value[i] = d * q[i]
+    qs = np.arange(-16, 16, dtype=np.int8)
+    raw = np.frombuffer(_f16_bytes(0.5) + qs.tobytes(), np.uint8)
+    got = DQ.dq_q8_0(raw)
+    np.testing.assert_allclose(got, 0.5 * qs.astype(np.float32), atol=1e-3)
+
+
+def test_q4_0_golden():
+    # block: f16 d, 16 bytes of nibbles. weight i<16 = low nibble of
+    # byte i, weight i>=16 = high nibble of byte i-16; value = d*(q - 8)
+    lo = np.arange(16, dtype=np.uint8)          # weights 0..15 = 0..15
+    hi = np.full(16, 0xA, np.uint8)             # weights 16..31 = 10
+    qs = (lo | (hi << 4)).astype(np.uint8)
+    raw = np.frombuffer(_f16_bytes(0.25) + qs.tobytes(), np.uint8)
+    got = DQ.dq_q4_0(raw)
+    exp = 0.25 * (np.concatenate([np.arange(16), np.full(16, 10)]) - 8.0)
+    np.testing.assert_allclose(got, exp.astype(np.float32), atol=1e-3)
+
+
+def test_q4_k_golden():
+    # super-block of 256: f16 d, f16 dmin, 12 bytes of 6-bit scales/mins,
+    # 128 nibble bytes. With scale bytes [1]*4 + [0]*4 + [1]*4 every
+    # sub-block gets sc=1, m=0 (llama.cpp get_scale_min_k4), so
+    # value = d * nibble. Nibbles: each 64-weight group j reads 32 bytes;
+    # weights j*64+i (i<32) = low nibbles, +32..63 = high nibbles.
+    scales = bytes([1, 1, 1, 1, 0, 0, 0, 0, 1, 1, 1, 1])
+    nib = np.tile(np.arange(16, dtype=np.uint8), 2)   # 32 bytes per group
+    qs = np.tile(nib | (nib << 4), 4)                 # 128 bytes
+    raw = np.frombuffer(_f16_bytes(0.5) + _f16_bytes(0.0) + scales
+                        + qs.tobytes(), np.uint8)
+    got = DQ.dq_q4_k(raw)
+    group = np.concatenate([np.tile(np.arange(16), 2)] * 2)  # lo then hi
+    exp = 0.5 * np.tile(group, 4).astype(np.float32)
+    np.testing.assert_allclose(got, exp, atol=1e-3)
+
+
+def test_q6_k_golden():
+    # super-block of 256: ql 128 B, qh 64 B, 16 int8 scales, f16 d.
+    # With qh = 0 the 6-bit q is just the 4-bit nibble; value =
+    # d * sc[i//16] * (q - 32). Scales alternate 1, 2.
+    nib = np.tile(np.arange(16, dtype=np.uint8), 4)   # 64 bytes per half
+    ql = np.tile(nib | (nib << 4), 2)                 # 128 bytes
+    qh = np.zeros(64, np.uint8)
+    scales = np.tile(np.array([1, 2], np.int8), 8)    # 16 sub-blocks
+    raw = np.frombuffer(ql.tobytes() + qh.tobytes() + scales.tobytes()
+                        + _f16_bytes(1.0), np.uint8)
+    got = DQ.dq_q6_k(raw)
+    # layout per 128-weight half: weights 0..31 = low nibbles of bytes
+    # 0..31, 32..63 = low nibbles of 32..63, 64..95 = high of 0..31,
+    # 96..127 = high of 32..63 (qh contributes bits 4..5, zero here)
+    lo = np.concatenate([np.tile(np.arange(16), 2)] * 2)     # 64 lows
+    half = np.concatenate([lo, lo])                          # + 64 highs
+    q = np.concatenate([half, half]).astype(np.float32)
+    sc = np.repeat(scales.astype(np.float32), 16)
+    exp = sc * (q - 32.0)
+    np.testing.assert_allclose(got, exp, atol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# committed golden regression fixture
+# ---------------------------------------------------------------------------
+
+# Blessed on the round-2 CPU CI environment from the deterministic
+# (torch seed 0) q8_0 fixture below. Any transcode/dequant/rope/engine
+# change that alters serving semantics — or an XLA numeric change big
+# enough to flip a greedy argmax — trips this; re-bless consciously with
+# hack/gen_golden reasoning, never mechanically.
+GOLDEN_TOKENS = [134, 190, 139, 177, 98, 34, 29, 93, 134, 102, 28, 98]
+GOLDEN_LOGITS_8 = [-0.13376, 0.02682, 0.14595, -0.04723, -0.05149,
+                   -0.20087, -0.18322, -0.15094]
+
+
+def test_golden_tokens_regression(tmp_path):
+    from ollama_operator_tpu.runtime.engine import (Engine, EngineConfig,
+                                                    SlotOptions)
+    model, hf_cfg = _hf_model()
+    path = str(tmp_path / "golden.gguf")
+    export_hf_to_gguf(path, model, hf_cfg, quant="q8_0")
+    cfg, params, _ = transcode_load(path, dtype=np.float32)
+    params = jax.tree.map(jnp.asarray, params)
+    lg, _, _ = decoder.prefill_chunk(
+        params, cfg, jnp.asarray(np.array(IDS, np.int32)[None]))
+    np.testing.assert_allclose(np.asarray(lg[0, -1, :8]), GOLDEN_LOGITS_8,
+                               atol=1e-3)
+    eng = Engine(cfg, params,
+                 ecfg=EngineConfig(max_slots=1, max_seq_len=64,
+                                   cache_dtype=jnp.float32,
+                                   min_prefill_bucket=16))
+    g = SlotOptions(temperature=0.0, repeat_penalty=1.0)
+    seq = [eng.admit(0, np.array(IDS, np.int32), g)]
+    for _ in range(11):
+        seq.append(int(eng.decode()[0]))
+    assert seq == GOLDEN_TOKENS, seq
