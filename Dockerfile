@@ -16,6 +16,11 @@ RUN apt-get update && apt-get install -y --no-install-recommends \
 WORKDIR /app
 COPY ollama_operator_tpu/ ollama_operator_tpu/
 COPY native/ native/
+# tests/ + hack/ ship in the image for the kind e2e's in-cluster fixtures
+# (hack/fake_registry_entry.py) — a few KB, and the e2e then needs zero
+# network egress from the cluster
+COPY tests/ tests/
+COPY hack/fake_registry_entry.py hack/fake_registry_entry.py
 COPY hack/entrypoint.sh /usr/local/bin/entrypoint.sh
 RUN chmod +x /usr/local/bin/entrypoint.sh
 

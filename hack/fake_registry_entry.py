@@ -1,0 +1,40 @@
+#!/usr/bin/env python
+"""In-cluster model-registry fixture for the kind e2e.
+
+Runs inside the CPU runtime image (tests/ are shipped in the image for
+exactly this): synthesises the deterministic tiny llama GGUF and serves it
+over the docker-v2-ish registry protocol the puller speaks — the e2e's
+stand-in for registry.ollama.ai, so the cluster needs no egress
+(ref test/e2e pulls nothing either; it only asserts the manager runs —
+our e2e goes further and serves a model through the full path).
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, "/app")
+sys.path.insert(0, "/app/tests")
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tests"))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from fake_registry import FakeRegistry, add_tiny_model  # noqa: E402
+
+
+def main():
+    port = int(os.environ.get("PORT", "5000"))
+    reg = FakeRegistry()
+    add_tiny_model(reg)
+    reg.start(host="0.0.0.0", port=port)
+    print(f"fake registry serving library/tiny:latest on :{port}",
+          flush=True)
+    while True:
+        time.sleep(3600)
+
+
+if __name__ == "__main__":
+    main()

@@ -443,15 +443,30 @@ class LoadedModel:
                     return pooled.astype(jnp.float32)
 
                 self._embed_fn = jax.jit(_embed)
-        outs = []
-        for t in texts:
-            ids = self.tokenizer.encode(t)
-            T = max(16, 1 << (len(ids) - 1).bit_length())
-            toks = np.zeros((1, T), np.int32)
-            toks[0, :len(ids)] = ids
-            out = self._embed_fn(self.engine.params, jnp.asarray(toks),
-                                 jnp.asarray([len(ids)], np.int32))
-            outs.append(np.asarray(out)[0])
+        # one device dispatch per LENGTH BUCKET, not per text (round-1
+        # weak #9: serial per-text dispatches — fine for probes, weak for
+        # real embedding traffic): texts bucket by padded length, each
+        # bucket embeds as one [n, T] batch, results return in input order
+        all_ids = [self.tokenizer.encode(t) for t in texts]
+        buckets: Dict[int, List[int]] = {}
+        for i, ids in enumerate(all_ids):
+            T = max(16, 1 << (max(len(ids), 1) - 1).bit_length())
+            buckets.setdefault(T, []).append(i)
+        outs: List[Optional[np.ndarray]] = [None] * len(texts)
+        for T, idxs in sorted(buckets.items()):
+            # batch dim padded to a power of two as well, so compiled
+            # program count stays O(log² (texts, len)), not O(requests)
+            n_pad = 1 << (len(idxs) - 1).bit_length()
+            toks = np.zeros((n_pad, T), np.int32)
+            lens = np.zeros((n_pad,), np.int32)
+            for row, i in enumerate(idxs):
+                ids = all_ids[i]
+                toks[row, :len(ids)] = ids
+                lens[row] = len(ids)
+            out = np.asarray(self._embed_fn(
+                self.engine.params, jnp.asarray(toks), jnp.asarray(lens)))
+            for row, i in enumerate(idxs):
+                outs[i] = out[row]
         return np.stack(outs)
 
     def unload(self):

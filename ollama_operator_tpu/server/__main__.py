@@ -31,14 +31,19 @@ def main(argv=None):
     p.add_argument("--store-only", action="store_true",
                    default=os.environ.get("TPU_STORE_ONLY") == "1",
                    help="registry/store mode: no inference engine")
-    p.add_argument("--dtype", default=os.environ.get("TPU_ENGINE_DTYPE",
-                                                     "bfloat16"),
-                   choices=["bfloat16", "bf16", "float32", "int8"])
-    p.add_argument("--kv-dtype", default=os.environ.get("TPU_KV_DTYPE",
-                                                        "bfloat16"),
+    p.add_argument("--dtype", default=os.environ.get("TPU_ENGINE_DTYPE")
+                   or None,
+                   choices=["bfloat16", "bf16", "float32", "int8"],
+                   help="weight dtype (default: bfloat16 on TPU, float32 "
+                        "on CPU — XLA's CPU thunk runtime has no bf16 "
+                        "dots, so a CPU pod defaulting to bf16 would 500 "
+                        "on its first generate)")
+    p.add_argument("--kv-dtype", default=os.environ.get("TPU_KV_DTYPE")
+                   or None,
                    choices=["bfloat16", "float32", "int8"],
                    help="KV cache storage (int8 = quantized cache: half "
-                        "the decode cache traffic, double the context)")
+                        "the decode cache traffic, double the context; "
+                        "default bfloat16 on TPU, float32 on CPU)")
     p.add_argument("--max-slots", type=int,
                    default=int(os.environ.get("TPU_MAX_SLOTS", "8")))
     p.add_argument("--decode-chunk", type=int,
@@ -121,6 +126,14 @@ def main(argv=None):
               file=sys.stderr)
 
     from ..runtime.engine import resolve_cache_dtype
+    # platform-aware dtype defaults: bf16 feeds the MXU on TPU; XLA's CPU
+    # thunk runtime has no bf16 dots, so CPU pods (kind e2e, dev) serve f32
+    on_cpu = not args.store_only and all(
+        d.platform == "cpu" for d in devices)
+    if args.dtype is None:
+        args.dtype = "float32" if on_cpu else "bfloat16"
+    if args.kv_dtype is None:
+        args.kv_dtype = "float32" if on_cpu else "bfloat16"
     ecfg = EngineConfig(max_slots=args.max_slots,
                         max_seq_len=args.max_seq_len,
                         decode_chunk=max(1, args.decode_chunk),

@@ -50,7 +50,7 @@ class FakeRegistry:
             "layers": layers,
         }
 
-    def start(self):
+    def start(self, host: str = "127.0.0.1", port: int = 0):
         reg = self
 
         class H(BaseHTTPRequestHandler):
@@ -165,7 +165,7 @@ class FakeRegistry:
                 self.send_response(404)
                 self.end_headers()
 
-        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.httpd = ThreadingHTTPServer((host, port), H)
         self.port = self.httpd.server_address[1]
         threading.Thread(target=self.httpd.serve_forever,
                          daemon=True).start()
@@ -174,3 +174,30 @@ class FakeRegistry:
     def stop(self):
         if self.httpd:
             self.httpd.shutdown()
+
+
+def add_tiny_model(reg, *, template="{{ .Prompt }}", params=None,
+                   gguf_path=None):
+    """Deterministic tiny-llama fixture shared by the compose e2e and the
+    in-cluster kind-e2e registry (hack/fake_registry_entry.py) — one
+    recipe, so the two e2e tiers can never diverge."""
+    import os
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from ollama_operator_tpu.models import config as cfglib, decoder
+    from test_transcode import write_tiny_llama_gguf
+
+    cfg = cfglib.PRESETS["tiny"]
+    model_params = decoder.init_params(cfg, jax.random.PRNGKey(0),
+                                       dtype=jnp.float32)
+    path = gguf_path or os.path.join(tempfile.mkdtemp(), "tiny.gguf")
+    write_tiny_llama_gguf(path, cfg, model_params)
+    with open(path, "rb") as f:
+        reg.add_model(
+            "library", "tiny", "latest", f.read(), template=template,
+            params=params if params is not None
+            else {"temperature": 0.0, "num_predict": 16})
+    return "library/tiny:latest"
