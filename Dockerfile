@@ -35,8 +35,10 @@ RUN pip install --no-cache-dir numpy ml_dtypes einops && \
 # native dequant kernels (ctypes-loaded from native/build/; gguf/native.py
 # also builds lazily at runtime if this layer is skipped)
 RUN mkdir -p native/build && \
-    g++ -O3 -march=native -shared -fPIC -o \
-      native/build/libtpuop_dequant.so native/dequant.cpp || true
+    (g++ -O3 -march=native -shared -fPIC -o \
+      native/build/libtpuop_dequant.so native/dequant.cpp || true) && \
+    (g++ -O3 -std=c++17 -shared -fPIC -o \
+      native/build/libtpuop_grammar.so native/grammar.cpp || true)
 
 ENV PYTHONUNBUFFERED=1
 EXPOSE 11434

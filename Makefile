@@ -22,10 +22,14 @@ lint:
 	$(PY) -m pyflakes ollama_operator_tpu tests 2>/dev/null || \
 	  $(PY) -m py_compile $$(git ls-files '*.py')
 
-native:  ## build the C++ dequant library
+# (grammar otherwise builds lazily at the first format:"json" request —
+# a latency spike)
+native:  ## build the C++ dequant + grammar libraries
 	mkdir -p native/build
 	g++ -O3 -march=native -shared -fPIC \
 	  -o native/build/libtpuop_dequant.so native/dequant.cpp
+	g++ -O3 -std=c++17 -shared -fPIC \
+	  -o native/build/libtpuop_grammar.so native/grammar.cpp
 
 bench:  ## headline decode-throughput benchmark (one JSON line)
 	$(PY) bench.py

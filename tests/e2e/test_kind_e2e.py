@@ -89,6 +89,10 @@ spec:
 
 @pytest.fixture(scope="module")
 def cluster():
+    # idempotent: a stale cluster (E2E_KEEP=1 or a killed run) must not
+    # error the fixture
+    subprocess.run(["kind", "delete", "cluster", "--name", CLUSTER],
+                   cwd=ROOT, timeout=300)
     run("kind", "create", "cluster", "--name", CLUSTER,
         "--config", "hack/kind-config.yaml")
     try:

@@ -169,7 +169,10 @@ class FakeRegistry:
         self.port = self.httpd.server_address[1]
         threading.Thread(target=self.httpd.serve_forever,
                          daemon=True).start()
-        return f"http://127.0.0.1:{self.port}"
+        # loopback URL for in-process callers; 0.0.0.0 binds are reached
+        # by cluster DNS, not this return value
+        url_host = "127.0.0.1" if host in ("0.0.0.0", "") else host
+        return f"http://{url_host}:{self.port}"
 
     def stop(self):
         if self.httpd:

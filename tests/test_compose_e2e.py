@@ -113,19 +113,21 @@ class ExecKubelet:
                    "ollama_operator_tpu.server.pull"] + args[1:]
         else:
             raise AssertionError(f"unknown container args {args}")
-        log = open(os.path.join(self.pvc, f"{c['name']}-{port}.log"),
-                   "wb+")
-        return subprocess.Popen(
-            cmd, env=self._env_for(c.get("env") or [], port), cwd=REPO,
-            stdout=subprocess.DEVNULL, stderr=log)
+        log_path = os.path.join(self.pvc, f"{c['name']}-{port}.log")
+        with open(log_path, "wb") as log:
+            proc = subprocess.Popen(
+                cmd, env=self._env_for(c.get("env") or [], port), cwd=REPO,
+                stdout=subprocess.DEVNULL, stderr=log)
+        proc.log_path = log_path
+        return proc
 
     @staticmethod
     def _tail(proc, n=2000):
         try:
-            proc.stderr.seek(0, 2)
-            size = proc.stderr.tell()
-            proc.stderr.seek(max(0, size - n))
-            return proc.stderr.read().decode("utf-8", "replace")
+            with open(proc.log_path, "rb") as f:
+                f.seek(0, 2)
+                f.seek(max(0, f.tell() - n))
+                return f.read().decode("utf-8", "replace")
         except Exception:  # noqa: BLE001
             return "<no stderr captured>"
 
