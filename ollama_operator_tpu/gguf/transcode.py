@@ -75,9 +75,34 @@ def config_from_gguf(f: GGUFFile) -> ModelConfig:
         cfg = ModelConfig(arch="llama", attn_bias=True, **base)
         if "output.weight" not in f.tensors:
             cfg = ModelConfig(**{**cfg.__dict__, "tie_embeddings": True})
+    elif arch == "qwen3":
+        # qwen2 minus the qkv bias, plus per-head RMS on q/k
+        cfg = ModelConfig(arch="llama", qk_norm=True, **base)
+        if "output.weight" not in f.tensors:
+            cfg = ModelConfig(**{**cfg.__dict__, "tie_embeddings": True})
     elif arch == "gemma":
         cfg = ModelConfig(arch="llama", act="gelu_tanh", emb_scale=True,
                           tie_embeddings=True, norm_weight_offset=1.0, **base)
+    elif arch == "gemma2":
+        if not base.get("sliding_window"):
+            # alternation is part of the arch; a gguf without the window
+            # metadata must fail loudly, not silently serve full attention
+            raise ValueError(
+                "gemma2 GGUF lacks attention.sliding_window metadata")
+        # llama.cpp writes no query_pre_attn_scalar key; its graph builder
+        # switches on model type — 27B (the only 46-layer gemma2) scales
+        # by 1/sqrt(n_embd/n_head), 2B/9B by 1/sqrt(head_dim)
+        qpas = float(f.field("attention.query_pre_attn_scalar", 0) or 0)
+        if not qpas and base["n_layers"] == 46:
+            qpas = base["dim"] / base["n_heads"]
+        cfg = ModelConfig(
+            arch="llama", act="gelu_tanh", emb_scale=True,
+            tie_embeddings=True, norm_weight_offset=1.0, post_norms=True,
+            altern_sliding=True,
+            attn_softcap=float(f.field("attn_logit_softcapping", 50.0)),
+            logit_softcap=float(f.field("final_logit_softcapping", 30.0)),
+            attn_scale=qpas,
+            **base)
     elif arch == "phi2":
         base["norm_eps"] = float(f.field("attention.layer_norm_epsilon",
                                          1e-5))
@@ -230,6 +255,17 @@ def load_params(f: GGUFFile, cfg: Optional[ModelConfig] = None,
         layers["bo"] = stack("blk.{}.attn_output.bias")
         layers["b_up"] = stack("blk.{}.ffn_up.bias")
         layers["b_down"] = stack("blk.{}.ffn_down.bias")
+    if cfg.post_norms:
+        # llama.cpp gguf-py names: ATTN_POST_NORM = post_attention_norm,
+        # FFN_POST_NORM = post_ffw_norm
+        layers["post_attn_norm_w"] = (
+            stack("blk.{}.post_attention_norm.weight", required=False)
+            if "blk.0.post_attention_norm.weight" in f.tensors
+            else stack("blk.{}.attn_post_norm.weight"))
+        layers["post_ffw_norm_w"] = (
+            stack("blk.{}.post_ffw_norm.weight", required=False)
+            if "blk.0.post_ffw_norm.weight" in f.tensors
+            else stack("blk.{}.ffn_post_norm.weight"))
     if cfg.qk_norm:
         layers["q_norm_w"] = stack("blk.{}.attn_q_norm.weight")
         layers["k_norm_w"] = stack("blk.{}.attn_k_norm.weight")

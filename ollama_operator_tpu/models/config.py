@@ -49,6 +49,15 @@ class ModelConfig:
     emb_scale: bool = False            # gemma: scale embeddings by sqrt(dim)
     logit_softcap: float = 0.0         # gemma2: tanh soft-capping of logits
     attn_softcap: float = 0.0          # gemma2: tanh soft-capping of scores
+    post_norms: bool = False           # gemma2: sandwich norms — extra RMS
+                                       # on attn/mlp OUTPUTS before the
+                                       # residual adds
+    altern_sliding: bool = False       # gemma2: even layers use the
+                                       # sliding window, odd layers full
+                                       # attention (einsum path only)
+    attn_scale: float = 0.0            # gemma2 query_pre_attn_scalar:
+                                       # scores scale 1/sqrt(this);
+                                       # 0 = 1/sqrt(head_dim)
     qk_norm: bool = False              # qwen3/llama4-style per-head RMS on q,k
     # mixture-of-experts (mixtral family); 0 experts = dense MLP
     n_experts: int = 0                 # total routed experts per layer
@@ -131,6 +140,24 @@ PRESETS = {
     "mistral": _mk(arch="llama", vocab_size=32000, dim=4096, n_layers=32,
                    n_heads=32, n_kv_heads=8, head_dim=128, ffn_dim=14336,
                    sliding_window=4096, max_seq_len=32768),
+    "gemma2": _mk(arch="llama", vocab_size=256000, dim=3584, n_layers=42,
+                  n_heads=16, n_kv_heads=8, head_dim=256, ffn_dim=14336,
+                  act="gelu_tanh", emb_scale=True, tie_embeddings=True,
+                  norm_weight_offset=1.0, post_norms=True,
+                  altern_sliding=True, sliding_window=4096,
+                  attn_softcap=50.0, logit_softcap=30.0,
+                  max_seq_len=8192),
+    "gemma2:27b": _mk(arch="llama", vocab_size=256000, dim=4608,
+                      n_layers=46, n_heads=32, n_kv_heads=16, head_dim=128,
+                      ffn_dim=36864, act="gelu_tanh", emb_scale=True,
+                      tie_embeddings=True, norm_weight_offset=1.0,
+                      post_norms=True, altern_sliding=True,
+                      sliding_window=4096, attn_softcap=50.0,
+                      logit_softcap=30.0, attn_scale=144.0,
+                      max_seq_len=8192),
+    "qwen3": _mk(arch="llama", vocab_size=151936, dim=4096, n_layers=36,
+                 n_heads=32, n_kv_heads=8, head_dim=128, ffn_dim=12288,
+                 qk_norm=True, rope_theta=1000000.0, max_seq_len=32768),
     "qwen2": _mk(arch="llama", vocab_size=152064, dim=3584, n_layers=28,
                  n_heads=28, n_kv_heads=4, head_dim=128, ffn_dim=18944,
                  attn_bias=True, rope_theta=1000000.0, max_seq_len=32768),

@@ -110,6 +110,9 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
     if cfg.qk_norm:
         layers["q_norm_w"] = jnp.ones((L, cfg.head_dim), dtype)
         layers["k_norm_w"] = jnp.ones((L, cfg.head_dim), dtype)
+    if cfg.post_norms:
+        layers["post_attn_norm_w"] = jnp.ones((L, D), dtype)
+        layers["post_ffw_norm_w"] = jnp.ones((L, D), dtype)
 
     params: Params = {
         "tok_emb": w(next(keys), (V, D)),
@@ -128,6 +131,22 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
 # --------------------------------------------------------------------------
 # building blocks
 # --------------------------------------------------------------------------
+
+def _causal_window_mask(k_pos, q_pos, window: int):
+    """Additive [B,1,T,A] mask for cache attention: keys at absolute slot
+    k_pos visible to queries at q_pos iff k <= q (within ``window`` when
+    set). Shared by the dense and paged cached forwards."""
+    ok = k_pos <= q_pos
+    if window:
+        ok = ok & (k_pos > q_pos - window)
+    return jnp.where(ok, 0.0, -1e30).astype(jnp.float32)[:, None, :, :]
+
+
+def _attn_scale(cfg: ModelConfig) -> float:
+    """Score scale: 1/sqrt(head_dim), or gemma2's
+    1/sqrt(query_pre_attn_scalar) when the config sets one."""
+    return 1.0 / math.sqrt(cfg.attn_scale or cfg.head_dim)
+
 
 def _norm(cfg: ModelConfig, x, w, b=None):
     if cfg.norm_type == "layernorm":
@@ -239,11 +258,17 @@ def _proj_out(cfg, lp, attn_out, B, T):
 
 
 def _residual(cfg: ModelConfig, lp, x, h, attn):
+    if cfg.post_norms:
+        # gemma2 sandwich norms: attn/mlp OUTPUTS normed before the adds
+        attn = _norm(cfg, attn, lp["post_attn_norm_w"])
     if cfg.parallel_block:
         return x + attn + _mlp(cfg, lp, h)
     x = x + attn
     h2 = _norm(cfg, x, lp["mlp_norm_w"], lp.get("mlp_norm_b"))
-    return x + _mlp(cfg, lp, h2)
+    m = _mlp(cfg, lp, h2)
+    if cfg.post_norms:
+        m = _norm(cfg, m, lp["post_ffw_norm_w"])
+    return x + m
 
 
 def _block_chunk(cfg: ModelConfig, lp, x, cos, sin, mask, scale,
@@ -257,10 +282,14 @@ def _block_chunk(cfg: ModelConfig, lp, x, cos, sin, mask, scale,
     q, k, v = _qkv(cfg, lp, h, cos, sin)
     k = k.transpose(0, 2, 1, 3)
     v = v.transpose(0, 2, 1, 3)
-    if attn_fn is None:
-        attn = chunk_attention(cfg, q, k, v, mask, scale, mesh=mesh)
-    else:
+    if attn_fn is not None:
         attn = attn_fn(q, k, v)
+    elif cfg.altern_sliding:
+        # per-layer window rides the mask (traced); kernel dispatch needs
+        # a static window, so alternating archs stay on the einsum path
+        attn = attend_hf(q, k, v, mask, scale, cfg.attn_softcap)
+    else:
+        attn = chunk_attention(cfg, q, k, v, mask, scale, mesh=mesh)
     attn = _proj_out(cfg, lp, attn, B, T)
     return _residual(cfg, lp, x, h, attn), (k, v)
 
@@ -341,7 +370,7 @@ def prefill_chunk(params: Params, cfg: ModelConfig, tokens: jax.Array,
     head-first, matching the cache layout.
     """
     B, T = tokens.shape
-    scale = 1.0 / math.sqrt(cfg.head_dim)
+    scale = _attn_scale(cfg)
     positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
     cos, sin = rope_angles(positions, cfg.rotary_dim, cfg.rope_theta,
                            cfg.rope_scaling)
@@ -353,12 +382,26 @@ def prefill_chunk(params: Params, cfg: ModelConfig, tokens: jax.Array,
     else:
         x = _embed(cfg, params, tokens)
 
-    def body(x, lp):
-        x, (k, v) = _block_chunk(cfg, lp, x, cos, sin, mask, scale,
-                                 mesh=mesh)
-        return x, (k, v)
+    if cfg.altern_sliding:
+        # gemma2: even layers sliding-window, odd layers full attention
+        m_full = jnp.broadcast_to(causal_mask(T, T, 0), (B, 1, T, T))
 
-    x, (ks, vs) = lax.scan(body, x, params["layers"])
+        def body_a(x, layer_in):
+            lp, i = layer_in
+            mask_l = jnp.where(i % 2 == 0, mask, m_full)
+            x, (k, v) = _block_chunk(cfg, lp, x, cos, sin, mask_l, scale,
+                                     mesh=mesh)
+            return x, (k, v)
+
+        x, (ks, vs) = lax.scan(
+            body_a, x, (params["layers"], jnp.arange(cfg.n_layers)))
+    else:
+        def body(x, lp):
+            x, (k, v) = _block_chunk(cfg, lp, x, cos, sin, mask, scale,
+                                     mesh=mesh)
+            return x, (k, v)
+
+        x, (ks, vs) = lax.scan(body, x, params["layers"])
     logits = _unembed(cfg, params, x)
     return logits, ks, vs
 
@@ -386,7 +429,7 @@ def forward_with_cache(params: Params, cfg: ModelConfig, tokens: jax.Array,
     kc_arr = k_cache["q"] if is_quantized_cache(k_cache) else k_cache
     L, _, _, S, _ = kc_arr.shape
     A = S if attn_len is None else min(attn_len, S)
-    scale = 1.0 / math.sqrt(cfg.head_dim)
+    scale = _attn_scale(cfg)
     positions = lengths[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
     cos, sin = rope_angles(positions, cfg.rotary_dim, cfg.rope_theta,
                            cfg.rope_scaling)
@@ -395,10 +438,10 @@ def forward_with_cache(params: Params, cfg: ModelConfig, tokens: jax.Array,
     # but satisfy j > p so they are masked.
     k_pos = jnp.arange(A, dtype=jnp.int32)[None, None, :]
     q_pos = positions[:, :, None]
-    ok = k_pos <= q_pos
-    if cfg.sliding_window:
-        ok = ok & (k_pos > q_pos - cfg.sliding_window)
-    mask = jnp.where(ok, 0.0, -1e30).astype(jnp.float32)[:, None, :, :]
+
+    mask = _causal_window_mask(k_pos, q_pos, cfg.sliding_window)
+    m_full = (_causal_window_mask(k_pos, q_pos, 0)
+              if cfg.altern_sliding else None)
 
     x = _embed(cfg, params, tokens)
 
@@ -420,6 +463,8 @@ def forward_with_cache(params: Params, cfg: ModelConfig, tokens: jax.Array,
     def body(carry, layer_in):
         x, kc, vc = carry
         lp, i = layer_in
+        mask_l = (jnp.where(i % 2 == 0, mask, m_full)
+                  if cfg.altern_sliding else mask)
         h = _norm(cfg, x, lp["attn_norm_w"], lp.get("attn_norm_b"))
         q, k, v = _qkv(cfg, lp, h, cos, sin)
         k = k.transpose(0, 2, 1, 3)                   # [B, KvH, T, hd]
@@ -436,15 +481,20 @@ def forward_with_cache(params: Params, cfg: ModelConfig, tokens: jax.Array,
                     "s": window(kc["s"], i, (1, B, KvH, A))}
             vwin = {"q": window(vc["q"], i, (1, B, KvH, A, hd)),
                     "s": window(vc["s"], i, (1, B, KvH, A))}
-            attn = QC.attend_hf_q(q, kwin, vwin, mask, scale,
+            attn = QC.attend_hf_q(q, kwin, vwin, mask_l, scale,
                                   cfg.attn_softcap, attn_len=A)
         else:
             kc = kc.at[i, bidx, hidx, pidx].set(k.astype(kc.dtype))
             vc = vc.at[i, bidx, hidx, pidx].set(v.astype(vc.dtype))
             kwin = window(kc, i, (1, B, KvH, A, hd))
             vwin = window(vc, i, (1, B, KvH, A, hd))
-            attn = cached_attention(cfg, q, kwin, vwin, mask, positions,
-                                    scale, attn_len=A, mesh=mesh)
+            if cfg.altern_sliding:
+                attn = attend_hf(q, kwin, vwin, mask_l, scale,
+                                 cfg.attn_softcap)
+            else:
+                attn = cached_attention(cfg, q, kwin, vwin, mask_l,
+                                        positions, scale, attn_len=A,
+                                        mesh=mesh)
         attn = _proj_out(cfg, lp, attn, B, T)
         x = _residual(cfg, lp, x, h, attn)
         return (x, kc, vc), None
@@ -540,6 +590,8 @@ def _paged_kernel_usable(cfg: ModelConfig, mesh, T: int, KvH: int, ps: int,
         return False
     if cfg.n_heads % KvH or ps % 8 or not _lane_ok(hd, mode == "interpret"):
         return False
+    if cfg.altern_sliding:
+        return False   # per-layer window rides the (traced) mask
     if mesh is not None and mesh.size > 1:
         tp = mesh.shape.get("tp", 1)
         if tp * 1 != mesh.size:            # engine enforces tp-only meshes
@@ -613,17 +665,17 @@ def forward_with_cache_paged(params: Params, cfg: ModelConfig,
     k_arr = k_pool["q"] if quant else k_pool
     L, P, KvH, ps, hd = k_arr.shape
     B, T = tokens.shape
-    scale = 1.0 / math.sqrt(cfg.head_dim)
+    scale = _attn_scale(cfg)
     positions = lengths[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
     cos, sin = rope_angles(positions, cfg.rotary_dim, cfg.rope_theta,
                            cfg.rope_scaling)
     S_attn = attn_blocks * ps
     k_pos = jnp.arange(S_attn, dtype=jnp.int32)[None, None, :]
     q_pos = positions[:, :, None]
-    ok = k_pos <= q_pos
-    if cfg.sliding_window:
-        ok = ok & (k_pos > q_pos - cfg.sliding_window)
-    mask = jnp.where(ok, 0.0, -1e30).astype(jnp.float32)[:, None, :, :]
+
+    mask = _causal_window_mask(k_pos, q_pos, cfg.sliding_window)
+    m_full = (_causal_window_mask(k_pos, q_pos, 0)
+              if cfg.altern_sliding else None)
 
     x = _embed(cfg, params, tokens)
     bi = jnp.arange(B)[:, None]
@@ -656,7 +708,9 @@ def forward_with_cache_paged(params: Params, cfg: ModelConfig,
         else:
             kp = _paged_scatter(kp, i, k.astype(k_arr.dtype), pg_w, off_w)
             vp = _paged_scatter(vp, i, v.astype(k_arr.dtype), pg_w, off_w)
-        attn = _paged_attend(cfg, q, kp, vp, i, tables, lengths, mask,
+        mask_l = (jnp.where(i % 2 == 0, mask, m_full)
+                  if cfg.altern_sliding else mask)
+        attn = _paged_attend(cfg, q, kp, vp, i, tables, lengths, mask_l,
                              scale, attn_blocks, mesh, use_kernel)
         attn = _proj_out(cfg, lp, attn, B, T)
         x = _residual(cfg, lp, x, h, attn)

@@ -28,8 +28,8 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..models.config import ModelConfig
-from ..models.decoder import (Params, _block_cached, _block_chunk, _embed,
-                              _unembed)
+from ..models.decoder import (Params, _attn_scale, _block_cached,
+                              _block_chunk, _embed, _unembed)
 from ..ops.rope import rope_angles
 from .ring_attention import (ring_attention, sp_cache_write,
                              sp_decode_attention)
@@ -50,7 +50,10 @@ def prefill_chunk_sp(params: Params, cfg: ModelConfig, tokens: jax.Array,
     sp = mesh.shape[SP_AXIS]
     B, T = tokens.shape
     assert T % sp == 0, f"prefill length {T} must divide sp={sp}"
-    scale = 1.0 / math.sqrt(cfg.head_dim)
+    assert not cfg.altern_sliding, (
+        "per-layer alternating windows (gemma2) are not implemented on "
+        "the sequence-parallel path")
+    scale = _attn_scale(cfg)
 
     def inner(tokens, inputs_embeds):
         my = lax.axis_index(SP_AXIS)
@@ -100,7 +103,10 @@ def forward_with_cache_sp(params: Params, cfg: ModelConfig,
     the cache reads/writes are sharded.
     Returns (logits [B,T,V] replicated, k_cache, v_cache).
     """
-    scale = 1.0 / math.sqrt(cfg.head_dim)
+    assert not cfg.altern_sliding, (
+        "per-layer alternating windows (gemma2) are not implemented on "
+        "the sequence-parallel path")
+    scale = _attn_scale(cfg)
     quant = isinstance(k_cache, dict)
 
     def inner(tokens, k_cache, v_cache, lengths):

@@ -39,7 +39,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 import dataclasses
 
 from ..models.config import ModelConfig
-from ..models.decoder import Params, _block_cached, _embed, _unembed
+from ..models.decoder import _attn_scale, Params, _block_cached, _embed, _unembed
 from ..ops.rope import rope_angles
 from .sharding import resolve_moe_impl
 
@@ -84,7 +84,10 @@ def forward_with_cache_pp(params: Params, cfg: ModelConfig,
     assert M >= pp, f"need at least pp={pp} microbatches, got {M}"
     b = B // M
     Lpp = L // pp
-    scale = 1.0 / math.sqrt(cfg.head_dim)
+    assert not cfg.altern_sliding, (
+        "per-layer alternating windows (gemma2) are not "
+        "implemented on the pipeline path")
+    scale = _attn_scale(cfg)
     KvH, hd = cfg.n_kv_heads, cfg.head_dim
     S = k_cache.shape[3]
 

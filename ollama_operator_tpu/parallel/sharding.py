@@ -37,6 +37,8 @@ _LAYER_SPECS: Dict[str, P] = {
     "b_down": P(None, None),
     "q_norm_w": P(None, None),
     "k_norm_w": P(None, None),
+    "post_attn_norm_w": P(None, None),
+    "post_ffw_norm_w": P(None, None),
     # MoE (mixtral family): experts on "ep", per-expert Megatron TP on "tp"
     "router": P(None, None, None),
     "we_gate": P(None, "ep", None, "tp"),

@@ -1,0 +1,377 @@
+"""Cross-implementation parity for every served model family.
+
+Extends tests/test_parity_hf.py's anchor (our GGUF→transcode→JAX pipeline
+vs transformers on identical weights) beyond llama: mistral (sliding
+window), qwen2 (attention bias, no rope permute), gemma (GeGLU, +1 norm
+offset, embedding scaling, tied head, wide head_dim), phi-2 (parallel
+block, partial rotary, LayerNorm, biases everywhere). Each exporter
+follows the family's llama.cpp conversion conventions (permute only for
+the llama family; everything else NEOX-layout), so the per-arch transcode
+paths are validated against the ecosystem-canonical implementations —
+SURVEY §7 risk 1 across ALL families, not just llama.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ollama_operator_tpu.gguf import writer as W
+from ollama_operator_tpu.gguf.transcode import load_model as transcode_load
+from ollama_operator_tpu.models import decoder
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from test_parity_hf import hf_permute  # noqa: E402
+
+IDS = [3, 1, 4, 1, 5, 9, 2, 6, 53, 58, 97, 93]
+
+
+def _base_meta(w, arch, hf_cfg, head_dim=None):
+    H = hf_cfg.num_attention_heads
+    w.add_meta("general.architecture", arch)
+    w.add_meta(f"{arch}.block_count", hf_cfg.num_hidden_layers)
+    w.add_meta(f"{arch}.embedding_length", hf_cfg.hidden_size)
+    w.add_meta(f"{arch}.attention.head_count", H)
+    w.add_meta(f"{arch}.attention.head_count_kv",
+               getattr(hf_cfg, "num_key_value_heads", H))
+    w.add_meta(f"{arch}.attention.key_length",
+               head_dim or hf_cfg.hidden_size // H)
+    w.add_meta(f"{arch}.feed_forward_length", hf_cfg.intermediate_size)
+    w.add_meta(f"{arch}.context_length", hf_cfg.max_position_embeddings)
+    w.add_meta(f"{arch}.rope.freq_base", float(hf_cfg.rope_theta))
+    V = hf_cfg.vocab_size
+    w.add_meta("tokenizer.ggml.model", "llama")
+    w.add_meta("tokenizer.ggml.tokens", [f"t{i}" for i in range(V)])
+    w.add_meta("tokenizer.ggml.scores", [0.0] * V)
+    w.add_meta("tokenizer.ggml.token_type", [1] * V)
+
+
+def _sd(model):
+    return {k: v.detach().cpu().numpy().astype(np.float32)
+            for k, v in model.state_dict().items()}
+
+
+def _our_logits(path):
+    cfg, params, _ = transcode_load(path, dtype=np.float32)
+    params = jax.tree.map(jnp.asarray, params)
+    logits, _, _ = decoder.prefill_chunk(
+        params, cfg, jnp.asarray(np.array(IDS, np.int32)[None]))
+    return np.asarray(logits[0], np.float64)
+
+
+def _ref_logits(model):
+    with torch.no_grad():
+        return model(torch.tensor([IDS])).logits[0].numpy() \
+            .astype(np.float64)
+
+
+def _check(path, model, rtol=3e-4, atol=3e-4):
+    ref = _ref_logits(model)
+    got = _our_logits(path)
+    assert np.abs(ref).max() > 0.05       # a meaningful comparison
+    np.testing.assert_allclose(got, ref, rtol=rtol, atol=atol)
+
+
+# ---------------------------------------------------------------------------
+
+def test_mistral_sliding_window(tmp_path):
+    cfg = transformers.MistralConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rope_theta=10000.0, sliding_window=6,
+        attn_implementation="eager")
+    torch.manual_seed(1)
+    model = transformers.MistralForCausalLM(cfg).eval()
+    sd = _sd(model)
+    w = W.GGUFWriter(str(tmp_path / "mistral.gguf"))
+    _base_meta(w, "llama", cfg)   # mistral ships as arch "llama" in GGUF
+    w.add_meta("llama.attention.sliding_window", cfg.sliding_window)
+    w.add_meta("llama.attention.layer_norm_rms_epsilon",
+               float(cfg.rms_norm_eps))
+    H, KvH = cfg.num_attention_heads, cfg.num_key_value_heads
+    w.add_tensor_f32("token_embd.weight", sd["model.embed_tokens.weight"])
+    w.add_tensor_f32("output_norm.weight", sd["model.norm.weight"])
+    w.add_tensor_f32("output.weight", sd["lm_head.weight"])
+    for i in range(cfg.num_hidden_layers):
+        p, b = f"model.layers.{i}.", f"blk.{i}."
+        w.add_tensor_f32(b + "attn_norm.weight",
+                         sd[p + "input_layernorm.weight"])
+        w.add_tensor_f32(b + "attn_q.weight",
+                         hf_permute(sd[p + "self_attn.q_proj.weight"], H))
+        w.add_tensor_f32(b + "attn_k.weight",
+                         hf_permute(sd[p + "self_attn.k_proj.weight"], KvH))
+        w.add_tensor_f32(b + "attn_v.weight",
+                         sd[p + "self_attn.v_proj.weight"])
+        w.add_tensor_f32(b + "attn_output.weight",
+                         sd[p + "self_attn.o_proj.weight"])
+        w.add_tensor_f32(b + "ffn_norm.weight",
+                         sd[p + "post_attention_layernorm.weight"])
+        w.add_tensor_f32(b + "ffn_gate.weight",
+                         sd[p + "mlp.gate_proj.weight"])
+        w.add_tensor_f32(b + "ffn_up.weight", sd[p + "mlp.up_proj.weight"])
+        w.add_tensor_f32(b + "ffn_down.weight",
+                         sd[p + "mlp.down_proj.weight"])
+    w.write()
+    _check(str(tmp_path / "mistral.gguf"), model)
+
+
+def test_qwen2_attention_bias_no_permute(tmp_path):
+    cfg = transformers.Qwen2Config(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rope_theta=10000.0,
+        attn_implementation="eager")
+    torch.manual_seed(2)
+    model = transformers.Qwen2ForCausalLM(cfg).eval()
+    sd = _sd(model)
+    w = W.GGUFWriter(str(tmp_path / "qwen2.gguf"))
+    _base_meta(w, "qwen2", cfg)
+    w.add_meta("qwen2.attention.layer_norm_rms_epsilon",
+               float(cfg.rms_norm_eps))
+    w.add_tensor_f32("token_embd.weight", sd["model.embed_tokens.weight"])
+    w.add_tensor_f32("output_norm.weight", sd["model.norm.weight"])
+    w.add_tensor_f32("output.weight", sd["lm_head.weight"])
+    for i in range(cfg.num_hidden_layers):
+        p, b = f"model.layers.{i}.", f"blk.{i}."
+        w.add_tensor_f32(b + "attn_norm.weight",
+                         sd[p + "input_layernorm.weight"])
+        # qwen2 is NEOX layout: llama.cpp does NOT permute q/k
+        for src, dst in (("q_proj", "attn_q"), ("k_proj", "attn_k"),
+                         ("v_proj", "attn_v")):
+            w.add_tensor_f32(b + dst + ".weight",
+                             sd[p + f"self_attn.{src}.weight"])
+            w.add_tensor_f32(b + dst + ".bias",
+                             sd[p + f"self_attn.{src}.bias"])
+        w.add_tensor_f32(b + "attn_output.weight",
+                         sd[p + "self_attn.o_proj.weight"])
+        w.add_tensor_f32(b + "ffn_norm.weight",
+                         sd[p + "post_attention_layernorm.weight"])
+        w.add_tensor_f32(b + "ffn_gate.weight",
+                         sd[p + "mlp.gate_proj.weight"])
+        w.add_tensor_f32(b + "ffn_up.weight", sd[p + "mlp.up_proj.weight"])
+        w.add_tensor_f32(b + "ffn_down.weight",
+                         sd[p + "mlp.down_proj.weight"])
+    w.write()
+    _check(str(tmp_path / "qwen2.gguf"), model)
+
+
+def test_gemma_geglu_norm_offset_tied_head(tmp_path):
+    cfg = transformers.GemmaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=32, max_position_embeddings=128, rope_theta=10000.0,
+        hidden_act="gelu_pytorch_tanh", attn_implementation="eager")
+    torch.manual_seed(3)
+    model = transformers.GemmaForCausalLM(cfg).eval()
+    sd = _sd(model)
+    w = W.GGUFWriter(str(tmp_path / "gemma.gguf"))
+    _base_meta(w, "gemma", cfg, head_dim=cfg.head_dim)
+    w.add_meta("gemma.attention.layer_norm_rms_epsilon",
+               float(cfg.rms_norm_eps))
+    w.add_tensor_f32("token_embd.weight", sd["model.embed_tokens.weight"])
+    # gemma norms ship as stored (HF keeps w with (1+w) semantics); no
+    # output.weight — the head ties to the embedding
+    w.add_tensor_f32("output_norm.weight", sd["model.norm.weight"])
+    for i in range(cfg.num_hidden_layers):
+        p, b = f"model.layers.{i}.", f"blk.{i}."
+        w.add_tensor_f32(b + "attn_norm.weight",
+                         sd[p + "input_layernorm.weight"])
+        for src, dst in (("q_proj", "attn_q"), ("k_proj", "attn_k"),
+                         ("v_proj", "attn_v")):
+            w.add_tensor_f32(b + dst + ".weight",
+                             sd[p + f"self_attn.{src}.weight"])
+        w.add_tensor_f32(b + "attn_output.weight",
+                         sd[p + "self_attn.o_proj.weight"])
+        w.add_tensor_f32(b + "ffn_norm.weight",
+                         sd[p + "post_attention_layernorm.weight"])
+        w.add_tensor_f32(b + "ffn_gate.weight",
+                         sd[p + "mlp.gate_proj.weight"])
+        w.add_tensor_f32(b + "ffn_up.weight", sd[p + "mlp.up_proj.weight"])
+        w.add_tensor_f32(b + "ffn_down.weight",
+                         sd[p + "mlp.down_proj.weight"])
+    w.write()
+    _check(str(tmp_path / "gemma.gguf"), model)
+
+
+def test_phi2_parallel_block_partial_rotary(tmp_path):
+    cfg = transformers.PhiConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=128, rope_theta=10000.0,
+        partial_rotary_factor=0.5, layer_norm_eps=1e-5,
+        attn_implementation="eager")
+    torch.manual_seed(4)
+    model = transformers.PhiForCausalLM(cfg).eval()
+    sd = _sd(model)
+    hd = cfg.hidden_size // cfg.num_attention_heads
+    w = W.GGUFWriter(str(tmp_path / "phi2.gguf"))
+    _base_meta(w, "phi2", cfg)
+    w.add_meta("phi2.attention.layer_norm_epsilon",
+               float(cfg.layer_norm_eps))
+    w.add_meta("phi2.rope.dimension_count",
+               int(hd * cfg.partial_rotary_factor))
+    w.add_tensor_f32("token_embd.weight", sd["model.embed_tokens.weight"])
+    w.add_tensor_f32("output_norm.weight", sd["model.final_layernorm.weight"])
+    w.add_tensor_f32("output_norm.bias", sd["model.final_layernorm.bias"])
+    w.add_tensor_f32("output.weight", sd["lm_head.weight"])
+    w.add_tensor_f32("output.bias", sd["lm_head.bias"])
+    for i in range(cfg.num_hidden_layers):
+        p, b = f"model.layers.{i}.", f"blk.{i}."
+        w.add_tensor_f32(b + "attn_norm.weight",
+                         sd[p + "input_layernorm.weight"])
+        w.add_tensor_f32(b + "attn_norm.bias",
+                         sd[p + "input_layernorm.bias"])
+        for src, dst in (("q_proj", "attn_q"), ("k_proj", "attn_k"),
+                         ("v_proj", "attn_v")):
+            w.add_tensor_f32(b + dst + ".weight",
+                             sd[p + f"self_attn.{src}.weight"])
+            w.add_tensor_f32(b + dst + ".bias",
+                             sd[p + f"self_attn.{src}.bias"])
+        w.add_tensor_f32(b + "attn_output.weight",
+                         sd[p + "self_attn.dense.weight"])
+        w.add_tensor_f32(b + "attn_output.bias",
+                         sd[p + "self_attn.dense.bias"])
+        w.add_tensor_f32(b + "ffn_up.weight", sd[p + "mlp.fc1.weight"])
+        w.add_tensor_f32(b + "ffn_up.bias", sd[p + "mlp.fc1.bias"])
+        w.add_tensor_f32(b + "ffn_down.weight", sd[p + "mlp.fc2.weight"])
+        w.add_tensor_f32(b + "ffn_down.bias", sd[p + "mlp.fc2.bias"])
+    w.write()
+    _check(str(tmp_path / "phi2.gguf"), model)
+
+
+def _export_gemma2(path, model, cfg):
+    sd = _sd(model)
+    w = W.GGUFWriter(path)
+    _base_meta(w, "gemma2", cfg, head_dim=cfg.head_dim)
+    w.add_meta("gemma2.attention.layer_norm_rms_epsilon",
+               float(cfg.rms_norm_eps))
+    w.add_meta("gemma2.attention.sliding_window", cfg.sliding_window)
+    w.add_meta("gemma2.attn_logit_softcapping",
+               float(cfg.attn_logit_softcapping))
+    w.add_meta("gemma2.final_logit_softcapping",
+               float(cfg.final_logit_softcapping))
+    w.add_meta("gemma2.attention.query_pre_attn_scalar",
+               float(cfg.query_pre_attn_scalar))
+    w.add_tensor_f32("token_embd.weight", sd["model.embed_tokens.weight"])
+    w.add_tensor_f32("output_norm.weight", sd["model.norm.weight"])
+    for i in range(cfg.num_hidden_layers):
+        p, b = f"model.layers.{i}.", f"blk.{i}."
+        w.add_tensor_f32(b + "attn_norm.weight",
+                         sd[p + "input_layernorm.weight"])
+        for src, dst in (("q_proj", "attn_q"), ("k_proj", "attn_k"),
+                         ("v_proj", "attn_v")):
+            w.add_tensor_f32(b + dst + ".weight",
+                             sd[p + f"self_attn.{src}.weight"])
+        w.add_tensor_f32(b + "attn_output.weight",
+                         sd[p + "self_attn.o_proj.weight"])
+        w.add_tensor_f32(b + "post_attention_norm.weight",
+                         sd[p + "post_attention_layernorm.weight"])
+        w.add_tensor_f32(b + "ffn_norm.weight",
+                         sd[p + "pre_feedforward_layernorm.weight"])
+        w.add_tensor_f32(b + "post_ffw_norm.weight",
+                         sd[p + "post_feedforward_layernorm.weight"])
+        w.add_tensor_f32(b + "ffn_gate.weight",
+                         sd[p + "mlp.gate_proj.weight"])
+        w.add_tensor_f32(b + "ffn_up.weight", sd[p + "mlp.up_proj.weight"])
+        w.add_tensor_f32(b + "ffn_down.weight",
+                         sd[p + "mlp.down_proj.weight"])
+    w.write()
+
+
+def test_gemma2_sandwich_norms_softcaps_alternating_window(tmp_path):
+    """gemma2: post-attn/post-ffw sandwich norms, attn + final logit
+    soft-capping, query_pre_attn_scalar score scale, and alternating
+    sliding/global layers — every piece validated at once against
+    transformers' Gemma2ForCausalLM."""
+    cfg = transformers.Gemma2Config(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=32, max_position_embeddings=128, rope_theta=10000.0,
+        sliding_window=6, query_pre_attn_scalar=24.0,
+        attn_logit_softcapping=50.0, final_logit_softcapping=30.0,
+        hidden_act="gelu_pytorch_tanh", attn_implementation="eager")
+    torch.manual_seed(5)
+    model = transformers.Gemma2ForCausalLM(cfg).eval()
+    path = str(tmp_path / "gemma2.gguf")
+    _export_gemma2(path, model, cfg)
+    _check(path, model)
+
+
+def test_gemma2_greedy_decode_matches_transformers(tmp_path):
+    """The CACHED decode path (per-layer alternating windows against the
+    slot KV cache) must continue exactly like transformers' greedy
+    generate — prefill parity alone wouldn't catch a wrong per-layer
+    window in forward_with_cache."""
+    from ollama_operator_tpu.runtime.engine import (Engine, EngineConfig,
+                                                    SlotOptions)
+    cfg = transformers.Gemma2Config(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=32, max_position_embeddings=128, rope_theta=10000.0,
+        sliding_window=6, query_pre_attn_scalar=24.0,
+        attn_logit_softcapping=50.0, final_logit_softcapping=30.0,
+        hidden_act="gelu_pytorch_tanh", attn_implementation="eager")
+    torch.manual_seed(5)
+    model = transformers.Gemma2ForCausalLM(cfg).eval()
+    with torch.no_grad():
+        ref = model.generate(torch.tensor([IDS]), max_new_tokens=6,
+                             do_sample=False)[0, len(IDS):].tolist()
+
+    path = str(tmp_path / "g2.gguf")
+    _export_gemma2(path, model, cfg)
+    mcfg, params, _ = transcode_load(path, dtype=np.float32)
+    params = jax.tree.map(jnp.asarray, params)
+    eng = Engine(mcfg, params,
+                 ecfg=EngineConfig(max_slots=1, max_seq_len=64,
+                                   cache_dtype=jnp.float32,
+                                   min_prefill_bucket=16))
+    g = SlotOptions(temperature=0.0, repeat_penalty=1.0)
+    got = [eng.admit(0, np.array(IDS, np.int32), g)]
+    for _ in range(5):
+        got.append(int(eng.decode()[0]))
+    assert got == ref, (got, ref)
+
+
+def test_qwen3_qk_norm(tmp_path):
+    """qwen3: per-head RMS norms on q/k (no qkv bias, NEOX layout)."""
+    cfg = transformers.Qwen3Config(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=128, rope_theta=10000.0,
+        attn_implementation="eager")
+    torch.manual_seed(6)
+    model = transformers.Qwen3ForCausalLM(cfg).eval()
+    sd = _sd(model)
+    w = W.GGUFWriter(str(tmp_path / "qwen3.gguf"))
+    _base_meta(w, "qwen3", cfg, head_dim=cfg.head_dim)
+    w.add_meta("qwen3.attention.layer_norm_rms_epsilon",
+               float(cfg.rms_norm_eps))
+    w.add_tensor_f32("token_embd.weight", sd["model.embed_tokens.weight"])
+    w.add_tensor_f32("output_norm.weight", sd["model.norm.weight"])
+    w.add_tensor_f32("output.weight", sd["lm_head.weight"])
+    for i in range(cfg.num_hidden_layers):
+        p, b = f"model.layers.{i}.", f"blk.{i}."
+        w.add_tensor_f32(b + "attn_norm.weight",
+                         sd[p + "input_layernorm.weight"])
+        for src, dst in (("q_proj", "attn_q"), ("k_proj", "attn_k"),
+                         ("v_proj", "attn_v")):
+            w.add_tensor_f32(b + dst + ".weight",
+                             sd[p + f"self_attn.{src}.weight"])
+        w.add_tensor_f32(b + "attn_q_norm.weight",
+                         sd[p + "self_attn.q_norm.weight"])
+        w.add_tensor_f32(b + "attn_k_norm.weight",
+                         sd[p + "self_attn.k_norm.weight"])
+        w.add_tensor_f32(b + "attn_output.weight",
+                         sd[p + "self_attn.o_proj.weight"])
+        w.add_tensor_f32(b + "ffn_norm.weight",
+                         sd[p + "post_attention_layernorm.weight"])
+        w.add_tensor_f32(b + "ffn_gate.weight",
+                         sd[p + "mlp.gate_proj.weight"])
+        w.add_tensor_f32(b + "ffn_up.weight", sd[p + "mlp.up_proj.weight"])
+        w.add_tensor_f32(b + "ffn_down.weight",
+                         sd[p + "mlp.down_proj.weight"])
+    w.write()
+    _check(str(tmp_path / "qwen3.gguf"), model)
