@@ -259,11 +259,11 @@ def load_params(f: GGUFFile, cfg: Optional[ModelConfig] = None,
         # llama.cpp gguf-py names: ATTN_POST_NORM = post_attention_norm,
         # FFN_POST_NORM = post_ffw_norm
         layers["post_attn_norm_w"] = (
-            stack("blk.{}.post_attention_norm.weight", required=False)
+            stack("blk.{}.post_attention_norm.weight")
             if "blk.0.post_attention_norm.weight" in f.tensors
             else stack("blk.{}.attn_post_norm.weight"))
         layers["post_ffw_norm_w"] = (
-            stack("blk.{}.post_ffw_norm.weight", required=False)
+            stack("blk.{}.post_ffw_norm.weight")
             if "blk.0.post_ffw_norm.weight" in f.tensors
             else stack("blk.{}.ffn_post_norm.weight"))
     if cfg.qk_norm:

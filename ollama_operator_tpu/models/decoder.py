@@ -142,6 +142,14 @@ def _causal_window_mask(k_pos, q_pos, window: int):
     return jnp.where(ok, 0.0, -1e30).astype(jnp.float32)[:, None, :, :]
 
 
+def _layer_mask(cfg: ModelConfig, i, mask, m_full):
+    """Per-layer attention mask: gemma2 alternates sliding (even layers)
+    and full (odd) attention; everything else uses ``mask`` as-is."""
+    if not cfg.altern_sliding:
+        return mask
+    return jnp.where(i % 2 == 0, mask, m_full)
+
+
 def _attn_scale(cfg: ModelConfig) -> float:
     """Score scale: 1/sqrt(head_dim), or gemma2's
     1/sqrt(query_pre_attn_scalar) when the config sets one."""
@@ -388,7 +396,7 @@ def prefill_chunk(params: Params, cfg: ModelConfig, tokens: jax.Array,
 
         def body_a(x, layer_in):
             lp, i = layer_in
-            mask_l = jnp.where(i % 2 == 0, mask, m_full)
+            mask_l = _layer_mask(cfg, i, mask, m_full)
             x, (k, v) = _block_chunk(cfg, lp, x, cos, sin, mask_l, scale,
                                      mesh=mesh)
             return x, (k, v)
@@ -463,8 +471,7 @@ def forward_with_cache(params: Params, cfg: ModelConfig, tokens: jax.Array,
     def body(carry, layer_in):
         x, kc, vc = carry
         lp, i = layer_in
-        mask_l = (jnp.where(i % 2 == 0, mask, m_full)
-                  if cfg.altern_sliding else mask)
+        mask_l = _layer_mask(cfg, i, mask, m_full)
         h = _norm(cfg, x, lp["attn_norm_w"], lp.get("attn_norm_b"))
         q, k, v = _qkv(cfg, lp, h, cos, sin)
         k = k.transpose(0, 2, 1, 3)                   # [B, KvH, T, hd]
@@ -708,8 +715,7 @@ def forward_with_cache_paged(params: Params, cfg: ModelConfig,
         else:
             kp = _paged_scatter(kp, i, k.astype(k_arr.dtype), pg_w, off_w)
             vp = _paged_scatter(vp, i, v.astype(k_arr.dtype), pg_w, off_w)
-        mask_l = (jnp.where(i % 2 == 0, mask, m_full)
-                  if cfg.altern_sliding else mask)
+        mask_l = _layer_mask(cfg, i, mask, m_full)
         attn = _paged_attend(cfg, q, kp, vp, i, tables, lengths, mask_l,
                              scale, attn_blocks, mesh, use_kernel)
         attn = _proj_out(cfg, lp, attn, B, T)

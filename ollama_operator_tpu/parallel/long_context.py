@@ -50,9 +50,10 @@ def prefill_chunk_sp(params: Params, cfg: ModelConfig, tokens: jax.Array,
     sp = mesh.shape[SP_AXIS]
     B, T = tokens.shape
     assert T % sp == 0, f"prefill length {T} must divide sp={sp}"
-    assert not cfg.altern_sliding, (
-        "per-layer alternating windows (gemma2) are not implemented on "
-        "the sequence-parallel path")
+    if cfg.altern_sliding:
+        raise NotImplementedError(
+            "per-layer alternating windows (gemma2) are not implemented "
+            "on the sequence-parallel path")
     scale = _attn_scale(cfg)
 
     def inner(tokens, inputs_embeds):
@@ -103,9 +104,10 @@ def forward_with_cache_sp(params: Params, cfg: ModelConfig,
     the cache reads/writes are sharded.
     Returns (logits [B,T,V] replicated, k_cache, v_cache).
     """
-    assert not cfg.altern_sliding, (
-        "per-layer alternating windows (gemma2) are not implemented on "
-        "the sequence-parallel path")
+    if cfg.altern_sliding:
+        raise NotImplementedError(
+            "per-layer alternating windows (gemma2) are not implemented "
+            "on the sequence-parallel path")
     scale = _attn_scale(cfg)
     quant = isinstance(k_cache, dict)
 

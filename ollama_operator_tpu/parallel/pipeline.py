@@ -84,9 +84,10 @@ def forward_with_cache_pp(params: Params, cfg: ModelConfig,
     assert M >= pp, f"need at least pp={pp} microbatches, got {M}"
     b = B // M
     Lpp = L // pp
-    assert not cfg.altern_sliding, (
-        "per-layer alternating windows (gemma2) are not "
-        "implemented on the pipeline path")
+    if cfg.altern_sliding:
+        raise NotImplementedError(
+            "per-layer alternating windows (gemma2) are not implemented "
+            "on the pipeline path")
     scale = _attn_scale(cfg)
     KvH, hd = cfg.n_kv_heads, cfg.head_dim
     S = k_cache.shape[3]

@@ -228,8 +228,21 @@ class LoadedModel:
 
     # ------------------------------------------------------------------
     def render_prompt(self, prompt: str, system: Optional[str] = None,
-                      template: Optional[str] = None) -> str:
+                      template: Optional[str] = None,
+                      suffix: Optional[str] = None) -> str:
+        """``suffix`` enables fill-in-middle (code models): it renders
+        through the template's ``.Suffix``; a model whose template has no
+        suffix section cannot insert — that's a client error (upstream
+        ollama answers the same way)."""
         tpl = Template(template) if template else self.template
+        if suffix:
+            if ".Suffix" not in tpl.src:
+                raise BadRequest(
+                    f"model {self.name} does not support insert (its "
+                    f"template has no .Suffix section)")
+            return tpl.render(prompt=prompt, suffix=suffix,
+                              system=system if system is not None else
+                              (self.system or ""))
         return tpl.render(prompt=prompt,
                           system=system if system is not None else
                           (self.system or ""))

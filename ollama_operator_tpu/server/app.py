@@ -765,7 +765,8 @@ class Handler(BaseHTTPRequestHandler):
         stream = body.get("stream", True)
         raw = bool(body.get("raw", False))
         text_prompt = prompt if raw else lm.render_prompt(
-            prompt, system=body.get("system"), template=body.get("template"))
+            prompt, system=body.get("system"),
+            template=body.get("template"), suffix=body.get("suffix"))
         gen = lm.generate_stream(text_prompt, options=body.get("options"),
                                  context=body.get("context"), raw=raw,
                                  images=_decode_images(body.get("images")),

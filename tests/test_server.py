@@ -452,3 +452,23 @@ def test_bad_request_maps_to_400_not_500(stack):
              {"model": name, "prompt": "hi", "stream": False,
               "images": ["!!!-not-an-image"]})
     assert ei.value.code == 400
+
+
+def test_generate_suffix_fim(stack):
+    """Ollama /api/generate `suffix` (fill-in-middle): renders through the
+    template's .Suffix; models without one answer 400 (upstream parity)."""
+    name = _model_name(stack)
+    post(stack["base"], "/api/pull", {"model": name}, stream=True)
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        post(stack["base"], "/api/generate",
+             {"model": name, "prompt": "def f(", "suffix": "return x",
+              "stream": False})
+    assert ei.value.code == 400
+    tpl = "<PRE>{{ .Prompt }}<SUF>{{ .Suffix }}<MID>"
+    post(stack["base"], "/api/create",
+         {"model": "tiny-fim", "stream": False,
+          "modelfile": f"FROM {name}\nTEMPLATE \"\"\"{tpl}\"\"\""})
+    r = post(stack["base"], "/api/generate",
+             {"model": "tiny-fim", "prompt": "p1", "suffix": "s1",
+              "stream": False, "options": {"num_predict": 4}})
+    assert r["done"] is True
