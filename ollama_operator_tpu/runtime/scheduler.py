@@ -25,6 +25,7 @@ from typing import Iterator, List, Optional, Sequence
 
 import numpy as np
 
+from ..server.metrics import GLOBAL as METRICS
 from .engine import Engine, SlotOptions
 from .errors import BadRequest
 from .paged import PagesExhausted
@@ -405,6 +406,7 @@ class Scheduler:
                      np.asarray(req.all_tokens, np.int32)])
                 req.slot = None
                 self.n_preemptions += 1
+                METRICS.inc("tpu_model_preemptions_total")
                 self._preempted.append(req)
             else:
                 req.error = ("preempted under KV-pool pressure; multimodal "

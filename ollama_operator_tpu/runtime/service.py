@@ -181,6 +181,13 @@ class LoadedModel:
         METRICS.gauge_fn("tpu_model_queue_depth",
                          lambda: (lm := wself()) is not None
                          and lm.scheduler._waiting.qsize() or 0)
+        if self.engine.paged:
+            # paged-pool pressure signal for autoscaling/alerting (the
+            # preemption COUNTER lives in the scheduler — counters survive
+            # unload, keeping Prometheus rate() semantics intact)
+            METRICS.gauge_fn("tpu_model_kv_free_pages",
+                             lambda: (lm := wself()) is not None
+                             and lm.engine.free_pages or 0)
 
     # ------------------------------------------------------------------
     # multimodal (llava): image bytes → projected embeddings → spliced
@@ -486,3 +493,5 @@ class LoadedModel:
         self.scheduler.shutdown()
         METRICS.remove_gauge("tpu_model_active_slots")
         METRICS.remove_gauge("tpu_model_queue_depth")
+        if self.engine.paged:
+            METRICS.remove_gauge("tpu_model_kv_free_pages")

@@ -121,6 +121,10 @@ GLOBAL.describe("tpu_model_decode_tokens_per_second",
                 "Per-request steady-state decode rate")
 GLOBAL.describe("tpu_model_active_slots", "Busy decode slots")
 GLOBAL.describe("tpu_model_queue_depth", "Requests waiting for a slot")
+GLOBAL.describe("tpu_model_kv_free_pages",
+                "Free pages in the paged KV pool (paged mode)")
+GLOBAL.describe("tpu_model_preemptions_total",
+                "Requests preempted and requeued under KV-pool pressure")
 
 
 class Stopwatch:
