@@ -1,0 +1,418 @@
+"""JSON-Schema-constrained decoding (Ollama ``format: {…}``).
+
+Upstream ollama compiles a JSON schema to a GBNF grammar inside llama.cpp
+(/root/reference/pkg/model/pod.go:11 delegates it). The TPU-native design
+keeps sampling on device like the generic JSON mode (ops/constrain.py):
+the host advances a byte automaton and uploads one packed mask per step.
+
+The automaton is a **skeleton machine**: the schema compiles to a node
+tree —
+
+  ("lit",  bytes)            fixed structural bytes ('{"name":', ',', '}')
+  ("leaf", kind)             a typed value hole, validated by the generic
+                             byte PDA with kind restrictions (string /
+                             number / integer / boolean / null / any)
+  ("seq",  (children, ...))  object skeleton: literals + holes in the
+                             schema's property order
+  ("enum", (alts, ...))      one of several literal JSON values
+  ("arr",  item, min1)       '[' item (',' item)* ']' (or empty)
+
+and the machine state is a stack of (node, position) frames — a
+recursive-descent acceptor driven one byte at a time, so token pieces
+that cross hole/literal boundaries are handled exactly.
+
+Unsupported schema constructs (anyOf, patternProperties, additional
+properties, numeric ranges, …) make ``compile_schema`` return None and
+the caller falls back to generic JSON mode with a warning — never a
+silently wrong constraint.
+
+Masks are cached per (schema, machine state) on the compiled Schema
+object, which the server shares across requests with the same schema.
+A 256-bucket first-byte index keeps mask fills cheap for the (many)
+structural states whose next byte is nearly determined; hole-interior
+states are few and recur, so each pays one vocab sweep per schema.
+"""
+
+from __future__ import annotations
+
+import json
+from collections import OrderedDict
+from threading import Lock
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .constrain import (INITIAL_STATE, M_AFTER, TokenTable,
+                        advance_byte, eos_ok)
+
+Kind = str
+Node = Tuple  # see module docstring
+
+_START_BYTES = {
+    "string": b'"',
+    "number": b"-0123456789",
+    "integer": b"-0123456789",
+    "boolean": b"tf",
+    "null": b"n",
+    "any": None,               # unrestricted
+}
+_INT_FORBIDDEN = frozenset(b".eE")
+
+
+# ---------------------------------------------------------------------------
+# schema → node tree
+# ---------------------------------------------------------------------------
+
+def _compile_node(schema) -> Optional[Node]:
+    if not isinstance(schema, dict):
+        return None
+    if "enum" in schema:
+        try:
+            alts = tuple(json.dumps(v, separators=(",", ":"),
+                                    ensure_ascii=False).encode()
+                         for v in schema["enum"])
+        except (TypeError, ValueError):
+            return None
+        return ("enum", alts) if alts else None
+    if "const" in schema:
+        try:
+            return ("enum", (json.dumps(schema["const"],
+                                        separators=(",", ":"),
+                                        ensure_ascii=False).encode(),))
+        except (TypeError, ValueError):
+            return None
+    t = schema.get("type")
+    if isinstance(t, list):
+        return None
+    unsupported = {"anyOf", "oneOf", "allOf", "not", "patternProperties",
+                   "$ref", "if", "then", "else", "pattern", "minimum",
+                   "maximum", "minLength", "maxLength", "format"}
+    if unsupported & schema.keys():
+        return None
+    if t == "object" or (t is None and "properties" in schema):
+        props = schema.get("properties")
+        if not isinstance(props, dict) or not props:
+            return None
+        if schema.get("additionalProperties") not in (None, False):
+            return None
+        req = schema.get("required")
+        if req is not None and set(req) != set(props):
+            # optional properties would need alternation; keep v1 exact
+            return None
+        parts: List[Node] = []
+        for i, (key, sub) in enumerate(props.items()):
+            child = _compile_node(sub)
+            if child is None:
+                return None
+            prefix = ("{" if i == 0 else ",") + json.dumps(key) + ":"
+            parts.append(("lit", prefix.encode()))
+            parts.append(child)
+        parts.append(("lit", b"}"))
+        return ("seq", tuple(parts))
+    if t == "array":
+        items = schema.get("items")
+        child = _compile_node(items) if items is not None else ("leaf", "any")
+        if child is None:
+            return None
+        min_items = schema.get("minItems", 0)
+        max_items = schema.get("maxItems")
+        if max_items is not None or min_items not in (0, 1):
+            return None
+        return ("arr", child, int(min_items))
+    if t in ("string", "number", "integer", "boolean", "null"):
+        return ("leaf", t)
+    if t is None:
+        return ("leaf", "any")
+    return None
+
+
+def compile_schema(schema) -> Optional["Schema"]:
+    """Schema dict → Schema machine, or None when a construct is outside
+    the supported subset (caller falls back to generic JSON mode)."""
+    root = _compile_node(schema)
+    if root is None:
+        return None
+    return Schema(root)
+
+
+# ---------------------------------------------------------------------------
+# the skeleton machine
+# ---------------------------------------------------------------------------
+
+def _init_sub(node: Node):
+    tag = node[0]
+    if tag == "lit":
+        return 0
+    if tag == "leaf":
+        return INITIAL_STATE
+    if tag == "enum":
+        return (0, tuple(range(len(node[1]))), False)
+    if tag == "arr":
+        return 0
+    raise AssertionError(tag)
+
+
+def _push(stack: list, node: Node):
+    """Push ``node``, descending into seq heads so the top frame is
+    always an active byte consumer."""
+    while node[0] == "seq":
+        stack.append((node, 0))
+        node = node[1][0]
+    stack.append((node, _init_sub(node)))
+
+
+def _completed_child(stack: list):
+    """Top frame finished and was popped; advance ancestors (possibly
+    completing them too) and push the next consumer if any."""
+    while stack:
+        node, sub = stack[-1]
+        tag = node[0]
+        if tag == "seq":
+            nxt = sub + 1
+            if nxt == len(node[1]):
+                stack.pop()
+                continue
+            stack[-1] = (node, nxt)
+            _push(stack, node[1][nxt])
+            return
+        if tag == "arr":
+            stack[-1] = (node, 3)   # after an item: ',' or ']'
+            return
+        raise AssertionError(tag)
+
+
+def machine_init(root: Node) -> tuple:
+    stack: list = []
+    _push(stack, root)
+    return tuple(stack)
+
+
+def machine_advance(root: Node, state: tuple, b: int) -> Optional[tuple]:
+    """One byte through the skeleton machine; None = rejected. ``state``
+    is an immutable tuple of (node, sub) frames."""
+    stack = list(state)
+    for _ in range(128):                    # pop-chain guard
+        if not stack:
+            return None                     # schema complete: EOS only
+        node, sub = stack[-1]
+        tag = node[0]
+        if tag == "lit":
+            data = node[1]
+            if data[sub] != b:
+                return None
+            sub += 1
+            if sub == len(data):
+                stack.pop()
+                _completed_child(stack)
+            else:
+                stack[-1] = (node, sub)
+            return tuple(stack)
+        if tag == "leaf":
+            kind = node[1]
+            allowed = True
+            if sub == INITIAL_STATE:
+                start = _START_BYTES[kind]
+                allowed = start is None or b in start
+            if allowed and kind == "integer" and b in _INT_FORBIDDEN:
+                allowed = False
+            ns = advance_byte(sub, b) if allowed else None
+            if ns is not None:
+                if len(ns) == 4 and ns[0] == M_AFTER:
+                    stack.pop()             # value definitely closed
+                    _completed_child(stack)
+                else:
+                    stack[-1] = (node, ns)
+                return tuple(stack)
+            if eos_ok(sub):                 # lazy close (numbers)
+                stack.pop()
+                _completed_child(stack)
+                continue                    # redispatch b
+            return None
+        if tag == "enum":
+            off, viable, done = sub
+            nv = tuple(i for i in viable if off < len(node[1][i])
+                       and node[1][i][off] == b)
+            if nv:
+                off += 1
+                fin = any(len(node[1][i]) == off for i in nv)
+                ext = tuple(i for i in nv if len(node[1][i]) > off)
+                if fin and not ext:
+                    stack.pop()
+                    _completed_child(stack)
+                else:
+                    stack[-1] = (node, (off, ext or nv, fin))
+                return tuple(stack)
+            if done:                        # a full alt matched earlier
+                stack.pop()
+                _completed_child(stack)
+                continue
+            return None
+        if tag == "arr":
+            if sub == 0:
+                if b != ord("["):
+                    return None
+                stack[-1] = (node, 1)
+                return tuple(stack)
+            if sub == 1:                    # first item or ']'
+                if b == ord("]") and node[2] == 0:
+                    stack.pop()
+                    _completed_child(stack)
+                    return tuple(stack)
+                stack[-1] = (node, 2)
+                _push(stack, node[1])
+                continue                    # redispatch into the item
+            if sub == 3:                    # after an item
+                if b == ord("]"):
+                    stack.pop()
+                    _completed_child(stack)
+                    return tuple(stack)
+                if b == ord(","):
+                    stack[-1] = (node, 2)
+                    _push(stack, node[1])
+                    return tuple(stack)
+                return None
+            return None                     # sub == 2 never sits on top
+        raise AssertionError(tag)
+    return None
+
+
+def machine_eos_ok(state: tuple) -> bool:
+    """EOS legal iff every open frame can close without more bytes."""
+    stack = list(state)
+    while stack:
+        node, sub = stack[-1]
+        tag = node[0]
+        if tag == "leaf" and eos_ok(sub):
+            stack.pop()
+            # complete ancestors WITHOUT pushing new consumers
+            while stack:
+                pn, ps = stack[-1]
+                if pn[0] == "seq" and ps + 1 == len(pn[1]):
+                    stack.pop()
+                    continue
+                return False
+            return True
+        if tag == "enum" and sub[2]:
+            stack.pop()
+            while stack:
+                pn, ps = stack[-1]
+                if pn[0] == "seq" and ps + 1 == len(pn[1]):
+                    stack.pop()
+                    continue
+                return False
+            return True
+        return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# masks
+# ---------------------------------------------------------------------------
+
+class Schema:
+    """Compiled schema + per-state mask cache (shared across requests)."""
+
+    def __init__(self, root: Node):
+        self.root = root
+        self._masks: OrderedDict = OrderedDict()
+        self._lock = Lock()
+        self._cap = 8192
+
+    def _state_key(self, table: TokenTable, state: tuple):
+        return (id(table),) + tuple((id(n), s) for n, s in state)
+
+    def mask_for(self, table: TokenTable, state: tuple) -> np.ndarray:
+        key = self._state_key(table, state)
+        with self._lock:
+            m = self._masks.get(key)
+            if m is not None:
+                self._masks.move_to_end(key)
+                return m
+        first = bytes(b for b in range(256)
+                      if machine_advance(self.root, state, b) is not None)
+        idx = _byte_index(table)
+        if len(first) <= 32:
+            cand: List[int] = []
+            for b in first:
+                cand.extend(idx[b])
+        else:
+            cand = range(table.n_vocab)
+        mask = np.zeros(table.n_words, np.uint32)
+        for tid in cand:
+            piece = table.pieces[tid]
+            if not piece:
+                continue
+            st = state
+            for b in piece:
+                st = machine_advance(self.root, st, b)
+                if st is None:
+                    break
+            if st is not None:
+                mask[tid >> 5] |= np.uint32(1 << (tid & 31))
+        if machine_eos_ok(state):
+            if not first:
+                mask = table._eog_packed.copy()   # nothing else is legal
+            else:
+                mask = mask | table._eog_packed
+        with self._lock:
+            self._masks[key] = mask
+            self._masks.move_to_end(key)
+            while len(self._masks) > self._cap:
+                self._masks.popitem(last=False)
+        return mask
+
+
+_byte_index_lock = Lock()
+
+
+def _byte_index(table: TokenTable) -> List[List[int]]:
+    """First-byte → token ids, built once and stored ON the table (its
+    lifetime owns the index; an id()-keyed global would leak across
+    model unloads and could serve a recycled address the wrong vocab)."""
+    idx = getattr(table, "_schema_byte_index", None)
+    if idx is None:
+        with _byte_index_lock:
+            idx = getattr(table, "_schema_byte_index", None)
+            if idx is None:
+                idx = [[] for _ in range(256)]
+                for tid, piece in enumerate(table.pieces):
+                    if piece:
+                        idx[piece[0]].append(tid)
+                table._schema_byte_index = idx
+    return idx
+
+
+class SchemaConstraint:
+    """Per-request schema state; same interface as JsonConstraint."""
+
+    def __init__(self, schema: Schema, table: TokenTable):
+        self.schema = schema
+        self.table = table
+        self.state: Optional[tuple] = machine_init(schema.root)
+
+    @classmethod
+    def for_tokenizer(cls, schema: Schema, tok) -> "SchemaConstraint":
+        return cls(schema, TokenTable.for_tokenizer(tok))
+
+    def mask_row(self) -> np.ndarray:
+        assert self.state is not None, "constraint already dead"
+        return self.schema.mask_for(self.table, self.state)
+
+    def advance(self, tid: int) -> bool:
+        if self.state is None:
+            return False
+        piece = (self.table.pieces[tid]
+                 if 0 <= tid < self.table.n_vocab else b"")
+        if not piece:
+            return False
+        st = self.state
+        for b in piece:
+            st = machine_advance(self.schema.root, st, b)
+            if st is None:
+                break
+        self.state = st
+        return st is not None
+
+    @property
+    def done(self) -> bool:
+        return self.state is not None and machine_eos_ok(self.state)

@@ -172,6 +172,7 @@ class LoadedModel:
         self.scheduler = Scheduler(self.engine)
         self._embed_fn = None
         self._embed_lock = threading.Lock()
+        self._schemas: Dict[str, object] = {}   # canonical schema → compiled
         # weakrefs: a registered gauge must not keep the engine (and its
         # multi-GB params) alive after unload()
         wself = weakref.ref(self)
@@ -234,6 +235,31 @@ class LoadedModel:
         return padded_ids, embeds
 
     # ------------------------------------------------------------------
+    def _make_constraint(self, format):
+        """format:"json" → generic grammar; a schema dict → the compiled
+        skeleton machine (ops/schema.py) when the schema is in the
+        supported subset, else generic JSON with a once-per-process
+        downgrade warning (never a silently wrong constraint)."""
+        from ..ops.constrain import JsonConstraint
+        if isinstance(format, dict):
+            import json as _json
+            from ..ops.schema import SchemaConstraint, compile_schema
+            key = _json.dumps(format, sort_keys=True)
+            sch = self._schemas.get(key)
+            if sch is None and key not in self._schemas:
+                sch = compile_schema(format)
+                if len(self._schemas) > 64:
+                    self._schemas.clear()
+                self._schemas[key] = sch   # None cached too (unsupported)
+            if sch is not None:
+                return SchemaConstraint.for_tokenizer(sch, self.tokenizer)
+            if not _schema_warned[0]:
+                _schema_warned[0] = True
+                print("warning: JSON schema outside the supported subset; "
+                      "constraining to generic JSON only",
+                      file=sys.stderr, flush=True)
+        return JsonConstraint.for_tokenizer(self.tokenizer)
+
     def render_prompt(self, prompt: str, system: Optional[str] = None,
                       template: Optional[str] = None,
                       suffix: Optional[str] = None) -> str:
@@ -335,16 +361,7 @@ class LoadedModel:
         constraint = None
         if format is not None and format != "":
             if format == "json" or isinstance(format, dict):
-                from ..ops.constrain import JsonConstraint
-                if isinstance(format, dict) and not _schema_warned[0]:
-                    # schema-constrained decoding isn't implemented; the
-                    # output is valid JSON but NOT guaranteed to conform.
-                    # Warn once per process — not per request on the hot path.
-                    _schema_warned[0] = True
-                    print("warning: format is a JSON schema; constraining "
-                          "to generic JSON only (schema not enforced)",
-                          file=sys.stderr, flush=True)
-                constraint = JsonConstraint.for_tokenizer(self.tokenizer)
+                constraint = self._make_constraint(format)
             else:
                 raise BadRequest(
                     f"unsupported format {format!r}; expected \"json\" or "

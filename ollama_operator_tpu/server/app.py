@@ -1001,12 +1001,16 @@ class Handler(BaseHTTPRequestHandler):
         prompt = lm.render_chat(messages, tools=tools)
         rid = f"chatcmpl-{int(time.time() * 1000)}"
         created = int(time.time())
-        # OpenAI response_format → grammar-constrained JSON decoding
+        # OpenAI response_format → grammar/schema-constrained decoding:
+        # json_schema carries its schema dict through to the skeleton
+        # machine (ops/schema.py); json_object = generic JSON grammar
         rf = body.get("response_format") or {}
         fmt = None
-        if isinstance(rf, dict) and rf.get("type") in ("json_object",
-                                                       "json_schema"):
-            fmt = "json"
+        if isinstance(rf, dict):
+            if rf.get("type") == "json_schema":
+                fmt = (rf.get("json_schema") or {}).get("schema") or "json"
+            elif rf.get("type") == "json_object":
+                fmt = "json"
         gen = lm.generate_stream(prompt, options=options, format=fmt)
         if tools:
             # buffer and answer as one completion: tool invocations are
