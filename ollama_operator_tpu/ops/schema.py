@@ -30,7 +30,11 @@ Masks are cached per (schema, machine state) on the compiled Schema
 object, which the server shares across requests with the same schema.
 A 256-bucket first-byte index keeps mask fills cheap for the (many)
 structural states whose next byte is nearly determined; hole-interior
-states are few and recur, so each pays one vocab sweep per schema.
+states cache by the PDA's abstract stack-suffix key, so each DISTINCT
+abstract state pays one pure-Python vocab sweep (amortised across the
+response and across requests sharing the schema). Porting the skeleton
+machine to native/grammar.cpp would remove that first-sweep cost; until
+then the generic format:"json" path remains the native-accelerated one.
 """
 
 from __future__ import annotations
@@ -63,10 +67,24 @@ _INT_FORBIDDEN = frozenset(b".eE")
 # schema → node tree
 # ---------------------------------------------------------------------------
 
+# annotation-only keywords that never change validation
+_BENIGN_KEYS = {"title", "description", "default", "examples", "$schema",
+                "$id", "$comment", "deprecated", "readOnly", "writeOnly"}
+
+
+def _only_keys(schema: dict, allowed: frozenset) -> bool:
+    """WHITELIST check: any keyword we don't implement (exclusiveMinimum,
+    multipleOf, prefixItems, …) must route to the generic-JSON fallback —
+    compiling past it would silently under-constrain."""
+    return not (set(schema) - allowed - _BENIGN_KEYS)
+
+
 def _compile_node(schema) -> Optional[Node]:
     if not isinstance(schema, dict):
         return None
     if "enum" in schema:
+        if not _only_keys(schema, frozenset({"enum", "type"})):
+            return None
         try:
             alts = tuple(json.dumps(v, separators=(",", ":"),
                                     ensure_ascii=False).encode()
@@ -75,6 +93,8 @@ def _compile_node(schema) -> Optional[Node]:
             return None
         return ("enum", alts) if alts else None
     if "const" in schema:
+        if not _only_keys(schema, frozenset({"const", "type"})):
+            return None
         try:
             return ("enum", (json.dumps(schema["const"],
                                         separators=(",", ":"),
@@ -84,12 +104,10 @@ def _compile_node(schema) -> Optional[Node]:
     t = schema.get("type")
     if isinstance(t, list):
         return None
-    unsupported = {"anyOf", "oneOf", "allOf", "not", "patternProperties",
-                   "$ref", "if", "then", "else", "pattern", "minimum",
-                   "maximum", "minLength", "maxLength", "format"}
-    if unsupported & schema.keys():
-        return None
     if t == "object" or (t is None and "properties" in schema):
+        if not _only_keys(schema, frozenset(
+                {"type", "properties", "required", "additionalProperties"})):
+            return None
         props = schema.get("properties")
         if not isinstance(props, dict) or not props:
             return None
@@ -110,6 +128,8 @@ def _compile_node(schema) -> Optional[Node]:
         parts.append(("lit", b"}"))
         return ("seq", tuple(parts))
     if t == "array":
+        if not _only_keys(schema, frozenset({"type", "items", "minItems"})):
+            return None
         items = schema.get("items")
         child = _compile_node(items) if items is not None else ("leaf", "any")
         if child is None:
@@ -119,6 +139,8 @@ def _compile_node(schema) -> Optional[Node]:
         if max_items is not None or min_items not in (0, 1):
             return None
         return ("arr", child, int(min_items))
+    if not _only_keys(schema, frozenset({"type"})):
+        return None
     if t in ("string", "number", "integer", "boolean", "null"):
         return ("leaf", t)
     if t is None:
@@ -319,7 +341,17 @@ class Schema:
         self._cap = 8192
 
     def _state_key(self, table: TokenTable, state: tuple):
-        return (id(table),) + tuple((id(n), s) for n, s in state)
+        # leaf PDA states use constrain.py's abstract stack-suffix key: a
+        # token of max_len bytes can pop at most max_len containers, so
+        # deeper "any"-hole nesting cannot change any token's acceptance
+        # — without this, '[[[…' would mint (and full-vocab-sweep) a
+        # fresh state per depth
+        def sub_key(n, s):
+            if n[0] == "leaf" and isinstance(s, bytes):
+                return s[:4] + s[4:][-table.max_len:]
+            return s
+        return (id(table),) + tuple((id(n), sub_key(n, s))
+                                    for n, s in state)
 
     def mask_for(self, table: TokenTable, state: tuple) -> np.ndarray:
         key = self._state_key(table, state)

@@ -252,7 +252,11 @@ class LoadedModel:
                     self._schemas.clear()
                 self._schemas[key] = sch   # None cached too (unsupported)
             if sch is not None:
-                return SchemaConstraint.for_tokenizer(sch, self.tokenizer)
+                c = SchemaConstraint.for_tokenizer(sch, self.tokenizer)
+                c.mask_row()   # prime the initial mask on the HTTP
+                # thread (later novel hole states still fill in the
+                # scheduler loop — amortised by the abstract-state cache)
+                return c
             if not _schema_warned[0]:
                 _schema_warned[0] = True
                 print("warning: JSON schema outside the supported subset; "

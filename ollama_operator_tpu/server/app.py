@@ -1008,7 +1008,9 @@ class Handler(BaseHTTPRequestHandler):
         fmt = None
         if isinstance(rf, dict):
             if rf.get("type") == "json_schema":
-                fmt = (rf.get("json_schema") or {}).get("schema") or "json"
+                js = rf.get("json_schema")
+                fmt = (js.get("schema") if isinstance(js, dict)
+                       else None) or "json"
             elif rf.get("type") == "json_object":
                 fmt = "json"
         gen = lm.generate_stream(prompt, options=options, format=fmt)

@@ -166,3 +166,41 @@ def test_scheduler_schema_constrained_output_conforms():
         assert conforming >= 1
     finally:
         sched.shutdown()
+
+
+def test_whitelist_rejects_unimplemented_keywords():
+    """Keywords outside the implemented subset must fall back (whitelist
+    semantics): compiling past exclusiveMinimum/multipleOf/... would
+    silently under-constrain."""
+    for bad in ({"type": "integer", "exclusiveMinimum": 0},
+                {"type": "number", "multipleOf": 2},
+                {"type": "array", "items": {"type": "string"},
+                 "uniqueItems": True},
+                {"type": "object", "properties": {"a": {"type": "string"}},
+                 "minProperties": 1},
+                {"type": "string", "contentEncoding": "base64"}):
+        assert S.compile_schema(bad) is None, bad
+    # annotation-only keywords stay supported
+    ok = S.compile_schema({"type": "string", "title": "name",
+                           "description": "d", "default": "x"})
+    assert ok is not None
+
+
+def test_any_hole_nesting_reuses_abstract_mask_states():
+    """Deep '[[[…' inside an "any" hole must NOT mint a fresh mask per
+    depth — leaf states cache by the PDA abstract stack-suffix key."""
+    pieces = [b""] + [bytes([c]) for c in range(32, 127)]
+    table = TokenTable(pieces, eog_ids=[0])
+    sch = S.compile_schema({"type": "object",
+                            "properties": {"v": {}}})
+    st = S.machine_init(sch.root)
+    for b in b'{"v":':
+        st = S.machine_advance(sch.root, st, b)
+    depth_keys = set()
+    for _ in range(table.max_len + 8):
+        st = S.machine_advance(sch.root, st, ord("["))
+        sch.mask_for(table, st)
+        depth_keys.add(sch._state_key(table, st))
+    # beyond max_len depth the abstract key saturates
+    assert len(depth_keys) <= table.max_len + 1
+    assert len(sch._masks) <= table.max_len + 4
