@@ -48,6 +48,11 @@ def stack(tmp_path_factory):
     httpd = serve(manager, "127.0.0.1", 0)
     port = httpd.server_address[1]
     base = f"http://127.0.0.1:{port}"
+    # pull once here so individually-selected tests don't depend on an
+    # earlier test in file order having pulled
+    post(base, "/api/pull",
+         {"model": f"http://{url.split('://')[1]}/library/tiny:latest"},
+         stream=True)
     yield {"base": base, "registry_url": url, "manager": manager,
            "registry": reg}
     httpd.shutdown()
