@@ -375,3 +375,54 @@ def test_qwen3_qk_norm(tmp_path):
                          sd[p + "mlp.down_proj.weight"])
     w.write()
     _check(str(tmp_path / "qwen3.gguf"), model)
+
+
+def test_mixtral_sparse_moe_routing(tmp_path):
+    """mixtral: top-2 sparse MoE — router softmax-renormalisation over the
+    selected experts, per-expert gated MLPs, and the llama q/k permute,
+    all validated against transformers' MixtralForCausalLM."""
+    cfg = transformers.MixtralConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_local_experts=4, num_experts_per_tok=2,
+        max_position_embeddings=128, rope_theta=10000.0,
+        attn_implementation="eager")
+    torch.manual_seed(7)
+    model = transformers.MixtralForCausalLM(cfg).eval()
+    sd = _sd(model)
+    w = W.GGUFWriter(str(tmp_path / "mixtral.gguf"))
+    _base_meta(w, "llama", cfg)     # mixtral ships as arch "llama" in GGUF
+    w.add_meta("llama.attention.layer_norm_rms_epsilon",
+               float(cfg.rms_norm_eps))
+    w.add_meta("llama.expert_count", cfg.num_local_experts)
+    w.add_meta("llama.expert_used_count", cfg.num_experts_per_tok)
+    H, KvH = cfg.num_attention_heads, cfg.num_key_value_heads
+    w.add_tensor_f32("token_embd.weight", sd["model.embed_tokens.weight"])
+    w.add_tensor_f32("output_norm.weight", sd["model.norm.weight"])
+    w.add_tensor_f32("output.weight", sd["lm_head.weight"])
+    for i in range(cfg.num_hidden_layers):
+        p, b = f"model.layers.{i}.", f"blk.{i}."
+        w.add_tensor_f32(b + "attn_norm.weight",
+                         sd[p + "input_layernorm.weight"])
+        w.add_tensor_f32(b + "attn_q.weight",
+                         hf_permute(sd[p + "self_attn.q_proj.weight"], H))
+        w.add_tensor_f32(b + "attn_k.weight",
+                         hf_permute(sd[p + "self_attn.k_proj.weight"], KvH))
+        w.add_tensor_f32(b + "attn_v.weight",
+                         sd[p + "self_attn.v_proj.weight"])
+        w.add_tensor_f32(b + "attn_output.weight",
+                         sd[p + "self_attn.o_proj.weight"])
+        w.add_tensor_f32(b + "ffn_norm.weight",
+                         sd[p + "post_attention_layernorm.weight"])
+        moe = p + "block_sparse_moe."
+        w.add_tensor_f32(b + "ffn_gate_inp.weight", sd[moe + "gate.weight"])
+        for e in range(cfg.num_local_experts):
+            # HF w1 = gate, w3 = up, w2 = down (all [out, in])
+            w.add_tensor_f32(b + f"ffn_gate.{e}.weight",
+                             sd[moe + f"experts.{e}.w1.weight"])
+            w.add_tensor_f32(b + f"ffn_up.{e}.weight",
+                             sd[moe + f"experts.{e}.w3.weight"])
+            w.add_tensor_f32(b + f"ffn_down.{e}.weight",
+                             sd[moe + f"experts.{e}.w2.weight"])
+    w.write()
+    _check(str(tmp_path / "mixtral.gguf"), model)
