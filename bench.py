@@ -64,11 +64,20 @@ def _run_attempt(env: dict, init_timeout: float, total_timeout: float):
     t = threading.Thread(target=pump_stderr, daemon=True)
     t.start()
     start = time.monotonic()
-    if not init_seen.wait(init_timeout):
-        log(f"bench: backend init exceeded {init_timeout:.0f}s, killing child")
-        p.kill()
-        p.wait()
-        return None, ""
+    # wait for the init marker OR child exit — an instant crash (import
+    # error, bad model name) must not burn the whole init window
+    while not init_seen.is_set():
+        if p.poll() is not None:
+            out = p.stdout.read()
+            t.join(timeout=5)
+            return p.returncode, out
+        if time.monotonic() - start > init_timeout:
+            log(f"bench: backend init exceeded {init_timeout:.0f}s, "
+                f"killing child")
+            p.kill()
+            p.wait()
+            return None, ""
+        time.sleep(1.0)
     remaining = total_timeout - (time.monotonic() - start)
     try:
         p.wait(timeout=max(remaining, 1.0))
@@ -83,20 +92,31 @@ def _run_attempt(env: dict, init_timeout: float, total_timeout: float):
 
 
 def run_supervised() -> int:
-    retries = int(os.environ.get("BENCH_INIT_RETRIES", "3"))
-    init_timeout = float(os.environ.get("BENCH_INIT_TIMEOUT", "150"))
+    # generous init windows: this box has been observed at >85% iowait,
+    # where a cold `import jax` alone can take minutes — a tight timeout
+    # would kill children that are merely slow-importing, not hung
+    retries = int(os.environ.get("BENCH_INIT_RETRIES", "2"))
+    init_timeout = float(os.environ.get("BENCH_INIT_TIMEOUT", "300"))
     total_timeout = float(os.environ.get("BENCH_TIMEOUT", "1500"))
     backoff = 10.0
     for attempt in range(retries + 1):
         env = dict(os.environ, BENCH_CHILD="1")
         fallback = attempt == retries
-        if fallback and not os.environ.get("JAX_PLATFORMS"):
+        # NB: this image's profile exports JAX_PLATFORMS=axon (preventing
+        # silent CPU fallback in normal runs), so the fallback must
+        # OVERRIDE it — only an explicit cpu pin skips the accelerator
+        # attempts entirely
+        if fallback and os.environ.get("JAX_PLATFORMS", "") != "cpu":
             # Last attempt: the accelerator never came up. Capture on CPU —
-            # a real (if slow) number beats a hang for the record.
+            # a real (if slow) number beats a hang for the record. The CPU
+            # box may be a single core, so the fallback also drops to the
+            # tiny model unless the caller pinned one: phi-2.7B f32 decode
+            # on one core would blow the child budget.
             log("bench: TPU backend unavailable after retries; CPU fallback")
             env["JAX_PLATFORMS"] = "cpu"
             env.setdefault("BENCH_STEPS", "32")
             env.setdefault("BENCH_SEQ", "512")
+            env.setdefault("BENCH_MODEL", "tiny")
         # CPU fallback has no hang risk but single-core init is slow;
         # give it extra headroom.
         rc, out = _run_attempt(env, init_timeout * (2 if fallback else 1),
@@ -205,6 +225,18 @@ def main() -> None:
                      n_pages=int(os.environ.get("BENCH_N_PAGES", "0"))
                      or None))
 
+    # the whole run must fit the context whatever BENCH_* says (the
+    # engine clamps max_seq to cfg.max_seq_len): prompt + warmup chunk +
+    # measured steps, else cache writes would clamp into the tail and
+    # corrupt the measurement
+    prompt_len = min(prompt_len, eng.max_seq // 2)
+    calls_budget = max(1, steps // chunk)
+    need = prompt_len + chunk + calls_budget * chunk + 2
+    if need > eng.max_seq:
+        steps = max(chunk, (eng.max_seq - prompt_len - chunk - 2)
+                    // chunk * chunk)
+        log(f"bench: clamping steps to {steps} to fit context "
+            f"{eng.max_seq}")
     rng = np.random.default_rng(0)
     prompts = rng.integers(1, cfg.vocab_size, size=(slots, prompt_len),
                            endpoint=False).astype(np.int32)
