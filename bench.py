@@ -172,9 +172,13 @@ def run_supervised() -> int:
     init_timeout = float(os.environ.get("BENCH_INIT_TIMEOUT", "300"))
     total_timeout = float(os.environ.get("BENCH_TIMEOUT", "1500"))
     backoff = 10.0
+    # BENCH_NO_FALLBACK=1: fail instead of capturing on CPU — the probe
+    # loop (hack/bench_probe.sh) wants "TPU or nothing" per attempt while
+    # the driver's single run wants "a parseable line no matter what"
+    no_fallback = os.environ.get("BENCH_NO_FALLBACK", "") == "1"
     for attempt in range(retries + 1):
         env = dict(os.environ, BENCH_CHILD="1")
-        fallback = attempt == retries
+        fallback = attempt == retries and not no_fallback
         # NB: this image's profile exports JAX_PLATFORMS=axon (preventing
         # silent CPU fallback in normal runs), so the fallback must
         # OVERRIDE it — only an explicit cpu pin skips the accelerator
@@ -198,10 +202,11 @@ def run_supervised() -> int:
             sys.stdout.write(out)
             sys.stdout.flush()
             return 0
+        more = attempt < retries
         log(f"bench: attempt {attempt + 1}/{retries + 1} failed "
-            f"(rc={rc}); retrying in {backoff:.0f}s" if not fallback else
-            f"bench: fallback attempt failed (rc={rc})")
-        if not fallback:
+            f"(rc={rc}); retrying in {backoff:.0f}s" if more else
+            f"bench: final attempt failed (rc={rc})")
+        if more:   # no dead sleep after the LAST attempt (no-fallback probes)
             time.sleep(backoff)
             backoff = min(backoff * 2, 60.0)
     return 1

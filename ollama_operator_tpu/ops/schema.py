@@ -135,8 +135,9 @@ def _compile_node(schema) -> Optional[Node]:
         if child is None:
             return None
         min_items = schema.get("minItems", 0)
-        max_items = schema.get("maxItems")
-        if max_items is not None or min_items not in (0, 1):
+        # (maxItems never reaches here — it fails the _only_keys whitelist
+        # above and falls back to the generic JSON PDA)
+        if min_items not in (0, 1):
             return None
         return ("arr", child, int(min_items))
     if not _only_keys(schema, frozenset({"type"})):

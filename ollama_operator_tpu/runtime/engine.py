@@ -48,10 +48,11 @@ class EngineConfig:
     # half the decode cache traffic, double the context per chip)
     cache_dtype: Any = jnp.bfloat16
     min_prefill_bucket: int = 64
-    # penalty window (Ollama repeat_last_n default): repeat/presence/
-    # frequency penalties see only the last N tokens, maintained as a
-    # device-side ring buffer. Engine-global (the ring size is static);
-    # per-request repeat_last_n values are currently ignored.
+    # penalty window CAPACITY (Ollama repeat_last_n default): repeat/
+    # presence/frequency penalties see only the last N tokens, maintained
+    # as a device-side ring buffer. The ring is statically sized at this
+    # engine max; each request's own repeat_last_n (SlotOptions) selects
+    # its effective window ≤ this via a per-slot modulus — no recompile.
     repeat_last_n: int = 64
     # decode steps per host round-trip: a lax.scan of this many steps runs
     # as ONE device program, so dispatch/sync latency (large under the
@@ -121,6 +122,9 @@ class SlotOptions:
     presence_penalty: float = 0.0
     frequency_penalty: float = 0.0
     seed: int = -1
+    # penalty window for THIS request: 0 disables the window, -1 means
+    # "engine max"; values above the engine's repeat_last_n capacity clamp
+    repeat_last_n: int = 64
 
 
 class Engine:
@@ -252,6 +256,9 @@ class Engine:
         self._constr_dev = zeros((B,), jnp.int32, slot_sh)
         self.active = np.zeros((B,), bool)  # host-side mask
         self._active_dev = zeros((B,), jnp.int32, slot_sh)
+        # per-slot effective penalty window (≤ W ring capacity)
+        self._repeat_n = np.full((B,), W, np.int32)
+        self._rln_dev = jnp.asarray(self._repeat_n)
         # host mirror of per-slot lengths — lets decode_n pick the static
         # attention bucket without a device sync
         self._host_lengths = np.zeros((B,), np.int64)
@@ -320,23 +327,26 @@ class Engine:
 
         def _sample_install(lengths, counts, last_tokens, pring, logits,
                             ring_row, counts_row, slot, total, sp_row, key,
-                            mask_row, cflag):
+                            mask_row, cflag, rln):
             """Shared admission tail (fresh prefill AND prefix-cache
             extend): grammar-mask + sample the first token from ``logits``
             (the [V] row of the last valid prompt position — the caller
             indexes it), push it through the penalty window
             (``ring_row``/``counts_row`` cover the prompt), and install
-            slot state. Returns (tok, lengths, counts, last_tokens,
-            pring)."""
+            slot state. ``rln`` is the request's effective window (≤ W;
+            0 = penalties see nothing). Returns (tok, lengths, counts,
+            last_tokens, pring)."""
             last = logits
             allowed = unpack_mask(mask_row, cfg.vocab_size)
             last = jnp.where((cflag == 1) & ~allowed, sampling.NEG_INF, last)
             tok = sampling.sample(last[None], counts_row[None], sp_row,
                                   key[None])[0]
-            evict = ring_row[total % W]
+            rmod = jnp.maximum(rln, 1)
+            evict = ring_row[total % rmod]
             counts_row = counts_row.at[evict].add(-1, mode="drop")
-            ring_row = ring_row.at[total % W].set(tok)
-            counts_row = counts_row.at[tok].add(1)
+            tok_entry = jnp.where(rln > 0, tok, jnp.int32(cfg.vocab_size))
+            ring_row = ring_row.at[total % rmod].set(tok_entry)
+            counts_row = counts_row.at[tok_entry].add(1, mode="drop")
             pring = pring.at[slot].set(ring_row)
             lengths = lengths.at[slot].set(total)
             counts = counts.at[slot].set(counts_row)
@@ -346,30 +356,35 @@ class Engine:
         def _insert_prefilled(k_cache, v_cache, lengths, counts,
                               last_tokens, pring, logits, ks, vs, tokens,
                               slot, n_valid, sp_row, key, mask_row, cflag,
-                              table_row=None):
+                              rln, table_row=None):
             """Fresh-prefill admission: build the penalty window from the
-            LAST repeat_last_n prompt tokens of the device-side chunk
-            (image pad positions carry id == vocab_size, which the
-            scatter-add drops — image tokens never enter the counts),
-            sample, and install chunk K/V + slot state."""
+            LAST ``rln`` prompt tokens of the device-side chunk (image pad
+            positions carry id == vocab_size, which the scatter-add drops —
+            image tokens never enter the counts), sample, and install
+            chunk K/V + slot state."""
             last = jax.lax.dynamic_index_in_dim(
                 logits[0], n_valid - 1, axis=0, keepdims=False)
-            # ring of the last W prompt tokens: absolute positions
-            # n_valid-W .. n_valid-1 land in slots pos % W (each slot
-            # exactly once — no scatter duplicates)
+            # ring of the last rln prompt tokens: absolute positions
+            # n_valid-rln .. n_valid-1 land in slots pos % rln (each slot
+            # exactly once — no scatter duplicates); ring capacity is the
+            # static W, entries >= rln stay sentinel
             T = tokens.shape[1]
-            pos = n_valid - W + jnp.arange(W, dtype=jnp.int32)
-            in_prompt = pos >= 0
+            rmod = jnp.maximum(rln, 1)
+            idx = jnp.arange(W, dtype=jnp.int32)
+            pos = n_valid - rln + idx
+            valid = (idx < rln) & (pos >= 0)
             vals = jnp.where(
-                in_prompt, tokens[0][jnp.clip(pos, 0, T - 1)],
+                valid, tokens[0][jnp.clip(pos, 0, T - 1)],
                 jnp.int32(cfg.vocab_size))
+            slot_idx = jnp.where(valid, pos % rmod, jnp.int32(W))
             ring_row = jnp.full((W,), cfg.vocab_size, jnp.int32
-                                ).at[pos % W].set(vals)
+                                ).at[slot_idx].set(vals, mode="drop")
             counts_row = jnp.zeros((cfg.vocab_size,), jnp.int32
                                    ).at[vals].add(1, mode="drop")
             (tok, lengths, counts, last_tokens, pring) = _sample_install(
                 lengths, counts, last_tokens, pring, last, ring_row,
-                counts_row, slot, n_valid, sp_row, key, mask_row, cflag)
+                counts_row, slot, n_valid, sp_row, key, mask_row, cflag,
+                rln)
             if self.paged:
                 k_cache, v_cache = decoder.paged_insert(
                     cfg, k_cache, v_cache, ks, vs, table_row, n_valid)
@@ -393,7 +408,7 @@ class Engine:
         @partial(jax.jit, donate_argnums=(1, 2, 3, 4, 5, 6))
         def _admit(params, k_cache, v_cache, lengths, counts, last_tokens,
                    pring, tokens, slot, n_valid, sp_row, key, mask_row,
-                   cflag, table_row=None):
+                   cflag, rln, table_row=None):
             """Prefill a padded B=1 chunk AND insert it into the slot state
             — one device program, one host round-trip per admission.
             ``table_row`` [NBLK] — the slot's block table (paged mode)."""
@@ -401,12 +416,13 @@ class Engine:
             return _insert_prefilled(k_cache, v_cache, lengths, counts,
                                      last_tokens, pring, logits, ks, vs,
                                      tokens, slot, n_valid, sp_row, key,
-                                     mask_row, cflag, table_row)
+                                     mask_row, cflag, rln, table_row)
 
         @partial(jax.jit, donate_argnums=(1, 2, 3, 4, 5, 6))
         def _admit_embeds(params, k_cache, v_cache, lengths, counts,
                           last_tokens, pring, tokens, embeds, slot, n_valid,
-                          sp_row, key, mask_row, cflag, table_row=None):
+                          sp_row, key, mask_row, cflag, rln,
+                          table_row=None):
             """Multimodal admission: like _admit but prefilling from a
             precomputed [1, T, D] embedding sequence (image tokens spliced
             into text embeddings); ``tokens`` feeds the penalty counts with
@@ -417,11 +433,11 @@ class Engine:
             return _insert_prefilled(k_cache, v_cache, lengths, counts,
                                      last_tokens, pring, logits, ks, vs,
                                      tokens, slot, n_valid, sp_row, key,
-                                     mask_row, cflag, table_row)
+                                     mask_row, cflag, rln, table_row)
 
         def _decode_body(params, k_cache, v_cache, lengths, counts,
                          last_tokens, pring, sp, keys, active, mask_bits,
-                         constrained, attn_len=None, tables=None):
+                         constrained, rln, attn_len=None, tables=None):
             if self.paged:
                 ps = self.ecfg.page_size
                 nblk = -(-(attn_len or self.max_seq) // ps)
@@ -445,15 +461,19 @@ class Engine:
             bi = jnp.arange(B)
             # penalty window: the NEW token's absolute position is
             # lengths + 1 (last_tokens sits at lengths); evict whatever
-            # occupied that ring slot W tokens ago, then admit the new
-            # token (inactive slots write the OOB sentinel)
-            slot_pos = (lengths + 1) % W
+            # occupied that ring slot rln[i] tokens ago, then admit the
+            # new token. Per-slot rln picks each request's effective
+            # window inside the static-W ring via the modulus — inactive
+            # or rln==0 slots write the OOB sentinel.
+            rmod = jnp.maximum(rln, 1)
+            slot_pos = (lengths + 1) % rmod
             evict = pring[bi, slot_pos]
             evict = jnp.where(active == 1, evict, jnp.int32(cfg.vocab_size))
-            new = jnp.where(active == 1, toks, jnp.int32(cfg.vocab_size))
+            live = (active == 1) & (rln > 0)
+            new = jnp.where(live, toks, jnp.int32(cfg.vocab_size))
             counts = counts.at[bi, evict].add(-1, mode="drop")
             counts = counts.at[bi, new].add(1, mode="drop")
-            pring = jnp.where((active == 1)[:, None],
+            pring = jnp.where(live[:, None],
                               pring.at[bi, slot_pos].set(toks), pring)
             lengths = lengths + active
             last_tokens = jnp.where(active == 1, toks, last_tokens)
@@ -462,21 +482,21 @@ class Engine:
 
         @partial(jax.jit, donate_argnums=(1, 2, 3, 4, 5, 6, 8))
         def _decode(params, k_cache, v_cache, lengths, counts, last_tokens,
-                    pring, sp, keys, active, mask_bits, constrained,
+                    pring, sp, keys, active, mask_bits, constrained, rln,
                     tables=None):
             (toks, k_cache, v_cache, lengths, counts, last_tokens,
              pring) = _decode_body(params, k_cache, v_cache, lengths,
                                    counts, last_tokens, pring, sp, keys,
-                                   active, mask_bits, constrained,
+                                   active, mask_bits, constrained, rln,
                                    tables=tables)
             return (toks, k_cache, v_cache, lengths, counts, last_tokens,
                     pring, keys)
 
-        @partial(jax.jit, static_argnums=(12, 13),
+        @partial(jax.jit, static_argnums=(13, 14),
                  donate_argnums=(1, 2, 3, 4, 5, 6, 8))
         def _decode_n(params, k_cache, v_cache, lengths, counts, last_tokens,
-                      pring, sp, keys, active, mask_bits, constrained, n,
-                      attn_len, tables=None, budgets=None):
+                      pring, sp, keys, active, mask_bits, constrained, rln,
+                      n, attn_len, tables=None, budgets=None):
             """n decode steps as ONE device program (lax.scan) — a single
             dispatch + host sync per n tokens per slot. ``attn_len`` is the
             static attended-cache prefix (decode traffic scales with it,
@@ -499,7 +519,7 @@ class Engine:
                  pring) = _decode_body(params, k_cache, v_cache,
                                        lengths, counts, last_tokens, pring,
                                        sp, keys, act, mask_bits,
-                                       constrained, attn_len=attn_len,
+                                       constrained, rln, attn_len=attn_len,
                                        tables=tables)
                 return (k_cache, v_cache, lengths, counts, last_tokens,
                         pring), toks
@@ -515,7 +535,7 @@ class Engine:
         def _extend_paged(params, k_cache, v_cache, lengths, counts,
                           last_tokens, pring, tokens, ring_row, counts_row,
                           slot, start, n_new, table_row, sp_row, key,
-                          mask_row, cflag):
+                          mask_row, cflag, rln):
             """Paged prefix-cache continuation: the reused prefix stays in
             its pages untouched; the tail prefills through the paged
             forward (B=1 view, positions offset by ``start``), writing
@@ -531,14 +551,14 @@ class Engine:
             (tok, lengths, counts, last_tokens, pring) = _sample_install(
                 lengths, counts, last_tokens, pring, last, ring_row,
                 counts_row, slot, start + n_new, sp_row, key, mask_row,
-                cflag)
+                cflag, rln)
             return (tok, *pin(k_cache, v_cache, lengths, counts,
                               last_tokens), pring)
 
         @partial(jax.jit, donate_argnums=(1, 2, 3, 4, 5, 6))
         def _extend(params, k_cache, v_cache, lengths, counts, last_tokens,
                     pring, tokens, ring_row, counts_row, slot, start, n_new,
-                    sp_row, key, mask_row, cflag):
+                    sp_row, key, mask_row, cflag, rln):
             """Prefix-cache continuation: prefill only the tail of a prompt
             whose first ``start`` tokens are already in ``slot``'s KV cache
             (a parked conversation). ``ring_row``/``counts_row`` are the
@@ -581,7 +601,7 @@ class Engine:
             (tok, lengths, counts, last_tokens, pring) = _sample_install(
                 lengths, counts, last_tokens, pring, last, ring_row,
                 counts_row, slot, start + n_new, sp_row, key, mask_row,
-                cflag)
+                cflag, rln)
             return (tok, *pin(k_cache, v_cache, lengths, counts,
                               last_tokens), pring)
 
@@ -666,12 +686,21 @@ class Engine:
                 jnp.int32(1)
         return key, self._mask_ones, jnp.int32(0)
 
+    def _resolve_rln(self, opts: SlotOptions) -> int:
+        """Request window → effective window: -1 = engine max, clamp to
+        the static ring capacity W."""
+        W = max(1, self.ecfg.repeat_last_n)
+        r = opts.repeat_last_n
+        return W if r < 0 else min(r, W)
+
     def _commit_slot(self, slot: int, n_total: int, opts: SlotOptions):
         """Shared admission tail: mark the slot live and rebuild batched
         sampling params."""
         self.active[slot] = True
         self._host_lengths[slot] = n_total
         self._opts[slot] = opts
+        self._repeat_n[slot] = self._resolve_rln(opts)
+        self._rln_dev = jnp.asarray(self._repeat_n)
         if self.paged:
             self._admit_seq += 1
             self._admit_order[slot] = self._admit_seq
@@ -712,14 +741,15 @@ class Engine:
                 self.counts, self.last_tokens, self.pring,
                 jnp.asarray(tokens), jnp.asarray(emb), jnp.int32(slot),
                 jnp.int32(n), self._sp_row(opts), key, mrow, cflag,
-                table_row)
+                jnp.int32(self._resolve_rln(opts)), table_row)
         else:
             (tok, self.k_cache, self.v_cache, self.lengths, self.counts,
              self.last_tokens, self.pring) = self._admit_exec(bucket)(
                 self.params, self.k_cache, self.v_cache, self.lengths,
                 self.counts, self.last_tokens, self.pring,
                 jnp.asarray(tokens), jnp.int32(slot), jnp.int32(n),
-                self._sp_row(opts), key, mrow, cflag, table_row)
+                self._sp_row(opts), key, mrow, cflag,
+                jnp.int32(self._resolve_rln(opts)), table_row)
         self._commit_slot(slot, n, opts)
         return int(tok)
 
@@ -766,7 +796,7 @@ class Engine:
             if self.paged:
                 args.append(jnp.zeros((self._nblk,), jnp.int32))
             args += [self._sp_row(SlotOptions()), jax.random.key(0),
-                     self._mask_ones, jnp.int32(0)]
+                     self._mask_ones, jnp.int32(0), jnp.int32(W)]
             exe = self._extend_fn.lower(*args).compile()
             self._extend_execs[bucket] = exe
         return exe
@@ -781,7 +811,8 @@ class Engine:
         ids share that prefix — stale entries at positions >= start are
         never attended: masking is position-based and the tail overwrites
         them)."""
-        assert self.supports_extend, "extend() on dense-quant/sp cache"
+        assert self.supports_extend, \
+            "extend() on an sp sequence-sharded cache"
         assert not self.active[slot], f"slot {slot} busy"
         full_ids = np.asarray(full_ids, np.int32)
         n_total = int(full_ids.shape[0])
@@ -801,13 +832,17 @@ class Engine:
         tokens = np.zeros((1, bucket), np.int32)
         tokens[0, :n_new] = full_ids[start:]
         # penalty window over the full continuation prompt (host-built:
-        # the parked ring may describe a divergent suffix)
+        # the parked ring may describe a divergent suffix), at the
+        # REQUEST's effective window inside the static-W ring
         W = max(1, self.ecfg.repeat_last_n)
+        rln = self._resolve_rln(opts)
+        rmod = max(rln, 1)
         V = self.cfg.vocab_size
         ring = np.full((W,), V, np.int32)
-        window = full_ids[max(0, n_total - W):]
+        window = full_ids[max(0, n_total - rln):] if rln > 0 \
+            else full_ids[:0]
         pos = np.arange(n_total - len(window), n_total)
-        ring[pos % W] = window
+        ring[pos % rmod] = window
         counts_row = np.zeros((V,), np.int32)
         np.add.at(counts_row, window, 1)
         key, mrow, cflag = self._prep_slot(slot, opts, n_total, mask_row)
@@ -822,11 +857,16 @@ class Engine:
             deficit = (self._pt.blocks_for(ahead)
                        - self._pt.owned_blocks(slot))
             if deficit > self._pt.n_free or not self._pt.grow(slot, n_total):
+                # the scheduler already popped this slot from its parked
+                # map, so nothing will ever reuse or evict the prefix —
+                # return its pages now or they leak until a fresh admit
+                # happens to land on this slot (ADVICE r2)
+                self._pt.release(slot)
                 raise PagesExhausted(
                     f"extend to {n_total} tokens (+1 chunk headroom): "
                     f"{self._pt.n_free} pages free")
             args.append(jnp.asarray(self._pt.tables[slot]))
-        args += [self._sp_row(opts), key, mrow, cflag]
+        args += [self._sp_row(opts), key, mrow, cflag, jnp.int32(rln)]
         (tok, self.k_cache, self.v_cache, self.lengths, self.counts,
          self.last_tokens, self.pring) = self._extend_exec(bucket)(*args)
         self._commit_slot(slot, n_total, opts)
@@ -889,7 +929,7 @@ class Engine:
             self.params, self.k_cache, self.v_cache, self.lengths,
             self.counts, self.last_tokens, self.pring, self.sp, self.keys,
             self._active_dev, self.mask_bits, self._constr_dev,
-            self._tables_dev())
+            self._rln_dev, self._tables_dev())
         self._host_lengths[self.active] += 1
         return np.asarray(toks)
 
@@ -902,7 +942,7 @@ class Engine:
                 self.params, self.k_cache, self.v_cache, self.lengths,
                 self.counts, self.last_tokens, self.pring, self.sp,
                 self.keys, self._active_dev, self.mask_bits,
-                self._constr_dev, n, attn_len,
+                self._constr_dev, self._rln_dev, n, attn_len,
                 self._tables_dev(), budgets).compile()
             self._decode_execs[key] = exe
         return exe
@@ -918,7 +958,8 @@ class Engine:
                 self.counts, self.last_tokens, self.pring, tokens,
                 jnp.int32(0), jnp.int32(1),
                 self._sp_row(SlotOptions()), jax.random.key(0),
-                self._mask_ones, jnp.int32(0), table_row).compile()
+                self._mask_ones, jnp.int32(0), jnp.int32(1),
+                table_row).compile()
             self._admit_execs[bucket] = exe
         return exe
 
@@ -1011,7 +1052,7 @@ class Engine:
             self.params, self.k_cache, self.v_cache, self.lengths,
             self.counts, self.last_tokens, self.pring, self.sp, self.keys,
             self._active_dev, self.mask_bits, self._constr_dev,
-            self._tables_dev(), jnp.asarray(budgets))
+            self._rln_dev, self._tables_dev(), jnp.asarray(budgets))
         self._host_lengths[self.active] += budgets[self.active]
         return np.asarray(toks_n)
 
@@ -1037,6 +1078,8 @@ class Engine:
         if self.paged:
             self._pt.release(slot)
         self._host_lengths[slot] = 0
+        self._repeat_n[slot] = max(1, self.ecfg.repeat_last_n)
+        self._rln_dev = jnp.asarray(self._repeat_n)
         (self.lengths, self.counts, self.last_tokens,
          self.pring) = self._release_fn(
             self.lengths, self.counts, self.last_tokens, self.pring,

@@ -312,6 +312,9 @@ class Scheduler:
                 if not self.engine.admissible(len(req.admit_ids)):
                     req.error = (f"prompt needs more KV pages than the "
                                  f"pool has: {e}")
+                    req.stats.t_done = time.monotonic()
+                    with self._lock:
+                        self.finished.append(req.stats)
                     req.out.put(("error", req.error))
                     continue
                 self._evict_one_parked()
@@ -319,6 +322,9 @@ class Scheduler:
                 return
             except Exception as e:  # surfacing engine errors to the caller
                 req.error = str(e)
+                req.stats.t_done = time.monotonic()
+                with self._lock:
+                    self.finished.append(req.stats)
                 req.out.put(("error", str(e)))
                 continue
             req.slot = slot

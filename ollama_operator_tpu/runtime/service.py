@@ -15,6 +15,7 @@ import sys
 import threading
 import time
 import weakref
+from collections import OrderedDict
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import jax
@@ -91,7 +92,8 @@ def merge_options(defaults: Dict, request: Optional[Dict]
             repeat_penalty=float(o.get("repeat_penalty", 1.1)),
             presence_penalty=float(o.get("presence_penalty", 0.0)),
             frequency_penalty=float(o.get("frequency_penalty", 0.0)),
-            seed=int(o.get("seed", -1)))
+            seed=int(o.get("seed", -1)),
+            repeat_last_n=int(o.get("repeat_last_n", 64)))
         num_predict = int(o.get("num_predict", 128))
     except (TypeError, ValueError) as e:
         raise BadRequest(f"invalid options: {e}") from e
@@ -172,7 +174,9 @@ class LoadedModel:
         self.scheduler = Scheduler(self.engine)
         self._embed_fn = None
         self._embed_lock = threading.Lock()
-        self._schemas: Dict[str, object] = {}   # canonical schema → compiled
+        # canonical schema JSON → compiled machine, LRU-evicted one at a
+        # time (each entry amortises full-vocab mask sweeps)
+        self._schemas: OrderedDict[str, object] = OrderedDict()
         # weakrefs: a registered gauge must not keep the engine (and its
         # multi-GB params) alive after unload()
         wself = weakref.ref(self)
@@ -245,11 +249,16 @@ class LoadedModel:
             import json as _json
             from ..ops.schema import SchemaConstraint, compile_schema
             key = _json.dumps(format, sort_keys=True)
-            sch = self._schemas.get(key)
-            if sch is None and key not in self._schemas:
+            if key in self._schemas:
+                sch = self._schemas[key]
+                self._schemas.move_to_end(key)
+            else:
                 sch = compile_schema(format)
                 if len(self._schemas) > 64:
-                    self._schemas.clear()
+                    # evict ONE stale entry — wholesale clears would
+                    # re-pay every compiled machine's per-state mask
+                    # cache on schema-rotating workloads (ADVICE r2)
+                    self._schemas.popitem(last=False)
                 self._schemas[key] = sch   # None cached too (unsupported)
             if sch is not None:
                 c = SchemaConstraint.for_tokenizer(sch, self.tokenizer)

@@ -1,0 +1,57 @@
+"""The 70B north star stops being a paper claim (VERDICT r2 weak #8).
+
+BASELINE.md config 4 / SURVEY §7 hard part 3: llama2:70b tensor-sharded
+across a v5e-16 slice. Real multi-chip hardware isn't reachable here, so
+the checkable halves are proven on CPU: the REAL-dimension program (80
+layers, dim 8192, GQA 8:1) compiles over a virtual 16-device mesh, and the
+per-device byte budget (int8 params + KV) fits a v5e chip's HBM.
+
+Runs hack/prog_70b.py in a subprocess — the proof needs 16 virtual devices
+while the suite's conftest pins this process to 8.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(ROOT, "hack", "prog_70b.py")
+
+
+@pytest.fixture(scope="module")
+def proof():
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=16")
+    r = subprocess.run([sys.executable, WORKER], env=env, cwd=ROOT,
+                       capture_output=True, text=True, timeout=1500)
+    assert r.returncode == 0, f"worker failed:\n{r.stderr[-4000:]}"
+    return json.loads(r.stdout.strip().splitlines()[-1])
+
+
+def test_real_dims(proof):
+    assert proof["model"] == "llama2:70b"
+    assert proof["n_devices"] == 16
+    # ~70B weights at int8 + scales; a shape-reduced config would be far
+    # smaller and void the proof
+    assert proof["global_param_gb"] > 60
+
+
+def test_programs_compile_and_fit(proof):
+    plans = {p["plan"]: p for p in proof["programs"]}
+    assert set(plans) == {"tp8xsp2", "tp8xdp2"}
+    for p in plans.values():
+        assert p["compiled"]
+        assert p["fits_v5e"]
+        # exact shard accounting: tp8 splits the int8 params 8 ways
+        assert p["per_device_param_gb"] == pytest.approx(
+            proof["global_param_gb"] / 8, rel=0.02)
+        assert p["per_device_total_gb"] < 14.5
+
+
+def test_paged_pool_fits(proof):
+    pool = proof["paged_pool"]
+    assert pool["slots"] == 32 and pool["fits_v5e"]
+    assert pool["per_device_total_gb"] < 14.5

@@ -127,6 +127,22 @@ def test_engine_preemption_victims_newest_first(params):
     eng.decode_n(8)                           # survivors keep decoding
 
 
+def test_extend_pages_exhausted_releases_prefix(params):
+    """A failed extend must hand the parked prefix's pages back to the
+    pool: the scheduler has already dropped the slot from its parked map,
+    so nothing else would ever free them (ADVICE r2)."""
+    eng = Engine(XLA, params, ecfg=dataclasses.replace(PAGED, n_pages=3))
+    eng.admit(0, PROMPT, GREEDY)              # 1 page (+ chunk headroom)
+    eng.release(0, park=True)                 # prefix keeps its page
+    held = eng._pt.owned_blocks(0)
+    assert held > 0
+    full = np.concatenate([PROMPT, np.arange(1, 25, dtype=np.int32)])
+    with pytest.raises(PagesExhausted):
+        eng.extend(0, full, start=len(PROMPT), opts=GREEDY)
+    assert eng._pt.owned_blocks(0) == 0
+    assert eng.free_pages == 3                # whole pool free again
+
+
 def test_admission_pages_exhausted(params):
     eng = Engine(XLA, params, ecfg=dataclasses.replace(PAGED, n_pages=2))
     eng.admit(0, PROMPT, GREEDY)              # 1 page
