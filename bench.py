@@ -244,7 +244,8 @@ def load_baseline(metric: str) -> float | None:
 def measure(jax, *, model: str, dtype: str, slots: int, steps: int,
             seq: int, prompt_len: int, paged: bool, mixed: bool,
             chunk: int, page_size: int, n_pages: int | None,
-            platform: str, params_cache: dict | None = None) -> dict:
+            platform: str, params_cache: dict | None = None,
+            env: dict | None = None) -> dict:
     """Run one engine capture and return its record (also frees the engine
     before returning so sequential captures don't stack HBM).
 
@@ -273,7 +274,8 @@ def measure(jax, *, model: str, dtype: str, slots: int, steps: int,
 
     cfg = get_config(model)
     log(f"bench: capture model={model} dtype={dtype} slots={slots} "
-        f"steps={steps} seq={seq} paged={paged} mixed={mixed}")
+        f"steps={steps} seq={seq} paged={paged} mixed={mixed} "
+        f"env={env or {}}")
     cache_key = (model, dtype)
     if params_cache is not None and cache_key in params_cache:
         params, param_bytes, dtype = params_cache[cache_key]
@@ -418,6 +420,8 @@ def measure(jax, *, model: str, dtype: str, slots: int, steps: int,
         rec["hbm_bw_util_pct"] = round(
             bytes_per_step / n_dev / (per_step_ms / 1e3)
             / V5E_HBM_GBS * 100, 1)
+    if env:
+        rec["env"] = dict(env)
     log(f"bench: capture done: {json.dumps(rec)}")
     del eng, params   # params stay alive in params_cache if one was given
     gc.collect()
@@ -489,6 +493,11 @@ def main() -> None:
                  seq=1024, prompt_len=128, paged=False, mixed=False),
             dict(model="tinyllama", dtype="int8", slots=32, steps=64,
                  seq=1024, prompt_len=128, paged=True, mixed=True),
+            # MHA decode-kernel A/B vs capture 1 (same config, kernel on):
+            # settles whether the head-tiled grid retires the einsum bail
+            dict(model="phi", dtype="int8", slots=8, steps=64, seq=1024,
+                 prompt_len=128, paged=False, mixed=False,
+                 env={"TPU_MHA_KERNEL": "1"}),
         ]
 
     captures = []
@@ -502,6 +511,12 @@ def main() -> None:
                 f"{len(plan) - i} captures")
             break
         t_cap = time.monotonic()
+        # capture-scoped env (e.g. TPU_MHA_KERNEL=1): kernel routing reads
+        # the environment at trace time — set before the engine compiles,
+        # restore even on failure so captures stay independent
+        cap_env = cap.get("env") or {}
+        saved_env = {k: os.environ.get(k) for k in cap_env}
+        os.environ.update(cap_env)
         try:
             captures.append(measure(jax, **cap, **common))
         except Exception as e:   # a later capture must not void the headline
@@ -510,6 +525,12 @@ def main() -> None:
             log(f"bench: capture {cap['model']} paged={cap['paged']} "
                 f"failed: {type(e).__name__}: {e}")
             continue
+        finally:
+            for k, old in saved_env.items():
+                if old is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = old
         worst_capture_s = max(worst_capture_s, time.monotonic() - t_cap)
         if partial_f:
             print(json.dumps(captures[-1]), file=partial_f, flush=True)

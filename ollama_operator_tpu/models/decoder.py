@@ -601,11 +601,28 @@ def _paged_kernel_usable(cfg: ModelConfig, mesh, T: int, KvH: int, ps: int,
         return False   # per-layer window rides the (traced) mask
     if mesh is not None and mesh.size > 1:
         tp = mesh.shape.get("tp", 1)
-        if tp * 1 != mesh.size:            # engine enforces tp-only meshes
-            return False
+        if _paged_dp_axes(cfg, mesh, KvH) is None and tp != mesh.size:
+            return False                   # engine enforces dp/tp meshes
         if cfg.n_heads % tp or KvH % tp:
             return False
     return True
+
+
+def _paged_dp_axes(cfg: ModelConfig, mesh, KvH: int):
+    """("dp", h_ax) when this mesh runs the paged forward as a dp-manual
+    region (pool PAGE axis sharded over dp, per-shard LOCAL tables —
+    runtime/paged.ShardedPageTable + engine.py build that layout), else
+    None. Strict divisibility: inside a manual region there is no einsum
+    fallback, so the engine refuses dp meshes that fail this check."""
+    if mesh is None or mesh.size == 1:
+        return None
+    shape = dict(mesh.shape)
+    dp, tp = shape.get("dp", 1), shape.get("tp", 1)
+    if dp <= 1 or dp * tp != mesh.size:
+        return None
+    if tp > 1 and (cfg.n_heads % tp or KvH % tp):
+        return None
+    return "dp", ("tp" if tp > 1 else None)
 
 
 def _paged_attend(cfg: ModelConfig, q, kp, vp, i, tables, lengths, mask,
@@ -654,6 +671,117 @@ def _paged_attend(cfg: ModelConfig, q, kp, vp, i, tables, lengths, mask,
     return attend_hf(q, kw, vw, mask, scale, cfg.attn_softcap)
 
 
+def _scatter_kv_pools(kp, vp, i, k, v, pg_w, off_w):
+    """Quantize (int8 pools) and scatter one layer's fresh K/V into the
+    pools at (page, offset) per (row, position) — shared by the dp-manual
+    region and the single-shard paged forward so the write layout can
+    never drift between them."""
+    quant = isinstance(kp, dict)
+    arr = kp["q"] if quant else kp
+    if quant:
+        from ..ops import quant_cache as QC
+        kq, ksc = QC.quantize_kv(k)
+        vq, vsc = QC.quantize_kv(v)
+        kp = {"q": _paged_scatter(kp["q"], i, kq, pg_w, off_w),
+              "s": _paged_scatter(kp["s"], i, ksc, pg_w, off_w)}
+        vp = {"q": _paged_scatter(vp["q"], i, vq, pg_w, off_w),
+              "s": _paged_scatter(vp["s"], i, vsc, pg_w, off_w)}
+    else:
+        kp = _paged_scatter(kp, i, k.astype(arr.dtype), pg_w, off_w)
+        vp = _paged_scatter(vp, i, v.astype(arr.dtype), pg_w, off_w)
+    return kp, vp
+
+
+def _paged_write_attend_local(cfg: ModelConfig, q, k, v, kp, vp, i, tables,
+                              lengths, positions, mask, scale,
+                              attn_blocks: int, use_kernel: bool,
+                              interp: bool):
+    """Scatter one layer's fresh K/V into the (device-local) page pool and
+    attend — the body of the dp-manual region. ``tables`` carry LOCAL page
+    indices; on a single device local == global and this is just the
+    fused write+attend."""
+    quant = isinstance(kp, dict)
+    arr = kp["q"] if quant else kp
+    ps = arr.shape[3]
+    NBLK = tables.shape[1]
+    bi = jnp.arange(tables.shape[0])[:, None]
+    blk_w = positions // ps
+    pg_w = jnp.where(blk_w < NBLK, tables[bi, jnp.minimum(blk_w, NBLK - 1)],
+                     jnp.int32(TRASH_PAGE))
+    off_w = positions % ps
+    kp, vp = _scatter_kv_pools(kp, vp, i, k, v, pg_w, off_w)
+    if use_kernel:
+        from ..ops.pallas.paged import paged_decode_attention
+        out = paged_decode_attention(
+            q, kp, vp, i, tables, lengths, scale, cfg.attn_softcap,
+            cfg.sliding_window, nblk=attn_blocks, interpret=interp)
+        if out is not None:
+            return kp, vp, out
+    out = _paged_attend(cfg, q, kp, vp, i, tables, lengths, mask, scale,
+                        attn_blocks, None, False)
+    return kp, vp, out
+
+
+def _paged_write_attend_dp(cfg: ModelConfig, q, k, v, kp, vp, i, tables,
+                           lengths, positions, mask, scale,
+                           attn_blocks: int, use_kernel: bool, interp: bool,
+                           mesh, h_ax):
+    """dp/tp-manual wrapper around ``_paged_write_attend_local``: the pool
+    PAGE axis is sharded over dp (each shard's local page 0 is its trash
+    page) and tables/lengths/batch rows ride dp — so scatter AND attend
+    stay device-local with no collectives, the same property the dense
+    kernels get from ``ops/attention._sharded_kernel_call``."""
+    from jax.sharding import PartitionSpec as P
+    quant = isinstance(kp, dict)
+    pool_spec = P(None, "dp", h_ax, None, None)
+    pool_specs = ({"q": pool_spec, "s": P(None, "dp", h_ax, None)}
+                  if quant else pool_spec)
+    qspec = P("dp", None, h_ax, None)
+    kvspec = P("dp", h_ax, None, None)
+
+    def inner(q, k, v, kp, vp, i, tables, lengths, positions, mask):
+        return _paged_write_attend_local(
+            cfg, q, k, v, kp, vp, i, tables, lengths, positions, mask,
+            scale, attn_blocks, use_kernel, interp)
+
+    return jax.shard_map(
+        inner, mesh=mesh,
+        in_specs=(qspec, kvspec, kvspec, pool_specs, pool_specs, P(),
+                  P("dp", None), P("dp"), P("dp", None),
+                  P("dp", None, None, None)),
+        out_specs=(pool_specs, pool_specs, qspec),
+        axis_names={"dp", "tp"}, check_vma=False)(
+        q, k, v, kp, vp, i, tables, lengths, positions, mask)
+
+
+def paged_insert_dp(cfg: ModelConfig, k_pool, v_pool, ks, vs, table_rows,
+                    n_valid, mesh):
+    """dp twin of ``paged_insert``: ``table_rows`` [dp, NBLK] carries each
+    shard's LOCAL table row — the slot's owning shard gets the real pages,
+    every other shard an all-trash row, so the replicated B=1 prefill
+    writes land in non-owners' own trash pages and the real insert happens
+    only where the slot lives. No collectives, no cross-shard indexing."""
+    from jax.sharding import PartitionSpec as P
+    quant = isinstance(k_pool, dict)
+    KvH = (k_pool["q"] if quant else k_pool).shape[2]
+    tp = dict(mesh.shape).get("tp", 1)
+    h_ax = "tp" if (tp > 1 and KvH % tp == 0) else None
+    pool_spec = P(None, "dp", h_ax, None, None)
+    pool_specs = ({"q": pool_spec, "s": P(None, "dp", h_ax, None)}
+                  if quant else pool_spec)
+    kvs = P(None, None, h_ax, None, None)
+
+    def inner(kp, vp, ks, vs, trow, n_valid):
+        return paged_insert(cfg, kp, vp, ks, vs, trow[0], n_valid)
+
+    return jax.shard_map(
+        inner, mesh=mesh,
+        in_specs=(pool_specs, pool_specs, kvs, kvs, P("dp", None), P()),
+        out_specs=(pool_specs, pool_specs),
+        axis_names={"dp", "tp"}, check_vma=False)(
+        k_pool, v_pool, ks, vs, table_rows, n_valid)
+
+
 def forward_with_cache_paged(params: Params, cfg: ModelConfig,
                              tokens: jax.Array, k_pool, v_pool,
                              tables: jax.Array, lengths: jax.Array,
@@ -689,13 +817,22 @@ def forward_with_cache_paged(params: Params, cfg: ModelConfig,
     # out-of-table blocks (a slot over-running max_seq) redirect to the
     # trash page — never clamp into the slot's LAST live page, which
     # would corrupt resident prefix K/V
-    blk_w = positions // ps
-    NBLK = tables.shape[1]
-    pg_w = jnp.where(blk_w < NBLK,
-                     tables[bi, jnp.minimum(blk_w, NBLK - 1)],
-                     jnp.int32(TRASH_PAGE))
-    off_w = positions % ps
     use_kernel = _paged_kernel_usable(cfg, mesh, T, KvH, ps, hd)
+    dp_axes = _paged_dp_axes(cfg, mesh, KvH)
+    if dp_axes is None:
+        # single-shard write indices, computed once outside the scan (the
+        # dp-manual region derives its LOCAL indices per shard instead)
+        blk_w = positions // ps
+        NBLK = tables.shape[1]
+        pg_w = jnp.where(blk_w < NBLK,
+                         tables[bi, jnp.minimum(blk_w, NBLK - 1)],
+                         jnp.int32(TRASH_PAGE))
+        off_w = positions % ps
+    if dp_axes is not None:
+        assert T == 1, ("paged dp meshes decode only (T=1); the engine "
+                        "gates prefix-cache extends off dp")
+        from ..ops.attention import resolve_kernels
+        interp = resolve_kernels(cfg.kernels) == "interpret"
 
     def body(carry, layer_in):
         x, kp, vp = carry
@@ -704,20 +841,19 @@ def forward_with_cache_paged(params: Params, cfg: ModelConfig,
         q, k, v = _qkv(cfg, lp, h, cos, sin)
         k = k.transpose(0, 2, 1, 3)           # [B, KvH, T, hd]
         v = v.transpose(0, 2, 1, 3)
-        if quant:
-            from ..ops import quant_cache as QC
-            kq, ksc = QC.quantize_kv(k)
-            vq, vsc = QC.quantize_kv(v)
-            kp = {"q": _paged_scatter(kp["q"], i, kq, pg_w, off_w),
-                  "s": _paged_scatter(kp["s"], i, ksc, pg_w, off_w)}
-            vp = {"q": _paged_scatter(vp["q"], i, vq, pg_w, off_w),
-                  "s": _paged_scatter(vp["s"], i, vsc, pg_w, off_w)}
-        else:
-            kp = _paged_scatter(kp, i, k.astype(k_arr.dtype), pg_w, off_w)
-            vp = _paged_scatter(vp, i, v.astype(k_arr.dtype), pg_w, off_w)
         mask_l = _layer_mask(cfg, i, mask, m_full)
-        attn = _paged_attend(cfg, q, kp, vp, i, tables, lengths, mask_l,
-                             scale, attn_blocks, mesh, use_kernel)
+        if dp_axes is not None:
+            # dp mesh: pool page axis is dp-sharded with per-shard local
+            # tables — scatter AND attend run in one dp/tp-manual region
+            kp, vp, attn = _paged_write_attend_dp(
+                cfg, q, k, v, kp, vp, i, tables, lengths, positions,
+                mask_l, scale, attn_blocks, use_kernel, interp, mesh,
+                dp_axes[1])
+        else:
+            kp, vp = _scatter_kv_pools(kp, vp, i, k, v, pg_w, off_w)
+            attn = _paged_attend(cfg, q, kp, vp, i, tables, lengths,
+                                 mask_l, scale, attn_blocks, mesh,
+                                 use_kernel)
         attn = _proj_out(cfg, lp, attn, B, T)
         x = _residual(cfg, lp, x, h, attn)
         return (x, kp, vp), None

@@ -165,6 +165,12 @@ def multihost_env(headless_service: str, namespace: str, hosts: int,
         {"name": "TPU_DIST_COORDINATOR",
          "value": f"$(TPU_DIST_STS_NAME)-0.{headless_service}"
                   f".{namespace}.svc:8476"},
+        # leader→follower serving control stream (runtime/follower.py):
+        # process 0 broadcasts load/engine calls here so the whole slice
+        # dispatches identical SPMD programs
+        {"name": "TPU_DIST_CONTROL",
+         "value": f"$(TPU_DIST_STS_NAME)-0.{headless_service}"
+                  f".{namespace}.svc:8477"},
         {"name": "TPU_DIST_POD_NAME",
          "valueFrom": {"fieldRef": {"fieldPath": "metadata.name"}}},
     ]

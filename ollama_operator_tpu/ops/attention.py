@@ -210,28 +210,46 @@ def cached_attention(cfg, q, k_cache, v_cache, mask, q_pos, scale: float,
     DMAs within it. On a >1-device ``mesh`` the kernel runs inside a
     dp/tp-manual shard_map (see chunk_attention)."""
     mode = resolve_kernels(cfg.kernels)
-    # MHA (G == 1) maps badly onto the decode kernel's (B, KvH, nk) grid —
-    # B×KvH tiny 8-row programs lose to one big XLA einsum (measured on
-    # v5e: phi 128 vs 147 tok/s) — so "auto"-resolved pallas skips it; an
-    # explicit pallas choice (config or OLLAMA_TPU_KERNELS) still forces it.
+    # MHA (G == 1) maps badly onto the GQA decode kernel's (B, KvH, nk)
+    # grid — B×KvH tiny 8-row programs lose to one big XLA einsum
+    # (measured on v5e: phi 128 vs 147 tok/s) — so "auto"-resolved pallas
+    # skips it; an explicit pallas choice (config or OLLAMA_TPU_KERNELS)
+    # still forces it. TPU_MHA_KERNEL=1 instead routes MHA through the
+    # head-tiled mha_decode kernel (grid (B, H/8, nk) — pallas/flash.py);
+    # it stays opt-in until a chip capture shows it beating the einsum
+    # (bench.py measures both).
     explicit_pallas = (cfg.kernels == "pallas"
                        or os.environ.get("OLLAMA_TPU_KERNELS") == "pallas")
-    gqa_ok = q.shape[2] > k_cache.shape[1] or explicit_pallas
+    is_mha = q.shape[2] == k_cache.shape[1]
+    mha_kernel = is_mha and os.environ.get("TPU_MHA_KERNEL", "") == "1"
+    gqa_ok = (not is_mha) or explicit_pallas or mha_kernel
     if (mode in ("pallas", "interpret") and q.shape[1] == 1
             and (gqa_ok or mode == "interpret")):
-        from .pallas import decode_attention, decode_tileable
+        from .pallas import (decode_attention, decode_tileable,
+                             mha_decode_attention, mha_decode_tileable)
         interp = mode == "interpret"
         hd, S = q.shape[3], k_cache.shape[2]
 
-        def inner(q, k_cache, v_cache, pos):
-            return decode_attention(
-                q, k_cache, v_cache, pos, scale, cfg.attn_softcap,
-                cfg.sliding_window, interpret=interp)
+        if mha_kernel:
+            def inner(q, k_cache, v_cache, pos):
+                return mha_decode_attention(
+                    q, k_cache, v_cache, pos, scale, cfg.attn_softcap,
+                    cfg.sliding_window, interpret=interp)
+
+            def tileable(h, kvh):
+                return mha_decode_tileable(S, h, kvh, hd, interp)
+        else:
+            def inner(q, k_cache, v_cache, pos):
+                return decode_attention(
+                    q, k_cache, v_cache, pos, scale, cfg.attn_softcap,
+                    cfg.sliding_window, interpret=interp)
+
+            def tileable(h, kvh):
+                return decode_tileable(S, h, kvh, hd, interp)
 
         if mesh is not None and mesh.size > 1:
             out = _sharded_kernel_call(
-                mesh, q, k_cache.shape[1],
-                lambda h, kvh: decode_tileable(S, h, kvh, hd, interp),
+                mesh, q, k_cache.shape[1], tileable,
                 inner, (q, k_cache, v_cache, q_pos[:, 0]), with_pos=True)
             # None → mesh not shardable/tileable → einsum (GSPMD-auto)
         else:

@@ -16,15 +16,21 @@ tree —
                              schema's property order
   ("enum", (alts, ...))      one of several literal JSON values
   ("arr",  item, min1)       '[' item (',' item)* ']' (or empty)
+  ("alt",  (children, ...))  anyOf/oneOf alternation — expanded at push
+                             time into one NFA branch per child
+  ("irange", lo, hi)         integer hole with bounds: a digit-count DFA
+                             decides which prefixes can still land in
+                             [lo, hi] (None = unbounded side)
 
-and the machine state is a stack of (node, position) frames — a
-recursive-descent acceptor driven one byte at a time, so token pieces
-that cross hole/literal boundaries are handled exactly.
+and the machine state is a SET of stacks of (node, position) frames — a
+recursive-descent acceptor driven one byte at a time (alternation makes
+it an NFA; branches prune as bytes disambiguate), so token pieces that
+cross hole/literal boundaries are handled exactly.
 
-Unsupported schema constructs (anyOf, patternProperties, additional
-properties, numeric ranges, …) make ``compile_schema`` return None and
-the caller falls back to generic JSON mode with a warning — never a
-silently wrong constraint.
+Unsupported schema constructs (patternProperties, additionalProperties
+schemas, string length/pattern, float ranges, multipleOf, …) make
+``compile_schema`` return None and the caller falls back to generic JSON
+mode with a warning — never a silently wrong constraint.
 
 Masks are cached per (schema, machine state) on the compiled Schema
 object, which the server shares across requests with the same schema.
@@ -82,6 +88,20 @@ def _only_keys(schema: dict, allowed: frozenset) -> bool:
 def _compile_node(schema) -> Optional[Node]:
     if not isinstance(schema, dict):
         return None
+    if "anyOf" in schema or "oneOf" in schema:
+        # oneOf's exclusivity is unenforceable token-by-token (a prefix can
+        # be extended into several alternatives); constraining to the anyOf
+        # union is the sound over-approximation every grammar sampler makes
+        key = "anyOf" if "anyOf" in schema else "oneOf"
+        if not _only_keys(schema, frozenset({key})):
+            return None
+        alts = schema[key]
+        if not isinstance(alts, list) or not alts:
+            return None
+        children = tuple(_compile_node(a) for a in alts)
+        if any(c is None for c in children):
+            return None
+        return ("alt", children)
     if "enum" in schema:
         if not _only_keys(schema, frozenset({"enum", "type"})):
             return None
@@ -140,6 +160,28 @@ def _compile_node(schema) -> Optional[Node]:
         if min_items not in (0, 1):
             return None
         return ("arr", child, int(min_items))
+    if t == "integer" and not _only_keys(schema, frozenset({"type"})):
+        # bounded integers: minimum/maximum (and their exclusive forms)
+        # compile to the digit-count DFA ("irange"); anything further
+        # falls back
+        if not _only_keys(schema, frozenset(
+                {"type", "minimum", "maximum",
+                 "exclusiveMinimum", "exclusiveMaximum"})):
+            return None
+        lo, hi = schema.get("minimum"), schema.get("maximum")
+        xlo, xhi = schema.get("exclusiveMinimum"), \
+            schema.get("exclusiveMaximum")
+        bounds = [b for b in (lo, hi, xlo, xhi) if b is not None]
+        if not all(isinstance(b, int) and not isinstance(b, bool)
+                   for b in bounds):
+            return None            # float bounds on integers: fall back
+        if xlo is not None:
+            lo = xlo + 1 if lo is None else max(lo, xlo + 1)
+        if xhi is not None:
+            hi = xhi - 1 if hi is None else min(hi, xhi - 1)
+        if lo is not None and hi is not None and lo > hi:
+            return None            # unsatisfiable: nothing could ever emit
+        return ("irange", lo, hi)
     if not _only_keys(schema, frozenset({"type"})):
         return None
     if t in ("string", "number", "integer", "boolean", "null"):
@@ -162,6 +204,44 @@ def compile_schema(schema) -> Optional["Schema"]:
 # the skeleton machine
 # ---------------------------------------------------------------------------
 
+def _irange_viable(lo, hi, sign: int, v: int, k: int) -> bool:
+    """Can the k-digit magnitude ``v`` (sign ``sign``), extended by zero or
+    more digits, still land in [lo, hi] (None = unbounded side)? The
+    digit-count DFA behind ("irange", lo, hi): at most ~19 interval checks
+    per byte, no enumeration."""
+    def fits(a, b2):
+        vlo, vhi = (a, b2) if sign >= 0 else (-b2, -a)
+        return (hi is None or vlo <= hi) and (lo is None or vhi >= lo)
+
+    if fits(v, v):
+        return True
+    if v == 0:
+        return False                    # leading zero: no extensions
+    if sign >= 0:
+        if hi is None:
+            return True                 # magnitude grows past any lo
+        limit = len(str(hi)) if hi > 0 else k
+    else:
+        if lo is None:
+            return True
+        limit = len(str(-lo)) if lo < 0 else k
+    for m in range(k + 1, limit + 1):
+        scale = 10 ** (m - k)
+        if fits(v * scale, v * scale + scale - 1):
+            return True
+    return False
+
+
+def _irange_done(node: Node, sub) -> bool:
+    """Current irange digits form a complete in-range integer."""
+    sign, v, k = sub
+    if k == 0:
+        return False
+    val = v if sign >= 0 else -v
+    return ((node[1] is None or val >= node[1])
+            and (node[2] is None or val <= node[2]))
+
+
 def _init_sub(node: Node):
     tag = node[0]
     if tag == "lit":
@@ -172,160 +252,212 @@ def _init_sub(node: Node):
         return (0, tuple(range(len(node[1]))), False)
     if tag == "arr":
         return 0
+    if tag == "irange":
+        return (0, 0, 0)                # (sign, magnitude, n_digits)
     raise AssertionError(tag)
 
 
-def _push(stack: list, node: Node):
-    """Push ``node``, descending into seq heads so the top frame is
-    always an active byte consumer."""
-    while node[0] == "seq":
-        stack.append((node, 0))
-        node = node[1][0]
-    stack.append((node, _init_sub(node)))
+def _push_multi(stack: tuple, node: Node) -> List[tuple]:
+    """All stacks reachable by pushing ``node``: seq heads are descended,
+    alt nodes expand into one branch per alternative (the NFA split)."""
+    out: List[tuple] = []
+    work = [(list(stack), node)]
+    while work:
+        st, n = work.pop()
+        tag = n[0]
+        if tag == "seq":
+            st.append((n, 0))
+            work.append((st, n[1][0]))
+        elif tag == "alt":
+            for child in n[1]:
+                work.append((list(st), child))
+        else:
+            st.append((n, _init_sub(n)))
+            out.append(tuple(st))
+    return out
 
 
-def _completed_child(stack: list):
+def _completed_child(stack: tuple) -> List[tuple]:
     """Top frame finished and was popped; advance ancestors (possibly
-    completing them too) and push the next consumer if any."""
-    while stack:
-        node, sub = stack[-1]
+    completing them too) and push the next consumer if any. Returns all
+    resulting stacks (alternation in a following consumer can split)."""
+    st = list(stack)
+    while st:
+        node, sub = st[-1]
         tag = node[0]
         if tag == "seq":
             nxt = sub + 1
             if nxt == len(node[1]):
-                stack.pop()
+                st.pop()
                 continue
-            stack[-1] = (node, nxt)
-            _push(stack, node[1][nxt])
-            return
+            st[-1] = (node, nxt)
+            return _push_multi(tuple(st), node[1][nxt])
         if tag == "arr":
-            stack[-1] = (node, 3)   # after an item: ',' or ']'
-            return
+            st[-1] = (node, 3)          # after an item: ',' or ']'
+            return [tuple(st)]
         raise AssertionError(tag)
+    return [tuple(st)]
 
 
 def machine_init(root: Node) -> tuple:
-    stack: list = []
-    _push(stack, root)
-    return tuple(stack)
+    """Initial NFA state: a tuple of stacks (alternation at the root
+    yields several)."""
+    return tuple(_push_multi((), root))
+
+
+def _advance_stack(root: Node, stack: tuple, b: int) -> List[tuple]:
+    """One byte through a single stack; returns every successor stack
+    (alternation pushes and lazy closes can split), [] = rejected."""
+    if not stack:
+        return []                           # schema complete: EOS only
+    st = list(stack)
+    node, sub = st[-1]
+    tag = node[0]
+    if tag == "lit":
+        data = node[1]
+        if data[sub] != b:
+            return []
+        sub += 1
+        if sub == len(data):
+            st.pop()
+            return _completed_child(tuple(st))
+        st[-1] = (node, sub)
+        return [tuple(st)]
+    if tag == "leaf":
+        kind = node[1]
+        allowed = True
+        if sub == INITIAL_STATE:
+            start = _START_BYTES[kind]
+            allowed = start is None or b in start
+        if allowed and kind == "integer" and b in _INT_FORBIDDEN:
+            allowed = False
+        ns = advance_byte(sub, b) if allowed else None
+        if ns is not None:
+            if len(ns) == 4 and ns[0] == M_AFTER:
+                st.pop()                    # value definitely closed
+                return _completed_child(tuple(st))
+            st[-1] = (node, ns)
+            return [tuple(st)]
+        if eos_ok(sub):                     # lazy close (numbers)
+            st.pop()
+            out: List[tuple] = []
+            for cs in _completed_child(tuple(st)):
+                out.extend(_advance_stack(root, cs, b))   # redispatch b
+            return out
+        return []
+    if tag == "enum":
+        off, viable, _ = sub
+        nv = tuple(i for i in viable if off < len(node[1][i])
+                   and node[1][i][off] == b)
+        if not nv:
+            return []
+        off += 1
+        ext = tuple(i for i in nv if len(node[1][i]) > off)
+        results: List[tuple] = []
+        if ext:
+            st2 = list(st)
+            st2[-1] = (node, (off, ext, False))
+            results.append(tuple(st2))
+        if any(len(node[1][i]) == off for i in nv):
+            st2 = list(st)
+            st2.pop()                       # an alternative fully matched
+            results.extend(_completed_child(tuple(st2)))
+        return results
+    if tag == "arr":
+        if sub == 0:
+            if b != ord("["):
+                return []
+            st[-1] = (node, 1)
+            return [tuple(st)]
+        if sub == 1:                        # first item or ']'
+            if b == ord("]") and node[2] == 0:
+                st.pop()
+                return _completed_child(tuple(st))
+            st[-1] = (node, 2)
+            out = []
+            for ps in _push_multi(tuple(st), node[1]):
+                out.extend(_advance_stack(root, ps, b))   # redispatch b
+            return out
+        if sub == 3:                        # after an item
+            if b == ord("]"):
+                st.pop()
+                return _completed_child(tuple(st))
+            if b == ord(","):
+                st[-1] = (node, 2)
+                return _push_multi(tuple(st), node[1])
+            return []
+        return []                           # sub == 2 never sits on top
+    if tag == "irange":
+        sign, v, k = sub
+        lo, hi = node[1], node[2]
+        if 0x30 <= b <= 0x39:
+            d = b - 0x30
+            if k == 0:
+                nv_, nk = d, 1
+            elif v == 0:
+                return []                   # leading zero can't extend
+            else:
+                nv_, nk = v * 10 + d, k + 1
+            s_eff = sign if sign != 0 else 1
+            if not _irange_viable(lo, hi, s_eff, nv_, nk):
+                return []
+            st[-1] = (node, (s_eff, nv_, nk))
+            return [tuple(st)]
+        if b == 0x2D and sign == 0 and k == 0:            # '-'
+            if any(_irange_viable(lo, hi, -1, d, 1) for d in range(10)):
+                st[-1] = (node, (-1, 0, 0))
+                return [tuple(st)]
+            return []
+        if _irange_done(node, sub):         # delimiter closes the integer
+            st.pop()
+            out = []
+            for cs in _completed_child(tuple(st)):
+                out.extend(_advance_stack(root, cs, b))   # redispatch b
+            return out
+        return []
+    raise AssertionError(tag)
 
 
 def machine_advance(root: Node, state: tuple, b: int) -> Optional[tuple]:
-    """One byte through the skeleton machine; None = rejected. ``state``
-    is an immutable tuple of (node, sub) frames."""
-    stack = list(state)
-    for _ in range(128):                    # pop-chain guard
-        if not stack:
-            return None                     # schema complete: EOS only
-        node, sub = stack[-1]
-        tag = node[0]
-        if tag == "lit":
-            data = node[1]
-            if data[sub] != b:
-                return None
-            sub += 1
-            if sub == len(data):
-                stack.pop()
-                _completed_child(stack)
-            else:
-                stack[-1] = (node, sub)
-            return tuple(stack)
-        if tag == "leaf":
-            kind = node[1]
-            allowed = True
-            if sub == INITIAL_STATE:
-                start = _START_BYTES[kind]
-                allowed = start is None or b in start
-            if allowed and kind == "integer" and b in _INT_FORBIDDEN:
-                allowed = False
-            ns = advance_byte(sub, b) if allowed else None
-            if ns is not None:
-                if len(ns) == 4 and ns[0] == M_AFTER:
-                    stack.pop()             # value definitely closed
-                    _completed_child(stack)
-                else:
-                    stack[-1] = (node, ns)
-                return tuple(stack)
-            if eos_ok(sub):                 # lazy close (numbers)
-                stack.pop()
-                _completed_child(stack)
-                continue                    # redispatch b
-            return None
-        if tag == "enum":
-            off, viable, done = sub
-            nv = tuple(i for i in viable if off < len(node[1][i])
-                       and node[1][i][off] == b)
-            if nv:
-                off += 1
-                fin = any(len(node[1][i]) == off for i in nv)
-                ext = tuple(i for i in nv if len(node[1][i]) > off)
-                if fin and not ext:
-                    stack.pop()
-                    _completed_child(stack)
-                else:
-                    stack[-1] = (node, (off, ext or nv, fin))
-                return tuple(stack)
-            if done:                        # a full alt matched earlier
-                stack.pop()
-                _completed_child(stack)
-                continue
-            return None
-        if tag == "arr":
-            if sub == 0:
-                if b != ord("["):
-                    return None
-                stack[-1] = (node, 1)
-                return tuple(stack)
-            if sub == 1:                    # first item or ']'
-                if b == ord("]") and node[2] == 0:
-                    stack.pop()
-                    _completed_child(stack)
-                    return tuple(stack)
-                stack[-1] = (node, 2)
-                _push(stack, node[1])
-                continue                    # redispatch into the item
-            if sub == 3:                    # after an item
-                if b == ord("]"):
-                    stack.pop()
-                    _completed_child(stack)
-                    return tuple(stack)
-                if b == ord(","):
-                    stack[-1] = (node, 2)
-                    _push(stack, node[1])
-                    return tuple(stack)
-                return None
-            return None                     # sub == 2 never sits on top
-        raise AssertionError(tag)
-    return None
+    """One byte through the NFA; None = rejected. ``state`` is a tuple of
+    stacks, each an immutable tuple of (node, sub) frames."""
+    out: List[tuple] = []
+    seen = set()
+    for stack in state:
+        for ns in _advance_stack(root, stack, b):
+            if ns not in seen:
+                seen.add(ns)
+                out.append(ns)
+    return tuple(out) if out else None
+
+
+def _stack_eos_ok(stack: tuple) -> bool:
+    """One stack closable without more bytes? Only lazily-closing holes
+    (numbers, bounded integers) can sit open at EOS — everything else
+    completes eagerly on its final byte, leaving the empty stack."""
+    if not stack:
+        return True                         # schema complete
+    st = list(stack)
+    node, sub = st[-1]
+    tag = node[0]
+    closable = ((tag == "leaf" and eos_ok(sub))
+                or (tag == "irange" and _irange_done(node, sub)))
+    if not closable:
+        return False
+    st.pop()
+    # ancestors must all be at their last position — no new consumers
+    while st:
+        pn, ps = st[-1]
+        if pn[0] == "seq" and ps + 1 == len(pn[1]):
+            st.pop()
+            continue
+        return False
+    return True
 
 
 def machine_eos_ok(state: tuple) -> bool:
-    """EOS legal iff every open frame can close without more bytes."""
-    stack = list(state)
-    while stack:
-        node, sub = stack[-1]
-        tag = node[0]
-        if tag == "leaf" and eos_ok(sub):
-            stack.pop()
-            # complete ancestors WITHOUT pushing new consumers
-            while stack:
-                pn, ps = stack[-1]
-                if pn[0] == "seq" and ps + 1 == len(pn[1]):
-                    stack.pop()
-                    continue
-                return False
-            return True
-        if tag == "enum" and sub[2]:
-            stack.pop()
-            while stack:
-                pn, ps = stack[-1]
-                if pn[0] == "seq" and ps + 1 == len(pn[1]):
-                    stack.pop()
-                    continue
-                return False
-            return True
-        return False
-    return True
+    """EOS legal iff SOME branch can close without more bytes."""
+    return any(_stack_eos_ok(s) for s in state)
 
 
 # ---------------------------------------------------------------------------
@@ -346,13 +478,15 @@ class Schema:
         # token of max_len bytes can pop at most max_len containers, so
         # deeper "any"-hole nesting cannot change any token's acceptance
         # — without this, '[[[…' would mint (and full-vocab-sweep) a
-        # fresh state per depth
+        # fresh state per depth. The NFA state is a SET of stacks, so the
+        # key is order-insensitive (frozenset).
         def sub_key(n, s):
             if n[0] == "leaf" and isinstance(s, bytes):
                 return s[:4] + s[4:][-table.max_len:]
             return s
-        return (id(table),) + tuple((id(n), sub_key(n, s))
-                                    for n, s in state)
+        return (id(table),
+                frozenset(tuple((id(n), sub_key(n, s)) for n, s in stack)
+                          for stack in state))
 
     def mask_for(self, table: TokenTable, state: tuple) -> np.ndarray:
         key = self._state_key(table, state)

@@ -62,7 +62,9 @@ class EngineConfig:
     # paged KV cache (runtime/paged.py + ops/pallas/paged.py): slots share
     # a physical page pool instead of each reserving max_seq_len — HBM
     # scales with live tokens, so max_slots can be 32+ on one chip
-    # (SURVEY.md §7 hard-part 2). Single-device or tp-only meshes.
+    # (SURVEY.md §7 hard-part 2). Meshes: single-device, tp, dp, dp×tp
+    # (under dp the pool shards into per-shard sub-pools); sp keeps the
+    # dense sequence-sharded cache.
     paged: bool = False
     page_size: int = 64
     # data pages in the pool (excl. the trash page); None = the dense
@@ -161,16 +163,24 @@ class Engine:
             assert S % self.sp_size == 0, (
                 f"max_seq_len {S} must be divisible by sp={self.sp_size}")
         self.paged = ecfg.paged
+        self._paged_dp = 1
         if self.paged:
             assert self.sp_size == 1, (
                 "paged cache: sp meshes keep the dense sequence-sharded "
                 "cache (long_context.py)")
             if mesh is not None:
                 extra = {ax: sz for ax, sz in dict(mesh.shape).items()
-                         if sz > 1 and ax != "tp"}
+                         if sz > 1 and ax not in ("tp", "dp")}
                 assert not extra, (
-                    f"paged cache supports single-device or tp-only "
-                    f"meshes; got {extra}")
+                    f"paged cache supports single-device, tp, dp, or "
+                    f"dp×tp meshes; got {extra}")
+                if mesh.shape.get("dp", 1) > 1:
+                    from ..models.decoder import _paged_dp_axes
+                    assert _paged_dp_axes(cfg, mesh, KvH) is not None, (
+                        f"paged dp mesh needs dp×tp covering all devices "
+                        f"with heads divisible by tp; got "
+                        f"{dict(mesh.shape)}, H={cfg.n_heads}, KvH={KvH}")
+                    self._paged_dp = mesh.shape["dp"]
             ps = ecfg.page_size
             assert ps > 0 and ps & (ps - 1) == 0, (
                 f"page_size {ps} must be a power of two")
@@ -179,33 +189,69 @@ class Engine:
             dp = mesh.shape.get("dp", 1)
             assert B % dp == 0, f"max_slots {B} must divide dp {dp}"
             cache_sh = NamedSharding(mesh, kv_cache_pspec(cfg, mesh))
-            slot_sh = NamedSharding(mesh, P("dp" if dp > 1 else None))
-            self._param_sh = params_sharding_tree(params, mesh, cfg)
-            params = jax.device_put(params, self._param_sh)
+            b_ax = "dp" if dp > 1 else None
+            slot_sh = NamedSharding(mesh, P(b_ax))
+            # rank-2 slot state (counts [B,V], pring [B,W], masks) needs a
+            # CLOSED spec: P("dp") on rank 2 leaves dim 1 open, and GSPMD
+            # is then free to shard it differently per program — an AOT
+            # decode exec would reject the re-sharded state
+            slot_sh2 = NamedSharding(mesh, P(b_ax, None))
+            # multi-controller slice (jax.distributed world): the mesh
+            # spans devices other processes own, so host values become
+            # global arrays via make_array_from_callback — device_put
+            # rejects non-addressable shardings
+            self._multi = not all(d.process_index == jax.process_index()
+                                  for d in mesh.devices.flat)
+            assert not (self._multi and dp > 1), (
+                "multi-host slices serve with tp/sp meshes; dp-sharded "
+                "slot state is process-local (decode outputs ride P('dp') "
+                "and the host only reads its own shard) — scale batch "
+                "across hosts with CRD replicas instead")
+            self._repl_sh = NamedSharding(mesh, P())
         else:
-            cache_sh = slot_sh = None
-            self._param_sh = None
+            cache_sh = slot_sh = slot_sh2 = None
+            self._multi = False
+            self._repl_sh = None
         self._cache_sh, self._slot_sh = cache_sh, slot_sh
+        self._slot_sh2 = slot_sh2
+        if mesh is not None:
+            self._param_sh = params_sharding_tree(params, mesh, cfg)
+            params = jax.tree_util.tree_map(self._g, params,
+                                            self._param_sh)
+        else:
+            self._param_sh = None
         self.params = params
 
         def zeros(shape, dtype, sh):
-            arr = jnp.zeros(shape, dtype)
-            return jax.device_put(arr, sh) if sh is not None else arr
+            return self._g(np.zeros(shape, dtype), sh)
 
         if self.paged:
-            from .paged import PageTable
+            from .paged import PageTable, ShardedPageTable
             ps = ecfg.page_size
             self._nblk = S // ps
             n_pages = ecfg.n_pages or (B * S) // ps
-            self._pt = PageTable(B, n_pages + 1, ps, self._nblk)
-            pool_shape = (L, n_pages + 1, KvH, ps, hd)
+            dp = self._paged_dp
+            if dp > 1:
+                # pool PAGE axis sharded over dp: each shard owns an
+                # independent sub-pool (own trash page, own free list) and
+                # tables carry shard-LOCAL page indices — the paged
+                # forward's dp-manual region then never crosses shards
+                per_shard = -(-n_pages // dp)
+                self._pt = ShardedPageTable(B, dp, per_shard, ps,
+                                            self._nblk)
+                pool_shape = (L, dp * (per_shard + 1), KvH, ps, hd)
+                pg_ax = "dp"
+            else:
+                self._pt = PageTable(B, n_pages + 1, ps, self._nblk)
+                pool_shape = (L, n_pages + 1, KvH, ps, hd)
+                pg_ax = None
             h_ax = ("tp" if (mesh is not None
                              and mesh.shape.get("tp", 1) > 1
                              and KvH % mesh.shape["tp"] == 0) else None)
-            pool_sh = (NamedSharding(mesh, P(None, None, h_ax, None, None))
+            pool_sh = (NamedSharding(mesh, P(None, pg_ax, h_ax, None, None))
                        if mesh is not None else None)
             if self.quant_cache:
-                s_sh = (NamedSharding(mesh, P(None, None, h_ax, None))
+                s_sh = (NamedSharding(mesh, P(None, pg_ax, h_ax, None))
                         if mesh is not None else None)
                 cache_sh = {"q": pool_sh, "s": s_sh}
                 self.k_cache = {
@@ -227,7 +273,9 @@ class Engine:
 
             def qzeros(sh):
                 c = empty_cache(L, B, KvH, S, hd)
-                return jax.device_put(c, sh) if sh is not None else c
+                if sh is None:
+                    return c
+                return jax.tree_util.tree_map(self._g, c, sh)
             cache_sh = self._quant_cache_sharding(cache_sh)
             self._cache_sh = cache_sh
             self.k_cache = qzeros(cache_sh)
@@ -237,38 +285,43 @@ class Engine:
             self.k_cache = zeros(cache_shape, ecfg.cache_dtype, cache_sh)
             self.v_cache = zeros(cache_shape, ecfg.cache_dtype, cache_sh)
         self.lengths = zeros((B,), jnp.int32, slot_sh)
-        self.counts = zeros((B, V), jnp.int32, slot_sh)
+        self.counts = zeros((B, V), jnp.int32, slot_sh2)
         # penalty ring: the last repeat_last_n token ids per slot (sentinel
         # V = "empty"; scatter-drop keeps it out of counts)
         W = max(1, ecfg.repeat_last_n)
-        self.pring = jax.device_put(
-            jnp.full((B, W), V, jnp.int32), slot_sh) \
-            if slot_sh is not None else jnp.full((B, W), V, jnp.int32)
+        self.pring = self._g(np.full((B, W), V, np.int32), slot_sh2)
         self.last_tokens = zeros((B,), jnp.int32, slot_sh)
         # grammar-constraint state: packed per-slot allowed-token masks
         # (all-ones + flag 0 = unconstrained; ops/constrain.py fills rows)
         self.mask_words = (V + 31) // 32
-        self._mask_ones = jnp.full((self.mask_words,), 0xFFFFFFFF, jnp.uint32)
-        ones = jnp.full((B, self.mask_words), 0xFFFFFFFF, jnp.uint32)
-        self.mask_bits = jax.device_put(ones, slot_sh) \
-            if slot_sh is not None else ones
+        self._mask_ones = self._gr(
+            np.full((self.mask_words,), 0xFFFFFFFF, np.uint32))
+        self.mask_bits = self._g(
+            np.full((B, self.mask_words), 0xFFFFFFFF, np.uint32), slot_sh2)
         self._constrained = np.zeros((B,), bool)
         self._constr_dev = zeros((B,), jnp.int32, slot_sh)
         self.active = np.zeros((B,), bool)  # host-side mask
         self._active_dev = zeros((B,), jnp.int32, slot_sh)
         # per-slot effective penalty window (≤ W ring capacity)
         self._repeat_n = np.full((B,), W, np.int32)
-        self._rln_dev = jnp.asarray(self._repeat_n)
+        self._rln_dev = self._g(self._repeat_n, self._slot_sh)
         # host mirror of per-slot lengths — lets decode_n pick the static
         # attention bucket without a device sync
         self._host_lengths = np.zeros((B,), np.int64)
 
         # per-slot sampling params, host mirror + device arrays
         self._opts: Dict[int, SlotOptions] = {}
-        self.sp = sampling.SamplingParams.make(B)
-        base = jax.random.key(0)
-        self.keys = jax.vmap(jax.random.fold_in)(
-            jnp.broadcast_to(base, (B,)), jnp.arange(B))
+        self.sp = jax.tree_util.tree_map(
+            lambda a: self._g(np.asarray(a), slot_sh),
+            sampling.SamplingParams.make(B))
+
+        def _base_keys():
+            return jax.vmap(jax.random.fold_in)(
+                jnp.broadcast_to(jax.random.key(0), (B,)), jnp.arange(B))
+        # typed key arrays can't ride make_array_from_callback — create
+        # them as a (collective) jitted program with a global out_sharding
+        self.keys = (jax.jit(_base_keys, out_shardings=slot_sh)()
+                     if slot_sh is not None else _base_keys())
 
         # SP prefill shards the chunk over sp — every bucket must divide it
         # (both are powers of two, so raising the floor suffices; the last
@@ -276,6 +329,44 @@ class Engine:
         self._buckets = prefill_buckets(
             S, max(ecfg.min_prefill_bucket, self.sp_size))
         self._compile_fns()
+
+    def _g(self, x, sharding):
+        """Host value → device array under ``sharding``. Single-process:
+        plain device_put. Multi-controller slice: the mesh spans devices
+        other processes own, so build a global array from the (identical)
+        host value via make_array_from_callback."""
+        if sharding is None:
+            return jnp.asarray(x)
+        if not self._multi:
+            return jax.device_put(x, sharding)
+        arr = np.asarray(x)
+        return jax.make_array_from_callback(
+            arr.shape, sharding, lambda idx: arr[idx])
+
+    def _gr(self, x):
+        """Replicated upload (scalars, B=1 rows, packed masks)."""
+        return self._g(x, self._repl_sh)
+
+    def _dummy_key(self):
+        """Replicated PRNG key for AOT lowering (typed key arrays can't
+        ride make_array_from_callback; a jitted maker can)."""
+        k = getattr(self, "_dummy_key_val", None)
+        if k is None:
+            if self._slot_sh is None:
+                k = jax.random.key(0)
+            else:
+                k = jax.jit(jax.random.key, static_argnums=0,
+                            out_shardings=self._repl_sh)(0)
+            self._dummy_key_val = k
+        return k
+
+    @staticmethod
+    def _fetch(x) -> np.ndarray:
+        """Device→host for replicated values; a multi-controller array is
+        not fully addressable, so read one local (identical) shard."""
+        if getattr(x, "is_fully_addressable", True):
+            return np.asarray(x)
+        return np.asarray(x.addressable_data(0))
 
     @staticmethod
     def _quant_cache_sharding(cache_sh):
@@ -293,18 +384,20 @@ class Engine:
     def _compile_fns(self):
         cfg = self.cfg
         cache_sh, slot_sh = self._cache_sh, self._slot_sh
+        slot_sh2 = self._slot_sh2
 
-        def pin(k_cache, v_cache, lengths, counts, last_tokens):
+        def pin(k_cache, v_cache, lengths, counts, last_tokens, pring):
             """Pin slot-state outputs to their canonical shardings — the
             AOT-compiled decode executables require the state sharding to
             be IDENTICAL across admits (GSPMD would otherwise pick a fresh
-            output sharding per program and the exec call would reject)."""
+            output sharding per program and the exec call would reject).
+            Rank-2 state pins with the CLOSED spec (see __init__)."""
             if slot_sh is None:
-                return k_cache, v_cache, lengths, counts, last_tokens
+                return k_cache, v_cache, lengths, counts, last_tokens, pring
             wsc = jax.lax.with_sharding_constraint
             return (wsc(k_cache, cache_sh), wsc(v_cache, cache_sh),
-                    wsc(lengths, slot_sh), wsc(counts, slot_sh),
-                    wsc(last_tokens, slot_sh))
+                    wsc(lengths, slot_sh), wsc(counts, slot_sh2),
+                    wsc(last_tokens, slot_sh), wsc(pring, slot_sh2))
 
         if self.sp_size > 1:
             from ..parallel import long_context
@@ -385,7 +478,11 @@ class Engine:
                 lengths, counts, last_tokens, pring, last, ring_row,
                 counts_row, slot, n_valid, sp_row, key, mask_row, cflag,
                 rln)
-            if self.paged:
+            if self.paged and self._paged_dp > 1:
+                k_cache, v_cache = decoder.paged_insert_dp(
+                    cfg, k_cache, v_cache, ks, vs, table_row, n_valid,
+                    self.mesh)
+            elif self.paged:
                 k_cache, v_cache = decoder.paged_insert(
                     cfg, k_cache, v_cache, ks, vs, table_row, n_valid)
             elif self.quant_cache:
@@ -403,9 +500,8 @@ class Engine:
                 v_cache = jax.lax.dynamic_update_slice(
                     v_cache, vs.astype(v_cache.dtype), (0, slot, 0, 0, 0))
             return (tok, *pin(k_cache, v_cache, lengths, counts,
-                              last_tokens), pring)
+                              last_tokens, pring))
 
-        @partial(jax.jit, donate_argnums=(1, 2, 3, 4, 5, 6))
         def _admit(params, k_cache, v_cache, lengths, counts, last_tokens,
                    pring, tokens, slot, n_valid, sp_row, key, mask_row,
                    cflag, rln, table_row=None):
@@ -418,7 +514,6 @@ class Engine:
                                      tokens, slot, n_valid, sp_row, key,
                                      mask_row, cflag, rln, table_row)
 
-        @partial(jax.jit, donate_argnums=(1, 2, 3, 4, 5, 6))
         def _admit_embeds(params, k_cache, v_cache, lengths, counts,
                           last_tokens, pring, tokens, embeds, slot, n_valid,
                           sp_row, key, mask_row, cflag, rln,
@@ -478,9 +573,8 @@ class Engine:
             lengths = lengths + active
             last_tokens = jnp.where(active == 1, toks, last_tokens)
             return (toks, *pin(k_cache, v_cache, lengths, counts,
-                               last_tokens), pring)
+                               last_tokens, pring))
 
-        @partial(jax.jit, donate_argnums=(1, 2, 3, 4, 5, 6, 8))
         def _decode(params, k_cache, v_cache, lengths, counts, last_tokens,
                     pring, sp, keys, active, mask_bits, constrained, rln,
                     tables=None):
@@ -492,8 +586,6 @@ class Engine:
             return (toks, k_cache, v_cache, lengths, counts, last_tokens,
                     pring, keys)
 
-        @partial(jax.jit, static_argnums=(13, 14),
-                 donate_argnums=(1, 2, 3, 4, 5, 6, 8))
         def _decode_n(params, k_cache, v_cache, lengths, counts, last_tokens,
                       pring, sp, keys, active, mask_bits, constrained, rln,
                       n, attn_len, tables=None, budgets=None):
@@ -531,7 +623,6 @@ class Engine:
             return (toks_n, k_cache, v_cache, lengths, counts, last_tokens,
                     pring, keys)
 
-        @partial(jax.jit, donate_argnums=(1, 2, 3, 4, 5, 6))
         def _extend_paged(params, k_cache, v_cache, lengths, counts,
                           last_tokens, pring, tokens, ring_row, counts_row,
                           slot, start, n_new, table_row, sp_row, key,
@@ -553,9 +644,8 @@ class Engine:
                 counts_row, slot, start + n_new, sp_row, key, mask_row,
                 cflag, rln)
             return (tok, *pin(k_cache, v_cache, lengths, counts,
-                              last_tokens), pring)
+                              last_tokens, pring))
 
-        @partial(jax.jit, donate_argnums=(1, 2, 3, 4, 5, 6))
         def _extend(params, k_cache, v_cache, lengths, counts, last_tokens,
                     pring, tokens, ring_row, counts_row, slot, start, n_new,
                     sp_row, key, mask_row, cflag, rln):
@@ -603,9 +693,8 @@ class Engine:
                 counts_row, slot, start + n_new, sp_row, key, mask_row,
                 cflag, rln)
             return (tok, *pin(k_cache, v_cache, lengths, counts,
-                              last_tokens), pring)
+                              last_tokens, pring))
 
-        @partial(jax.jit, donate_argnums=(0, 1, 2, 3))
         def _release(lengths, counts, last_tokens, pring, slot):
             lengths = lengths.at[slot].set(0)
             counts = counts.at[slot].set(0)
@@ -613,25 +702,71 @@ class Engine:
             pring = pring.at[slot].set(cfg.vocab_size)
             return lengths, counts, last_tokens, pring
 
-        @partial(jax.jit, donate_argnums=(0, 1))
         def _set_mask(mask_bits, constr, slot, row, flag):
             mask_bits = mask_bits.at[slot].set(row)
             constr = constr.at[slot].set(flag)
             if slot_sh is not None:
                 wsc = jax.lax.with_sharding_constraint
-                mask_bits = wsc(mask_bits, slot_sh)
+                mask_bits = wsc(mask_bits, slot_sh2)
                 constr = wsc(constr, slot_sh)
             return mask_bits, constr
 
-        self._admit_fn = _admit
-        self._admit_embeds_fn = _admit_embeds
+        # Explicit out_shardings on every state-returning program: wsc
+        # inside the trace guides internals, but the JIT BOUNDARY sharding
+        # of unannotated outputs is GSPMD's choice — on a dp×tp mesh it
+        # happily re-shards counts [B, V] over tp in one program, and the
+        # AOT execs (compiled against the canonical state shardings) then
+        # reject their own prior outputs.
+        state_outs = None
+        if slot_sh is not None:
+            state_outs = (cache_sh, cache_sh, slot_sh, slot_sh2, slot_sh,
+                          slot_sh2)
+
+        def _jit(fn, donate, static=None, outs=None):
+            kw = {"donate_argnums": donate}
+            if static is not None:
+                kw["static_argnums"] = static
+            if outs is not None and slot_sh is not None:
+                kw["out_shardings"] = outs
+            return jax.jit(fn, **kw)
+
+        if state_outs:
+            # every output gets a CONCRETE sharding (a None leaf in an
+            # out_shardings tree reads as an empty pytree node, not
+            # "unspecified"): sampled tokens ride the batch axis, the
+            # first admission token is a replicated scalar
+            b_ax = slot_sh.spec[0] if slot_sh.spec else None
+            repl_sh = NamedSharding(self.mesh, P())
+            toksn_sh = NamedSharding(self.mesh, P(None, b_ax))
+            tok_outs = (repl_sh,) + state_outs
+            dec_outs = (slot_sh,) + state_outs + (slot_sh,)
+            decn_outs = (toksn_sh,) + state_outs + (slot_sh,)
+        else:
+            tok_outs = dec_outs = decn_outs = None
+        self._admit_fn = _jit(_admit, (1, 2, 3, 4, 5, 6), outs=tok_outs)
+        self._admit_embeds_fn = _jit(_admit_embeds, (1, 2, 3, 4, 5, 6),
+                                     outs=tok_outs)
         self._admit_execs: Dict[int, Any] = {}
-        self._extend_fn = _extend_paged if self.paged else _extend
+        self._extend_fn = _jit(_extend_paged if self.paged else _extend,
+                               (1, 2, 3, 4, 5, 6), outs=tok_outs)
         self._extend_execs: Dict[int, Any] = {}
-        self._decode_fn = _decode
-        self._decode_n_fn = _decode_n
-        self._release_fn = _release
-        self._set_mask_fn = _set_mask
+        self._decode_fn = _jit(_decode, (1, 2, 3, 4, 5, 6, 8),
+                               outs=dec_outs)
+        self._decode_n_fn = _jit(_decode_n, (1, 2, 3, 4, 5, 6, 8),
+                                 static=(13, 14), outs=decn_outs)
+        self._release_fn = _jit(
+            _release, (0, 1, 2, 3),
+            outs=(slot_sh, slot_sh2, slot_sh, slot_sh2) if slot_sh else None)
+
+        def _install_key(keys, slot, seed):
+            k = jax.random.key(seed)
+            return keys.at[slot].set(k), k
+        self._install_key_fn = _jit(
+            _install_key, (0,),
+            outs=(slot_sh, self._repl_sh) if slot_sh is not None else None)
+        self._set_mask_fn = _jit(
+            _set_mask, (0, 1),
+            outs=(slot_sh2, slot_sh) if slot_sh else None)
         # AOT-compiled decode_n executables keyed by (n, attn_bucket) — a
         # bucket crossing must swap programs, never recompile mid-serving
         self._decode_execs: Dict[Any, Any] = {}
@@ -650,41 +785,49 @@ class Engine:
                          f"{self.max_seq}")
 
     def _sp_row(self, o: SlotOptions):
+        g = self._gr
         return sampling.SamplingParams(
-            temperature=jnp.array([o.temperature], jnp.float32),
-            top_k=jnp.array([o.top_k], jnp.int32),
-            top_p=jnp.array([o.top_p], jnp.float32),
-            min_p=jnp.array([o.min_p], jnp.float32),
-            repeat_penalty=jnp.array([o.repeat_penalty], jnp.float32),
-            presence_penalty=jnp.array([o.presence_penalty], jnp.float32),
-            frequency_penalty=jnp.array([o.frequency_penalty], jnp.float32))
+            temperature=g(np.array([o.temperature], np.float32)),
+            top_k=g(np.array([o.top_k], np.int32)),
+            top_p=g(np.array([o.top_p], np.float32)),
+            min_p=g(np.array([o.min_p], np.float32)),
+            repeat_penalty=g(np.array([o.repeat_penalty], np.float32)),
+            presence_penalty=g(np.array([o.presence_penalty], np.float32)),
+            frequency_penalty=g(np.array([o.frequency_penalty],
+                                         np.float32)))
 
     def _rebuild_sp(self):
         opts = [self._opts.get(i, SlotOptions()) for i in range(self.n_slots)]
+        g = lambda a: self._g(a, self._slot_sh)  # noqa: E731
         self.sp = sampling.SamplingParams(
-            temperature=jnp.array([o.temperature for o in opts], jnp.float32),
-            top_k=jnp.array([o.top_k for o in opts], jnp.int32),
-            top_p=jnp.array([o.top_p for o in opts], jnp.float32),
-            min_p=jnp.array([o.min_p for o in opts], jnp.float32),
-            repeat_penalty=jnp.array(
-                [o.repeat_penalty for o in opts], jnp.float32),
-            presence_penalty=jnp.array(
-                [o.presence_penalty for o in opts], jnp.float32),
-            frequency_penalty=jnp.array(
-                [o.frequency_penalty for o in opts], jnp.float32))
+            temperature=g(np.array([o.temperature for o in opts],
+                                   np.float32)),
+            top_k=g(np.array([o.top_k for o in opts], np.int32)),
+            top_p=g(np.array([o.top_p for o in opts], np.float32)),
+            min_p=g(np.array([o.min_p for o in opts], np.float32)),
+            repeat_penalty=g(np.array(
+                [o.repeat_penalty for o in opts], np.float32)),
+            presence_penalty=g(np.array(
+                [o.presence_penalty for o in opts], np.float32)),
+            frequency_penalty=g(np.array(
+                [o.frequency_penalty for o in opts], np.float32)))
 
     def _prep_slot(self, slot: int, opts: SlotOptions, seq_len: int,
                    mask_row: Optional[np.ndarray]):
         """Shared admission setup: install the slot PRNG key, resolve the
         optional grammar mask. Returns (key, mask_row_dev, cflag)."""
+        # deterministic mix (NOT hash(): Python salts it per process, and
+        # multi-host followers must derive byte-identical keys or the
+        # replicated sampling inputs diverge across the SPMD world)
         seed = (opts.seed if opts.seed >= 0
-                else (hash((slot, seq_len)) & 0x7FFFFFFF))
-        key = jax.random.key(seed)
-        self.keys = self.keys.at[slot].set(key)
+                else (slot * 1000003 + seq_len * 7919 + 12345)
+                & 0x7FFFFFFF)
+        self.keys, key = self._install_key_fn(
+            self.keys, self._gr(np.int32(slot)), self._gr(np.int32(seed)))
         if mask_row is not None:
-            return key, jnp.asarray(self._pad_mask_row(mask_row)), \
-                jnp.int32(1)
-        return key, self._mask_ones, jnp.int32(0)
+            return key, self._gr(self._pad_mask_row(mask_row)), \
+                self._gr(np.int32(1))
+        return key, self._mask_ones, self._gr(np.int32(0))
 
     def _resolve_rln(self, opts: SlotOptions) -> int:
         """Request window → effective window: -1 = engine max, clamp to
@@ -700,12 +843,13 @@ class Engine:
         self._host_lengths[slot] = n_total
         self._opts[slot] = opts
         self._repeat_n[slot] = self._resolve_rln(opts)
-        self._rln_dev = jnp.asarray(self._repeat_n)
+        self._rln_dev = self._g(self._repeat_n, self._slot_sh)
         if self.paged:
             self._admit_seq += 1
             self._admit_order[slot] = self._admit_seq
         self._rebuild_sp()
-        self._active_dev = jnp.asarray(self.active.astype(np.int32))
+        self._active_dev = self._g(self.active.astype(np.int32),
+                                   self._slot_sh)
 
     def admit(self, slot: int, prompt: np.ndarray,
               opts: SlotOptions = SlotOptions(),
@@ -739,17 +883,19 @@ class Engine:
              self.last_tokens, self.pring) = self._admit_embeds_fn(
                 self.params, self.k_cache, self.v_cache, self.lengths,
                 self.counts, self.last_tokens, self.pring,
-                jnp.asarray(tokens), jnp.asarray(emb), jnp.int32(slot),
-                jnp.int32(n), self._sp_row(opts), key, mrow, cflag,
-                jnp.int32(self._resolve_rln(opts)), table_row)
+                self._gr(tokens), self._gr(emb), self._gr(np.int32(slot)),
+                self._gr(np.int32(n)), self._sp_row(opts), key, mrow,
+                cflag, self._gr(np.int32(self._resolve_rln(opts))),
+                table_row)
         else:
             (tok, self.k_cache, self.v_cache, self.lengths, self.counts,
              self.last_tokens, self.pring) = self._admit_exec(bucket)(
                 self.params, self.k_cache, self.v_cache, self.lengths,
                 self.counts, self.last_tokens, self.pring,
-                jnp.asarray(tokens), jnp.int32(slot), jnp.int32(n),
-                self._sp_row(opts), key, mrow, cflag,
-                jnp.int32(self._resolve_rln(opts)), table_row)
+                self._gr(tokens), self._gr(np.int32(slot)),
+                self._gr(np.int32(n)), self._sp_row(opts), key, mrow,
+                cflag, self._gr(np.int32(self._resolve_rln(opts))),
+                table_row)
         self._commit_slot(slot, n, opts)
         return int(tok)
 
@@ -763,40 +909,60 @@ class Engine:
         self._pt.release(slot)
         # availability check includes one decode chunk of headroom (not
         # allocated — prepare_decode claims it): admitting a request the
-        # very next chunk must preempt would thrash prefill work
+        # very next chunk must preempt would thrash prefill work.
+        # free_for(slot): on a dp mesh each slot allocates only from its
+        # own shard's sub-pool
         ahead = min(n + self.ecfg.decode_chunk, self.max_seq)
-        if self._pt.blocks_for(ahead) > self._pt.n_free:
+        if self._pt.blocks_for(ahead) > self._pt.free_for(slot):
             raise PagesExhausted(
                 f"prompt of {n} tokens (+1 chunk headroom) needs "
                 f"{self._pt.blocks_for(ahead)} pages; "
-                f"{self._pt.n_free} free")
+                f"{self._pt.free_for(slot)} free")
         self._pt.grow(slot, n)
-        return jnp.asarray(self._pt.tables[slot])
+        return self._table_row_dev(slot)
+
+    def _table_row_dev(self, slot: int):
+        """The admission program's table argument: the slot's row [NBLK]
+        (local == global indices without dp), or [dp, NBLK] per-shard rows
+        where only the owning shard carries real (LOCAL) pages — the
+        others get all-trash rows so their replicated writes self-discard
+        (decoder.paged_insert_dp)."""
+        if self._paged_dp == 1:
+            return self._gr(self._pt.tables[slot])
+        from .paged import TRASH_PAGE
+        rows = np.full((self._paged_dp, self._nblk), TRASH_PAGE, np.int32)
+        rows[self._pt.shard_of(slot)] = self._pt.tables[slot]
+        # [dp, NBLK]: each dp shard reads its own row inside the insert's
+        # manual region
+        return self._g(rows, NamedSharding(self.mesh, P("dp", None))
+                       if self.mesh is not None else None)
 
     @property
     def supports_extend(self) -> bool:
-        """Prefix-cache continuation: any paged pool and any dense cache
-        incl. int8 (both quantize the tail in place). Only the sp
-        sequence-sharded cache is out — its shards would each need a
-        partial-tail write."""
+        """Prefix-cache continuation: any single-shard paged pool and any
+        dense cache incl. int8 (both quantize the tail in place). Out:
+        the sp sequence-sharded cache (shards would each need a
+        partial-tail write) and paged×dp (the B=1 tail prefill can't ride
+        the dp-manual region)."""
         if self.paged:
-            return True
+            return self._paged_dp == 1
         return self.sp_size == 1
 
     def _extend_exec(self, bucket: int):
         exe = self._extend_execs.get(bucket)
         if exe is None:
-            tokens = jnp.zeros((1, bucket), jnp.int32)
+            tokens = self._gr(np.zeros((1, bucket), np.int32))
             W = max(1, self.ecfg.repeat_last_n)
+            zi = lambda v: self._gr(np.int32(v))  # noqa: E731
             args = [self.params, self.k_cache, self.v_cache, self.lengths,
                     self.counts, self.last_tokens, self.pring, tokens,
-                    jnp.zeros((W,), jnp.int32), jnp.zeros(
-                        (self.cfg.vocab_size,), jnp.int32),
-                    jnp.int32(0), jnp.int32(1), jnp.int32(1)]
+                    self._gr(np.zeros((W,), np.int32)), self._gr(
+                        np.zeros((self.cfg.vocab_size,), np.int32)),
+                    zi(0), zi(1), zi(1)]
             if self.paged:
-                args.append(jnp.zeros((self._nblk,), jnp.int32))
-            args += [self._sp_row(SlotOptions()), jax.random.key(0),
-                     self._mask_ones, jnp.int32(0), jnp.int32(W)]
+                args.append(self._gr(np.zeros((self._nblk,), np.int32)))
+            args += [self._sp_row(SlotOptions()), self._dummy_key(),
+                     self._mask_ones, zi(0), zi(W)]
             exe = self._extend_fn.lower(*args).compile()
             self._extend_execs[bucket] = exe
         return exe
@@ -848,15 +1014,16 @@ class Engine:
         key, mrow, cflag = self._prep_slot(slot, opts, n_total, mask_row)
         args = [self.params, self.k_cache, self.v_cache, self.lengths,
                 self.counts, self.last_tokens, self.pring,
-                jnp.asarray(tokens), jnp.asarray(ring),
-                jnp.asarray(counts_row), jnp.int32(slot), jnp.int32(start),
-                jnp.int32(n_new)]
+                self._gr(tokens), self._gr(ring),
+                self._gr(counts_row), self._gr(np.int32(slot)),
+                self._gr(np.int32(start)), self._gr(np.int32(n_new))]
         if self.paged:
             from .paged import PagesExhausted
             ahead = min(n_total + self.ecfg.decode_chunk, self.max_seq)
             deficit = (self._pt.blocks_for(ahead)
                        - self._pt.owned_blocks(slot))
-            if deficit > self._pt.n_free or not self._pt.grow(slot, n_total):
+            if deficit > self._pt.free_for(slot) \
+                    or not self._pt.grow(slot, n_total):
                 # the scheduler already popped this slot from its parked
                 # map, so nothing will ever reuse or evict the prefix —
                 # return its pages now or they leak until a fresh admit
@@ -865,8 +1032,9 @@ class Engine:
                 raise PagesExhausted(
                     f"extend to {n_total} tokens (+1 chunk headroom): "
                     f"{self._pt.n_free} pages free")
-            args.append(jnp.asarray(self._pt.tables[slot]))
-        args += [self._sp_row(opts), key, mrow, cflag, jnp.int32(rln)]
+            args.append(self._gr(self._pt.tables[slot]))
+        args += [self._sp_row(opts), key, mrow, cflag,
+                 self._gr(np.int32(rln))]
         (tok, self.k_cache, self.v_cache, self.lengths, self.counts,
          self.last_tokens, self.pring) = self._extend_exec(bucket)(*args)
         self._commit_slot(slot, n_total, opts)
@@ -902,19 +1070,22 @@ class Engine:
         the next decode step; constrained until release/clear_mask)."""
         self._constrained[slot] = True
         self.mask_bits, self._constr_dev = self._set_mask_fn(
-            self.mask_bits, self._constr_dev, jnp.int32(slot),
-            jnp.asarray(self._pad_mask_row(row)), jnp.int32(1))
+            self.mask_bits, self._constr_dev, self._gr(np.int32(slot)),
+            self._gr(self._pad_mask_row(row)), self._gr(np.int32(1)))
 
     def clear_mask(self, slot: int):
         if not self._constrained[slot]:
             return
         self._constrained[slot] = False
         self.mask_bits, self._constr_dev = self._set_mask_fn(
-            self.mask_bits, self._constr_dev, jnp.int32(slot),
-            self._mask_ones, jnp.int32(0))
+            self.mask_bits, self._constr_dev, self._gr(np.int32(slot)),
+            self._mask_ones, self._gr(np.int32(0)))
 
     def _tables_dev(self):
-        return jnp.asarray(self._pt.tables) if self.paged else None
+        if not self.paged:
+            return None
+        return self._g(self._pt.tables,
+                       self._slot_sh2 if self.mesh is not None else None)
 
     def decode(self) -> np.ndarray:
         """One decode step for every slot; returns sampled tokens [B] (only
@@ -931,13 +1102,14 @@ class Engine:
             self._active_dev, self.mask_bits, self._constr_dev,
             self._rln_dev, self._tables_dev())
         self._host_lengths[self.active] += 1
-        return np.asarray(toks)
+        return self._fetch(toks)
 
     def _decode_n_exec(self, n: int, attn_len: int):
         key = (n, attn_len)
         exe = self._decode_execs.get(key)
         if exe is None:
-            budgets = jnp.full((self.n_slots,), n, jnp.int32)
+            budgets = self._g(np.full((self.n_slots,), n, np.int32),
+                              self._slot_sh)
             exe = self._decode_n_fn.lower(
                 self.params, self.k_cache, self.v_cache, self.lengths,
                 self.counts, self.last_tokens, self.pring, self.sp,
@@ -950,15 +1122,23 @@ class Engine:
     def _admit_exec(self, bucket: int):
         exe = self._admit_execs.get(bucket)
         if exe is None:
-            tokens = jnp.zeros((1, bucket), jnp.int32)
-            table_row = (jnp.zeros((self._nblk,), jnp.int32)
-                         if self.paged else None)
+            tokens = self._gr(np.zeros((1, bucket), np.int32))
+            if not self.paged:
+                table_row = None
+            elif self._paged_dp > 1:
+                table_row = self._g(
+                    np.zeros((self._paged_dp, self._nblk), np.int32),
+                    NamedSharding(self.mesh, P("dp", None))
+                    if self.mesh is not None else None)
+            else:
+                table_row = self._gr(np.zeros((self._nblk,), np.int32))
+            zi = lambda v: self._gr(np.int32(v))  # noqa: E731
             exe = self._admit_fn.lower(
                 self.params, self.k_cache, self.v_cache, self.lengths,
                 self.counts, self.last_tokens, self.pring, tokens,
-                jnp.int32(0), jnp.int32(1),
-                self._sp_row(SlotOptions()), jax.random.key(0),
-                self._mask_ones, jnp.int32(0), jnp.int32(1),
+                zi(0), zi(1),
+                self._sp_row(SlotOptions()), self._dummy_key(),
+                self._mask_ones, zi(0), zi(1),
                 table_row).compile()
             self._admit_execs[bucket] = exe
         return exe
@@ -1007,13 +1187,25 @@ class Engine:
         victims.reverse()
         return victims
 
+    def can_admit(self, slot: int, n_tokens: int) -> bool:
+        """Would admitting ``n_tokens`` into ``slot`` find enough pages in
+        its allocation domain (the slot's dp-shard sub-pool)? Admission
+        releases the slot's own parked pages first, so they count as
+        available. Dense mode: always True (the scheduler uses this to
+        steer admissions toward dp shards that still have pages)."""
+        if not self.paged:
+            return True
+        ahead = min(n_tokens + self.ecfg.decode_chunk, self.max_seq)
+        return (self._pt.blocks_for(ahead)
+                <= self._pt.free_for(slot) + self._pt.owned_blocks(slot))
+
     def admissible(self, n_tokens: int) -> bool:
         """Could a prompt of n_tokens EVER be admitted (whole pool free)?
         Dense mode always True — length limits are checked elsewhere."""
         if not self.paged:
             return True
         ahead = min(n_tokens + self.ecfg.decode_chunk, self.max_seq)
-        return self._pt.blocks_for(ahead) <= self._pt.n_pages - 1
+        return self._pt.blocks_for(ahead) <= self._pt.data_pages
 
     def free_slot_pages(self, slot: int):
         """Drop a PARKED (inactive) slot's pages back to the pool — the
@@ -1052,9 +1244,10 @@ class Engine:
             self.params, self.k_cache, self.v_cache, self.lengths,
             self.counts, self.last_tokens, self.pring, self.sp, self.keys,
             self._active_dev, self.mask_bits, self._constr_dev,
-            self._rln_dev, self._tables_dev(), jnp.asarray(budgets))
+            self._rln_dev, self._tables_dev(),
+            self._g(budgets, self._slot_sh))
         self._host_lengths[self.active] += budgets[self.active]
-        return np.asarray(toks_n)
+        return self._fetch(toks_n)
 
     def step_budgets(self, n: int) -> np.ndarray:
         """Per-slot decode-step budget for a chunk of ``n``: constrained
@@ -1069,7 +1262,8 @@ class Engine:
         self.clear_mask(slot)
         self.active[slot] = False
         self._opts.pop(slot, None)
-        self._active_dev = jnp.asarray(self.active.astype(np.int32))
+        self._active_dev = self._g(self.active.astype(np.int32),
+                                   self._slot_sh)
         if park and self.supports_extend:
             # paged: the parked prefix keeps its pages until an admit
             # overwrites the slot or the scheduler evicts via
@@ -1079,14 +1273,14 @@ class Engine:
             self._pt.release(slot)
         self._host_lengths[slot] = 0
         self._repeat_n[slot] = max(1, self.ecfg.repeat_last_n)
-        self._rln_dev = jnp.asarray(self._repeat_n)
+        self._rln_dev = self._g(self._repeat_n, self._slot_sh)
         (self.lengths, self.counts, self.last_tokens,
          self.pring) = self._release_fn(
             self.lengths, self.counts, self.last_tokens, self.pring,
-            jnp.int32(slot))
+            self._gr(np.int32(slot)))
 
     def slot_length(self, slot: int) -> int:
-        return int(np.asarray(self.lengths)[slot])
+        return int(self._fetch(self.lengths)[slot])
 
     @property
     def kv_bytes(self) -> int:

@@ -1,0 +1,221 @@
+"""Multi-host serving control plane: process 0 leads, the rest follow.
+
+A multi-host slice is ONE jax.distributed world (parallel/distributed.py)
+— every process must dispatch the SAME compiled programs in the SAME
+order or the SPMD collectives deadlock. The reference never faces this:
+its replicas are independent single-host servers (SURVEY.md §2.3). Here:
+
+- **process 0** runs the full HTTP server + scheduler. Its engine is
+  wrapped in :class:`MirroredEngine`, which broadcasts every
+  device-dispatching call (admit / extend / decode_n / release / masks /
+  warm) over a TCP control stream BEFORE executing it locally.
+- **processes 1..n-1** run :func:`run_follower`: connect to process 0's
+  control port, then replay the stream — load the same model from their
+  own store (the StatefulSet init container pulled it), build the same
+  Engine, execute the same calls with the same (replicated) arguments.
+  Ordering is the socket's FIFO; synchronisation is the collectives
+  themselves.
+
+All host-side decision state is deterministic by construction: prompt
+buckets, page tables, penalty windows, and PRNG seeds derive from the
+call arguments alone (engine.py avoids per-process `hash()`), so replayed
+calls produce byte-identical device programs and inputs.
+
+The control port is the jax.distributed coordinator's port + 1, rendered
+by the operator as TPU_DIST_CONTROL (operator/pod.py).
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import sys
+import threading
+import time
+from typing import Any, List, Optional
+
+CONTROL_PORT_OFFSET = 1      # coordinator port + 1
+
+
+def log(msg: str) -> None:
+    print(f"follower-cp: {msg}", file=sys.stderr, flush=True)
+
+
+def _send(sock: socket.socket, obj: Any) -> None:
+    data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(struct.pack(">I", len(data)) + data)
+
+
+def _recv(sock: socket.socket) -> Any:
+    hdr = b""
+    while len(hdr) < 4:
+        chunk = sock.recv(4 - len(hdr))
+        if not chunk:
+            raise ConnectionError("control stream closed")
+        hdr += chunk
+    n = struct.unpack(">I", hdr)[0]
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("control stream closed mid-message")
+        buf += chunk
+    return pickle.loads(bytes(buf))
+
+
+class ControlPlane:
+    """Process 0's broadcast channel to the followers."""
+
+    def __init__(self, n_followers: int, port: int, bind: str = "0.0.0.0"):
+        self.n = n_followers
+        self._conns: List[socket.socket] = []
+        self._lock = threading.Lock()
+        self._ready = threading.Event()
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((bind, port))
+        self._srv.listen(n_followers)
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+        log(f"awaiting {n_followers} follower(s) on :{port}")
+
+    def _accept_loop(self):
+        while len(self._conns) < self.n:
+            conn, addr = self._srv.accept()
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._lock:
+                self._conns.append(conn)
+            log(f"follower connected from {addr} "
+                f"({len(self._conns)}/{self.n})")
+        self._ready.set()
+
+    def broadcast(self, msg: tuple) -> None:
+        """FIFO broadcast; blocks until the full follower set has joined
+        (a call dispatched before the world is complete would desync)."""
+        self._ready.wait()
+        with self._lock:
+            for c in self._conns:
+                _send(c, msg)
+
+    def close(self):
+        with self._lock:
+            for c in self._conns:
+                try:
+                    c.close()
+                except OSError:
+                    pass
+            self._conns.clear()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+
+class MirroredEngine:
+    """Engine proxy for process 0: broadcast-then-execute for every call
+    that dispatches a device program or mutates replay-relevant host
+    state (page tables). Everything else delegates transparently."""
+
+    MIRRORED = ("admit", "extend", "decode", "decode_n", "release",
+                "set_mask", "clear_mask", "warm_buckets",
+                "free_slot_pages", "prepare_decode")
+
+    def __init__(self, inner, cp: ControlPlane):
+        object.__setattr__(self, "_inner", inner)
+        object.__setattr__(self, "_cp", cp)
+
+    def __getattr__(self, name):
+        value = getattr(self._inner, name)
+        if name in self.MIRRORED:
+            cp = self._cp
+
+            def mirrored(*a, __value=value, __name=name, **kw):
+                cp.broadcast(("call", __name, a, kw))
+                return __value(*a, **kw)
+            return mirrored
+        return value
+
+
+def control_address(env=None) -> Optional[tuple]:
+    """(host, port) of the control stream, from the operator env:
+    TPU_DIST_CONTROL if present, else coordinator host at port+1."""
+    import os
+    e = env if env is not None else os.environ
+    ctl = e.get("TPU_DIST_CONTROL")
+    if ctl:
+        host, _, port = ctl.rpartition(":")
+        return host, int(port)
+    coord = e.get("TPU_DIST_COORDINATOR")
+    if not coord:
+        return None
+    host, _, port = coord.rpartition(":")
+    return host, int(port) + CONTROL_PORT_OFFSET
+
+
+def run_follower(manager, host: str, port: int,
+                 health_port: Optional[int] = None) -> None:
+    """Replay the leader's stream forever (process_index > 0).
+
+    ``manager`` is a follower-mode ModelManager (server/app.py): load()
+    builds a bare Engine — no scheduler, no HTTP app — against the same
+    store this pod's init container populated."""
+    if health_port:
+        _serve_health(health_port)
+    sock = None
+    for attempt in range(240):       # leader may still be compiling
+        try:
+            sock = socket.create_connection((host, port), timeout=10)
+            break
+        except OSError:
+            time.sleep(2.0)
+    if sock is None:
+        raise ConnectionError(f"leader control port {host}:{port} "
+                              f"unreachable")
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    log(f"joined control stream {host}:{port}")
+    engine = None
+    while True:
+        msg = _recv(sock)
+        op = msg[0]
+        if op == "load":
+            lm = manager.load(msg[1])
+            engine = lm.engine
+            log(f"loaded {msg[1]}")
+        elif op == "unload":
+            manager.unload_now()
+            engine = None
+        elif op == "call":
+            _, method, a, kw = msg
+            try:
+                getattr(engine, method)(*a, **kw)
+            except Exception as e:   # noqa: BLE001
+                # deterministic failures (PagesExhausted, too-long prompt)
+                # happen on the leader too, BEFORE any device dispatch —
+                # replaying them (incl. their page-table side effects)
+                # keeps host state in lockstep; anything else will show
+                # up here loudly and then desync visibly
+                log(f"replayed {method} raised {type(e).__name__}: {e}")
+        elif op == "shutdown":
+            log("leader shut down")
+            return
+        else:
+            raise ValueError(f"unknown control op {op!r}")
+
+
+def _serve_health(port: int) -> None:
+    """Minimal /healthz endpoint so the follower pod's readinessProbe
+    (same template as the leader's) reports Ready."""
+    import http.server
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain")
+            self.end_headers()
+            self.wfile.write(b"ok")
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.ThreadingHTTPServer(("0.0.0.0", port), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()

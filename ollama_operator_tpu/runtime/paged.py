@@ -82,3 +82,75 @@ class PageTable:
 
     def owned_blocks(self, slot: int) -> int:
         return len(self._owned[slot])
+
+    def free_for(self, slot: int) -> int:
+        """Pages available to ``slot`` (its allocation domain's free count
+        — the whole pool here; a dp shard's pool in ShardedPageTable)."""
+        return len(self._free)
+
+    @property
+    def data_pages(self) -> int:
+        """Max pages one slot could ever hold (pool minus the trash page)."""
+        return self.n_pages - 1
+
+
+class ShardedPageTable:
+    """dp-sharded page accounting: one independent PageTable per dp shard.
+
+    The device pool's PAGE axis is sharded over ``dp``
+    (engine.py: ``P(None, "dp", ...)``), so inside the dp-manual
+    shard_map each device sees only its local ``pages_per_shard + 1``
+    pages — table entries are therefore LOCAL page indices, and each
+    shard's local page 0 is its own trash page. Slot ``s`` lives on shard
+    ``s // (n_slots // dp)`` (the contiguous-block layout GSPMD gives a
+    batch axis), and allocates only from that shard's free list: page
+    locality is a placement invariant, not a runtime check."""
+
+    def __init__(self, n_slots: int, dp: int, pages_per_shard: int,
+                 page_size: int, max_blocks: int):
+        assert n_slots % dp == 0
+        self.dp = dp
+        self.page_size = page_size
+        self.n_pages = pages_per_shard + 1   # per-shard incl. trash
+        self.max_blocks = max_blocks
+        self._slots_per = n_slots // dp
+        self._pts = [PageTable(self._slots_per, pages_per_shard + 1,
+                               page_size, max_blocks) for _ in range(dp)]
+
+    def _loc(self, slot: int):
+        return self._pts[slot // self._slots_per], slot % self._slots_per
+
+    @property
+    def tables(self):
+        import numpy as np
+        return np.concatenate([pt.tables for pt in self._pts], axis=0)
+
+    @property
+    def n_free(self) -> int:
+        return sum(pt.n_free for pt in self._pts)
+
+    def blocks_for(self, n_tokens: int) -> int:
+        return -(-n_tokens // self.page_size)
+
+    def grow(self, slot: int, n_tokens: int) -> bool:
+        pt, ls = self._loc(slot)
+        return pt.grow(ls, n_tokens)
+
+    def release(self, slot: int):
+        pt, ls = self._loc(slot)
+        pt.release(ls)
+
+    def owned_blocks(self, slot: int) -> int:
+        pt, ls = self._loc(slot)
+        return pt.owned_blocks(ls)
+
+    def free_for(self, slot: int) -> int:
+        pt, _ = self._loc(slot)
+        return pt.n_free
+
+    @property
+    def data_pages(self) -> int:
+        return self.n_pages - 1
+
+    def shard_of(self, slot: int) -> int:
+        return slot // self._slots_per

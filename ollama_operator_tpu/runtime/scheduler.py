@@ -283,10 +283,24 @@ class Scheduler:
                 slot = reuse_slot
                 free.remove(slot)
             else:
-                # prefer slots without a parked prefix: keep reusable
-                # caches alive as long as slots allow
-                slot = next((s for s in free if s not in self._parked),
-                            free[0])
+                # prefer slots that (a) sit on a dp shard whose sub-pool
+                # can actually hold this prompt (paged×dp: shard-blind
+                # picks would raise PagesExhausted and thrash evictions
+                # while another shard idles) and (b) have no parked
+                # prefix, keeping reusable caches alive as slots allow
+                n_tok = len(req.admit_ids)
+
+                def _pick():
+                    for cond in (
+                            lambda s: s not in self._parked
+                            and self.engine.can_admit(s, n_tok),
+                            lambda s: self.engine.can_admit(s, n_tok),
+                            lambda s: s not in self._parked):
+                        for s in free:
+                            if cond(s):
+                                return s
+                    return free[0]
+                slot = _pick()
                 free.remove(slot)
             # the slot's parked cache is spoken for either way: on success
             # the request owns it; on failure the slot state is unknown and

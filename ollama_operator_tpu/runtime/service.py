@@ -150,7 +150,8 @@ class LoadedModel:
                  system: Optional[str] = None,
                  default_params: Optional[Dict] = None,
                  mesh=None, ecfg: Optional[EngineConfig] = None,
-                 digest: str = "", vision: Optional[Tuple] = None):
+                 digest: str = "", vision: Optional[Tuple] = None,
+                 control_plane=None, follower: bool = False):
         self.name = name
         self.cfg = cfg
         # (VisionConfig, vision params) for multimodal models (llava) —
@@ -164,14 +165,26 @@ class LoadedModel:
         self.default_params = default_params or {}
         self.loaded_at = time.time()
         self.ecfg = ecfg or EngineConfig()
+        self.control_plane = control_plane
+        self.follower = follower
         self.engine = Engine(cfg, params, mesh=mesh, ecfg=self.ecfg)
+        if control_plane is not None:
+            # multi-host leader: every device-dispatching engine call is
+            # broadcast to the follower processes BEFORE running locally,
+            # so the whole slice executes identical SPMD programs
+            # (runtime/follower.py)
+            from .follower import MirroredEngine
+            self.engine = MirroredEngine(self.engine, control_plane)
         # AOT-compile every attention-bucket decode program up front —
         # serving must never pay an XLA compile at a bucket crossing (the
-        # persistent compilation cache makes this near-free on restarts)
+        # persistent compilation cache makes this near-free on restarts).
+        # Followers warm via the leader's replayed warm_buckets call.
         import os as _os
-        if _os.environ.get("TPU_WARM_BUCKETS", "1") != "0":
+        if not follower and _os.environ.get("TPU_WARM_BUCKETS", "1") != "0":
             self.engine.warm_buckets()
-        self.scheduler = Scheduler(self.engine)
+        # followers replay engine calls from the control stream — they
+        # never schedule on their own
+        self.scheduler = None if follower else Scheduler(self.engine)
         self._embed_fn = None
         self._embed_lock = threading.Lock()
         # canonical schema JSON → compiled machine, LRU-evicted one at a
@@ -182,9 +195,11 @@ class LoadedModel:
         wself = weakref.ref(self)
         METRICS.gauge_fn("tpu_model_active_slots",
                          lambda: (lm := wself()) is not None
+                         and lm.scheduler is not None
                          and lm.scheduler.n_active or 0)
         METRICS.gauge_fn("tpu_model_queue_depth",
                          lambda: (lm := wself()) is not None
+                         and lm.scheduler is not None
                          and lm.scheduler._waiting.qsize() or 0)
         if self.engine.paged:
             # paged-pool pressure signal for autoscaling/alerting (the
@@ -200,6 +215,10 @@ class LoadedModel:
     # ------------------------------------------------------------------
     def encode_images(self, images_u8) -> "np.ndarray":
         """List of uint8 [H, W, 3] arrays → [n_img, n_patches, D]."""
+        if self.control_plane is not None:
+            raise RuntimeError(
+                "multimodal requests are not supported on multi-host "
+                "slices yet (the vision tower jit is leader-only)")
         from ..models import vision as V
         import jax
         vcfg, vparams = self.vision
@@ -453,6 +472,12 @@ class LoadedModel:
         """Mean-pooled final hidden states (ollama /api/embeddings)."""
         from ..models import decoder as D
 
+        if self.control_plane is not None:
+            # a leader-only jit would dispatch a program the followers
+            # never see and deadlock the slice mid-collective — refuse
+            # loudly until the embed path is mirrored
+            raise RuntimeError(
+                "embeddings are not supported on multi-host slices yet")
         with self._embed_lock:
             if self._embed_fn is None:
                 cfg = self.cfg
@@ -520,7 +545,19 @@ class LoadedModel:
         return np.stack(outs)
 
     def unload(self):
-        self.scheduler.shutdown()
+        if self.scheduler is not None:
+            self.scheduler.shutdown()   # may still mirror engine calls
+            if self.control_plane is not None:
+                # the ("unload",) broadcast must be FIFO-AFTER the loop's
+                # last mirrored call: shutdown()'s bounded join can time
+                # out mid-compile, and a call broadcast after unload
+                # would hit followers with no engine while the leader
+                # enters the collective alone
+                t = getattr(self.scheduler, "_thread", None)
+                if t is not None and t.is_alive():
+                    t.join()
+        if self.control_plane is not None:
+            self.control_plane.broadcast(("unload",))
         METRICS.remove_gauge("tpu_model_active_slots")
         METRICS.remove_gauge("tpu_model_queue_depth")
         if self.engine.paged:

@@ -63,6 +63,12 @@ def main(argv=None):
                    default=int(os.environ.get("TPU_EXPERT_PARALLEL", "1")),
                    help="expert-parallel ways (MoE experts sharded over "
                         "the ep mesh axis; >1 only helps MoE archs)")
+    p.add_argument("--dp", type=int,
+                   default=int(os.environ.get("TPU_DATA_PARALLEL", "0")),
+                   help="in-engine data-parallel ways: slots (and the "
+                        "paged page pool) shard over dp (0 = derive from "
+                        "devices left over after tp/sp/ep; note replicas "
+                        "in the CRD fan out dp across PODS instead)")
     p.add_argument("--paged", action="store_true",
                    default=os.environ.get("TPU_PAGED", "") == "1",
                    help="paged KV cache: slots share a physical page pool "
@@ -83,6 +89,7 @@ def main(argv=None):
     from .app import ModelManager, serve
 
     mesh = None
+    joined = False
     if not args.store_only:
         import jax
         # honor an explicit JAX_PLATFORMS (e.g. cpu for kind/e2e pods) even
@@ -92,7 +99,7 @@ def main(argv=None):
         # multi-host slice? join the jax.distributed world BEFORE touching
         # the backend (operator-rendered env; no-op single-host)
         from ..parallel.distributed import maybe_initialize
-        maybe_initialize()
+        joined = maybe_initialize()
         if args.cache:
             # persistent XLA compilation cache beside the weight cache: pod
             # restarts skip the multi-program warm-up compiles
@@ -113,16 +120,29 @@ def main(argv=None):
                     f"{jax.default_backend()!r} (devices: {devices})")
         sp = max(1, args.sp)
         ep = max(1, args.ep)
-        tp = args.tp or len(devices) // (sp * ep)
-        if tp < 1 or len(devices) % (tp * sp * ep) != 0:
-            p.error(f"parallelism plan tp={args.tp or 'auto'} sp={sp} "
-                    f"ep={ep} does not fit {len(devices)} devices")
-        if tp * sp * ep > 1:
+        dp = max(0, args.dp)
+        if dp:
+            tp = args.tp or max(1, len(devices) // (sp * ep * dp))
             from ..parallel import MeshPlan, make_mesh
-            mesh = make_mesh(MeshPlan.for_devices(len(devices), tp=tp,
-                                                  sp=sp, ep=ep))
+            plan = MeshPlan(dp=dp, sp=sp, tp=tp, ep=ep)
+            if plan.n_devices > len(devices):
+                p.error(f"plan {plan} needs {plan.n_devices} devices; "
+                        f"have {len(devices)}")
+            mesh = make_mesh(plan, devices[: plan.n_devices])
+        else:
+            tp = args.tp or len(devices) // (sp * ep)
+            if tp < 1 or len(devices) % (tp * sp * ep) != 0:
+                p.error(f"parallelism plan tp={args.tp or 'auto'} sp={sp} "
+                        f"ep={ep} does not fit {len(devices)} devices")
+            if tp * sp * ep > 1:
+                from ..parallel import MeshPlan, make_mesh
+                plan = MeshPlan.for_devices(len(devices), tp=tp, sp=sp,
+                                            ep=ep)
+                mesh = make_mesh(plan)
+                dp = plan.dp
         print(f"devices: {devices}, tensor-parallel: {tp}, "
-              f"sequence-parallel: {sp}, expert-parallel: {ep}",
+              f"sequence-parallel: {sp}, expert-parallel: {ep}, "
+              f"data-parallel: {dp or 1}",
               file=sys.stderr)
 
     from ..runtime.engine import resolve_cache_dtype
@@ -141,9 +161,33 @@ def main(argv=None):
                         paged=args.paged, page_size=args.page_size,
                         n_pages=args.n_pages or None)
     engine_dtype = {"bf16": "bfloat16"}.get(args.dtype, args.dtype)
+
+    # multi-host slice roles (runtime/follower.py): process 0 serves HTTP
+    # and broadcasts every engine call; the rest replay the stream so the
+    # whole jax.distributed world executes identical SPMD programs
+    control_plane = None
+    if not args.store_only and joined:
+        import jax as _jax
+
+        from ..runtime.follower import (ControlPlane, control_address,
+                                        run_follower)
+        chost, cport = control_address()
+        if _jax.process_index() == 0:
+            control_plane = ControlPlane(_jax.process_count() - 1, cport)
+        else:
+            manager = ModelManager(args.store, cache_dir=args.cache,
+                                   mesh=mesh, ecfg=ecfg,
+                                   engine_dtype=engine_dtype,
+                                   follower=True)
+            print(f"follower {_jax.process_index()}: replaying "
+                  f"{chost}:{cport}", file=sys.stderr)
+            run_follower(manager, chost, cport, health_port=args.port)
+            return
+
     manager = ModelManager(args.store, cache_dir=args.cache, mesh=mesh,
                            ecfg=ecfg, engine_dtype=engine_dtype,
-                           serve_models=not args.store_only)
+                           serve_models=not args.store_only,
+                           control_plane=control_plane)
     if args.preload and not args.store_only:
         print(f"preloading {args.preload}...", file=sys.stderr)
         manager.load(args.preload)

@@ -133,7 +133,8 @@ class ModelManager:
     def __init__(self, store_root: str, cache_dir: Optional[str] = None,
                  mesh=None, ecfg: Optional[EngineConfig] = None,
                  engine_dtype="bfloat16", serve_models: bool = True,
-                 default_keep_alive=None):
+                 default_keep_alive=None, control_plane=None,
+                 follower: bool = False):
         self.store = ModelStore(store_root)
         self.client = RegistryClient(self.store)
         self.mesh = mesh
@@ -141,6 +142,11 @@ class ModelManager:
         self.cache_dir = cache_dir
         self.engine_dtype = engine_dtype
         self.serve_models = serve_models  # store-only mode serves pulls only
+        # multi-host slice roles (runtime/follower.py): the leader's
+        # control plane broadcasts load/unload + engine calls; a follower
+        # manager builds bare engines (no scheduler/HTTP) and replays
+        self.control_plane = control_plane
+        self.follower = follower
         self.loaded: Optional[LoadedModel] = None
         self._lock = threading.Lock()
         self.start_time = time.time()
@@ -160,7 +166,9 @@ class ModelManager:
         self.expires_at: Optional[float] = None
         self._last_ka: Optional[float] = self.default_keep_alive
         self._reaper_stop = threading.Event()
-        if serve_models:
+        # followers unload on the leader's ("unload",) broadcast, never on
+        # their own clock
+        if serve_models and not follower:
             self._reaper = threading.Thread(
                 target=self._reap_idle, daemon=True, name="keepalive-reaper")
             self._reaper.start()
@@ -223,6 +231,14 @@ class ModelManager:
             self.expires_at = None
         lm.unload()
         return True
+
+    def unload_now(self):
+        """Immediate unload (follower replay of the leader's unload)."""
+        with self._lock:
+            lm, self.loaded = self.loaded, None
+            self.expires_at = None
+        if lm is not None:
+            lm.unload()
 
     def shutdown(self):
         self._reaper_stop.set()
@@ -352,10 +368,16 @@ class ModelManager:
             ecfg = self.ecfg or EngineConfig(
                 max_seq_len=min(cfg.max_seq_len,
                                 int(default_params.get("num_ctx", 4096))))
+            if self.control_plane is not None:
+                # followers pull the same layers from their own store and
+                # replay this load; their first mirrored engine call
+                # queues behind it on the FIFO control stream
+                self.control_plane.broadcast(("load", ref))
             self.loaded = LoadedModel(
                 name.short, cfg, params, tokenizer, template=template,
                 system=system, default_params=default_params,
-                mesh=self.mesh, ecfg=ecfg, digest=digest, vision=vision)
+                mesh=self.mesh, ecfg=ecfg, digest=digest, vision=vision,
+                control_plane=self.control_plane, follower=self.follower)
             # fresh deadline under this same lock: a stale expiry from the
             # previous model must never reap the one we just installed
             self._last_ka = self.default_keep_alive

