@@ -253,3 +253,466 @@ void json_fill_mask(const uint8_t* state, int32_t state_len,
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Schema skeleton machine (ops/schema.py) — native NFA mask fill.
+//
+// The compiled schema node tree is serialized by Python into flat arrays
+// (one int64[6] record per node + an extra int64 pool + a byte blob); the
+// current NFA state (a set of frame stacks) rides in a packed byte buffer.
+// schema_fill_mask simulates every vocab token's bytes through a faithful
+// port of ops/schema._advance_stack — including seq descent, alt expansion,
+// enum splits, array redispatch, leaf lazy closes, and the bounded-integer
+// digit DFA — and sets one bit per schema-legal token. Returns 0 on
+// success, -1 when a structural cap is hit (Python then falls back to its
+// reference implementation; parity is asserted by tests/test_schema.py).
+// ---------------------------------------------------------------------------
+
+namespace schema {
+
+enum NodeType : int64_t {
+  N_LIT = 0, N_LEAF = 1, N_SEQ = 2, N_ENUM = 3, N_ARR = 4, N_ALT = 5,
+  N_IRANGE = 6,
+};
+
+enum LeafKind : int64_t {
+  K_STRING = 0, K_NUMBER = 1, K_INTEGER = 2, K_BOOLEAN = 3, K_NULL = 4,
+  K_ANY = 5,
+};
+
+constexpr int kMaxFrames = 96;
+constexpr int kMaxStacks = 64;
+constexpr int kMaxPda = 160;
+
+struct Program {
+  const int64_t* nodes;  // [n, 6]: type, a, b, c, d, e
+  int32_t n_nodes;
+  const int64_t* extra;
+  const uint8_t* blob;
+  int64_t type(int32_t i) const { return nodes[i * 6]; }
+  int64_t a(int32_t i) const { return nodes[i * 6 + 1]; }
+  int64_t b(int32_t i) const { return nodes[i * 6 + 2]; }
+  int64_t c(int32_t i) const { return nodes[i * 6 + 3]; }
+  int64_t d(int32_t i) const { return nodes[i * 6 + 4]; }
+};
+
+struct Frame {
+  int32_t node;
+  uint8_t tag;  // 0 pos, 1 leaf, 2 enum, 3 irange
+  int32_t pos;
+  // leaf (PDA) — only ever on the TOP frame
+  uint8_t mode, aux1, aux2, key;
+  int32_t depth;
+  // enum
+  uint64_t viable;
+  // irange
+  int8_t sign;
+  int64_t v;
+  int32_t k;
+};
+
+struct Stack {
+  Frame frames[kMaxFrames];
+  int32_t n;
+  uint8_t pda[kMaxPda];  // leaf container stack (top frame only)
+
+  Frame& top() { return frames[n - 1]; }
+};
+
+inline bool frame_pos(Stack& st, int32_t node, int32_t pos) {
+  if (st.n >= kMaxFrames) return false;
+  Frame f{}; f.node = node; f.tag = 0; f.pos = pos;
+  st.frames[st.n++] = f;
+  return true;
+}
+
+// init_sub for a consumer node pushed on top; false = overflow
+bool push_consumer(const Program& p, Stack& st, int32_t node) {
+  if (st.n >= kMaxFrames) return false;
+  Frame f{};
+  f.node = node;
+  switch (p.type(node)) {
+    case N_LIT: f.tag = 0; f.pos = 0; break;
+    case N_LEAF:
+      f.tag = 1; f.mode = M_VALUE; f.aux1 = f.aux2 = f.key = 0;
+      f.depth = 0;
+      break;
+    case N_ENUM: {
+      f.tag = 2; f.pos = 0;
+      int64_t nalts = p.b(node);
+      f.viable = (nalts >= 64) ? ~0ull : ((1ull << nalts) - 1);
+      break;
+    }
+    case N_ARR: f.tag = 0; f.pos = 0; break;
+    case N_IRANGE: f.tag = 3; f.sign = 0; f.v = 0; f.k = 0; break;
+    default: return false;  // SEQ/ALT never sit on a stack top directly
+  }
+  st.frames[st.n++] = f;
+  return true;
+}
+
+// _push_multi: push `node` onto a copy of st for each alternative path.
+// Appends results to out; false on overflow (caller bails).
+bool push_multi(const Program& p, const Stack& st, int32_t node,
+                std::vector<Stack>& out) {
+  struct Item { Stack st; int32_t node; };
+  std::vector<Item> work;
+  work.push_back({st, node});
+  while (!work.empty()) {
+    Item it = work.back();
+    work.pop_back();
+    int64_t t = p.type(it.node);
+    if (t == N_SEQ) {
+      if (!frame_pos(it.st, it.node, 0)) return false;
+      int32_t child = (int32_t)p.extra[p.a(it.node)];
+      work.push_back({it.st, child});
+    } else if (t == N_ALT) {
+      int64_t off = p.a(it.node), n = p.b(it.node);
+      for (int64_t i = 0; i < n; i++)
+        work.push_back({it.st, (int32_t)p.extra[off + i]});
+    } else {
+      if (!push_consumer(p, it.st, it.node)) return false;
+      if (out.size() >= kMaxStacks) return false;
+      out.push_back(it.st);
+    }
+  }
+  return true;
+}
+
+// _completed_child: top frame popped; advance ancestors, push next
+// consumer(s). Appends all results to out; false on overflow.
+bool completed_child(const Program& p, Stack st, std::vector<Stack>& out) {
+  while (st.n > 0) {
+    Frame& f = st.top();
+    int64_t t = p.type(f.node);
+    if (t == N_SEQ) {
+      int32_t nxt = f.pos + 1;
+      if (nxt == (int32_t)p.b(f.node)) { st.n--; continue; }
+      f.pos = nxt;
+      int32_t child = (int32_t)p.extra[p.a(f.node) + nxt];
+      return push_multi(p, st, child, out);
+    }
+    if (t == N_ARR) {
+      f.pos = 3;
+      if (out.size() >= kMaxStacks) return false;
+      out.push_back(st);
+      return true;
+    }
+    return false;  // malformed
+  }
+  if (out.size() >= kMaxStacks) return false;
+  out.push_back(st);  // empty stack = schema complete (EOS only)
+  return true;
+}
+
+inline bool irange_fits(bool has_lo, int64_t lo, bool has_hi, int64_t hi,
+                        int8_t sign, __int128 a, __int128 b2) {
+  __int128 vlo = sign >= 0 ? a : -b2;
+  __int128 vhi = sign >= 0 ? b2 : -a;
+  return (!has_hi || vlo <= (__int128)hi) && (!has_lo || vhi >= (__int128)lo);
+}
+
+bool irange_viable(bool has_lo, int64_t lo, bool has_hi, int64_t hi,
+                   int8_t sign, int64_t v, int32_t k) {
+  if (irange_fits(has_lo, lo, has_hi, hi, sign, v, v)) return true;
+  if (v == 0) return false;  // leading zero: no extensions
+  int32_t limit;
+  if (sign >= 0) {
+    if (!has_hi) return true;
+    if (hi <= 0) return false;
+    limit = 0; for (int64_t x = hi; x > 0; x /= 10) limit++;
+  } else {
+    if (!has_lo) return true;
+    if (lo >= 0) return false;
+    limit = 0; for (int64_t x = -lo; x > 0; x /= 10) limit++;
+  }
+  __int128 scale = 1;
+  for (int32_t m = k + 1; m <= limit; m++) {
+    scale *= 10;
+    if (irange_fits(has_lo, lo, has_hi, hi, sign, (__int128)v * scale,
+                    (__int128)v * scale + scale - 1))
+      return true;
+  }
+  return false;
+}
+
+inline bool irange_done(const Program& p, const Frame& f) {
+  if (f.k == 0) return false;
+  bool has_lo = p.a(f.node) != 0, has_hi = p.c(f.node) != 0;
+  int64_t lo = p.b(f.node), hi = p.d(f.node);
+  int64_t val = f.sign >= 0 ? f.v : -f.v;
+  return (!has_lo || val >= lo) && (!has_hi || val <= hi);
+}
+
+inline bool leaf_start_ok(int64_t kind, uint8_t b) {
+  switch (kind) {
+    case K_STRING:  return b == '"';
+    case K_NUMBER: case K_INTEGER:
+      return b == '-' || (b >= '0' && b <= '9');
+    case K_BOOLEAN: return b == 't' || b == 'f';
+    case K_NULL:    return b == 'n';
+    default:        return true;  // any
+  }
+}
+
+// one byte through one stack; appends successors to out. false = bail.
+bool advance_stack(const Program& p, const Stack& st0, uint8_t b,
+                   std::vector<Stack>& out, int rec = 0) {
+  if (rec > 8) return false;
+  if (st0.n == 0) return true;  // complete: EOS only — rejects b
+  Stack st = st0;
+  Frame& f = st.top();
+  switch (p.type(f.node)) {
+    case N_LIT: {
+      int64_t off = p.a(f.node), len = p.b(f.node);
+      if (p.blob[off + f.pos] != b) return true;
+      if (++f.pos == (int32_t)len) {
+        st.n--;
+        return completed_child(p, st, out);
+      }
+      if (out.size() >= kMaxStacks) return false;
+      out.push_back(st);
+      return true;
+    }
+    case N_LEAF: {
+      int64_t kind = p.a(f.node);
+      bool fresh = f.mode == M_VALUE && f.depth == 0;
+      bool allowed = !fresh || leaf_start_ok(kind, b);
+      if (allowed && kind == K_INTEGER &&
+          (b == '.' || b == 'e' || b == 'E'))
+        allowed = false;
+      State s;
+      s.mode = f.mode; s.aux1 = f.aux1; s.aux2 = f.aux2; s.key = f.key;
+      s.stack = st.pda; s.depth = f.depth;
+      bool adv = allowed && f.depth < kMaxPda - 2 && advance(s, b);
+      if (adv) {
+        if (s.mode == M_AFTER && s.depth == 0) {
+          st.n--;
+          return completed_child(p, st, out);
+        }
+        f.mode = s.mode; f.aux1 = s.aux1; f.aux2 = s.aux2; f.key = s.key;
+        f.depth = s.depth;
+        if (out.size() >= kMaxStacks) return false;
+        out.push_back(st);
+        return true;
+      }
+      if (allowed && f.depth >= kMaxPda - 2) return false;  // cap: bail
+      // lazy close (numbers complete at depth 0)
+      if (f.depth == 0 &&
+          (f.mode == M_AFTER || (f.mode == M_NUM && ns_terminal(f.aux1)))) {
+        Stack popped = st0;
+        popped.n--;
+        std::vector<Stack> closed;
+        if (!completed_child(p, popped, closed)) return false;
+        for (auto& cs : closed)
+          if (!advance_stack(p, cs, b, out, rec + 1)) return false;
+        return true;
+      }
+      return true;
+    }
+    case N_ENUM: {
+      int64_t off = p.a(f.node), nalts = p.b(f.node);
+      uint64_t nv = 0;
+      bool any_fin = false;
+      for (int64_t i = 0; i < nalts; i++) {
+        if (!(f.viable >> i & 1)) continue;
+        int64_t aoff = p.extra[off + 2 * i], alen = p.extra[off + 2 * i + 1];
+        if (f.pos < alen && p.blob[aoff + f.pos] == b) {
+          if (f.pos + 1 == alen) any_fin = true;
+          else nv |= 1ull << i;
+        }
+      }
+      if (!nv && !any_fin) return true;
+      if (nv) {
+        Stack cont = st;
+        cont.top().pos = f.pos + 1;
+        cont.top().viable = nv;
+        if (out.size() >= kMaxStacks) return false;
+        out.push_back(cont);
+      }
+      if (any_fin) {
+        Stack done = st;
+        done.n--;
+        if (!completed_child(p, done, out)) return false;
+      }
+      return true;
+    }
+    case N_ARR: {
+      if (f.pos == 0) {
+        if (b != '[') return true;
+        f.pos = 1;
+        if (out.size() >= kMaxStacks) return false;
+        out.push_back(st);
+        return true;
+      }
+      if (f.pos == 1) {  // first item or ']'
+        if (b == ']' && p.b(f.node) == 0) {
+          st.n--;
+          return completed_child(p, st, out);
+        }
+        f.pos = 2;
+        std::vector<Stack> pushed;
+        if (!push_multi(p, st, (int32_t)p.a(f.node), pushed)) return false;
+        for (auto& ps : pushed)
+          if (!advance_stack(p, ps, b, out, rec + 1)) return false;
+        return true;
+      }
+      if (f.pos == 3) {  // after an item
+        if (b == ']') {
+          st.n--;
+          return completed_child(p, st, out);
+        }
+        if (b == ',') {
+          f.pos = 2;
+          return push_multi(p, st, (int32_t)p.a(f.node), out);
+        }
+        return true;
+      }
+      return true;
+    }
+    case N_IRANGE: {
+      bool has_lo = p.a(f.node) != 0, has_hi = p.c(f.node) != 0;
+      int64_t lo = p.b(f.node), hi = p.d(f.node);
+      if (b >= '0' && b <= '9') {
+        int64_t d = b - '0';
+        int64_t nv; int32_t nk;
+        if (f.k == 0) { nv = d; nk = 1; }
+        else if (f.v == 0) return true;  // leading zero can't extend
+        else if (f.v > (int64_t)1e17) {
+          // unbounded-side growth: saturate (Python serialization refuses
+          // finite bounds beyond 1e15, so the saturated magnitude is
+          // already past every bound and comparisons stay exact)
+          nv = (int64_t)1e17 + 9; nk = f.k + 1;
+        } else {
+          nv = f.v * 10 + d; nk = f.k + 1;
+        }
+        int8_t s_eff = f.sign != 0 ? f.sign : 1;
+        if (!irange_viable(has_lo, lo, has_hi, hi, s_eff, nv, nk))
+          return true;
+        f.sign = s_eff; f.v = nv; f.k = nk;
+        if (out.size() >= kMaxStacks) return false;
+        out.push_back(st);
+        return true;
+      }
+      if (b == '-' && f.sign == 0 && f.k == 0) {
+        for (int64_t d = 0; d <= 9; d++) {
+          if (irange_viable(has_lo, lo, has_hi, hi, -1, d, 1)) {
+            f.sign = -1; f.v = 0; f.k = 0;
+            if (out.size() >= kMaxStacks) return false;
+            out.push_back(st);
+            return true;
+          }
+        }
+        return true;
+      }
+      if (irange_done(p, f)) {  // delimiter closes the integer
+        Stack popped = st0;
+        popped.n--;
+        std::vector<Stack> closed;
+        if (!completed_child(p, popped, closed)) return false;
+        for (auto& cs : closed)
+          if (!advance_stack(p, cs, b, out, rec + 1)) return false;
+        return true;
+      }
+      return true;
+    }
+    default:
+      return false;
+  }
+}
+
+}  // namespace schema
+
+extern "C" {
+
+// Returns 0 on success (mask_out filled), -1 on a structural cap (caller
+// falls back to the Python reference fill).
+int32_t schema_fill_mask(const int64_t* nodes, int32_t n_nodes,
+                         const int64_t* extra, const uint8_t* blob,
+                         const uint8_t* state_buf, int64_t state_len,
+                         const uint8_t* tok_bytes, const int64_t* tok_off,
+                         int32_t n_tokens, uint32_t* mask_out) {
+  using schema::Stack;
+  using schema::Frame;
+  schema::Program p{nodes, n_nodes, extra, blob};
+
+  // ---- decode the packed NFA state --------------------------------------
+  const uint8_t* q = state_buf;
+  const uint8_t* end = state_buf + state_len;
+  auto rd_u32 = [&](uint32_t& v) {
+    if (q + 4 > end) return false;
+    std::memcpy(&v, q, 4); q += 4; return true;
+  };
+  auto rd_i64 = [&](int64_t& v) {
+    if (q + 8 > end) return false;
+    std::memcpy(&v, q, 8); q += 8; return true;
+  };
+  uint32_t n_stacks;
+  if (!rd_u32(n_stacks) || n_stacks == 0 || n_stacks > schema::kMaxStacks)
+    return -1;
+  std::vector<Stack> init(n_stacks);
+  for (uint32_t si = 0; si < n_stacks; si++) {
+    Stack& st = init[si];
+    st.n = 0;
+    uint32_t n_frames;
+    if (!rd_u32(n_frames) || n_frames > schema::kMaxFrames) return -1;
+    for (uint32_t fi = 0; fi < n_frames; fi++) {
+      if (q + 5 > end) return -1;
+      Frame f{};
+      uint32_t node;
+      std::memcpy(&node, q, 4); q += 4;
+      f.node = (int32_t)node;
+      f.tag = *q++;
+      if (f.tag == 0) {
+        uint32_t pos; if (!rd_u32(pos)) return -1;
+        f.pos = (int32_t)pos;
+      } else if (f.tag == 1) {
+        uint32_t plen; if (!rd_u32(plen)) return -1;
+        if (plen < 4 || q + plen > end) return -1;
+        f.mode = q[0]; f.aux1 = q[1]; f.aux2 = q[2]; f.key = q[3];
+        f.depth = (int32_t)plen - 4;
+        if (f.depth > schema::kMaxPda - 64) return -1;  // headroom for token
+        std::memcpy(st.pda, q + 4, f.depth);
+        q += plen;
+      } else if (f.tag == 2) {
+        uint32_t pos; if (!rd_u32(pos)) return -1;
+        f.pos = (int32_t)pos;
+        if (q + 8 > end) return -1;
+        std::memcpy(&f.viable, q, 8); q += 8;
+      } else if (f.tag == 3) {
+        if (q + 1 > end) return -1;
+        f.sign = (int8_t)*q++;
+        int64_t v; if (!rd_i64(v)) return -1;
+        f.v = v;
+        uint32_t k; if (!rd_u32(k)) return -1;
+        f.k = (int32_t)k;
+      } else {
+        return -1;
+      }
+      if (st.n >= schema::kMaxFrames) return -1;
+      st.frames[st.n++] = f;
+    }
+  }
+
+  // ---- simulate every token ---------------------------------------------
+  std::vector<Stack> cur, nxt;
+  for (int32_t t = 0; t < n_tokens; t++) {
+    int64_t lo = tok_off[t], hi = tok_off[t + 1];
+    if (hi <= lo) continue;
+    cur = init;
+    bool alive = true;
+    for (int64_t i = lo; i < hi && alive; i++) {
+      nxt.clear();
+      for (auto& st : cur) {
+        if (!schema::advance_stack(p, st, tok_bytes[i], nxt)) return -1;
+      }
+      if (nxt.empty()) alive = false;
+      cur.swap(nxt);
+    }
+    if (alive && !cur.empty())
+      mask_out[t >> 5] |= (uint32_t)1 << (t & 31);
+  }
+  return 0;
+}
+
+}  // extern "C"

@@ -257,9 +257,20 @@ def _load_native():
         u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
         i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
         u32p = np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS")
-        lib.json_fill_mask.argtypes = [
-            u8p, ctypes.c_int32, u8p, i64p, ctypes.c_int32, u32p]
-        lib.json_fill_mask.restype = None
+        try:
+            lib.json_fill_mask.argtypes = [
+                u8p, ctypes.c_int32, u8p, i64p, ctypes.c_int32, u32p]
+            lib.json_fill_mask.restype = None
+            # schema skeleton-machine fill (ops/schema.py) lives in the
+            # same library; rc 0 = filled, -1 = cap → python fallback
+            lib.schema_fill_mask.argtypes = [
+                i64p, ctypes.c_int32, i64p, u8p,
+                u8p, ctypes.c_int64, u8p, i64p, ctypes.c_int32, u32p]
+            lib.schema_fill_mask.restype = ctypes.c_int32
+        except AttributeError:
+            # a stale prebuilt .so (restored build cache) may predate a
+            # symbol; the contract is fall-back-to-Python, never raise
+            return None
         _lib = lib
         return _lib
 

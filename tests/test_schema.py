@@ -318,3 +318,69 @@ def test_any_hole_nesting_reuses_abstract_mask_states():
     # beyond max_len depth the abstract key saturates
     assert len(depth_keys) <= table.max_len + 1
     assert len(sch._masks) <= table.max_len + 4
+
+
+def test_native_schema_fill_parity_and_speed():
+    """native/grammar.cpp's schema_fill_mask must agree bit-for-bit with
+    the Python NFA sweep on every state of a multi-construct walk, and
+    retire the cold hole-state fill cost (round-2 VERDICT weak #7 /
+    next-6: the Python sweep was seconds for 100k vocabs)."""
+    import time
+
+    from ollama_operator_tpu.ops.constrain import _load_native
+    if _load_native() is None:
+        pytest.skip("native grammar lib unavailable (no g++?)")
+
+    rng = np.random.default_rng(3)
+    pieces = [b""] + [bytes(rng.integers(32, 127, size=int(n)))
+                      for n in rng.integers(1, 6, size=4096)]
+    pieces += [b'{"', b'":', b'",', b'"}', b'12', b'-3', b'true', b'[',
+               b']', b'a', b'5', b'}', b'{']
+    table = TokenTable(pieces, eog_ids=[0])
+
+    sch = S.compile_schema({"anyOf": [
+        {"type": "object",
+         "properties": {"name": {"type": "string"},
+                        "n": {"type": "integer", "minimum": -30,
+                              "maximum": 1200},
+                        "tags": {"type": "array",
+                                 "items": {"enum": ["a", "bb", 3]}},
+                        "v": {}}},
+        {"type": "string"},
+    ]})
+    assert sch is not None and sch._prog is not None
+
+    walk = b'{"name":"ab","n":-2,"tags":["bb",3],"v":[{"x":1},'
+    st = S.machine_init(sch.root)
+    checked = 0
+    t_native = t_python = 0.0
+    for i in range(len(walk) + 1):
+        # parity at every prefix state (incl. hole interiors + NFA splits)
+        t0 = time.perf_counter()
+        native = sch._native_fill(table, st)
+        t_native += time.perf_counter() - t0
+        assert native is not None, f"native bailed at prefix {walk[:i]!r}"
+        t0 = time.perf_counter()
+        ref = np.zeros(table.n_words, np.uint32)
+        for tid, piece in enumerate(table.pieces):
+            if not piece:
+                continue
+            s2 = st
+            for b in piece:
+                s2 = S.machine_advance(sch.root, s2, b)
+                if s2 is None:
+                    break
+            if s2 is not None:
+                ref[tid >> 5] |= np.uint32(1 << (tid & 31))
+        t_python += time.perf_counter() - t0
+        assert (native == ref).all(), (i, walk[:i])
+        checked += 1
+        if i < len(walk):
+            st = S.machine_advance(sch.root, st, walk[i])
+            assert st is not None, walk[: i + 1]
+    assert checked == len(walk) + 1
+    print(f"\nnative schema fill: {checked} states x {len(pieces)} tokens; "
+          f"python {t_python:.3f}s vs native {t_native:.3f}s "
+          f"({t_python / max(t_native, 1e-9):.0f}x)")
+    # the point of the port: the cold sweep must be far cheaper
+    assert t_native * 3 < t_python
