@@ -168,21 +168,53 @@ def main() -> None:
         assert fits, (f"{plan_name}: {total/1e9:.1f} GB/device exceeds "
                       f"v5e budget")
 
-    # paged-pool fit (analytic): 32 mixed-length slots sharing a full-HBM
-    # page pool on the tp8 axis — the serving default's capacity story
+    # paged pool on tp8: 32 mixed-length slots sharing a page pool — the
+    # high-concurrency serving layout. Compile the REAL-dimension paged
+    # decode program (block tables + scatter + attend per layer) AND
+    # assert the exact per-shard byte budget.
     mesh = make_mesh(MeshPlan(tp=8), devs[:8])
     p_sh = params_sharding_tree(p_int8, mesh, cfg)
     per_dev_params = leaf_device_bytes(p_int8, p_sh)
     L, KvH, hd, S = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim, \
         cfg.max_seq_len
-    n_pages = N_SLOTS_PAGED * S // PAGE
-    kvh_dev = KvH // 8
-    pool = 2 * ((n_pages + 1) * L * kvh_dev * PAGE * hd      # int8 entries
-                + (n_pages + 1) * L * kvh_dev * PAGE * 4)    # f32 scales
+    B = N_SLOTS_PAGED
+    n_pages = B * S // PAGE
+    nblk = S // PAGE
+    pool_spec = P(None, None, "tp", None, None)
+    pool_sh = NamedSharding(mesh, pool_spec)
+    ps_sh = NamedSharding(mesh, P(None, None, "tp", None))
+    pool_aval = {
+        "q": jax.ShapeDtypeStruct((L, n_pages + 1, KvH, PAGE, hd),
+                                  jnp.int8, sharding=pool_sh),
+        "s": jax.ShapeDtypeStruct((L, n_pages + 1, KvH, PAGE),
+                                  jnp.float32, sharding=ps_sh)}
+    pool = leaf_device_bytes(pool_aval, {"q": pool_sh, "s": ps_sh}) * 2
+    repl = NamedSharding(mesh, P())
+    tables = jax.ShapeDtypeStruct((B, nblk), jnp.int32, sharding=repl)
+    lengths = jax.ShapeDtypeStruct((B,), jnp.int32, sharding=repl)
+    tokens = jax.ShapeDtypeStruct((B, 1), jnp.int32, sharding=repl)
+    p_aval = jax.tree.map(
+        lambda a, sh: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=sh),
+        p_int8, p_sh,
+        is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct))
+
+    def paged_step(params, kp, vp, tokens, lengths, tables):
+        return decoder.forward_with_cache_paged(
+            params, cfg, tokens, kp, vp, tables, lengths, nblk, mesh=mesh)
+
+    t0 = time.monotonic()
+    exe = jax.jit(paged_step, donate_argnums=(1, 2)).lower(
+        p_aval, pool_aval, pool_aval, tokens, lengths, tables).compile()
+    compile_s = time.monotonic() - t0
+    hlo = exe.as_text()
+    assert ("all-reduce" in hlo or "all-gather" in hlo
+            or "reduce-scatter" in hlo), "paged program: no tp collectives"
+    log(f"tp8 paged decode step compiled in {compile_s:.0f}s")
     total = per_dev_params + pool
     fits = total <= V5E_HBM - ACT_HEADROOM
     results["paged_pool"] = {
-        "plan": "tp8", "slots": N_SLOTS_PAGED, "n_pages": n_pages,
+        "plan": "tp8", "slots": B, "n_pages": n_pages, "compiled": True,
+        "compile_s": round(compile_s, 1),
         "per_device_param_gb": round(per_dev_params / 1e9, 2),
         "per_device_pool_gb": round(pool / 1e9, 2),
         "per_device_total_gb": round(total / 1e9, 2),

@@ -261,6 +261,11 @@ def _load_native():
             lib.json_fill_mask.argtypes = [
                 u8p, ctypes.c_int32, u8p, i64p, ctypes.c_int32, u32p]
             lib.json_fill_mask.restype = None
+        except AttributeError:
+            # a stale prebuilt .so (restored build cache) may predate a
+            # symbol; the contract is fall-back-to-Python, never raise
+            return None
+        try:
             # schema skeleton-machine fill (ops/schema.py) lives in the
             # same library; rc 0 = filled, -1 = cap → python fallback
             lib.schema_fill_mask.argtypes = [
@@ -268,9 +273,9 @@ def _load_native():
                 u8p, ctypes.c_int64, u8p, i64p, ctypes.c_int32, u32p]
             lib.schema_fill_mask.restype = ctypes.c_int32
         except AttributeError:
-            # a stale prebuilt .so (restored build cache) may predate a
-            # symbol; the contract is fall-back-to-Python, never raise
-            return None
+            # .so predates the schema machine: keep the (working) generic
+            # json path native, schema fills fall back to Python
+            lib.schema_fill_mask = None
         _lib = lib
         return _lib
 

@@ -621,7 +621,8 @@ class Schema:
         None → caller runs the Python reference sweep."""
         from .constrain import _load_native
         lib = _load_native()
-        if lib is None or self._prog is None:
+        if (lib is None or self._prog is None
+                or getattr(lib, "schema_fill_mask", None) is None):
             return None
         sb = _serialize_state(state, self._prog[3])
         if sb is None:

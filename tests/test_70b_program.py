@@ -51,7 +51,8 @@ def test_programs_compile_and_fit(proof):
         assert p["per_device_total_gb"] < 14.5
 
 
-def test_paged_pool_fits(proof):
+def test_paged_pool_compiles_and_fits(proof):
     pool = proof["paged_pool"]
+    assert pool["compiled"]          # real-dims paged decode program
     assert pool["slots"] == 32 and pool["fits_v5e"]
     assert pool["per_device_total_gb"] < 14.5
