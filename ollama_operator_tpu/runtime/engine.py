@@ -623,77 +623,92 @@ class Engine:
             return (toks_n, k_cache, v_cache, lengths, counts, last_tokens,
                     pring, keys)
 
-        def _extend_paged(params, k_cache, v_cache, lengths, counts,
-                          last_tokens, pring, tokens, ring_row, counts_row,
-                          slot, start, n_new, table_row, sp_row, key,
-                          mask_row, cflag, rln):
-            """Paged prefix-cache continuation: the reused prefix stays in
-            its pages untouched; the tail prefills through the paged
-            forward (B=1 view, positions offset by ``start``), writing
-            into pages from ``table_row`` — no cache slice/unslice copies,
-            and quantized pools work the same (the paged forward
-            quantizes fresh K/V per layer). Tail bucket-padding beyond
-            n_new lands on unowned table entries, i.e. the trash page."""
-            logits, k_cache, v_cache = decoder.forward_with_cache_paged(
-                params, cfg, tokens, k_cache, v_cache, table_row[None],
-                start[None], self._nblk, mesh=self.mesh)
-            last = jax.lax.dynamic_index_in_dim(
-                logits[0], n_new - 1, axis=0, keepdims=False)
-            (tok, lengths, counts, last_tokens, pring) = _sample_install(
-                lengths, counts, last_tokens, pring, last, ring_row,
-                counts_row, slot, start + n_new, sp_row, key, mask_row,
-                cflag, rln)
-            return (tok, *pin(k_cache, v_cache, lengths, counts,
-                              last_tokens, pring))
+        def _make_extend_paged(A):
+            """Paged prefix-cache continuation, attending only the first
+            ``A`` positions (the live-prefix bucket): the reused prefix
+            stays in its pages untouched; the tail prefills through the
+            paged forward (B=1 view, positions offset by ``start``),
+            writing into pages from ``table_row`` — no cache
+            slice/unslice copies, and quantized pools work the same (the
+            paged forward quantizes fresh K/V per layer). Tail
+            bucket-padding beyond n_new lands on unowned table entries,
+            i.e. the trash page."""
+            nblk_a = -(-A // self.ecfg.page_size)
 
-        def _extend(params, k_cache, v_cache, lengths, counts, last_tokens,
-                    pring, tokens, ring_row, counts_row, slot, start, n_new,
-                    sp_row, key, mask_row, cflag, rln):
-            """Prefix-cache continuation: prefill only the tail of a prompt
-            whose first ``start`` tokens are already in ``slot``'s KV cache
-            (a parked conversation). ``ring_row``/``counts_row`` are the
-            penalty window over the FULL continuation prompt, prebuilt on
-            the host (the parked window may belong to a divergent suffix).
+            def _extend_paged(params, k_cache, v_cache, lengths, counts,
+                              last_tokens, pring, tokens, ring_row,
+                              counts_row, slot, start, n_new, table_row,
+                              sp_row, key, mask_row, cflag, rln):
+                logits, k_cache, v_cache = \
+                    decoder.forward_with_cache_paged(
+                        params, cfg, tokens, k_cache, v_cache,
+                        table_row[None], start[None], nblk_a,
+                        mesh=self.mesh)
+                last = jax.lax.dynamic_index_in_dim(
+                    logits[0], n_new - 1, axis=0, keepdims=False)
+                (tok, lengths, counts, last_tokens,
+                 pring) = _sample_install(
+                    lengths, counts, last_tokens, pring, last, ring_row,
+                    counts_row, slot, start + n_new, sp_row, key, mask_row,
+                    cflag, rln)
+                return (tok, *pin(k_cache, v_cache, lengths, counts,
+                                  last_tokens, pring))
+            return _extend_paged
+
+        def _make_extend(A):
+            """Prefix-cache continuation: prefill only the tail of a
+            prompt whose first ``start`` tokens are already in ``slot``'s
+            KV cache (a parked conversation), slicing AND attending only
+            the first ``A`` cache positions — the live-prefix bucket
+            (programs are keyed by (tail, attn) bucket pairs, so the
+            admission's HBM traffic scales with the conversation, not
+            max_seq_len). ``ring_row``/``counts_row`` are the penalty
+            window over the FULL continuation prompt, prebuilt on the
+            host (the parked window may belong to a divergent suffix).
             Dense caches only (sp is scheduler-gated); int8 caches slice
             both the entries and their scales — the cached forward
             quantizes the tail in place (round-1 weak #4: int8 and prefix
-            caching used to be mutually exclusive).
-            The slot cache is sliced/written at full S and the tail attends
-            all S key slots; bucketing both to the live prefix (programs
-            keyed by (tail, attn) bucket pairs) would cut the admission's
-            HBM traffic further at the cost of a quadratic warm-up set.
-            """
-            dsl, dus = jax.lax.dynamic_slice, jax.lax.dynamic_update_slice
-            if self.quant_cache:
-                Lq, _, KvH, S, hd = k_cache["q"].shape
-                def slice5(c):
-                    return {"q": dsl(c["q"], (0, slot, 0, 0, 0),
-                                     (Lq, 1, KvH, S, hd)),
-                            "s": dsl(c["s"], (0, slot, 0, 0),
-                                     (Lq, 1, KvH, S))}
-                def write5(c, cs):
-                    return {"q": dus(c["q"], cs["q"], (0, slot, 0, 0, 0)),
-                            "s": dus(c["s"], cs["s"], (0, slot, 0, 0))}
-            else:
-                Lq, _, KvH, S, hd = k_cache.shape
-                def slice5(c):
-                    return dsl(c, (0, slot, 0, 0, 0), (Lq, 1, KvH, S, hd))
-                def write5(c, cs):
-                    return dus(c, cs, (0, slot, 0, 0, 0))
-            kc_s, vc_s = slice5(k_cache), slice5(v_cache)
-            logits, kc_s, vc_s = decoder.forward_with_cache(
-                params, cfg, tokens, kc_s, vc_s, start[None],
-                mesh=self.mesh)
-            k_cache = write5(k_cache, kc_s)
-            v_cache = write5(v_cache, vc_s)
-            last = jax.lax.dynamic_index_in_dim(
-                logits[0], n_new - 1, axis=0, keepdims=False)
-            (tok, lengths, counts, last_tokens, pring) = _sample_install(
-                lengths, counts, last_tokens, pring, last, ring_row,
-                counts_row, slot, start + n_new, sp_row, key, mask_row,
-                cflag, rln)
-            return (tok, *pin(k_cache, v_cache, lengths, counts,
-                              last_tokens, pring))
+            caching used to be mutually exclusive)."""
+            def _extend(params, k_cache, v_cache, lengths, counts,
+                        last_tokens, pring, tokens, ring_row, counts_row,
+                        slot, start, n_new, sp_row, key, mask_row, cflag,
+                        rln):
+                dsl = jax.lax.dynamic_slice
+                dus = jax.lax.dynamic_update_slice
+                if self.quant_cache:
+                    Lq, _, KvH, _S, hd = k_cache["q"].shape
+                    def slice5(c):
+                        return {"q": dsl(c["q"], (0, slot, 0, 0, 0),
+                                         (Lq, 1, KvH, A, hd)),
+                                "s": dsl(c["s"], (0, slot, 0, 0),
+                                         (Lq, 1, KvH, A))}
+                    def write5(c, cs):
+                        return {"q": dus(c["q"], cs["q"],
+                                         (0, slot, 0, 0, 0)),
+                                "s": dus(c["s"], cs["s"], (0, slot, 0, 0))}
+                else:
+                    Lq, _, KvH, _S, hd = k_cache.shape
+                    def slice5(c):
+                        return dsl(c, (0, slot, 0, 0, 0),
+                                   (Lq, 1, KvH, A, hd))
+                    def write5(c, cs):
+                        return dus(c, cs, (0, slot, 0, 0, 0))
+                kc_s, vc_s = slice5(k_cache), slice5(v_cache)
+                logits, kc_s, vc_s = decoder.forward_with_cache(
+                    params, cfg, tokens, kc_s, vc_s, start[None],
+                    mesh=self.mesh)
+                k_cache = write5(k_cache, kc_s)
+                v_cache = write5(v_cache, vc_s)
+                last = jax.lax.dynamic_index_in_dim(
+                    logits[0], n_new - 1, axis=0, keepdims=False)
+                (tok, lengths, counts, last_tokens,
+                 pring) = _sample_install(
+                    lengths, counts, last_tokens, pring, last, ring_row,
+                    counts_row, slot, start + n_new, sp_row, key, mask_row,
+                    cflag, rln)
+                return (tok, *pin(k_cache, v_cache, lengths, counts,
+                                  last_tokens, pring))
+            return _extend
 
         def _release(lengths, counts, last_tokens, pring, slot):
             lengths = lengths.at[slot].set(0)
@@ -747,9 +762,11 @@ class Engine:
         self._admit_embeds_fn = _jit(_admit_embeds, (1, 2, 3, 4, 5, 6),
                                      outs=tok_outs)
         self._admit_execs: Dict[int, Any] = {}
-        self._extend_fn = _jit(_extend_paged if self.paged else _extend,
-                               (1, 2, 3, 4, 5, 6), outs=tok_outs)
-        self._extend_execs: Dict[int, Any] = {}
+        make_ext = _make_extend_paged if self.paged else _make_extend
+        self._extend_make = lambda A: _jit(make_ext(A), (1, 2, 3, 4, 5, 6),
+                                           outs=tok_outs)
+        self._extend_jits: Dict[int, Any] = {}
+        self._extend_execs: Dict[Any, Any] = {}
         self._decode_fn = _jit(_decode, (1, 2, 3, 4, 5, 6, 8),
                                outs=dec_outs)
         self._decode_n_fn = _jit(_decode_n, (1, 2, 3, 4, 5, 6, 8),
@@ -948,8 +965,24 @@ class Engine:
             return self._paged_dp == 1
         return self.sp_size == 1
 
-    def _extend_exec(self, bucket: int):
-        exe = self._extend_execs.get(bucket)
+    def _canon_attn(self, A: int) -> int:
+        """Paged extend programs depend only on ceil(A / page_size):
+        canonicalize so byte-identical programs share one compile."""
+        if not self.paged:
+            return A
+        ps = self.ecfg.page_size
+        return -(-A // ps) * ps
+
+    def _extend_jit(self, A: int):
+        fn = self._extend_jits.get(A)
+        if fn is None:
+            fn = self._extend_make(A)
+            self._extend_jits[A] = fn
+        return fn
+
+    def _extend_exec(self, bucket: int, A: int):
+        A = self._canon_attn(A)
+        exe = self._extend_execs.get((bucket, A))
         if exe is None:
             tokens = self._gr(np.zeros((1, bucket), np.int32))
             W = max(1, self.ecfg.repeat_last_n)
@@ -963,8 +996,8 @@ class Engine:
                 args.append(self._gr(np.zeros((self._nblk,), np.int32)))
             args += [self._sp_row(SlotOptions()), self._dummy_key(),
                      self._mask_ones, zi(0), zi(W)]
-            exe = self._extend_fn.lower(*args).compile()
-            self._extend_execs[bucket] = exe
+            exe = self._extend_jit(A).lower(*args).compile()
+            self._extend_execs[(bucket, A)] = exe
         return exe
 
     def extend(self, slot: int, full_ids: np.ndarray, start: int,
@@ -995,6 +1028,10 @@ class Engine:
             # of defence)
             raise ValueError(
                 f"tail bucket {bucket} does not fit above {start}")
+        # attended-prefix bucket: the program slices/attends only the
+        # first A cache positions, so continuation cost scales with the
+        # conversation, not max_seq_len
+        attn_a = self.bucket_for(start + bucket)
         tokens = np.zeros((1, bucket), np.int32)
         tokens[0, :n_new] = full_ids[start:]
         # penalty window over the full continuation prompt (host-built:
@@ -1036,7 +1073,8 @@ class Engine:
         args += [self._sp_row(opts), key, mrow, cflag,
                  self._gr(np.int32(rln))]
         (tok, self.k_cache, self.v_cache, self.lengths, self.counts,
-         self.last_tokens, self.pring) = self._extend_exec(bucket)(*args)
+         self.last_tokens, self.pring) = \
+            self._extend_exec(bucket, attn_a)(*args)
         self._commit_slot(slot, n_total, opts)
         return int(tok)
 
@@ -1160,11 +1198,18 @@ class Engine:
         for b in self._buckets:
             self._admit_exec(b)
         if self.supports_extend:
-            # the max_seq tail bucket is unreachable: extend requires
-            # start >= 1 and start + bucket <= max_seq
+            # (tail, attended) bucket pairs; the max_seq tail bucket is
+            # unreachable (extend requires start >= 1 and start + bucket
+            # <= max_seq), and the attended bucket covers start + tail so
+            # A >= the tail bucket — O(log² max_seq) programs
             for b in self._buckets:
-                if b < self.max_seq:
-                    self._extend_exec(b)
+                if b >= self.max_seq:
+                    continue
+                for a in self._buckets:
+                    # start >= 1, so attn_a = bucket_for(start + b) is
+                    # always the NEXT bucket up — a == b is unreachable
+                    if a > b:
+                        self._extend_exec(b, a)
 
     def prepare_decode(self, n: Optional[int] = None) -> list:
         """Paged mode: grow every active slot's block table to cover
