@@ -623,6 +623,80 @@ class Engine:
             return (toks_n, k_cache, v_cache, lengths, counts, last_tokens,
                     pring, keys)
 
+        def _spec_verify(params, k_cache, v_cache, lengths, counts,
+                         last_tokens, pring, sp, keys, active, mask_bits,
+                         constrained, rln, is_greedy, drafts, attn_len,
+                         tables=None):
+            """Speculative verify step (one dispatch): run the cached
+            forward over [last_token, draft_0..draft_{k-1}] per slot,
+            greedy-accept the longest matching draft prefix (greedy
+            slots only — temperature-0 acceptance is exact), and emit
+            accepted drafts + one model token per slot. Rejected
+            positions\' K/V are garbage above the advanced length and are
+            never attended; the next write overwrites them. Non-greedy
+            slots sample their single token exactly like _decode_body, so
+            a k=0-accepting batch degrades to one normal decode step."""
+            B, kk = drafts.shape
+            V = cfg.vocab_size
+            tokens_in = jnp.concatenate([last_tokens[:, None], drafts], 1)
+            kw = {"attn_len": attn_len} if self._bucketed_attn else {}
+            if self.paged:
+                ps = self.ecfg.page_size
+                nblk = -(-attn_len // ps)
+                logits, k_cache, v_cache = \
+                    decoder.forward_with_cache_paged(
+                        params, cfg, tokens_in, k_cache, v_cache,
+                        tables, lengths, nblk, mesh=self.mesh)
+            else:
+                logits, k_cache, v_cache = step_impl(
+                    params, tokens=tokens_in, k_cache=k_cache,
+                    v_cache=v_cache, lengths=lengths, **kw)
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            match = (drafts == greedy[:, :-1]).astype(jnp.int32)
+            n_acc = jnp.sum(jnp.cumprod(match, axis=1), axis=1)
+            ok = (active == 1) & (is_greedy == 1)
+            n_acc = jnp.where(ok, n_acc, 0)
+            bi = jnp.arange(B)
+            step_keys = jax.vmap(jax.random.fold_in)(keys, lengths)
+            l0 = logits[:, 0]
+            allowed = unpack_mask(mask_bits, V)
+            l0 = jnp.where((constrained == 1)[:, None] & ~allowed,
+                           sampling.NEG_INF, l0)
+            sampled0 = sampling.sample(l0, counts, sp, step_keys)
+            bonus = jnp.where(ok, greedy[bi, n_acc], sampled0)
+            t_idx = jnp.arange(kk + 1, dtype=jnp.int32)[None, :]
+            dpad = jnp.concatenate(
+                [drafts, jnp.zeros((B, 1), jnp.int32)], axis=1)
+            out = jnp.where(t_idx < n_acc[:, None], dpad, jnp.int32(V))
+            out = out.at[bi, n_acc].set(bonus)
+            out = jnp.where((active == 1)[:, None], out, jnp.int32(V))
+
+            def push(carry, t):
+                lengths, counts, last_tokens, pring = carry
+                tok_t = out[:, t]
+                act_t = ((active == 1) & (t <= n_acc)
+                         & (tok_t < V)).astype(jnp.int32)
+                rmod = jnp.maximum(rln, 1)
+                slot_pos = (lengths + 1) % rmod
+                evict = pring[bi, slot_pos]
+                evict = jnp.where(act_t == 1, evict, jnp.int32(V))
+                live = (act_t == 1) & (rln > 0)
+                new = jnp.where(live, tok_t, jnp.int32(V))
+                counts2 = counts.at[bi, evict].add(-1, mode="drop")
+                counts2 = counts2.at[bi, new].add(1, mode="drop")
+                pring2 = jnp.where(live[:, None],
+                                   pring.at[bi, slot_pos].set(tok_t),
+                                   pring)
+                lengths2 = lengths + act_t
+                last2 = jnp.where(act_t == 1, tok_t, last_tokens)
+                return (lengths2, counts2, last2, pring2), None
+
+            (lengths, counts, last_tokens, pring), _ = jax.lax.scan(
+                push, (lengths, counts, last_tokens, pring),
+                jnp.arange(kk + 1, dtype=jnp.int32))
+            return (out, *pin(k_cache, v_cache, lengths, counts,
+                              last_tokens, pring), keys)
+
         def _make_extend_paged(A):
             """Paged prefix-cache continuation, attending only the first
             ``A`` positions (the live-prefix bucket): the reused prefix
@@ -771,6 +845,11 @@ class Engine:
                                outs=dec_outs)
         self._decode_n_fn = _jit(_decode_n, (1, 2, 3, 4, 5, 6, 8),
                                  static=(13, 14), outs=decn_outs)
+        spec_outs = (((slot_sh2,) + state_outs + (slot_sh,))
+                     if state_outs else None)
+        self._spec_fn = _jit(_spec_verify, (1, 2, 3, 4, 5, 6, 8),
+                             static=(15,), outs=spec_outs)
+        self._spec_execs: Dict[Any, Any] = {}
         self._release_fn = _jit(
             _release, (0, 1, 2, 3),
             outs=(slot_sh, slot_sh2, slot_sh, slot_sh2) if slot_sh else None)
@@ -1197,6 +1276,14 @@ class Engine:
                 self._decode_n_exec(1, b)
         for b in self._buckets:
             self._admit_exec(b)
+        import os as _os
+        spec_k = int(_os.environ.get("TPU_SPEC_DECODE", "0") or "0")
+        if (spec_k > 0 and self.sp_size == 1
+                and not (self.paged and self._paged_dp > 1)):
+            # speculative verify programs per attention bucket — a bucket
+            # crossing must swap programs, never recompile mid-serving
+            for b in buckets:
+                self._spec_exec(spec_k, b)
         if self.supports_extend:
             # (tail, attended) bucket pairs; the max_seq tail bucket is
             # unreachable (extend requires start >= 1 and start + bucket
@@ -1293,6 +1380,70 @@ class Engine:
             self._g(budgets, self._slot_sh))
         self._host_lengths[self.active] += budgets[self.active]
         return self._fetch(toks_n)
+
+    def _spec_exec(self, k: int, attn_len: int):
+        key = (k, attn_len)
+        exe = self._spec_execs.get(key)
+        if exe is None:
+            drafts = self._g(np.zeros((self.n_slots, k), np.int32),
+                             self._slot_sh2)
+            flags = self._g(np.zeros((self.n_slots,), np.int32),
+                            self._slot_sh)
+            exe = self._spec_fn.lower(
+                self.params, self.k_cache, self.v_cache, self.lengths,
+                self.counts, self.last_tokens, self.pring, self.sp,
+                self.keys, self._active_dev, self.mask_bits,
+                self._constr_dev, self._rln_dev, flags, drafts, attn_len,
+                self._tables_dev()).compile()
+            self._spec_execs[key] = exe
+        return exe
+
+    def decode_spec(self, drafts: np.ndarray) -> np.ndarray:
+        """Speculative verify step (prompt-lookup decoding): ``drafts``
+        [B, k] int32 are candidate continuations per slot (zeros are fine
+        for slots with nothing to propose). ONE dispatch verifies all
+        drafts and emits, per slot, its accepted prefix plus one model
+        token — up to k+1 tokens for a greedy slot, exactly 1 otherwise
+        (non-greedy slots sample their token identically to decode()).
+        Returns [B, k+1] with vocab_size sentinel padding; row b's valid
+        tokens are the entries < vocab_size, in order."""
+        assert self.sp_size == 1, \
+            "speculative decode: bucketed caches only (no sp meshes)"
+        assert not (self.paged and self._paged_dp > 1), \
+            "speculative decode: the paged dp-manual region is T=1 only"
+        k = int(drafts.shape[1])
+        assert k >= 1, "need at least one draft column"
+        n = k + 1
+        victims = self.prepare_decode(n)
+        if victims:
+            from .paged import PagesExhausted
+            raise PagesExhausted(f"pool dry; victims {victims}")
+        attn = self._attn_bucket(n)
+        # acceptance compares raw argmax, so it is exact ONLY for greedy
+        # slots with neutral penalties (sample() would otherwise adjust
+        # logits by the evolving counts); everything else takes the
+        # single-token path inside the same dispatch
+        def _spec_ok(o: SlotOptions) -> bool:
+            return (o.temperature <= 0.0 and o.repeat_penalty == 1.0
+                    and o.presence_penalty == 0.0
+                    and o.frequency_penalty == 0.0)
+        is_greedy = np.array(
+            [1 if (self.active[s] and not self._constrained[s]
+                   and _spec_ok(self._opts.get(s, SlotOptions())))
+             else 0 for s in range(self.n_slots)], np.int32)
+        exe = self._spec_exec(k, attn)
+        (toks, self.k_cache, self.v_cache, self.lengths, self.counts,
+         self.last_tokens, self.pring, self.keys) = exe(
+            self.params, self.k_cache, self.v_cache, self.lengths,
+            self.counts, self.last_tokens, self.pring, self.sp, self.keys,
+            self._active_dev, self.mask_bits, self._constr_dev,
+            self._rln_dev, self._g(is_greedy, self._slot_sh),
+            self._g(np.asarray(drafts, np.int32), self._slot_sh2),
+            self._tables_dev())
+        toks = self._fetch(toks)
+        n_out = (toks < self.cfg.vocab_size).sum(axis=1)
+        self._host_lengths[self.active] += n_out[self.active]
+        return toks
 
     def step_budgets(self, n: int) -> np.ndarray:
         """Per-slot decode-step budget for a chunk of ``n``: constrained

@@ -116,8 +116,8 @@ class MirroredEngine:
     that dispatches a device program or mutates replay-relevant host
     state (page tables). Everything else delegates transparently."""
 
-    MIRRORED = ("admit", "extend", "decode", "decode_n", "release",
-                "set_mask", "clear_mask", "warm_buckets",
+    MIRRORED = ("admit", "extend", "decode", "decode_n", "decode_spec",
+                "release", "set_mask", "clear_mask", "warm_buckets",
                 "free_slot_pages", "prepare_decode")
 
     def __init__(self, inner, cp: ControlPlane):

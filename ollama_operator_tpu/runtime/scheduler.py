@@ -85,6 +85,11 @@ class Request:
         # every sampled token (incl. EOG), for parking the slot's KV as a
         # reusable prefix after the request finishes
         self.all_tokens: List[int] = []
+        # prompt-lookup drafting index: final-bigram → position of its
+        # continuation in (prompt + generated), maintained incrementally
+        # so drafting stays O(k) per step on long contexts
+        self._bigram_idx: dict = {}
+        self._indexed_upto = 0
         # set when the request is preempted (paged pool pressure): the
         # full prompt + tokens generated so far; re-admission prefills
         # from here and generation continues seamlessly on the same
@@ -118,6 +123,13 @@ class Scheduler:
 
     def __init__(self, engine: Engine, max_queue: int = 256):
         self.engine = engine
+        # speculative decoding (prompt-lookup, engine.decode_spec): draft
+        # up to k tokens per greedy penalty-free slot from n-gram matches
+        # in its own context. Opt-in (TPU_SPEC_DECODE=k) — it trades the
+        # decode_chunk's dispatch amortization for multi-token verify
+        # steps, a win where dispatch is cheap and outputs are repetitive
+        import os as _os
+        self.spec_k = int(_os.environ.get("TPU_SPEC_DECODE", "0") or "0")
         self._waiting: queue.Queue = queue.Queue(maxsize=max_queue)
         # preempted requests (paged pool pressure) re-admit before the
         # waiting queue — they already hold a place in the line
@@ -436,6 +448,57 @@ class Scheduler:
                     self.finished.append(req.stats)
                 req.out.put(("error", req.error))
 
+    def _build_drafts(self, k: int):
+        """Prompt-lookup drafts [B, k] (zeros where nothing to propose),
+        or None when no eligible slot found an n-gram match — the loop
+        then takes the normal chunked path. Only greedy penalty-free
+        unconstrained slots draft (engine acceptance is exact there)."""
+        drafts = np.zeros((self.engine.n_slots, k), np.int32)
+        n_drafting = n_running = 0
+        for slot, req in enumerate(self._running):
+            if req is None:
+                continue
+            n_running += 1
+            if req.constraint is not None:
+                continue
+            o = req.opts
+            if (o.temperature > 0.0 or o.repeat_penalty != 1.0
+                    or o.presence_penalty != 0.0
+                    or o.frequency_penalty != 0.0):
+                continue
+            d = self._lookup_draft(req, k)
+            if d:
+                drafts[slot, :len(d)] = d
+                n_drafting += 1
+        # a spec dispatch caps every NON-drafting slot at 1 token (vs a
+        # full decode_chunk on the chunked path) — only worth it when at
+        # least half the batch is drafting
+        if n_drafting == 0 or n_drafting * 2 < n_running:
+            return None
+        return drafts
+
+    @staticmethod
+    def _lookup_draft(req: Request, k: int, ngram: int = 2):
+        """Latest earlier occurrence of the context's final bigram → the
+        k tokens that followed it (llama.cpp-style lookup decoding; no
+        draft model needed). The bigram→continuation-position index is
+        maintained incrementally on the request, so a step costs O(new
+        tokens + k), not O(context)."""
+        hist = list(req.prompt_ids) + req.all_tokens
+        if len(hist) < ngram + 1:
+            return None
+        # index bigrams ENDING strictly before the final position (the
+        # final bigram itself must not match its own occurrence)
+        upto = len(hist) - 1
+        for i in range(max(req._indexed_upto, ngram), upto):
+            req._bigram_idx[(int(hist[i - 2]), int(hist[i - 1]))] = i
+        req._indexed_upto = max(req._indexed_upto, upto)
+        key = (int(hist[-2]), int(hist[-1]))
+        pos = req._bigram_idx.get(key)
+        if pos is None:
+            return None
+        return hist[pos: pos + k] or None
+
     def _step(self):
         self._admit_waiting()
         active = [(s, r) for s, r in enumerate(self._running)
@@ -464,10 +527,19 @@ class Scheduler:
         n_steps = (1 if running
                    and all(r.constraint is not None for r in running)
                    else None)
-        self._relieve_pressure(n_steps)
+        spec_usable = (self.spec_k > 0 and self.engine.sp_size == 1
+                       and not (self.engine.paged
+                                and self.engine._paged_dp > 1)
+                       and n_steps is None)
+        drafts = self._build_drafts(self.spec_k) if spec_usable else None
+        self._relieve_pressure(self.spec_k + 1 if drafts is not None
+                               else n_steps)
         if self.n_active == 0:
             return
-        toks_n = self.engine.decode_n(n_steps)
+        if drafts is not None:
+            toks_n = self.engine.decode_spec(drafts).T   # [k+1, B] rows
+        else:
+            toks_n = self.engine.decode_n(n_steps)
         self._consecutive_failures = 0
         for row_idx, row in enumerate(np.asarray(toks_n)):
             any_running = False
@@ -478,6 +550,9 @@ class Scheduler:
                 if req.constraint is not None and row_idx >= 1:
                     continue  # frozen after its 1-token budget
                 tid = int(row[slot])
+                if tid >= self.engine.cfg.vocab_size:
+                    continue   # spec-step padding beyond the slot's
+                               # accepted prefix (engine.decode_spec)
                 # grammar check BEFORE emitting: a dead-end state (empty
                 # mask → uniform sampling over -inf logits) must not leak
                 # an illegal token into the client's JSON stream

@@ -1,0 +1,202 @@
+"""Speculative decoding (prompt-lookup verify step, engine.decode_spec).
+
+llama.cpp ships lookup decoding behind the reference's delegated engine;
+here the verify step is ONE jitted dispatch over the whole slot batch:
+greedy penalty-free slots accept their longest matching draft prefix plus
+a bonus token, everyone else (sampling, constrained, penalized) gets
+exactly the token the normal decode path would produce.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ollama_operator_tpu.models import config as cfglib, decoder
+from ollama_operator_tpu.runtime.engine import Engine, EngineConfig, SlotOptions
+
+CFG = dataclasses.replace(cfglib.PRESETS["tiny"], kernels="xla")
+GREEDY = SlotOptions(temperature=0.0, repeat_penalty=1.0)
+ECFG = EngineConfig(max_slots=2, max_seq_len=128, cache_dtype=jnp.float32,
+                    min_prefill_bucket=16, decode_chunk=4)
+PROMPT = np.array([3, 1, 4, 1, 5, 9, 2, 6], np.int32)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return decoder.init_params(CFG, jax.random.key(0), jnp.float32)
+
+
+def _reference_tokens(params, n, opts=GREEDY):
+    eng = Engine(CFG, params, ecfg=ECFG)
+    seq = [eng.admit(0, PROMPT, opts)]
+    for _ in range(n):
+        seq.append(int(eng.decode()[0]))
+    return seq
+
+
+def _valid(row):
+    return [int(t) for t in row if t < CFG.vocab_size]
+
+
+def test_correct_drafts_all_accepted(params):
+    ref = _reference_tokens(params, 6)
+    eng = Engine(CFG, params, ecfg=ECFG)
+    first = eng.admit(0, PROMPT, GREEDY)
+    assert first == ref[0]
+    # draft exactly what the model will produce → all k accepted
+    drafts = np.full((eng.n_slots, 3), 0, np.int32)
+    drafts[0] = ref[1:4]
+    toks = eng.decode_spec(drafts)
+    got = _valid(toks[0])
+    assert got == ref[1:5], (got, ref)          # 3 accepted + 1 bonus
+    # after admit length == prompt (ref[0] pends in last_tokens); the
+    # spec step wrote ref[0..3]'s K/V and advanced by the 4 emitted
+    assert eng.slot_length(0) == len(PROMPT) + 4
+    # the engine continues correctly from the speculated state
+    assert int(eng.decode()[0]) == ref[5]
+
+
+def test_wrong_drafts_degrade_to_one_token(params):
+    ref = _reference_tokens(params, 3)
+    eng = Engine(CFG, params, ecfg=ECFG)
+    eng.admit(0, PROMPT, GREEDY)
+    bad = np.full((eng.n_slots, 3), (ref[1] + 1) % CFG.vocab_size, np.int32)
+    toks = eng.decode_spec(bad)
+    assert _valid(toks[0]) == [ref[1]]          # 0 accepted + bonus
+    assert eng.slot_length(0) == len(PROMPT) + 1
+    assert int(eng.decode()[0]) == ref[2]
+
+
+def test_partial_acceptance(params):
+    ref = _reference_tokens(params, 4)
+    eng = Engine(CFG, params, ecfg=ECFG)
+    eng.admit(0, PROMPT, GREEDY)
+    drafts = np.zeros((eng.n_slots, 3), np.int32)
+    drafts[0] = [ref[1], (ref[2] + 1) % CFG.vocab_size, ref[3]]
+    toks = eng.decode_spec(drafts)
+    # first draft accepted; second mismatches → bonus = the real ref[2]
+    assert _valid(toks[0]) == ref[1:3]
+    assert int(eng.decode()[0]) == ref[3]
+
+
+def test_state_matches_token_by_token_decode(params):
+    """Counts/pring/lengths after a spec step must equal the state after
+    the same tokens emitted one decode() at a time (the penalty ring sees
+    identical positions)."""
+    ref = _reference_tokens(params, 5)
+
+    eng_a = Engine(CFG, params, ecfg=ECFG)   # token-by-token
+    eng_a.admit(0, PROMPT, GREEDY)
+    for _ in range(4):
+        eng_a.decode()
+
+    eng_b = Engine(CFG, params, ecfg=ECFG)   # speculative
+    eng_b.admit(0, PROMPT, GREEDY)
+    drafts = np.zeros((eng_b.n_slots, 3), np.int32)
+    drafts[0] = ref[1:4]
+    eng_b.decode_spec(drafts)
+
+    np.testing.assert_array_equal(np.asarray(eng_a.lengths),
+                                  np.asarray(eng_b.lengths))
+    np.testing.assert_array_equal(np.asarray(eng_a.counts),
+                                  np.asarray(eng_b.counts))
+    np.testing.assert_array_equal(np.asarray(eng_a.last_tokens),
+                                  np.asarray(eng_b.last_tokens))
+    np.testing.assert_array_equal(np.asarray(eng_a.pring),
+                                  np.asarray(eng_b.pring))
+
+
+def test_sampling_slot_gets_normal_token(params):
+    """A non-greedy slot in the same batch accepts nothing and samples
+    exactly what decode() would (same per-step PRNG fold)."""
+    sample_opts = SlotOptions(temperature=0.9, seed=7)
+    eng_a = Engine(CFG, params, ecfg=ECFG)
+    eng_a.admit(0, PROMPT, GREEDY)
+    eng_a.admit(1, PROMPT[:5], sample_opts)
+    want = int(eng_a.decode()[1])
+
+    eng_b = Engine(CFG, params, ecfg=ECFG)
+    eng_b.admit(0, PROMPT, GREEDY)
+    eng_b.admit(1, PROMPT[:5], sample_opts)
+    toks = eng_b.decode_spec(np.zeros((2, 2), np.int32))
+    row1 = _valid(toks[1])
+    assert len(row1) == 1 and row1[0] == want
+
+
+def test_penalized_greedy_excluded_from_acceptance(params):
+    """repeat_penalty != 1.0 makes raw-argmax acceptance inexact — the
+    slot must fall back to the (penalty-aware) single-token path."""
+    pen = SlotOptions(temperature=0.0, repeat_penalty=1.8)
+    eng_a = Engine(CFG, params, ecfg=ECFG)
+    eng_a.admit(0, PROMPT, pen)
+    want = int(eng_a.decode()[0])
+
+    eng_b = Engine(CFG, params, ecfg=ECFG)
+    eng_b.admit(0, PROMPT, pen)
+    drafts = np.full((eng_b.n_slots, 3), want, np.int32)
+    toks = eng_b.decode_spec(drafts)
+    assert _valid(toks[0]) == [want]            # exactly one, exact token
+
+
+def test_paged_spec_decode(params):
+    ref = _reference_tokens(params, 4)
+    eng = Engine(CFG, params,
+                 ecfg=dataclasses.replace(ECFG, paged=True, page_size=8))
+    eng.admit(0, PROMPT, GREEDY)
+    drafts = np.zeros((eng.n_slots, 3), np.int32)
+    drafts[0] = ref[1:4]
+    toks = eng.decode_spec(drafts)
+    assert _valid(toks[0]) == ref[1:5]
+    assert int(eng.decode()[0]) == ref[5] if len(ref) > 5 else True
+
+
+def test_scheduler_spec_end_to_end(params, monkeypatch):
+    """TPU_SPEC_DECODE=3 through the real scheduler: the generated
+    stream must be IDENTICAL to the non-speculative run — speculation may
+    only change speed. Drafting uses an oracle (the base run's own
+    continuation) so acceptance is deterministic; the production
+    prompt-lookup drafter is covered by test_lookup_draft below (the
+    tiny random model's outputs never repeat an n-gram, so organic
+    matches can't be forced here)."""
+    from ollama_operator_tpu.runtime.scheduler import Scheduler
+
+    prompt = np.array([7, 8, 9, 7, 8, 9, 7, 8], np.int32)
+
+    def run(spec, base=None):
+        monkeypatch.setenv("TPU_SPEC_DECODE", "3" if spec else "0")
+        if base is not None:
+            monkeypatch.setattr(
+                Scheduler, "_lookup_draft",
+                staticmethod(lambda req, k, ngram=2:
+                             base[len(req.all_tokens):
+                                  len(req.all_tokens) + k]))
+        eng = Engine(CFG, params, ecfg=ECFG)
+        sched = Scheduler(eng)
+        try:
+            req = sched.submit(prompt, GREEDY, max_tokens=24,
+                               eog_ids=frozenset())
+            toks = list(req.tokens())
+        finally:
+            sched.shutdown()
+        return toks, len(eng._spec_execs)
+
+    base, n_spec_base = run(False)
+    assert len(base) == 24 and n_spec_base == 0
+    spec, n_spec = run(True, base=base)
+    assert spec == base, (base, spec)
+    assert n_spec >= 1          # the spec program actually dispatched
+
+
+def test_lookup_draft_matches_ngram():
+    from ollama_operator_tpu.runtime.scheduler import Request, Scheduler
+    req = Request(np.array([7, 8, 9, 7, 8, 9, 7, 8], np.int32),
+                  GREEDY, 8, frozenset())
+    assert [int(t) for t in Scheduler._lookup_draft(req, 3)] == [9, 7, 8]
+    req2 = Request(np.array([1, 2, 3], np.int32), GREEDY, 8, frozenset())
+    assert Scheduler._lookup_draft(req2, 3) is None
+    # generated tokens extend the searchable history
+    req.all_tokens = [9, 7]
+    assert [int(t) for t in Scheduler._lookup_draft(req, 2)] == [8, 9]
