@@ -69,6 +69,13 @@ class ControlPlane:
 
     def __init__(self, n_followers: int, port: int, bind: str = "0.0.0.0"):
         self.n = n_followers
+        # serializes broadcast+local-dispatch pairs: the follower replays
+        # the stream single-threaded in FIFO order, so every leader
+        # thread that dispatches SPMD programs (scheduler decode loop,
+        # HTTP embed threads, unload) must enter the stream AND the
+        # device queue in the same order — holding this lock across both
+        # is what guarantees it
+        self.dispatch_lock = threading.RLock()
         self._conns: List[socket.socket] = []
         self._lock = threading.Lock()
         self._ready = threading.Event()
@@ -130,8 +137,9 @@ class MirroredEngine:
             cp = self._cp
 
             def mirrored(*a, __value=value, __name=name, **kw):
-                cp.broadcast(("call", __name, a, kw))
-                return __value(*a, **kw)
+                with cp.dispatch_lock:
+                    cp.broadcast(("call", __name, a, kw))
+                    return __value(*a, **kw)
             return mirrored
         return value
 
@@ -184,6 +192,12 @@ def run_follower(manager, host: str, port: int,
         elif op == "unload":
             manager.unload_now()
             engine = None
+        elif op == "lm_call":
+            _, method, a = msg
+            try:
+                getattr(manager.loaded, method)(*a)
+            except Exception as e:   # noqa: BLE001
+                log(f"replayed lm {method} raised {type(e).__name__}: {e}")
         elif op == "call":
             _, method, a, kw = msg
             try:

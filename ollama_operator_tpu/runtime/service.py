@@ -167,6 +167,7 @@ class LoadedModel:
         self.ecfg = ecfg or EngineConfig()
         self.control_plane = control_plane
         self.follower = follower
+        self._unloaded = False   # set under dispatch_lock on multi-host
         self.engine = Engine(cfg, params, mesh=mesh, ecfg=self.ecfg)
         if control_plane is not None:
             # multi-host leader: every device-dispatching engine call is
@@ -472,12 +473,6 @@ class LoadedModel:
         """Mean-pooled final hidden states (ollama /api/embeddings)."""
         from ..models import decoder as D
 
-        if self.control_plane is not None:
-            # a leader-only jit would dispatch a program the followers
-            # never see and deadlock the slice mid-collective — refuse
-            # loudly until the embed path is mirrored
-            raise RuntimeError(
-                "embeddings are not supported on multi-host slices yet")
         with self._embed_lock:
             if self._embed_fn is None:
                 cfg = self.cfg
@@ -517,7 +512,10 @@ class LoadedModel:
                         valid.sum(1, keepdims=True), 1)
                     return pooled.astype(jnp.float32)
 
-                self._embed_fn = jax.jit(_embed)
+                # replicated output: multi-controller processes can
+                # only read fully-addressable (or replicated) arrays
+                self._embed_fn = jax.jit(
+                    _embed, out_shardings=self.engine._repl_sh)
         # one device dispatch per LENGTH BUCKET, not per text (round-1
         # weak #9: serial per-text dispatches — fine for probes, weak for
         # real embedding traffic): texts bucket by padded length, each
@@ -528,20 +526,39 @@ class LoadedModel:
             T = max(16, 1 << (max(len(ids), 1) - 1).bit_length())
             buckets.setdefault(T, []).append(i)
         outs: List[Optional[np.ndarray]] = [None] * len(texts)
-        for T, idxs in sorted(buckets.items()):
-            # batch dim padded to a power of two as well, so compiled
-            # program count stays O(log² (texts, len)), not O(requests)
-            n_pad = 1 << (len(idxs) - 1).bit_length()
-            toks = np.zeros((n_pad, T), np.int32)
-            lens = np.zeros((n_pad,), np.int32)
-            for row, i in enumerate(idxs):
-                ids = all_ids[i]
-                toks[row, :len(ids)] = ids
-                lens[row] = len(ids)
-            out = np.asarray(self._embed_fn(
-                self.engine.params, jnp.asarray(toks), jnp.asarray(lens)))
-            for row, i in enumerate(idxs):
-                outs[i] = out[row]
+
+        def dispatch():
+            for T, idxs in sorted(buckets.items()):
+                # batch dim padded to a power of two as well, so compiled
+                # program count stays O(log² (texts, len)), not O(requests)
+                n_pad = 1 << (len(idxs) - 1).bit_length()
+                toks = np.zeros((n_pad, T), np.int32)
+                lens = np.zeros((n_pad,), np.int32)
+                for row, i in enumerate(idxs):
+                    ids = all_ids[i]
+                    toks[row, :len(ids)] = ids
+                    lens[row] = len(ids)
+                out = self.engine._fetch(self._embed_fn(
+                    self.engine.params, self.engine._gr(toks),
+                    self.engine._gr(lens)))
+                for row, i in enumerate(idxs):
+                    outs[i] = out[row]
+
+        cp = self.control_plane
+        if cp is None:
+            dispatch()
+        else:
+            # followers replay embed() with the same texts — bucketing and
+            # the jit body are deterministic, so the SPMD programs line
+            # up. The dispatch lock keeps the broadcast AND the local
+            # device dispatches atomic against the decode loop's mirrored
+            # calls (and against unload), preserving the follower's FIFO
+            # replay order on the leader's device queue.
+            with cp.dispatch_lock:
+                if self._unloaded:
+                    raise RuntimeError("model unloaded")
+                cp.broadcast(("lm_call", "embed", (list(texts),)))
+                dispatch()
         return np.stack(outs)
 
     def unload(self):
@@ -557,7 +574,12 @@ class LoadedModel:
                 if t is not None and t.is_alive():
                     t.join()
         if self.control_plane is not None:
-            self.control_plane.broadcast(("unload",))
+            # under the dispatch lock: an embed holding it finishes its
+            # dispatches first; embeds arriving after see _unloaded and
+            # refuse instead of dispatching into a dead world
+            with self.control_plane.dispatch_lock:
+                self._unloaded = True
+                self.control_plane.broadcast(("unload",))
         METRICS.remove_gauge("tpu_model_active_slots")
         METRICS.remove_gauge("tpu_model_queue_depth")
         if self.engine.paged:

@@ -331,6 +331,16 @@ def test_multihost_model_cr_serves(tmp_path):
         res = json.loads(urllib.request.urlopen(req, timeout=300).read())
         assert res.get("done") is True and res.get("response"), res
 
+        # embeddings are mirrored to the followers too (the embed jit is
+        # its own SPMD program — round 3 first refused it with a 501)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/api/embeddings",
+            data=json.dumps({"model": image,
+                             "prompt": "hello world"}).encode(),
+            headers={"Content-Type": "application/json"})
+        emb = json.loads(urllib.request.urlopen(req, timeout=300).read())
+        assert len(emb["embedding"]) > 0
+
         # it must actually be a 2-process world serving one sharded model,
         # not two independent servers
         leader = kubelet.procs["ollama-model-tiny"]

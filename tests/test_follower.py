@@ -1,0 +1,101 @@
+"""Multi-host control plane unit tier (runtime/follower.py): framing,
+FIFO broadcast, broadcast-before-execute ordering, address resolution.
+The full 2-process serving e2e lives in
+tests/test_compose_e2e.py::test_multihost_model_cr_serves."""
+
+import socket
+import threading
+
+import numpy as np
+
+from ollama_operator_tpu.runtime import follower as F
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_framing_roundtrip():
+    a, b = socket.socketpair()
+    msgs = [("load", "m:latest"),
+            ("call", "admit", (np.arange(5, dtype=np.int32),), {}),
+            ("lm_call", "embed", (["x" * 5000],)),
+            ("unload",)]
+    for m in msgs:
+        F._send(a, m)
+    for m in msgs:
+        got = F._recv(b)
+        assert got[0] == m[0]
+        if m[0] == "call":
+            np.testing.assert_array_equal(got[2][0], m[2][0])
+    a.close()
+    try:
+        F._recv(b)
+        raise AssertionError("expected ConnectionError on closed stream")
+    except ConnectionError:
+        pass
+    b.close()
+
+
+def test_control_plane_fifo_and_ready_gate():
+    port = _free_port()
+    cp = F.ControlPlane(2, port, bind="127.0.0.1")
+    sent = []
+
+    def producer():
+        for i in range(50):
+            cp.broadcast(("call", "decode_n", (i,), {}))
+            sent.append(i)
+
+    t = threading.Thread(target=producer)
+    t.start()
+    # broadcast must BLOCK until both followers join (a call dispatched
+    # into a partial world would desync the SPMD programs)
+    assert not sent, "broadcast ran before the follower set was complete"
+    c1 = socket.create_connection(("127.0.0.1", port))
+    assert not sent
+    c2 = socket.create_connection(("127.0.0.1", port))
+    t.join(timeout=10)
+    assert len(sent) == 50
+    for conn in (c1, c2):
+        got = [F._recv(conn)[2][0] for _ in range(50)]
+        assert got == list(range(50))      # FIFO, no loss, per follower
+        conn.close()
+    cp.close()
+
+
+def test_mirrored_engine_broadcasts_before_execute():
+    events = []
+
+    class FakeCP:
+        dispatch_lock = threading.RLock()
+
+        def broadcast(self, msg):
+            events.append(("bcast", msg[1]))
+
+    class FakeEngine:
+        n_slots = 4
+
+        def decode_n(self, n=None):
+            events.append(("exec", "decode_n"))
+            return "toks"
+
+        def admissible(self, n):
+            return True
+
+    me = F.MirroredEngine(FakeEngine(), FakeCP())
+    assert me.decode_n(8) == "toks"
+    assert events == [("bcast", "decode_n"), ("exec", "decode_n")]
+    # non-mirrored attributes delegate without broadcasting
+    assert me.n_slots == 4 and me.admissible(3) is True
+    assert len(events) == 2
+
+
+def test_control_address_resolution():
+    assert F.control_address({"TPU_DIST_CONTROL": "sts-0.svc:8477"}) == \
+        ("sts-0.svc", 8477)
+    assert F.control_address(
+        {"TPU_DIST_COORDINATOR": "sts-0.svc:8476"}) == ("sts-0.svc", 8477)
+    assert F.control_address({}) is None
