@@ -75,7 +75,7 @@ def _paged_kernel(lay_ref, len_ref, tbl_ref, q_ref, k_ref, v_ref, o_ref,
             preferred_element_type=jnp.float32) * scale       # [Gp, ps]
         if quant:
             # per-position k scale: lane-aligned broadcast over the scores
-            s = s * ks_ref[0, 0, 0, :][None, :]
+            s = s * ks_ref[0, 0, 0, 0, :][None, :]
         s = softcap_scores(s, softcap)
         Gp = s.shape[0]
         k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (Gp, ps), 1)
@@ -92,7 +92,7 @@ def _paged_kernel(lay_ref, len_ref, tbl_ref, q_ref, k_ref, v_ref, o_ref,
         vb = v_ref[0, 0, 0, :, :]                             # [ps, hd]
         if quant:
             # fold the per-position v scale into p (lane-aligned again)
-            p = p * vs_ref[0, 0, 0, :][None, :]
+            p = p * vs_ref[0, 0, 0, 0, :][None, :]
             vb = vb.astype(jnp.float32)
             acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
                 p, vb, (((1,), (0,)), ((), ())),
@@ -147,11 +147,6 @@ def paged_decode_attention(q, k_pool, v_pool, layer, tables, lengths,
         pg = tbl_ref[b, jnp.minimum(ki, last)]
         return (lay_ref[0], pg, h, 0, 0)
 
-    def s_index(b, h, ki, lay_ref, len_ref, tbl_ref):
-        last = len_ref[b] // ps
-        pg = tbl_ref[b, jnp.minimum(ki, last)]
-        return (lay_ref[0], pg, h, 0)
-
     kernel = functools.partial(
         _paged_kernel, scale=scale, softcap=softcap, window=sliding_window,
         ps=ps, nblk=nblk, quant=quant)
@@ -171,9 +166,16 @@ def paged_decode_attention(q, k_pool, v_pool, layer, tables, lengths,
                 acc_ref, m_ref, l_ref, scale=scale, softcap=softcap,
                 window=sliding_window, ps=ps, nblk=nblk, quant=True,
                 ks_ref=ks_ref, vs_ref=vs_ref)
-        in_specs += [pl.BlockSpec((1, 1, 1, ps), s_index),
-                     pl.BlockSpec((1, 1, 1, ps), s_index)]
-        args += [k_pool["s"], v_pool["s"]]
+        # scales ride as [L, P, KvH, 1, ps]: the unit axis makes the block's
+        # trailing dims (1, ps) each EQUAL to their array dim, satisfying
+        # the TPU (8, 128)-tiling rule, and keeps ps on lanes so the
+        # broadcast over the score matrix needs no relayout. (The 4D
+        # (1, 1, 1, ps) spec lowers fine in interpret mode but is rejected
+        # by the real Mosaic lowering: block dim 1 over KvH.)
+        in_specs += [pl.BlockSpec((1, 1, 1, 1, ps), kv_index),
+                     pl.BlockSpec((1, 1, 1, 1, ps), kv_index)]
+        args += [k_pool["s"].reshape(L, P, KvH, 1, ps),
+                 v_pool["s"].reshape(L, P, KvH, 1, ps)]
 
     out = pl.pallas_call(
         kernel,
