@@ -77,12 +77,12 @@ def new_server_container(
         env.append({"name": "TPU_MAX_SEQ_LEN", "value": str(context_length)})
     if quantization:
         # CRD quantization -> the server's weight-dtype knob (CRD spells
-        # bf16, the server bfloat16); int8 also turns on the quantized KV
-        # cache (the pairing every int8 config wants: half the weight AND
-        # half the cache traffic)
+        # bf16, the server bfloat16); int8/int4 also turn on the quantized
+        # KV cache (the pairing every quantized config wants: half/quarter
+        # the weight AND half the cache traffic)
         dtype = {"bf16": "bfloat16"}.get(quantization, quantization)
         env.append({"name": "TPU_ENGINE_DTYPE", "value": dtype})
-        if quantization == "int8":
+        if quantization in ("int8", "int4"):
             env.append({"name": "TPU_KV_DTYPE", "value": "int8"})
     if placement is not None:
         # a TPU pod that silently fell back to CPU must crash, not serve

@@ -1,15 +1,21 @@
-"""Fused dequant-matmul Pallas kernel (weight-only int8, W8A16).
+"""Fused dequant-matmul Pallas kernels (weight-only int8/int4).
 
-Decode matmuls are HBM-bound: the win is streaming int8 weight tiles
-(half the bytes of bf16) into VMEM and dequantizing in-register right
-before the MXU dot — the bf16 weight tensor never exists in HBM. The
-XLA grouped-einsum path (ops/quant.qmm) is the portable fallback; this
-kernel is the single-chip fast path, dispatched through the same
-kernels switch as the flash-attention kernels (ops/attention.py).
+Decode matmuls are HBM-bound: the win is streaming quantized weight
+tiles (half / a quarter of bf16's bytes) into VMEM and dequantizing
+in-register right before the MXU dot — the bf16 weight tensor never
+exists in HBM. The XLA grouped-einsum paths (ops/quant.qmm / qmm4) are
+the portable fallbacks; these kernels are the single-chip fast path,
+dispatched through the same kernels switch as the flash-attention
+kernels (ops/attention.py).
 
-Grid (oi, ki), ki innermost: each step loads an (bk, bo) int8 tile plus
-its (bk/g, bo) scales, dequantizes to one bf16 tile in VMEM, and
-accumulates x_tile @ w_tile into an f32 scratch that persists across ki.
+Grid (oi, ki), ki innermost: each step loads a (bk, bo) int8 tile (or
+(bk/2, bo) packed-nibble tile) plus its (bk/g, bo) scales, dequantizes
+to one tile in VMEM, and accumulates x_tile @ w_tile into an f32
+scratch that persists across ki. The int4 unpack exploits the
+group-local packing (ops/quant.pack_int4): low/high nibble planes are
+whole half-groups, so rebuilding weight rows is one sublane-granular
+concat per tile, and each packed byte is read from HBM exactly once —
+the traffic halving the XLA int4 path can't get.
 """
 
 from __future__ import annotations
@@ -21,7 +27,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ..quant import GROUP, qmm
+from ..quant import GROUP, qmm, qmm4
 
 _BLOCKS = (512, 256, 128, 64, 32)
 
@@ -93,4 +99,74 @@ def qmm_pallas(x: jax.Array, q: jax.Array, s: jax.Array,
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(x, q, s.astype(jnp.float32))
+    return out[:B]
+
+
+def _kernel4(x_ref, q_ref, s_ref, o_ref, acc_ref, *, nk: int, g: int):
+    ki = pl.program_id(1)
+
+    @pl.when(ki == 0)
+    def init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    xb = x_ref[...]                                   # [B, bk] bf16
+    qb = q_ref[...]                                   # [bk/2, bo] uint8
+    sb = s_ref[...]                                   # [bk/g, bo] f32
+    bkp, bo = qb.shape
+    h = g // 2
+    bi = qb.astype(jnp.int32).reshape(bkp // h, h, bo)
+    lo = (bi & 0xF) - 8                               # rows [0, g/2) of
+    hi = (bi >> 4) - 8                                # each group; [g/2, g)
+    w = jnp.concatenate([lo, hi], axis=1).astype(jnp.float32)
+    w = (w * sb[:, None, :]).reshape(2 * bkp, bo)
+    acc_ref[...] += jax.lax.dot_general(
+        xb.astype(jnp.float32), w, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def flush():
+        o_ref[...] = acc_ref[...]
+
+
+def qmm4_pallas(x: jax.Array, q4: jax.Array, s: jax.Array,
+                interpret: bool = False) -> jax.Array:
+    """x [B, K] @ dequant(q4 [K/2, O] packed, s [K/g, O]) → [B, O] f32.
+
+    Falls back to the XLA grouped path when the shapes don't tile cleanly
+    (odd dims, tiny K/O) — callers never need to care.
+    """
+    B, K = x.shape
+    Kp, O = q4.shape
+    assert 2 * Kp == K, (Kp, K)
+    G = s.shape[0]
+    g = K // G
+    # bk % 2g keeps the packed tile's sublane count a multiple of g —
+    # no partial groups, and the uint8 tile stays (32, 128)-tileable
+    bk = _pick(K, 512, multiple=2 * g) if g in (16, 32, 64, 128) else None
+    bo = _pick(O, 512)
+    lanes_ok = interpret or (O % 128 == 0 and bo is not None and
+                             bo % 128 == 0)
+    if bk is None or bo is None or not lanes_ok:
+        return qmm4(x, {"q4": q4, "s": s}, out_dtype=jnp.float32)
+
+    Bp = max(8, B)
+    if Bp != B:
+        x = jnp.pad(x, ((0, Bp - B), (0, 0)))
+    nk = K // bk
+
+    out = pl.pallas_call(
+        functools.partial(_kernel4, nk=nk, g=g),
+        grid=(O // bo, nk),
+        in_specs=[
+            pl.BlockSpec((Bp, bk), lambda oi, ki: (0, ki)),
+            pl.BlockSpec((bk // 2, bo), lambda oi, ki: (ki, oi)),
+            pl.BlockSpec((bk // g, bo), lambda oi, ki: (ki, oi)),
+        ],
+        out_specs=pl.BlockSpec((Bp, bo), lambda oi, ki: (0, oi)),
+        out_shape=jax.ShapeDtypeStruct((Bp, O), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((Bp, bo), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(x, q4, s.astype(jnp.float32))
     return out[:B]

@@ -1,4 +1,4 @@
-"""Weight-only int8 quantization for serving (W8A16-style).
+"""Weight-only int8/int4 quantization for serving (W8A16 / W4A16).
 
 The reference serves GGUF-quantized weights through llama.cpp's CPU/GPU
 dequant kernels inside the delegated ollama image (SURVEY.md §2.2). The
@@ -9,18 +9,31 @@ fit comfortably across a v5e-16 (BASELINE.md north star).
 
 Representation: a quantized linear is a dict leaf in the params pytree —
 
-    {"q": int8 [..., K, O],  "s": f32 [..., K/g, O]}
+    int8: {"q":  int8  [..., K,   O], "s": f32 [..., K/g, O]}
+    int4: {"q4": uint8 [..., K/2, O], "s": f32 [..., K/g, O]}
 
 symmetric, group-wise along the contracted (input) axis with group size
-``g`` = 32, llama.cpp's q8_0 block size — so transcoding q8_0 weights onto
-this grid adds (almost) no error beyond the original quantization, and
-finer GGUF grids (q4_*) are strictly refined by it.
+``g`` = 32, llama.cpp's q8_0/q4_0 block size — so transcoding q8_0 weights
+onto the int8 grid adds (almost) no error beyond the original quantization,
+and q4-family weights land on the int4 grid with only the clip of q4_0's
+lone -8 code (we keep the symmetric [-7, 7] range).
 
-Two matmul paths:
-- ``qmm``: pure-XLA grouped partial einsum — correct on any backend and
-  under GSPMD (the int8→bf16 convert fuses into the dot's operand stream).
-- ``ops/pallas/quant.py``: fused dequant-matmul kernel for single-chip
-  decode, dispatched via the same kernels switch as attention.
+int4 packing is **group-local**: each group of 32 rows packs into 16 bytes
+where byte j holds row j in its low nibble and row j+16 in its high nibble
+(both biased by +8 into [1, 15]). Group-local packing means any K-tile
+that is a multiple of the group unpacks with a sublane-granular concat —
+no cross-tile shuffles — which is what the pallas kernel wants.
+
+Matmul paths:
+- ``qmm`` / ``qmm4``: pure-XLA grouped partial einsums — correct on any
+  backend and under GSPMD (the convert fuses into the dot's operand
+  stream). The int4 decode form runs two half-group dots over the same
+  packed bytes, so its HBM traffic matches int8's — the *capacity* win
+  (70B int4 ≈ 34.5 GB) is unconditional, the *bandwidth* win needs the
+  kernel below.
+- ``ops/pallas/quant.py``: fused dequant-matmul kernels (int8 and int4);
+  the int4 kernel reads each packed byte once, i.e. half int8's weight
+  traffic.
 """
 
 from __future__ import annotations
@@ -41,7 +54,11 @@ QUANT_TOP_KEYS = ("lm_head",)
 
 
 def is_quantized(w: Any) -> bool:
-    return isinstance(w, dict) and "q" in w and "s" in w
+    return isinstance(w, dict) and ("q" in w or "q4" in w) and "s" in w
+
+
+def is_int4(w: Any) -> bool:
+    return isinstance(w, dict) and "q4" in w
 
 
 def quantize_groupwise(w, group: int = GROUP) -> Dict[str, Any]:
@@ -95,13 +112,128 @@ def _quantize_jax(w: jax.Array, group: int = GROUP) -> Dict[str, Any]:
     return _quantize_jax_impl(w)
 
 
+def pack_int4(q, bias: int = 8):
+    """Pack int codes in [-7, 7] ([..., K, O]) into group-local nibbles
+    ([..., K/2, O] uint8): within each 32-row group, byte j = row j
+    (low nibble) | row j+16 (high nibble), both biased by +8."""
+    xp = jnp if isinstance(q, jax.Array) else np
+    *lead, K, O = q.shape
+    assert K % GROUP == 0
+    qr = (q.reshape(*lead, K // GROUP, GROUP, O) + bias).astype(xp.uint8)
+    lo, hi = qr[..., :GROUP // 2, :], qr[..., GROUP // 2:, :]
+    return (lo | (hi << 4)).reshape(*lead, K // 2, O)
+
+
+def unpack_int4(q4, bias: int = 8):
+    """Inverse of pack_int4: [..., K/2, O] uint8 → int8 [..., K, O]."""
+    xp = jnp if isinstance(q4, jax.Array) else np
+    *lead, Kp, O = q4.shape
+    h = GROUP // 2
+    assert Kp % h == 0
+    b = q4.reshape(*lead, Kp // h, h, O)
+    lo = (b & 0xF).astype(xp.int8) - bias
+    hi = (b >> 4).astype(xp.int8) - bias
+    return xp.concatenate([lo, hi], axis=-2).reshape(*lead, 2 * Kp, O)
+
+
+def quantize_groupwise_int4(w, group: int = GROUP) -> Dict[str, Any]:
+    """Symmetric int4 per ``group`` along the input axis, nibble-packed.
+
+    w [..., K, O] float → {"q4" uint8 [..., K/2, O], "s" f32 [..., K/g, O]}.
+    Codes clip to [-7, 7]: q4_0's asymmetric -8 code costs one extra
+    grid point of error on transcode, and symmetry keeps dequant a pure
+    multiply (no zero-point correction term in the matmuls).
+    """
+    assert group == GROUP, "int4 packing is specialised to the group size"
+    if isinstance(w, jax.Array):
+        return _quantize_jax_int4(w)
+    w = np.asarray(w)
+    *lead, K, O = w.shape
+    assert K % group == 0, f"group {group} must divide in-dim {K}"
+    if lead:
+        q4 = np.empty((*lead, K // 2, O), np.uint8)
+        s = np.empty((*lead, K // group, O), np.float32)
+        flat_w = w.reshape(-1, K, O)
+        flat_q = q4.reshape(-1, K // 2, O)
+        flat_s = s.reshape(-1, K // group, O)
+        for i in range(flat_w.shape[0]):
+            sl = quantize_groupwise_int4(flat_w[i], group)
+            flat_q[i], flat_s[i] = sl["q4"], sl["s"]
+        return {"q4": q4, "s": s}
+    w = np.asarray(w, np.float32)
+    wr = w.reshape(K // group, group, O)
+    amax = np.abs(wr).max(axis=-2, keepdims=True)
+    s = (amax / 7.0).astype(np.float32)
+    q = np.rint(np.where(s > 0, wr / np.maximum(s, 1e-30), 0.0))
+    q = np.clip(q, -7, 7).astype(np.int8).reshape(K, O)
+    return {"q4": pack_int4(q), "s": s[:, 0, :]}
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _quantize_jax_int4_impl(w):
+    *lead, K, O = w.shape
+    g = GROUP
+    wr = w.astype(jnp.float32).reshape(*lead, K // g, g, O)
+    amax = jnp.max(jnp.abs(wr), axis=-2, keepdims=True)
+    s = amax / 7.0
+    q = jnp.round(jnp.where(s > 0, wr / jnp.maximum(s, 1e-30), 0.0))
+    q = jnp.clip(q, -7, 7).astype(jnp.int8).reshape(*lead, K, O)
+    return {"q4": pack_int4(q), "s": s[..., 0, :]}
+
+
+def _quantize_jax_int4(w: jax.Array) -> Dict[str, Any]:
+    assert w.shape[-2] % GROUP == 0
+    return _quantize_jax_int4_impl(w)
+
+
 def dequantize_groupwise(qw: Dict[str, Any]) -> jnp.ndarray:
-    """Reference inverse of quantize_groupwise (f32)."""
-    q, s = jnp.asarray(qw["q"]), jnp.asarray(qw["s"])
+    """Reference inverse of quantize_groupwise[_int4] (f32)."""
+    if is_int4(qw):
+        q = unpack_int4(jnp.asarray(qw["q4"]))
+    else:
+        q = jnp.asarray(qw["q"])
+    s = jnp.asarray(qw["s"])
     *lead, K, O = q.shape
     G = s.shape[-2]
     qr = q.reshape(*lead, G, K // G, O).astype(jnp.float32)
     return (qr * s[..., :, None, :]).reshape(*lead, K, O)
+
+
+def qmm4(x: jax.Array, qw: Dict[str, Any],
+         out_dtype: Optional[Any] = None) -> jax.Array:
+    """x [..., K] @ dequant(int4 qw) — XLA formulation (portable/GSPMD).
+
+    Same N-split as qmm. The decode form dots the two nibble planes
+    separately against the matching half-group activation slices —
+    group-local packing makes those static slices, no gather — so the
+    packed bytes are each read twice (int8-equivalent traffic); the
+    pallas kernel is the half-traffic path.
+    """
+    q4, s = qw["q4"], qw["s"]
+    Kp, O = q4.shape
+    K = 2 * Kp
+    G = s.shape[0]
+    g = K // G
+    h = g // 2
+    N = 1
+    for d in x.shape[:-1]:
+        N *= d
+    if N > 16:
+        w = (unpack_int4(q4).reshape(G, g, O).astype(x.dtype)
+             * s[:, None, :].astype(x.dtype)).reshape(K, O)
+        y = jnp.einsum("...k,ko->...o", x, w,
+                       preferred_element_type=jnp.float32)
+        return y.astype(out_dtype or x.dtype)
+    xr = x.reshape(*x.shape[:-1], G, g)
+    b = q4.reshape(G, h, O)
+    lo = ((b & 0xF).astype(jnp.int8) - 8).astype(x.dtype)
+    hi = ((b >> 4).astype(jnp.int8) - 8).astype(x.dtype)
+    partial = (jnp.einsum("...Gg,Ggo->...Go", xr[..., :h], lo,
+                          preferred_element_type=jnp.float32)
+               + jnp.einsum("...Gg,Ggo->...Go", xr[..., h:], hi,
+                            preferred_element_type=jnp.float32))
+    y = jnp.einsum("...Go,Go->...o", partial, s)
+    return y.astype(out_dtype or x.dtype)
 
 
 def qmm(x: jax.Array, qw: Dict[str, Any],
@@ -154,28 +286,36 @@ def matmul(x: jax.Array, w: Any, out_dtype: Optional[Any] = None,
         y = x @ w
         return y.astype(out_dtype) if out_dtype is not None else y
     if kernels in ("pallas", "interpret"):
-        from .pallas.quant import qmm_pallas
+        from .pallas.quant import qmm4_pallas, qmm_pallas
         lead = x.shape[:-1]
         x2 = x.reshape(-1, x.shape[-1])
-        y = qmm_pallas(x2, w["q"], w["s"], interpret=(kernels == "interpret"))
+        if is_int4(w):
+            y = qmm4_pallas(x2, w["q4"], w["s"],
+                            interpret=(kernels == "interpret"))
+        else:
+            y = qmm_pallas(x2, w["q"], w["s"],
+                           interpret=(kernels == "interpret"))
         return y.reshape(*lead, -1).astype(out_dtype or x.dtype)
-    return qmm(x, w, out_dtype)
+    return (qmm4 if is_int4(w) else qmm)(x, w, out_dtype)
 
 
 def quantize_params(params: Dict[str, Any], group: int = GROUP,
-                    keys_layer=QUANT_LAYER_KEYS, keys_top=QUANT_TOP_KEYS
-                    ) -> Dict[str, Any]:
-    """Convert the big matmul leaves of a decoder param tree to int8.
+                    keys_layer=QUANT_LAYER_KEYS, keys_top=QUANT_TOP_KEYS,
+                    bits: int = 8) -> Dict[str, Any]:
+    """Convert the big matmul leaves of a decoder param tree to int8
+    (``bits=8``) or packed int4 (``bits=4``).
 
     Works on numpy (host) or jax (on-device) arrays; stacked [L, ...]
     layer leaves quantize along their input axis, which is second-to-last
     either way.
 
     On-device (jax) sources are DONATED leaf by leaf — each bf16 leaf's
-    HBM is released as its int8 replacement materialises, so peak memory
-    is the bf16 tree + one leaf, never bf16 + int8 trees together (a 7B
-    bf16 tree alone is 13.4 GB of a v5e chip's 16).
+    HBM is released as its quantized replacement materialises, so peak
+    memory is the bf16 tree + one leaf, never bf16 + quantized trees
+    together (a 7B bf16 tree alone is 13.4 GB of a v5e chip's 16).
     """
+    assert bits in (8, 4), bits
+    quant = quantize_groupwise if bits == 8 else quantize_groupwise_int4
     out: Dict[str, Any] = {}
     for k in list(params.keys()):
         v = params[k]
@@ -183,12 +323,12 @@ def quantize_params(params: Dict[str, Any], group: int = GROUP,
             lo = {}
             for lk in list(v.keys()):
                 if lk in keys_layer:
-                    lo[lk] = quantize_groupwise(v.pop(lk), group)
+                    lo[lk] = quant(v.pop(lk), group)
                 else:
                     lo[lk] = v[lk]
             out[k] = lo
         elif k in keys_top:
-            out[k] = quantize_groupwise(params.pop(k), group)
+            out[k] = quant(params.pop(k), group)
         else:
             out[k] = v
     return out

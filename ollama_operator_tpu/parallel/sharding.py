@@ -104,12 +104,15 @@ def resolve_moe_impl(cfg: ModelConfig, mesh: Optional[Mesh]) -> str:
 
 
 def _leaf_spec(spec: P, v: Any, mesh: Optional[Mesh]):
-    """A quantized dict leaf {"q", "s"} shares its dense spec: q has the
-    dense shape and the group axis of s is K/g at the same position, so the
-    same PartitionSpec usually partitions both. When a scale dim is too
-    small to divide its mesh axis (tiny K/g), that axis replicates for s
-    only — XLA still partials the dot over the sharded q rows."""
-    from ..ops.quant import is_quantized
+    """A quantized dict leaf {"q"|"q4", "s"} shares its dense spec: q has
+    the dense shape (q4 the packed K/2 at the same position) and the group
+    axis of s is K/g at the same position, so the same PartitionSpec
+    usually partitions both. When a scale dim is too small to divide its
+    mesh axis (tiny K/g), that axis replicates for s only — XLA still
+    partials the dot over the sharded q rows. int4 leaves additionally
+    need the shard boundary to respect whole packing groups; qmm4's
+    (G, g/2, O) reshape enforces that at trace time."""
+    from ..ops.quant import is_int4, is_quantized
     if not is_quantized(v):
         return spec
     s_shape = v["s"].shape
@@ -117,7 +120,7 @@ def _leaf_spec(spec: P, v: Any, mesh: Optional[Mesh]):
     for i, ax in enumerate(spec):
         size = mesh.shape.get(ax, 1) if (mesh is not None and ax) else 1
         s_spec.append(ax if ax and s_shape[i] % size == 0 else None)
-    return {"q": spec, "s": P(*s_spec)}
+    return {("q4" if is_int4(v) else "q"): spec, "s": P(*s_spec)}
 
 
 def params_pspec_tree(params: Dict[str, Any],

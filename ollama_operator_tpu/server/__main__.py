@@ -33,11 +33,12 @@ def main(argv=None):
                    help="registry/store mode: no inference engine")
     p.add_argument("--dtype", default=os.environ.get("TPU_ENGINE_DTYPE")
                    or None,
-                   choices=["bfloat16", "bf16", "float32", "int8"],
+                   choices=["bfloat16", "bf16", "float32", "int8", "int4"],
                    help="weight dtype (default: bfloat16 on TPU, float32 "
                         "on CPU — XLA's CPU thunk runtime has no bf16 "
                         "dots, so a CPU pod defaulting to bf16 would 500 "
-                        "on its first generate)")
+                        "on its first generate; int4 packs two nibbles "
+                        "per byte — a quarter of bf16's HBM)")
     p.add_argument("--kv-dtype", default=os.environ.get("TPU_KV_DTYPE")
                    or None,
                    choices=["bfloat16", "float32", "int8"],

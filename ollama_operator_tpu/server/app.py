@@ -316,6 +316,7 @@ class ModelManager:
             digest = self.store.model_digest(name) or ""
             import ml_dtypes
             dt = {"bfloat16": ml_dtypes.bfloat16, "int8": ml_dtypes.bfloat16,
+                  "int4": ml_dtypes.bfloat16,
                   "float32": np.float32}[self.engine_dtype]
             import jax
             if (jax.default_backend() == "cpu"
@@ -348,11 +349,13 @@ class ModelManager:
                 self.loaded = None
             import jax.numpy as jnp
             import jax
-            if self.engine_dtype == "int8":
-                # weight-only quantization: int8 weights stay quantized in
-                # HBM; dequant fuses into the matmuls (ops/quant.py)
+            if self.engine_dtype in ("int8", "int4"):
+                # weight-only quantization: int8/packed-int4 weights stay
+                # quantized in HBM; dequant fuses into the matmuls
+                # (ops/quant.py)
                 from ..ops.quant import quantize_params
-                params = quantize_params(params)
+                params = quantize_params(
+                    params, bits=4 if self.engine_dtype == "int4" else 8)
             params = jax.tree_util.tree_map(jnp.asarray, params)
             vision = None
             proj_path = layers.get(MT_PROJECTOR)
