@@ -526,6 +526,16 @@ def forward_with_cache(params: Params, cfg: ModelConfig, tokens: jax.Array,
 TRASH_PAGE = 0
 
 
+def _pad_hd(x, hd_pool: int):
+    """Zero-pad the trailing head dim to the pool's 128-lane-padded width
+    (engine.py pads the POOL so XLA never materialises padded temp copies
+    of it; zeros are inert in both the score and output dots)."""
+    d = hd_pool - x.shape[-1]
+    if d == 0:
+        return x
+    return jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, d)])
+
+
 def _paged_scatter(pool, i, vals, pg, off):
     """Write ``vals`` [B, KvH, T(, hd)] into layer ``i`` of a page pool at
     (page ``pg``, offset ``off``) per (row, position); pg/off [B, T]."""
@@ -571,15 +581,15 @@ def paged_insert(cfg: ModelConfig, k_pool, v_pool, ks, vs, table_row,
 
     if quant:
         from ..ops import quant_cache as QC
-        kq, ksc = QC.quantize_kv(ks)
-        vq, vsc = QC.quantize_kv(vs)
-        k_pool = {"q": put(k_pool["q"], kq[:, 0]),
+        kq, ksc = QC.quantize_kv(ks)      # quantize over the TRUE hd,
+        vq, vsc = QC.quantize_kv(vs)      # then pad codes with zeros
+        k_pool = {"q": put(k_pool["q"], _pad_hd(kq[:, 0], hd)),
                   "s": put(k_pool["s"], ksc[:, 0])}
-        v_pool = {"q": put(v_pool["q"], vq[:, 0]),
+        v_pool = {"q": put(v_pool["q"], _pad_hd(vq[:, 0], hd)),
                   "s": put(v_pool["s"], vsc[:, 0])}
     else:
-        k_pool = put(k_pool, ks[:, 0].astype(arr.dtype))
-        v_pool = put(v_pool, vs[:, 0].astype(arr.dtype))
+        k_pool = put(k_pool, _pad_hd(ks[:, 0].astype(arr.dtype), hd))
+        v_pool = put(v_pool, _pad_hd(vs[:, 0].astype(arr.dtype), hd))
     return k_pool, v_pool
 
 
@@ -659,16 +669,22 @@ def _paged_attend(cfg: ModelConfig, q, kp, vp, i, tables, lengths, mask,
         if out is not None:
             return out
     tbl = tables[:, :attn_blocks]
+    # gather fallback: the pool hd is 128-lane padded; pad q to match
+    # (zeros are inert in the score dot) and slice the pad lanes back off
+    # the output
+    hd_q = q.shape[-1]
+    qp = _pad_hd(q, (kp["q"] if quant else kp).shape[-1])
     if quant:
         from ..ops.quant_cache import attend_hf_q
         kw = {"q": _gather_pages(kp["q"], i, tbl),
               "s": _gather_pages(kp["s"], i, tbl)}
         vw = {"q": _gather_pages(vp["q"], i, tbl),
               "s": _gather_pages(vp["s"], i, tbl)}
-        return attend_hf_q(q, kw, vw, mask, scale, cfg.attn_softcap)
+        return attend_hf_q(qp, kw, vw, mask, scale,
+                           cfg.attn_softcap)[..., :hd_q]
     kw = _gather_pages(kp, i, tbl)
     vw = _gather_pages(vp, i, tbl)
-    return attend_hf(q, kw, vw, mask, scale, cfg.attn_softcap)
+    return attend_hf(qp, kw, vw, mask, scale, cfg.attn_softcap)[..., :hd_q]
 
 
 def _scatter_kv_pools(kp, vp, i, k, v, pg_w, off_w):
@@ -678,17 +694,22 @@ def _scatter_kv_pools(kp, vp, i, k, v, pg_w, off_w):
     never drift between them."""
     quant = isinstance(kp, dict)
     arr = kp["q"] if quant else kp
+    hd_pool = arr.shape[-1]
     if quant:
         from ..ops import quant_cache as QC
-        kq, ksc = QC.quantize_kv(k)
-        vq, vsc = QC.quantize_kv(v)
-        kp = {"q": _paged_scatter(kp["q"], i, kq, pg_w, off_w),
+        kq, ksc = QC.quantize_kv(k)       # quantize over the TRUE hd,
+        vq, vsc = QC.quantize_kv(v)       # then pad codes with zeros
+        kp = {"q": _paged_scatter(kp["q"], i, _pad_hd(kq, hd_pool),
+                                  pg_w, off_w),
               "s": _paged_scatter(kp["s"], i, ksc, pg_w, off_w)}
-        vp = {"q": _paged_scatter(vp["q"], i, vq, pg_w, off_w),
+        vp = {"q": _paged_scatter(vp["q"], i, _pad_hd(vq, hd_pool),
+                                  pg_w, off_w),
               "s": _paged_scatter(vp["s"], i, vsc, pg_w, off_w)}
     else:
-        kp = _paged_scatter(kp, i, k.astype(arr.dtype), pg_w, off_w)
-        vp = _paged_scatter(vp, i, v.astype(arr.dtype), pg_w, off_w)
+        kp = _paged_scatter(kp, i, _pad_hd(k.astype(arr.dtype), hd_pool),
+                            pg_w, off_w)
+        vp = _paged_scatter(vp, i, _pad_hd(v.astype(arr.dtype), hd_pool),
+                            pg_w, off_w)
     return kp, vp
 
 

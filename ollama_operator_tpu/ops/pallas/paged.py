@@ -128,8 +128,8 @@ def paged_decode_attention(q, k_pool, v_pool, layer, tables, lengths,
     quant = isinstance(k_pool, dict)
     k_arr = k_pool["q"] if quant else k_pool
     v_arr = v_pool["q"] if quant else v_pool
-    B, T, H, hd = q.shape
-    L, P, KvH, ps, _ = k_arr.shape
+    B, T, H, hd_q = q.shape
+    L, P, KvH, ps, hd = k_arr.shape
     NBLK = tables.shape[1]
     if T != 1 or H % KvH or not _lane_ok(hd, interpret) or nblk > NBLK:
         return None
@@ -138,9 +138,12 @@ def paged_decode_attention(q, k_pool, v_pool, layer, tables, lengths,
     G = H // KvH
     Gp = max(8, -(-G // 8) * 8)
 
-    qg = q.reshape(B, KvH, G, hd)
-    if Gp != G:
-        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, Gp - G), (0, 0)))
+    qg = q.reshape(B, KvH, G, hd_q)
+    if Gp != G or hd != hd_q:
+        # group rows pad to a sublane multiple; the head dim pads to the
+        # pool's 128-lane width (engine pads the POOL; zero q lanes are
+        # inert in the score dot and the pad outputs are sliced off below)
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, Gp - G), (0, hd - hd_q)))
 
     def kv_index(b, h, ki, lay_ref, len_ref, tbl_ref):
         last = len_ref[b] // ps
@@ -198,4 +201,4 @@ def paged_decode_attention(q, k_pool, v_pool, layer, tables, lengths,
     )(jnp.reshape(layer, (1,)).astype(jnp.int32),
       lengths.astype(jnp.int32), tables.astype(jnp.int32),
       qg, *args[1:])
-    return out[:, :, :G, :].reshape(B, 1, H, hd)
+    return out[:, :, :G, :hd_q].reshape(B, 1, H, hd_q)

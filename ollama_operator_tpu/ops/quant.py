@@ -29,8 +29,8 @@ Matmul paths:
   backend and under GSPMD (the convert fuses into the dot's operand
   stream). The int4 decode form runs two half-group dots over the same
   packed bytes, so its HBM traffic matches int8's — the *capacity* win
-  (70B int4 ≈ 34.5 GB) is unconditional, the *bandwidth* win needs the
-  kernel below.
+  (~0.63 B/weight with the f32 group scales; 70B int4 ≈ 43 GB) is
+  unconditional, the *bandwidth* win needs the kernel below.
 - ``ops/pallas/quant.py``: fused dequant-matmul kernels (int8 and int4);
   the int4 kernel reads each packed byte once, i.e. half int8's weight
   traffic.

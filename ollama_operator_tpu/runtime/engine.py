@@ -230,6 +230,16 @@ class Engine:
             ps = ecfg.page_size
             self._nblk = S // ps
             n_pages = ecfg.n_pages or (B * S) // ps
+            # pool head dim padded to the 128-lane tile: an unaligned hd
+            # (phi's 80) otherwise makes XLA materialise PADDED temp
+            # copies of the whole pool per program (measured on v5e:
+            # 2x4 GB HLO temps, OOM at 32 slots). Writers zero-pad K/V;
+            # readers slice back (models/decoder.py paged section).
+            # hd=128 families (llama/qwen/mixtral) are untouched; hd<128
+            # (phi 80, tinyllama 64) pay the padding in pool bytes — on
+            # TPU the minor dim would tile to 128 anyway, but on the CPU
+            # backend (dev/kind clusters) this genuinely grows host RAM.
+            hd_pool = -(-hd // 128) * 128
             dp = self._paged_dp
             if dp > 1:
                 # pool PAGE axis sharded over dp: each shard owns an
@@ -239,11 +249,11 @@ class Engine:
                 per_shard = -(-n_pages // dp)
                 self._pt = ShardedPageTable(B, dp, per_shard, ps,
                                             self._nblk)
-                pool_shape = (L, dp * (per_shard + 1), KvH, ps, hd)
+                pool_shape = (L, dp * (per_shard + 1), KvH, ps, hd_pool)
                 pg_ax = "dp"
             else:
                 self._pt = PageTable(B, n_pages + 1, ps, self._nblk)
-                pool_shape = (L, n_pages + 1, KvH, ps, hd)
+                pool_shape = (L, n_pages + 1, KvH, ps, hd_pool)
                 pg_ax = None
             h_ax = ("tp" if (mesh is not None
                              and mesh.shape.get("tp", 1) > 1

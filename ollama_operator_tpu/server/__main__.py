@@ -38,7 +38,7 @@ def main(argv=None):
                         "on CPU — XLA's CPU thunk runtime has no bf16 "
                         "dots, so a CPU pod defaulting to bf16 would 500 "
                         "on its first generate; int4 packs two nibbles "
-                        "per byte — a quarter of bf16's HBM)")
+                        "per byte, ~0.63 B/weight with group scales)")
     p.add_argument("--kv-dtype", default=os.environ.get("TPU_KV_DTYPE")
                    or None,
                    choices=["bfloat16", "float32", "int8"],
