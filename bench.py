@@ -289,15 +289,16 @@ def measure(jax, *, model: str, dtype: str, slots: int, steps: int,
             cfg, jax.random.key(0),
             dtype=jnp.float32 if on_cpu else jnp.bfloat16)
         jax.block_until_ready(params)
-        if dtype == "int8":
+        if dtype in ("int8", "int4"):
             if cfg.n_experts:
                 dtype = "bfloat16"   # MoE expert stacks serve dense
             else:
-                # weight-only int8 serving (ops/quant.py): the production
-                # default — decode is HBM-bound, so halving weight bytes
-                # cuts the weight-streaming share of the step
+                # weight-only quantized serving (ops/quant.py): decode is
+                # HBM-bound, so weight bytes set the step floor — int8
+                # halves bf16's, int4 packs two codes per byte
                 from ollama_operator_tpu.ops.quant import quantize_params
-                params = quantize_params(params)   # on-device, jitted
+                params = quantize_params(
+                    params, bits=4 if dtype == "int4" else 8)
                 jax.block_until_ready(params)
         param_bytes = sum(x.nbytes for x in jax.tree.leaves(params))
         log(f"params init ({cfg.n_params/1e9:.2f}B, serve dtype={dtype}, "
@@ -316,6 +317,14 @@ def measure(jax, *, model: str, dtype: str, slots: int, steps: int,
         mesh = make_mesh(MeshPlan.for_devices(len(devs), tp=tp))
         log(f"mesh: {dict(mesh.shape)}")
 
+    if dtype == "int4" and not on_cpu and mesh is None:
+        # single-device int4: the fused pallas kernel is the only matmul
+        # path that reads each packed byte once (see ModelConfig.mm_kernels;
+        # OLLAMA_TPU_KERNELS=xla stays the escape hatch)
+        from ollama_operator_tpu.ops.attention import resolve_kernels
+        if resolve_kernels(cfg.kernels) != "xla":
+            import dataclasses
+            cfg = dataclasses.replace(cfg, mm_kernels="pallas")
     eng = Engine(cfg, params, mesh=mesh,
                  ecfg=EngineConfig(
                      max_slots=slots, max_seq_len=seq, decode_chunk=chunk,
@@ -489,15 +498,20 @@ def main() -> None:
                  prompt_len=128, paged=False, mixed=False),
             dict(model="phi", dtype="int8", slots=32, steps=64, seq=1024,
                  prompt_len=128, paged=True, mixed=True),
+            # MHA decode-kernel A/B vs capture 1 (same config, kernel on;
+            # params-cache hit): settles whether the head-tiled grid
+            # retires the einsum bail
+            dict(model="phi", dtype="int8", slots=8, steps=64, seq=1024,
+                 prompt_len=128, paged=False, mixed=False,
+                 env={"TPU_MHA_KERNEL": "1"}),
+            # int4 A/B vs capture 1: packed nibbles through the fused
+            # pallas qmm — the weight-streaming floor halves again
+            dict(model="phi", dtype="int4", slots=8, steps=64, seq=1024,
+                 prompt_len=128, paged=False, mixed=False),
             dict(model="tinyllama", dtype="int8", slots=8, steps=64,
                  seq=1024, prompt_len=128, paged=False, mixed=False),
             dict(model="tinyllama", dtype="int8", slots=32, steps=64,
                  seq=1024, prompt_len=128, paged=True, mixed=True),
-            # MHA decode-kernel A/B vs capture 1 (same config, kernel on):
-            # settles whether the head-tiled grid retires the einsum bail
-            dict(model="phi", dtype="int8", slots=8, steps=64, seq=1024,
-                 prompt_len=128, paged=False, mixed=False,
-                 env={"TPU_MHA_KERNEL": "1"}),
         ]
 
     captures = []

@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""One-shot real-TPU lowering check for the pallas kernels.
+
+Interpret mode skips Mosaic's TPU lowering entirely — round 3 learned
+that the hard way twice (the paged kernel's 4D scale BlockSpec and the
+hd=80 pool-copy OOM both only surfaced on the real chip). This script
+AOT-compiles the serving kernels on the axon chip at shape-representative
+(but small) configs in ~2 minutes, WITHOUT running a full bench capture:
+
+    python hack/tpu_kernel_check.py
+
+Run it between probe attempts (the axon tunnel is single-client: never
+run it while bench.py holds the chip).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def check(name, fn, *args):
+    try:
+        jax.jit(fn).lower(*args).compile()
+        print(f"OK   {name}")
+        return True
+    except Exception as e:
+        print(f"FAIL {name}: {type(e).__name__}: {str(e)[:400]}")
+        return False
+
+
+def main():
+    dev = jax.devices()[0]
+    print(f"device: {dev.platform} ({dev})")
+    if dev.platform != "tpu":
+        print("not a TPU — nothing to validate", file=sys.stderr)
+        return 1
+    ok = True
+    rng = np.random.default_rng(0)
+
+    # fused dequant matmuls, phi-shaped (K=2560) and llama-shaped (K=4096)
+    from ollama_operator_tpu.ops.pallas.quant import qmm4_pallas, qmm_pallas
+    for K, O in ((2560, 2560), (4096, 4096)):
+        x = jnp.asarray(rng.standard_normal((8, K)), jnp.bfloat16)
+        q8 = jnp.asarray(rng.integers(-127, 128, (K, O)), jnp.int8)
+        q4 = jnp.asarray(rng.integers(0, 256, (K // 2, O)), jnp.uint8)
+        s = jnp.asarray(rng.random((K // 32, O)), jnp.float32)
+        ok &= check(f"qmm_pallas K={K}", qmm_pallas, x, q8, s)
+        ok &= check(f"qmm4_pallas K={K}", qmm4_pallas, x, q4, s)
+
+    # paged decode kernel: quantized pool, phi-like MHA (KvH=32, hd 80→128
+    # padded) and tinyllama-like GQA (KvH=4, hd=64→128); L small — compile
+    # time scales with the program, not the pool
+    from ollama_operator_tpu.ops.pallas.paged import paged_decode_attention
+    for KvH, H in ((32, 32), (4, 32)):
+        L, P, ps, hd, B, NBLK = 2, 33, 64, 128, 8, 16
+        kq = jnp.zeros((L, P, KvH, ps, hd), jnp.int8)
+        ksc = jnp.zeros((L, P, KvH, ps), jnp.float32)
+        pool = {"q": kq, "s": ksc}
+        q = jnp.zeros((B, 1, H, hd), jnp.bfloat16)
+        tables = jnp.zeros((B, NBLK), jnp.int32)
+        lengths = jnp.zeros((B,), jnp.int32)
+
+        def paged(q, kq, ksc, tables, lengths, KvH=KvH):
+            kp = {"q": kq, "s": ksc}
+            return paged_decode_attention(
+                q, kp, kp, jnp.int32(0), tables, lengths, 0.125, nblk=8)
+
+        ok &= check(f"paged_decode KvH={KvH}", paged, q, kq, ksc,
+                    tables, lengths)
+
+    # dense decode + MHA head-tiled grids (bf16 cache)
+    from ollama_operator_tpu.ops.pallas.flash import (decode_attention,
+                                                      mha_decode_attention)
+    kc = jnp.zeros((8, 4, 1024, 128), jnp.bfloat16)
+    q = jnp.zeros((8, 1, 32, 128), jnp.bfloat16)
+    qpos = jnp.zeros((8,), jnp.int32)
+    # scale must stay a static python float (as production partials it
+    # into the kernel) — passing it through jit would trace it and the
+    # kernel closure would capture a tracer
+    ok &= check("decode_attention GQA",
+                lambda q, k, v, p: decode_attention(q, k, v, p, 0.125),
+                q, kc, kc, qpos)
+    kcm = jnp.zeros((8, 32, 1024, 80), jnp.bfloat16)
+    qm = jnp.zeros((8, 1, 32, 80), jnp.bfloat16)
+    ok &= check("mha_decode hd=80",
+                lambda q, k, v, p: mha_decode_attention(q, k, v, p, 0.125),
+                qm, kcm, kcm, qpos)
+    print("ALL OK" if ok else "FAILURES", flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

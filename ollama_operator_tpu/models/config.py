@@ -64,6 +64,13 @@ class ModelConfig:
     n_experts_used: int = 2            # top-k experts per token
     moe_impl: str = "auto"             # auto|einsum|scan (models/decoder.py)
     kernels: str = "auto"              # attention impl: auto|pallas|xla|interpret
+    mm_kernels: str = "auto"           # quantized-matmul impl. "auto" = XLA
+                                       # (the grouped einsum measured faster
+                                       # than the fused kernel for int8 on
+                                       # v5e); the int4 loader sets "pallas"
+                                       # on single-device TPU — only the
+                                       # kernel reads packed bytes once, the
+                                       # XLA int4 path reads them twice
 
     @property
     def q_dim(self) -> int:
@@ -95,6 +102,7 @@ class ModelConfig:
         assert self.mlp_type in ("gated", "plain")
         assert self.act in ("silu", "gelu", "gelu_tanh")
         assert self.kernels in ("auto", "pallas", "xla", "interpret")
+        assert self.mm_kernels in ("auto", "pallas", "xla", "interpret")
         assert self.moe_impl in ("auto", "einsum", "scan")
         if self.n_experts:
             assert self.mlp_type == "gated", "MoE is gated-MLP only"

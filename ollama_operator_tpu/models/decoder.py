@@ -54,12 +54,20 @@ Params = Dict[str, Any]
 
 
 def _mm(cfg: ModelConfig, x, w, out_dtype=None):
-    """Linear against a dense array or an int8 quantized dict leaf
-    (ops/quant.py). The XLA grouped path wins on v5e for full-model decode
-    (the fused pallas kernel measured slower: 137 vs 147 tok/s on phi), so
-    "auto" resolves to XLA here; an explicit kernels="pallas"/"interpret"
-    config still routes through the kernel."""
-    mode = cfg.kernels if cfg.kernels in ("pallas", "interpret") else "xla"
+    """Linear against a dense array or a quantized dict leaf
+    (ops/quant.py). The XLA grouped path wins on v5e for int8 full-model
+    decode (the fused pallas kernel measured slower: 137 vs 147 tok/s on
+    phi), so "auto" resolves to XLA here; cfg.mm_kernels overrides just
+    the matmul choice (the int4 loader sets it to "pallas" on
+    single-device TPU, where the kernel's read-each-byte-once is the
+    whole bandwidth win), and an explicit kernels="pallas"/"interpret"
+    config still routes everything through kernels."""
+    if cfg.kernels in ("pallas", "interpret"):
+        mode = cfg.kernels
+    elif cfg.mm_kernels in ("pallas", "interpret"):
+        mode = cfg.mm_kernels
+    else:
+        mode = "xla"
     return Q.matmul(x, w, out_dtype, kernels=mode)
 
 

@@ -739,7 +739,21 @@ class Engine:
                                   last_tokens, pring))
             return _extend_paged
 
-        def _make_extend(A):
+        def _make_extend_sp(A):
+            """sp twin of ``_make_extend``: the slot's cache stays
+            sequence-sharded end to end. The tail chunk's compute is
+            replicated across sp — ``forward_with_cache_sp`` is built for
+            T>1 continuation (per-query absolute positions mask the chunk
+            causally against the cache AND itself; ``sp_cache_write``
+            scatters each fresh key to its owning shard) — so the only
+            sp-specific engine work is skipping the attended-prefix
+            bucketing: the sp path always attends its full local chunk,
+            and ``extend()`` passes A = max_seq (closing round-2 weak #5:
+            sp caches used to forfeit prefix caching entirely)."""
+            from ..parallel.long_context import forward_with_cache_sp
+            return _make_extend(A, forward=forward_with_cache_sp)
+
+        def _make_extend(A, forward=None):
             """Prefix-cache continuation: prefill only the tail of a
             prompt whose first ``start`` tokens are already in ``slot``'s
             KV cache (a parked conversation), slicing AND attending only
@@ -749,10 +763,15 @@ class Engine:
             max_seq_len). ``ring_row``/``counts_row`` are the penalty
             window over the FULL continuation prompt, prebuilt on the
             host (the parked window may belong to a divergent suffix).
-            Dense caches only (sp is scheduler-gated); int8 caches slice
-            both the entries and their scales — the cached forward
-            quantizes the tail in place (round-1 weak #4: int8 and prefix
-            caching used to be mutually exclusive)."""
+            sp caches extend through ``_make_extend_sp`` (same body,
+            ``forward`` swapped, A = max_seq so the slice is the whole
+            sequence axis); int8 caches slice both the entries and their
+            scales — the cached forward quantizes the tail in place
+            (round-1 weak #4: int8 and prefix caching used to be
+            mutually exclusive)."""
+            fwd = forward if forward is not None \
+                else decoder.forward_with_cache
+
             def _extend(params, k_cache, v_cache, lengths, counts,
                         last_tokens, pring, tokens, ring_row, counts_row,
                         slot, start, n_new, sp_row, key, mask_row, cflag,
@@ -778,7 +797,7 @@ class Engine:
                     def write5(c, cs):
                         return dus(c, cs, (0, slot, 0, 0, 0))
                 kc_s, vc_s = slice5(k_cache), slice5(v_cache)
-                logits, kc_s, vc_s = decoder.forward_with_cache(
+                logits, kc_s, vc_s = fwd(
                     params, cfg, tokens, kc_s, vc_s, start[None],
                     mesh=self.mesh)
                 k_cache = write5(k_cache, kc_s)
@@ -846,7 +865,9 @@ class Engine:
         self._admit_embeds_fn = _jit(_admit_embeds, (1, 2, 3, 4, 5, 6),
                                      outs=tok_outs)
         self._admit_execs: Dict[int, Any] = {}
-        make_ext = _make_extend_paged if self.paged else _make_extend
+        make_ext = (_make_extend_paged if self.paged
+                    else _make_extend_sp if self.sp_size > 1
+                    else _make_extend)
         self._extend_make = lambda A: _jit(make_ext(A), (1, 2, 3, 4, 5, 6),
                                            outs=tok_outs)
         self._extend_jits: Dict[int, Any] = {}
@@ -1046,13 +1067,13 @@ class Engine:
     @property
     def supports_extend(self) -> bool:
         """Prefix-cache continuation: any single-shard paged pool and any
-        dense cache incl. int8 (both quantize the tail in place). Out:
-        the sp sequence-sharded cache (shards would each need a
-        partial-tail write) and paged×dp (the B=1 tail prefill can't ride
-        the dp-manual region)."""
+        dense cache incl. int8 and sp sequence-sharded (the sp extend
+        replicates the tail's compute and scatters each key to its owning
+        shard — _make_extend_sp). Out: paged×dp only (the B=1 tail
+        prefill can't ride the dp-manual region)."""
         if self.paged:
             return self._paged_dp == 1
-        return self.sp_size == 1
+        return True
 
     def _canon_attn(self, A: int) -> int:
         """Paged extend programs depend only on ceil(A / page_size):
@@ -1100,7 +1121,7 @@ class Engine:
         never attended: masking is position-based and the tail overwrites
         them)."""
         assert self.supports_extend, \
-            "extend() on an sp sequence-sharded cache"
+            "extend() on a dp-sharded paged pool"
         assert not self.active[slot], f"slot {slot} busy"
         full_ids = np.asarray(full_ids, np.int32)
         n_total = int(full_ids.shape[0])
@@ -1119,8 +1140,10 @@ class Engine:
                 f"tail bucket {bucket} does not fit above {start}")
         # attended-prefix bucket: the program slices/attends only the
         # first A cache positions, so continuation cost scales with the
-        # conversation, not max_seq_len
-        attn_a = self.bucket_for(start + bucket)
+        # conversation, not max_seq_len (sp always attends its full local
+        # chunk — one program per tail bucket)
+        attn_a = (self.bucket_for(start + bucket) if self._bucketed_attn
+                  else self.max_seq)
         tokens = np.zeros((1, bucket), np.int32)
         tokens[0, :n_new] = full_ids[start:]
         # penalty window over the full continuation prompt (host-built:
@@ -1298,15 +1321,16 @@ class Engine:
             # (tail, attended) bucket pairs; the max_seq tail bucket is
             # unreachable (extend requires start >= 1 and start + bucket
             # <= max_seq), and the attended bucket covers start + tail so
-            # A >= the tail bucket — O(log² max_seq) programs
+            # A >= the tail bucket — O(log² max_seq) programs. sp extends
+            # ignore A entirely (extend() always passes max_seq there):
+            # one program per tail bucket, not a pair matrix.
             for b in self._buckets:
                 if b >= self.max_seq:
                     continue
-                for a in self._buckets:
-                    # start >= 1, so attn_a = bucket_for(start + b) is
-                    # always the NEXT bucket up — a == b is unreachable
-                    if a > b:
-                        self._extend_exec(b, a)
+                attns = ([a for a in self._buckets if a > b]
+                         if self._bucketed_attn else [self.max_seq])
+                for a in attns:
+                    self._extend_exec(b, a)
 
     def prepare_decode(self, n: Optional[int] = None) -> list:
         """Paged mode: grow every active slot's block table to cover

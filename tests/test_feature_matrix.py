@@ -55,8 +55,10 @@ MATRIX = [
     ("paged-f32-dp2", jnp.float32, True, MeshPlan(dp=2), False),
     ("paged-int8-dp2", jnp.int8, True, MeshPlan(dp=2), False),
     ("paged-int8-dp2tp2", jnp.int8, True, MeshPlan(dp=2, tp=2), False),
-    ("dense-f32-sp2", jnp.float32, False, MeshPlan(sp=2, tp=2), False),
-    ("dense-int8-sp2", jnp.int8, False, MeshPlan(sp=2, tp=2), False),
+    # sp caches extend too since round 3 (_make_extend_sp: the tail's
+    # compute replicates across sp, writes scatter to the owning shard)
+    ("dense-f32-sp2", jnp.float32, False, MeshPlan(sp=2, tp=2), True),
+    ("dense-int8-sp2", jnp.int8, False, MeshPlan(sp=2, tp=2), True),
 ]
 
 

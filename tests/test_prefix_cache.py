@@ -45,10 +45,13 @@ def test_extend_matches_fresh_prefill():
     eng.release(0, park=True)
     parked_ids = p1 + gen
 
-    # continuation: full conversation + a new turn
+    # continuation: full conversation + a new turn. The cached prefix
+    # excludes gen's LAST token (sampled, never fed — its K/V was never
+    # written; the scheduler's parked map applies the same -1), so the
+    # tail re-feeds it.
     new_prompt = parked_ids + [7, 13, 52]
     got = [eng.extend(0, np.asarray(new_prompt, np.int32),
-                      start=len(parked_ids), opts=GREEDY)]
+                      start=len(parked_ids) - 1, opts=GREEDY)]
     for _ in range(5):
         got.append(int(eng.decode()[0]))
     eng.release(0)
@@ -216,9 +219,85 @@ def test_extend_int8_dense_cache():
     parked_ids = p1 + gen
     new_prompt = parked_ids + [7, 13, 52]
     got = [eng.extend(0, np.asarray(new_prompt, np.int32),
-                      start=len(parked_ids), opts=GREEDY)]
+                      start=len(parked_ids) - 1, opts=GREEDY)]
     for _ in range(5):
         got.append(int(eng.decode()[0]))
 
     ref = run_fresh(make_q(), new_prompt, GREEDY, 5)
+    assert got == ref
+
+
+def test_extend_sp_sequence_sharded_cache():
+    """sp caches extend too (round-2 weak #5): an sp=2 engine's
+    continuation must match its own fresh full prefill token-for-token,
+    and the single-device dense engine's output as well."""
+    from ollama_operator_tpu.parallel import MeshPlan, make_mesh
+    cfg = cfglib.PRESETS["tiny"]
+    params = decoder.init_params(cfg, jax.random.PRNGKey(0), dtype=F32)
+
+    def sp_engine():
+        mesh = make_mesh(MeshPlan(sp=2))
+        return Engine(cfg, params, mesh=mesh,
+                      ecfg=EngineConfig(max_slots=2, max_seq_len=128,
+                                        cache_dtype=F32,
+                                        min_prefill_bucket=16,
+                                        repeat_last_n=8))
+
+    eng = sp_engine()
+    assert eng.supports_extend
+    p1 = list(np.random.default_rng(3).integers(1, 250, 24))
+    first = eng.admit(0, np.asarray(p1, np.int32), GREEDY)
+    gen = [first] + [int(eng.decode()[0]) for _ in range(4)]
+    eng.release(0, park=True)
+    parked_ids = p1 + gen
+
+    new_prompt = parked_ids + [7, 13, 52]
+    # the cached prefix excludes gen's LAST token (sampled, never fed —
+    # its K/V was never written; the scheduler's parked map applies the
+    # same -1), so the tail re-feeds it
+    got = [eng.extend(0, np.asarray(new_prompt, np.int32),
+                      start=len(parked_ids) - 1, opts=GREEDY)]
+    for _ in range(5):
+        got.append(int(eng.decode()[0]))
+    eng.release(0)
+
+    ref_sp = run_fresh(sp_engine(), new_prompt, GREEDY, 5)
+    assert got == ref_sp
+    ref_dense = run_fresh(make_engine(cfg, params, slots=2), new_prompt,
+                          GREEDY, 5)
+    assert got == ref_dense
+
+
+def test_extend_sp_int8_cache():
+    """sp extend with the quantized sequence-sharded cache: the tail
+    quantizes in place per shard; greedy continuation matches the sp
+    engine's own fresh prefill."""
+    from ollama_operator_tpu.parallel import MeshPlan, make_mesh
+    cfg = cfglib.PRESETS["tiny"]
+    params = decoder.init_params(cfg, jax.random.PRNGKey(0), dtype=F32)
+
+    def sp_engine():
+        mesh = make_mesh(MeshPlan(sp=2))
+        return Engine(cfg, params, mesh=mesh,
+                      ecfg=EngineConfig(max_slots=2, max_seq_len=128,
+                                        cache_dtype=jnp.int8,
+                                        min_prefill_bucket=16,
+                                        repeat_last_n=8))
+
+    eng = sp_engine()
+    assert eng.supports_extend
+    p1 = list(np.random.default_rng(4).integers(1, 250, 20))
+    first = eng.admit(0, np.asarray(p1, np.int32), GREEDY)
+    gen = [first] + [int(eng.decode()[0]) for _ in range(3)]
+    eng.release(0, park=True)
+    parked_ids = p1 + gen
+
+    new_prompt = parked_ids + [9, 41]
+    got = [eng.extend(0, np.asarray(new_prompt, np.int32),
+                      start=len(parked_ids) - 1, opts=GREEDY)]
+    for _ in range(4):
+        got.append(int(eng.decode()[0]))
+    eng.release(0)
+
+    ref = run_fresh(sp_engine(), new_prompt, GREEDY, 4)
     assert got == ref

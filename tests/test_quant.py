@@ -314,3 +314,21 @@ def test_int4_bytes_quartered():
     q4 = Q.quantize_params(params2, bits=4)["layers"]["wq"]
     assert q4["q4"].nbytes * 2 == q8["q"].nbytes
     assert q4["s"].nbytes == q8["s"].nbytes
+
+
+def test_int4_mm_kernels_interpret_matches_xla():
+    """cfg.mm_kernels routes just the quantized matmuls through the
+    kernel (decoder._mm); interpret-mode output must match the XLA path."""
+    cfg = tiny()
+    params = decoder.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    qparams = jax.tree_util.tree_map(
+        jnp.asarray, Q.quantize_params(jax.tree_util.tree_map(
+            np.asarray, params), bits=4))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 9), 0,
+                                cfg.vocab_size)
+    ref, _, _ = decoder.prefill_chunk(qparams, cfg, tokens)
+    import dataclasses
+    cfg_k = dataclasses.replace(cfg, mm_kernels="interpret")
+    got, _, _ = decoder.prefill_chunk(qparams, cfg_k, tokens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
