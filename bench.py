@@ -317,14 +317,12 @@ def measure(jax, *, model: str, dtype: str, slots: int, steps: int,
         mesh = make_mesh(MeshPlan.for_devices(len(devs), tp=tp))
         log(f"mesh: {dict(mesh.shape)}")
 
-    if dtype == "int4" and not on_cpu and mesh is None:
-        # single-device int4: the fused pallas kernel is the only matmul
-        # path that reads each packed byte once (see ModelConfig.mm_kernels;
-        # OLLAMA_TPU_KERNELS=xla stays the escape hatch)
-        from ollama_operator_tpu.ops.attention import resolve_kernels
-        if resolve_kernels(cfg.kernels) != "xla":
-            import dataclasses
-            cfg = dataclasses.replace(cfg, mm_kernels="pallas")
+    if dtype == "int4":
+        # shared routing with the server loader (ops/quant.int4_mm_kernels)
+        # so the bench can never measure a different matmul path than the
+        # server ships
+        from ollama_operator_tpu.ops.quant import int4_mm_kernels
+        cfg = int4_mm_kernels(cfg, mesh)
     eng = Engine(cfg, params, mesh=mesh,
                  ecfg=EngineConfig(
                      max_slots=slots, max_seq_len=seq, decode_chunk=chunk,

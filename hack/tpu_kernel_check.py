@@ -59,7 +59,6 @@ def main():
         L, P, ps, hd, B, NBLK = 2, 33, 64, 128, 8, 16
         kq = jnp.zeros((L, P, KvH, ps, hd), jnp.int8)
         ksc = jnp.zeros((L, P, KvH, ps), jnp.float32)
-        pool = {"q": kq, "s": ksc}
         q = jnp.zeros((B, 1, H, hd), jnp.bfloat16)
         tables = jnp.zeros((B, NBLK), jnp.int32)
         lengths = jnp.zeros((B,), jnp.int32)

@@ -811,6 +811,47 @@ def paged_insert_dp(cfg: ModelConfig, k_pool, v_pool, ks, vs, table_rows,
         k_pool, v_pool, ks, vs, table_rows, n_valid)
 
 
+def paged_extend_dp(params: Params, cfg: ModelConfig, tokens: jax.Array,
+                    k_pool, v_pool, table_rows: jax.Array,
+                    lengths: jax.Array, attn_blocks: int,
+                    owner: jax.Array, mesh):
+    """dp twin of the paged prefix-cache extend (B=1 tail prefill).
+
+    The pool PAGE axis is dp-sharded and the reused prefix lives on ONE
+    shard, so the tail replicates its compute across dp the same way
+    ``paged_insert_dp`` replicates admissions: ``table_rows`` [dp, NBLK]
+    carries the owner's real LOCAL row and all-trash rows elsewhere —
+    non-owners scatter into their own trash page and attend garbage,
+    and an owner-select psum drops their logits (jnp.where picks 0 for
+    the unselected branch, so even a non-owner NaN cannot propagate).
+    Manual over dp ONLY: params/pool tp shardings stay GSPMD-auto inside
+    the region (the same trick parallel/long_context.py uses for sp),
+    and the inner forward is the plain single-shard paged path
+    (``mesh=None`` — T>1 rides the gather fallback).
+    """
+    from jax.sharding import PartitionSpec as P
+    quant = isinstance(k_pool, dict)
+    pool_spec = P(None, "dp", None, None, None)
+    pool_specs = ({"q": pool_spec, "s": P(None, "dp", None, None)}
+                  if quant else pool_spec)
+
+    def inner(tokens, kp, vp, trow, lengths, owner):
+        logits, kp, vp = forward_with_cache_paged(
+            params, cfg, tokens, kp, vp, trow, lengths, attn_blocks,
+            mesh=None)
+        my = lax.axis_index("dp")
+        logits = lax.psum(jnp.where(my == owner, logits, 0.0), "dp")
+        return logits, kp, vp
+
+    return jax.shard_map(
+        inner, mesh=mesh,
+        in_specs=(P(None, None), pool_specs, pool_specs, P("dp", None),
+                  P(None), P()),
+        out_specs=(P(None, None, None), pool_specs, pool_specs),
+        axis_names={"dp"}, check_vma=False)(
+        tokens, k_pool, v_pool, table_rows, lengths, owner)
+
+
 def forward_with_cache_paged(params: Params, cfg: ModelConfig,
                              tokens: jax.Array, k_pool, v_pool,
                              tables: jax.Array, lengths: jax.Array,
@@ -858,8 +899,9 @@ def forward_with_cache_paged(params: Params, cfg: ModelConfig,
                          jnp.int32(TRASH_PAGE))
         off_w = positions % ps
     if dp_axes is not None:
-        assert T == 1, ("paged dp meshes decode only (T=1); the engine "
-                        "gates prefix-cache extends off dp")
+        assert T == 1, ("the dp-manual region decodes only (T=1); T>1 "
+                        "extends ride paged_extend_dp, whose inner "
+                        "forward is the single-shard path")
         from ..ops.attention import resolve_kernels
         interp = resolve_kernels(cfg.kernels) == "interpret"
 

@@ -334,6 +334,26 @@ def quantize_params(params: Dict[str, Any], group: int = GROUP,
     return out
 
 
+def int4_mm_kernels(cfg, mesh) -> Any:
+    """The ``mm_kernels`` value an int4 load should serve with: the fused
+    pallas kernel on a single-device TPU (the only matmul path that reads
+    each packed byte once), the portable XLA einsum under GSPMD meshes —
+    and ``kernels=xla`` (config or OLLAMA_TPU_KERNELS) stays the escape
+    hatch if the kernel miscompiles. One helper so the server loader and
+    bench.py can never drift onto different matmul paths (they feed the
+    same BASELINE numbers). Returns the cfg, possibly replaced."""
+    import dataclasses
+
+    import jax
+
+    from .attention import resolve_kernels
+    if (jax.default_backend() == "tpu"
+            and (mesh is None or mesh.size == 1)
+            and resolve_kernels(cfg.kernels) != "xla"):
+        return dataclasses.replace(cfg, mm_kernels="pallas")
+    return cfg
+
+
 def quantized_bytes(params: Dict[str, Any]) -> int:
     """HBM footprint of a (possibly partly quantized) param tree."""
     total = 0

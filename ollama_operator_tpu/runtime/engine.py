@@ -716,7 +716,10 @@ class Engine:
             slice/unslice copies, and quantized pools work the same (the
             paged forward quantizes fresh K/V per layer). Tail
             bucket-padding beyond n_new lands on unowned table entries,
-            i.e. the trash page."""
+            i.e. the trash page. On dp meshes the table argument is the
+            [dp, NBLK] owner-real/others-trash rows plus the owning
+            shard's index, and the forward is the dp-manual twin
+            (decoder.paged_extend_dp)."""
             nblk_a = -(-A // self.ecfg.page_size)
 
             def _extend_paged(params, k_cache, v_cache, lengths, counts,
@@ -737,7 +740,26 @@ class Engine:
                     cflag, rln)
                 return (tok, *pin(k_cache, v_cache, lengths, counts,
                                   last_tokens, pring))
-            return _extend_paged
+
+            def _extend_paged_dp(params, k_cache, v_cache, lengths, counts,
+                                 last_tokens, pring, tokens, ring_row,
+                                 counts_row, slot, start, n_new,
+                                 table_rows, owner, sp_row, key, mask_row,
+                                 cflag, rln):
+                logits, k_cache, v_cache = decoder.paged_extend_dp(
+                    params, cfg, tokens, k_cache, v_cache, table_rows,
+                    start[None], nblk_a, owner, self.mesh)
+                last = jax.lax.dynamic_index_in_dim(
+                    logits[0], n_new - 1, axis=0, keepdims=False)
+                (tok, lengths, counts, last_tokens,
+                 pring) = _sample_install(
+                    lengths, counts, last_tokens, pring, last, ring_row,
+                    counts_row, slot, start + n_new, sp_row, key, mask_row,
+                    cflag, rln)
+                return (tok, *pin(k_cache, v_cache, lengths, counts,
+                                  last_tokens, pring))
+            return (_extend_paged_dp if self._paged_dp > 1
+                    else _extend_paged)
 
         def _make_extend_sp(A):
             """sp twin of ``_make_extend``: the slot's cache stays
@@ -1066,13 +1088,12 @@ class Engine:
 
     @property
     def supports_extend(self) -> bool:
-        """Prefix-cache continuation: any single-shard paged pool and any
-        dense cache incl. int8 and sp sequence-sharded (the sp extend
-        replicates the tail's compute and scatters each key to its owning
-        shard — _make_extend_sp). Out: paged×dp only (the B=1 tail
-        prefill can't ride the dp-manual region)."""
-        if self.paged:
-            return self._paged_dp == 1
+        """Prefix-cache continuation: EVERY cache mode since round 3 —
+        dense (incl. int8), sp sequence-sharded (tail compute replicates,
+        writes scatter to the owning shard — _make_extend_sp), paged, and
+        paged×dp (tail replicates across shards with owner-real/
+        others-trash table rows and an owner-select psum —
+        decoder.paged_extend_dp)."""
         return True
 
     def _canon_attn(self, A: int) -> int:
@@ -1102,7 +1123,12 @@ class Engine:
                     self._gr(np.zeros((W,), np.int32)), self._gr(
                         np.zeros((self.cfg.vocab_size,), np.int32)),
                     zi(0), zi(1), zi(1)]
-            if self.paged:
+            if self.paged and self._paged_dp > 1:
+                rows = np.zeros((self._paged_dp, self._nblk), np.int32)
+                args.append(self._g(rows, NamedSharding(
+                    self.mesh, P("dp", None))))
+                args.append(zi(0))            # owning shard index
+            elif self.paged:
                 args.append(self._gr(np.zeros((self._nblk,), np.int32)))
             args += [self._sp_row(SlotOptions()), self._dummy_key(),
                      self._mask_ones, zi(0), zi(W)]
@@ -1120,8 +1146,6 @@ class Engine:
         ids share that prefix — stale entries at positions >= start are
         never attended: masking is position-based and the tail overwrites
         them)."""
-        assert self.supports_extend, \
-            "extend() on a dp-sharded paged pool"
         assert not self.active[slot], f"slot {slot} busy"
         full_ids = np.asarray(full_ids, np.int32)
         n_total = int(full_ids.shape[0])
@@ -1181,7 +1205,13 @@ class Engine:
                 raise PagesExhausted(
                     f"extend to {n_total} tokens (+1 chunk headroom): "
                     f"{self._pt.n_free} pages free")
-            args.append(self._gr(self._pt.tables[slot]))
+            if self._paged_dp > 1:
+                # [dp, NBLK] owner-real/others-trash rows + owner index
+                # (decoder.paged_extend_dp)
+                args.append(self._table_row_dev(slot))
+                args.append(self._gr(np.int32(self._pt.shard_of(slot))))
+            else:
+                args.append(self._gr(self._pt.tables[slot]))
         args += [self._sp_row(opts), key, mrow, cflag,
                  self._gr(np.int32(rln))]
         (tok, self.k_cache, self.v_cache, self.lengths, self.counts,

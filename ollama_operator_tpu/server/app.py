@@ -356,19 +356,9 @@ class ModelManager:
                 from ..ops.quant import quantize_params
                 params = quantize_params(
                     params, bits=4 if self.engine_dtype == "int4" else 8)
-                from ..ops.attention import resolve_kernels
-                if (self.engine_dtype == "int4"
-                        and jax.default_backend() == "tpu"
-                        and (self.mesh is None or self.mesh.size == 1)
-                        and resolve_kernels(cfg.kernels) != "xla"):
-                    # route int4 decode matmuls through the fused pallas
-                    # kernel: only it reads each packed byte once (the
-                    # XLA int4 path lands at int8-equivalent traffic);
-                    # GSPMD meshes keep the portable einsum, and an
-                    # explicit kernels=xla (config or OLLAMA_TPU_KERNELS)
-                    # stays the escape hatch if the kernel miscompiles
-                    import dataclasses
-                    cfg = dataclasses.replace(cfg, mm_kernels="pallas")
+                if self.engine_dtype == "int4":
+                    from ..ops.quant import int4_mm_kernels
+                    cfg = int4_mm_kernels(cfg, self.mesh)
             params = jax.tree_util.tree_map(jnp.asarray, params)
             vision = None
             proj_path = layers.get(MT_PROJECTOR)

@@ -301,3 +301,75 @@ def test_extend_sp_int8_cache():
 
     ref = run_fresh(sp_engine(), new_prompt, GREEDY, 4)
     assert got == ref
+
+
+def test_extend_paged_dp_sharded_pool():
+    """paged×dp extend (the matrix's last hole): the tail replicates
+    across dp shards with owner-real/others-trash table rows; greedy
+    continuation matches the same engine's fresh full prefill and the
+    single-device dense engine."""
+    from ollama_operator_tpu.parallel import MeshPlan, make_mesh
+    cfg = cfglib.PRESETS["tiny"]
+    params = decoder.init_params(cfg, jax.random.PRNGKey(0), dtype=F32)
+
+    def dp_engine():
+        mesh = make_mesh(MeshPlan(dp=2))
+        return Engine(cfg, params, mesh=mesh,
+                      ecfg=EngineConfig(max_slots=2, max_seq_len=128,
+                                        cache_dtype=F32,
+                                        min_prefill_bucket=16,
+                                        repeat_last_n=8, paged=True,
+                                        page_size=16))
+
+    eng = dp_engine()
+    assert eng.supports_extend
+    p1 = list(np.random.default_rng(7).integers(1, 250, 24))
+    first = eng.admit(0, np.asarray(p1, np.int32), GREEDY)
+    gen = [first] + [int(eng.decode()[0]) for _ in range(4)]
+    eng.release(0, park=True)
+    parked_ids = p1 + gen
+
+    new_prompt = parked_ids + [7, 13, 52]
+    got = [eng.extend(0, np.asarray(new_prompt, np.int32),
+                      start=len(parked_ids) - 1, opts=GREEDY)]
+    for _ in range(5):
+        got.append(int(eng.decode()[0]))
+    eng.release(0)
+
+    ref_dp = run_fresh(dp_engine(), new_prompt, GREEDY, 5)
+    assert got == ref_dp
+    ref_dense = run_fresh(make_engine(cfg, params, slots=2), new_prompt,
+                          GREEDY, 5)
+    assert got == ref_dense
+
+
+def test_extend_paged_dp_slot_on_second_shard():
+    """Same as above but the slot lives on dp shard 1 — the owner-select
+    psum must pick the non-zero shard's logits."""
+    from ollama_operator_tpu.parallel import MeshPlan, make_mesh
+    cfg = cfglib.PRESETS["tiny"]
+    params = decoder.init_params(cfg, jax.random.PRNGKey(0), dtype=F32)
+    mesh = make_mesh(MeshPlan(dp=2))
+    eng = Engine(cfg, params, mesh=mesh,
+                 ecfg=EngineConfig(max_slots=2, max_seq_len=128,
+                                   cache_dtype=jnp.int8,
+                                   min_prefill_bucket=16,
+                                   repeat_last_n=8, paged=True,
+                                   page_size=16))
+    slot = 1                      # slots_per_shard = 1 → shard_of(1) == 1
+    assert eng._pt.shard_of(slot) == 1
+    p1 = list(np.random.default_rng(8).integers(1, 250, 20))
+    first = eng.admit(slot, np.asarray(p1, np.int32), GREEDY)
+    gen = [first] + [int(eng.decode()[slot]) for _ in range(3)]
+    eng.release(slot, park=True)
+    parked_ids = p1 + gen
+
+    new_prompt = parked_ids + [9, 41]
+    got = [eng.extend(slot, np.asarray(new_prompt, np.int32),
+                      start=len(parked_ids) - 1, opts=GREEDY)]
+    for _ in range(4):
+        got.append(int(eng.decode()[slot]))
+
+    ref = run_fresh(make_engine(cfg, params, slots=2), new_prompt,
+                    GREEDY, 4)
+    assert got == ref
