@@ -9,16 +9,27 @@ is the decode step against that pool:
   lengths ride in SMEM (``PrefetchScalarGridSpec``), so the K/V index map
   dereferences ``table[b, block]`` at grid time — pages are DMA'd straight
   out of the pool with no gather copy.
+- **Head-blocked grid (B, nblk).** Each step reads a page ACROSS all its
+  KV heads (one [KvH, ps, hd] DMA) and runs the per-head flash updates
+  unrolled inside the kernel. The first on-chip capture ran the old
+  (B, KvH, nblk) grid and measured phi (MHA, KvH=32) at 233 ms/step —
+  16384 tiny 8 KB steps/layer, 2.1% of HBM bandwidth; folding heads into
+  the block cuts the grid by KvH and makes every DMA page-contiguous.
 - **Per-slot DMA elision.** The block index is clamped to the slot's last
   live block; Pallas elides the repeated DMA and ``@pl.when`` skips the
   math — a 100-token slot in a 4096-token-bucket batch reads 1-2 pages,
-  not the bucket (this is what retires round-1's global-bucket cost: the
-  grid is bounded by the bucket, the traffic by each slot's length).
+  not the bucket.
 - **Lane-wise int8 dequant.** For the quantized pool the per-position
   scales multiply the score matrix (``s * k_scale[None, :]``) and the
   probability matrix (``p * v_scale[None, :]``) — both lane-aligned
-  broadcasts, so dequant adds no relayout and page DMAs stay int8 (half
-  the decode bandwidth).
+  broadcasts, so dequant adds no relayout and page DMAs stay int8. Scales
+  ride as [L, P, KvH, 1, ps]: the unit axis keeps the block's trailing
+  dims equal to their array dims (Mosaic's (8,128) rule — the 4D spec
+  lowers in interpret mode but is rejected by the real TPU lowering).
+- **bf16 score/probability dots.** int8 codes are exact in bf16's 8-bit
+  mantissa and the MXU is bf16-native; dotting f32 (the first kernel
+  generation) runs at a fraction of MXU rate. f32 activations (CPU
+  tests) keep f32 dots for bit-stable parity.
 
 The layer index is a prefetched scalar too: the kernel reads the full
 ``[L, ...]`` pool and the grid never materialises a per-layer slice.
@@ -31,7 +42,6 @@ TPU-native equivalent (SURVEY.md §7 hard-part 2).
 from __future__ import annotations
 
 import functools
-from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -45,12 +55,14 @@ from .flash import _lane_ok
 def _paged_kernel(lay_ref, len_ref, tbl_ref, q_ref, k_ref, v_ref, o_ref,
                   acc_ref, m_ref, l_ref, *,
                   scale: float, softcap: float, window: int,
-                  ps: int, nblk: int, quant: bool, ks_ref=None, vs_ref=None):
-    """Grid (B, KvH, nblk). Block ki covers the slot's logical positions
-    [ki*ps, (ki+1)*ps). With ``quant`` the k/v refs are int8 pages and
-    ks/vs carry the per-position f32 scales (appended to the positional
-    ref list by the caller)."""
-    b, ki = pl.program_id(0), pl.program_id(2)
+                  ps: int, nblk: int, kvh: int, gp: int, cdt,
+                  quant: bool, ks_ref=None, vs_ref=None):
+    """Grid (B, nblk). Block ki covers the slot's logical positions
+    [ki*ps, (ki+1)*ps) across ALL KvH heads; the per-head flash updates
+    are unrolled below (static python loop — KvH is a trace-time
+    constant). With ``quant`` the k/v refs are int8 pages and ks/vs carry
+    the per-position f32 scales."""
+    b, ki = pl.program_id(0), pl.program_id(1)
     qp = len_ref[b]                        # query's absolute position
 
     @pl.when(ki == 0)
@@ -66,47 +78,44 @@ def _paged_kernel(lay_ref, len_ref, tbl_ref, q_ref, k_ref, v_ref, o_ref,
 
     @pl.when(needed)
     def _step():
-        q = q_ref[0, 0, :, :]                                 # [Gp, hd]
-        kb = k_ref[0, 0, 0, :, :]                             # [ps, hd]
-        if quant:
-            kb = kb.astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, kb, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale       # [Gp, ps]
-        if quant:
-            # per-position k scale: lane-aligned broadcast over the scores
-            s = s * ks_ref[0, 0, 0, 0, :][None, :]
-        s = softcap_scores(s, softcap)
-        Gp = s.shape[0]
-        k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (Gp, ps), 1)
+        k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (gp, ps), 1)
         ok = k_pos <= qp
         if window:
             ok = jnp.logical_and(ok, k_pos > qp - window)
-        s = jnp.where(ok, s, NEG_INF)
+        for h in range(kvh):               # unrolled per kv head
+            r0 = h * gp
+            q = q_ref[0, h, :, :].astype(cdt)                 # [Gp, hd]
+            kb = k_ref[0, 0, h, :, :]                         # [ps, hd]
+            s = jax.lax.dot_general(
+                q, kb.astype(cdt), (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale   # [Gp, ps]
+            if quant:
+                # per-position k scale: lane-aligned broadcast
+                s = s * ks_ref[0, 0, h, 0, :][None, :]
+            s = softcap_scores(s, softcap)
+            s = jnp.where(ok, s, NEG_INF)
 
-        m_prev = m_ref[:]
-        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.where(m_cur > NEG_INF / 2, jnp.exp(s - m_cur), 0.0)
-        alpha = jnp.exp(m_prev - m_cur)
-        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        vb = v_ref[0, 0, 0, :, :]                             # [ps, hd]
-        if quant:
-            # fold the per-position v scale into p (lane-aligned again)
-            p = p * vs_ref[0, 0, 0, 0, :][None, :]
-            vb = vb.astype(jnp.float32)
-            acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-                p, vb, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)
-        else:
-            acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-                p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)
-        m_ref[:] = m_cur
+            m_prev = m_ref[r0:r0 + gp, :]
+            m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+            p = jnp.where(m_cur > NEG_INF / 2, jnp.exp(s - m_cur), 0.0)
+            alpha = jnp.exp(m_prev - m_cur)
+            l_ref[r0:r0 + gp, :] = (l_ref[r0:r0 + gp, :] * alpha
+                                    + jnp.sum(p, axis=-1, keepdims=True))
+            vb = v_ref[0, 0, h, :, :]                         # [ps, hd]
+            if quant:
+                # fold the per-position v scale into p (lane-aligned)
+                p = p * vs_ref[0, 0, h, 0, :][None, :]
+            acc_ref[r0:r0 + gp, :] = (
+                acc_ref[r0:r0 + gp, :] * alpha + jax.lax.dot_general(
+                    p.astype(cdt), vb.astype(cdt),
+                    (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32))
+            m_ref[r0:r0 + gp, :] = m_cur
 
     @pl.when(ki == nblk - 1)
     def _done():
         out = acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)
-        o_ref[0, 0, :, :] = out.astype(o_ref.dtype)
+        o_ref[0, :, :] = out.astype(o_ref.dtype)
 
 
 def paged_decode_attention(q, k_pool, v_pool, layer, tables, lengths,
@@ -137,6 +146,7 @@ def paged_decode_attention(q, k_pool, v_pool, layer, tables, lengths,
         return None
     G = H // KvH
     Gp = max(8, -(-G // 8) * 8)
+    cdt = jnp.bfloat16 if q.dtype == jnp.bfloat16 else jnp.float32
 
     qg = q.reshape(B, KvH, G, hd_q)
     if Gp != G or hd != hd_q:
@@ -145,19 +155,18 @@ def paged_decode_attention(q, k_pool, v_pool, layer, tables, lengths,
         # inert in the score dot and the pad outputs are sliced off below)
         qg = jnp.pad(qg, ((0, 0), (0, 0), (0, Gp - G), (0, hd - hd_q)))
 
-    def kv_index(b, h, ki, lay_ref, len_ref, tbl_ref):
+    def kv_index(b, ki, lay_ref, len_ref, tbl_ref):
         last = len_ref[b] // ps
         pg = tbl_ref[b, jnp.minimum(ki, last)]
-        return (lay_ref[0], pg, h, 0, 0)
+        return (lay_ref[0], pg, 0, 0, 0)
 
     kernel = functools.partial(
         _paged_kernel, scale=scale, softcap=softcap, window=sliding_window,
-        ps=ps, nblk=nblk, quant=quant)
+        ps=ps, nblk=nblk, kvh=KvH, gp=Gp, cdt=cdt, quant=quant)
     in_specs = [
-        pl.BlockSpec((1, 1, Gp, hd),
-                     lambda b, h, ki, *pref: (b, h, 0, 0)),
-        pl.BlockSpec((1, 1, 1, ps, hd), kv_index),
-        pl.BlockSpec((1, 1, 1, ps, hd), kv_index),
+        pl.BlockSpec((1, KvH, Gp, hd), lambda b, ki, *pref: (b, 0, 0, 0)),
+        pl.BlockSpec((1, 1, KvH, ps, hd), kv_index),
+        pl.BlockSpec((1, 1, KvH, ps, hd), kv_index),
     ]
     args = [qg, k_arr, v_arr]
     if quant:
@@ -167,16 +176,10 @@ def paged_decode_attention(q, k_pool, v_pool, layer, tables, lengths,
             return _paged_kernel(
                 lay_ref, len_ref, tbl_ref, q_ref, k_ref, v_ref, o_ref,
                 acc_ref, m_ref, l_ref, scale=scale, softcap=softcap,
-                window=sliding_window, ps=ps, nblk=nblk, quant=True,
-                ks_ref=ks_ref, vs_ref=vs_ref)
-        # scales ride as [L, P, KvH, 1, ps]: the unit axis makes the block's
-        # trailing dims (1, ps) each EQUAL to their array dim, satisfying
-        # the TPU (8, 128)-tiling rule, and keeps ps on lanes so the
-        # broadcast over the score matrix needs no relayout. (The 4D
-        # (1, 1, 1, ps) spec lowers fine in interpret mode but is rejected
-        # by the real Mosaic lowering: block dim 1 over KvH.)
-        in_specs += [pl.BlockSpec((1, 1, 1, 1, ps), kv_index),
-                     pl.BlockSpec((1, 1, 1, 1, ps), kv_index)]
+                window=sliding_window, ps=ps, nblk=nblk, kvh=KvH, gp=Gp,
+                cdt=cdt, quant=True, ks_ref=ks_ref, vs_ref=vs_ref)
+        in_specs += [pl.BlockSpec((1, 1, KvH, 1, ps), kv_index),
+                     pl.BlockSpec((1, 1, KvH, 1, ps), kv_index)]
         args += [k_pool["s"].reshape(L, P, KvH, 1, ps),
                  v_pool["s"].reshape(L, P, KvH, 1, ps)]
 
@@ -184,21 +187,22 @@ def paged_decode_attention(q, k_pool, v_pool, layer, tables, lengths,
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=3,
-            grid=(B, KvH, nblk),
+            grid=(B, nblk),
             in_specs=in_specs,
-            out_specs=pl.BlockSpec((1, 1, Gp, hd),
-                                   lambda b, h, ki, *pref: (b, h, 0, 0)),
+            out_specs=pl.BlockSpec((1, KvH * Gp, hd),
+                                   lambda b, ki, *pref: (b, 0, 0)),
             scratch_shapes=[
-                pltpu.VMEM((Gp, hd), jnp.float32),
-                pltpu.VMEM((Gp, 1), jnp.float32),
-                pltpu.VMEM((Gp, 1), jnp.float32),
+                pltpu.VMEM((KvH * Gp, hd), jnp.float32),
+                pltpu.VMEM((KvH * Gp, 1), jnp.float32),
+                pltpu.VMEM((KvH * Gp, 1), jnp.float32),
             ],
         ),
-        out_shape=jax.ShapeDtypeStruct((B, KvH, Gp, hd), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, KvH * Gp, hd), q.dtype),
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
+            dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(jnp.reshape(layer, (1,)).astype(jnp.int32),
       lengths.astype(jnp.int32), tables.astype(jnp.int32),
       qg, *args[1:])
+    out = out.reshape(B, KvH, Gp, hd)
     return out[:, :, :G, :hd_q].reshape(B, 1, H, hd_q)

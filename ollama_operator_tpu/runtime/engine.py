@@ -64,13 +64,47 @@ class EngineConfig:
     # scales with live tokens, so max_slots can be 32+ on one chip
     # (SURVEY.md §7 hard-part 2). Meshes: single-device, tp, dp, dp×tp
     # (under dp the pool shards into per-shard sub-pools); sp keeps the
-    # dense sequence-sharded cache.
-    paged: bool = False
+    # dense sequence-sharded cache. The SERVER passes None = decide per
+    # model at load (resolve_paged_default); direct engine constructions
+    # default off.
+    paged: Optional[bool] = False
     page_size: int = 64
     # data pages in the pool (excl. the trash page); None = the dense
     # equivalent max_slots * max_seq_len / page_size — same HBM ceiling,
     # but shared, so mixed-length batches fit far more concurrency
     n_pages: Optional[int] = None
+
+
+def resolve_paged_default(cfg: ModelConfig, mesh) -> bool:
+    """The serving default for an unset paged flag, per model and mesh.
+
+    Data-driven (BASELINE.md r3, v5e): with the head-blocked kernel the
+    paged pool measured 1.90x the dense aggregate on a GQA model
+    (tinyllama, 2646.9 vs 1390.6 tok/s at B=32 mixed) but MHA pools are
+    per-head-dot-bound (phi KvH=32: 191 ms/step vs 14 dense) — so GQA
+    models page by default and MHA stays dense. Off for MoE (untested
+    combination), for meshes the pool can't shard (sp; dp without a
+    valid dp-manual layout), and off the TPU backend entirely (the
+    measurement is v5e's; a 1-core CPU dev/kind pod gets 4x the per-step
+    compute from a 32-slot batch). An explicit --paged / TPU_PAGED=0|1
+    always wins."""
+    import jax
+    if jax.default_backend() != "tpu":
+        return False
+    if cfg.n_kv_heads >= cfg.n_heads:
+        return False
+    if cfg.n_experts:
+        return False
+    if mesh is None:
+        return True
+    shape = dict(mesh.shape)
+    if any(sz > 1 for ax, sz in shape.items() if ax not in ("tp", "dp")):
+        return False
+    if shape.get("dp", 1) > 1:
+        from ..models.decoder import _paged_dp_axes
+        if _paged_dp_axes(cfg, mesh, cfg.n_kv_heads) is None:
+            return False
+    return True
 
 
 CACHE_DTYPES = {"bfloat16": jnp.bfloat16, "float32": jnp.float32,

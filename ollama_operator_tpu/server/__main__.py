@@ -46,7 +46,9 @@ def main(argv=None):
                         "the decode cache traffic, double the context; "
                         "default bfloat16 on TPU, float32 on CPU)")
     p.add_argument("--max-slots", type=int,
-                   default=int(os.environ.get("TPU_MAX_SLOTS", "8")))
+                   default=int(os.environ.get("TPU_MAX_SLOTS", "0")),
+                   help="continuous-batching slots (0 = per-model default:"
+                        " 32 paged, 8 dense)")
     p.add_argument("--decode-chunk", type=int,
                    default=int(os.environ.get("TPU_DECODE_CHUNK", "8")),
                    help="decode steps per device round-trip (higher = "
@@ -70,11 +72,19 @@ def main(argv=None):
                         "paged page pool) shard over dp (0 = derive from "
                         "devices left over after tp/sp/ep; note replicas "
                         "in the CRD fan out dp across PODS instead)")
+    _paged_env = os.environ.get("TPU_PAGED", "")
+    if _paged_env not in ("", "0", "1"):
+        # 'false'/'off'/... must not silently resolve to the auto default
+        # (which could page the very pod that asked for dense)
+        p.error(f"TPU_PAGED={_paged_env!r}: expected 1, 0, or unset")
     p.add_argument("--paged", action="store_true",
-                   default=os.environ.get("TPU_PAGED", "") == "1",
+                   default=({"1": True, "0": False}.get(_paged_env, None)),
                    help="paged KV cache: slots share a physical page pool "
                         "so HBM scales with live tokens, not max_slots × "
-                        "max_seq_len (single-device / tp-only meshes)")
+                        "max_seq_len. Unset = per-model default (paged "
+                        "for GQA models — measured 1.90x the dense "
+                        "aggregate; dense for MHA/MoE); TPU_PAGED=0 "
+                        "forces dense")
     p.add_argument("--page-size", type=int,
                    default=int(os.environ.get("TPU_PAGE_SIZE", "64")))
     p.add_argument("--n-pages", type=int,

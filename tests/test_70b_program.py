@@ -56,3 +56,16 @@ def test_paged_pool_compiles_and_fits(proof):
     assert pool["compiled"]          # real-dims paged decode program
     assert pool["slots"] == 32 and pool["fits_v5e"]
     assert pool["per_device_total_gb"] < 14.5
+
+
+def test_int4_quarter_slice(proof):
+    """llama2:70b int4 on a v5e-4 — a QUARTER of the north-star slice:
+    packed nibbles + f32 scales ≈ 0.63 B/weight, and the real-dimension
+    tp4 decode program compiles with collectives present."""
+    q = proof["int4_quarter_slice"]
+    assert q["compiled"] and q["fits_v5e"]
+    # ~0.63 B/weight on ~69B params
+    assert 40 < q["global_param_gb"] < 48
+    assert q["per_device_param_gb"] == pytest.approx(
+        q["global_param_gb"] / 4, rel=0.02)
+    assert q["per_device_total_gb"] < 14.5
