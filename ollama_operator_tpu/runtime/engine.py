@@ -75,6 +75,33 @@ class EngineConfig:
     n_pages: Optional[int] = None
 
 
+def resolve_serving_defaults(ecfg: "EngineConfig", cfg: ModelConfig,
+                             mesh) -> "EngineConfig":
+    """Resolve the server's tri-state knobs into a concrete EngineConfig.
+
+    - ``paged=None`` → resolve_paged_default (GQA on TPU pages, MHA/MoE/
+      CPU stay dense; explicit True/False passes through).
+    - ``max_slots=0`` → 32 paged / 8 dense.
+    - When paged resolved on with auto slots and no explicit pool size,
+      the pool is capped at the OLD dense default's HBM ceiling
+      (8 × serving max_seq of pages): the 32 slots share it, so the
+      default footprint is unchanged and mixed-length concurrency
+      quadruples; full-length overload preempts/requeues instead of
+      OOMing at load.
+    """
+    if ecfg.paged is not None and ecfg.max_slots != 0:
+        return ecfg
+    paged = (resolve_paged_default(cfg, mesh) if ecfg.paged is None
+             else ecfg.paged)
+    slots = ecfg.max_slots or (32 if paged else 8)
+    n_pages = ecfg.n_pages
+    if paged and n_pages is None and ecfg.max_slots == 0:
+        serve_seq = min(ecfg.max_seq_len, cfg.max_seq_len)
+        n_pages = max(1, (8 * serve_seq) // ecfg.page_size)
+    return dataclasses.replace(ecfg, paged=paged, max_slots=slots,
+                               n_pages=n_pages)
+
+
 def resolve_paged_default(cfg: ModelConfig, mesh) -> bool:
     """The serving default for an unset paged flag, per model and mesh.
 

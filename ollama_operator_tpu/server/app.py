@@ -40,7 +40,7 @@ import numpy as np
 from .. import __version__
 from ..gguf.reader import GGUFFile
 from ..gguf.transcode import load_model as transcode_load
-from ..runtime.engine import EngineConfig
+from ..runtime.engine import EngineConfig, resolve_serving_defaults
 from ..runtime.errors import BadRequest
 from ..runtime.scheduler import SchedulerBroken, SchedulerBusy
 from ..runtime.service import LoadedModel
@@ -374,27 +374,10 @@ class ModelManager:
             ecfg = self.ecfg or EngineConfig(
                 max_seq_len=min(cfg.max_seq_len,
                                 int(default_params.get("num_ctx", 4096))))
-            if ecfg.paged is None or ecfg.max_slots == 0:
-                # tri-state serving defaults, resolved per model: paged
-                # for GQA on TPU (measured 1.90x the dense aggregate),
-                # dense for MHA/MoE (engine.resolve_paged_default). When
-                # paged resolves ON, the pool is sized to the OLD dense
-                # default's HBM ceiling (8 slots x max_seq) — the 32
-                # slots share it, so the default's footprint is unchanged
-                # and mixed-length concurrency quadruples; full-length
-                # overload preempts/requeues instead of OOMing at load.
-                import dataclasses
-                from ..runtime.engine import resolve_paged_default
-                paged = (resolve_paged_default(cfg, self.mesh)
-                         if ecfg.paged is None else ecfg.paged)
-                slots = ecfg.max_slots or (32 if paged else 8)
-                n_pages = ecfg.n_pages
-                if paged and n_pages is None and ecfg.max_slots == 0:
-                    serve_seq = min(ecfg.max_seq_len, cfg.max_seq_len)
-                    n_pages = max(1, (8 * serve_seq) // ecfg.page_size)
-                ecfg = dataclasses.replace(ecfg, paged=paged,
-                                           max_slots=slots,
-                                           n_pages=n_pages)
+            # tri-state serving defaults, resolved per model: paged for
+            # GQA on TPU (measured 2x the dense aggregate), dense for
+            # MHA/MoE/CPU, pool capped at the old dense-8 HBM ceiling
+            ecfg = resolve_serving_defaults(ecfg, cfg, self.mesh)
             if self.control_plane is not None:
                 # followers pull the same layers from their own store and
                 # replay this load; their first mirrored engine call

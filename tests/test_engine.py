@@ -275,3 +275,33 @@ def test_resolve_paged_default():
             gqa, make_mesh(MeshPlan(tp=2))) is True
         assert resolve_paged_default(
             gqa, make_mesh(MeshPlan(dp=2))) is True
+
+
+def test_resolve_serving_defaults():
+    """Tri-state knob resolution incl. the pool-ceiling guarantee: the
+    auto-paged default must NOT grow HBM past the old dense-8 footprint."""
+    from unittest import mock
+
+    from ollama_operator_tpu.runtime.engine import resolve_serving_defaults
+    gqa = cfglib.PRESETS["tiny"]                       # max_seq_len 128
+    base = EngineConfig(max_slots=0, max_seq_len=4096, paged=None,
+                        page_size=16)
+    with mock.patch("jax.default_backend", return_value="tpu"):
+        r = resolve_serving_defaults(base, gqa, None)
+        assert r.paged is True and r.max_slots == 32
+        # ceiling uses the SERVING seq (engine clamps to the model's 128)
+        assert r.n_pages == 8 * 128 // 16
+        # explicit slots: user asked for scale — dense-equivalent pool
+        r2 = resolve_serving_defaults(
+            EngineConfig(max_slots=16, max_seq_len=4096, paged=None,
+                         page_size=16), gqa, None)
+        assert r2.paged is True and r2.max_slots == 16
+        assert r2.n_pages is None
+        # explicit dense stays dense with 8 slots
+        r3 = resolve_serving_defaults(
+            EngineConfig(max_slots=0, max_seq_len=4096, paged=False),
+            gqa, None)
+        assert r3.paged is False and r3.max_slots == 8
+    # CPU backend: auto resolves dense
+    r4 = resolve_serving_defaults(base, gqa, None)
+    assert r4.paged is False and r4.max_slots == 8
