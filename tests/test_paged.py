@@ -228,3 +228,22 @@ def test_scheduler_paged_full_flow_no_pressure(params):
             sched.shutdown()
 
     assert run(PAGED) == run(DENSE)
+
+
+@pytest.mark.parametrize("kernels,cache_dtype", [
+    ("interpret", jnp.float32),
+    ("interpret", jnp.int8),
+])
+def test_paged_engine_mha_matches_dense(kernels, cache_dtype):
+    """MHA pools (G=1) route through the VPU paged kernel branch
+    (_paged_kernel_mha — no per-head dots); greedy output must match the
+    dense engine. KvH=8 keeps the sublane-alignment gate satisfied."""
+    mha_cfg = dataclasses.replace(BASE, n_heads=8, n_kv_heads=8,
+                                  kernels=kernels)
+    mha_xla = dataclasses.replace(mha_cfg, kernels="xla")
+    p = decoder.init_params(mha_cfg, jax.random.key(3), jnp.float32)
+    dense = dataclasses.replace(DENSE, cache_dtype=cache_dtype)
+    paged = dataclasses.replace(PAGED, cache_dtype=cache_dtype)
+    ref = _greedy_run(mha_xla, dense, p)
+    got = _greedy_run(mha_cfg, paged, p)
+    assert got == ref, (got, ref)
