@@ -241,6 +241,51 @@ def load_baseline(metric: str) -> float | None:
 # ---------------------------------------------------------------------------
 
 
+def _init_quantized_leafwise(jax, cfg, decoder, bits: int):
+    """Random params for big models, materialised one leaf at a time:
+    each bf16 leaf is generated on device, quantized (donated) if it is
+    a quantizable matmul leaf, and only then does the next leaf
+    materialise — peak HBM = quantized tree + one bf16 leaf."""
+    import jax.numpy as jnp
+
+    from ollama_operator_tpu.ops.quant import (QUANT_LAYER_KEYS,
+                                               QUANT_TOP_KEYS,
+                                               quantize_groupwise,
+                                               quantize_groupwise_int4)
+    quant = quantize_groupwise if bits == 8 else quantize_groupwise_int4
+    avals = jax.eval_shape(
+        lambda k: decoder.init_params(cfg, k, dtype=jnp.bfloat16),
+        jax.random.key(0))
+
+    def gen(key, aval):
+        mk = jax.jit(lambda k: (jax.random.normal(k, aval.shape,
+                                                  jnp.float32)
+                                * 0.02).astype(aval.dtype))
+        return mk(key)
+
+    out = {}
+    ki = 0
+    for name, sub in avals.items():
+        if name == "layers":
+            lo = {}
+            for lk, aval in sub.items():
+                leaf = gen(jax.random.key(ki), aval)
+                ki += 1
+                if lk in QUANT_LAYER_KEYS:
+                    leaf = quant(leaf)
+                jax.block_until_ready(leaf)
+                lo[lk] = leaf
+            out[name] = lo
+        else:
+            leaf = gen(jax.random.key(ki), sub)
+            ki += 1
+            if name in QUANT_TOP_KEYS:
+                leaf = quant(leaf)
+            jax.block_until_ready(leaf)
+            out[name] = leaf
+    return out
+
+
 def measure(jax, *, model: str, dtype: str, slots: int, steps: int,
             seq: int, prompt_len: int, paged: bool, mixed: bool,
             chunk: int, page_size: int, n_pages: int | None,
@@ -285,14 +330,23 @@ def measure(jax, *, model: str, dtype: str, slots: int, steps: int,
             params_cache.clear()   # free the previous model's HBM first
             gc.collect()
         t0 = time.perf_counter()
-        params = decoder.init_params(
-            cfg, jax.random.key(0),
-            dtype=jnp.float32 if on_cpu else jnp.bfloat16)
-        jax.block_until_ready(params)
-        if dtype in ("int8", "int4"):
-            if cfg.n_experts:
-                dtype = "bfloat16"   # MoE expert stacks serve dense
-            else:
+        if dtype in ("int8", "int4") and cfg.n_experts:
+            dtype = "bfloat16"       # MoE expert stacks serve dense
+        if dtype in ("int8", "int4") and not on_cpu \
+                and cfg.n_params > 3e9:
+            # 7B-class models: the whole-tree bf16 init (13.4+ GB) OOMs
+            # a shared 16 GB chip before quantization can halve it —
+            # init + quantize LEAF BY LEAF instead, so peak HBM is the
+            # quantized tree plus ONE bf16 leaf (a real pull quantizes
+            # host-side during transcode; this is bench-only synthesis)
+            params = _init_quantized_leafwise(
+                jax, cfg, decoder, bits=4 if dtype == "int4" else 8)
+        else:
+            params = decoder.init_params(
+                cfg, jax.random.key(0),
+                dtype=jnp.float32 if on_cpu else jnp.bfloat16)
+            jax.block_until_ready(params)
+            if dtype in ("int8", "int4"):
                 # weight-only quantized serving (ops/quant.py): decode is
                 # HBM-bound, so weight bytes set the step floor — int8
                 # halves bf16's, int4 packs two codes per byte
