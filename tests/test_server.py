@@ -477,3 +477,40 @@ def test_generate_suffix_fim(stack):
              {"model": "tiny-fim", "prompt": "p1", "suffix": "s1",
               "stream": False, "options": {"num_predict": 4}})
     assert r["done"] is True
+
+
+def test_int4_server_generates(tmp_path):
+    """--dtype int4 end-to-end over HTTP: pull -> transcode -> packed-int4
+    quantize at load (app.py engine_dtype gate) -> /api/generate. On the
+    CPU backend int4_mm_kernels keeps the portable XLA matmul path."""
+    cfg = cfglib.PRESETS["tiny"]
+    params = decoder.init_params(cfg, jax.random.PRNGKey(0),
+                                 dtype=jnp.float32)
+    gguf_path = str(tmp_path / "tiny.gguf")
+    write_tiny_llama_gguf(gguf_path, cfg, params)
+    reg = FakeRegistry()
+    url = reg.start()
+    with open(gguf_path, "rb") as f:
+        reg.add_model("library", "tiny", "latest", f.read(),
+                      params={"temperature": 0.0, "num_predict": 6})
+    manager = ModelManager(str(tmp_path / "store"),
+                           cache_dir=str(tmp_path / "cache"),
+                           ecfg=EngineConfig(max_slots=2, max_seq_len=128,
+                                             cache_dtype=jnp.float32,
+                                             min_prefill_bucket=16),
+                           engine_dtype="int4")
+    httpd = serve(manager, "127.0.0.1", 0)
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        name = f"http://{url.split('://')[1]}/library/tiny:latest"
+        post(base, "/api/pull", {"model": name}, stream=True)
+        out = post(base, "/api/generate",
+                   {"model": name, "prompt": "t1 t2", "stream": False,
+                    "options": {"num_predict": 6}})
+        assert out["done"] and out["eval_count"] == 6
+        from ollama_operator_tpu.ops.quant import is_int4
+        lm = manager.loaded
+        assert is_int4(lm.engine.params["layers"]["wq"])
+    finally:
+        httpd.shutdown()
+        reg.stop()
