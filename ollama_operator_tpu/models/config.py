@@ -145,6 +145,20 @@ PRESETS = {
     "llama3:70b": _mk(arch="llama", vocab_size=128256, dim=8192, n_layers=80,
                       n_heads=64, n_kv_heads=8, head_dim=128, ffn_dim=28672,
                       rope_theta=500000.0, max_seq_len=8192),
+    # llama3.1 shares llama3-8B dims (longer context via llama3-type rope
+    # scaling, carried by the GGUF metadata on real pulls); 3.2 are the
+    # small GQA variants — both tie embeddings
+    "llama3.1": _mk(arch="llama", vocab_size=128256, dim=4096, n_layers=32,
+                    n_heads=32, n_kv_heads=8, head_dim=128, ffn_dim=14336,
+                    rope_theta=500000.0, max_seq_len=8192),
+    "llama3.2:1b": _mk(arch="llama", vocab_size=128256, dim=2048,
+                       n_layers=16, n_heads=32, n_kv_heads=8, head_dim=64,
+                       ffn_dim=8192, rope_theta=500000.0,
+                       tie_embeddings=True, max_seq_len=8192),
+    "llama3.2:3b": _mk(arch="llama", vocab_size=128256, dim=3072,
+                       n_layers=28, n_heads=24, n_kv_heads=8, head_dim=128,
+                       ffn_dim=8192, rope_theta=500000.0,
+                       tie_embeddings=True, max_seq_len=8192),
     "mistral": _mk(arch="llama", vocab_size=32000, dim=4096, n_layers=32,
                    n_heads=32, n_kv_heads=8, head_dim=128, ffn_dim=14336,
                    sliding_window=4096, max_seq_len=32768),
@@ -169,6 +183,11 @@ PRESETS = {
     "qwen2": _mk(arch="llama", vocab_size=152064, dim=3584, n_layers=28,
                  n_heads=28, n_kv_heads=4, head_dim=128, ffn_dim=18944,
                  attn_bias=True, rope_theta=1000000.0, max_seq_len=32768),
+    # qwen2.5-7B keeps qwen2-7B's architecture/dims
+    "qwen2.5": _mk(arch="llama", vocab_size=152064, dim=3584, n_layers=28,
+                   n_heads=28, n_kv_heads=4, head_dim=128, ffn_dim=18944,
+                   attn_bias=True, rope_theta=1000000.0,
+                   max_seq_len=32768),
     "qwen2:0.5b": _mk(arch="llama", vocab_size=151936, dim=896, n_layers=24,
                       n_heads=14, n_kv_heads=2, head_dim=64, ffn_dim=4864,
                       attn_bias=True, tie_embeddings=True,
