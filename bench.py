@@ -286,6 +286,56 @@ def _init_quantized_leafwise(jax, cfg, decoder, bits: int):
     return out
 
 
+def _bench_params(jax, cfg, model: str, dtype: str, on_cpu: bool,
+                  params_cache: dict | None):
+    """Initialized (and possibly quantized) bench params, via the shared
+    cache so adjacent same-model captures skip the minutes-long init.
+    Returns (params, param_bytes, resolved_dtype)."""
+    import gc
+
+    import jax.numpy as jnp
+
+    from ollama_operator_tpu.models import decoder
+
+    cache_key = (model, dtype)
+    if params_cache is not None and cache_key in params_cache:
+        log("bench: reusing cached params")
+        return params_cache[cache_key]
+    if params_cache:
+        params_cache.clear()   # free the previous model's HBM first
+        gc.collect()
+    t0 = time.perf_counter()
+    if dtype in ("int8", "int4") and cfg.n_experts:
+        dtype = "bfloat16"       # MoE expert stacks serve dense
+    if dtype in ("int8", "int4") and not on_cpu and cfg.n_params > 3e9:
+        # 7B-class models: the whole-tree bf16 init (13.4+ GB) OOMs
+        # a shared 16 GB chip before quantization can halve it —
+        # init + quantize LEAF BY LEAF instead, so peak HBM is the
+        # quantized tree plus ONE bf16 leaf (a real pull quantizes
+        # host-side during transcode; this is bench-only synthesis)
+        params = _init_quantized_leafwise(
+            jax, cfg, decoder, bits=4 if dtype == "int4" else 8)
+    else:
+        params = decoder.init_params(
+            cfg, jax.random.key(0),
+            dtype=jnp.float32 if on_cpu else jnp.bfloat16)
+        jax.block_until_ready(params)
+        if dtype in ("int8", "int4"):
+            # weight-only quantized serving (ops/quant.py): decode is
+            # HBM-bound, so weight bytes set the step floor — int8
+            # halves bf16's, int4 packs two codes per byte
+            from ollama_operator_tpu.ops.quant import quantize_params
+            params = quantize_params(
+                params, bits=4 if dtype == "int4" else 8)
+            jax.block_until_ready(params)
+    param_bytes = sum(x.nbytes for x in jax.tree.leaves(params))
+    log(f"params init ({cfg.n_params/1e9:.2f}B, serve dtype={dtype}, "
+        f"{param_bytes/1e9:.2f} GB) in {time.perf_counter()-t0:.1f}s")
+    if params_cache is not None:
+        params_cache[cache_key] = (params, param_bytes, dtype)
+    return params, param_bytes, dtype
+
+
 def measure(jax, *, model: str, dtype: str, slots: int, steps: int,
             seq: int, prompt_len: int, paged: bool, mixed: bool,
             chunk: int, page_size: int, n_pages: int | None,
@@ -321,44 +371,8 @@ def measure(jax, *, model: str, dtype: str, slots: int, steps: int,
     log(f"bench: capture model={model} dtype={dtype} slots={slots} "
         f"steps={steps} seq={seq} paged={paged} mixed={mixed} "
         f"env={env or {}}")
-    cache_key = (model, dtype)
-    if params_cache is not None and cache_key in params_cache:
-        params, param_bytes, dtype = params_cache[cache_key]
-        log("bench: reusing cached params")
-    else:
-        if params_cache:
-            params_cache.clear()   # free the previous model's HBM first
-            gc.collect()
-        t0 = time.perf_counter()
-        if dtype in ("int8", "int4") and cfg.n_experts:
-            dtype = "bfloat16"       # MoE expert stacks serve dense
-        if dtype in ("int8", "int4") and not on_cpu \
-                and cfg.n_params > 3e9:
-            # 7B-class models: the whole-tree bf16 init (13.4+ GB) OOMs
-            # a shared 16 GB chip before quantization can halve it —
-            # init + quantize LEAF BY LEAF instead, so peak HBM is the
-            # quantized tree plus ONE bf16 leaf (a real pull quantizes
-            # host-side during transcode; this is bench-only synthesis)
-            params = _init_quantized_leafwise(
-                jax, cfg, decoder, bits=4 if dtype == "int4" else 8)
-        else:
-            params = decoder.init_params(
-                cfg, jax.random.key(0),
-                dtype=jnp.float32 if on_cpu else jnp.bfloat16)
-            jax.block_until_ready(params)
-            if dtype in ("int8", "int4"):
-                # weight-only quantized serving (ops/quant.py): decode is
-                # HBM-bound, so weight bytes set the step floor — int8
-                # halves bf16's, int4 packs two codes per byte
-                from ollama_operator_tpu.ops.quant import quantize_params
-                params = quantize_params(
-                    params, bits=4 if dtype == "int4" else 8)
-                jax.block_until_ready(params)
-        param_bytes = sum(x.nbytes for x in jax.tree.leaves(params))
-        log(f"params init ({cfg.n_params/1e9:.2f}B, serve dtype={dtype}, "
-            f"{param_bytes/1e9:.2f} GB) in {time.perf_counter()-t0:.1f}s")
-        if params_cache is not None:
-            params_cache[cache_key] = (params, param_bytes, dtype)
+    params, param_bytes, dtype = _bench_params(
+        jax, cfg, model, dtype, on_cpu, params_cache)
 
     devs = jax.devices()
     mesh = None
@@ -489,6 +503,276 @@ def measure(jax, *, model: str, dtype: str, slots: int, steps: int,
     return rec
 
 
+def measure_spec(jax, *, model: str, dtype: str, slots: int, steps: int,
+                 seq: int, prompt_len: int, paged: bool, mixed: bool,
+                 chunk: int, page_size: int, n_pages: int | None,
+                 platform: str, params_cache: dict | None = None,
+                 env: dict | None = None, spec_k: int = 4) -> dict:
+    """Speculative-decoding envelope (VERDICT r3 #7): greedy slots driven
+    through engine.decode_spec with (a) known-correct drafts — accept-all,
+    the scheme's ceiling — and (b) garbage drafts — reject-all, its floor —
+    against the plain decode_n baseline. Prompt-lookup's real acceptance
+    rate lands between these depending on how repetitive the workload is;
+    the envelope is what a serving default can be decided from."""
+    import gc
+
+    import jax.numpy as jnp
+
+    from ollama_operator_tpu.models.config import get_config
+    from ollama_operator_tpu.runtime.engine import (Engine, EngineConfig,
+                                                    SlotOptions,
+                                                    resolve_cache_dtype)
+
+    on_cpu = platform == "cpu"
+    if on_cpu:
+        dtype = "float32"
+    kv_dtype = resolve_cache_dtype(
+        os.environ.get("BENCH_KV_DTYPE", "float32" if on_cpu else "int8"))
+    cfg = get_config(model)
+    log(f"bench: SPEC capture model={model} dtype={dtype} slots={slots} "
+        f"k={spec_k}")
+    params, param_bytes, dtype = _bench_params(
+        jax, cfg, model, dtype, on_cpu, params_cache)
+    if dtype == "int4":
+        from ollama_operator_tpu.ops.quant import int4_mm_kernels
+        cfg = int4_mm_kernels(cfg, None)
+    eng = Engine(cfg, params,
+                 ecfg=EngineConfig(max_slots=slots, max_seq_len=seq,
+                                   decode_chunk=chunk,
+                                   cache_dtype=kv_dtype))
+    greedy = SlotOptions(temperature=0.0, repeat_penalty=1.0)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, cfg.vocab_size, size=prompt_len,
+                            endpoint=False).astype(np.int32)
+               for _ in range(slots)]
+
+    def admit_all():
+        return [eng.admit(s, prompts[s], greedy) for s in range(slots)]
+
+    admit_all()
+    eng.warm_buckets()
+    # record the true greedy continuation — the accept-all draft source —
+    # and time the plain decode_n baseline on the same work
+    calls = max(1, steps // chunk)
+    eng.decode_n()                      # warm the chunk program
+    t0 = time.perf_counter()
+    recs = [eng.decode_n() for _ in range(calls)]
+    base_dt = time.perf_counter() - t0
+    n_steps = calls * chunk
+    base_tok_s = n_steps * slots / base_dt
+    # continuation per slot, starting right after the warm chunk
+    cont = np.concatenate(recs, axis=0).T          # [B, n_steps]
+
+    k = spec_k
+    exp_steps = (n_steps // (k + 1)) * (k + 1)
+
+    def run_spec(draft_fn, label):
+        for s in range(slots):
+            eng.release(s)
+        admit_all()
+        eng.decode_n()                  # same warm chunk → positions align
+        pos = np.zeros(slots, np.int64)
+        # warm the spec program on a throwaway dispatch, then rewind by
+        # re-admitting (compile must not land in the timing)
+        eng.decode_spec(draft_fn(pos))
+        for s in range(slots):
+            eng.release(s)
+        admit_all()
+        eng.decode_n()
+        pos = np.zeros(slots, np.int64)
+        dispatches = 0
+        t0 = time.perf_counter()
+        while pos.min() < exp_steps and dispatches < 4 * n_steps:
+            toks = eng.decode_spec(draft_fn(pos))
+            pos = pos + (toks < cfg.vocab_size).sum(axis=1)
+            dispatches += 1
+        dt = time.perf_counter() - t0
+        emitted = int(pos.sum())
+        rec = {"label": label, "tok_s": round(emitted / dt, 2),
+               "dispatches": dispatches,
+               "ms_per_dispatch": round(dt / max(dispatches, 1) * 1e3, 2),
+               "tokens_per_dispatch": round(emitted / max(dispatches, 1),
+                                            2)}
+        log(f"bench: spec {label}: {json.dumps(rec)}")
+        return rec
+
+    def true_drafts(pos):
+        d = np.zeros((slots, k), np.int32)
+        for b in range(slots):
+            p = int(pos[b])
+            seg = cont[b, p:p + k]
+            d[b, :len(seg)] = seg
+        return d
+
+    def junk_drafts(pos):
+        return np.full((slots, k), cfg.vocab_size - 1, np.int32)
+
+    best = run_spec(true_drafts, "accept_all")
+    worst = run_spec(junk_drafts, "reject_all")
+    rec = {
+        "model": model,
+        "mode": f"spec_decode_k{k}",
+        "tok_s": best["tok_s"],                  # headline: the ceiling
+        "baseline_tok_s": round(base_tok_s, 2),
+        "accept_all": best,
+        "reject_all": worst,
+        "speedup_ceiling": round(best["tok_s"] / base_tok_s, 3),
+        "overhead_floor": round(worst["tok_s"] / base_tok_s, 3),
+        "slots": slots, "steps": n_steps, "dtype": dtype,
+        "decode_chunk": chunk,
+    }
+    if env:
+        rec["env"] = dict(env)
+    log(f"bench: spec capture done: {json.dumps(rec)}")
+    del eng, params
+    gc.collect()
+    return rec
+
+
+def _bench_tokenizer(vocab_size: int):
+    """A byte-fallback llama tokenizer over a synthetic vocab: any prompt
+    text encodes (one byte token per char), so the HTTP capture's prompt
+    length is controllable without a real model's vocab."""
+    from ollama_operator_tpu.tokenizer.tokenizer import (TT_BYTE, TT_CONTROL,
+                                                         TT_NORMAL, Tokenizer)
+    toks = ["<unk>", "<s>", "</s>"]
+    tt = [TT_CONTROL, TT_CONTROL, TT_CONTROL]
+    for i in range(256):
+        toks.append(f"<0x{i:02X}>")
+        tt.append(TT_BYTE)
+    while len(toks) < vocab_size:
+        toks.append(f"<fill{len(toks)}>")
+        tt.append(TT_NORMAL)
+    return Tokenizer("llama", toks[:vocab_size],
+                     token_types=tt[:vocab_size], bos_id=1, eos_id=-1)
+
+
+def measure_http(jax, *, model: str, dtype: str, slots: int, steps: int,
+                 seq: int, prompt_len: int, paged: bool, mixed: bool,
+                 chunk: int, page_size: int, n_pages: int | None,
+                 platform: str, params_cache: dict | None = None,
+                 env: dict | None = None) -> dict:
+    """One capture through the REAL server: ModelManager + the Ollama
+    /api/generate surface over sockets, concurrent streaming clients —
+    the surface BASELINE.json's metric names (and the reference probes,
+    /root/reference/pkg/model/pod.go:41-64). The delta vs the engine-level
+    capture quantifies HTTP + scheduler + tokenize overhead."""
+    import gc
+    import json as _json
+    import tempfile
+    import threading
+    import urllib.request
+
+    from ollama_operator_tpu.models.config import get_config
+    from ollama_operator_tpu.runtime.engine import (EngineConfig,
+                                                    resolve_cache_dtype)
+    from ollama_operator_tpu.runtime.service import LoadedModel
+    from ollama_operator_tpu.server.app import ModelManager, serve
+    from ollama_operator_tpu.server.names import ModelName
+
+    on_cpu = platform == "cpu"
+    if on_cpu:
+        dtype = "float32"
+    kv_dtype = resolve_cache_dtype(
+        os.environ.get("BENCH_KV_DTYPE", "float32" if on_cpu else "int8"))
+    cfg = get_config(model)
+    log(f"bench: HTTP capture model={model} dtype={dtype} slots={slots} "
+        f"steps={steps} paged={paged}")
+    params, param_bytes, dtype = _bench_params(
+        jax, cfg, model, dtype, on_cpu, params_cache)
+    if dtype == "int4":
+        from ollama_operator_tpu.ops.quant import int4_mm_kernels
+        cfg = int4_mm_kernels(cfg, None)
+
+    tok = _bench_tokenizer(cfg.vocab_size)
+    name = ModelName.parse("bench").short
+    lm = LoadedModel(
+        name, cfg, params, tok,
+        ecfg=EngineConfig(max_slots=slots, max_seq_len=seq,
+                          decode_chunk=chunk, cache_dtype=kv_dtype,
+                          paged=paged, page_size=page_size,
+                          n_pages=n_pages))
+    tmp = tempfile.mkdtemp(prefix="bench-http-")
+    manager = ModelManager(tmp, serve_models=True, default_keep_alive=-1)
+    manager.loaded = lm
+    httpd = serve(manager, "127.0.0.1", 0)
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+
+    prompt = "x" * prompt_len          # byte fallback: ~1 token per char
+    rng = np.random.default_rng(0)
+    lens = (rng.integers(max(8, prompt_len // 4), prompt_len + 1,
+                         size=slots) if mixed
+            else np.full(slots, prompt_len))
+
+    def generate(n_predict: int, plen: int, out: dict | None = None):
+        req = urllib.request.Request(
+            base + "/api/generate",
+            data=_json.dumps({
+                "model": "bench", "prompt": prompt[:plen], "stream": True,
+                "options": {"num_predict": n_predict, "temperature": 0.7,
+                            "seed": 7}}).encode(),
+            headers={"Content-Type": "application/json"})
+        t0 = time.perf_counter()
+        n = 0
+        first = True
+        with urllib.request.urlopen(req, timeout=600) as resp:
+            for line in resp:
+                if not line.strip():
+                    continue
+                if out is not None and first:
+                    out["ttft"] = time.perf_counter() - t0
+                    first = False
+                rec = _json.loads(line)
+                if rec.get("done"):
+                    # a stream line may carry several tokens (the server
+                    # flushes per decode dispatch) — the done record's
+                    # eval_count is the authoritative token count
+                    n = int(rec.get("eval_count") or n)
+                else:
+                    n += 1
+        if out is not None:
+            out["tokens"] = n
+
+    generate(2, int(lens[0]))          # warm the serving path end to end
+
+    results = [dict() for _ in range(slots)]
+    threads = [threading.Thread(target=generate,
+                                args=(steps, int(lens[i]), results[i]))
+               for i in range(slots)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+
+    total_tokens = sum(r.get("tokens", 0) for r in results)
+    ttfts = [r["ttft"] for r in results if "ttft" in r]
+    rec = {
+        "model": model,
+        "surface": "http",
+        "tok_s": round(total_tokens / wall, 2),
+        "ttft_p50_ms": round(float(np.median(ttfts)) * 1e3, 1),
+        "slots": slots,
+        "steps": steps,
+        "dtype": dtype,
+        "paged": paged,
+        "mixed_len": mixed,
+        "prompt_len": int(np.max(lens)),
+        "total_tokens": total_tokens,
+        "wall_s": round(wall, 2),
+    }
+    if env:
+        rec["env"] = dict(env)
+    log(f"bench: HTTP capture done: {json.dumps(rec)}")
+    httpd.shutdown()
+    manager.loaded = None
+    lm.unload()                        # stop the scheduler decode thread
+    del lm, params
+    gc.collect()
+    return rec
+
+
 def main() -> None:
     import jax
 
@@ -532,9 +816,11 @@ def main() -> None:
                  mixed=os.environ.get("BENCH_MIXED", "") == "1")
     if os.environ.get("BENCH_MODEL"):
         # pinned single capture — manual runs / CPU fallback keep the old
-        # knob semantics exactly
+        # knob semantics exactly; BENCH_HTTP=1 drives it through the real
+        # server instead of the bare engine
         plan = [dict(model=os.environ["BENCH_MODEL"],
-                     dtype=os.environ.get("BENCH_DTYPE", "int8"), **knobs)]
+                     dtype=os.environ.get("BENCH_DTYPE", "int8"),
+                     http=os.environ.get("BENCH_HTTP", "") == "1", **knobs)]
     elif platform == "cpu":
         # unpinned CPU smoke: tiny model, but every knob still applies
         plan = [dict(model="tiny", dtype="float32",
@@ -552,8 +838,19 @@ def main() -> None:
         plan = [
             dict(model="phi", dtype="int8", slots=8, steps=64, seq=1024,
                  prompt_len=128, paged=False, mixed=False),
+            # the same serving config measured THROUGH /api/generate
+            # (the surface the metric names) — params reused from cap 1,
+            # delta vs cap 1 = HTTP + scheduler + tokenize overhead
+            dict(model="phi", dtype="int8", slots=8, steps=64, seq=1024,
+                 prompt_len=128, paged=False, mixed=False, http=True),
+            # the GQA paged flagship on the v3 default kernel, then the
+            # v2 REVERT arm (TPU_PAGED_V3 defaults ON since r4 — the A/B
+            # baseline must explicitly opt back into the grid kernel)
             dict(model="tinyllama", dtype="int8", slots=32, steps=64,
                  seq=1024, prompt_len=128, paged=True, mixed=True),
+            dict(model="tinyllama", dtype="int8", slots=32, steps=64,
+                 seq=1024, prompt_len=128, paged=True, mixed=True,
+                 env={"TPU_PAGED_V3": "0"}),
             dict(model="tinyllama", dtype="int8", slots=8, steps=64,
                  seq=1024, prompt_len=128, paged=False, mixed=False),
             # MHA decode-kernel A/B vs capture 1 (same config, kernel
@@ -561,14 +858,22 @@ def main() -> None:
             dict(model="phi", dtype="int8", slots=8, steps=64, seq=1024,
                  prompt_len=128, paged=False, mixed=False,
                  env={"TPU_MHA_KERNEL": "1"}),
+            # speculative-decoding envelope BEFORE the int4 A/B so the
+            # (phi, int8) params cache survives into it (the int4 entry
+            # evicts the single-model cache)
+            dict(model="phi", dtype="int8", slots=8, steps=64, seq=1024,
+                 prompt_len=128, paged=False, mixed=False, spec=True),
             # int4 A/B vs capture 1: packed nibbles through the fused
             # pallas qmm (capacity feature; bandwidth parity tracked)
             dict(model="phi", dtype="int4", slots=8, steps=64, seq=1024,
                  prompt_len=128, paged=False, mixed=False),
-            # MHA paged diagnostic: per-head-dot-bound (BASELINE r3) —
-            # the serving default keeps MHA dense, this tracks the gap
+            # MHA paged (pages by default since the v3 kernel): the v3
+            # number, then the v2-revert diagnostic tracking the old gap
             dict(model="phi", dtype="int8", slots=32, steps=64, seq=1024,
                  prompt_len=128, paged=True, mixed=True),
+            dict(model="phi", dtype="int8", slots=32, steps=64, seq=1024,
+                 prompt_len=128, paged=True, mixed=True,
+                 env={"TPU_PAGED_V3": "0"}),
         ]
 
     captures = []
@@ -588,8 +893,12 @@ def main() -> None:
         cap_env = cap.get("env") or {}
         saved_env = {k: os.environ.get(k) for k in cap_env}
         os.environ.update(cap_env)
+        http = cap.pop("http", False)
+        spec = cap.pop("spec", False)
         try:
-            captures.append(measure(jax, **cap, **common))
+            fn = (measure_http if http
+                  else measure_spec if spec else measure)
+            captures.append(fn(jax, **cap, **common))
         except Exception as e:   # a later capture must not void the headline
             if i == 0:
                 raise
@@ -622,12 +931,15 @@ def assemble(captures: list, platform: str, n_devices: int) -> str:
         "value": head["tok_s"],
         "unit": "tok/s",
         "vs_baseline": round(vs, 3),
-        "ttft_p50_ms": head["ttft_p50_ms"],
-        "decode_step_ms": head["decode_step_ms"],
+        # surface-level captures (http/spec) don't carry every
+        # engine-capture field — the headline is normally capture 0
+        # (engine), but a pinned BENCH_HTTP run must still assemble
+        "ttft_p50_ms": head.get("ttft_p50_ms"),
+        "decode_step_ms": head.get("decode_step_ms"),
         "slots": head["slots"],
         "platform": platform,
         "dtype": head["dtype"],
-        "paged": head["paged"],
+        "paged": head.get("paged"),
         "n_devices": n_devices,
         "captures": captures,
     })

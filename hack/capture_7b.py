@@ -1,0 +1,69 @@
+"""One-shot 7B-class TPU capture (VERDICT r3 item #2: the metric model).
+
+Runs the shipped bench.measure() against the real chip for the 7B-class
+configs the round-3 window could not fit at int8: int4 weights (~3.9 GB)
+plus the int8 paged pool fit where int8's 6.9 GB did not. Writes one JSON
+record per completed capture to .bench_7b.jsonl so a mid-run tunnel drop
+still keeps the finished ones.
+
+Usage: python hack/capture_7b.py [out_path]
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+
+
+def main() -> int:
+    out_path = sys.argv[1] if len(sys.argv) > 1 else ".bench_7b.jsonl"
+    import jax
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    devs = jax.devices()
+    platform = devs[0].platform
+    bench.log(f"capture_7b: devices={[str(d) for d in devs]}")
+    if platform == "cpu":
+        bench.log("capture_7b: no TPU — refusing (this capture is the "
+                  "hardware evidence, a CPU number is useless)")
+        return 1
+
+    plan = [
+        # the 7B-class GQA flagship: dense per-chip number first
+        dict(model="mistral", dtype="int4", slots=8, steps=64, seq=1024,
+             prompt_len=128, paged=False, mixed=False),
+        # the paged pool at serving concurrency (GQA → pages by default)
+        dict(model="mistral", dtype="int4", slots=32, steps=64, seq=1024,
+             prompt_len=128, paged=True, mixed=True),
+        # the metric model by name (BASELINE.json: llama2-7b). MHA → dense.
+        dict(model="llama2", dtype="int4", slots=8, steps=64, seq=1024,
+             prompt_len=128, paged=False, mixed=False),
+    ]
+    cache: dict = {}
+    common = dict(chunk=32, page_size=64, n_pages=None, platform=platform,
+                  params_cache=cache)
+    f = open(out_path, "a")
+    ok = 0
+    for cap in plan:
+        t0 = time.monotonic()
+        try:
+            rec = bench.measure(jax, **cap, **common)
+        except Exception as e:  # keep going: each capture stands alone
+            bench.log(f"capture_7b: {cap['model']} paged={cap['paged']} "
+                      f"FAILED after {time.monotonic()-t0:.0f}s: "
+                      f"{type(e).__name__}: {e}")
+            continue
+        rec["wall_s"] = round(time.monotonic() - t0, 1)
+        print(json.dumps(rec), file=f, flush=True)
+        ok += 1
+    f.close()
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,57 @@
+"""llama2-7b int4 dense capture (the BASELINE.json metric model), plus a
+mistral paged retry at a smaller bucket count. Appends to .bench_7b.jsonl.
+Split from capture_7b.py: the first run's mistral paged warm hung the
+tunnel compile; the metric model must not queue behind a hang."""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+
+
+def main() -> int:
+    out_path = sys.argv[1] if len(sys.argv) > 1 else ".bench_7b.jsonl"
+    import jax
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    devs = jax.devices()
+    platform = devs[0].platform
+    bench.log(f"capture_7b_llama2: devices={[str(d) for d in devs]}")
+    if platform == "cpu":
+        return 1
+    plan = [
+        dict(model="llama2", dtype="int4", slots=8, steps=64, seq=1024,
+             prompt_len=128, paged=False, mixed=False),
+    ]
+    if os.environ.get("CAP_MISTRAL_PAGED", "") == "1":
+        plan.append(
+            dict(model="mistral", dtype="int4", slots=32, steps=64,
+                 seq=512, prompt_len=128, paged=True, mixed=True))
+    cache: dict = {}
+    common = dict(chunk=32, page_size=64, n_pages=None, platform=platform,
+                  params_cache=cache)
+    f = open(out_path, "a")
+    ok = 0
+    for cap in plan:
+        t0 = time.monotonic()
+        try:
+            rec = bench.measure(jax, **cap, **common)
+        except Exception as e:
+            bench.log(f"capture_7b_llama2: {cap['model']} "
+                      f"paged={cap['paged']} FAILED after "
+                      f"{time.monotonic()-t0:.0f}s: {type(e).__name__}: {e}")
+            continue
+        rec["wall_s"] = round(time.monotonic() - t0, 1)
+        print(json.dumps(rec), file=f, flush=True)
+        ok += 1
+    f.close()
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,75 @@
+"""v2-vs-v3 paged-kernel A/B on the real chip (VERDICT r3 #1).
+
+Captures, in one process (params cached per model):
+  1. tinyllama int8 paged B=32 mixed — v2 (round-comparable flagship)
+  2. same — v3 (TPU_PAGED_V3=1)
+  3. phi int8 paged B=32 mixed — v2 (MHA diagnostic, known ~190 ms/step)
+  4. same — v3
+
+Appends one JSON per capture to .bench_v3ab.jsonl.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+
+
+def main() -> int:
+    out_path = sys.argv[1] if len(sys.argv) > 1 else ".bench_v3ab.jsonl"
+    import jax
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    platform = jax.devices()[0].platform
+    if platform == "cpu":
+        bench.log("needs TPU")
+        return 1
+    plan = [
+        dict(model="tinyllama", dtype="int8", slots=32, steps=64, seq=1024,
+             prompt_len=128, paged=True, mixed=True),
+        dict(model="tinyllama", dtype="int8", slots=32, steps=64, seq=1024,
+             prompt_len=128, paged=True, mixed=True,
+             env={"TPU_PAGED_V3": "1"}),
+        dict(model="phi", dtype="int8", slots=32, steps=64, seq=1024,
+             prompt_len=128, paged=True, mixed=True),
+        dict(model="phi", dtype="int8", slots=32, steps=64, seq=1024,
+             prompt_len=128, paged=True, mixed=True,
+             env={"TPU_PAGED_V3": "1"}),
+    ]
+    cache: dict = {}
+    common = dict(chunk=32, page_size=64, n_pages=None, platform=platform,
+                  params_cache=cache)
+    f = open(out_path, "a")
+    ok = 0
+    for cap in plan:
+        cap_env = cap.pop("env", {}) or {}
+        saved = {k: os.environ.get(k) for k in cap_env}
+        os.environ.update(cap_env)
+        t0 = time.monotonic()
+        try:
+            rec = bench.measure(jax, **cap, **common)
+        except Exception as e:
+            bench.log(f"v3ab: {cap['model']} {cap_env} FAILED after "
+                      f"{time.monotonic()-t0:.0f}s: {type(e).__name__}: {e}")
+            continue
+        finally:
+            for k, old in saved.items():
+                if old is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = old
+        rec["env"] = cap_env
+        rec["wall_s"] = round(time.monotonic() - t0, 1)
+        print(json.dumps(rec), file=f, flush=True)
+        ok += 1
+    f.close()
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,75 @@
+"""Clean v2-vs-v3 paged A/B (second window): fixed v2 (padded-scale
+BlockSpec), idle host, plus a LONG-context pair — the v3 kernel's dead-step
+elimination only matters when the attention bucket is much larger than the
+average live prefix, which the 128-token-prompt pair cannot show.
+
+Appends to .bench_v3ab.jsonl (env field tells the kernels apart).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+
+
+def main() -> int:
+    out_path = sys.argv[1] if len(sys.argv) > 1 else ".bench_v3ab.jsonl"
+    import jax
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    platform = jax.devices()[0].platform
+    if platform == "cpu":
+        return 1
+    base = dict(dtype="int8", slots=32, steps=64, seq=1024, paged=True,
+                mixed=True)
+    plan = [
+        # short-context pair (v2 now runs the fixed padded-scale path)
+        dict(model="tinyllama", prompt_len=128, **base),
+        dict(model="tinyllama", prompt_len=128, env={"TPU_PAGED_V3": "1"},
+             **base),
+        # long-context pair: avg live ~600 tokens, bucket 1024
+        dict(model="tinyllama", prompt_len=768, **base),
+        dict(model="tinyllama", prompt_len=768, env={"TPU_PAGED_V3": "1"},
+             **base),
+        # MHA diagnostic pair
+        dict(model="phi", prompt_len=128, **base),
+        dict(model="phi", prompt_len=128, env={"TPU_PAGED_V3": "1"},
+             **base),
+    ]
+    cache: dict = {}
+    common = dict(chunk=32, page_size=64, n_pages=None, platform=platform,
+                  params_cache=cache)
+    f = open(out_path, "a")
+    ok = 0
+    for cap in plan:
+        cap_env = cap.pop("env", {}) or {}
+        saved = {k: os.environ.get(k) for k in cap_env}
+        os.environ.update(cap_env)
+        t0 = time.monotonic()
+        try:
+            rec = bench.measure(jax, **cap, **common)
+        except Exception as e:
+            bench.log(f"v3ab2: {cap['model']} {cap_env} FAILED after "
+                      f"{time.monotonic()-t0:.0f}s: {type(e).__name__}: {e}")
+            continue
+        finally:
+            for k, old in saved.items():
+                if old is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = old
+        rec["env"] = cap_env
+        rec["wall_s"] = round(time.monotonic() - t0, 1)
+        print(json.dumps(rec), file=f, flush=True)
+        ok += 1
+    f.close()
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,154 @@
+"""int4 matmul formulation shoot-out at decode shapes (VERDICT r3 #3).
+
+The r3 capture showed fused-pallas int4 at 537 tok/s vs int8-XLA 569 —
+0.94x while touching 0.62x the bytes; bandwidth-proportional would be
+~1.6x. Candidate formulations, timed per matmul at decode shapes on the
+real chip:
+
+  int8-einsum   ops/quant.qmm decode form (the int8 winner: grouped
+                partial, scales applied on the 64x smaller partial)
+  int4-xla      ops/quant.qmm4 decode form (two half-group dots over the
+                same packed bytes - int8-equivalent traffic)
+  int4-pallas   ops/pallas/quant.qmm4_pallas (fused unpack+scale+dot;
+                reads each byte once but pays per-tile VPU unpack)
+  int4-native   XLA s4 dtype: codes stored as jnp.int4, grouped partial
+                identical to int8-einsum - the convert rides the dot's
+                operand stream, each byte read once, no manual unpack.
+
+Also verifies whether the TPU backend actually PACKS s4 in HBM (two codes
+per byte) via device memory_stats - if it doesn't, int4-native is
+capacity-equivalent to int8 and loses its point.
+
+Usage: python hack/int4_microbench.py   (needs the TPU chip)
+"""
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def timeit(fn, *args, iters=50):
+    import jax
+    fn(*args)[0].block_until_ready() if isinstance(fn(*args), tuple) else \
+        jax.block_until_ready(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    dev = jax.devices()[0]
+    print(f"device: {dev}", file=sys.stderr)
+    if dev.platform == "cpu":
+        print("needs TPU", file=sys.stderr)
+        return 1
+
+    from ollama_operator_tpu.ops.quant import GROUP, qmm, qmm4
+    from ollama_operator_tpu.ops.pallas.quant import qmm4_pallas, qmm_pallas
+
+    # --- is s4 packed in HBM? -------------------------------------------
+    # memory_stats() is unavailable through this backend (returns None);
+    # fall back to the array's own device-buffer accounting
+    def dev_bytes(arr):
+        try:
+            stats = dev.memory_stats()
+            return stats["bytes_in_use"] if stats else None
+        except Exception:
+            return None
+
+    s4_ok = True
+    try:
+        probe = jax.device_put(np.zeros((256, 256), np.int8))
+        probe4 = jax.jit(lambda c: c.astype(jnp.int4))(probe)
+        probe4.block_until_ready()
+        print(f"s4 arrays: ok (logical nbytes {probe4.nbytes}; the "
+              f"timing below is the bandwidth evidence)", file=sys.stderr)
+        del probe, probe4
+    except Exception as e:
+        s4_ok = False
+        print(f"s4 arrays unavailable on this backend: "
+              f"{type(e).__name__}: {str(e)[:120]}", file=sys.stderr)
+
+    B = 8
+    results = {"s4_ok": bool(s4_ok), "shapes": []}
+    rng = np.random.default_rng(0)
+    for K, O in ((4096, 4096), (4096, 14336), (14336, 4096)):
+        g = GROUP
+        G = K // g
+        codes = rng.integers(-7, 8, size=(K, O)).astype(np.int8)
+        scales = (np.abs(rng.normal(size=(G, O))) * 0.01 + 1e-3) \
+            .astype(np.float32)
+        x = jnp.asarray(rng.normal(size=(B, K)), jnp.bfloat16)
+
+        q8 = jnp.asarray(codes)
+        s = jnp.asarray(scales)
+        from ollama_operator_tpu.ops.quant import pack_int4
+        q4p = jnp.asarray(pack_int4(codes))
+        q4n = (jax.jit(lambda c: c.astype(jnp.int4))(jnp.asarray(codes))
+               if s4_ok else None)
+
+        row = {"K": K, "O": O}
+        bytes_int8 = K * O + G * O * 4
+        bytes_int4 = K * O // 2 + G * O * 4
+
+        f_int8 = jax.jit(lambda x, q, s: qmm(x, {"q": q, "s": s}))
+        t = timeit(f_int8, x, q8, s)
+        row["int8_einsum_us"] = round(t * 1e6, 1)
+        row["int8_einsum_gbs"] = round(bytes_int8 / t / 1e9, 1)
+
+        f_x4 = jax.jit(lambda x, q, s: qmm4(x, {"q4": q, "s": s}))
+        t = timeit(f_x4, x, q4p, s)
+        row["int4_xla_us"] = round(t * 1e6, 1)
+        row["int4_xla_gbs"] = round(bytes_int4 / t / 1e9, 1)
+
+        f_p4 = jax.jit(functools.partial(qmm4_pallas, interpret=False))
+        t = timeit(f_p4, x, q4p, s)
+        row["int4_pallas_us"] = round(t * 1e6, 1)
+        row["int4_pallas_gbs"] = round(bytes_int4 / t / 1e9, 1)
+
+        def qmm_native(x, q, s):
+            # identical structure to qmm's decode form; the s4->bf16
+            # convert fuses into the dot operand stream
+            xr = x.reshape(*x.shape[:-1], G, g)
+            qr = q.reshape(G, g, O)
+            partial = jnp.einsum("...Gg,Ggo->...Go", xr,
+                                 qr.astype(x.dtype),
+                                 preferred_element_type=jnp.float32)
+            return jnp.einsum("...Go,Go->...o", partial, s).astype(x.dtype)
+
+        if q4n is not None:
+            try:
+                f_n4 = jax.jit(qmm_native)
+                t = timeit(f_n4, x, q4n, s)
+                row["int4_native_us"] = round(t * 1e6, 1)
+                row["int4_native_gbs"] = round(bytes_int4 / t / 1e9, 1)
+            except Exception as e:
+                row["int4_native_error"] = f"{type(e).__name__}: {e}"[:200]
+
+        # int8-pallas for reference
+        f_p8 = jax.jit(functools.partial(qmm_pallas, interpret=False))
+        t = timeit(f_p8, x, q8, s)
+        row["int8_pallas_us"] = round(t * 1e6, 1)
+
+        print(json.dumps(row), file=sys.stderr)
+        results["shapes"].append(row)
+
+    print(json.dumps(results))
+    return 0
+
+
+if __name__ == "__main__":
+    main()

@@ -183,10 +183,11 @@ def main() -> None:
     pool_spec = P(None, None, "tp", None, None)
     pool_sh = NamedSharding(mesh, pool_spec)
     ps_sh = NamedSharding(mesh, P(None, None, "tp", None))
+    sp_pool = -(-PAGE // 128) * 128   # engine pads scale lanes to the tile
     pool_aval = {
         "q": jax.ShapeDtypeStruct((L, n_pages + 1, KvH, PAGE, hd),
                                   jnp.int8, sharding=pool_sh),
-        "s": jax.ShapeDtypeStruct((L, n_pages + 1, KvH, PAGE),
+        "s": jax.ShapeDtypeStruct((L, n_pages + 1, KvH, sp_pool),
                                   jnp.float32, sharding=ps_sh)}
     pool = leaf_device_bytes(pool_aval, {"q": pool_sh, "s": ps_sh}) * 2
     repl = NamedSharding(mesh, P())

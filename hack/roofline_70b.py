@@ -1,0 +1,110 @@
+"""70B roofline projection: bridge the measured per-chip HBM utilization to
+the north-star config (llama2:70b on v5e-16, >1000 tok/s aggregate —
+BASELINE.json).
+
+Decode is HBM-bandwidth-bound: per decode step every resident weight byte
+streams once per chip, plus the slots' live KV windows. Given the EXACT
+per-device bytes of the sharded 70B program (eval_shape + NamedSharding —
+same accounting as hack/prog_70b.py, no arrays materialise) and a
+bandwidth-utilization fraction, the projected aggregate throughput is
+
+    tok/s = n_slots / (per_device_bytes / (819 GB/s x util))
+
+This makes the north star falsifiable: the table prints the utilization
+each config needs to cross 1000 tok/s, next to the utilizations actually
+measured on the v5e-1 (BENCH_r*.json: 26-30% dense, 14% paged v2). Run on
+a virtual 16-device CPU mesh:
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=16 JAX_PLATFORMS=cpu \
+        python hack/roofline_70b.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault(
+    "XLA_FLAGS", "--xla_force_host_platform_device_count=16")
+
+import jax                                    # noqa: E402
+import jax.numpy as jnp                       # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+V5E_BW = 819e9      # bytes/s per chip (public spec)
+
+
+def leaf_device_bytes(aval_tree, sharding_tree) -> int:
+    total = 0
+    for a, sh in zip(jax.tree.leaves(aval_tree),
+                     jax.tree.leaves(sharding_tree,
+                                     is_leaf=lambda x: isinstance(
+                                         x, NamedSharding))):
+        shard = sh.shard_shape(a.shape)
+        n = 1
+        for d in shard:
+            n *= d
+        total += n * jnp.dtype(a.dtype).itemsize
+    return total
+
+
+def main() -> None:
+    from ollama_operator_tpu.models import decoder
+    from ollama_operator_tpu.models.config import get_config
+    from ollama_operator_tpu.ops.quant import (quantize_params)
+    from ollama_operator_tpu.parallel.mesh import MeshPlan, make_mesh
+    from ollama_operator_tpu.parallel.sharding import params_sharding_tree
+
+    cfg = get_config("llama2:70b")
+    devs = jax.devices()
+    assert len(devs) >= 16, f"need 16 virtual devices, have {len(devs)}"
+    mesh = make_mesh(MeshPlan(tp=8, dp=2), devs[:16])
+
+    p_bf16 = jax.eval_shape(
+        lambda k: decoder.init_params(cfg, k, dtype=jnp.bfloat16),
+        jax.random.key(0))
+
+    def quant_avals(bits):
+        from ollama_operator_tpu.ops import quant as Q
+        return jax.eval_shape(lambda p: Q.quantize_params(p, bits=bits),
+                              p_bf16)
+
+    rows = []
+    for dtype, bits in (("int8", 8), ("int4", 4)):
+        p_q = quant_avals(bits)
+        p_sh = params_sharding_tree(p_q, mesh, cfg)
+        per_dev_w = leaf_device_bytes(p_q, p_sh)
+        # live KV read per step per chip: each slot's window, int8 codes,
+        # KvH sharded over tp8 (8 kv heads / 8 ways -> 1 head per chip),
+        # batch over dp2
+        L, KvH, hd = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
+        for slots, ctx in ((8, 1024), (32, 1024), (32, 4096)):
+            kv_per_dev = (slots // 2) * ctx * L * (KvH // 8) * hd * 2  # int8
+            per_dev = per_dev_w + kv_per_dev
+            row = {"dtype": dtype, "slots": slots, "ctx": ctx,
+                   "per_device_gb": round(per_dev / 1e9, 2)}
+            for util in (0.14, 0.30, 0.45, 0.60):
+                step_s = per_dev / (V5E_BW * util)
+                row[f"tok_s@{int(util*100)}%"] = round(slots / step_s, 1)
+            # util needed for 1000 tok/s aggregate
+            need = (per_dev / V5E_BW) / (slots / 1000.0)
+            row["util_for_1000"] = round(need * 100, 1)
+            rows.append(row)
+
+    print(json.dumps({"mesh": "tp8xdp2 (v5e-16)", "rows": rows}, indent=1))
+
+    # markdown table for BASELINE.md
+    print("\n| dtype | slots | ctx | GB/chip/step | tok/s @14% | @30% | "
+          "@45% | @60% | util for 1000 tok/s |", file=sys.stderr)
+    print("|---|---|---|---|---|---|---|---|---|", file=sys.stderr)
+    for r in rows:
+        print(f"| {r['dtype']} | {r['slots']} | {r['ctx']} | "
+              f"{r['per_device_gb']} | {r['tok_s@14%']} | {r['tok_s@30%']} "
+              f"| {r['tok_s@45%']} | {r['tok_s@60%']} | "
+              f"{r['util_for_1000']}% |", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -71,6 +71,43 @@ def main():
         ok &= check(f"paged_decode KvH={KvH}", paged, q, kq, ksc,
                     tables, lengths)
 
+        from ollama_operator_tpu.ops.pallas.paged import \
+            paged_decode_attention_v3
+
+        # v3 requires the 128-lane-padded scale pools the engine allocates
+        ksc128 = jnp.zeros((L, P, KvH, 128), jnp.float32)
+
+        def paged_v3(q, kq, ksc, tables, lengths, KvH=KvH):
+            kp = {"q": kq, "s": ksc}
+            out = paged_decode_attention_v3(
+                q, kp, kp, jnp.int32(0), tables, lengths, 0.125, nblk=8)
+            assert out is not None, "v3 unexpectedly bailed"
+            return out
+
+        ok &= check(f"paged_decode_v3 KvH={KvH}", paged_v3, q, kq, ksc128,
+                    tables, lengths)
+
+        def paged_v3_win(q, kq, ksc, tables, lengths, KvH=KvH):
+            kp = {"q": kq, "s": ksc}
+            out = paged_decode_attention_v3(
+                q, kp, kp, jnp.int32(0), tables, lengths, 0.125,
+                sliding_window=4096, nblk=8)
+            assert out is not None, "v3 unexpectedly bailed"
+            return out
+
+        ok &= check(f"paged_decode_v3 win KvH={KvH}", paged_v3_win, q, kq,
+                    ksc128, tables, lengths)
+
+        def paged_v3_bf16(q, kp, tables, lengths):
+            out = paged_decode_attention_v3(
+                q, kp, kp, jnp.int32(0), tables, lengths, 0.125, nblk=8)
+            assert out is not None, "v3 unexpectedly bailed"
+            return out
+
+        kbf = jnp.zeros((L, P, KvH, ps, hd), jnp.bfloat16)
+        ok &= check(f"paged_decode_v3 bf16 KvH={KvH}", paged_v3_bf16, q,
+                    kbf, tables, lengths)
+
     # dense decode + MHA head-tiled grids (bf16 cache)
     from ollama_operator_tpu.ops.pallas.flash import (decode_attention,
                                                       mha_decode_attention)

@@ -218,6 +218,50 @@ def dq_q6_k(raw: np.ndarray) -> np.ndarray:
 
 
 # ---------------------------------------------------------------------------
+# i-quants (non-linear 4-bit: shared LUT; ggml dequantize_row_iq4_nl/_xs)
+# ---------------------------------------------------------------------------
+
+# kvalues_iq4nl: the non-linear code→value map both iq4 formats share
+_IQ4NL_LUT = np.array([-127, -104, -83, -65, -49, -35, -22, -10,
+                       1, 13, 25, 38, 53, 69, 89, 113], np.float32)
+
+
+def dq_iq4_nl(raw: np.ndarray) -> np.ndarray:
+    """32-elem blocks, q4_0 layout (f16 d | 16B nibbles); codes map
+    through the non-linear LUT instead of (q - 8)."""
+    b = raw.reshape(-1, 18)
+    d = _f16(b[:, :2])                       # [N,1]
+    qs = b[:, 2:]
+    lo = _IQ4NL_LUT[qs & 0x0F]
+    hi = _IQ4NL_LUT[qs >> 4]
+    q = np.concatenate([lo, hi], axis=1)
+    return (q * d).reshape(-1)
+
+
+def dq_iq4_xs(raw: np.ndarray) -> np.ndarray:
+    """256-elem super-blocks: f16 d | u16 scales_h | 4B scales_l |
+    128B nibbles. Sub-block ib (of 8×32): 6-bit scale
+    ls = scales_l nibble | scales_h 2-bit pair << 4, value
+    d·(ls-32)·LUT[q]; within a sub-block low nibbles are elements
+    0..15, high 16..31."""
+    b = raw.reshape(-1, 136)
+    N = b.shape[0]
+    d = _f16(b[:, 0:2])                              # [N,1]
+    scales_h = np.ascontiguousarray(b[:, 2:4]).view(np.uint16)  # [N,1]
+    scales_l = b[:, 4:8]                             # [N,4]
+    qs = b[:, 8:].reshape(N, 8, 16)                  # [N, ib, 16]
+    ib = np.arange(8)
+    ls_l = (scales_l[:, ib // 2] >> (4 * (ib % 2))) & 0xF       # [N,8]
+    ls_h = (scales_h >> (2 * ib).astype(np.uint16)) & 3         # [N,8]
+    ls = (ls_l | (ls_h << 4)).astype(np.float32) - 32
+    dl = (d * ls).reshape(N, 8, 1)                   # [N,8,1]
+    lo = _IQ4NL_LUT[qs & 0x0F]                       # [N,8,16]
+    hi = _IQ4NL_LUT[qs >> 4]
+    y = dl * np.concatenate([lo, hi], axis=2)        # [N,8,32]
+    return y.reshape(-1)
+
+
+# ---------------------------------------------------------------------------
 # plain types + dispatch
 # ---------------------------------------------------------------------------
 
@@ -240,6 +284,7 @@ _DISPATCH = {
     R.GGML_Q5_0: dq_q5_0, R.GGML_Q5_1: dq_q5_1, R.GGML_Q8_0: dq_q8_0,
     R.GGML_Q2_K: dq_q2_k, R.GGML_Q3_K: dq_q3_k, R.GGML_Q4_K: dq_q4_k,
     R.GGML_Q5_K: dq_q5_k, R.GGML_Q6_K: dq_q6_k,
+    R.GGML_IQ4_NL: dq_iq4_nl, R.GGML_IQ4_XS: dq_iq4_xs,
     R.GGML_I8: lambda raw: raw.view(np.int8).astype(np.float32),
     R.GGML_I32: lambda raw: raw.view(np.int32).astype(np.float32),
 }

@@ -45,6 +45,10 @@ GGML_Q2_K, GGML_Q3_K, GGML_Q4_K, GGML_Q5_K, GGML_Q6_K, GGML_Q8_K = \
     10, 11, 12, 13, 14, 15
 GGML_I8, GGML_I16, GGML_I32 = 24, 25, 26
 GGML_BF16 = 30
+# importance-matrix ("i-quant") family; the 4-bit non-linear pair is the
+# common one in modern registry tags (iq4_nl blocks like q4_0, iq4_xs
+# k-quant-style super-blocks, both through the same non-linear LUT)
+GGML_IQ4_NL, GGML_IQ4_XS = 20, 23
 
 GGML_TYPE_NAMES = {
     GGML_F32: "F32", GGML_F16: "F16", GGML_BF16: "BF16",
@@ -53,6 +57,7 @@ GGML_TYPE_NAMES = {
     GGML_Q2_K: "Q2_K", GGML_Q3_K: "Q3_K", GGML_Q4_K: "Q4_K",
     GGML_Q5_K: "Q5_K", GGML_Q6_K: "Q6_K",
     GGML_I8: "I8", GGML_I16: "I16", GGML_I32: "I32",
+    GGML_IQ4_NL: "IQ4_NL", GGML_IQ4_XS: "IQ4_XS",
 }
 
 # (block_elems, block_bytes) per quantised type
@@ -63,6 +68,7 @@ BLOCK_LAYOUT = {
     GGML_Q5_0: (32, 22), GGML_Q5_1: (32, 24), GGML_Q8_0: (32, 34),
     GGML_Q2_K: (256, 84), GGML_Q3_K: (256, 110), GGML_Q4_K: (256, 144),
     GGML_Q5_K: (256, 176), GGML_Q6_K: (256, 210),
+    GGML_IQ4_NL: (32, 18), GGML_IQ4_XS: (256, 136),
 }
 
 

@@ -40,6 +40,56 @@ _INTERLEAVED_ROPE_ARCHES = {"llama"}
 # config
 # ---------------------------------------------------------------------------
 
+def _rope_scaling_from_gguf(f: GGUFFile) -> Dict[str, Any]:
+    """rope.scaling.* metadata + the rope_freqs factor tensor → ModelConfig
+    rope fields (ops/rope.scaled_inv_freq semantics, = llama.cpp's).
+
+    llama3.1-family conversions pre-bake their low/high-freq scheme into a
+    ``rope_freqs.weight`` tensor of per-frequency divisors; when present it
+    takes precedence (scaled_inv_freq applies it INSTEAD of the metadata
+    scheme, matching llama.cpp). Legacy keys ``rope.scale_linear`` /
+    ``rope.scale`` (old GGUF exports) map onto the linear scheme.
+    """
+    out: Dict[str, Any] = {}
+    stype = f.field("rope.scaling.type")
+    factor = f.field("rope.scaling.factor")
+    if factor is None:
+        factor = f.field("rope.scale_linear", f.field("rope.scale"))
+        if factor is not None and stype is None:
+            stype = "linear"
+    if stype is not None and str(stype) not in ("none", "linear", "yarn"):
+        raise NotImplementedError(
+            f"unsupported GGUF rope.scaling.type {stype!r}")
+    if stype is not None and str(stype) != "none":
+        out["rope_scaling_type"] = str(stype)
+    if factor is not None and float(factor) > 0:
+        out["rope_scaling"] = float(factor)
+    octx = f.field("rope.scaling.original_context_length")
+    if octx:
+        out["rope_orig_ctx"] = int(octx)
+    attn_f = f.field("rope.scaling.attn_factor")
+    if attn_f:
+        out["rope_attn_factor"] = float(attn_f)
+    bf = f.field("rope.scaling.yarn_beta_fast")
+    if bf:
+        out["rope_yarn_beta_fast"] = float(bf)
+    bs = f.field("rope.scaling.yarn_beta_slow")
+    if bs:
+        out["rope_yarn_beta_slow"] = float(bs)
+    if "rope_freqs.weight" in f.tensors:
+        ff = DQ.dequantize_tensor(f, f.tensors["rope_freqs.weight"])
+        out["rope_freq_factors"] = tuple(
+            float(x) for x in np.asarray(ff, np.float64).reshape(-1))
+    # yarn needs the original window; older exports omit it — fall back to
+    # context_length / factor (the convention llama.cpp applies)
+    if (out.get("rope_scaling_type") == "yarn"
+            and not out.get("rope_orig_ctx")):
+        ctx = int(f.field("context_length", 4096))
+        out["rope_orig_ctx"] = max(1, int(ctx / out.get("rope_scaling",
+                                                        1.0)))
+    return out
+
+
 def config_from_gguf(f: GGUFFile) -> ModelConfig:
     arch = f.arch
     n_heads = int(f.field("attention.head_count"))
@@ -61,6 +111,7 @@ def config_from_gguf(f: GGUFFile) -> ModelConfig:
         rope_theta=float(f.field("rope.freq_base", 10000.0)),
         sliding_window=int(f.field("attention.sliding_window", 0) or 0),
     )
+    base.update(_rope_scaling_from_gguf(f))
     eps = f.field("attention.layer_norm_rms_epsilon")
     if eps is not None:
         base["norm_eps"] = float(eps)
@@ -73,13 +124,9 @@ def config_from_gguf(f: GGUFFile) -> ModelConfig:
         cfg = ModelConfig(arch="llama", **base)
     elif arch == "qwen2":
         cfg = ModelConfig(arch="llama", attn_bias=True, **base)
-        if "output.weight" not in f.tensors:
-            cfg = ModelConfig(**{**cfg.__dict__, "tie_embeddings": True})
     elif arch == "qwen3":
         # qwen2 minus the qkv bias, plus per-head RMS on q/k
         cfg = ModelConfig(arch="llama", qk_norm=True, **base)
-        if "output.weight" not in f.tensors:
-            cfg = ModelConfig(**{**cfg.__dict__, "tie_embeddings": True})
     elif arch == "gemma":
         cfg = ModelConfig(arch="llama", act="gelu_tanh", emb_scale=True,
                           tie_embeddings=True, norm_weight_offset=1.0, **base)
@@ -113,6 +160,12 @@ def config_from_gguf(f: GGUFFile) -> ModelConfig:
                           rotary_pct=rot / head_dim, **base)
     else:
         raise NotImplementedError(f"unsupported GGUF architecture {arch!r}")
+    if not cfg.tie_embeddings and "output.weight" not in f.tensors:
+        # any arch may tie the head to the embedding (llama3.2, qwen2
+        # small variants): llama.cpp falls back to token_embd when the
+        # output tensor is absent — arch-generic, not a qwen special case
+        import dataclasses
+        cfg = dataclasses.replace(cfg, tie_embeddings=True)
     return cfg.validate()
 
 

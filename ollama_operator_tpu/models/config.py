@@ -33,7 +33,24 @@ class ModelConfig:
     ffn_dim: int = 11008               # hidden width of the MLP
     norm_eps: float = 1e-5
     rope_theta: float = 10000.0
-    rope_scaling: float = 1.0          # linear position scaling (1.0 = off)
+    # context-extension rope scaling (ops/rope.scaled_inv_freq): the scheme
+    # llama.cpp reads from GGUF rope.scaling.* metadata / the rope_freqs
+    # tensor inside the image the reference delegates to
+    # (/root/reference/pkg/model/pod.go:11)
+    rope_scaling_type: str = "none"    # none | linear | yarn | llama3
+    rope_scaling: float = 1.0          # the scaling factor (1.0 = off);
+                                       # with type "none" a non-1 factor is
+                                       # honored as linear (legacy field)
+    rope_orig_ctx: int = 0             # original (pre-extension) context
+    rope_attn_factor: float = 0.0      # yarn cos/sin magnitude; 0 = auto
+    rope_low_freq_factor: float = 1.0  # llama3 interpolation band
+    rope_high_freq_factor: float = 4.0
+    rope_yarn_beta_fast: float = 32.0  # yarn correction-dim betas
+    rope_yarn_beta_slow: float = 1.0
+    # per-frequency factors from a GGUF rope_freqs.weight tensor
+    # (llama3.1-family conversions bake their scheme into this); tuple so
+    # the config stays hashable for jit static args
+    rope_freq_factors: Optional[Tuple[float, ...]] = None
     rotary_pct: float = 1.0            # phi-2 rotates only part of head_dim
     max_seq_len: int = 4096
     sliding_window: int = 0            # 0 = full attention (mistral: 4096)
@@ -98,6 +115,20 @@ class ModelConfig:
 
     def validate(self) -> "ModelConfig":
         assert self.n_heads % self.n_kv_heads == 0, "GQA requires n_heads % n_kv_heads == 0"
+        assert self.rope_scaling_type in ("none", "linear", "yarn", "llama3")
+        if self.rope_freq_factors is not None:
+            # JSON round-trips (gguf/store.py meta) hand back a list; the
+            # config must stay hashable for jit static args
+            object.__setattr__(self, "rope_freq_factors",
+                               tuple(float(x)
+                                     for x in self.rope_freq_factors))
+            assert len(self.rope_freq_factors) == self.rotary_dim // 2, (
+                f"rope_freq_factors: {len(self.rope_freq_factors)} entries "
+                f"for rotary_dim {self.rotary_dim}")
+        if self.rope_scaling_type in ("yarn", "llama3"):
+            assert self.rope_orig_ctx > 0, (
+                f"{self.rope_scaling_type} rope scaling requires "
+                "rope_orig_ctx")
         assert self.norm_type in ("rmsnorm", "layernorm")
         assert self.mlp_type in ("gated", "plain")
         assert self.act in ("silu", "gelu", "gelu_tanh")
@@ -145,20 +176,32 @@ PRESETS = {
     "llama3:70b": _mk(arch="llama", vocab_size=128256, dim=8192, n_layers=80,
                       n_heads=64, n_kv_heads=8, head_dim=128, ffn_dim=28672,
                       rope_theta=500000.0, max_seq_len=8192),
-    # llama3.1 shares llama3-8B dims (longer context via llama3-type rope
-    # scaling, carried by the GGUF metadata on real pulls); 3.2 are the
-    # small GQA variants — both tie embeddings
+    # llama3.1 shares llama3-8B dims; the 131072 context comes from
+    # llama3-type rope scaling (ops/rope.scaled_inv_freq) — factor 8 over
+    # the 8192 native window, low/high-freq interpolation band 1..4 (real
+    # GGUF pulls carry the equivalent pre-baked rope_freqs tensor, which
+    # the transcoder reads into rope_freq_factors). 3.2 are the small GQA
+    # variants — factor 32, tied embeddings.
     "llama3.1": _mk(arch="llama", vocab_size=128256, dim=4096, n_layers=32,
                     n_heads=32, n_kv_heads=8, head_dim=128, ffn_dim=14336,
-                    rope_theta=500000.0, max_seq_len=8192),
+                    rope_theta=500000.0, rope_scaling_type="llama3",
+                    rope_scaling=8.0, rope_orig_ctx=8192,
+                    rope_low_freq_factor=1.0, rope_high_freq_factor=4.0,
+                    max_seq_len=131072),
     "llama3.2:1b": _mk(arch="llama", vocab_size=128256, dim=2048,
                        n_layers=16, n_heads=32, n_kv_heads=8, head_dim=64,
                        ffn_dim=8192, rope_theta=500000.0,
-                       tie_embeddings=True, max_seq_len=8192),
+                       rope_scaling_type="llama3", rope_scaling=32.0,
+                       rope_orig_ctx=8192, rope_low_freq_factor=1.0,
+                       rope_high_freq_factor=4.0,
+                       tie_embeddings=True, max_seq_len=131072),
     "llama3.2:3b": _mk(arch="llama", vocab_size=128256, dim=3072,
                        n_layers=28, n_heads=24, n_kv_heads=8, head_dim=128,
                        ffn_dim=8192, rope_theta=500000.0,
-                       tie_embeddings=True, max_seq_len=8192),
+                       rope_scaling_type="llama3", rope_scaling=32.0,
+                       rope_orig_ctx=8192, rope_low_freq_factor=1.0,
+                       rope_high_freq_factor=4.0,
+                       tie_embeddings=True, max_seq_len=131072),
     "mistral": _mk(arch="llama", vocab_size=32000, dim=4096, n_layers=32,
                    n_heads=32, n_kv_heads=8, head_dim=128, ffn_dim=14336,
                    sliding_window=4096, max_seq_len=32768),

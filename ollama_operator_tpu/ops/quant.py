@@ -219,8 +219,11 @@ def qmm4(x: jax.Array, qw: Dict[str, Any],
     for d in x.shape[:-1]:
         N *= d
     if N > 16:
-        w = (unpack_int4(q4).reshape(G, g, O).astype(x.dtype)
-             * s[:, None, :].astype(x.dtype)).reshape(K, O)
+        # dequantize in f32, cast the product once — the decode form and
+        # the pallas kernel apply f32 scales post-dot, so prefill must not
+        # see scale values rounded through bf16's 8-bit mantissa
+        w = (unpack_int4(q4).reshape(G, g, O).astype(jnp.float32)
+             * s[:, None, :]).reshape(K, O).astype(x.dtype)
         y = jnp.einsum("...k,ko->...o", x, w,
                        preferred_element_type=jnp.float32)
         return y.astype(out_dtype or x.dtype)
@@ -262,8 +265,9 @@ def qmm(x: jax.Array, qw: Dict[str, Any],
     for d in x.shape[:-1]:
         N *= d
     if N > 16:
-        w = (q.reshape(G, g, O).astype(x.dtype)
-             * s[:, None, :].astype(x.dtype)).reshape(K, O)
+        # f32 scales (same reasoning as qmm4's batch form)
+        w = (q.reshape(G, g, O).astype(jnp.float32)
+             * s[:, None, :]).reshape(K, O).astype(x.dtype)
         y = jnp.einsum("...k,ko->...o", x, w,
                        preferred_element_type=jnp.float32)
         return y.astype(out_dtype or x.dtype)
@@ -338,15 +342,19 @@ def int4_mm_kernels(cfg, mesh) -> Any:
     """The ``mm_kernels`` value an int4 load should serve with: the fused
     pallas kernel on a single-device TPU (the only matmul path that reads
     each packed byte once), the portable XLA einsum under GSPMD meshes —
-    and ``kernels=xla`` (config or OLLAMA_TPU_KERNELS) stays the escape
-    hatch if the kernel miscompiles. One helper so the server loader and
-    bench.py can never drift onto different matmul paths (they feed the
-    same BASELINE numbers). Returns the cfg, possibly replaced."""
+    and an explicitly-set ``mm_kernels`` (config) or ``kernels=xla``
+    (config or OLLAMA_TPU_KERNELS) stays the escape hatch if the kernel
+    miscompiles — the matmul hatch works independently of the attention
+    switch. One helper so the server loader and bench.py can never drift
+    onto different matmul paths (they feed the same BASELINE numbers).
+    Returns the cfg, possibly replaced."""
     import dataclasses
 
     import jax
 
     from .attention import resolve_kernels
+    if cfg.mm_kernels != "auto":
+        return cfg
     if (jax.default_backend() == "tpu"
             and (mesh is None or mesh.size == 1)
             and resolve_kernels(cfg.kernels) != "xla"):

@@ -30,7 +30,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..models.config import ModelConfig
 from ..models.decoder import (Params, _attn_scale, _block_cached,
                               _block_chunk, _embed, _unembed)
-from ..ops.rope import rope_angles
+from ..ops.rope import rope_angles_cfg
 from .ring_attention import (ring_attention, sp_cache_write,
                              sp_decode_attention)
 
@@ -61,8 +61,7 @@ def prefill_chunk_sp(params: Params, cfg: ModelConfig, tokens: jax.Array,
         Bc, Tc = tokens.shape
         positions = my * Tc + jnp.arange(Tc, dtype=jnp.int32)
         positions = jnp.broadcast_to(positions[None], (Bc, Tc))
-        cos, sin = rope_angles(positions, cfg.rotary_dim, cfg.rope_theta,
-                               cfg.rope_scaling)
+        cos, sin = rope_angles_cfg(positions, cfg)
         if inputs_embeds is not None:
             x = inputs_embeds.astype(params["tok_emb"].dtype)
         else:
@@ -114,8 +113,7 @@ def forward_with_cache_sp(params: Params, cfg: ModelConfig,
     def inner(tokens, k_cache, v_cache, lengths):
         B, T = tokens.shape
         positions = lengths[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
-        cos, sin = rope_angles(positions, cfg.rotary_dim, cfg.rope_theta,
-                               cfg.rope_scaling)
+        cos, sin = rope_angles_cfg(positions, cfg)
         x = _embed(cfg, params, tokens)
 
         def attn_fn(q, kc, vc, pos):

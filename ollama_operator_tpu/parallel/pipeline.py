@@ -40,7 +40,7 @@ import dataclasses
 
 from ..models.config import ModelConfig
 from ..models.decoder import _attn_scale, Params, _block_cached, _embed, _unembed
-from ..ops.rope import rope_angles
+from ..ops.rope import rope_angles_cfg
 from .sharding import resolve_moe_impl
 
 PP_AXIS = "pp"
@@ -106,8 +106,7 @@ def forward_with_cache_pp(params: Params, cfg: ModelConfig,
         k_pos = jnp.arange(S, dtype=jnp.int32)[None, None, :]
 
         def run_stage(x_mb, kc_mb, vc_mb, pos_mb):
-            cos, sin = rope_angles(pos_mb, cfg.rotary_dim, cfg.rope_theta,
-                                   cfg.rope_scaling)
+            cos, sin = rope_angles_cfg(pos_mb, cfg)
             ok = k_pos <= pos_mb[:, :, None]
             if cfg.sliding_window:
                 ok = ok & (k_pos > pos_mb[:, :, None] - cfg.sliding_window)

@@ -25,6 +25,10 @@ _LAYER_SPECS: Dict[str, P] = {
     "wq": P(None, None, "tp"),
     "wk": P(None, None, "tp"),
     "wv": P(None, None, "tp"),
+    # fused qkv (engine-side, only on meshes without a sharded tp axis —
+    # a tp split would straddle the q/kv column boundary)
+    "wqkv": P(None, None, None),
+    "bqkv": P(None, None),
     "wo": P(None, "tp", None),
     "bq": P(None, "tp"),
     "bk": P(None, "tp"),
@@ -103,18 +107,35 @@ def resolve_moe_impl(cfg: ModelConfig, mesh: Optional[Mesh]) -> str:
     return cfg.moe_impl
 
 
-def _leaf_spec(spec: P, v: Any, mesh: Optional[Mesh]):
+def _leaf_spec(spec: P, v: Any, mesh: Optional[Mesh], name: str = "?"):
     """A quantized dict leaf {"q"|"q4", "s"} shares its dense spec: q has
     the dense shape (q4 the packed K/2 at the same position) and the group
     axis of s is K/g at the same position, so the same PartitionSpec
     usually partitions both. When a scale dim is too small to divide its
     mesh axis (tiny K/g), that axis replicates for s only — XLA still
-    partials the dot over the sharded q rows. int4 leaves additionally
-    need the shard boundary to respect whole packing groups; qmm4's
-    (G, g/2, O) reshape enforces that at trace time."""
-    from ..ops.quant import is_int4, is_quantized
+    partials the dot over the sharded q rows. An int4 leaf whose shard
+    boundary splits a packing group (GROUP/2 packed rows carry one
+    group's nibbles) still computes correctly — GSPMD reshards around
+    qmm4's (G, g/2, O) reshape (tests/test_quant.py pins it) — but the
+    reshard is an all-gather-class copy on a hot decode matmul, so it is
+    flagged loudly at load with the leaf and mesh axis named."""
+    from ..ops.quant import GROUP, is_int4, is_quantized
     if not is_quantized(v):
         return spec
+    if is_int4(v) and mesh is not None:
+        kp = v["q4"].shape[-2]          # packed K/2 rows
+        ax = spec[-2] if len(spec) >= 2 else None
+        size = mesh.shape.get(ax, 1) if ax else 1
+        if size > 1 and (kp % size or (kp // size) % (GROUP // 2)):
+            import warnings
+            warnings.warn(
+                f"int4 leaf {name!r}: packed K axis ({kp} rows) sharded "
+                f"{size}-way over mesh axis {ax!r} does not split on "
+                f"whole {GROUP}-row packing groups ({GROUP // 2} packed "
+                f"rows); GSPMD inserts a reshard on this matmul every "
+                f"decode step — prefer a tp that divides K into "
+                f"multiples of {GROUP}, or serve this model int8",
+                stacklevel=2)
     s_shape = v["s"].shape
     s_spec = []
     for i, ax in enumerate(spec):
@@ -130,10 +151,10 @@ def params_pspec_tree(params: Dict[str, Any],
     out: Dict[str, Any] = {}
     for k, v in params.items():
         if k == "layers":
-            out[k] = {lk: _leaf_spec(layer[lk], lv, mesh)
+            out[k] = {lk: _leaf_spec(layer[lk], lv, mesh, name=lk)
                       for lk, lv in v.items()}
         else:
-            out[k] = _leaf_spec(top[k], v, mesh)
+            out[k] = _leaf_spec(top[k], v, mesh, name=k)
     return out
 
 

@@ -87,7 +87,9 @@ def resolve_serving_defaults(ecfg: "EngineConfig", cfg: ModelConfig,
       (8 × serving max_seq of pages): the 32 slots share it, so the
       default footprint is unchanged and mixed-length concurrency
       quadruples; full-length overload preempts/requeues instead of
-      OOMing at load.
+      OOMing at load. The pool stores heads padded to the 128-lane tile,
+      so for hd<128 models the auto page count shrinks by hd/hd_pool —
+      the BYTE ceiling is what's preserved, not the token count.
     """
     if ecfg.paged is not None and ecfg.max_slots != 0:
         return ecfg
@@ -97,7 +99,9 @@ def resolve_serving_defaults(ecfg: "EngineConfig", cfg: ModelConfig,
     n_pages = ecfg.n_pages
     if paged and n_pages is None and ecfg.max_slots == 0:
         serve_seq = min(ecfg.max_seq_len, cfg.max_seq_len)
-        n_pages = max(1, (8 * serve_seq) // ecfg.page_size)
+        hd_pool = -(-cfg.head_dim // 128) * 128
+        n_pages = max(1, (8 * serve_seq) * cfg.head_dim
+                      // hd_pool // ecfg.page_size)
     return dataclasses.replace(ecfg, paged=paged, max_slots=slots,
                                n_pages=n_pages)
 
@@ -105,20 +109,25 @@ def resolve_serving_defaults(ecfg: "EngineConfig", cfg: ModelConfig,
 def resolve_paged_default(cfg: ModelConfig, mesh) -> bool:
     """The serving default for an unset paged flag, per model and mesh.
 
-    Data-driven (BASELINE.md r3, v5e): with the head-blocked kernel the
-    paged pool measured 1.90x the dense aggregate on a GQA model
-    (tinyllama, 2646.9 vs 1390.6 tok/s at B=32 mixed) but MHA pools are
-    per-head-dot-bound (phi KvH=32: 191 ms/step vs 14 dense) — so GQA
-    models page by default and MHA stays dense. Off for MoE (untested
-    combination), for meshes the pool can't shard (sp; dp without a
-    valid dp-manual layout), and off the TPU backend entirely (the
-    measurement is v5e's; a 1-core CPU dev/kind pod gets 4x the per-step
-    compute from a 32-slot batch). An explicit --paged / TPU_PAGED=0|1
-    always wins."""
+    Data-driven (BASELINE.md r3+r4, v5e): GQA models page (r3: paged-32
+    measured 1.90-2.04x the dense-8 aggregate on tinyllama). MHA models
+    page too SINCE the v3 live-page async-DMA kernel — the r4
+    same-window A/B measured phi (KvH=32) paged-32 at 934.5 tok/s
+    vs ~570 dense-8 (the r3 grid kernel was per-head-dot-bound at
+    190 ms/step, which is why MHA used to stay dense); with the kernel
+    explicitly reverted (TPU_PAGED_V3=0) MHA keeps the dense default.
+    Off for MoE (untested combination), for meshes the pool can't shard
+    (sp; dp without a valid dp-manual layout), and off the TPU backend
+    entirely (the measurement is v5e's; a 1-core CPU dev/kind pod gets
+    4x the per-step compute from a 32-slot batch). An explicit --paged /
+    TPU_PAGED=0|1 always wins."""
+    import os
+
     import jax
     if jax.default_backend() != "tpu":
         return False
-    if cfg.n_kv_heads >= cfg.n_heads:
+    if (cfg.n_kv_heads >= cfg.n_heads
+            and os.environ.get("TPU_PAGED_V3", "1") != "1"):
         return False
     if cfg.n_experts:
         return False
@@ -275,6 +284,20 @@ class Engine:
             self._repl_sh = None
         self._cache_sh, self._slot_sh = cache_sh, slot_sh
         self._slot_sh2 = slot_sh2
+        # fused single-matmul QKV (models/decoder.fuse_qkv_params).
+        # Opt-in (TPU_FUSED_QKV=1): isolated jit-call microbenches showed
+        # 3.5x on GQA projections, but the on-chip serving A/B measured
+        # -3.7% — inside the one compiled decode program XLA already
+        # schedules the three dots back-to-back, so there is no per-op
+        # dispatch floor to save (BASELINE.md r4). Kept for experiments
+        # and hosts where dispatch-bound serving paths exist.
+        import os as _os
+        if (_os.environ.get("TPU_FUSED_QKV", "0") == "1"
+                and (mesh is None
+                     or all(sz == 1 for ax, sz in dict(mesh.shape).items()
+                            if ax != "dp"))):
+            from ..models.decoder import fuse_qkv_params
+            params = fuse_qkv_params(params, cfg)
         if mesh is not None:
             self._param_sh = params_sharding_tree(params, mesh, cfg)
             params = jax.tree_util.tree_map(self._g, params,
@@ -325,12 +348,20 @@ class Engine:
                 s_sh = (NamedSharding(mesh, P(None, pg_ax, h_ax, None))
                         if mesh is not None else None)
                 cache_sh = {"q": pool_sh, "s": s_sh}
+                # scale arrays lane-padded to the 128 tile like the codes'
+                # head dim: the v3 kernel DMAs [KvH, ps] f32 slices per
+                # page, and Mosaic requires the DMA'd minor dim to be a
+                # multiple of 128 lanes (ps=64 default crashes the real
+                # lowering). Writers scatter at off < ps; readers slice
+                # (:ps); pad lanes stay zero and inert.
+                sp_pool = -(-ps // 128) * 128
+                s_shape = pool_shape[:-2] + (sp_pool,)
                 self.k_cache = {
                     "q": zeros(pool_shape, jnp.int8, pool_sh),
-                    "s": zeros(pool_shape[:-1], jnp.float32, s_sh)}
+                    "s": zeros(s_shape, jnp.float32, s_sh)}
                 self.v_cache = {
                     "q": zeros(pool_shape, jnp.int8, pool_sh),
-                    "s": zeros(pool_shape[:-1], jnp.float32, s_sh)}
+                    "s": zeros(s_shape, jnp.float32, s_sh)}
             else:
                 cache_sh = pool_sh
                 self.k_cache = zeros(pool_shape, ecfg.cache_dtype, pool_sh)

@@ -485,11 +485,10 @@ class LoadedModel:
                     import math
                     B, T = tokens.shape
                     scale = 1.0 / math.sqrt(cfg.head_dim)
-                    from ..ops.rope import rope_angles
+                    from ..ops.rope import rope_angles_cfg
                     positions = jnp.broadcast_to(
                         jnp.arange(T, dtype=jnp.int32), (B, T))
-                    cos, sin = rope_angles(positions, cfg.rotary_dim,
-                                           cfg.rope_theta, cfg.rope_scaling)
+                    cos, sin = rope_angles_cfg(positions, cfg)
                     mask = causal_mask(T, T, 0,
                                        sliding_window=cfg.sliding_window)
                     mask = jnp.broadcast_to(mask, (B, 1, T, T))

@@ -249,9 +249,10 @@ def test_per_request_repeat_last_n():
     assert len(eng._admit_execs) == 1
 
 
-def test_resolve_paged_default():
-    """Serving default (VERDICT r2 next-3, data-driven per BASELINE r3):
-    paged for GQA on TPU, dense for MHA/MoE/CPU/incompatible meshes;
+def test_resolve_paged_default(monkeypatch):
+    """Serving default (data-driven per BASELINE r3+r4): paged for GQA on
+    TPU, paged for MHA since the v3 live-page kernel (dense again when
+    v3 is explicitly reverted), dense for MoE/CPU/incompatible meshes;
     explicit flags resolve in the server before the engine is built."""
     from unittest import mock
 
@@ -266,7 +267,11 @@ def test_resolve_paged_default():
     with mock.patch("jax.default_backend", return_value="tpu"):
         assert resolve_paged_default(gqa, None) is True
         mha = dataclasses.replace(gqa, n_kv_heads=gqa.n_heads)
+        assert resolve_paged_default(mha, None) is True   # v3 default
+        monkeypatch.setenv("TPU_PAGED_V3", "0")           # v2 revert
         assert resolve_paged_default(mha, None) is False
+        assert resolve_paged_default(gqa, None) is True
+        monkeypatch.delenv("TPU_PAGED_V3")
         moe = dataclasses.replace(gqa, n_experts=4)
         assert resolve_paged_default(moe, None) is False
         assert resolve_paged_default(
@@ -290,7 +295,14 @@ def test_resolve_serving_defaults():
         r = resolve_serving_defaults(base, gqa, None)
         assert r.paged is True and r.max_slots == 32
         # ceiling uses the SERVING seq (engine clamps to the model's 128)
-        assert r.n_pages == 8 * 128 // 16
+        # and preserves dense-8 BYTES: the pool pads head_dim to the
+        # 128-lane tile (tiny: hd 16 → 8× padding), so the page count
+        # shrinks by hd/hd_pool (round-3 advisor finding)
+        assert r.n_pages == 8 * 128 * 16 // 128 // 16
+        # a hd=128 model keeps the full token count
+        r128 = resolve_serving_defaults(
+            base, cfglib.PRESETS["llama3.2:3b"], None)
+        assert r128.n_pages == 8 * 4096 // 16
         # explicit slots: user asked for scale — dense-equivalent pool
         r2 = resolve_serving_defaults(
             EngineConfig(max_slots=16, max_seq_len=4096, paged=None,
@@ -305,3 +317,37 @@ def test_resolve_serving_defaults():
     # CPU backend: auto resolves dense
     r4 = resolve_serving_defaults(base, gqa, None)
     assert r4.paged is False and r4.max_slots == 8
+
+
+def test_fused_qkv_matches_separate(monkeypatch):
+    """Engine-side fused single-matmul QKV (models/decoder.fuse_qkv_params)
+    must decode bitwise-identically to the separate projections — every
+    output column of the (q)mm is independent, so fusion is pure op-count
+    reduction. Covers biases (attn_bias) and GQA."""
+    import dataclasses
+
+    import numpy as np
+
+    from ollama_operator_tpu.runtime.engine import Engine, SlotOptions
+    cfg = dataclasses.replace(cfglib.PRESETS["tiny"], attn_bias=True,
+                              kernels="xla")
+    params = decoder.init_params(cfg, jax.random.PRNGKey(3), jnp.float32)
+    prompt = np.array([5, 6, 7, 8, 9, 2], np.int32)
+    g = SlotOptions(temperature=0.0, repeat_penalty=1.0)
+
+    def run():
+        eng = Engine(cfg, params,
+                     ecfg=EngineConfig(max_slots=2, max_seq_len=64,
+                                       cache_dtype=jnp.float32,
+                                       min_prefill_bucket=16))
+        toks = [eng.admit(0, prompt, g)]
+        toks += [int(eng.decode()[0]) for _ in range(6)]
+        return toks, "wqkv" in eng.params["layers"]
+
+    monkeypatch.setenv("TPU_FUSED_QKV", "0")
+    ref, fused0 = run()
+    assert not fused0
+    monkeypatch.setenv("TPU_FUSED_QKV", "1")
+    got, fused1 = run()
+    assert fused1, "fusion did not engage on a single-device engine"
+    assert got == ref, (got, ref)
