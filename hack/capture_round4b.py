@@ -1,0 +1,72 @@
+"""Round-4 third capture window: the remaining VERDICT items on-chip.
+
+1. phi int8 speculative-decode envelope (accept-all / reject-all vs
+   decode_n) — the r3 #7 "give it a number".
+2. phi int8 through /api/generate (HTTP surface, r3 weak #7) next to the
+   known engine-level headline band.
+3. phi int8 dense decode_chunk=64 — the dispatch-floor insight says the
+   headline is program-dispatch-bound; a bigger chunk amortises further.
+4. mistral int4 paged-32 retry at seq 512 (the seq-1024 warm hung the
+   tunnel in window 1) — the 7B paged number.
+
+Appends one JSON per capture to .bench_r4b.jsonl.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+
+
+def main() -> int:
+    out_path = sys.argv[1] if len(sys.argv) > 1 else ".bench_r4b.jsonl"
+    import jax
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    platform = jax.devices()[0].platform
+    if platform == "cpu":
+        return 1
+    cache: dict = {}
+    common = dict(page_size=64, n_pages=None, platform=platform,
+                  params_cache=cache)
+    plan = [
+        ("spec", dict(model="phi", dtype="int8", slots=8, steps=64,
+                      seq=1024, prompt_len=128, paged=False, mixed=False,
+                      chunk=32)),
+        ("http", dict(model="phi", dtype="int8", slots=8, steps=64,
+                      seq=1024, prompt_len=128, paged=False, mixed=False,
+                      chunk=32)),
+        ("engine", dict(model="phi", dtype="int8", slots=8, steps=128,
+                        seq=1024, prompt_len=128, paged=False, mixed=False,
+                        chunk=64)),
+        ("engine", dict(model="mistral", dtype="int4", slots=32, steps=64,
+                        seq=512, prompt_len=128, paged=True, mixed=True,
+                        chunk=32)),
+    ]
+    f = open(out_path, "a")
+    ok = 0
+    for kind, cap in plan:
+        fn = {"spec": bench.measure_spec, "http": bench.measure_http,
+              "engine": bench.measure}[kind]
+        t0 = time.monotonic()
+        try:
+            rec = fn(jax, **cap, **common)
+        except Exception as e:
+            bench.log(f"r4b: {kind} {cap['model']} FAILED after "
+                      f"{time.monotonic()-t0:.0f}s: {type(e).__name__}: {e}")
+            continue
+        rec["kind"] = kind
+        rec["wall_s"] = round(time.monotonic() - t0, 1)
+        print(json.dumps(rec), file=f, flush=True)
+        ok += 1
+    f.close()
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -30,6 +30,11 @@ os.environ.setdefault(
     "XLA_FLAGS", "--xla_force_host_platform_device_count=16")
 
 import jax                                    # noqa: E402
+
+# sitecustomize force-sets jax_platforms programmatically; the env var
+# alone is not enough (same guard as conftest.py / __graft_entry__.py)
+jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
 import jax.numpy as jnp                       # noqa: E402
 from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
 

@@ -125,9 +125,13 @@ class Scheduler:
         self.engine = engine
         # speculative decoding (prompt-lookup, engine.decode_spec): draft
         # up to k tokens per greedy penalty-free slot from n-gram matches
-        # in its own context. Opt-in (TPU_SPEC_DECODE=k) — it trades the
-        # decode_chunk's dispatch amortization for multi-token verify
-        # steps, a win where dispatch is cheap and outputs are repetitive
+        # in its own context. Opt-in (TPU_SPEC_DECODE=k), and the r4
+        # envelope capture is why it STAYS opt-in: on the remote-dispatch
+        # v5e even the accept-ALL ceiling measured 0.023x the chunked
+        # decode_n baseline (823 ms per spec dispatch vs 32 tokens per
+        # chunk dispatch — BASELINE.md r4). It can only win where
+        # dispatch is near-free (colocated host) AND per-token streaming
+        # latency matters more than throughput.
         import os as _os
         self.spec_k = int(_os.environ.get("TPU_SPEC_DECODE", "0") or "0")
         self._waiting: queue.Queue = queue.Queue(maxsize=max_queue)
