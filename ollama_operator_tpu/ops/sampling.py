@@ -7,8 +7,9 @@ program, with per-slot parameters carried as arrays so heterogeneous
 requests share one compiled decode step.
 
 Supported (matching the Ollama API options surface): temperature, top_k,
-top_p, min_p, repeat_penalty (over a token-count buffer), presence/frequency
-penalty, per-slot PRNG seed.
+top_p, min_p, typical_p, repeat_penalty (over a token-count buffer),
+presence/frequency penalty, mirostat v1/v2 (per-slot ``mu`` state carried
+by the engine), per-slot PRNG seed.
 """
 
 from __future__ import annotations
@@ -29,25 +30,35 @@ class SamplingParams:
     top_k: jax.Array         # [B] i32; <=0 → off
     top_p: jax.Array         # [B] f32; >=1 → off
     min_p: jax.Array         # [B] f32; <=0 → off
+    typical_p: jax.Array     # [B] f32; >=1 → off
     repeat_penalty: jax.Array    # [B] f32; 1.0 → off
     presence_penalty: jax.Array  # [B] f32
     frequency_penalty: jax.Array  # [B] f32
+    mirostat: jax.Array      # [B] i32; 0 off, 1/2 → replaces the filters
+    mirostat_tau: jax.Array  # [B] f32 target surprise (bits/token)
+    mirostat_eta: jax.Array  # [B] f32 learning rate for mu
 
     @staticmethod
     def make(B: int, temperature=0.8, top_k=40, top_p=0.9, min_p=0.0,
-             repeat_penalty=1.1, presence_penalty=0.0, frequency_penalty=0.0):
+             typical_p=1.0, repeat_penalty=1.1, presence_penalty=0.0,
+             frequency_penalty=0.0, mirostat=0, mirostat_tau=5.0,
+             mirostat_eta=0.1):
         f = lambda v: jnp.full((B,), v, jnp.float32)
         return SamplingParams(
             temperature=f(temperature), top_k=jnp.full((B,), top_k, jnp.int32),
-            top_p=f(top_p), min_p=f(min_p), repeat_penalty=f(repeat_penalty),
+            top_p=f(top_p), min_p=f(min_p), typical_p=f(typical_p),
+            repeat_penalty=f(repeat_penalty),
             presence_penalty=f(presence_penalty),
-            frequency_penalty=f(frequency_penalty))
+            frequency_penalty=f(frequency_penalty),
+            mirostat=jnp.full((B,), mirostat, jnp.int32),
+            mirostat_tau=f(mirostat_tau), mirostat_eta=f(mirostat_eta))
 
 
 jax.tree_util.register_dataclass(
     SamplingParams,
-    data_fields=["temperature", "top_k", "top_p", "min_p", "repeat_penalty",
-                 "presence_penalty", "frequency_penalty"],
+    data_fields=["temperature", "top_k", "top_p", "min_p", "typical_p",
+                 "repeat_penalty", "presence_penalty", "frequency_penalty",
+                 "mirostat", "mirostat_tau", "mirostat_eta"],
     meta_fields=[])
 
 
@@ -66,22 +77,34 @@ def apply_penalties(logits, token_counts, sp: SamplingParams):
 N_CANDIDATES = 1024
 
 
-def sample(logits, token_counts, sp: SamplingParams, key,
-           n_candidates: int = N_CANDIDATES):
-    """logits [B, V] f32 → tokens [B] i32.
+_LN2 = 0.6931471805599453
+_MIROSTAT_M = 100   # v1's zipf-fit window (llama.cpp default)
 
-    Greedy where temperature <= 0, otherwise penalised + top-k/p/min-p
-    filtered categorical sampling. ``key`` is either a single PRNG key
-    (shared across the batch) or a [B] array of per-slot keys (each request
-    carries its own seed, per the Ollama API `seed` option).
+
+def sample(logits, token_counts, sp: SamplingParams, key, mu=None,
+           n_candidates: int = N_CANDIDATES):
+    """logits [B, V] f32 → tokens [B] i32, or (tokens, mu') when ``mu``
+    ([B] f32, the mirostat surprise-budget state) is given.
+
+    Greedy where temperature <= 0, otherwise penalised + top-k/typical/
+    top-p/min-p filtered categorical sampling. Slots with mirostat 1/2
+    replace the static filters with the adaptive surprise truncation
+    (llama.cpp's sampler chain does the same: penalties → temp →
+    mirostat); their ``mu`` entries update per sampled token, everyone
+    else's pass through unchanged. Callers that never serve mirostat may
+    omit ``mu`` and get the plain token array. ``key`` is either a single
+    PRNG key (shared across the batch) or a [B] array of per-slot keys
+    (each request carries its own seed, per the Ollama API `seed` option).
 
     The filters run in a compressed top-``n_candidates`` space: ONE
-    ``lax.top_k`` replaces the two full [B, V] sorts the masks would
+    ``lax.top_k`` replaces the full [B, V] sorts the masks would
     otherwise need (a large share of the decode step at 50k+ vocabs), and
     since candidates come out sorted the top-p cumsum needs no further
-    sort. ``top_k`` is effectively capped at n_candidates, and top-p mass
-    beyond the top-1024 logits is treated as zero — both far outside any
-    practical sampling configuration (Ollama defaults: top_k=40).
+    sort (typical_p re-orders by entropy deviation — its argsort runs
+    over [B, C], not [B, V]). ``top_k`` is effectively capped at
+    n_candidates, and top-p/typical mass beyond the top-1024 logits is
+    treated as zero — both far outside any practical sampling
+    configuration (Ollama defaults: top_k=40).
     """
     logits = apply_penalties(logits, token_counts, sp)
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -97,26 +120,81 @@ def sample(logits, token_counts, sp: SamplingParams, key,
     kth = jnp.take_along_axis(scaled, (k - 1)[:, None], axis=-1)
     keep = scaled >= kth
     keep = jnp.where((sp.top_k > 0)[:, None], keep, True)
-    scaled = jnp.where(keep, scaled, NEG_INF)
+    filt = jnp.where(keep, scaled, NEG_INF)
+
+    # locally-typical: keep the candidates whose surprise deviates least
+    # from the distribution's entropy, up to typical_p cumulative mass
+    # (Meister et al.; llama.cpp llama_sampler_typical). Deviation order
+    # is not the sorted-logit order, so this is the one filter that pays
+    # its own [B, C] argsort.
+    probs = jax.nn.softmax(filt, axis=-1)
+    nlp = -jnp.log(jnp.maximum(probs, 1e-30))       # nats
+    ent = jnp.sum(jnp.where(probs > 0, probs * nlp, 0.0), axis=-1,
+                  keepdims=True)
+    order = jnp.argsort(jnp.abs(nlp - ent), axis=-1)
+    p_ord = jnp.take_along_axis(probs, order, axis=-1)
+    cum = jnp.cumsum(p_ord, axis=-1)
+    keep_ord = (cum - p_ord) < sp.typical_p[:, None]   # keeps the first
+    bi = jnp.arange(B)[:, None]
+    keep = jnp.zeros((B, C), bool).at[bi, order].set(keep_ord)
+    keep = jnp.where((sp.typical_p < 1.0)[:, None], keep, True)
+    filt = jnp.where(keep, filt, NEG_INF)
 
     # top-p over the (sorted) candidate probabilities
-    probs = jax.nn.softmax(scaled, axis=-1)
+    probs = jax.nn.softmax(filt, axis=-1)
     cum = jnp.cumsum(probs, axis=-1)
     keep = (cum - probs) < sp.top_p[:, None]        # always keeps the first
     keep = jnp.where((sp.top_p < 1.0)[:, None], keep, True)
-    scaled = jnp.where(keep, scaled, NEG_INF)
+    filt = jnp.where(keep, filt, NEG_INF)
 
     # min-p relative to the max candidate probability
-    probs = jax.nn.softmax(scaled, axis=-1)
+    probs = jax.nn.softmax(filt, axis=-1)
     keep = probs >= (sp.min_p[:, None] * probs[:, :1])
     keep = jnp.where((sp.min_p > 0.0)[:, None], keep, True)
-    scaled = jnp.where(keep, scaled, NEG_INF)
+    filt = jnp.where(keep, filt, NEG_INF)
+
+    if mu is not None:
+        # mirostat truncation over the UNfiltered temp-scaled candidates
+        # (the adaptive cut replaces the static filters). v2 drops
+        # candidates whose surprise (-log2 p) exceeds mu; v1 derives a
+        # top-k cut from a zipf-exponent fit over the head of the
+        # distribution (llama.cpp llama_sampler_mirostat{,_v2}).
+        pm = jax.nn.softmax(scaled, axis=-1)
+        surprise = -jnp.log(jnp.maximum(pm, 1e-30)) / _LN2   # bits
+        m = min(_MIROSTAT_M, C)
+        t_i = jnp.log(jnp.arange(2, m + 1) / jnp.arange(1, m))   # [m-1]
+        b_i = jnp.log(jnp.maximum(pm[:, :m - 1], 1e-30)
+                      / jnp.maximum(pm[:, 1:m], 1e-30))          # [B, m-1]
+        s_hat = jnp.sum(t_i * b_i, axis=-1) / jnp.sum(t_i * t_i)  # [B]
+        eps = jnp.maximum(s_hat - 1.0, 1e-5)
+        k1 = ((eps * jnp.exp2(jnp.minimum(mu, 60.0)))
+              / (1.0 - float(V) ** (-eps))) ** (1.0 / jnp.maximum(s_hat,
+                                                                  1e-5))
+        k1 = jnp.clip(jnp.nan_to_num(k1, nan=float(C)), 1.0, float(C))
+        col = jnp.arange(C)[None, :]
+        keep1 = col < k1[:, None]
+        keep2 = surprise <= mu[:, None]
+        keep_m = jnp.where((sp.mirostat == 2)[:, None], keep2, keep1)
+        keep_m = keep_m.at[:, 0].set(True)          # min_keep = 1
+        use_m = (sp.mirostat > 0)[:, None]
+        filt = jnp.where(use_m, jnp.where(keep_m, scaled, NEG_INF), filt)
 
     if getattr(key, "ndim", 0) >= 1:  # per-slot keys
-        ci = jax.vmap(jax.random.categorical)(key, scaled)
+        ci = jax.vmap(jax.random.categorical)(key, filt)
     else:
-        ci = jax.random.categorical(key, scaled, axis=-1)
+        ci = jax.random.categorical(key, filt, axis=-1)
     sampled = jnp.take_along_axis(cand, ci[:, None], axis=-1)[:, 0]
     sampled = sampled.astype(jnp.int32)
+    toks = jnp.where(sp.temperature <= 0.0, greedy, sampled)
+    if mu is None:
+        return toks
 
-    return jnp.where(sp.temperature <= 0.0, greedy, sampled)
+    # observed surprise of the sampled token in the truncated,
+    # re-normalised distribution drives the mu update (llama.cpp measures
+    # p from the post-truncation softmax the same way)
+    pf = jax.nn.softmax(filt, axis=-1)
+    p_sel = jnp.take_along_axis(pf, ci[:, None], axis=-1)[:, 0]
+    e_obs = -jnp.log(jnp.maximum(p_sel, 1e-30)) / _LN2
+    mu2 = mu - sp.mirostat_eta * (e_obs - sp.mirostat_tau)
+    live = (sp.mirostat > 0) & (sp.temperature > 0.0)
+    return toks, jnp.where(live, mu2, mu)

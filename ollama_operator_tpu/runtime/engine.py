@@ -190,9 +190,15 @@ class SlotOptions:
     top_k: int = 40
     top_p: float = 0.9
     min_p: float = 0.0
+    typical_p: float = 1.0
     repeat_penalty: float = 1.1
     presence_penalty: float = 0.0
     frequency_penalty: float = 0.0
+    # mirostat: 0 off, 1/2 replace the static filters with the adaptive
+    # surprise truncation (per-slot mu state lives in Engine.mu)
+    mirostat: int = 0
+    mirostat_tau: float = 5.0
+    mirostat_eta: float = 0.1
     seed: int = -1
     # penalty window for THIS request: 0 disables the window, -1 means
     # "engine max"; values above the engine's repeat_last_n capacity clamp
@@ -416,6 +422,9 @@ class Engine:
         self.sp = jax.tree_util.tree_map(
             lambda a: self._g(np.asarray(a), slot_sh),
             sampling.SamplingParams.make(B))
+        # mirostat surprise budget, re-seeded to 2*tau at admission; rides
+        # the slot-state tuple through every decode/admit program
+        self.mu = zeros((B,), jnp.float32, slot_sh)
 
         def _base_keys():
             return jax.vmap(jax.random.fold_in)(
@@ -488,18 +497,20 @@ class Engine:
         cache_sh, slot_sh = self._cache_sh, self._slot_sh
         slot_sh2 = self._slot_sh2
 
-        def pin(k_cache, v_cache, lengths, counts, last_tokens, pring):
+        def pin(k_cache, v_cache, lengths, counts, last_tokens, pring, mu):
             """Pin slot-state outputs to their canonical shardings — the
             AOT-compiled decode executables require the state sharding to
             be IDENTICAL across admits (GSPMD would otherwise pick a fresh
             output sharding per program and the exec call would reject).
             Rank-2 state pins with the CLOSED spec (see __init__)."""
             if slot_sh is None:
-                return k_cache, v_cache, lengths, counts, last_tokens, pring
+                return (k_cache, v_cache, lengths, counts, last_tokens,
+                        pring, mu)
             wsc = jax.lax.with_sharding_constraint
             return (wsc(k_cache, cache_sh), wsc(v_cache, cache_sh),
                     wsc(lengths, slot_sh), wsc(counts, slot_sh2),
-                    wsc(last_tokens, slot_sh), wsc(pring, slot_sh2))
+                    wsc(last_tokens, slot_sh), wsc(pring, slot_sh2),
+                    wsc(mu, slot_sh))
 
         if self.sp_size > 1:
             from ..parallel import long_context
@@ -520,7 +531,7 @@ class Engine:
 
         W = max(1, self.ecfg.repeat_last_n)
 
-        def _sample_install(lengths, counts, last_tokens, pring, logits,
+        def _sample_install(lengths, counts, last_tokens, pring, mu, logits,
                             ring_row, counts_row, slot, total, sp_row, key,
                             mask_row, cflag, rln):
             """Shared admission tail (fresh prefill AND prefix-cache
@@ -529,13 +540,18 @@ class Engine:
             indexes it), push it through the penalty window
             (``ring_row``/``counts_row`` cover the prompt), and install
             slot state. ``rln`` is the request's effective window (≤ W;
-            0 = penalties see nothing). Returns (tok, lengths, counts,
-            last_tokens, pring)."""
+            0 = penalties see nothing). The slot's mirostat budget
+            re-seeds to 2*tau here (llama.cpp's init) and absorbs the
+            first token's surprise. Returns (tok, lengths, counts,
+            last_tokens, pring, mu)."""
             last = logits
             allowed = unpack_mask(mask_row, cfg.vocab_size)
             last = jnp.where((cflag == 1) & ~allowed, sampling.NEG_INF, last)
-            tok = sampling.sample(last[None], counts_row[None], sp_row,
-                                  key[None])[0]
+            mu_row = 2.0 * sp_row.mirostat_tau
+            tok, mu_row = sampling.sample(last[None], counts_row[None],
+                                          sp_row, key[None], mu_row)
+            tok = tok[0]
+            mu = mu.at[slot].set(mu_row[0])
             rmod = jnp.maximum(rln, 1)
             evict = ring_row[total % rmod]
             counts_row = counts_row.at[evict].add(-1, mode="drop")
@@ -546,12 +562,12 @@ class Engine:
             lengths = lengths.at[slot].set(total)
             counts = counts.at[slot].set(counts_row)
             last_tokens = last_tokens.at[slot].set(tok)
-            return tok, lengths, counts, last_tokens, pring
+            return tok, lengths, counts, last_tokens, pring, mu
 
         def _insert_prefilled(k_cache, v_cache, lengths, counts,
-                              last_tokens, pring, logits, ks, vs, tokens,
-                              slot, n_valid, sp_row, key, mask_row, cflag,
-                              rln, table_row=None):
+                              last_tokens, pring, mu, logits, ks, vs,
+                              tokens, slot, n_valid, sp_row, key, mask_row,
+                              cflag, rln, table_row=None):
             """Fresh-prefill admission: build the penalty window from the
             LAST ``rln`` prompt tokens of the device-side chunk (image pad
             positions carry id == vocab_size, which the scatter-add drops —
@@ -576,8 +592,9 @@ class Engine:
                                 ).at[slot_idx].set(vals, mode="drop")
             counts_row = jnp.zeros((cfg.vocab_size,), jnp.int32
                                    ).at[vals].add(1, mode="drop")
-            (tok, lengths, counts, last_tokens, pring) = _sample_install(
-                lengths, counts, last_tokens, pring, last, ring_row,
+            (tok, lengths, counts, last_tokens, pring,
+             mu) = _sample_install(
+                lengths, counts, last_tokens, pring, mu, last, ring_row,
                 counts_row, slot, n_valid, sp_row, key, mask_row, cflag,
                 rln)
             if self.paged and self._paged_dp > 1:
@@ -602,23 +619,23 @@ class Engine:
                 v_cache = jax.lax.dynamic_update_slice(
                     v_cache, vs.astype(v_cache.dtype), (0, slot, 0, 0, 0))
             return (tok, *pin(k_cache, v_cache, lengths, counts,
-                              last_tokens, pring))
+                              last_tokens, pring, mu))
 
         def _admit(params, k_cache, v_cache, lengths, counts, last_tokens,
-                   pring, tokens, slot, n_valid, sp_row, key, mask_row,
+                   pring, mu, tokens, slot, n_valid, sp_row, key, mask_row,
                    cflag, rln, table_row=None):
             """Prefill a padded B=1 chunk AND insert it into the slot state
             — one device program, one host round-trip per admission.
             ``table_row`` [NBLK] — the slot's block table (paged mode)."""
             logits, ks, vs = prefill_impl(params, tokens=tokens)
             return _insert_prefilled(k_cache, v_cache, lengths, counts,
-                                     last_tokens, pring, logits, ks, vs,
+                                     last_tokens, pring, mu, logits, ks, vs,
                                      tokens, slot, n_valid, sp_row, key,
                                      mask_row, cflag, rln, table_row)
 
         def _admit_embeds(params, k_cache, v_cache, lengths, counts,
-                          last_tokens, pring, tokens, embeds, slot, n_valid,
-                          sp_row, key, mask_row, cflag, rln,
+                          last_tokens, pring, mu, tokens, embeds, slot,
+                          n_valid, sp_row, key, mask_row, cflag, rln,
                           table_row=None):
             """Multimodal admission: like _admit but prefilling from a
             precomputed [1, T, D] embedding sequence (image tokens spliced
@@ -628,13 +645,14 @@ class Engine:
             logits, ks, vs = prefill_impl(params, tokens=tokens,
                                           inputs_embeds=embeds)
             return _insert_prefilled(k_cache, v_cache, lengths, counts,
-                                     last_tokens, pring, logits, ks, vs,
+                                     last_tokens, pring, mu, logits, ks, vs,
                                      tokens, slot, n_valid, sp_row, key,
                                      mask_row, cflag, rln, table_row)
 
         def _decode_body(params, k_cache, v_cache, lengths, counts,
-                         last_tokens, pring, sp, keys, active, mask_bits,
-                         constrained, rln, attn_len=None, tables=None):
+                         last_tokens, pring, mu, sp, keys, active,
+                         mask_bits, constrained, rln, attn_len=None,
+                         tables=None):
             if self.paged:
                 ps = self.ecfg.page_size
                 nblk = -(-(attn_len or self.max_seq) // ps)
@@ -653,7 +671,9 @@ class Engine:
             allowed = unpack_mask(mask_bits, cfg.vocab_size)
             last = jnp.where((constrained == 1)[:, None] & ~allowed,
                              sampling.NEG_INF, last)
-            toks = sampling.sample(last, counts, sp, step_keys)
+            toks, mu_new = sampling.sample(last, counts, sp, step_keys,
+                                           mu)
+            mu = jnp.where(active == 1, mu_new, mu)
             B = toks.shape[0]
             bi = jnp.arange(B)
             # penalty window: the NEW token's absolute position is
@@ -675,22 +695,22 @@ class Engine:
             lengths = lengths + active
             last_tokens = jnp.where(active == 1, toks, last_tokens)
             return (toks, *pin(k_cache, v_cache, lengths, counts,
-                               last_tokens, pring))
+                               last_tokens, pring, mu))
 
         def _decode(params, k_cache, v_cache, lengths, counts, last_tokens,
-                    pring, sp, keys, active, mask_bits, constrained, rln,
-                    tables=None):
+                    pring, mu, sp, keys, active, mask_bits, constrained,
+                    rln, tables=None):
             (toks, k_cache, v_cache, lengths, counts, last_tokens,
-             pring) = _decode_body(params, k_cache, v_cache, lengths,
-                                   counts, last_tokens, pring, sp, keys,
-                                   active, mask_bits, constrained, rln,
-                                   tables=tables)
+             pring, mu) = _decode_body(params, k_cache, v_cache, lengths,
+                                       counts, last_tokens, pring, mu, sp,
+                                       keys, active, mask_bits,
+                                       constrained, rln, tables=tables)
             return (toks, k_cache, v_cache, lengths, counts, last_tokens,
-                    pring, keys)
+                    pring, mu, keys)
 
         def _decode_n(params, k_cache, v_cache, lengths, counts, last_tokens,
-                      pring, sp, keys, active, mask_bits, constrained, rln,
-                      n, attn_len, tables=None, budgets=None):
+                      pring, mu, sp, keys, active, mask_bits, constrained,
+                      rln, n, attn_len, tables=None, budgets=None):
             """n decode steps as ONE device program (lax.scan) — a single
             dispatch + host sync per n tokens per slot. ``attn_len`` is the
             static attended-cache prefix (decode traffic scales with it,
@@ -707,28 +727,31 @@ class Engine:
             format:"json" request used to collapse everyone to n=1)."""
             def step(carry, t):
                 (k_cache, v_cache, lengths, counts, last_tokens,
-                 pring) = carry
+                 pring, mu) = carry
                 act = active if budgets is None else active * (t < budgets)
                 (toks, k_cache, v_cache, lengths, counts, last_tokens,
-                 pring) = _decode_body(params, k_cache, v_cache,
-                                       lengths, counts, last_tokens, pring,
-                                       sp, keys, act, mask_bits,
-                                       constrained, rln, attn_len=attn_len,
-                                       tables=tables)
+                 pring, mu) = _decode_body(params, k_cache, v_cache,
+                                           lengths, counts, last_tokens,
+                                           pring, mu, sp, keys, act,
+                                           mask_bits, constrained, rln,
+                                           attn_len=attn_len,
+                                           tables=tables)
                 return (k_cache, v_cache, lengths, counts, last_tokens,
-                        pring), toks
+                        pring, mu), toks
 
-            carry = (k_cache, v_cache, lengths, counts, last_tokens, pring)
+            carry = (k_cache, v_cache, lengths, counts, last_tokens, pring,
+                     mu)
             carry, toks_n = jax.lax.scan(
                 step, carry, jnp.arange(n, dtype=jnp.int32))
-            (k_cache, v_cache, lengths, counts, last_tokens, pring) = carry
+            (k_cache, v_cache, lengths, counts, last_tokens, pring,
+             mu) = carry
             return (toks_n, k_cache, v_cache, lengths, counts, last_tokens,
-                    pring, keys)
+                    pring, mu, keys)
 
         def _spec_verify(params, k_cache, v_cache, lengths, counts,
-                         last_tokens, pring, sp, keys, active, mask_bits,
-                         constrained, rln, is_greedy, drafts, attn_len,
-                         tables=None):
+                         last_tokens, pring, mu, sp, keys, active,
+                         mask_bits, constrained, rln, is_greedy, drafts,
+                         attn_len, tables=None):
             """Speculative verify step (one dispatch): run the cached
             forward over [last_token, draft_0..draft_{k-1}] per slot,
             greedy-accept the longest matching draft prefix (greedy
@@ -764,7 +787,11 @@ class Engine:
             allowed = unpack_mask(mask_bits, V)
             l0 = jnp.where((constrained == 1)[:, None] & ~allowed,
                            sampling.NEG_INF, l0)
-            sampled0 = sampling.sample(l0, counts, sp, step_keys)
+            sampled0, mu_new = sampling.sample(l0, counts, sp, step_keys,
+                                               mu)
+            # greedy (accepting) slots never run mirostat; only the
+            # sampled path's slots absorb the surprise update
+            mu = jnp.where((active == 1) & ~ok, mu_new, mu)
             bonus = jnp.where(ok, greedy[bi, n_acc], sampled0)
             t_idx = jnp.arange(kk + 1, dtype=jnp.int32)[None, :]
             dpad = jnp.concatenate(
@@ -797,7 +824,7 @@ class Engine:
                 push, (lengths, counts, last_tokens, pring),
                 jnp.arange(kk + 1, dtype=jnp.int32))
             return (out, *pin(k_cache, v_cache, lengths, counts,
-                              last_tokens, pring), keys)
+                              last_tokens, pring, mu), keys)
 
         def _make_extend_paged(A):
             """Paged prefix-cache continuation, attending only the first
@@ -815,7 +842,7 @@ class Engine:
             nblk_a = -(-A // self.ecfg.page_size)
 
             def _extend_paged(params, k_cache, v_cache, lengths, counts,
-                              last_tokens, pring, tokens, ring_row,
+                              last_tokens, pring, mu, tokens, ring_row,
                               counts_row, slot, start, n_new, table_row,
                               sp_row, key, mask_row, cflag, rln):
                 logits, k_cache, v_cache = \
@@ -825,17 +852,17 @@ class Engine:
                         mesh=self.mesh)
                 last = jax.lax.dynamic_index_in_dim(
                     logits[0], n_new - 1, axis=0, keepdims=False)
-                (tok, lengths, counts, last_tokens,
-                 pring) = _sample_install(
-                    lengths, counts, last_tokens, pring, last, ring_row,
-                    counts_row, slot, start + n_new, sp_row, key, mask_row,
-                    cflag, rln)
+                (tok, lengths, counts, last_tokens, pring,
+                 mu) = _sample_install(
+                    lengths, counts, last_tokens, pring, mu, last,
+                    ring_row, counts_row, slot, start + n_new, sp_row, key,
+                    mask_row, cflag, rln)
                 return (tok, *pin(k_cache, v_cache, lengths, counts,
-                                  last_tokens, pring))
+                                  last_tokens, pring, mu))
 
-            def _extend_paged_dp(params, k_cache, v_cache, lengths, counts,
-                                 last_tokens, pring, tokens, ring_row,
-                                 counts_row, slot, start, n_new,
+            def _extend_paged_dp(params, k_cache, v_cache, lengths,
+                                 counts, last_tokens, pring, mu, tokens,
+                                 ring_row, counts_row, slot, start, n_new,
                                  table_rows, owner, sp_row, key, mask_row,
                                  cflag, rln):
                 logits, k_cache, v_cache = decoder.paged_extend_dp(
@@ -843,13 +870,13 @@ class Engine:
                     start[None], nblk_a, owner, self.mesh)
                 last = jax.lax.dynamic_index_in_dim(
                     logits[0], n_new - 1, axis=0, keepdims=False)
-                (tok, lengths, counts, last_tokens,
-                 pring) = _sample_install(
-                    lengths, counts, last_tokens, pring, last, ring_row,
-                    counts_row, slot, start + n_new, sp_row, key, mask_row,
-                    cflag, rln)
+                (tok, lengths, counts, last_tokens, pring,
+                 mu) = _sample_install(
+                    lengths, counts, last_tokens, pring, mu, last,
+                    ring_row, counts_row, slot, start + n_new, sp_row, key,
+                    mask_row, cflag, rln)
                 return (tok, *pin(k_cache, v_cache, lengths, counts,
-                                  last_tokens, pring))
+                                  last_tokens, pring, mu))
             return (_extend_paged_dp if self._paged_dp > 1
                     else _extend_paged)
 
@@ -887,9 +914,9 @@ class Engine:
                 else decoder.forward_with_cache
 
             def _extend(params, k_cache, v_cache, lengths, counts,
-                        last_tokens, pring, tokens, ring_row, counts_row,
-                        slot, start, n_new, sp_row, key, mask_row, cflag,
-                        rln):
+                        last_tokens, pring, mu, tokens, ring_row,
+                        counts_row, slot, start, n_new, sp_row, key,
+                        mask_row, cflag, rln):
                 dsl = jax.lax.dynamic_slice
                 dus = jax.lax.dynamic_update_slice
                 if self.quant_cache:
@@ -918,21 +945,22 @@ class Engine:
                 v_cache = write5(v_cache, vc_s)
                 last = jax.lax.dynamic_index_in_dim(
                     logits[0], n_new - 1, axis=0, keepdims=False)
-                (tok, lengths, counts, last_tokens,
-                 pring) = _sample_install(
-                    lengths, counts, last_tokens, pring, last, ring_row,
-                    counts_row, slot, start + n_new, sp_row, key, mask_row,
-                    cflag, rln)
+                (tok, lengths, counts, last_tokens, pring,
+                 mu) = _sample_install(
+                    lengths, counts, last_tokens, pring, mu, last,
+                    ring_row, counts_row, slot, start + n_new, sp_row, key,
+                    mask_row, cflag, rln)
                 return (tok, *pin(k_cache, v_cache, lengths, counts,
-                                  last_tokens, pring))
+                                  last_tokens, pring, mu))
             return _extend
 
-        def _release(lengths, counts, last_tokens, pring, slot):
+        def _release(lengths, counts, last_tokens, pring, mu, slot):
             lengths = lengths.at[slot].set(0)
             counts = counts.at[slot].set(0)
             last_tokens = last_tokens.at[slot].set(0)
             pring = pring.at[slot].set(cfg.vocab_size)
-            return lengths, counts, last_tokens, pring
+            mu = mu.at[slot].set(0.0)
+            return lengths, counts, last_tokens, pring, mu
 
         def _set_mask(mask_bits, constr, slot, row, flag):
             mask_bits = mask_bits.at[slot].set(row)
@@ -952,7 +980,7 @@ class Engine:
         state_outs = None
         if slot_sh is not None:
             state_outs = (cache_sh, cache_sh, slot_sh, slot_sh2, slot_sh,
-                          slot_sh2)
+                          slot_sh2, slot_sh)
 
         def _jit(fn, donate, static=None, outs=None):
             kw = {"donate_argnums": donate}
@@ -975,29 +1003,32 @@ class Engine:
             decn_outs = (toksn_sh,) + state_outs + (slot_sh,)
         else:
             tok_outs = dec_outs = decn_outs = None
-        self._admit_fn = _jit(_admit, (1, 2, 3, 4, 5, 6), outs=tok_outs)
-        self._admit_embeds_fn = _jit(_admit_embeds, (1, 2, 3, 4, 5, 6),
+        self._admit_fn = _jit(_admit, (1, 2, 3, 4, 5, 6, 7),
+                              outs=tok_outs)
+        self._admit_embeds_fn = _jit(_admit_embeds, (1, 2, 3, 4, 5, 6, 7),
                                      outs=tok_outs)
         self._admit_execs: Dict[int, Any] = {}
         make_ext = (_make_extend_paged if self.paged
                     else _make_extend_sp if self.sp_size > 1
                     else _make_extend)
-        self._extend_make = lambda A: _jit(make_ext(A), (1, 2, 3, 4, 5, 6),
+        self._extend_make = lambda A: _jit(make_ext(A),
+                                           (1, 2, 3, 4, 5, 6, 7),
                                            outs=tok_outs)
         self._extend_jits: Dict[int, Any] = {}
         self._extend_execs: Dict[Any, Any] = {}
-        self._decode_fn = _jit(_decode, (1, 2, 3, 4, 5, 6, 8),
+        self._decode_fn = _jit(_decode, (1, 2, 3, 4, 5, 6, 7, 9),
                                outs=dec_outs)
-        self._decode_n_fn = _jit(_decode_n, (1, 2, 3, 4, 5, 6, 8),
-                                 static=(13, 14), outs=decn_outs)
+        self._decode_n_fn = _jit(_decode_n, (1, 2, 3, 4, 5, 6, 7, 9),
+                                 static=(14, 15), outs=decn_outs)
         spec_outs = (((slot_sh2,) + state_outs + (slot_sh,))
                      if state_outs else None)
-        self._spec_fn = _jit(_spec_verify, (1, 2, 3, 4, 5, 6, 8),
-                             static=(15,), outs=spec_outs)
+        self._spec_fn = _jit(_spec_verify, (1, 2, 3, 4, 5, 6, 7, 9),
+                             static=(16,), outs=spec_outs)
         self._spec_execs: Dict[Any, Any] = {}
         self._release_fn = _jit(
-            _release, (0, 1, 2, 3),
-            outs=(slot_sh, slot_sh2, slot_sh, slot_sh2) if slot_sh else None)
+            _release, (0, 1, 2, 3, 4),
+            outs=((slot_sh, slot_sh2, slot_sh, slot_sh2, slot_sh)
+                  if slot_sh else None))
 
         def _install_key(keys, slot, seed):
             k = jax.random.key(seed)
@@ -1032,10 +1063,14 @@ class Engine:
             top_k=g(np.array([o.top_k], np.int32)),
             top_p=g(np.array([o.top_p], np.float32)),
             min_p=g(np.array([o.min_p], np.float32)),
+            typical_p=g(np.array([o.typical_p], np.float32)),
             repeat_penalty=g(np.array([o.repeat_penalty], np.float32)),
             presence_penalty=g(np.array([o.presence_penalty], np.float32)),
             frequency_penalty=g(np.array([o.frequency_penalty],
-                                         np.float32)))
+                                         np.float32)),
+            mirostat=g(np.array([o.mirostat], np.int32)),
+            mirostat_tau=g(np.array([o.mirostat_tau], np.float32)),
+            mirostat_eta=g(np.array([o.mirostat_eta], np.float32)))
 
     def _rebuild_sp(self):
         opts = [self._opts.get(i, SlotOptions()) for i in range(self.n_slots)]
@@ -1046,12 +1081,18 @@ class Engine:
             top_k=g(np.array([o.top_k for o in opts], np.int32)),
             top_p=g(np.array([o.top_p for o in opts], np.float32)),
             min_p=g(np.array([o.min_p for o in opts], np.float32)),
+            typical_p=g(np.array([o.typical_p for o in opts], np.float32)),
             repeat_penalty=g(np.array(
                 [o.repeat_penalty for o in opts], np.float32)),
             presence_penalty=g(np.array(
                 [o.presence_penalty for o in opts], np.float32)),
             frequency_penalty=g(np.array(
-                [o.frequency_penalty for o in opts], np.float32)))
+                [o.frequency_penalty for o in opts], np.float32)),
+            mirostat=g(np.array([o.mirostat for o in opts], np.int32)),
+            mirostat_tau=g(np.array(
+                [o.mirostat_tau for o in opts], np.float32)),
+            mirostat_eta=g(np.array(
+                [o.mirostat_eta for o in opts], np.float32)))
 
     def _prep_slot(self, slot: int, opts: SlotOptions, seq_len: int,
                    mask_row: Optional[np.ndarray]):
@@ -1121,18 +1162,20 @@ class Engine:
             emb = np.zeros((1, bucket, embeds.shape[1]), np.float32)
             emb[0, :n] = embeds
             (tok, self.k_cache, self.v_cache, self.lengths, self.counts,
-             self.last_tokens, self.pring) = self._admit_embeds_fn(
+             self.last_tokens, self.pring,
+             self.mu) = self._admit_embeds_fn(
                 self.params, self.k_cache, self.v_cache, self.lengths,
-                self.counts, self.last_tokens, self.pring,
+                self.counts, self.last_tokens, self.pring, self.mu,
                 self._gr(tokens), self._gr(emb), self._gr(np.int32(slot)),
                 self._gr(np.int32(n)), self._sp_row(opts), key, mrow,
                 cflag, self._gr(np.int32(self._resolve_rln(opts))),
                 table_row)
         else:
             (tok, self.k_cache, self.v_cache, self.lengths, self.counts,
-             self.last_tokens, self.pring) = self._admit_exec(bucket)(
+             self.last_tokens, self.pring,
+             self.mu) = self._admit_exec(bucket)(
                 self.params, self.k_cache, self.v_cache, self.lengths,
-                self.counts, self.last_tokens, self.pring,
+                self.counts, self.last_tokens, self.pring, self.mu,
                 self._gr(tokens), self._gr(np.int32(slot)),
                 self._gr(np.int32(n)), self._sp_row(opts), key, mrow,
                 cflag, self._gr(np.int32(self._resolve_rln(opts))),
@@ -1211,7 +1254,8 @@ class Engine:
             W = max(1, self.ecfg.repeat_last_n)
             zi = lambda v: self._gr(np.int32(v))  # noqa: E731
             args = [self.params, self.k_cache, self.v_cache, self.lengths,
-                    self.counts, self.last_tokens, self.pring, tokens,
+                    self.counts, self.last_tokens, self.pring, self.mu,
+                    tokens,
                     self._gr(np.zeros((W,), np.int32)), self._gr(
                         np.zeros((self.cfg.vocab_size,), np.int32)),
                     zi(0), zi(1), zi(1)]
@@ -1278,7 +1322,7 @@ class Engine:
         np.add.at(counts_row, window, 1)
         key, mrow, cflag = self._prep_slot(slot, opts, n_total, mask_row)
         args = [self.params, self.k_cache, self.v_cache, self.lengths,
-                self.counts, self.last_tokens, self.pring,
+                self.counts, self.last_tokens, self.pring, self.mu,
                 self._gr(tokens), self._gr(ring),
                 self._gr(counts_row), self._gr(np.int32(slot)),
                 self._gr(np.int32(start)), self._gr(np.int32(n_new))]
@@ -1307,7 +1351,7 @@ class Engine:
         args += [self._sp_row(opts), key, mrow, cflag,
                  self._gr(np.int32(rln))]
         (tok, self.k_cache, self.v_cache, self.lengths, self.counts,
-         self.last_tokens, self.pring) = \
+         self.last_tokens, self.pring, self.mu) = \
             self._extend_exec(bucket, attn_a)(*args)
         self._commit_slot(slot, n_total, opts)
         return int(tok)
@@ -1368,10 +1412,11 @@ class Engine:
                 from .paged import PagesExhausted
                 raise PagesExhausted(f"pool dry; victims {victims}")
         (toks, self.k_cache, self.v_cache, self.lengths, self.counts,
-         self.last_tokens, self.pring, self.keys) = self._decode_fn(
+         self.last_tokens, self.pring, self.mu,
+         self.keys) = self._decode_fn(
             self.params, self.k_cache, self.v_cache, self.lengths,
-            self.counts, self.last_tokens, self.pring, self.sp, self.keys,
-            self._active_dev, self.mask_bits, self._constr_dev,
+            self.counts, self.last_tokens, self.pring, self.mu, self.sp,
+            self.keys, self._active_dev, self.mask_bits, self._constr_dev,
             self._rln_dev, self._tables_dev())
         self._host_lengths[self.active] += 1
         return self._fetch(toks)
@@ -1384,8 +1429,8 @@ class Engine:
                               self._slot_sh)
             exe = self._decode_n_fn.lower(
                 self.params, self.k_cache, self.v_cache, self.lengths,
-                self.counts, self.last_tokens, self.pring, self.sp,
-                self.keys, self._active_dev, self.mask_bits,
+                self.counts, self.last_tokens, self.pring, self.mu,
+                self.sp, self.keys, self._active_dev, self.mask_bits,
                 self._constr_dev, self._rln_dev, n, attn_len,
                 self._tables_dev(), budgets).compile()
             self._decode_execs[key] = exe
@@ -1407,8 +1452,8 @@ class Engine:
             zi = lambda v: self._gr(np.int32(v))  # noqa: E731
             exe = self._admit_fn.lower(
                 self.params, self.k_cache, self.v_cache, self.lengths,
-                self.counts, self.last_tokens, self.pring, tokens,
-                zi(0), zi(1),
+                self.counts, self.last_tokens, self.pring, self.mu,
+                tokens, zi(0), zi(1),
                 self._sp_row(SlotOptions()), self._dummy_key(),
                 self._mask_ones, zi(0), zi(1),
                 table_row).compile()
@@ -1528,10 +1573,10 @@ class Engine:
         exe = self._decode_n_exec(n, self._attn_bucket(n))
         budgets = self.step_budgets(n)
         (toks_n, self.k_cache, self.v_cache, self.lengths, self.counts,
-         self.last_tokens, self.pring, self.keys) = exe(
+         self.last_tokens, self.pring, self.mu, self.keys) = exe(
             self.params, self.k_cache, self.v_cache, self.lengths,
-            self.counts, self.last_tokens, self.pring, self.sp, self.keys,
-            self._active_dev, self.mask_bits, self._constr_dev,
+            self.counts, self.last_tokens, self.pring, self.mu, self.sp,
+            self.keys, self._active_dev, self.mask_bits, self._constr_dev,
             self._rln_dev, self._tables_dev(),
             self._g(budgets, self._slot_sh))
         self._host_lengths[self.active] += budgets[self.active]
@@ -1547,8 +1592,8 @@ class Engine:
                             self._slot_sh)
             exe = self._spec_fn.lower(
                 self.params, self.k_cache, self.v_cache, self.lengths,
-                self.counts, self.last_tokens, self.pring, self.sp,
-                self.keys, self._active_dev, self.mask_bits,
+                self.counts, self.last_tokens, self.pring, self.mu,
+                self.sp, self.keys, self._active_dev, self.mask_bits,
                 self._constr_dev, self._rln_dev, flags, drafts, attn_len,
                 self._tables_dev()).compile()
             self._spec_execs[key] = exe
@@ -1589,10 +1634,10 @@ class Engine:
              else 0 for s in range(self.n_slots)], np.int32)
         exe = self._spec_exec(k, attn)
         (toks, self.k_cache, self.v_cache, self.lengths, self.counts,
-         self.last_tokens, self.pring, self.keys) = exe(
+         self.last_tokens, self.pring, self.mu, self.keys) = exe(
             self.params, self.k_cache, self.v_cache, self.lengths,
-            self.counts, self.last_tokens, self.pring, self.sp, self.keys,
-            self._active_dev, self.mask_bits, self._constr_dev,
+            self.counts, self.last_tokens, self.pring, self.mu, self.sp,
+            self.keys, self._active_dev, self.mask_bits, self._constr_dev,
             self._rln_dev, self._g(is_greedy, self._slot_sh),
             self._g(np.asarray(drafts, np.int32), self._slot_sh2),
             self._tables_dev())
@@ -1626,10 +1671,10 @@ class Engine:
         self._host_lengths[slot] = 0
         self._repeat_n[slot] = max(1, self.ecfg.repeat_last_n)
         self._rln_dev = self._g(self._repeat_n, self._slot_sh)
-        (self.lengths, self.counts, self.last_tokens,
-         self.pring) = self._release_fn(
+        (self.lengths, self.counts, self.last_tokens, self.pring,
+         self.mu) = self._release_fn(
             self.lengths, self.counts, self.last_tokens, self.pring,
-            self._gr(np.int32(slot)))
+            self.mu, self._gr(np.int32(slot)))
 
     def slot_length(self, slot: int) -> int:
         return int(self._fetch(self.lengths)[slot])

@@ -89,9 +89,13 @@ def merge_options(defaults: Dict, request: Optional[Dict]
             top_k=int(o.get("top_k", 40)),
             top_p=float(o.get("top_p", 0.9)),
             min_p=float(o.get("min_p", 0.0)),
+            typical_p=float(o.get("typical_p", 1.0)),
             repeat_penalty=float(o.get("repeat_penalty", 1.1)),
             presence_penalty=float(o.get("presence_penalty", 0.0)),
             frequency_penalty=float(o.get("frequency_penalty", 0.0)),
+            mirostat=int(o.get("mirostat", 0)),
+            mirostat_tau=float(o.get("mirostat_tau", 5.0)),
+            mirostat_eta=float(o.get("mirostat_eta", 0.1)),
             seed=int(o.get("seed", -1)),
             repeat_last_n=int(o.get("repeat_last_n", 64)))
         num_predict = int(o.get("num_predict", 128))

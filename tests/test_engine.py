@@ -351,3 +351,48 @@ def test_fused_qkv_matches_separate(monkeypatch):
     got, fused1 = run()
     assert fused1, "fusion did not engage on a single-device engine"
     assert got == ref, (got, ref)
+
+
+def test_mirostat_mu_threads_through_decode_chunks():
+    """A mirostat slot's surprise budget must (a) re-seed to 2*tau at
+    admission, (b) keep evolving across decode_n chunk boundaries, and
+    (c) stay frozen for non-mirostat slots sharing the batch."""
+    cfg = cfglib.PRESETS["tiny"]
+    params = decoder.init_params(cfg, jax.random.PRNGKey(0), dtype=F32)
+    eng = make_engine(cfg, params)
+
+    tau = 5.0
+    miro = SlotOptions(temperature=0.7, repeat_penalty=1.0, mirostat=2,
+                       mirostat_tau=tau, mirostat_eta=0.3, seed=3)
+    eng.admit(0, np.array([5, 9, 2], np.int32), miro)
+    eng.admit(1, np.array([4, 1, 8], np.int32), GREEDY)
+    mu_after_admit = np.asarray(eng._fetch(eng.mu))
+    # the admission sample already applied one update off the 2*tau seed
+    assert mu_after_admit[0] != 0.0
+    assert abs(mu_after_admit[0] - 2 * tau) < tau  # one eta-sized step
+    # non-mirostat slots carry the inert 2*tau seed (never read)
+    assert mu_after_admit[1] == 2 * 5.0
+
+    eng.decode_n(4)
+    mu_mid = np.asarray(eng._fetch(eng.mu))
+    assert mu_mid[0] != mu_after_admit[0]          # evolved inside chunk
+    assert mu_mid[1] == 2 * 5.0                    # frozen: mirostat off
+
+    eng.decode_n(4)
+    assert np.asarray(eng._fetch(eng.mu))[0] != mu_mid[0]
+
+    eng.release(0)
+    assert np.asarray(eng._fetch(eng.mu))[0] == 0.0
+
+
+def test_mirostat_generation_stays_in_vocab():
+    cfg = cfglib.PRESETS["tiny"]
+    params = decoder.init_params(cfg, jax.random.PRNGKey(1), dtype=F32)
+    eng = make_engine(cfg, params)
+    opts = SlotOptions(temperature=0.9, repeat_penalty=1.1, mirostat=1,
+                       seed=11)
+    first = eng.admit(2, np.array([3, 7, 1, 2], np.int32), opts)
+    toks = [first]
+    for _ in range(3):
+        toks.extend(int(t) for t in eng.decode_n(2)[:, 2])
+    assert all(0 <= t < cfg.vocab_size for t in toks)

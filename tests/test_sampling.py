@@ -67,3 +67,89 @@ def test_frequency_and_presence_penalty():
                                       frequency_penalty=0.05)
     tok = sampling.sample(logits, counts, sp, jax.random.key(0))
     assert int(tok[0]) == 1  # 1.0 - 0.05 - 3*0.05 = 0.8 < 0.9
+
+def test_typical_p_drops_atypical_outliers():
+    # wide near-uniform body + one modestly-peaked head: entropy sits at
+    # the body's surprise, so the HEAD is the atypical token (its surprise
+    # is far below H) — a tight typical_p keeps the body and drops the
+    # argmax (locally-typical sampling; llama.cpp llama_sampler_typical)
+    logits = jnp.array([[2.0] + [0.0] * 99])
+    sp = mk_sp(1, temperature=1.0, top_k=0, top_p=1.0, min_p=0.0,
+               typical_p=0.5, repeat_penalty=1.0)
+    counts = jnp.zeros((1, 100), jnp.int32)
+    seen = {int(sampling.sample(logits, counts, sp, jax.random.key(i))[0])
+            for i in range(60)}
+    assert 0 not in seen and len(seen) > 1
+
+
+def test_typical_p_off_is_identity():
+    logits = jnp.array([[3.0, 2.0, 1.0, 0.0]])
+    counts = jnp.zeros((1, 4), jnp.int32)
+    base = mk_sp(1, temperature=1.0, repeat_penalty=1.0)
+    typ = mk_sp(1, temperature=1.0, repeat_penalty=1.0, typical_p=1.0)
+    for i in range(10):
+        t1 = sampling.sample(logits, counts, base, jax.random.key(i))
+        t2 = sampling.sample(logits, counts, typ, jax.random.key(i))
+        assert int(t1[0]) == int(t2[0])
+
+
+def test_mirostat_v2_truncates_by_surprise_budget():
+    # mu near zero admits only the top candidate (surprise of everything
+    # else exceeds the budget) even though the static filters are wide open
+    logits = jnp.array([[3.0, 2.5, 2.0, 1.0, 0.0]])
+    counts = jnp.zeros((1, 5), jnp.int32)
+    sp = mk_sp(1, temperature=1.0, top_k=0, top_p=1.0, repeat_penalty=1.0,
+               mirostat=2, mirostat_tau=5.0, mirostat_eta=0.1)
+    mu = jnp.array([0.05], jnp.float32)
+    for i in range(20):
+        tok, _ = sampling.sample(logits, counts, sp, jax.random.key(i), mu)
+        assert int(tok[0]) == 0
+
+
+def test_mirostat_mu_moves_toward_tau():
+    # observed surprise far below tau → mu must RISE by eta*(tau - s)
+    logits = jnp.array([[10.0, 0.0, 0.0, 0.0]])
+    counts = jnp.zeros((1, 4), jnp.int32)
+    tau, eta = 5.0, 0.5
+    sp = mk_sp(1, temperature=1.0, top_k=0, top_p=1.0, repeat_penalty=1.0,
+               mirostat=2, mirostat_tau=tau, mirostat_eta=eta)
+    mu = jnp.array([2 * tau], jnp.float32)
+    _, mu2 = sampling.sample(logits, counts, sp, jax.random.key(0), mu)
+    assert float(mu2[0]) > float(mu[0]) - 1e-6  # s≈0 → mu += eta*tau
+    np.testing.assert_allclose(float(mu2[0]), 2 * tau + eta * tau, atol=0.2)
+
+
+def test_mirostat_off_slots_keep_mu_frozen():
+    logits = jnp.tile(jnp.array([[1.0, 0.5, 0.0]]), (2, 1))
+    counts = jnp.zeros((2, 3), jnp.int32)
+    sp = sampling.SamplingParams.make(2, temperature=1.0,
+                                      repeat_penalty=1.0)
+    sp = sampling.SamplingParams(
+        temperature=sp.temperature, top_k=sp.top_k, top_p=sp.top_p,
+        min_p=sp.min_p, typical_p=sp.typical_p,
+        repeat_penalty=sp.repeat_penalty,
+        presence_penalty=sp.presence_penalty,
+        frequency_penalty=sp.frequency_penalty,
+        mirostat=jnp.array([0, 2], jnp.int32),
+        mirostat_tau=sp.mirostat_tau, mirostat_eta=sp.mirostat_eta)
+    mu = jnp.array([7.7, 10.0], jnp.float32)
+    keys = jnp.stack([jax.random.key(1), jax.random.key(2)])
+    _, mu2 = sampling.sample(logits, counts, sp, keys, mu)
+    assert float(mu2[0]) == np.float32(7.7)  # mirostat off → untouched
+    assert float(mu2[1]) != 10.0         # mirostat on → updated
+
+
+def test_mirostat_v1_zipf_cut_keeps_head():
+    # steep zipf-ish distribution with a tiny mu: the derived k cut must
+    # restrict sampling to the head of the distribution
+    V = 64
+    logits = (-1.5 * jnp.log(jnp.arange(1, V + 1, dtype=jnp.float32)))[None]
+    counts = jnp.zeros((1, V), jnp.int32)
+    sp = mk_sp(1, temperature=1.0, top_k=0, top_p=1.0, repeat_penalty=1.0,
+               mirostat=1, mirostat_tau=2.0, mirostat_eta=0.1)
+    mu = jnp.array([1.0], jnp.float32)
+    seen = set()
+    for i in range(40):
+        tok, _ = sampling.sample(logits, counts, sp, jax.random.key(i), mu)
+        seen.add(int(tok[0]))
+    assert max(seen) < 8  # k ≈ (eps·2^mu / (1-V^-eps))^(1/s) is small

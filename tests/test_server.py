@@ -514,3 +514,23 @@ def test_int4_server_generates(tmp_path):
     finally:
         httpd.shutdown()
         reg.stop()
+
+
+def test_generate_mirostat_and_typical_options(stack):
+    """mirostat/typical_p ride the Ollama options surface end-to-end:
+    same seed → reproducible, and generation completes normally."""
+    payload = {"model": _model_name(stack), "prompt": "t1 t2",
+               "stream": False,
+               "options": {"num_predict": 6, "temperature": 0.9,
+                           "mirostat": 2, "mirostat_tau": 4.0,
+                           "mirostat_eta": 0.2, "seed": 42}}
+    r1 = post(stack["base"], "/api/generate", payload)
+    r2 = post(stack["base"], "/api/generate", payload)
+    assert r1["done"] and r1["eval_count"] >= 1
+    assert r1["response"] == r2["response"]   # seeded mirostat reproduces
+    r3 = post(stack["base"], "/api/generate",
+              {"model": _model_name(stack), "prompt": "t1 t2",
+               "stream": False,
+               "options": {"num_predict": 4, "temperature": 1.0,
+                           "typical_p": 0.8, "seed": 7}})
+    assert r3["done"] and r3["eval_count"] >= 1
