@@ -30,7 +30,8 @@ class SamplingParams:
     top_k: jax.Array         # [B] i32; <=0 → off
     top_p: jax.Array         # [B] f32; >=1 → off
     min_p: jax.Array         # [B] f32; <=0 → off
-    typical_p: jax.Array     # [B] f32; >=1 → off
+    typical_p: jax.Array     # [B] f32; >=1 → off; <=0 → keep
+                             #   only the most-typical token
     repeat_penalty: jax.Array    # [B] f32; 1.0 → off
     presence_penalty: jax.Array  # [B] f32
     frequency_penalty: jax.Array  # [B] f32
@@ -115,18 +116,28 @@ def sample(logits, token_counts, sp: SamplingParams, key, mu=None,
     t = jnp.maximum(sp.temperature, 1e-6)[:, None]
     scaled = vals / t
 
+    # The static filters (top-k/typical/top-p/min-p) all evaluate at T=1:
+    # llama.cpp's chain runs them BEFORE temperature (top_k → typ_p →
+    # top_p → min_p → temp), so the kept set must not depend on the
+    # temperature — only the final categorical draw does. ``filt`` is the
+    # T=1 view carrying the accumulated mask; temperature applies when
+    # the mask transfers onto ``scaled`` below.
+
     # top-k: the k-th largest is simply column k-1 of the sorted values
     k = jnp.clip(sp.top_k, 1, C)
-    kth = jnp.take_along_axis(scaled, (k - 1)[:, None], axis=-1)
-    keep = scaled >= kth
+    kth = jnp.take_along_axis(vals, (k - 1)[:, None], axis=-1)
+    keep = vals >= kth
     keep = jnp.where((sp.top_k > 0)[:, None], keep, True)
-    filt = jnp.where(keep, scaled, NEG_INF)
+    filt = jnp.where(keep, vals, NEG_INF)
 
     # locally-typical: keep the candidates whose surprise deviates least
     # from the distribution's entropy, up to typical_p cumulative mass
     # (Meister et al.; llama.cpp llama_sampler_typical). Deviation order
     # is not the sorted-logit order, so this is the one filter that pays
-    # its own [B, C] argsort.
+    # its own [B, C] argsort. The first deviation-ordered token is always
+    # kept (min_keep=1): typical_p <= 0 degrades to "most typical token
+    # only", exactly llama.cpp's limit behaviour, not a blank
+    # distribution.
     probs = jax.nn.softmax(filt, axis=-1)
     nlp = -jnp.log(jnp.maximum(probs, 1e-30))       # nats
     ent = jnp.sum(jnp.where(probs > 0, probs * nlp, 0.0), axis=-1,
@@ -134,7 +145,8 @@ def sample(logits, token_counts, sp: SamplingParams, key, mu=None,
     order = jnp.argsort(jnp.abs(nlp - ent), axis=-1)
     p_ord = jnp.take_along_axis(probs, order, axis=-1)
     cum = jnp.cumsum(p_ord, axis=-1)
-    keep_ord = (cum - p_ord) < sp.typical_p[:, None]   # keeps the first
+    keep_ord = (cum - p_ord) < sp.typical_p[:, None]
+    keep_ord = keep_ord.at[:, 0].set(True)          # min_keep = 1
     bi = jnp.arange(B)[:, None]
     keep = jnp.zeros((B, C), bool).at[bi, order].set(keep_ord)
     keep = jnp.where((sp.typical_p < 1.0)[:, None], keep, True)
@@ -147,11 +159,17 @@ def sample(logits, token_counts, sp: SamplingParams, key, mu=None,
     keep = jnp.where((sp.top_p < 1.0)[:, None], keep, True)
     filt = jnp.where(keep, filt, NEG_INF)
 
-    # min-p relative to the max candidate probability
+    # min-p relative to the max SURVIVING candidate probability (not
+    # column 0 — typical_p may have dropped the global argmax)
     probs = jax.nn.softmax(filt, axis=-1)
-    keep = probs >= (sp.min_p[:, None] * probs[:, :1])
+    keep = probs >= (sp.min_p[:, None]
+                     * jnp.max(probs, axis=-1, keepdims=True))
     keep = jnp.where((sp.min_p > 0.0)[:, None], keep, True)
     filt = jnp.where(keep, filt, NEG_INF)
+
+    # transfer the T=1 mask onto the temperature-scaled logits for the
+    # final draw
+    filt = jnp.where(filt > NEG_INF / 2, scaled, NEG_INF)
 
     if mu is not None:
         # mirostat truncation over the UNfiltered temp-scaled candidates

@@ -93,7 +93,9 @@ def merge_options(defaults: Dict, request: Optional[Dict]
             repeat_penalty=float(o.get("repeat_penalty", 1.1)),
             presence_penalty=float(o.get("presence_penalty", 0.0)),
             frequency_penalty=float(o.get("frequency_penalty", 0.0)),
-            mirostat=int(o.get("mirostat", 0)),
+            # llama.cpp treats any value other than 1/2 as off
+            mirostat=(int(o.get("mirostat", 0))
+                      if int(o.get("mirostat", 0)) in (1, 2) else 0),
             mirostat_tau=float(o.get("mirostat_tau", 5.0)),
             mirostat_eta=float(o.get("mirostat_eta", 0.1)),
             seed=int(o.get("seed", -1)),

@@ -1,5 +1,7 @@
 """Sampler semantics: masks, penalties, greedy/seeded behaviour."""
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -124,14 +126,7 @@ def test_mirostat_off_slots_keep_mu_frozen():
     counts = jnp.zeros((2, 3), jnp.int32)
     sp = sampling.SamplingParams.make(2, temperature=1.0,
                                       repeat_penalty=1.0)
-    sp = sampling.SamplingParams(
-        temperature=sp.temperature, top_k=sp.top_k, top_p=sp.top_p,
-        min_p=sp.min_p, typical_p=sp.typical_p,
-        repeat_penalty=sp.repeat_penalty,
-        presence_penalty=sp.presence_penalty,
-        frequency_penalty=sp.frequency_penalty,
-        mirostat=jnp.array([0, 2], jnp.int32),
-        mirostat_tau=sp.mirostat_tau, mirostat_eta=sp.mirostat_eta)
+    sp = dataclasses.replace(sp, mirostat=jnp.array([0, 2], jnp.int32))
     mu = jnp.array([7.7, 10.0], jnp.float32)
     keys = jnp.stack([jax.random.key(1), jax.random.key(2)])
     _, mu2 = sampling.sample(logits, counts, sp, keys, mu)
@@ -153,3 +148,53 @@ def test_mirostat_v1_zipf_cut_keeps_head():
         tok, _ = sampling.sample(logits, counts, sp, jax.random.key(i), mu)
         seen.add(int(tok[0]))
     assert max(seen) < 8  # k ≈ (eps·2^mu / (1-V^-eps))^(1/s) is small
+
+
+def test_typical_p_zero_keeps_most_typical_token():
+    # a zero budget must NOT blank the distribution — min_keep=1 keeps
+    # exactly the most-typical candidate (llama.cpp's limit behaviour),
+    # deterministically. Here the p≈0.97 head is also the most typical
+    # (its surprise is nearest the low entropy).
+    logits = jnp.array([[5.0, 1.0, 0.0, -1.0]])
+    counts = jnp.zeros((1, 4), jnp.int32)
+    sp = mk_sp(1, temperature=1.0, top_k=0, top_p=1.0, repeat_penalty=1.0,
+               typical_p=0.0)
+    for i in range(15):
+        assert int(sampling.sample(logits, counts, sp,
+                                   jax.random.key(i))[0]) == 0
+
+
+def test_typical_p_kept_set_is_temperature_invariant():
+    # llama.cpp evaluates typ_p at T=1 (chain: top_k → typ_p → … → temp):
+    # the same logits with different temperatures must keep the same set
+    logits = jnp.array([[2.0] + [0.0] * 99])
+    counts = jnp.zeros((1, 100), jnp.int32)
+    for temp in (0.3, 1.0, 2.5):
+        sp = mk_sp(1, temperature=temp, top_k=0, top_p=1.0,
+                   repeat_penalty=1.0, typical_p=0.5)
+        seen = {int(sampling.sample(logits, counts, sp,
+                                    jax.random.key(i))[0])
+                for i in range(40)}
+        assert 0 not in seen   # the atypical head stays dropped at any T
+
+
+def test_min_p_anchors_to_surviving_max_after_typical_drop():
+    # typical_p drops the global argmax; min_p must then anchor to the
+    # max SURVIVING probability, culling the low-prob tail (the
+    # column-0 anchor would read ~0 and keep everything)
+    logits = jnp.array([[2.0] + [0.0] * 30 + [-1.2] * 30])
+    counts = jnp.zeros((1, 61), jnp.int32)
+    sp = mk_sp(1, temperature=1.0, top_k=0, top_p=1.0, repeat_penalty=1.0,
+               typical_p=0.838, min_p=0.4)
+    seen = {int(sampling.sample(logits, counts, sp, jax.random.key(i))[0])
+            for i in range(80)}
+    assert 0 not in seen                      # typical dropped the head
+    assert all(tok <= 30 for tok in seen)     # min_p culled the tail
+
+
+def test_merge_options_clamps_invalid_mirostat():
+    from ollama_operator_tpu.runtime.service import merge_options
+    so, _, _ = merge_options({}, {"mirostat": 3})
+    assert so.mirostat == 0        # llama.cpp: non-1/2 reads as off
+    so, _, _ = merge_options({}, {"mirostat": 2, "mirostat_tau": 3.0})
+    assert so.mirostat == 2 and so.mirostat_tau == 3.0
