@@ -509,12 +509,22 @@ class ModelManager:
         name = ModelName.parse(ref)
         layers = []
         base_params: Dict = {}
-        # FROM: local model name or a GGUF file path
-        base = ModelName.parse(mf.from_)
-        base_manifest = self.store.read_manifest(base)
-        if base_manifest is not None:
-            # inherit every base layer the Modelfile doesn't override (ollama
-            # keeps base template/system/params on create); params merge
+        if mf.from_.startswith("@"):
+            # pre-uploaded blob reference: `ollama create` rewrites a
+            # local-file FROM into POST /api/blobs/<digest> + FROM @digest
+            import os
+            digest = mf.from_[1:]
+            if not self.store.has_blob(digest):
+                raise ApiError(400, f"FROM {mf.from_!r}: blob not "
+                                    "uploaded (POST /api/blobs/<digest>)")
+            layers.append({"mediaType": MT_MODEL, "digest": digest,
+                           "size": os.path.getsize(
+                               self.store.blob_path(digest))})
+        elif (base_manifest := self.store.read_manifest(
+                ModelName.parse(mf.from_))) is not None:
+            # FROM a local model name: inherit every base layer the
+            # Modelfile doesn't override (ollama keeps base template/
+            # system/params on create); params merge
             overridden = set()
             if mf.template:
                 overridden.add(MT_TEMPLATE)
@@ -536,6 +546,7 @@ class ModelManager:
                 if mt not in overridden:
                     layers.append(layer)
         else:
+            # FROM a GGUF file path on the server's filesystem
             import os
             if not os.path.exists(mf.from_):
                 raise ApiError(400, f"FROM {mf.from_!r}: not a local model "
@@ -715,7 +726,16 @@ class Handler(BaseHTTPRequestHandler):
             self._send_json({"error": f"internal: {e}"}, 500)
 
     def do_HEAD(self):
-        if self.path.split("?")[0] == "/":
+        path = self.path.split("?")[0]
+        if path.startswith("/api/blobs/"):
+            # `ollama create` probes blobs before uploading (HEAD 200 =
+            # skip the POST)
+            ok = self.manager.store.has_blob(path[len("/api/blobs/"):])
+            self.send_response(200 if ok else 404)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        if path == "/":
             self.send_response(200)
             self.send_header("Content-Length", "0")
             self.end_headers()
@@ -726,6 +746,9 @@ class Handler(BaseHTTPRequestHandler):
 
     def do_POST(self):
         path = self.path.split("?")[0]
+        if path.startswith("/api/blobs/"):
+            self._api_blob_upload(path[len("/api/blobs/"):])
+            return
         try:
             body = self._json_body()
             route = {
@@ -957,9 +980,46 @@ class Handler(BaseHTTPRequestHandler):
             self.manager.client.push(model)
             self._send_json({"status": "success"})
 
+    def _api_blob_upload(self, digest: str):
+        """POST /api/blobs/sha256:<hex> — raw body is the blob; the CLI
+        uploads local GGUFs here before /api/create references them."""
+        from .registry import RegistryError
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            if length <= 0:
+                self._send_error("missing blob body", 400)
+                return
+            self.manager.store.put_blob_stream(digest, self.rfile, length)
+            self.send_response(201)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+        except RegistryError as e:
+            self._send_error(str(e), 400)
+        except BrokenPipeError:
+            pass
+        except Exception as e:  # noqa: BLE001
+            self._send_error(f"internal: {e}", 500)
+
     def _api_create(self, body: Dict):
         model = self._model_arg(body)
         modelfile_text = body.get("modelfile", "")
+        if not modelfile_text and body.get("files"):
+            # newer create API: {"files": {"x.gguf": "sha256:..."}} of
+            # pre-uploaded blobs (see _api_blob_upload)
+            files = body["files"]
+            if len(files) != 1:
+                raise ApiError(400, "multi-file create is not supported "
+                                    "(one GGUF per model)")
+            digest = next(iter(files.values()))
+            lines = [f"FROM @{digest}"]
+            if body.get("template"):
+                lines.append("TEMPLATE \"\"\"" + body["template"] + "\"\"\"")
+            if body.get("system"):
+                lines.append("SYSTEM \"\"\"" + body["system"] + "\"\"\"")
+            for k, v in (body.get("parameters") or {}).items():
+                items = v if isinstance(v, list) else [v]
+                lines.extend(f"PARAMETER {k} {item}" for item in items)
+            modelfile_text = "\n".join(lines)
         if not modelfile_text and body.get("from"):
             modelfile_text = f"FROM {body['from']}"
         stream = body.get("stream", True)

@@ -184,6 +184,47 @@ class ModelStore:
             os.replace(tmp, path)
         return {"digest": digest, "size": len(data)}
 
+    def put_blob_stream(self, digest: str, fileobj, length: int) -> dict:
+        """Client blob upload (POST /api/blobs/<digest>): stream ``length``
+        bytes to the content-addressed path, verifying the declared sha256
+        on the way — a mismatch leaves no partial file behind. Matches the
+        upload half of `ollama create`'s CLI flow (the reference serves it
+        via the stock ollama image, /root/reference/pkg/model/pod.go:11)."""
+        algo, _, hexd = digest.partition(":")
+        if algo != "sha256" or len(hexd) != 64:
+            raise RegistryError(f"unsupported digest {digest!r}")
+        path = self.blob_path(digest)
+        if os.path.exists(path):
+            # content-addressed: identical bytes already present — drain
+            # the body so the connection stays usable
+            remaining = length
+            while remaining > 0:
+                remaining -= len(fileobj.read(min(1 << 20, remaining)))
+            return {"digest": digest, "size": length}
+        h = hashlib.sha256()
+        size = 0
+        tmp = path + f".partial.{os.getpid()}"
+        try:
+            with open(tmp, "wb") as f:
+                remaining = length
+                while remaining > 0:
+                    chunk = fileobj.read(min(1 << 20, remaining))
+                    if not chunk:
+                        raise RegistryError("short blob body")
+                    h.update(chunk)
+                    f.write(chunk)
+                    size += len(chunk)
+                    remaining -= len(chunk)
+            got = h.hexdigest()
+            if got != hexd:
+                raise RegistryError(
+                    f"digest mismatch: body is sha256:{got}")
+            os.replace(tmp, path)
+        finally:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+        return {"digest": digest, "size": size}
+
     def add_blob_file(self, src: str) -> dict:
         h = hashlib.sha256()
         size = 0

@@ -54,7 +54,7 @@ def stack(tmp_path_factory):
          {"model": f"http://{url.split('://')[1]}/library/tiny:latest"},
          stream=True)
     yield {"base": base, "registry_url": url, "manager": manager,
-           "registry": reg}
+           "registry": reg, "gguf_path": gguf_path}
     httpd.shutdown()
     reg.stop()
 
@@ -534,3 +534,73 @@ def test_generate_mirostat_and_typical_options(stack):
                "options": {"num_predict": 4, "temperature": 1.0,
                            "typical_p": 0.8, "seed": 7}})
     assert r3["done"] and r3["eval_count"] >= 1
+
+
+def test_blob_upload_and_create_from_digest(stack):
+    """The `ollama create` CLI flow: HEAD /api/blobs/<digest> (404) →
+    POST the GGUF bytes → HEAD (200) → /api/create with FROM @digest →
+    the created model serves."""
+    import hashlib
+    base = stack["base"]
+    raw = open(stack["gguf_path"], "rb").read()
+    digest = "sha256:" + hashlib.sha256(raw).hexdigest()
+
+    def head(path):
+        req = urllib.request.Request(base + path, method="HEAD")
+        try:
+            return urllib.request.urlopen(req, timeout=30).status
+        except urllib.error.HTTPError as e:
+            return e.code
+
+    assert head(f"/api/blobs/{digest}") == 404
+    req = urllib.request.Request(
+        base + f"/api/blobs/{digest}", data=raw,
+        headers={"Content-Type": "application/octet-stream"})
+    assert urllib.request.urlopen(req, timeout=60).status == 201
+    assert head(f"/api/blobs/{digest}") == 200
+
+    # wrong digest must 400 and store nothing
+    bad = "sha256:" + "0" * 64
+    req = urllib.request.Request(base + f"/api/blobs/{bad}", data=b"junk",
+                                 headers={"Content-Type":
+                                          "application/octet-stream"})
+    try:
+        urllib.request.urlopen(req, timeout=30)
+        assert False, "mismatched digest accepted"
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+    assert head(f"/api/blobs/{bad}") == 404
+
+    # modelfile FROM @digest (the CLI's rewritten form)
+    r = post(base, "/api/create",
+             {"model": "fromblob", "stream": False,
+              "modelfile": f"FROM @{digest}\n"
+                           "TEMPLATE \"\"\"{{ .Prompt }}\"\"\"\n"
+                           "PARAMETER temperature 0.0\n"
+                           "PARAMETER num_predict 4"})
+    assert r.get("status") == "success"
+    r = post(base, "/api/generate",
+             {"model": "fromblob", "prompt": "t1 t2", "stream": False,
+              "options": {"num_predict": 3}})
+    assert r["done"] and r["eval_count"] >= 1
+
+    # newer create API: files dict referencing the same blob
+    r = post(base, "/api/create",
+             {"model": "fromfiles", "stream": False,
+              "files": {"tiny.gguf": digest},
+              "template": "{{ .Prompt }}",
+              "parameters": {"num_predict": 4, "stop": ["zz"]}})
+    assert r.get("status") == "success"
+    shown = post(base, "/api/show", {"model": "fromfiles"})
+    assert "num_predict" in shown["parameters"]
+
+
+def test_create_from_missing_blob_is_400(stack):
+    missing = "sha256:" + "ab" * 32
+    try:
+        post(stack["base"], "/api/create",
+             {"model": "nope", "stream": False,
+              "modelfile": f"FROM @{missing}"})
+        assert False, "missing blob accepted"
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
