@@ -294,10 +294,31 @@ def supported_types():
     return set(_DISPATCH)
 
 
+# IQ1/IQ2/IQ3 (iq2_xxs/iq2_xs/iq2_s/iq3_xxs/iq3_s/iq1_s/iq1_m) decode
+# through large SEARCHED codebooks (256–2048-entry sign/magnitude grids
+# found by offline optimization in upstream llama.cpp, not derivable from
+# a closed-form spec the way the q*_0/K-quant grids and the 16-entry
+# iq4 LUT are). This build environment has no llama.cpp source, no gguf
+# python package, and no network egress to fetch the tables, and shipping
+# approximated codebooks would silently dequantize real registry images
+# to WRONG weights — so these types fail loudly instead. Blocker recorded
+# round 5; resolution = vendor the codebook tables when the build
+# environment can obtain them.
+_IQ_CODEBOOK_TYPES = {R.GGML_IQ2_XXS, R.GGML_IQ2_XS, R.GGML_IQ3_XXS,
+                      R.GGML_IQ1_S, R.GGML_IQ3_S, R.GGML_IQ2_S,
+                      R.GGML_IQ1_M}
+
+
 def dequantize(raw: np.ndarray, ggml_type: int, shape: tuple) -> np.ndarray:
     """raw uint8 buffer → float32 array of ``shape`` (numpy row-major)."""
     if ggml_type not in _DISPATCH:
         name = R.GGML_TYPE_NAMES.get(ggml_type, ggml_type)
+        if ggml_type in _IQ_CODEBOOK_TYPES:
+            raise NotImplementedError(
+                f"ggml type {name}: codebook i-quants need llama.cpp's "
+                f"searched grid tables, which are unavailable in this "
+                f"build (no vendored llama.cpp, no egress); re-pull the "
+                f"model at q4_0/q8_0/K-quant/iq4 precision")
         raise NotImplementedError(f"ggml type {name} not supported")
     return _DISPATCH[ggml_type](raw).reshape(shape)
 

@@ -49,6 +49,10 @@ GGML_BF16 = 30
 # common one in modern registry tags (iq4_nl blocks like q4_0, iq4_xs
 # k-quant-style super-blocks, both through the same non-linear LUT)
 GGML_IQ4_NL, GGML_IQ4_XS = 20, 23
+# codebook i-quants: named so unsupported-type errors are readable
+# (decode needs llama.cpp's searched grid tables — see gguf/dequant.py)
+GGML_IQ2_XXS, GGML_IQ2_XS, GGML_IQ3_XXS, GGML_IQ1_S = 16, 17, 18, 19
+GGML_IQ3_S, GGML_IQ2_S, GGML_IQ1_M = 21, 22, 29
 
 GGML_TYPE_NAMES = {
     GGML_F32: "F32", GGML_F16: "F16", GGML_BF16: "BF16",
@@ -58,6 +62,9 @@ GGML_TYPE_NAMES = {
     GGML_Q5_K: "Q5_K", GGML_Q6_K: "Q6_K",
     GGML_I8: "I8", GGML_I16: "I16", GGML_I32: "I32",
     GGML_IQ4_NL: "IQ4_NL", GGML_IQ4_XS: "IQ4_XS",
+    GGML_IQ2_XXS: "IQ2_XXS", GGML_IQ2_XS: "IQ2_XS",
+    GGML_IQ3_XXS: "IQ3_XXS", GGML_IQ1_S: "IQ1_S",
+    GGML_IQ3_S: "IQ3_S", GGML_IQ2_S: "IQ2_S", GGML_IQ1_M: "IQ1_M",
 }
 
 # (block_elems, block_bytes) per quantised type

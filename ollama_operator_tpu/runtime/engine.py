@@ -57,7 +57,10 @@ class EngineConfig:
     # decode steps per host round-trip: a lax.scan of this many steps runs
     # as ONE device program, so dispatch/sync latency (large under the
     # remote-TPU tunnel; nonzero everywhere) amortises across the chunk.
-    # Streaming granularity and admission latency grow with it.
+    # Streaming granularity and admission latency grow with it. 0 = let
+    # resolve_serving_defaults pick per backend (32 on TPU — the measured
+    # serving config, BASELINE.md r3/r4 — 8 elsewhere); direct engine
+    # constructions use the explicit value.
     decode_chunk: int = 8
     # paged KV cache (runtime/paged.py + ops/pallas/paged.py): slots share
     # a physical page pool instead of each reserving max_seq_len — HBM
@@ -82,6 +85,9 @@ def resolve_serving_defaults(ecfg: "EngineConfig", cfg: ModelConfig,
     - ``paged=None`` → resolve_paged_default (GQA on TPU pages, MHA/MoE/
       CPU stay dense; explicit True/False passes through).
     - ``max_slots=0`` → 32 paged / 8 dense.
+    - ``decode_chunk=0`` → 32 on TPU, 8 elsewhere (the config every
+      BASELINE.md headline was measured at; round-1's chunk-8 default
+      served the 64–116 tok/s class on the same chip).
     - When paged resolved on with auto slots and no explicit pool size,
       the pool is capped at the OLD dense default's HBM ceiling
       (8 × serving max_seq of pages): the 32 slots share it, so the
@@ -91,8 +97,9 @@ def resolve_serving_defaults(ecfg: "EngineConfig", cfg: ModelConfig,
       so for hd<128 models the auto page count shrinks by hd/hd_pool —
       the BYTE ceiling is what's preserved, not the token count.
     """
+    chunk = ecfg.decode_chunk or resolve_decode_chunk_default()
     if ecfg.paged is not None and ecfg.max_slots != 0:
-        return ecfg
+        return dataclasses.replace(ecfg, decode_chunk=chunk)
     paged = (resolve_paged_default(cfg, mesh) if ecfg.paged is None
              else ecfg.paged)
     slots = ecfg.max_slots or (32 if paged else 8)
@@ -103,7 +110,7 @@ def resolve_serving_defaults(ecfg: "EngineConfig", cfg: ModelConfig,
         n_pages = max(1, (8 * serve_seq) * cfg.head_dim
                       // hd_pool // ecfg.page_size)
     return dataclasses.replace(ecfg, paged=paged, max_slots=slots,
-                               n_pages=n_pages)
+                               n_pages=n_pages, decode_chunk=chunk)
 
 
 def resolve_paged_default(cfg: ModelConfig, mesh) -> bool:
@@ -141,6 +148,52 @@ def resolve_paged_default(cfg: ModelConfig, mesh) -> bool:
         if _paged_dp_axes(cfg, mesh, cfg.n_kv_heads) is None:
             return False
     return True
+
+
+def resolve_decode_chunk_default() -> int:
+    """Serving decode_chunk when the CR/env/flag leaves it unset.
+
+    Data-driven (BASELINE.md, v5e): the dispatch+sync round-trip under the
+    remote-TPU path is ~10 ms, so chunk 8 leaves >50% of the step budget in
+    host turnaround; every headline capture since r2 ran chunk 32 (phi
+    dense-8 ~570 tok/s vs 64–116 at r1's chunk 8), with chunk 64 only ~3%
+    beyond it (589.2 — not worth 2× chunkier streaming by default; it
+    remains the explicit-throughput knob, TPU_DECODE_CHUNK=64). CPU pods
+    keep 8: per-step compute dominates there, and kind/e2e latency would
+    otherwise balloon."""
+    import jax
+    return 32 if jax.default_backend() == "tpu" else 8
+
+
+def resolve_engine_dtype(cfg: ModelConfig, backend: str) -> str:
+    """Weight serving dtype when neither CR ``spec.quantization`` nor
+    --dtype/TPU_ENGINE_DTYPE picked one.
+
+    The zero-config contract (the reference's sample CR serves usably with
+    no tuning fields, /root/reference/config/samples/ollama_v1_model.yaml)
+    must land in the measured headline band, not the bf16 config nothing
+    benches: on a 16 GB v5e chip, int8 weight-only quantization is the
+    measured serving config ≤4B (phi int8 ~570 tok/s dense-8; bf16 halves
+    that by doubling streamed bytes), and 7B+ needs int4 to leave HBM room
+    for the KV pool (mistral-7B int4 = the r4 flagship; bf16 7B does not
+    fit at all). MoE expert stacks serve dense bf16 (quantized expert
+    matmuls are an unmeasured path). CPU serves f32 — XLA's CPU thunk
+    runtime has no bf16 dots and the quantized matmuls are pallas/TPU
+    paths. An explicit spec/env/flag always wins (callers only consult
+    this when theirs is unset)."""
+    if backend != "tpu":
+        return "float32"
+    if cfg.n_experts:
+        return "bfloat16"
+    return "int4" if cfg.n_params >= 4e9 else "int8"
+
+
+def resolve_kv_dtype_default(backend: str) -> str:
+    """KV-cache dtype default: int8 on TPU (half the decode cache traffic,
+    double the context per chip — every BASELINE.md capture since r2 runs
+    it; parity suite covers the quantized cache), f32 on CPU (no bf16
+    support in the thunk runtime, and CPU pods are dev/e2e anyway)."""
+    return "int8" if backend == "tpu" else "float32"
 
 
 CACHE_DTYPES = {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
@@ -1460,20 +1513,40 @@ class Engine:
             self._admit_execs[bucket] = exe
         return exe
 
-    def warm_buckets(self, n: Optional[int] = None):
+    def warm_buckets(self, n: Optional[int] = None, *,
+                     ctx_lo: Optional[int] = None,
+                     ctx_hi: Optional[int] = None,
+                     full: bool = True):
         """AOT-compile the chunked decode program for every attention
         bucket AND the admission program for every prefill bucket, so
         serving never pays an XLA compile mid-request. Non-bucketed paths
         (sp meshes) only ever decode at max_seq — one program, not a
-        duplicate per bucket."""
+        duplicate per bucket.
+
+        ``ctx_lo``/``ctx_hi`` bound the context lengths the caller will
+        actually reach, restricting the decode warm to the reachable
+        attention buckets (smallest covering ctx_lo+n .. smallest covering
+        ctx_hi) — the bench uses this so a capture doesn't pay compiles for
+        buckets it never decodes in. ``full=False`` additionally skips the
+        single-step, admission, spec, and extend warms (lazy compile covers
+        a first use; a server must never take that hit mid-request, a bench
+        capture may)."""
         n = n or self.ecfg.decode_chunk
         buckets = self._buckets if self._bucketed_attn else [self.max_seq]
+        if self._bucketed_attn and (ctx_lo is not None
+                                    or ctx_hi is not None):
+            lo = self.bucket_for(min((ctx_lo or 0) + n, self.max_seq))
+            hi = self.bucket_for(min(ctx_hi, self.max_seq)) \
+                if ctx_hi else self.max_seq
+            buckets = [b for b in buckets if lo <= b <= hi] or [hi]
         for b in buckets:
             self._decode_n_exec(n, b)
-            if n != 1:
+            if n != 1 and full:
                 # grammar-constrained serving steps one token per dispatch
                 # (scheduler drops to decode_n(1)) — warm those too
                 self._decode_n_exec(1, b)
+        if not full:
+            return
         for b in self._buckets:
             self._admit_exec(b)
         import os as _os

@@ -314,11 +314,29 @@ class ModelManager:
             if not gguf_path:
                 raise ApiError(500, f"model {name.short} has no model layer")
             digest = self.store.model_digest(name) or ""
+            import jax
             import ml_dtypes
+            engine_dtype = self.engine_dtype
+            if engine_dtype is None:
+                # no CR quantization / --dtype: resolve the measured
+                # serving dtype PER MODEL from the GGUF header (int8 ≤4B,
+                # int4 7B+, bf16 MoE on TPU; f32 on CPU) so `kubectl
+                # apply` of a bare Model CR serves the config the bench
+                # proves, not an unmeasured bf16 one (VERDICT r4 #3)
+                from ..gguf.reader import GGUFFile
+                from ..gguf.transcode import config_from_gguf
+                from ..runtime.engine import resolve_engine_dtype
+                with GGUFFile(gguf_path) as _hf:
+                    _hcfg = config_from_gguf(_hf)
+                engine_dtype = resolve_engine_dtype(
+                    _hcfg, jax.default_backend())
+                import sys
+                print(f"serving dtype for {name.short}: {engine_dtype} "
+                      f"({_hcfg.n_params/1e9:.2f}B params, auto)",
+                      file=sys.stderr)
             dt = {"bfloat16": ml_dtypes.bfloat16, "int8": ml_dtypes.bfloat16,
                   "int4": ml_dtypes.bfloat16,
-                  "float32": np.float32}[self.engine_dtype]
-            import jax
+                  "float32": np.float32}[engine_dtype]
             if (jax.default_backend() == "cpu"
                     and dt is ml_dtypes.bfloat16):
                 # this XLA CPU build cannot execute bf16 dots
@@ -348,15 +366,16 @@ class ModelManager:
                 self.loaded.unload()
                 self.loaded = None
             import jax.numpy as jnp
-            import jax
-            if self.engine_dtype in ("int8", "int4"):
+            # (auto resolution never picks int8/int4 for MoE — explicit
+            # spec.quantization on an MoE model keeps its old behavior)
+            if engine_dtype in ("int8", "int4"):
                 # weight-only quantization: int8/packed-int4 weights stay
                 # quantized in HBM; dequant fuses into the matmuls
                 # (ops/quant.py)
                 from ..ops.quant import quantize_params
                 params = quantize_params(
-                    params, bits=4 if self.engine_dtype == "int4" else 8)
-                if self.engine_dtype == "int4":
+                    params, bits=4 if engine_dtype == "int4" else 8)
+                if engine_dtype == "int4":
                     from ..ops.quant import int4_mm_kernels
                     cfg = int4_mm_kernels(cfg, self.mesh)
             params = jax.tree_util.tree_map(jnp.asarray, params)
@@ -388,6 +407,9 @@ class ModelManager:
                 system=system, default_params=default_params,
                 mesh=self.mesh, ecfg=ecfg, digest=digest, vision=vision,
                 control_plane=self.control_plane, follower=self.follower)
+            # effective serving config, for /api/ps observability (the
+            # auto-resolved dtype is otherwise invisible to clients)
+            self.loaded.serving_dtype = engine_dtype
             # fresh deadline under this same lock: a stale expiry from the
             # previous model must never reap the one we just installed
             self._last_ka = self.default_keep_alive
@@ -438,7 +460,11 @@ class ModelManager:
                 "size": int(lm.cfg.n_params * 2),
                 "digest": lm.digest.replace("sha256:", ""),
                 "details": {"format": "gguf", "family": lm.cfg.arch,
-                            "parameter_size": _fmt_params(lm.cfg.n_params)},
+                            "parameter_size": _fmt_params(lm.cfg.n_params),
+                            "serving_dtype": getattr(lm, "serving_dtype",
+                                                     None),
+                            "decode_chunk": lm.engine.ecfg.decode_chunk,
+                            "paged": bool(lm.engine.paged)},
                 "expires_at": expires,
                 "size_vram": 0,
             })
@@ -729,8 +755,13 @@ class Handler(BaseHTTPRequestHandler):
         path = self.path.split("?")[0]
         if path.startswith("/api/blobs/"):
             # `ollama create` probes blobs before uploading (HEAD 200 =
-            # skip the POST)
-            ok = self.manager.store.has_blob(path[len("/api/blobs/"):])
+            # skip the POST). Reject non-hex digests before touching the
+            # filesystem — blob_path() joins the digest into a path, so an
+            # unvalidated one is an arbitrary-path existence oracle.
+            from .registry import valid_blob_digest
+            digest = path[len("/api/blobs/"):]
+            ok = (valid_blob_digest(digest)
+                  and self.manager.store.has_blob(digest))
             self.send_response(200 if ok else 404)
             self.send_header("Content-Length", "0")
             self.end_headers()
@@ -983,21 +1014,31 @@ class Handler(BaseHTTPRequestHandler):
     def _api_blob_upload(self, digest: str):
         """POST /api/blobs/sha256:<hex> — raw body is the blob; the CLI
         uploads local GGUFs here before /api/create references them."""
-        from .registry import RegistryError
+        from .registry import RegistryError, valid_blob_digest
+        # Any error response sent without consuming the declared body would
+        # leave blob bytes on the HTTP/1.1 keep-alive socket to be parsed as
+        # the next request line — close the connection on every error path.
         try:
             length = int(self.headers.get("Content-Length", "0"))
             if length <= 0:
+                self.close_connection = True
                 self._send_error("missing blob body", 400)
+                return
+            if not valid_blob_digest(digest):
+                self.close_connection = True
+                self._send_error(f"unsupported digest {digest!r}", 400)
                 return
             self.manager.store.put_blob_stream(digest, self.rfile, length)
             self.send_response(201)
             self.send_header("Content-Length", "0")
             self.end_headers()
         except RegistryError as e:
+            self.close_connection = True
             self._send_error(str(e), 400)
         except BrokenPipeError:
             pass
         except Exception as e:  # noqa: BLE001
+            self.close_connection = True
             self._send_error(f"internal: {e}", 500)
 
     def _api_create(self, body: Dict):

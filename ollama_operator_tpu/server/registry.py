@@ -28,6 +28,7 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import re
 import shutil
 import threading
 import time
@@ -55,6 +56,20 @@ ProgressCb = Callable[..., None]
 
 class RegistryError(RuntimeError):
     pass
+
+
+_HEX64 = re.compile(r"[0-9a-f]{64}\Z")
+
+
+def valid_blob_digest(digest: str) -> bool:
+    """True iff ``digest`` is ``sha256:`` + 64 lowercase hex chars.
+
+    Must be checked before any filesystem access derived from a
+    client-supplied digest: `blob_path` joins the digest into a path, so a
+    64-char digest containing ``/../`` would otherwise escape the blobs
+    dir (upstream ollama enforces the same pattern)."""
+    algo, _, hexd = digest.partition(":")
+    return algo == "sha256" and _HEX64.match(hexd) is not None
 
 
 class ModelStore:
@@ -178,7 +193,7 @@ class ModelStore:
         digest = "sha256:" + hashlib.sha256(data).hexdigest()
         path = self.blob_path(digest)
         if not os.path.exists(path):
-            tmp = path + f".partial.{os.getpid()}"
+            tmp = path + f".partial.{os.getpid()}.{threading.get_ident()}"
             with open(tmp, "wb") as f:
                 f.write(data)
             os.replace(tmp, path)
@@ -190,20 +205,25 @@ class ModelStore:
         on the way — a mismatch leaves no partial file behind. Matches the
         upload half of `ollama create`'s CLI flow (the reference serves it
         via the stock ollama image, /root/reference/pkg/model/pod.go:11)."""
-        algo, _, hexd = digest.partition(":")
-        if algo != "sha256" or len(hexd) != 64:
+        if not valid_blob_digest(digest):
             raise RegistryError(f"unsupported digest {digest!r}")
+        hexd = digest.partition(":")[2]
         path = self.blob_path(digest)
         if os.path.exists(path):
             # content-addressed: identical bytes already present — drain
             # the body so the connection stays usable
             remaining = length
             while remaining > 0:
-                remaining -= len(fileobj.read(min(1 << 20, remaining)))
+                chunk = fileobj.read(min(1 << 20, remaining))
+                if not chunk:
+                    raise RegistryError("short blob body")
+                remaining -= len(chunk)
             return {"digest": digest, "size": length}
         h = hashlib.sha256()
         size = 0
-        tmp = path + f".partial.{os.getpid()}"
+        # unique per upload: the server is threaded, so two concurrent
+        # uploads of the same digest must not share one tmp inode
+        tmp = path + f".partial.{os.getpid()}.{threading.get_ident()}"
         try:
             with open(tmp, "wb") as f:
                 remaining = length
@@ -235,7 +255,7 @@ class ModelStore:
         digest = "sha256:" + h.hexdigest()
         path = self.blob_path(digest)
         if not os.path.exists(path):
-            tmp = path + f".partial.{os.getpid()}"
+            tmp = path + f".partial.{os.getpid()}.{threading.get_ident()}"
             shutil.copyfile(src, tmp)
             os.replace(tmp, path)
         return {"digest": digest, "size": size}

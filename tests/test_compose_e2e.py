@@ -275,6 +275,17 @@ def test_model_cr_to_serving_tokens(tmp_path):
             headers={"Content-Type": "application/json"})
         res = json.loads(urllib.request.urlopen(req, timeout=300).read())
         assert res.get("done") is True and "response" in res, res
+
+        # the zero-config CR serves the RESOLVED defaults (VERDICT r4 #3):
+        # nothing in the CR set dtype/chunk/paged, so the CPU pod must
+        # report the auto-resolved config (f32 weights, chunk 8, dense) —
+        # on a TPU pod the same CR resolves int8/int4 + chunk 32
+        ps = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/api/ps", timeout=60).read())
+        details = ps["models"][0]["details"]
+        assert details["serving_dtype"] == "float32", details
+        assert details["decode_chunk"] == 8, details
+        assert details["paged"] is False, details
     finally:
         mgr.stop()
         kubelet.stop()

@@ -319,6 +319,54 @@ def test_resolve_serving_defaults():
     assert r4.paged is False and r4.max_slots == 8
 
 
+def test_resolve_decode_chunk_default():
+    """decode_chunk=0 resolves per backend (32 TPU / 8 CPU — BASELINE.md's
+    measured serving config vs round-1's chunk-8); an explicit chunk always
+    passes through, including when paged/slots are explicit too (the early
+    return must still resolve the chunk)."""
+    from unittest import mock
+
+    from ollama_operator_tpu.runtime.engine import resolve_serving_defaults
+    gqa = cfglib.PRESETS["tiny"]
+    auto = EngineConfig(max_slots=0, max_seq_len=4096, paged=None,
+                        decode_chunk=0)
+    with mock.patch("jax.default_backend", return_value="tpu"):
+        assert resolve_serving_defaults(auto, gqa, None).decode_chunk == 32
+        # explicit paged+slots takes the early return — chunk still resolves
+        explicit = EngineConfig(max_slots=8, max_seq_len=4096, paged=False,
+                                decode_chunk=0)
+        assert resolve_serving_defaults(explicit, gqa,
+                                        None).decode_chunk == 32
+        pinned = EngineConfig(max_slots=8, max_seq_len=4096, paged=False,
+                              decode_chunk=16)
+        assert resolve_serving_defaults(pinned, gqa, None).decode_chunk == 16
+    # CPU backend: streaming-latency default
+    assert resolve_serving_defaults(auto, gqa, None).decode_chunk == 8
+
+
+def test_resolve_engine_dtype():
+    """Zero-config weight dtype per model size (VERDICT r4 #3): a bare
+    Model CR must serve the measured config — int8 ≤4B, int4 7B+, bf16
+    MoE on TPU; f32 on CPU. Explicit spec/env wins upstream (ModelManager
+    only consults this when engine_dtype is None)."""
+    import dataclasses
+
+    from ollama_operator_tpu.runtime.engine import (resolve_engine_dtype,
+                                                    resolve_kv_dtype_default)
+    tiny = cfglib.PRESETS["tiny"]
+    assert resolve_engine_dtype(tiny, "cpu") == "float32"
+    assert resolve_engine_dtype(tiny, "tpu") == "int8"
+    small = cfglib.PRESETS["llama3.2:3b"]
+    assert resolve_engine_dtype(small, "tpu") == "int8"
+    big = cfglib.PRESETS["mistral"]          # 7B class
+    assert big.n_params >= 4e9
+    assert resolve_engine_dtype(big, "tpu") == "int4"
+    moe = dataclasses.replace(tiny, n_experts=4)
+    assert resolve_engine_dtype(moe, "tpu") == "bfloat16"
+    assert resolve_kv_dtype_default("tpu") == "int8"
+    assert resolve_kv_dtype_default("cpu") == "float32"
+
+
 def test_fused_qkv_matches_separate(monkeypatch):
     """Engine-side fused single-matmul QKV (models/decoder.fuse_qkv_params)
     must decode bitwise-identically to the separate projections — every

@@ -367,3 +367,18 @@ def test_iq4_transcode_path(tmp_path):
     assert y.shape == (2, 64)
     err = np.abs(y - x).mean() / np.abs(x).mean()
     assert err < 0.1                              # 4-bit non-linear grid
+
+
+def test_codebook_iquants_fail_loudly():
+    """IQ1/IQ2/IQ3 decode through searched codebooks that only exist as
+    llama.cpp source tables — unavailable in this build env (no vendored
+    llama.cpp, zero egress). The honest behavior is a loud, actionable
+    error at transcode time, never an approximated grid that would
+    silently produce wrong weights (recorded blocker, round 5)."""
+    for t, name in ((R.GGML_IQ2_XXS, "IQ2_XXS"), (R.GGML_IQ2_XS, "IQ2_XS"),
+                    (R.GGML_IQ3_XXS, "IQ3_XXS"), (R.GGML_IQ3_S, "IQ3_S"),
+                    (R.GGML_IQ1_S, "IQ1_S"), (R.GGML_IQ1_M, "IQ1_M"),
+                    (R.GGML_IQ2_S, "IQ2_S")):
+        with pytest.raises(NotImplementedError) as ei:
+            DQ.dequantize(np.zeros(128, np.uint8), t, (256,))
+        assert name in str(ei.value) and "codebook" in str(ei.value)

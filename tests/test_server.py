@@ -536,14 +536,23 @@ def test_generate_mirostat_and_typical_options(stack):
     assert r3["done"] and r3["eval_count"] >= 1
 
 
-def test_blob_upload_and_create_from_digest(stack):
+def test_blob_upload_and_create_from_digest(stack, tmp_path):
     """The `ollama create` CLI flow: HEAD /api/blobs/<digest> (404) →
     POST the GGUF bytes → HEAD (200) → /api/create with FROM @digest →
     the created model serves."""
     import hashlib
     base = stack["base"]
-    raw = open(stack["gguf_path"], "rb").read()
+    # a GGUF the store has never seen: the fixture's pull already installed
+    # tiny.gguf's digest, so re-uploading it would HEAD 200 from the start —
+    # different init weights give different bytes, hence a fresh digest
+    cfg = cfglib.PRESETS["tiny"]
+    params = decoder.init_params(cfg, jax.random.PRNGKey(123),
+                                 dtype=jnp.float32)
+    fresh_path = str(tmp_path / "fresh.gguf")
+    write_tiny_llama_gguf(fresh_path, cfg, params)
+    raw = open(fresh_path, "rb").read()
     digest = "sha256:" + hashlib.sha256(raw).hexdigest()
+    assert not stack["manager"].store.has_blob(digest)
 
     def head(path):
         req = urllib.request.Request(base + path, method="HEAD")
@@ -604,3 +613,64 @@ def test_create_from_missing_blob_is_400(stack):
         assert False, "missing blob accepted"
     except urllib.error.HTTPError as e:
         assert e.code == 400
+
+
+def test_blob_digest_must_be_hex(stack):
+    """A 64-char digest containing path separators must never reach the
+    filesystem: blob_path() joins the digest into a path, so without hex
+    validation HEAD is an existence oracle for arbitrary files and POST
+    writes outside the blobs dir."""
+    base = stack["base"]
+    # 64 chars, right length, but a traversal payload — not hex
+    evil = "/../" * 16
+    assert len(evil) == 64
+    req = urllib.request.Request(base + f"/api/blobs/sha256:{evil}",
+                                 method="HEAD")
+    try:
+        status = urllib.request.urlopen(req, timeout=30).status
+    except urllib.error.HTTPError as e:
+        status = e.code
+    assert status == 404
+
+    req = urllib.request.Request(base + f"/api/blobs/sha256:{evil}",
+                                 data=b"x" * 8,
+                                 headers={"Content-Type":
+                                          "application/octet-stream"})
+    try:
+        urllib.request.urlopen(req, timeout=30)
+        assert False, "non-hex digest accepted"
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+    # uppercase hex is also rejected (store paths are lowercase-keyed)
+    up = "AB" * 32
+    req = urllib.request.Request(base + f"/api/blobs/sha256:{up}",
+                                 data=b"x" * 8,
+                                 headers={"Content-Type":
+                                          "application/octet-stream"})
+    try:
+        urllib.request.urlopen(req, timeout=30)
+        assert False, "uppercase digest accepted"
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+
+
+def test_blob_upload_eof_mid_body_does_not_hang(stack):
+    """A client that disconnects before sending Content-Length bytes must
+    not pin the handler thread: the drain/write loops treat read()==b'' as
+    a short body and error out. Observable contract: the server keeps
+    answering new requests and the half-uploaded digest is never stored."""
+    import hashlib
+    import socket
+    base_host, base_port = stack["base"][len("http://"):].split(":")
+    payload = b"y" * 4096
+    digest = "sha256:" + hashlib.sha256(payload).hexdigest()
+    for _ in range(2):   # fresh-path then (if stored) drain-path — never is
+        s = socket.create_connection((base_host, int(base_port)), timeout=10)
+        s.sendall(f"POST /api/blobs/{digest} HTTP/1.1\r\n"
+                  f"Host: x\r\nContent-Length: {len(payload)}\r\n"
+                  f"\r\n".encode() + payload[:100])
+        s.close()   # EOF mid-body
+    # server still serves, and the truncated upload was not promoted
+    assert not stack["manager"].store.has_blob(digest)
+    r = post(stack["base"], "/api/show", {"model": _model_name(stack)})
+    assert "parameters" in r or "template" in r
