@@ -508,9 +508,20 @@ def measure(jax, *, model: str, dtype: str, slots: int, steps: int,
         "paged": paged,
         "mixed_len": mixed,
         "prompt_len": int(np.max(plens)),
+        # full config provenance: without these the committed capture log
+        # can't distinguish A/B arms (a ps-64 and a ps-128 record would be
+        # byte-identical in every config field)
+        "decode_chunk": chunk,
+        "seq": seq,
         "bytes_per_step_gb": round(bytes_per_step / 1e9, 3),
         "hbm_gb_s": round(hbm_gbs, 1),
     }
+    if paged:
+        rec["page_size"] = page_size
+        rec["n_pages"] = n_pages or eng._pt.n_pages
+        depth = os.environ.get("TPU_PAGED_DEPTH")
+        if depth:
+            rec["paged_depth"] = int(depth)
     if platform != "cpu":
         # per-chip bytes vs the v5e spec (other TPU generations will read
         # slightly off; the driver chip is a v5e — BASELINE.md)
@@ -880,37 +891,57 @@ def main() -> None:
                         "seq": envi("BENCH_SEQ", 512),
                         "prompt_len": envi("BENCH_PROMPT", 32)})]
     else:
-        # the full TPU suite: headline first (comparable across rounds),
-        # then the paged pool at high concurrency, then a GQA model so the
-        # pallas flash/paged decode kernels are in a measured path
-        # ordered so a deadline-cut run still records the strongest
-        # evidence: the round-comparable headline, then the paged pool's
-        # flagship GQA number and its dense baseline, then the A/Bs; the
-        # known-slow MHA-paged diagnostic goes last
+        # the full TPU suite, deadline-ordered so a cut run still records
+        # the strongest evidence (VERDICT r4 #1/#2): the round-comparable
+        # headline first, then the kernel-default A/B pairs — v3 vs v2 on
+        # the GQA short-ctx flagship (the one driver-recorded r4 A/B
+        # showed v3 −3.3% there, inside noise but the wrong sign for the
+        # default flip), the B=64 ladder arm, the long-ctx pair (where v3's
+        # +17% claim lives), then MHA paged — each A/B at 128 steps so a
+        # ±5% band resolves. Same-model captures are adjacent where the
+        # evidence ordering allows (params_cache holds one model).
+        ab = dict(steps=128, seq=1024, prompt_len=128, paged=True,
+                  mixed=True)
         plan = [
             dict(model="phi", dtype="int8", slots=8, steps=64, seq=1024,
                  prompt_len=128, paged=False, mixed=False),
-            # the same serving config measured THROUGH /api/generate
-            # (the surface the metric names) — params reused from cap 1,
-            # delta vs cap 1 = HTTP + scheduler + tokenize overhead
-            dict(model="phi", dtype="int8", slots=8, steps=64, seq=1024,
-                 prompt_len=128, paged=False, mixed=False, http=True),
-            # the GQA paged flagship on the v3 default kernel, then the
-            # v2 REVERT arm (TPU_PAGED_V3 defaults ON since r4 — the A/B
-            # baseline must explicitly opt back into the grid kernel)
-            dict(model="tinyllama", dtype="int8", slots=32, steps=64,
-                 seq=1024, prompt_len=128, paged=True, mixed=True),
-            dict(model="tinyllama", dtype="int8", slots=32, steps=64,
-                 seq=1024, prompt_len=128, paged=True, mixed=True,
+            # the SHIPPED zero-config GQA default (r5: 64 slots, ps=128,
+            # dense-24 pool = 192 pages) — the flagship config every
+            # future round must track; a regression here (e.g. pool-dry
+            # preemption) is a regression in what `kubectl apply` serves
+            dict(model="tinyllama", dtype="int8", slots=64, page_size=128,
+                 n_pages=192, **ab),
+            # GQA short-ctx flagship A/B: v3 (default) then the v2 revert
+            dict(model="tinyllama", dtype="int8", slots=32, **ab),
+            dict(model="tinyllama", dtype="int8", slots=32,
+                 env={"TPU_PAGED_V3": "0"}, **ab),
+            # long-ctx A/B: the regime the v3 live-page pipeline targets
+            dict(model="tinyllama", dtype="int8", slots=32, steps=128,
+                 seq=2048, prompt_len=1024, paged=True, mixed=True),
+            dict(model="tinyllama", dtype="int8", slots=32, steps=128,
+                 seq=2048, prompt_len=1024, paged=True, mixed=True,
                  env={"TPU_PAGED_V3": "0"}),
+            # dense GQA baseline (paged-vs-dense aggregate ratio)
             dict(model="tinyllama", dtype="int8", slots=8, steps=64,
                  seq=1024, prompt_len=128, paged=False, mixed=False),
+            # MHA paged A/B (phi, KvH=32): v3 made MHA page by default;
+            # the v2 arm tracks the old per-head-dot gap
+            dict(model="phi", dtype="int8", slots=32, steps=128, seq=1024,
+                 prompt_len=128, paged=True, mixed=True),
+            dict(model="phi", dtype="int8", slots=32, steps=128, seq=1024,
+                 prompt_len=128, paged=True, mixed=True,
+                 env={"TPU_PAGED_V3": "0"}),
+            # the headline config measured THROUGH /api/generate (the
+            # surface the metric names) — delta vs capture 1 = HTTP +
+            # scheduler + tokenize overhead
+            dict(model="phi", dtype="int8", slots=8, steps=64, seq=1024,
+                 prompt_len=128, paged=False, mixed=False, http=True),
             # MHA decode-kernel A/B vs capture 1 (same config, kernel
             # on): keeps the einsum bail measurement-backed
             dict(model="phi", dtype="int8", slots=8, steps=64, seq=1024,
                  prompt_len=128, paged=False, mixed=False,
                  env={"TPU_MHA_KERNEL": "1"}),
-            # speculative-decoding envelope BEFORE the int4 A/B so the
+            # speculative-decoding envelope BEFORE the int4 arm so the
             # (phi, int8) params cache survives into it (the int4 entry
             # evicts the single-model cache)
             dict(model="phi", dtype="int8", slots=8, steps=64, seq=1024,
@@ -919,13 +950,6 @@ def main() -> None:
             # pallas qmm (capacity feature; bandwidth parity tracked)
             dict(model="phi", dtype="int4", slots=8, steps=64, seq=1024,
                  prompt_len=128, paged=False, mixed=False),
-            # MHA paged (pages by default since the v3 kernel): the v3
-            # number, then the v2-revert diagnostic tracking the old gap
-            dict(model="phi", dtype="int8", slots=32, steps=64, seq=1024,
-                 prompt_len=128, paged=True, mixed=True),
-            dict(model="phi", dtype="int8", slots=32, steps=64, seq=1024,
-                 prompt_len=128, paged=True, mixed=True,
-                 env={"TPU_PAGED_V3": "0"}),
         ]
 
     captures = []
@@ -950,7 +974,9 @@ def main() -> None:
         try:
             fn = (measure_http if http
                   else measure_spec if spec else measure)
-            captures.append(fn(jax, **cap, **common))
+            # plan-level keys override the global knobs (a capture may pin
+            # its own page_size/n_pages — e.g. the shipped-default arm)
+            captures.append(fn(jax, **{**common, **cap}))
         except Exception as e:   # a later capture must not void the headline
             if i == 0:
                 raise

@@ -51,6 +51,64 @@ def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
+def collective_stats(hlo: str, layer_trips: int) -> dict:
+    """Per-decode-step collective traffic, read from the PARTITIONED HLO.
+
+    Collects every all-reduce / all-gather / reduce-scatter /
+    collective-permute result shape in the compiled module. Instructions
+    inside a while body (the lax.scan over layers) execute ``layer_trips``
+    times per step; everything else once. Returns logical tensor bytes —
+    the roofline applies the ring factor (2·(n−1)/n for all-reduce over n
+    ways) when converting to per-chip link traffic (VERDICT r4 #6: the
+    1000-tok/s projection previously priced no collectives at all)."""
+    import re
+
+    # computations are blocks "name (...) -> ... {"; while bodies are
+    # referenced as body=<name>. Params may contain NESTED parens (wide
+    # tuple params), so the header match keys on "-> ... {" at line end
+    # rather than balancing the param list.
+    comp_of_line = {}
+    current = None
+    lines = hlo.splitlines()
+    hdr_re = re.compile(r"^\s*(?:ENTRY\s+)?%?([\w.\-]+)\s*\(.*->.*\{\s*$")
+    for i, ln in enumerate(lines):
+        m = hdr_re.match(ln)
+        if m:
+            current = m.group(1)
+        comp_of_line[i] = current
+    body_names = set(re.findall(r"body=%?([\w.\-]+)", hlo))
+    # sync forms and async -start forms (the -done half aliases the same
+    # bytes, so only -start is counted). Known limitation: collectives in
+    # computations CALLED from the loop body (not textually inside it)
+    # are priced once — test_collectives_priced's analytic floor catches
+    # that regression loudly.
+    coll_re = re.compile(
+        r"=\s*(?:\()?([a-z0-9]+)\[([\d,]*)\][^=]*?"
+        r"(all-reduce|all-gather|reduce-scatter|collective-permute)"
+        r"(?:-start)?\(")
+    itemsize = {"f32": 4, "bf16": 2, "f16": 2, "s32": 4, "u32": 4,
+                "s8": 1, "u8": 1, "pred": 1, "f64": 8, "s64": 8}
+    ops = []
+    total = 0
+    for i, ln in enumerate(lines):
+        m = coll_re.search(ln)
+        if not m:
+            continue
+        dt, dims, kind = m.groups()
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        nbytes = n * itemsize.get(dt, 4)
+        trips = layer_trips if comp_of_line[i] in body_names else 1
+        ops.append({"kind": kind, "dtype": dt, "bytes": nbytes,
+                    "in_layer_loop": trips > 1})
+        total += nbytes * trips
+    return {"ops": ops,
+            "n_in_layer_loop": sum(1 for o in ops if o["in_layer_loop"]),
+            "logical_bytes_per_step": int(total)}
+
+
 def leaf_device_bytes(aval_tree, sharding_tree) -> int:
     """Exact per-device bytes: every leaf's shard_shape times itemsize."""
     total = 0
@@ -157,6 +215,10 @@ def main() -> None:
 
         total = per_dev_params + per_dev_kv
         fits = total <= V5E_HBM - ACT_HEADROOM
+        coll = collective_stats(hlo, cfg.n_layers)
+        log(f"{plan_name}: {len(coll['ops'])} collective sites, "
+            f"{coll['n_in_layer_loop']} in the layer loop, "
+            f"{coll['logical_bytes_per_step']/1e6:.1f} MB logical/step")
         results["programs"].append({
             "plan": plan_name, "compiled": True,
             "compile_s": round(compile_s, 1),
@@ -164,6 +226,7 @@ def main() -> None:
             "per_device_kv_gb": round(per_dev_kv / 1e9, 2),
             "per_device_total_gb": round(total / 1e9, 2),
             "slots": B, "seq": S, "temp_gb": temp_gb,
+            "collectives": coll,
             "fits_v5e": bool(fits)})
         assert fits, (f"{plan_name}: {total/1e9:.1f} GB/device exceeds "
                       f"v5e budget")

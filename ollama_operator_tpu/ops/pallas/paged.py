@@ -234,7 +234,7 @@ def paged_decode_attention(q, k_pool, v_pool, layer, tables, lengths,
 def _paged_kernel_v3(lay_ref, len_ref, tbl_ref, q_ref, k_hbm, v_hbm, *rest,
                      scale: float, softcap: float, window: int,
                      ps: int, sp: int, kvh: int, gp: int, hd: int, cdt,
-                     quant: bool):
+                     quant: bool, depth: int = 2):
     """One grid step per SLOT; the kernel walks only the slot's LIVE pages
     with a depth-2 manually-pipelined DMA (pltpu.make_async_copy), so
 
@@ -300,16 +300,22 @@ def _paged_kernel_v3(lay_ref, len_ref, tbl_ref, q_ref, k_hbm, v_hbm, *rest,
     acc_ref[...] = jnp.zeros_like(acc_ref)
     m_ref[...] = jnp.full_like(m_ref, NEG_INF)
     l_ref[...] = jnp.zeros_like(l_ref)
-    start_dma(start, start % 2)
+    # prologue: depth−1 pages in flight before the first wait, so per-page
+    # DMA latency amortizes depth−1 deep instead of serializing (depth 2 =
+    # the classic double buffer)
+    for j in range(depth - 1):
+        @pl.when(start + j < nlive)
+        def _prime(j=j):
+            start_dma(start + j, (start + j) % depth)
 
     qv = q_ref[0]                            # [KvH, Gp, hd]
 
     def body(i, _):
-        slot = i % 2
+        slot = i % depth
 
-        @pl.when(i + 1 < nlive)
+        @pl.when(i + depth - 1 < nlive)
         def _prefetch():
-            start_dma(i + 1, (i + 1) % 2)
+            start_dma(i + depth - 1, (i + depth - 1) % depth)
 
         wait_dma(i, slot)
         kb = kbuf[slot]                      # [KvH, ps, hd]
@@ -357,6 +363,7 @@ def paged_decode_attention_v3(q, k_pool, v_pool, layer, tables, lengths,
     """Same contract as :func:`paged_decode_attention`; the live-page
     async-DMA formulation. ``nblk`` only bounds validity (tables must
     cover it) — the walked range is the slot's live count."""
+    import os
     quant = isinstance(k_pool, dict)
     k_arr = k_pool["q"] if quant else k_pool
     v_arr = v_pool["q"] if quant else v_pool
@@ -375,6 +382,10 @@ def paged_decode_attention_v3(q, k_pool, v_pool, layer, tables, lengths,
     G = H // KvH
     Gp = max(8, -(-G // 8) * 8)
     cdt = jnp.bfloat16 if q.dtype == jnp.bfloat16 else jnp.float32
+    # DMA pipeline depth: how many page fetches are in flight ahead of
+    # the flash update (2 = classic double buffer). Deeper hides more
+    # per-page latency at the cost of depth x page VMEM buffers.
+    depth = max(2, int(os.environ.get("TPU_PAGED_DEPTH", "2") or "2"))
 
     qg = q.reshape(B, KvH, G, hd_q)
     if Gp != G or hd != hd_q:
@@ -387,26 +398,26 @@ def paged_decode_attention_v3(q, k_pool, v_pool, layer, tables, lengths,
     ]
     args = [qg, k_arr, v_arr]
     scratch = [
-        pltpu.VMEM((2, KvH, ps, hd), k_arr.dtype),
-        pltpu.VMEM((2, KvH, ps, hd), v_arr.dtype),
+        pltpu.VMEM((depth, KvH, ps, hd), k_arr.dtype),
+        pltpu.VMEM((depth, KvH, ps, hd), v_arr.dtype),
     ]
     if quant:
         in_specs += [hbm, hbm]
         args += [k_pool["s"].reshape(L, P, KvH, 1, -1).astype(jnp.float32),
                  v_pool["s"].reshape(L, P, KvH, 1, -1).astype(jnp.float32)]
-        scratch += [pltpu.VMEM((2, KvH, 1, sp), jnp.float32),
-                    pltpu.VMEM((2, KvH, 1, sp), jnp.float32)]
+        scratch += [pltpu.VMEM((depth, KvH, 1, sp), jnp.float32),
+                    pltpu.VMEM((depth, KvH, 1, sp), jnp.float32)]
     scratch += [
         pltpu.VMEM((KvH, Gp, hd), jnp.float32),
         pltpu.VMEM((KvH, Gp, 1), jnp.float32),
         pltpu.VMEM((KvH, Gp, 1), jnp.float32),
-        pltpu.SemaphoreType.DMA((4 if quant else 2, 2)),
+        pltpu.SemaphoreType.DMA((4 if quant else 2, depth)),
     ]
 
     kernel = functools.partial(
         _paged_kernel_v3, scale=scale, softcap=softcap,
         window=sliding_window, ps=ps, sp=sp, kvh=KvH, gp=Gp, hd=hd,
-        cdt=cdt, quant=quant)
+        cdt=cdt, quant=quant, depth=depth)
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(

@@ -71,6 +71,10 @@ class EngineConfig:
     # model at load (resolve_paged_default); direct engine constructions
     # default off.
     paged: Optional[bool] = False
+    # 0 = resolve per backend when paged (128 on TPU — the round-5
+    # page-size ladder measured +10.5% over 64 at B=32 and −1.5% at 256;
+    # fewer, larger page DMAs amortize the serialized per-page walk);
+    # direct engine constructions use the explicit value
     page_size: int = 64
     # data pages in the pool (excl. the trash page); None = the dense
     # equivalent max_slots * max_seq_len / page_size — same HBM ceiling,
@@ -84,33 +88,61 @@ def resolve_serving_defaults(ecfg: "EngineConfig", cfg: ModelConfig,
 
     - ``paged=None`` → resolve_paged_default (GQA on TPU pages, MHA/MoE/
       CPU stay dense; explicit True/False passes through).
-    - ``max_slots=0`` → 32 paged / 8 dense.
+    - ``max_slots=0`` → 64 for GQA paged on TPU (r5 ladder: 3902 tok/s
+      vs 2848 at 32), 32 for other paged, 8 dense.
     - ``decode_chunk=0`` → 32 on TPU, 8 elsewhere (the config every
       BASELINE.md headline was measured at; round-1's chunk-8 default
       served the 64–116 tok/s class on the same chip).
+    - ``page_size=0`` → 128 for GQA paged on TPU (r5 page-size ladder:
+      +10.5% over 64 at B=32, 256 regresses; MHA measured −2% so it
+      keeps 64), 64 elsewhere.
     - When paged resolved on with auto slots and no explicit pool size,
-      the pool is capped at the OLD dense default's HBM ceiling
-      (8 × serving max_seq of pages): the 32 slots share it, so the
-      default footprint is unchanged and mixed-length concurrency
-      quadruples; full-length overload preempts/requeues instead of
+      the pool is byte-capped: the 32-slot default shares a dense-8
+      HBM-equivalent pool (footprint of the old dense default), the
+      64-slot GQA default a dense-24 one — the measured minimum that
+      holds 64 mixed slots at design load without running dry (r5
+      window 3/4). Full-length overload preempts/requeues instead of
       OOMing at load. The pool stores heads padded to the 128-lane tile,
       so for hd<128 models the auto page count shrinks by hd/hd_pool —
       the BYTE ceiling is what's preserved, not the token count.
     """
+    import jax
+    on_tpu = jax.default_backend() == "tpu"
     chunk = ecfg.decode_chunk or resolve_decode_chunk_default()
+    # page_size 128 only pays for GQA (few kv heads → 16 KB pages at 64;
+    # doubling them bought +10.5% in the r5 ladder). An MHA page is
+    # already KvH× larger — the same window measured ps=128 at −2%
+    # (noise) on phi, so MHA keeps 64.
+    gqa = cfg.n_kv_heads < cfg.n_heads
     if ecfg.paged is not None and ecfg.max_slots != 0:
-        return dataclasses.replace(ecfg, decode_chunk=chunk)
+        ps = ecfg.page_size or (128 if on_tpu and ecfg.paged and gqa
+                                else 64)
+        return dataclasses.replace(ecfg, decode_chunk=chunk, page_size=ps)
     paged = (resolve_paged_default(cfg, mesh) if ecfg.paged is None
              else ecfg.paged)
-    slots = ecfg.max_slots or (32 if paged else 8)
+    ps = ecfg.page_size or (128 if on_tpu and paged and gqa else 64)
+    # GQA pages at 64 slots on TPU (r5 ladder: 3902 tok/s at 64 vs 2848
+    # at 32, TTFT p50 ~112 ms — aggregate throughput is the serving
+    # metric); MHA keeps 32 (its paged step is ~3x GQA's, 64 would double
+    # streaming latency on an unmeasured combination)
+    slots = ecfg.max_slots or ((64 if on_tpu and gqa else 32)
+                               if paged else 8)
     n_pages = ecfg.n_pages
     if paged and n_pages is None and ecfg.max_slots == 0:
         serve_seq = min(ecfg.max_seq_len, cfg.max_seq_len)
         hd_pool = -(-cfg.head_dim // 128) * 128
-        n_pages = max(1, (8 * serve_seq) * cfg.head_dim
-                      // hd_pool // ecfg.page_size)
+        # pool byte ceiling: dense-8 equivalent for the 32-slot default,
+        # dense-24 for the 64-slot GQA default — measured, not guessed:
+        # the r5 window-3 capture showed 64 mixed slots at design load
+        # (live ~210/slot) round up to ~160 ps-128 pages, so a dense-16
+        # cap (128 pages) ran the pool dry mid-capture; 24×seq holds the
+        # design load with ~15% slack (window-4 validation capture)
+        ceil_slots = 24 if slots >= 64 else 8
+        n_pages = max(1, (ceil_slots * serve_seq) * cfg.head_dim
+                      // hd_pool // ps)
     return dataclasses.replace(ecfg, paged=paged, max_slots=slots,
-                               n_pages=n_pages, decode_chunk=chunk)
+                               n_pages=n_pages, decode_chunk=chunk,
+                               page_size=ps)
 
 
 def resolve_paged_default(cfg: ModelConfig, mesh) -> bool:

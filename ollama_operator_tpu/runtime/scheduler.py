@@ -134,6 +134,17 @@ class Scheduler:
         # latency matters more than throughput.
         import os as _os
         self.spec_k = int(_os.environ.get("TPU_SPEC_DECODE", "0") or "0")
+        if self.spec_k > 0:
+            # EXPERIMENTAL, and say so at enable time: no measured
+            # deployment currently benefits (the ceiling is 0.023x under
+            # remote dispatch); the knob exists for colocated-host setups
+            # to measure their own envelope
+            import sys as _sys
+            print(f"warning: TPU_SPEC_DECODE={self.spec_k} is "
+                  f"EXPERIMENTAL — the measured accept-all CEILING under "
+                  f"remote dispatch is 0.023x chunked decode (BASELINE.md "
+                  f"r4); enable only on colocated hosts after measuring "
+                  f"bench.py's spec envelope there", file=_sys.stderr)
         self._waiting: queue.Queue = queue.Queue(maxsize=max_queue)
         # preempted requests (paged pool pressure) re-admit before the
         # waiting queue — they already hold a place in the line

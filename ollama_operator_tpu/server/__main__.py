@@ -90,7 +90,10 @@ def main(argv=None):
                         "aggregate; dense for MHA/MoE); TPU_PAGED=0 "
                         "forces dense")
     p.add_argument("--page-size", type=int,
-                   default=int(os.environ.get("TPU_PAGE_SIZE", "64")))
+                   default=int(os.environ.get("TPU_PAGE_SIZE", "0")),
+                   help="KV pool page size in tokens (0 = backend "
+                        "default: 128 paged on TPU — measured +10%% over "
+                        "64 at B=32 — else 64)")
     p.add_argument("--n-pages", type=int,
                    default=int(os.environ.get("TPU_N_PAGES", "0")),
                    help="KV pool pages (0 = dense-equivalent "

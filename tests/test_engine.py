@@ -293,16 +293,20 @@ def test_resolve_serving_defaults():
                         page_size=16)
     with mock.patch("jax.default_backend", return_value="tpu"):
         r = resolve_serving_defaults(base, gqa, None)
-        assert r.paged is True and r.max_slots == 32
+        # GQA paged on TPU defaults to 64 slots since r5 (ladder: 3902
+        # tok/s at 64 vs 2848 at 32) with a dense-24-equivalent pool
+        # ceiling (dense-8/16 caps measured pool-dry under 64 mixed
+        # slots at design load, r5 window 3)
+        assert r.paged is True and r.max_slots == 64
         # ceiling uses the SERVING seq (engine clamps to the model's 128)
-        # and preserves dense-8 BYTES: the pool pads head_dim to the
+        # and preserves dense-24 BYTES: the pool pads head_dim to the
         # 128-lane tile (tiny: hd 16 → 8× padding), so the page count
         # shrinks by hd/hd_pool (round-3 advisor finding)
-        assert r.n_pages == 8 * 128 * 16 // 128 // 16
+        assert r.n_pages == 24 * 128 * 16 // 128 // 16
         # a hd=128 model keeps the full token count
         r128 = resolve_serving_defaults(
             base, cfglib.PRESETS["llama3.2:3b"], None)
-        assert r128.n_pages == 8 * 4096 // 16
+        assert r128.n_pages == 24 * 4096 // 16
         # explicit slots: user asked for scale — dense-equivalent pool
         r2 = resolve_serving_defaults(
             EngineConfig(max_slots=16, max_seq_len=4096, paged=None,
@@ -317,6 +321,36 @@ def test_resolve_serving_defaults():
     # CPU backend: auto resolves dense
     r4 = resolve_serving_defaults(base, gqa, None)
     assert r4.paged is False and r4.max_slots == 8
+
+
+def test_resolve_page_size_and_mha_slots():
+    """page_size=0 resolves to 128 when paged on TPU (r5 ladder: +10.5%
+    over 64 at B=32, 256 regresses) and 64 elsewhere; MHA models keep 32
+    slots (their paged step is ~3x GQA's — 64 is unmeasured there)."""
+    import dataclasses as dc
+    from unittest import mock
+
+    from ollama_operator_tpu.runtime.engine import resolve_serving_defaults
+    gqa = cfglib.PRESETS["tiny"]
+    mha = dc.replace(gqa, n_kv_heads=gqa.n_heads)
+    auto = EngineConfig(max_slots=0, max_seq_len=4096, paged=None,
+                        page_size=0)
+    with mock.patch("jax.default_backend", return_value="tpu"):
+        r = resolve_serving_defaults(auto, gqa, None)
+        assert r.page_size == 128 and r.max_slots == 64
+        m = resolve_serving_defaults(auto, mha, None)
+        assert m.paged is True and m.max_slots == 32
+        assert m.page_size == 64    # ps=128 measured -2% on MHA (phi)
+        # explicit page size passes through, incl. via the early return
+        pinned = EngineConfig(max_slots=8, max_seq_len=4096, paged=True,
+                              page_size=64)
+        assert resolve_serving_defaults(pinned, gqa, None).page_size == 64
+        early = EngineConfig(max_slots=8, max_seq_len=4096, paged=True,
+                             page_size=0)
+        assert resolve_serving_defaults(early, gqa, None).page_size == 128
+    # CPU: dense anyway, page size resolves to the classic 64
+    c = resolve_serving_defaults(auto, gqa, None)
+    assert c.page_size == 64 and c.paged is False
 
 
 def test_resolve_decode_chunk_default():
