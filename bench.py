@@ -522,6 +522,11 @@ def measure(jax, *, model: str, dtype: str, slots: int, steps: int,
         depth = os.environ.get("TPU_PAGED_DEPTH")
         if depth:
             rec["paged_depth"] = int(depth)
+        # ambient kernel routing (capture-scoped env is recorded via
+        # rec["env"]; pinned runs set these in the process environment)
+        for var in ("TPU_PAGED_V4", "TPU_PAGED_V3"):
+            if os.environ.get(var):
+                rec[var.lower()] = os.environ[var]
     if platform != "cpu":
         # per-chip bytes vs the v5e spec (other TPU generations will read
         # slightly off; the driver chip is a v5e — BASELINE.md)

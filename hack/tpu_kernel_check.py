@@ -108,6 +108,31 @@ def main():
         ok &= check(f"paged_decode_v3 bf16 KvH={KvH}", paged_v3_bf16, q,
                     kbf, tables, lengths)
 
+        # v4 compacted flat-grid (round 5): int8 pool + sliding window
+        from ollama_operator_tpu.ops.pallas.paged import \
+            paged_decode_attention_v4
+
+        def paged_v4(q, kq, ksc, tables, lengths, KvH=KvH):
+            kp = {"q": kq, "s": ksc}
+            out = paged_decode_attention_v4(
+                q, kp, kp, jnp.int32(0), tables, lengths, 0.125, nblk=8)
+            assert out is not None, "v4 unexpectedly bailed"
+            return out
+
+        ok &= check(f"paged_decode_v4 KvH={KvH}", paged_v4, q, kq, ksc128,
+                    tables, lengths)
+
+        def paged_v4_win(q, kq, ksc, tables, lengths, KvH=KvH):
+            kp = {"q": kq, "s": ksc}
+            out = paged_decode_attention_v4(
+                q, kp, kp, jnp.int32(0), tables, lengths, 0.125,
+                sliding_window=4096, nblk=8)
+            assert out is not None, "v4 unexpectedly bailed"
+            return out
+
+        ok &= check(f"paged_decode_v4 win KvH={KvH}", paged_v4_win, q, kq,
+                    ksc128, tables, lengths)
+
     # dense decode + MHA head-tiled grids (bf16 cache)
     from ollama_operator_tpu.ops.pallas.flash import (decode_attention,
                                                       mha_decode_attention)

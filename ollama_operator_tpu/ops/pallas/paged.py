@@ -143,6 +143,14 @@ def paged_decode_attention(q, k_pool, v_pool, layer, tables, lengths,
     the v2 grid kernel below.
     """
     import os
+    if os.environ.get("TPU_PAGED_V4", "0") == "1":
+        # experimental compacted flat-grid formulation (A/B against v3
+        # before any default change)
+        out = paged_decode_attention_v4(
+            q, k_pool, v_pool, layer, tables, lengths, scale, softcap,
+            sliding_window, nblk=nblk, interpret=interpret)
+        if out is not None:
+            return out
     if os.environ.get("TPU_PAGED_V3", "1") == "1":
         out = paged_decode_attention_v3(
             q, k_pool, v_pool, layer, tables, lengths, scale, softcap,
@@ -224,6 +232,214 @@ def paged_decode_attention(q, k_pool, v_pool, layer, tables, lengths,
       lengths.astype(jnp.int32), tables.astype(jnp.int32),
       qg, *args[1:])
     out = out.reshape(B, KvH, Gp, hd)
+    return out[:, :, :G, :hd_q].reshape(B, 1, H, hd_q)
+
+
+# ---------------------------------------------------------------------------
+# shared pieces of the v3/v4 formulations
+# ---------------------------------------------------------------------------
+
+def _flash_page_update(qv, kb, vb, ksc, vsc, m_ref, l_ref, acc_ref, *,
+                       k_start, qp, scale: float, softcap: float,
+                       window: int, ps: int, kvh: int, gp: int, cdt):
+    """KvH-batched online-softmax update for ONE [KvH, ps, hd] page —
+    the body both the v3 per-slot walk and the v4 flat grid run per live
+    page (one score dot + one p·v dot, batch dim = kv head). ``ksc``/
+    ``vsc`` are the per-position dequant scale rows ([KvH, ·, ps]) or
+    None for bf16/f32 pools. Mutates m/l/acc scratch in place."""
+    s = jax.lax.dot_general(
+        qv.astype(cdt), kb.astype(cdt), (((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32) * scale      # [KvH, Gp, ps]
+    if ksc is not None:
+        s = s * ksc
+    s = softcap_scores(s, softcap)
+    k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (kvh, gp, ps), 2)
+    ok = k_pos <= qp
+    if window:
+        ok = jnp.logical_and(ok, k_pos > qp - window)
+    s = jnp.where(ok, s, NEG_INF)
+
+    m_prev = m_ref[...]
+    m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.where(m_cur > NEG_INF / 2, jnp.exp(s - m_cur), 0.0)
+    alpha = jnp.exp(m_prev - m_cur)
+    l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    if vsc is not None:
+        p = p * vsc
+    acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+        p.astype(cdt), vb.astype(cdt), (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32)
+    m_ref[...] = m_cur
+
+
+def _prep_paged(q, k_pool, v_pool, tables, nblk: int, interpret: bool):
+    """Shared v3/v4 wrapper preamble: shape/tiling guards and the padded
+    grouped query. Returns None when the shapes don't tile (the caller
+    bails to the next formulation), else
+    (quant, k_arr, v_arr, dims, sp, G, Gp, cdt, qg) with
+    dims = (B, H, hd_q, L, P, KvH, ps, hd)."""
+    quant = isinstance(k_pool, dict)
+    k_arr = k_pool["q"] if quant else k_pool
+    v_arr = v_pool["q"] if quant else v_pool
+    B, T, H, hd_q = q.shape
+    L, P, KvH, ps, hd = k_arr.shape
+    NBLK = tables.shape[1]
+    if T != 1 or H % KvH or not _lane_ok(hd, interpret) or nblk > NBLK:
+        return None
+    if ps % 8:
+        return None
+    sp = k_pool["s"].shape[-1] if quant else ps
+    G = H // KvH
+    Gp = max(8, -(-G // 8) * 8)
+    cdt = jnp.bfloat16 if q.dtype == jnp.bfloat16 else jnp.float32
+    qg = q.reshape(B, KvH, G, hd_q)
+    if Gp != G or hd != hd_q:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, Gp - G), (0, hd - hd_q)))
+    return (quant, k_arr, v_arr, (B, H, hd_q, L, P, KvH, ps, hd),
+            sp, G, Gp, cdt, qg)
+
+
+# ---------------------------------------------------------------------------
+# v4: compacted flat-grid (grid over the slot-sorted list of LIVE pages)
+# ---------------------------------------------------------------------------
+
+def _paged_kernel_v4(nb_ref, slot_ref, page_ref, blk_ref, lay_ref, len_ref,
+                     q_ref, k_ref, v_ref, *rest,
+                     scale: float, softcap: float, window: int,
+                     ps: int, flat_n: int, kvh: int, gp: int, cdt,
+                     quant: bool):
+    """Grid (flat_n,): step n processes LIVE page n of the slot-sorted
+    flat list (slot_ref/page_ref/blk_ref scalars; nb_ref[0] = live total).
+
+    The design swaps v3's per-slot fori_loop (whose per-page flash update
+    serializes behind each DMA wait — the measured B=32 floor) for v2's
+    implicit cross-step pipeline, but with ZERO dead interior steps: the
+    flat list contains only live pages, consecutive steps of one slot
+    revisit the same q/out block (no re-DMA), and dead tail steps beyond
+    nb_ref[0] freeze the index maps so their DMAs elide. Dots are
+    KvH-batched like v3 (one score + one pv dot_general per page, batch
+    dim = kv head), not v2's per-head unrolled chain.
+
+    Accumulators live in scratch [KvH, Gp, hd]; a slot boundary
+    (slot_ref[n] != slot_ref[n-1]) resets them, and the slot's LAST live
+    page (slot changes at n+1, or n is the live total − 1) normalizes
+    and stores the output block."""
+    if quant:
+        ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        o_ref, acc_ref, m_ref, l_ref = rest
+        ks_ref = vs_ref = None
+    n = pl.program_id(0)
+    n_total = nb_ref[0]
+    slot = slot_ref[n]
+    qp = len_ref[slot]
+    valid = n < n_total
+
+    first = jnp.logical_or(n == 0, slot_ref[jnp.maximum(n - 1, 0)] != slot)
+
+    @pl.when(jnp.logical_and(valid, first))
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    @pl.when(valid)
+    def _step():
+        _flash_page_update(
+            q_ref[0], k_ref[0, 0], v_ref[0, 0],
+            ks_ref[0, 0][:, :, :ps] if quant else None,
+            vs_ref[0, 0][:, :, :ps] if quant else None,
+            m_ref, l_ref, acc_ref,
+            k_start=blk_ref[n] * ps, qp=qp, scale=scale, softcap=softcap,
+            window=window, ps=ps, kvh=kvh, gp=gp, cdt=cdt)
+
+        last = jnp.logical_or(
+            n + 1 >= n_total,
+            slot_ref[jnp.minimum(n + 1, flat_n - 1)] != slot)
+
+        @pl.when(last)
+        def _done():
+            out = acc_ref[...] / jnp.maximum(l_ref[...], 1e-30)
+            o_ref[0] = out.astype(o_ref.dtype)
+
+
+def paged_decode_attention_v4(q, k_pool, v_pool, layer, tables, lengths,
+                              scale: float, softcap: float = 0.0,
+                              sliding_window: int = 0, *, nblk: int,
+                              interpret: bool = False):
+    """Same contract as :func:`paged_decode_attention`; the compacted
+    flat-grid formulation. The flat (slot, page, block) list is built in
+    XLA from the live lengths (cumsum + searchsorted) and handed to the
+    kernel as prefetched scalars; the static grid is the worst case
+    B·nblk, with every step past the live total frozen to the last live
+    index so its DMAs elide at the revisit check."""
+    prep = _prep_paged(q, k_pool, v_pool, tables, nblk, interpret)
+    if prep is None:
+        return None
+    quant, k_arr, v_arr, dims, sp, G, Gp, cdt, qg = prep
+    B, H, hd_q, L, P, KvH, ps, hd = dims
+    flat_n = B * nblk
+
+    lengths = lengths.astype(jnp.int32)
+    tables = tables.astype(jnp.int32)
+    nlive = jnp.minimum(lengths // ps + 1, nblk)           # [B]
+    ends = jnp.cumsum(nlive)                               # [B]
+    starts = ends - nlive
+    n_total = ends[-1]
+    idx = jnp.arange(flat_n, dtype=jnp.int32)
+    slot = jnp.minimum(jnp.searchsorted(ends, idx, side="right"),
+                       B - 1).astype(jnp.int32)            # [flat_n]
+    blk = jnp.clip(idx - starts[slot], 0, nblk - 1)
+    page = tables[slot, blk]
+    # freeze dead tail steps to the LAST live index so their q/kv/out
+    # block indices repeat and pallas elides the copies
+    live = idx < n_total
+    last_blk = jnp.clip(nlive[B - 1] - 1, 0, nblk - 1)
+    page = jnp.where(live, page, tables[B - 1, last_blk])
+    blk = jnp.where(live, blk, last_blk)
+
+    def q_index(n, nb, slot_r, page_r, blk_r, lay_r, len_r):
+        return (slot_r[n], 0, 0, 0)
+
+    def kv_index(n, nb, slot_r, page_r, blk_r, lay_r, len_r):
+        return (lay_r[0], page_r[n], 0, 0, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, KvH, Gp, hd), q_index),
+        pl.BlockSpec((1, 1, KvH, ps, hd), kv_index),
+        pl.BlockSpec((1, 1, KvH, ps, hd), kv_index),
+    ]
+    args = [qg, k_arr, v_arr]
+    if quant:
+        in_specs += [pl.BlockSpec((1, 1, KvH, 1, sp), kv_index),
+                     pl.BlockSpec((1, 1, KvH, 1, sp), kv_index)]
+        args += [k_pool["s"].reshape(L, P, KvH, 1, -1),
+                 v_pool["s"].reshape(L, P, KvH, 1, -1)]
+
+    kernel = functools.partial(
+        _paged_kernel_v4, scale=scale, softcap=softcap,
+        window=sliding_window, ps=ps, flat_n=flat_n, kvh=KvH, gp=Gp,
+        cdt=cdt, quant=quant)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=6,
+            grid=(flat_n,),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec((1, KvH, Gp, hd), q_index),
+            scratch_shapes=[
+                pltpu.VMEM((KvH, Gp, hd), jnp.float32),
+                pltpu.VMEM((KvH, Gp, 1), jnp.float32),
+                pltpu.VMEM((KvH, Gp, 1), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, KvH, Gp, hd), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=interpret,
+    )(jnp.reshape(n_total, (1,)).astype(jnp.int32), slot, page, blk,
+      jnp.reshape(layer, (1,)).astype(jnp.int32), lengths,
+      *args)
     return out[:, :, :G, :hd_q].reshape(B, 1, H, hd_q)
 
 
@@ -318,37 +534,17 @@ def _paged_kernel_v3(lay_ref, len_ref, tbl_ref, q_ref, k_hbm, v_hbm, *rest,
             start_dma(i + depth - 1, (i + depth - 1) % depth)
 
         wait_dma(i, slot)
-        kb = kbuf[slot]                      # [KvH, ps, hd]
-        s = jax.lax.dot_general(
-            qv.astype(cdt), kb.astype(cdt), (((2,), (2,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32) * scale  # [KvH, Gp, ps]
-        if quant:
-            # scale buffers are 4-D [2, KvH, 1, sp] (a 3-D buffer's
-            # dynamic-slot load lowers as an unsupported gather) and
-            # lane-padded to sp >= ps (Mosaic DMA tile rule); the unit
-            # axis is the broadcast axis and only the live ps lanes
-            # multiply
-            s = s * ksbuf[slot][:, :, :ps]
-        s = softcap_scores(s, softcap)
-        k_pos = i * ps + jax.lax.broadcasted_iota(jnp.int32,
-                                                  (kvh, gp, ps), 2)
-        ok = k_pos <= qp
-        if window:
-            ok = jnp.logical_and(ok, k_pos > qp - window)
-        s = jnp.where(ok, s, NEG_INF)
-
-        m_prev = m_ref[...]
-        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.where(m_cur > NEG_INF / 2, jnp.exp(s - m_cur), 0.0)
-        alpha = jnp.exp(m_prev - m_cur)
-        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        if quant:
-            p = p * vsbuf[slot][:, :, :ps]
-        vb = vbuf[slot]                      # [KvH, ps, hd]
-        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
-            p.astype(cdt), vb.astype(cdt), (((2,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32)
-        m_ref[...] = m_cur
+        # scale buffers are 4-D [depth, KvH, 1, sp] (a 3-D buffer's
+        # dynamic-slot load lowers as an unsupported gather) and
+        # lane-padded to sp >= ps (Mosaic DMA tile rule); the unit axis
+        # is the broadcast axis and only the live ps lanes multiply
+        _flash_page_update(
+            qv, kbuf[slot], vbuf[slot],
+            ksbuf[slot][:, :, :ps] if quant else None,
+            vsbuf[slot][:, :, :ps] if quant else None,
+            m_ref, l_ref, acc_ref,
+            k_start=i * ps, qp=qp, scale=scale, softcap=softcap,
+            window=window, ps=ps, kvh=kvh, gp=gp, cdt=cdt)
         return 0
 
     jax.lax.fori_loop(start, nlive, body, 0)
@@ -364,32 +560,19 @@ def paged_decode_attention_v3(q, k_pool, v_pool, layer, tables, lengths,
     async-DMA formulation. ``nblk`` only bounds validity (tables must
     cover it) — the walked range is the slot's live count."""
     import os
-    quant = isinstance(k_pool, dict)
-    k_arr = k_pool["q"] if quant else k_pool
-    v_arr = v_pool["q"] if quant else v_pool
-    B, T, H, hd_q = q.shape
-    L, P, KvH, ps, hd = k_arr.shape
-    NBLK = tables.shape[1]
-    if T != 1 or H % KvH or not _lane_ok(hd, interpret) or nblk > NBLK:
+    prep = _prep_paged(q, k_pool, v_pool, tables, nblk, interpret)
+    if prep is None:
         return None
-    if ps % 8:
-        return None
-    sp = k_pool["s"].shape[-1] if quant else ps
+    quant, k_arr, v_arr, dims, sp, G, Gp, cdt, qg = prep
+    B, H, hd_q, L, P, KvH, ps, hd = dims
     if quant and not interpret and sp % 128:
         # manual f32 DMAs need a 128-lane minor dim; unpadded scale pools
         # (hand-built tests, older stores) fall back to the v2 grid kernel
         return None
-    G = H // KvH
-    Gp = max(8, -(-G // 8) * 8)
-    cdt = jnp.bfloat16 if q.dtype == jnp.bfloat16 else jnp.float32
     # DMA pipeline depth: how many page fetches are in flight ahead of
     # the flash update (2 = classic double buffer). Deeper hides more
     # per-page latency at the cost of depth x page VMEM buffers.
     depth = max(2, int(os.environ.get("TPU_PAGED_DEPTH", "2") or "2"))
-
-    qg = q.reshape(B, KvH, G, hd_q)
-    if Gp != G or hd != hd_q:
-        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, Gp - G), (0, hd - hd_q)))
 
     hbm = pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM)
     in_specs = [

@@ -269,12 +269,16 @@ def _rand_pool(key, L, P, KvH, ps, hd, quant):
 
 @pytest.mark.parametrize("quant", [False, True])
 @pytest.mark.parametrize("kvh,h", [(2, 8), (4, 4)])   # GQA and MHA
-def test_paged_v3_matches_v2_direct(quant, kvh, h):
+def test_paged_v3_matches_v2_direct(quant, kvh, h, monkeypatch):
     """Kernel-level parity: the dynamic live-page walk + KvH-batched dots
     must reproduce the v2 grid kernel bit-for-bit-ish on mixed lengths,
     both pool dtypes, GQA and MHA."""
     from ollama_operator_tpu.ops.pallas.paged import (
         paged_decode_attention, paged_decode_attention_v3)
+    # the dispatcher routes to v3/v4 by default — the REFERENCE must be
+    # the v2 grid kernel, not a self-comparison
+    monkeypatch.setenv("TPU_PAGED_V3", "0")
+    monkeypatch.setenv("TPU_PAGED_V4", "0")
     L, P, ps, hd, B = 2, 9, 8, 128, 4
     key = jax.random.key(0)
     kp, vp = _rand_pool(key, L, P, kvh, ps, hd, quant)
@@ -293,9 +297,11 @@ def test_paged_v3_matches_v2_direct(quant, kvh, h):
                                rtol=2e-5, atol=2e-5)
 
 
-def test_paged_v3_sliding_window_matches_v2():
+def test_paged_v3_sliding_window_matches_v2(monkeypatch):
     from ollama_operator_tpu.ops.pallas.paged import (
         paged_decode_attention, paged_decode_attention_v3)
+    monkeypatch.setenv("TPU_PAGED_V3", "0")
+    monkeypatch.setenv("TPU_PAGED_V4", "0")
     L, P, KvH, ps, hd, B, H = 1, 9, 2, 8, 128, 4, 4
     kp, vp = _rand_pool(jax.random.key(2), L, P, KvH, ps, hd, False)
     q = jax.random.normal(jax.random.key(3), (B, 1, H, hd), jnp.float32)
@@ -318,6 +324,75 @@ def test_paged_v3_engine_matches_dense(params, cache_dtype, monkeypatch):
     """End-to-end: the engine's greedy decode through the v3 kernel equals
     the dense-cache reference (same invariant the v2 kernel pins)."""
     monkeypatch.setenv("TPU_PAGED_V3", "1")
+    dense = dataclasses.replace(DENSE, cache_dtype=cache_dtype)
+    paged = dataclasses.replace(PAGED, cache_dtype=cache_dtype)
+    ref = _greedy_run(XLA, dense, params)
+    got = _greedy_run(INTERP, paged, params)
+    assert got == ref, (got, ref)
+
+
+# ---------------------------------------------------------------------------
+# v4 compacted flat-grid kernel (round 5: the B=32 walk-serialization floor)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("quant", [False, True])
+@pytest.mark.parametrize("kvh,h", [(2, 8), (4, 4)])   # GQA and MHA
+def test_paged_v4_matches_v2_direct(quant, kvh, h, monkeypatch):
+    """Kernel-level parity for the flat-grid formulation: the slot-sorted
+    live-page list (cumsum + searchsorted construction, dead tail frozen)
+    must reproduce the v2 grid kernel on mixed lengths, both pool dtypes,
+    GQA and MHA."""
+    from ollama_operator_tpu.ops.pallas.paged import (
+        paged_decode_attention, paged_decode_attention_v4)
+    # pin the reference to the v2 grid kernel (the dispatcher would
+    # otherwise hand back v3 — or v4 itself under TPU_PAGED_V4=1)
+    monkeypatch.setenv("TPU_PAGED_V3", "0")
+    monkeypatch.setenv("TPU_PAGED_V4", "0")
+    L, P, ps, hd, B = 2, 9, 8, 128, 4
+    key = jax.random.key(0)
+    kp, vp = _rand_pool(key, L, P, kvh, ps, hd, quant)
+    q = jax.random.normal(jax.random.key(1), (B, 1, h, hd), jnp.float32)
+    tables = jnp.asarray(
+        np.random.default_rng(0).permutation(np.arange(1, 9))
+        .reshape(B, 2), jnp.int32)
+    lengths = jnp.asarray([0, 3, 8, 15], jnp.int32)
+    layer = jnp.asarray([1], jnp.int32)
+    ref = paged_decode_attention(q, kp, vp, layer, tables, lengths,
+                                 scale=0.35, nblk=2, interpret=True)
+    got = paged_decode_attention_v4(q, kp, vp, layer, tables, lengths,
+                                    scale=0.35, nblk=2, interpret=True)
+    assert ref is not None and got is not None
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_paged_v4_sliding_window_matches_v2(monkeypatch):
+    from ollama_operator_tpu.ops.pallas.paged import (
+        paged_decode_attention, paged_decode_attention_v4)
+    monkeypatch.setenv("TPU_PAGED_V3", "0")
+    monkeypatch.setenv("TPU_PAGED_V4", "0")
+    L, P, KvH, ps, hd, B, H = 1, 9, 2, 8, 128, 4, 4
+    kp, vp = _rand_pool(jax.random.key(2), L, P, KvH, ps, hd, False)
+    q = jax.random.normal(jax.random.key(3), (B, 1, H, hd), jnp.float32)
+    tables = jnp.asarray(np.arange(1, 9).reshape(B, 2), jnp.int32)
+    lengths = jnp.asarray([2, 9, 12, 15], jnp.int32)
+    layer = jnp.asarray([0], jnp.int32)
+    for win in (4, 11):
+        ref = paged_decode_attention(q, kp, vp, layer, tables, lengths,
+                                     scale=0.3, sliding_window=win,
+                                     nblk=2, interpret=True)
+        got = paged_decode_attention_v4(q, kp, vp, layer, tables, lengths,
+                                        scale=0.3, sliding_window=win,
+                                        nblk=2, interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5, err_msg=f"win={win}")
+
+
+@pytest.mark.parametrize("cache_dtype", [jnp.float32, jnp.int8])
+def test_paged_v4_engine_matches_dense(params, cache_dtype, monkeypatch):
+    """End-to-end: the engine's greedy decode through the v4 kernel equals
+    the dense-cache reference (same invariant v2/v3 pin)."""
+    monkeypatch.setenv("TPU_PAGED_V4", "1")
     dense = dataclasses.replace(DENSE, cache_dtype=cache_dtype)
     paged = dataclasses.replace(PAGED, cache_dtype=cache_dtype)
     ref = _greedy_run(XLA, dense, params)
