@@ -25,3 +25,35 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# Single-process suite robustness (round 5, VERDICT r4 #4): a full
+# `pytest tests/` run compiles many hundreds of XLA programs in ONE
+# process and segfaulted inside XLA's native compile (~85% in, during a
+# model reload's warm_buckets) on this host in rounds 4 and 5 — per-file
+# runs are all green, so the trigger is accumulated in-process compiler
+# state, not any one test. Bound it:
+# - persistent on-disk compilation cache, so the per-module cache clear
+#   below costs disk reads, not recompiles (same mechanism the server
+#   and bench use);
+# - drop live executables between modules (jax.clear_caches) so the
+#   in-process accumulation resets ~45 times instead of growing
+#   monotonically.
+_cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          ".xla_test_cache")
+os.makedirs(_cache_dir, exist_ok=True)
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+# 0.0, NOT the 1.0 the server/bench use: test-sized CPU programs compile
+# in well under a second and would otherwise never be persisted — the
+# per-module clear would then force full recompiles instead of disk reads
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+import gc  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _bound_xla_state():
+    yield
+    jax.clear_caches()
+    gc.collect()

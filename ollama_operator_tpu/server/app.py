@@ -1283,10 +1283,34 @@ class Handler(BaseHTTPRequestHandler):
         return b"data: " + json.dumps(obj).encode() + b"\n\n"
 
 
+class _DeepStackHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer whose HANDLER threads get a deep stack.
+
+    Handler threads can run XLA compiles (a /api/chat that loads a model
+    warms its buckets on the request thread); LLVM recursion is
+    stack-hungry and a default thread stack invites a native overflow.
+    The bump is scoped to handler-thread creation and restored right
+    after — `threading.stack_size` is process-global, and leaving 64 MiB
+    set would tax every thread the process creates afterwards. (A thread
+    spawned elsewhere during this narrow window also gets the deep
+    stack; that is a virtual reservation, not committed memory.)"""
+
+    def process_request(self, request, client_address):
+        try:
+            old = threading.stack_size(64 << 20)
+        except (ValueError, RuntimeError):
+            old = None
+        try:
+            super().process_request(request, client_address)
+        finally:
+            if old is not None:
+                threading.stack_size(old)
+
+
 def serve(manager: ModelManager, host: str = "0.0.0.0", port: int = 11434
           ) -> ThreadingHTTPServer:
     handler = type("BoundHandler", (Handler,), {"manager": manager})
-    httpd = ThreadingHTTPServer((host, port), handler)
+    httpd = _DeepStackHTTPServer((host, port), handler)
     t = threading.Thread(target=httpd.serve_forever, daemon=True,
                          name="http-server")
     t.start()
