@@ -57,10 +57,13 @@ def _rope_scaling_from_gguf(f: GGUFFile) -> Dict[str, Any]:
         factor = f.field("rope.scale_linear", f.field("rope.scale"))
         if factor is not None and stype is None:
             stype = "linear"
-    if stype is not None and str(stype) not in ("none", "linear", "yarn"):
+    if stype is not None and str(stype) not in ("none", "linear", "yarn",
+                                                "longrope"):
         raise NotImplementedError(
             f"unsupported GGUF rope.scaling.type {stype!r}")
-    if stype is not None and str(stype) != "none":
+    if stype is not None and str(stype) not in ("none", "longrope"):
+        # longrope is carried entirely by the rope_factors_* tensors
+        # (handled below) — the metadata type itself maps to no scheme
         out["rope_scaling_type"] = str(stype)
     if factor is not None and float(factor) > 0:
         out["rope_scaling"] = float(factor)
@@ -80,6 +83,31 @@ def _rope_scaling_from_gguf(f: GGUFFile) -> Dict[str, Any]:
         ff = DQ.dequantize_tensor(f, f.tensors["rope_freqs.weight"])
         out["rope_freq_factors"] = tuple(
             float(x) for x in np.asarray(ff, np.float64).reshape(-1))
+    elif "rope_factors_long.weight" in f.tensors:
+        # phi3-family longrope: the conversion stores TWO per-frequency
+        # divisor tensors; the serving context selects which applies
+        # (long when the model's extended window exceeds the original
+        # training window — llama.cpp picks per-graph by n_ctx, we serve
+        # the GGUF's full declared window so the choice is static), and
+        # cos/sin scale by the longrope magnitude factor
+        # sqrt(1 + ln(ctx/orig)/ln(orig)) unless the conversion recorded
+        # an explicit attn_factor (transformers Phi3 semantics)
+        ctx = int(f.field("context_length", 4096))
+        octx2 = int(octx or ctx)
+        name = ("rope_factors_long.weight" if ctx > octx2
+                else "rope_factors_short.weight")
+        ff = DQ.dequantize_tensor(f, f.tensors[name])
+        out["rope_freq_factors"] = tuple(
+            float(x) for x in np.asarray(ff, np.float64).reshape(-1))
+        if not out.get("rope_attn_factor") and ctx > octx2:
+            out["rope_attn_factor"] = float(
+                np.sqrt(1.0 + np.log(ctx / octx2) / np.log(octx2)))
+    elif str(stype or "") == "longrope":
+        raise ValueError(
+            "rope.scaling.type is longrope but the GGUF carries no "
+            "rope_factors_long/short tensors — refusing to serve with "
+            "unscaled rope (outputs past the original window would be "
+            "garbage)")
     # yarn needs the original window; older exports omit it — fall back to
     # context_length / factor (the convention llama.cpp applies)
     if (out.get("rope_scaling_type") == "yarn"
@@ -150,6 +178,22 @@ def config_from_gguf(f: GGUFFile) -> ModelConfig:
             logit_softcap=float(f.field("final_logit_softcapping", 30.0)),
             attn_scale=qpas,
             **base)
+    elif arch == "phi3":
+        # phi3/phi3.5 (mini 3.8B MHA, medium GQA): llama-family block —
+        # RMSNorm, gated-silu MLP, full rotary — converted with FUSED
+        # attn_qkv and gate+up ffn_up tensors (split in load_params) and
+        # longrope context extension (rope_factors_long/short tensors →
+        # rope_freq_factors + the magnitude factor,
+        # _rope_scaling_from_gguf)
+        if not base.get("sliding_window") and base["max_seq_len"] <= 4096:
+            # older conversions of the 4k tags omit the window key
+            # (llama.cpp hardcodes phi3's n_swa for the same reason);
+            # serving full attention past the trained 2047 window would
+            # silently diverge. The 128k tags set sliding_window >= ctx
+            # in HF config (i.e. effectively none) — only short-context
+            # models get the default.
+            base["sliding_window"] = 2047
+        cfg = ModelConfig(arch="llama", **base)
     elif arch == "phi2":
         base["norm_eps"] = float(f.field("attention.layer_norm_epsilon",
                                          1e-5))
@@ -241,8 +285,25 @@ def load_params(f: GGUFFile, cfg: Optional[ModelConfig] = None,
         "attn_norm_w": stack("blk.{}.attn_norm.weight"),
         "wo": stack("blk.{}.attn_output.weight", T_),
     }
+    fused_gate_up = (cfg.mlp_type == "gated" and not cfg.n_experts
+                     and "blk.0.ffn_gate.weight" not in f.tensors)
     if not cfg.n_experts:
-        layers["w_up"] = stack("blk.{}.ffn_up.weight", T_)
+        if fused_gate_up:
+            # phi3-family: ffn_up holds [gate; up] fused ([2F, D] —
+            # HF gate_up_proj order, kept by the conversion); split so
+            # the decoder's separate-projection path serves unchanged
+            F = cfg.ffn_dim
+            gs, us = [], []
+            for i in range(L):
+                w = _dq(f, f"blk.{i}.ffn_up.weight")
+                assert w.shape[0] == 2 * F, (
+                    f"fused ffn_up rows {w.shape[0]} != 2*ffn_dim {2 * F}")
+                gs.append(cast(w[:F].T))
+                us.append(cast(w[F:].T))
+            layers["w_gate"] = np.stack(gs)
+            layers["w_up"] = np.stack(us)
+        else:
+            layers["w_up"] = stack("blk.{}.ffn_up.weight", T_)
         layers["w_down"] = stack("blk.{}.ffn_down.weight", T_)
     if "blk.0.attn_qkv.weight" in f.tensors:  # fused qkv (phi2)
         q_dim, kv_dim = cfg.q_dim, cfg.kv_dim
@@ -302,7 +363,7 @@ def load_params(f: GGUFFile, cfg: Optional[ModelConfig] = None,
             layers["we_gate"] = stack_experts("blk.{}.ffn_gate.{}.weight")
             layers["we_up"] = stack_experts("blk.{}.ffn_up.{}.weight")
             layers["we_down"] = stack_experts("blk.{}.ffn_down.{}.weight")
-    elif cfg.mlp_type == "gated":
+    elif cfg.mlp_type == "gated" and not fused_gate_up:
         layers["w_gate"] = stack("blk.{}.ffn_gate.weight", T_)
     if cfg.out_bias:
         layers["bo"] = stack("blk.{}.attn_output.bias")

@@ -161,6 +161,13 @@ PRESETS = {
                norm_type="layernorm", mlp_type="plain", act="gelu_tanh",
                parallel_block=True, attn_bias=True, out_bias=True,
                rotary_pct=0.4, max_seq_len=2048),
+    # phi3-mini 3.8B (the ollama `phi3` default tag): llama-family block,
+    # MHA (32/32), full rotary; the 4k-instruct variant serves without
+    # longrope (the 128k tags carry rope_factors tensors the transcoder
+    # maps to rope_freq_factors)
+    "phi3": _mk(arch="llama", vocab_size=32064, dim=3072, n_layers=32,
+                n_heads=32, n_kv_heads=32, head_dim=96, ffn_dim=8192,
+                max_seq_len=4096, sliding_window=2047),
     "llama2": _mk(arch="llama", vocab_size=32000, dim=4096, n_layers=32,
                   n_heads=32, n_kv_heads=32, head_dim=128, ffn_dim=11008,
                   max_seq_len=4096),

@@ -75,6 +75,12 @@ def scaled_inv_freq(rotary_dim: int, theta: float, *,
             f"rope_freq_factors has {ff.shape[0]} entries; rotary_dim "
             f"{rotary_dim} needs {half}")
         inv_freq = inv_freq / ff
+        if attn_factor > 0:
+            # phi3-family longrope: the factor tensor rescales frequencies
+            # AND cos/sin scale by the magnitude factor (transformers
+            # Phi3LongRoPE; plain llama3.1 rope_freqs carry no attn_factor
+            # so their mscale stays 1)
+            mscale = attn_factor
     elif scaling_type == "linear" or (scaling_type == "none"
                                       and factor != 1.0):
         inv_freq = inv_freq / factor

@@ -593,3 +593,90 @@ def test_qwen25_yarn_past_native_window(tmp_path):
                                 dtype=np.float32)
     assert mcfg.rope_scaling_type == "yarn"
     _check_long(str(tmp_path / "qwen25.gguf"), model)
+
+
+def _write_phi3(path, cfg, sd, long_factor=None, short_factor=None,
+                orig_ctx=None):
+    """phi3 GGUF per the llama.cpp conversion: FUSED attn_qkv and
+    gate+up ffn_up (HF keeps them fused too — qkv_proj / gate_up_proj),
+    no rope permute (NEOX half-split layout), longrope as
+    rope_factors_{long,short}.weight divisor tensors."""
+    w = W.GGUFWriter(path)
+    _base_meta(w, "phi3", cfg)
+    w.add_meta("phi3.attention.layer_norm_rms_epsilon",
+               float(cfg.rms_norm_eps))
+    if orig_ctx:
+        # real conversions declare the type too — the loader must accept
+        # (not reject) the "longrope" string and route to the tensors
+        w.add_meta("phi3.rope.scaling.type", "longrope")
+        w.add_meta("phi3.rope.scaling.original_context_length", orig_ctx)
+    w.add_tensor_f32("token_embd.weight", sd["model.embed_tokens.weight"])
+    w.add_tensor_f32("output_norm.weight", sd["model.norm.weight"])
+    w.add_tensor_f32("output.weight", sd["lm_head.weight"])
+    if long_factor is not None:
+        w.add_tensor_f32("rope_factors_long.weight",
+                         np.asarray(long_factor, np.float32))
+        w.add_tensor_f32("rope_factors_short.weight",
+                         np.asarray(short_factor, np.float32))
+    for i in range(cfg.num_hidden_layers):
+        p, b = f"model.layers.{i}.", f"blk.{i}."
+        w.add_tensor_f32(b + "attn_norm.weight",
+                         sd[p + "input_layernorm.weight"])
+        w.add_tensor_f32(b + "attn_qkv.weight",
+                         sd[p + "self_attn.qkv_proj.weight"])
+        w.add_tensor_f32(b + "attn_output.weight",
+                         sd[p + "self_attn.o_proj.weight"])
+        w.add_tensor_f32(b + "ffn_norm.weight",
+                         sd[p + "post_attention_layernorm.weight"])
+        w.add_tensor_f32(b + "ffn_up.weight",
+                         sd[p + "mlp.gate_up_proj.weight"])
+        w.add_tensor_f32(b + "ffn_down.weight",
+                         sd[p + "mlp.down_proj.weight"])
+    w.write()
+
+
+def test_phi3_fused_qkv_gate_up(tmp_path):
+    """phi3 fused qkv + gate_up source tensors, at GQA shapes (kv < q —
+    phi3:14b/medium): the transcoder's UNEQUAL split offsets must
+    reproduce transformers Phi3 logits (the longrope test covers the
+    mini-style MHA split)."""
+    cfg = transformers.Phi3Config(
+        vocab_size=256, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rope_theta=10000.0,
+        pad_token_id=0, attn_implementation="eager")
+    torch.manual_seed(5)
+    model = transformers.Phi3ForCausalLM(cfg).eval()
+    path = str(tmp_path / "phi3.gguf")
+    _write_phi3(path, cfg, _sd(model))
+    _check(path, model)
+
+
+def test_phi3_longrope_past_original_window(tmp_path):
+    """phi3 longrope: the long-factor divisors + the magnitude factor
+    sqrt(1 + ln(ctx/orig)/ln(orig)) must match transformers Phi3 on a
+    sequence past the ORIGINAL window (transformers selects factors per
+    forward length; llama.cpp — and we — select statically by the
+    serving context, so parity holds exactly in the extended regime the
+    128k tags serve)."""
+    rng = np.random.default_rng(11)
+    half = 8                                        # head_dim 16
+    long_f = (1.0 + rng.random(half) * 3.0).astype(np.float32)
+    short_f = np.ones(half, np.float32)
+    cfg = transformers.Phi3Config(
+        vocab_size=256, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
+        max_position_embeddings=128,
+        original_max_position_embeddings=8,
+        rope_scaling={"type": "longrope",
+                      "long_factor": [float(x) for x in long_f],
+                      "short_factor": [float(x) for x in short_f]},
+        rope_theta=10000.0, pad_token_id=0, attn_implementation="eager")
+    torch.manual_seed(7)
+    model = transformers.Phi3ForCausalLM(cfg).eval()
+    path = str(tmp_path / "phi3lr.gguf")
+    _write_phi3(path, cfg, _sd(model), long_factor=long_f,
+                short_factor=short_f, orig_ctx=8)
+    # IDS is 12 tokens > the 8-token original window: transformers picks
+    # the long factors for the whole forward, matching the static choice
+    _check(path, model)

@@ -132,8 +132,8 @@ def test_presets_llama31_32_scaled():
 # GGUF metadata plumbing
 # ---------------------------------------------------------------------------
 
-def _tiny_gguf(tmp_path, extra_meta=(), extra_tensors=()):
-    path = str(tmp_path / "m.gguf")
+def _tiny_gguf(tmp_path, extra_meta=(), extra_tensors=(), name="m.gguf"):
+    path = str(tmp_path / name)
     w = W.GGUFWriter(path)
     w.add_meta("general.architecture", "llama")
     w.add_meta("llama.block_count", 1)
@@ -203,10 +203,19 @@ def test_gguf_rope_freqs_tensor(tmp_path):
 
 
 def test_gguf_unsupported_scaling_type_fails_loudly(tmp_path):
+    # a genuinely unknown scheme is rejected outright
     path = _tiny_gguf(tmp_path, extra_meta=[
-        ("llama.rope.scaling.type", "longrope")])
+        ("llama.rope.scaling.type", "ntk-parts-v9")])
     with GGUFFile(path) as f:
         with pytest.raises(NotImplementedError):
+            config_from_gguf(f)
+    # longrope is supported (phi3 family, round 5) but ONLY via its
+    # rope_factors_* tensors — declaring the type without them must fail
+    # loudly, not serve unscaled rope
+    path = _tiny_gguf(tmp_path, extra_meta=[
+        ("llama.rope.scaling.type", "longrope")], name="lr.gguf")
+    with GGUFFile(path) as f:
+        with pytest.raises(ValueError, match="rope_factors"):
             config_from_gguf(f)
 
 
