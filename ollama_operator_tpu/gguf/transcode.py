@@ -202,6 +202,15 @@ def config_from_gguf(f: GGUFFile) -> ModelConfig:
                           mlp_type="plain", act="gelu_tanh",
                           parallel_block=True, attn_bias=True, out_bias=True,
                           rotary_pct=rot / head_dim, **base)
+    elif arch == "starcoder2":
+        # starcoder2 3/7/15B: sequential pre-LN block (NOT phi2's
+        # parallel one), LayerNorm + biases everywhere, plain
+        # gelu-tanh MLP, full NEOX rotary, sliding-window attention
+        base["norm_eps"] = float(f.field("attention.layer_norm_epsilon",
+                                         1e-5))
+        cfg = ModelConfig(arch="llama", norm_type="layernorm",
+                          mlp_type="plain", act="gelu_tanh",
+                          attn_bias=True, out_bias=True, **base)
     else:
         raise NotImplementedError(f"unsupported GGUF architecture {arch!r}")
     if not cfg.tie_embeddings and "output.weight" not in f.tensors:
@@ -567,4 +576,86 @@ def load_vision_params(f: GGUFFile, vcfg=None,
         layers["w_down"] = stackv("v.blk.{}.ffn_up.weight", T_)
         layers["b_down"] = stackv("v.blk.{}.ffn_up.bias")
     params["layers"] = layers
+    return params
+
+
+# ---------------------------------------------------------------------------
+# encoder (embedding) models — BERT family
+# ---------------------------------------------------------------------------
+
+ENCODER_ARCHES = ("bert",)
+
+
+def is_encoder_arch(arch: str) -> bool:
+    """True for embedding-only architectures (served without an Engine —
+    runtime/service.EmbeddingModel; the reference serves these images
+    through llama.cpp's BERT path in the delegated container)."""
+    return arch in ENCODER_ARCHES
+
+
+def encoder_config_from_gguf(f: GGUFFile):
+    """'<arch>.*' metadata → models.encoder.EncoderConfig (bert family)."""
+    from ..models.encoder import EncoderConfig
+    pooling = int(f.field("pooling_type", 1) or 1)
+    if pooling not in (1, 2):
+        # 1 = mean, 2 = CLS (bge-*); anything else (none/last/rank) has
+        # no honest fallback — wrong pooling is silently wrong embeddings
+        raise NotImplementedError(
+            f"unsupported bert pooling_type {pooling} (mean=1 and cls=2 "
+            f"are implemented)")
+    return EncoderConfig(
+        vocab_size=len(f.metadata["tokenizer.ggml.tokens"]),
+        dim=int(f.field("embedding_length")),
+        n_layers=int(f.field("block_count")),
+        n_heads=int(f.field("attention.head_count")),
+        ffn_dim=int(f.field("feed_forward_length")),
+        max_seq_len=int(f.field("context_length", 512)),
+        norm_eps=float(f.field("attention.layer_norm_epsilon", 1e-12)),
+        pooling={1: "mean", 2: "cls"}[pooling],
+        arch=f.arch)
+
+
+def load_encoder_params(f: GGUFFile, cfg=None,
+                        dtype=np.float32) -> Dict[str, Any]:
+    """BERT tensor names (llama.cpp layout: attn_output_norm = post-attn
+    LN, layer_output_norm = post-FFN LN) → models.encoder param tree."""
+    cfg = cfg or encoder_config_from_gguf(f)
+    L = cfg.n_layers
+
+    def cast(a):
+        return np.ascontiguousarray(a, dtype=dtype)
+
+    def stack(fmt: str, post=None):
+        arrs = []
+        for i in range(L):
+            a = _dq(f, fmt.format(i))
+            arrs.append(cast(post(a) if post else a))
+        return np.stack(arrs)
+
+    T_ = lambda a: a.T  # noqa: E731
+    params: Dict[str, Any] = {
+        "tok_emb": cast(_dq(f, "token_embd.weight")),
+        "pos_emb": cast(_dq(f, "position_embd.weight")),
+        "type_emb": cast(_dq(f, "token_types.weight")),
+        "emb_norm_w": cast(_dq(f, "token_embd_norm.weight")),
+        "emb_norm_b": cast(_dq(f, "token_embd_norm.bias")),
+        "layers": {
+            "wq": stack("blk.{}.attn_q.weight", T_),
+            "bq": stack("blk.{}.attn_q.bias"),
+            "wk": stack("blk.{}.attn_k.weight", T_),
+            "bk": stack("blk.{}.attn_k.bias"),
+            "wv": stack("blk.{}.attn_v.weight", T_),
+            "bv": stack("blk.{}.attn_v.bias"),
+            "wo": stack("blk.{}.attn_output.weight", T_),
+            "bo": stack("blk.{}.attn_output.bias"),
+            "attn_norm_w": stack("blk.{}.attn_output_norm.weight"),
+            "attn_norm_b": stack("blk.{}.attn_output_norm.bias"),
+            "w_up": stack("blk.{}.ffn_up.weight", T_),
+            "b_up": stack("blk.{}.ffn_up.bias"),
+            "w_down": stack("blk.{}.ffn_down.weight", T_),
+            "b_down": stack("blk.{}.ffn_down.bias"),
+            "ffn_norm_w": stack("blk.{}.layer_output_norm.weight"),
+            "ffn_norm_b": stack("blk.{}.layer_output_norm.bias"),
+        },
+    }
     return params

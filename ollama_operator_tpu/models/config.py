@@ -168,6 +168,15 @@ PRESETS = {
     "phi3": _mk(arch="llama", vocab_size=32064, dim=3072, n_layers=32,
                 n_heads=32, n_kv_heads=32, head_dim=96, ffn_dim=8192,
                 max_seq_len=4096, sliding_window=2047),
+    # starcoder2-3b (the ollama `starcoder2` default tag): LayerNorm +
+    # biases, plain gelu MLP, GQA 12:1, sliding window
+    "starcoder2": _mk(arch="llama", vocab_size=49152, dim=3072,
+                      n_layers=30, n_heads=24, n_kv_heads=2, head_dim=128,
+                      ffn_dim=12288, norm_type="layernorm",
+                      mlp_type="plain", act="gelu_tanh", attn_bias=True,
+                      out_bias=True, tie_embeddings=True,
+                      max_seq_len=16384, sliding_window=4096,
+                      rope_theta=999999.0),
     "llama2": _mk(arch="llama", vocab_size=32000, dim=4096, n_layers=32,
                   n_heads=32, n_kv_heads=32, head_dim=128, ffn_dim=11008,
                   max_seq_len=4096),

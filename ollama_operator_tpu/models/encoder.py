@@ -1,0 +1,166 @@
+"""BERT-family text encoder (embedding models: all-minilm, bge-*, …).
+
+The reference serves embedding images (ollama's `all-minilm`,
+`mxbai-embed-large`, …) through llama.cpp's BERT implementation inside the
+delegated container (/root/reference/pkg/model/pod.go:11); this is the
+TPU-native equivalent. Architecture (classic BERT, post-LayerNorm):
+
+    x = LN(tok_emb[ids] + pos_emb[0..T) + type_emb[0])
+    L x [ x = LN(x + MHA_bidir(x));  x = LN(x + gelu-MLP(x)) ]
+    embed = mean-pool over valid tokens  (bert.pooling_type = 1)
+
+Everything is one jitted forward over a padded [B, T] batch with a
+[B, T] validity mask — bidirectional attention (no causal mask), so
+there is no KV cache, no scheduler, no decode loop: an embedding model
+loads as runtime/service.EmbeddingModel, not as an Engine.
+
+Weight layout follows the llama.cpp conversion (token_embd /
+position_embd / token_types / token_embd_norm; per block attn_{q,k,v},
+attn_output, attn_output_norm, ffn_up, ffn_down, layer_output_norm —
+all with biases).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class EncoderConfig:
+    vocab_size: int
+    dim: int
+    n_layers: int
+    n_heads: int
+    ffn_dim: int
+    max_seq_len: int = 512          # learned position table size
+    n_token_types: int = 2
+    norm_eps: float = 1e-12
+    pooling: str = "mean"           # bert.pooling_type 1 = mean
+    arch: str = "bert"
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @property
+    def n_params(self) -> int:
+        D, F, L, V = self.dim, self.ffn_dim, self.n_layers, self.vocab_size
+        per_layer = 4 * D * D + 2 * D * F
+        return V * D + self.max_seq_len * D + L * per_layer
+
+
+def init_params(cfg: EncoderConfig, key, dtype=jnp.float32) -> Dict[str, Any]:
+    """Random params in the transcoded layout (tests / benches)."""
+    D, F, L = cfg.dim, cfg.ffn_dim, cfg.n_layers
+    ks = jax.random.split(key, 8)
+    g = lambda k, sh: (jax.random.normal(k, sh, jnp.float32) * 0.02  # noqa
+                       ).astype(dtype)
+    layers = {
+        "wq": g(ks[0], (L, D, D)), "wk": g(ks[1], (L, D, D)),
+        "wv": g(ks[2], (L, D, D)), "wo": g(ks[3], (L, D, D)),
+        "bq": jnp.zeros((L, D), dtype), "bk": jnp.zeros((L, D), dtype),
+        "bv": jnp.zeros((L, D), dtype), "bo": jnp.zeros((L, D), dtype),
+        "attn_norm_w": jnp.ones((L, D), dtype),
+        "attn_norm_b": jnp.zeros((L, D), dtype),
+        "w_up": g(ks[4], (L, D, F)), "b_up": jnp.zeros((L, F), dtype),
+        "w_down": g(ks[5], (L, F, D)), "b_down": jnp.zeros((L, D), dtype),
+        "ffn_norm_w": jnp.ones((L, D), dtype),
+        "ffn_norm_b": jnp.zeros((L, D), dtype),
+    }
+    return {
+        "tok_emb": g(ks[6], (cfg.vocab_size, D)),
+        "pos_emb": g(ks[7], (cfg.max_seq_len, D)),
+        "type_emb": jnp.zeros((cfg.n_token_types, D), dtype),
+        "emb_norm_w": jnp.ones((D,), dtype),
+        "emb_norm_b": jnp.zeros((D,), dtype),
+        "layers": layers,
+    }
+
+
+def _ln(x, w, b, eps):
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * w + b
+
+
+def forward(params: Dict[str, Any], cfg: EncoderConfig, tokens: jnp.ndarray,
+            n_valid: jnp.ndarray) -> jnp.ndarray:
+    """tokens [B, T] int32 (zero-padded), n_valid [B] int32 →
+    pooled embeddings [B, D] float32 (mean over valid positions)."""
+    B, T = tokens.shape
+    D, H, hd = cfg.dim, cfg.n_heads, cfg.head_dim
+    eps = cfg.norm_eps
+    valid = (jnp.arange(T, dtype=jnp.int32)[None, :]
+             < n_valid[:, None])                       # [B, T]
+
+    x = (params["tok_emb"][tokens]
+         + params["pos_emb"][None, :T, :]
+         + params["type_emb"][0][None, None, :])
+    x = _ln(x.astype(jnp.float32), params["emb_norm_w"],
+            params["emb_norm_b"], eps)
+
+    # padding mask: every query may attend every VALID key (bidirectional)
+    bias = jnp.where(valid[:, None, None, :], 0.0, -1e30)  # [B,1,1,T]
+    scale = 1.0 / math.sqrt(hd)
+
+    def body(x, lp):
+        q = (x @ lp["wq"] + lp["bq"]).reshape(B, T, H, hd)
+        k = (x @ lp["wk"] + lp["bk"]).reshape(B, T, H, hd)
+        v = (x @ lp["wv"] + lp["bv"]).reshape(B, T, H, hd)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale + bias
+        p = jax.nn.softmax(s, axis=-1)
+        a = jnp.einsum("bhqk,bkhd->bqhd", p, v).reshape(B, T, D)
+        a = a @ lp["wo"] + lp["bo"]
+        x = _ln(x + a, lp["attn_norm_w"], lp["attn_norm_b"], eps)
+        f = jax.nn.gelu(x @ lp["w_up"] + lp["b_up"], approximate=False)
+        f = f @ lp["w_down"] + lp["b_down"]
+        x = _ln(x + f, lp["ffn_norm_w"], lp["ffn_norm_b"], eps)
+        return x, None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    if cfg.pooling == "cls":
+        # bge-family: the [CLS] position's final hidden state
+        return x[:, 0, :]
+    # mean pooling over valid tokens (pad positions contribute zero)
+    m = valid.astype(jnp.float32)[:, :, None]
+    pooled = jnp.sum(x * m, axis=1) / jnp.maximum(
+        jnp.sum(m, axis=1), 1.0)
+    return pooled
+
+
+_forward_jit = jax.jit(forward, static_argnames=("cfg",))
+
+
+def _bucket(n: int, lo: int, hi: int) -> int:
+    """Smallest power-of-two >= n in [lo, hi] (clamped)."""
+    b = lo
+    while b < n and b < hi:
+        b *= 2
+    return min(b, hi)
+
+
+def embed_batch(params, cfg: EncoderConfig, token_lists) -> np.ndarray:
+    """Pad a list of token-id lists and run ONE jitted forward. Batch and
+    length pad to power-of-two buckets so the compiled-program count
+    stays O(log B x log T) under mixed traffic (same policy as the
+    decoder embed path), not one program per exact shape. Returns [N, D]
+    float32 (unnormalized — callers normalize per API contract)."""
+    n = len(token_lists)
+    t_max = max((len(t) for t in token_lists), default=1)
+    T = _bucket(max(1, t_max), 16, cfg.max_seq_len)
+    B = _bucket(max(1, n), 1, 1 << 20)
+    toks = np.zeros((B, T), np.int32)
+    lens = np.zeros((B,), np.int32)
+    for i, ids in enumerate(token_lists):
+        ids = list(ids)[:T]
+        toks[i, :len(ids)] = ids
+        lens[i] = len(ids)
+    out = _forward_jit(params, cfg=cfg, tokens=jnp.asarray(toks),
+                       n_valid=jnp.asarray(lens))
+    return np.asarray(out[:n], np.float32)

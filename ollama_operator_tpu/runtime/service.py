@@ -589,3 +589,67 @@ class LoadedModel:
         METRICS.remove_gauge("tpu_model_queue_depth")
         if self.engine.paged:
             METRICS.remove_gauge("tpu_model_kv_free_pages")
+
+
+class _IdleScheduler:
+    """Scheduler facade for embedding-only models: always quiet, never
+    broken — the manager's keep-alive reaper and load-health checks read
+    these fields (n_active, _waiting, finished, broken) on every
+    resident model."""
+    n_active = 0
+    broken = False
+    n_preemptions = 0
+    finished = ()      # reaper: no completed generations to re-arm from
+
+    class _EmptyQ:
+        @staticmethod
+        def empty():
+            return True
+
+        @staticmethod
+        def qsize():
+            return 0
+
+    _waiting = _EmptyQ()
+
+    def shutdown(self):
+        pass
+
+
+class EmbeddingModel:
+    """A resident encoder (BERT-family) model: tokenizer + ONE jitted
+    bidirectional forward, no Engine/KV-cache/decode loop. Serves
+    /api/embed, /api/embeddings, and /v1/embeddings; generation routes
+    reject with a clear 400 (matching how the reference's embedding
+    images behave — llama.cpp refuses generation on encoder archs)."""
+
+    def __init__(self, name: str, cfg, params, tokenizer,
+                 digest: str = ""):
+        import jax.numpy as jnp
+        self.name = name
+        self.cfg = cfg
+        self.digest = digest
+        self.tokenizer = tokenizer
+        self.loaded_at = time.time()
+        self.params = jax.tree_util.tree_map(jnp.asarray, params)
+        self.scheduler = _IdleScheduler()
+        self.is_encoder = True
+        self._lock = threading.Lock()
+
+    def embed(self, texts) -> np.ndarray:
+        from ..models import encoder as E
+        ids = [self.tokenizer.encode(t) for t in texts]
+        with self._lock:   # jit cache + single-chip dispatch serialization
+            return E.embed_batch(self.params, self.cfg, ids)
+
+    # -- generation surface: honest rejection --------------------------
+    def _reject(self, *_a, **_kw):
+        from ..server.app import ApiError
+        raise ApiError(400, f"{self.name!r} is an embedding model "
+                            f"(arch {self.cfg.arch}); it does not support "
+                            f"generation — use /api/embed")
+
+    generate = generate_stream = render_chat = render_prompt = _reject
+
+    def unload(self):
+        self.params = None

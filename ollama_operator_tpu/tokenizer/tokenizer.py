@@ -86,6 +86,17 @@ class Tokenizer:
             merges = merges or []
             self._ranks = {tuple(m.split(" ", 1)): r
                            for r, m in enumerate(merges)}
+        if model == "bert":
+            # WordPiece (embedding models: all-minilm & friends). Uncased
+            # checkpoints ship all-lowercase vocabs — detect once so
+            # encode() lowercases to match (llama.cpp reads the same
+            # signal from the vocab rather than a metadata flag)
+            self._wp_lower = not any(
+                any(ch.isalpha() and ch.isupper() for ch in t)
+                for t in self.tokens
+                if not (t.startswith("[") and t.endswith("]")))
+            self._unk_id = next(
+                (i for i, t in enumerate(self.tokens) if t == "[UNK]"), 0)
         self._byte_ids = {}
         for i, t in enumerate(self.tokens):
             if self.token_types[i] == TT_BYTE and len(t) == 6:  # <0xXX>
@@ -101,6 +112,17 @@ class Tokenizer:
         tokens = md["tokenizer.ggml.tokens"]
         bos = md.get("tokenizer.ggml.bos_token_id", -1)
         eos = md.get("tokenizer.ggml.eos_token_id", -1)
+        if model == "bert":
+            # BERT frames sequences as [CLS] … [SEP]; conversions carry
+            # cls/seperator ids (llama.cpp's spelling) instead of bos/eos
+            bos = md.get("tokenizer.ggml.cls_token_id", bos)
+            eos = md.get("tokenizer.ggml.seperator_token_id",
+                         md.get("tokenizer.ggml.separator_token_id", eos))
+            return cls(model=model, tokens=tokens,
+                       token_types=md.get("tokenizer.ggml.token_type"),
+                       bos_id=bos, eos_id=eos,
+                       add_bos=md.get("tokenizer.ggml.add_bos_token", True),
+                       add_eos=md.get("tokenizer.ggml.add_eos_token", True))
         extra = set()
         for key in ("tokenizer.ggml.eot_token_id",
                     "tokenizer.ggml.eom_token_id"):
@@ -155,11 +177,73 @@ class Tokenizer:
                 continue
             if self.model == "gpt2":
                 ids.extend(self._encode_bpe(c))
+            elif self.model == "bert":
+                ids.extend(self._encode_wpm(c))
             else:
                 ids.extend(self._encode_spm(c, first_text))
             first_text = False
         if self.add_eos and self.eos_id >= 0:
             ids.append(self.eos_id)
+        return ids
+
+    # -- WordPiece (bert embedding models) -----------------------------
+    def _encode_wpm(self, text: str) -> List[int]:
+        """BERT WordPiece: basic-clean + (uncased) lowercase/strip-accents
+        normalization, whitespace + punctuation pre-split, then greedy
+        longest-prefix matching with ##-continuations; a word with no
+        full cover collapses to [UNK] (canonical WordPiece semantics)."""
+        import unicodedata
+        if getattr(self, "_wp_lower", False):
+            text = text.lower()
+            text = "".join(ch for ch in unicodedata.normalize("NFD", text)
+                           if unicodedata.category(ch) != "Mn")
+
+        def is_punct(ch):
+            return (unicodedata.category(ch).startswith("P")
+                    or (33 <= ord(ch) <= 47) or (58 <= ord(ch) <= 64)
+                    or (91 <= ord(ch) <= 96) or (123 <= ord(ch) <= 126))
+
+        words: List[str] = []
+        buf = []
+        for ch in text:
+            if ch.isspace():
+                if buf:
+                    words.append("".join(buf))
+                    buf = []
+            elif is_punct(ch) or 0x4E00 <= ord(ch) <= 0x9FFF:
+                # punctuation and CJK split to single-char words
+                if buf:
+                    words.append("".join(buf))
+                    buf = []
+                words.append(ch)
+            else:
+                buf.append(ch)
+        if buf:
+            words.append("".join(buf))
+
+        ids: List[int] = []
+        for word in words:
+            if len(word) > 100:
+                ids.append(self._unk_id)
+                continue
+            out, start, ok = [], 0, True
+            while start < len(word):
+                end = len(word)
+                piece_id = None
+                while end > start:
+                    sub = word[start:end]
+                    if start > 0:
+                        sub = "##" + sub
+                    if sub in self.vocab:
+                        piece_id = self.vocab[sub]
+                        break
+                    end -= 1
+                if piece_id is None:
+                    ok = False
+                    break
+                out.append(piece_id)
+                start = end
+            ids.extend(out if ok else [self._unk_id])
         return ids
 
     # -- SPM (llama) ---------------------------------------------------

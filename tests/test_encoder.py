@@ -1,0 +1,248 @@
+"""Embedding (encoder) models: BERT-family forward + WordPiece tokenizer
++ the embedding-only serving route.
+
+The reference serves embedding images (ollama `all-minilm`,
+`mxbai-embed-large`, …) via llama.cpp's BERT path in the delegated
+container; this tier pins our encoder against transformers BertModel on
+identical weights, the WordPiece encoder against BertTokenizer, and the
+server contract (embed works, generate 400s) over real sockets.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ollama_operator_tpu.gguf import writer as W
+from ollama_operator_tpu.gguf.reader import GGUFFile
+from ollama_operator_tpu.gguf.transcode import (encoder_config_from_gguf,
+                                                is_encoder_arch,
+                                                load_encoder_params)
+from ollama_operator_tpu.models import encoder as E
+from ollama_operator_tpu.tokenizer import Tokenizer
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+# ---------------------------------------------------------------------------
+# synthetic BERT GGUF (llama.cpp conversion layout)
+# ---------------------------------------------------------------------------
+
+VOCAB = (["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"]
+         + ["the", "sky", "is", "blue", "why", "deep",
+            "##s", "##ing", "##ed", "un", "##believ", "##able",
+            "hello", "world", ",", ".", "!", "a", "b", "c"]
+         + [f"w{i}" for i in range(7)])      # 32 pieces
+
+
+def _write_bert(path, hf_cfg, sd, pooling=1):
+    w = W.GGUFWriter(path)
+    w.add_meta("general.architecture", "bert")
+    w.add_meta("bert.block_count", hf_cfg.num_hidden_layers)
+    w.add_meta("bert.embedding_length", hf_cfg.hidden_size)
+    w.add_meta("bert.attention.head_count", hf_cfg.num_attention_heads)
+    w.add_meta("bert.feed_forward_length", hf_cfg.intermediate_size)
+    w.add_meta("bert.context_length", hf_cfg.max_position_embeddings)
+    w.add_meta("bert.attention.layer_norm_epsilon",
+               float(hf_cfg.layer_norm_eps))
+    w.add_meta("bert.pooling_type", pooling)  # 1=mean, 2=cls
+    w.add_meta("tokenizer.ggml.model", "bert")
+    w.add_meta("tokenizer.ggml.tokens", VOCAB)
+    w.add_meta("tokenizer.ggml.token_type", [1] * len(VOCAB))
+    w.add_meta("tokenizer.ggml.cls_token_id", 2)
+    w.add_meta("tokenizer.ggml.seperator_token_id", 3)
+    w.add_meta("tokenizer.ggml.unknown_token_id", 1)
+    w.add_tensor_f32("token_embd.weight",
+                     sd["embeddings.word_embeddings.weight"])
+    w.add_tensor_f32("position_embd.weight",
+                     sd["embeddings.position_embeddings.weight"])
+    w.add_tensor_f32("token_types.weight",
+                     sd["embeddings.token_type_embeddings.weight"])
+    w.add_tensor_f32("token_embd_norm.weight",
+                     sd["embeddings.LayerNorm.weight"])
+    w.add_tensor_f32("token_embd_norm.bias", sd["embeddings.LayerNorm.bias"])
+    for i in range(hf_cfg.num_hidden_layers):
+        p, b = f"encoder.layer.{i}.", f"blk.{i}."
+        w.add_tensor_f32(b + "attn_q.weight",
+                         sd[p + "attention.self.query.weight"])
+        w.add_tensor_f32(b + "attn_q.bias",
+                         sd[p + "attention.self.query.bias"])
+        w.add_tensor_f32(b + "attn_k.weight",
+                         sd[p + "attention.self.key.weight"])
+        w.add_tensor_f32(b + "attn_k.bias",
+                         sd[p + "attention.self.key.bias"])
+        w.add_tensor_f32(b + "attn_v.weight",
+                         sd[p + "attention.self.value.weight"])
+        w.add_tensor_f32(b + "attn_v.bias",
+                         sd[p + "attention.self.value.bias"])
+        w.add_tensor_f32(b + "attn_output.weight",
+                         sd[p + "attention.output.dense.weight"])
+        w.add_tensor_f32(b + "attn_output.bias",
+                         sd[p + "attention.output.dense.bias"])
+        w.add_tensor_f32(b + "attn_output_norm.weight",
+                         sd[p + "attention.output.LayerNorm.weight"])
+        w.add_tensor_f32(b + "attn_output_norm.bias",
+                         sd[p + "attention.output.LayerNorm.bias"])
+        w.add_tensor_f32(b + "ffn_up.weight",
+                         sd[p + "intermediate.dense.weight"])
+        w.add_tensor_f32(b + "ffn_up.bias", sd[p + "intermediate.dense.bias"])
+        w.add_tensor_f32(b + "ffn_down.weight", sd[p + "output.dense.weight"])
+        w.add_tensor_f32(b + "ffn_down.bias", sd[p + "output.dense.bias"])
+        w.add_tensor_f32(b + "layer_output_norm.weight",
+                         sd[p + "output.LayerNorm.weight"])
+        w.add_tensor_f32(b + "layer_output_norm.bias",
+                         sd[p + "output.LayerNorm.bias"])
+    w.write()
+
+
+def _tiny_bert():
+    cfg = transformers.BertConfig(
+        vocab_size=len(VOCAB), hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=48,
+        max_position_embeddings=64, pad_token_id=0,
+        hidden_act="gelu", attn_implementation="eager")
+    torch.manual_seed(13)
+    return cfg, transformers.BertModel(cfg).eval()
+
+
+def test_bert_forward_matches_transformers(tmp_path):
+    """GGUF→transcode→encoder forward must reproduce transformers
+    BertModel last_hidden_state mean-pooling, including padded rows of a
+    mixed-length batch (bidirectional padding mask)."""
+    hf_cfg, model = _tiny_bert()
+    sd = {k: v.detach().numpy().astype(np.float32)
+          for k, v in model.state_dict().items()}
+    path = str(tmp_path / "bert.gguf")
+    _write_bert(path, hf_cfg, sd)
+    with GGUFFile(path) as f:
+        assert is_encoder_arch(f.arch)
+        cfg = encoder_config_from_gguf(f)
+        params = load_encoder_params(f, cfg)
+    assert cfg.n_layers == 2 and cfg.pooling == "mean"
+
+    batch = [[2, 5, 6, 7, 8, 3],            # [CLS] the sky is blue [SEP]
+             [2, 17, 18, 3]]                 # [CLS] hello world [SEP]
+    got = E.embed_batch(jax.tree_util.tree_map(jnp.asarray, params),
+                        cfg, batch)
+
+    T = max(len(b) for b in batch)
+    ids = torch.zeros((2, T), dtype=torch.long)
+    mask = torch.zeros((2, T), dtype=torch.long)
+    for i, b in enumerate(batch):
+        ids[i, :len(b)] = torch.tensor(b)
+        mask[i, :len(b)] = 1
+    with torch.no_grad():
+        hs = model(input_ids=ids, attention_mask=mask).last_hidden_state
+    m = mask[:, :, None].float()
+    ref = (hs * m).sum(1) / m.sum(1)
+    np.testing.assert_allclose(got, ref.numpy(), rtol=2e-4, atol=2e-4)
+
+
+def test_bert_cls_pooling(tmp_path):
+    """bge-family GGUFs carry pooling_type=2 (CLS): the embedding must be
+    the [CLS] position's final hidden state, not the mean."""
+    hf_cfg, model = _tiny_bert()
+    sd = {k: v.detach().numpy().astype(np.float32)
+          for k, v in model.state_dict().items()}
+    path = str(tmp_path / "bge.gguf")
+    _write_bert(path, hf_cfg, sd, pooling=2)
+    with GGUFFile(path) as f:
+        cfg = encoder_config_from_gguf(f)
+        params = load_encoder_params(f, cfg)
+    assert cfg.pooling == "cls"
+    batch = [[2, 5, 6, 7, 8, 3]]
+    got = E.embed_batch(jax.tree_util.tree_map(jnp.asarray, params),
+                        cfg, batch)
+    ids = torch.tensor(batch)
+    with torch.no_grad():
+        hs = model(input_ids=ids).last_hidden_state
+    np.testing.assert_allclose(got, hs[:, 0, :].numpy(),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_wordpiece_matches_bert_tokenizer(tmp_path):
+    """WordPiece encode (lowercase, punctuation split, ##-continuations,
+    [UNK] collapse) must match transformers BertTokenizer on the same
+    vocab."""
+    vf = tmp_path / "vocab.txt"
+    vf.write_text("\n".join(VOCAB) + "\n")
+    ref_tok = transformers.BertTokenizer(str(vf), do_lower_case=True)
+    tok = Tokenizer.from_gguf_metadata({
+        "tokenizer.ggml.model": "bert",
+        "tokenizer.ggml.tokens": VOCAB,
+        "tokenizer.ggml.token_type": [1] * len(VOCAB),
+        "tokenizer.ggml.cls_token_id": 2,
+        "tokenizer.ggml.seperator_token_id": 3,
+        "tokenizer.ggml.unknown_token_id": 1,
+    })
+    for text in ("the sky is blue", "Why is the sky blue!",
+                 "unbelievable skies", "hello, world.",
+                 "zzz the qqq", "skying skied skies", ""):
+        got = tok.encode(text)
+        ref = ref_tok.encode(text)
+        assert got == ref, (text, got, ref,
+                            ref_tok.convert_ids_to_tokens(ref))
+
+
+def test_embedding_model_serves_and_rejects_generate(tmp_path):
+    """Server contract over real sockets: pull an embedding image →
+    /api/embed, /api/embeddings, /v1/embeddings work; /api/generate
+    rejects with 400 (embedding-only), /api/ps lists it."""
+    import sys
+    import os
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from fake_registry import FakeRegistry
+
+    from ollama_operator_tpu.server.app import ModelManager, serve
+
+    hf_cfg, model = _tiny_bert()
+    sd = {k: v.detach().numpy().astype(np.float32)
+          for k, v in model.state_dict().items()}
+    path = str(tmp_path / "minilm.gguf")
+    _write_bert(path, hf_cfg, sd)
+    reg = FakeRegistry()
+    url = reg.start()
+    reg.add_model("library", "all-minilm", "latest",
+                  open(path, "rb").read())
+    manager = ModelManager(str(tmp_path / "store"))
+    httpd = serve(manager, "127.0.0.1", 0)
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    ref = f"http://{url.split('://')[1]}/library/all-minilm:latest"
+
+    def post(p, d):
+        return json.loads(urllib.request.urlopen(urllib.request.Request(
+            base + p, data=json.dumps(d).encode(),
+            headers={"Content-Type": "application/json"}),
+            timeout=120).read())
+
+    try:
+        post("/api/pull", {"model": ref, "stream": False})
+        r = post("/api/embed", {"model": ref,
+                                "input": ["the sky is blue", "hello world"]})
+        assert len(r["embeddings"]) == 2
+        assert len(r["embeddings"][0]) == hf_cfg.hidden_size
+        # distinct inputs → distinct embeddings
+        assert r["embeddings"][0] != r["embeddings"][1]
+        r1 = post("/api/embeddings", {"model": ref, "prompt": "the sky"})
+        assert len(r1["embedding"]) == hf_cfg.hidden_size
+        r2 = post("/v1/embeddings", {"model": ref, "input": "the sky"})
+        assert r2["data"][0]["embedding"]
+        ps = json.loads(urllib.request.urlopen(base + "/api/ps",
+                                               timeout=30).read())
+        det = ps["models"][0]["details"]
+        assert det["family"] == "bert" and det["paged"] is False
+        try:
+            post("/api/generate", {"model": ref, "prompt": "hi",
+                                   "stream": False})
+            assert False, "generate on an embedding model must 400"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+    finally:
+        httpd.shutdown()
+        reg.stop()

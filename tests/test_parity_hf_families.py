@@ -680,3 +680,52 @@ def test_phi3_longrope_past_original_window(tmp_path):
     # IDS is 12 tokens > the 8-token original window: transformers picks
     # the long factors for the whole forward, matching the static choice
     _check(path, model)
+
+
+def test_starcoder2_layernorm_bias_plain_mlp(tmp_path):
+    """starcoder2 (3/7/15B): sequential pre-LN block with LayerNorm +
+    biases everywhere, plain gelu-tanh MLP (c_fc/c_proj), tied
+    embeddings, NEOX rotary — our transcode+forward must reproduce
+    transformers Starcoder2 logits."""
+    cfg = transformers.Starcoder2Config(
+        vocab_size=256, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rope_theta=10000.0,
+        sliding_window=None, attn_implementation="eager")
+    torch.manual_seed(9)
+    model = transformers.Starcoder2ForCausalLM(cfg).eval()
+    sd = _sd(model)
+    path = str(tmp_path / "sc2.gguf")
+    w = W.GGUFWriter(path)
+    _base_meta(w, "starcoder2", cfg)
+    w.add_meta("starcoder2.attention.layer_norm_epsilon",
+               float(cfg.norm_epsilon))
+    w.add_tensor_f32("token_embd.weight", sd["model.embed_tokens.weight"])
+    w.add_tensor_f32("output_norm.weight", sd["model.norm.weight"])
+    w.add_tensor_f32("output_norm.bias", sd["model.norm.bias"])
+    # tied head: no output.weight tensor (llama.cpp falls back to embd)
+    for i in range(cfg.num_hidden_layers):
+        p, b = f"model.layers.{i}.", f"blk.{i}."
+        w.add_tensor_f32(b + "attn_norm.weight",
+                         sd[p + "input_layernorm.weight"])
+        w.add_tensor_f32(b + "attn_norm.bias",
+                         sd[p + "input_layernorm.bias"])
+        for t, hf in (("q", "q_proj"), ("k", "k_proj"), ("v", "v_proj")):
+            w.add_tensor_f32(b + f"attn_{t}.weight",
+                             sd[p + f"self_attn.{hf}.weight"])
+            w.add_tensor_f32(b + f"attn_{t}.bias",
+                             sd[p + f"self_attn.{hf}.bias"])
+        w.add_tensor_f32(b + "attn_output.weight",
+                         sd[p + "self_attn.o_proj.weight"])
+        w.add_tensor_f32(b + "attn_output.bias",
+                         sd[p + "self_attn.o_proj.bias"])
+        w.add_tensor_f32(b + "ffn_norm.weight",
+                         sd[p + "post_attention_layernorm.weight"])
+        w.add_tensor_f32(b + "ffn_norm.bias",
+                         sd[p + "post_attention_layernorm.bias"])
+        w.add_tensor_f32(b + "ffn_up.weight", sd[p + "mlp.c_fc.weight"])
+        w.add_tensor_f32(b + "ffn_up.bias", sd[p + "mlp.c_fc.bias"])
+        w.add_tensor_f32(b + "ffn_down.weight", sd[p + "mlp.c_proj.weight"])
+        w.add_tensor_f32(b + "ffn_down.bias", sd[p + "mlp.c_proj.bias"])
+    w.write()
+    _check(path, model)
