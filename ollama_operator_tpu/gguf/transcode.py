@@ -155,6 +155,22 @@ def config_from_gguf(f: GGUFFile) -> ModelConfig:
     elif arch == "qwen3":
         # qwen2 minus the qkv bias, plus per-head RMS on q/k
         cfg = ModelConfig(arch="llama", qk_norm=True, **base)
+    elif arch == "qwen3moe":
+        # qwen3 attention (qk norms, no bias) + sparse MoE MLPs
+        # (qwen3:30b-a3b etc.). Router convention: softmax renormalised
+        # over the selected top-k (norm_topk_prob) — the same math the
+        # mixtral path runs (_moe_gates). Expert FFN dims come from the
+        # tensors themselves (expert_feed_forward_length metadata is
+        # informational here).
+        if not base.get("n_experts"):
+            raise ValueError("qwen3moe GGUF without expert_count metadata")
+        if f.field("expert_used_count") is None:
+            # the generic default (2, mixtral's top-k) would silently
+            # route an 8-experts-per-token model at top-2 — degraded
+            # outputs with no error; require the real value
+            raise ValueError(
+                "qwen3moe GGUF without expert_used_count metadata")
+        cfg = ModelConfig(arch="llama", qk_norm=True, **base)
     elif arch == "gemma":
         cfg = ModelConfig(arch="llama", act="gelu_tanh", emb_scale=True,
                           tie_embeddings=True, norm_weight_offset=1.0, **base)

@@ -729,3 +729,57 @@ def test_starcoder2_layernorm_bias_plain_mlp(tmp_path):
         w.add_tensor_f32(b + "ffn_down.bias", sd[p + "mlp.c_proj.bias"])
     w.write()
     _check(path, model)
+
+
+def test_qwen3moe_sparse_moe_qk_norm(tmp_path):
+    """qwen3moe (qwen3:30b-a3b class): qwen3's per-head q/k RMS norms
+    composed with sparse MoE MLPs — router softmax renormalised over the
+    selected top-k (norm_topk_prob), merged expert tensors, NEOX layout —
+    against transformers Qwen3MoeForCausalLM."""
+    cfg = transformers.Qwen3MoeConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        moe_intermediate_size=48, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+        num_experts=4, num_experts_per_tok=2, norm_topk_prob=True,
+        decoder_sparse_step=1, mlp_only_layers=[],
+        max_position_embeddings=128, rope_theta=10000.0,
+        attn_implementation="eager")
+    torch.manual_seed(21)
+    model = transformers.Qwen3MoeForCausalLM(cfg).eval()
+    sd = _sd(model)
+    w = W.GGUFWriter(str(tmp_path / "q3moe.gguf"))
+    _base_meta(w, "qwen3moe", cfg, head_dim=cfg.head_dim)
+    w.add_meta("qwen3moe.attention.layer_norm_rms_epsilon",
+               float(cfg.rms_norm_eps))
+    w.add_meta("qwen3moe.expert_count", cfg.num_experts)
+    w.add_meta("qwen3moe.expert_used_count", cfg.num_experts_per_tok)
+    w.add_meta("qwen3moe.expert_feed_forward_length",
+               cfg.moe_intermediate_size)
+    w.add_tensor_f32("token_embd.weight", sd["model.embed_tokens.weight"])
+    w.add_tensor_f32("output_norm.weight", sd["model.norm.weight"])
+    w.add_tensor_f32("output.weight", sd["lm_head.weight"])
+    E = cfg.num_experts
+    for i in range(cfg.num_hidden_layers):
+        p, b = f"model.layers.{i}.", f"blk.{i}."
+        w.add_tensor_f32(b + "attn_norm.weight",
+                         sd[p + "input_layernorm.weight"])
+        for src, dst in (("q_proj", "attn_q"), ("k_proj", "attn_k"),
+                         ("v_proj", "attn_v"), ("o_proj", "attn_output")):
+            w.add_tensor_f32(b + dst + ".weight",
+                             sd[p + f"self_attn.{src}.weight"])
+        w.add_tensor_f32(b + "attn_q_norm.weight",
+                         sd[p + "self_attn.q_norm.weight"])
+        w.add_tensor_f32(b + "attn_k_norm.weight",
+                         sd[p + "self_attn.k_norm.weight"])
+        w.add_tensor_f32(b + "ffn_norm.weight",
+                         sd[p + "post_attention_layernorm.weight"])
+        w.add_tensor_f32(b + "ffn_gate_inp.weight",
+                         sd[p + "mlp.gate.weight"])
+        # merged expert tensors [E, F, D] (modern conversion layout)
+        for kind, hf in (("gate", "gate_proj"), ("up", "up_proj"),
+                         ("down", "down_proj")):
+            stacked = np.stack([sd[p + f"mlp.experts.{e}.{hf}.weight"]
+                                for e in range(E)])
+            w.add_tensor_f32(b + f"ffn_{kind}_exps.weight", stacked)
+    w.write()
+    _check(str(tmp_path / "q3moe.gguf"), model)
