@@ -194,6 +194,30 @@ def config_from_gguf(f: GGUFFile) -> ModelConfig:
             logit_softcap=float(f.field("final_logit_softcapping", 30.0)),
             attn_scale=qpas,
             **base)
+    elif arch == "gemma3":
+        if not base.get("sliding_window"):
+            raise ValueError(
+                "gemma3 GGUF lacks attention.sliding_window metadata")
+        # pattern-6 alternation (every 6th layer full attention), qk RMS
+        # norms (gemma (w−1) storage), DUAL rope: sliding layers at the
+        # local 10k theta, full layers at the global theta (metadata
+        # freq_base, 1e6) with any linear context scaling. The local
+        # theta and the 6-pattern are architecture constants (llama.cpp
+        # hardcodes both); query_pre_attn_scalar defaults to gemma3's 256.
+        # query_pre_attn_scalar: llama.cpp writes no key (same situation
+        # as gemma2); 1B/4B/12B use 256, but 27B — the only 62-layer
+        # gemma3 — uses dim/n_heads (5376/32 = 168). A silent 256
+        # fallback there would mis-scale every attention layer.
+        qpas = float(f.field("attention.query_pre_attn_scalar", 0) or 0)
+        if not qpas:
+            qpas = (base["dim"] / base["n_heads"]
+                    if base["n_layers"] == 62 else 256.0)
+        cfg = ModelConfig(
+            arch="llama", act="gelu_tanh", emb_scale=True,
+            tie_embeddings=True, norm_weight_offset=1.0, post_norms=True,
+            altern_sliding=True, sliding_pattern=6, qk_norm=True,
+            rope_local_theta=10000.0, attn_scale=qpas,
+            **base)
     elif arch == "phi3":
         # phi3/phi3.5 (mini 3.8B MHA, medium GQA): llama-family block —
         # RMSNorm, gated-silu MLP, full rotary — converted with FUSED

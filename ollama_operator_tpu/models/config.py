@@ -69,9 +69,18 @@ class ModelConfig:
     post_norms: bool = False           # gemma2: sandwich norms — extra RMS
                                        # on attn/mlp OUTPUTS before the
                                        # residual adds
-    altern_sliding: bool = False       # gemma2: even layers use the
-                                       # sliding window, odd layers full
-                                       # attention (einsum path only)
+    altern_sliding: bool = False       # gemma2/gemma3: layers alternate
+                                       # sliding-window and full attention
+                                       # (einsum path only)
+    sliding_pattern: int = 2           # alternation period: layer i runs
+                                       # FULL attention iff
+                                       # i % pattern == pattern - 1
+                                       # (gemma2: 2 — odd layers full;
+                                       # gemma3: 6 — every 6th layer full)
+    rope_local_theta: float = 0.0      # gemma3: SLIDING layers rope at
+                                       # this theta with no scaling; full
+                                       # layers use rope_theta + scaling.
+                                       # 0 = one rope for all layers
     attn_scale: float = 0.0            # gemma2 query_pre_attn_scalar:
                                        # scores scale 1/sqrt(this);
                                        # 0 = 1/sqrt(head_dim)
@@ -138,6 +147,11 @@ class ModelConfig:
         if self.n_experts:
             assert self.mlp_type == "gated", "MoE is gated-MLP only"
             assert 0 < self.n_experts_used <= self.n_experts
+        if self.rope_local_theta:
+            assert self.altern_sliding, (
+                "rope_local_theta pairs with per-layer (altern_sliding) "
+                "attention — the dual rope selects by the same pattern")
+        assert self.sliding_pattern >= 2
         return self
 
 
@@ -168,6 +182,19 @@ PRESETS = {
     "phi3": _mk(arch="llama", vocab_size=32064, dim=3072, n_layers=32,
                 n_heads=32, n_kv_heads=32, head_dim=96, ffn_dim=8192,
                 max_seq_len=4096, sliding_window=2047),
+    # gemma3-4b (the ollama `gemma3` default tag): pattern-6 alternating
+    # attention with DUAL rope (local 10k on sliding layers, global 1e6
+    # linear-scaled ×8 on full layers), gemma-offset qk norms, sandwich
+    # norms, no softcapping
+    "gemma3": _mk(arch="llama", vocab_size=262208, dim=2560, n_layers=34,
+                  n_heads=8, n_kv_heads=4, head_dim=256, ffn_dim=10240,
+                  act="gelu_tanh", emb_scale=True, tie_embeddings=True,
+                  norm_weight_offset=1.0, post_norms=True,
+                  altern_sliding=True, sliding_pattern=6, qk_norm=True,
+                  sliding_window=1024, rope_local_theta=10000.0,
+                  rope_theta=1000000.0, rope_scaling_type="linear",
+                  rope_scaling=8.0, attn_scale=256.0,
+                  max_seq_len=131072),
     # starcoder2-3b (the ollama `starcoder2` default tag): LayerNorm +
     # biases, plain gelu MLP, GQA 12:1, sliding window
     "starcoder2": _mk(arch="llama", vocab_size=49152, dim=3072,

@@ -150,12 +150,41 @@ def _causal_window_mask(k_pos, q_pos, window: int):
     return jnp.where(ok, 0.0, -1e30).astype(jnp.float32)[:, None, :, :]
 
 
+def _is_full_layer(cfg: ModelConfig, i):
+    """Alternating-attention pattern: layer i runs FULL attention iff
+    i % sliding_pattern == sliding_pattern - 1 (gemma2: odd layers;
+    gemma3: every 6th layer). ``i`` may be traced (scan index)."""
+    return (i % cfg.sliding_pattern) == (cfg.sliding_pattern - 1)
+
+
 def _layer_mask(cfg: ModelConfig, i, mask, m_full):
-    """Per-layer attention mask: gemma2 alternates sliding (even layers)
-    and full (odd) attention; everything else uses ``mask`` as-is."""
+    """Per-layer attention mask: alternating archs (gemma2/gemma3) pick
+    sliding vs full per layer; everything else uses ``mask`` as-is."""
     if not cfg.altern_sliding:
         return mask
-    return jnp.where(i % 2 == 0, mask, m_full)
+    return jnp.where(_is_full_layer(cfg, i), m_full, mask)
+
+
+def _layer_rope(cfg: ModelConfig, i, cos, sin, cos_l, sin_l):
+    """Per-layer rope (gemma3): SLIDING layers rotate at the local theta
+    (cos_l/sin_l, unscaled), FULL layers at the global theta incl. any
+    context-extension scaling. Single-rope archs pass cos_l=None."""
+    if cos_l is None:
+        return cos, sin
+    full = _is_full_layer(cfg, i)
+    return jnp.where(full, cos, cos_l), jnp.where(full, sin, sin_l)
+
+
+def _rope_pair(positions, cfg: ModelConfig):
+    """(cos, sin, cos_l, sin_l): the global rope table plus, for dual-rope
+    archs (cfg.rope_local_theta — gemma3), the local-theta table."""
+    from ..ops.rope import rope_angles
+    cos, sin = rope_angles_cfg(positions, cfg)
+    if not cfg.rope_local_theta:
+        return cos, sin, None, None
+    cos_l, sin_l = rope_angles(positions, cfg.rotary_dim,
+                               cfg.rope_local_theta)
+    return cos, sin, cos_l, sin_l
 
 
 def _attn_scale(cfg: ModelConfig) -> float:
@@ -298,8 +327,12 @@ def _qkv(cfg: ModelConfig, lp, h, cos, sin):
     k = k.reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
     v = v.reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
     if cfg.qk_norm:
-        q = rms_norm(q, lp["q_norm_w"], cfg.norm_eps)
-        k = rms_norm(k, lp["k_norm_w"], cfg.norm_eps)
+        # gemma3 stores the norm weight gemma-style as (w − 1); qwen3's
+        # offset is 0, so the shared call is exact for both
+        q = rms_norm(q, lp["q_norm_w"], cfg.norm_eps,
+                     cfg.norm_weight_offset)
+        k = rms_norm(k, lp["k_norm_w"], cfg.norm_eps,
+                     cfg.norm_weight_offset)
     q = apply_rope(q, cos, sin, cfg.rotary_dim)
     k = apply_rope(k, cos, sin, cfg.rotary_dim)
     return q, k, v
@@ -427,7 +460,7 @@ def prefill_chunk(params: Params, cfg: ModelConfig, tokens: jax.Array,
     B, T = tokens.shape
     scale = _attn_scale(cfg)
     positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
-    cos, sin = rope_angles_cfg(positions, cfg)
+    cos, sin, cos_l, sin_l = _rope_pair(positions, cfg)
     mask = causal_mask(T, T, 0, sliding_window=cfg.sliding_window)
     mask = jnp.broadcast_to(mask, (B, 1, T, T))
 
@@ -437,14 +470,16 @@ def prefill_chunk(params: Params, cfg: ModelConfig, tokens: jax.Array,
         x = _embed(cfg, params, tokens)
 
     if cfg.altern_sliding:
-        # gemma2: even layers sliding-window, odd layers full attention
+        # gemma2/gemma3: per-layer sliding vs full attention (and, for
+        # gemma3, per-layer local vs global rope)
         m_full = jnp.broadcast_to(causal_mask(T, T, 0), (B, 1, T, T))
 
         def body_a(x, layer_in):
             lp, i = layer_in
             mask_l = _layer_mask(cfg, i, mask, m_full)
-            x, (k, v) = _block_chunk(cfg, lp, x, cos, sin, mask_l, scale,
-                                     mesh=mesh)
+            cos_i, sin_i = _layer_rope(cfg, i, cos, sin, cos_l, sin_l)
+            x, (k, v) = _block_chunk(cfg, lp, x, cos_i, sin_i, mask_l,
+                                     scale, mesh=mesh)
             return x, (k, v)
 
         x, (ks, vs) = lax.scan(
@@ -485,7 +520,7 @@ def forward_with_cache(params: Params, cfg: ModelConfig, tokens: jax.Array,
     A = S if attn_len is None else min(attn_len, S)
     scale = _attn_scale(cfg)
     positions = lengths[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
-    cos, sin = rope_angles_cfg(positions, cfg)
+    cos, sin, cos_l, sin_l = _rope_pair(positions, cfg)
     # key j (absolute slot) is visible to query at absolute pos p iff j <= p,
     # within the sliding window; slots beyond the written region are garbage
     # but satisfy j > p so they are masked.
@@ -517,8 +552,9 @@ def forward_with_cache(params: Params, cfg: ModelConfig, tokens: jax.Array,
         x, kc, vc = carry
         lp, i = layer_in
         mask_l = _layer_mask(cfg, i, mask, m_full)
+        cos_i, sin_i = _layer_rope(cfg, i, cos, sin, cos_l, sin_l)
         h = _norm(cfg, x, lp["attn_norm_w"], lp.get("attn_norm_b"))
-        q, k, v = _qkv(cfg, lp, h, cos, sin)
+        q, k, v = _qkv(cfg, lp, h, cos_i, sin_i)
         k = k.transpose(0, 2, 1, 3)                   # [B, KvH, T, hd]
         v = v.transpose(0, 2, 1, 3)
         if quant:
@@ -914,7 +950,7 @@ def forward_with_cache_paged(params: Params, cfg: ModelConfig,
     B, T = tokens.shape
     scale = _attn_scale(cfg)
     positions = lengths[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
-    cos, sin = rope_angles_cfg(positions, cfg)
+    cos, sin, cos_l, sin_l = _rope_pair(positions, cfg)
     S_attn = attn_blocks * ps
     k_pos = jnp.arange(S_attn, dtype=jnp.int32)[None, None, :]
     q_pos = positions[:, :, None]
@@ -950,7 +986,8 @@ def forward_with_cache_paged(params: Params, cfg: ModelConfig,
         x, kp, vp = carry
         lp, i = layer_in
         h = _norm(cfg, x, lp["attn_norm_w"], lp.get("attn_norm_b"))
-        q, k, v = _qkv(cfg, lp, h, cos, sin)
+        cos_i, sin_i = _layer_rope(cfg, i, cos, sin, cos_l, sin_l)
+        q, k, v = _qkv(cfg, lp, h, cos_i, sin_i)
         k = k.transpose(0, 2, 1, 3)           # [B, KvH, T, hd]
         v = v.transpose(0, 2, 1, 3)
         mask_l = _layer_mask(cfg, i, mask, m_full)

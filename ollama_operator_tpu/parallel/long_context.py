@@ -52,7 +52,7 @@ def prefill_chunk_sp(params: Params, cfg: ModelConfig, tokens: jax.Array,
     assert T % sp == 0, f"prefill length {T} must divide sp={sp}"
     if cfg.altern_sliding:
         raise NotImplementedError(
-            "per-layer alternating windows (gemma2) are not implemented "
+            "per-layer alternating windows / dual rope (gemma2, gemma3) are not implemented "
             "on the sequence-parallel path")
     scale = _attn_scale(cfg)
 
@@ -105,7 +105,7 @@ def forward_with_cache_sp(params: Params, cfg: ModelConfig,
     """
     if cfg.altern_sliding:
         raise NotImplementedError(
-            "per-layer alternating windows (gemma2) are not implemented "
+            "per-layer alternating windows / dual rope (gemma2, gemma3) are not implemented "
             "on the sequence-parallel path")
     scale = _attn_scale(cfg)
     quant = isinstance(k_cache, dict)

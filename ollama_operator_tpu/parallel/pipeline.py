@@ -86,7 +86,7 @@ def forward_with_cache_pp(params: Params, cfg: ModelConfig,
     Lpp = L // pp
     if cfg.altern_sliding:
         raise NotImplementedError(
-            "per-layer alternating windows (gemma2) are not implemented "
+            "per-layer alternating windows / dual rope (gemma2, gemma3) are not implemented "
             "on the pipeline path")
     scale = _attn_scale(cfg)
     KvH, hd = cfg.n_kv_heads, cfg.head_dim

@@ -783,3 +783,62 @@ def test_qwen3moe_sparse_moe_qk_norm(tmp_path):
             w.add_tensor_f32(b + f"ffn_{kind}_exps.weight", stacked)
     w.write()
     _check(str(tmp_path / "q3moe.gguf"), model)
+
+
+def test_gemma3_dual_rope_pattern6(tmp_path):
+    """gemma3: pattern-6 alternation (every 6th layer full attention),
+    DUAL rope (sliding layers at the local 10k theta, full layers at the
+    global theta with linear scaling), gemma-offset q/k RMS norms,
+    sandwich norms, no softcapping — against transformers
+    Gemma3ForCausalLM. 7 layers cover both layer types; linear rope
+    scaling on the global rope exercises the split."""
+    cfg = transformers.Gemma3TextConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=7, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, sliding_window=8, rope_theta=1000000.0,
+        rope_local_base_freq=10000.0, query_pre_attn_scalar=16,
+        rope_scaling={"rope_type": "linear", "factor": 8.0},
+        max_position_embeddings=256, pad_token_id=0,
+        attn_implementation="eager")
+    torch.manual_seed(23)
+    model = transformers.Gemma3ForCausalLM(cfg).eval()
+    sd = _sd(model)
+    w = W.GGUFWriter(str(tmp_path / "g3.gguf"))
+    _base_meta(w, "gemma3", cfg, head_dim=cfg.head_dim)
+    w.add_meta("gemma3.attention.layer_norm_rms_epsilon",
+               float(cfg.rms_norm_eps))
+    w.add_meta("gemma3.attention.sliding_window", cfg.sliding_window)
+    w.add_meta("gemma3.attention.query_pre_attn_scalar",
+               float(cfg.query_pre_attn_scalar))
+    w.add_meta("gemma3.rope.scaling.type", "linear")
+    w.add_meta("gemma3.rope.scaling.factor", 8.0)
+    w.add_tensor_f32("token_embd.weight", sd["model.embed_tokens.weight"])
+    w.add_tensor_f32("output_norm.weight", sd["model.norm.weight"])
+    # tied head: no output.weight tensor
+    for i in range(cfg.num_hidden_layers):
+        p, b = f"model.layers.{i}.", f"blk.{i}."
+        w.add_tensor_f32(b + "attn_norm.weight",
+                         sd[p + "input_layernorm.weight"])
+        for src, dst in (("q_proj", "attn_q"), ("k_proj", "attn_k"),
+                         ("v_proj", "attn_v"), ("o_proj", "attn_output")):
+            w.add_tensor_f32(b + dst + ".weight",
+                             sd[p + f"self_attn.{src}.weight"])
+        w.add_tensor_f32(b + "attn_q_norm.weight",
+                         sd[p + "self_attn.q_norm.weight"])
+        w.add_tensor_f32(b + "attn_k_norm.weight",
+                         sd[p + "self_attn.k_norm.weight"])
+        w.add_tensor_f32(b + "attn_post_norm.weight",
+                         sd[p + "post_attention_layernorm.weight"])
+        w.add_tensor_f32(b + "ffn_norm.weight",
+                         sd[p + "pre_feedforward_layernorm.weight"])
+        w.add_tensor_f32(b + "ffn_post_norm.weight",
+                         sd[p + "post_feedforward_layernorm.weight"])
+        w.add_tensor_f32(b + "ffn_gate.weight",
+                         sd[p + "mlp.gate_proj.weight"])
+        w.add_tensor_f32(b + "ffn_up.weight", sd[p + "mlp.up_proj.weight"])
+        w.add_tensor_f32(b + "ffn_down.weight",
+                         sd[p + "mlp.down_proj.weight"])
+    w.write()
+    # 12 tokens exceed the 8-token sliding window, so sliding layers'
+    # masks and the local rope both bind
+    _check(str(tmp_path / "g3.gguf"), model)
