@@ -33,7 +33,7 @@ from .store import TensorStore, TensorStoreWriter
 # arches whose GGUF q/k weights are stored in the interleaved-rope (Meta)
 # layout and need un-permuting for half-split rope (mistral/mixtral GGUFs
 # carry arch "llama")
-_INTERLEAVED_ROPE_ARCHES = {"llama"}
+_INTERLEAVED_ROPE_ARCHES = {"llama", "granite"}
 
 
 # ---------------------------------------------------------------------------
@@ -194,6 +194,19 @@ def config_from_gguf(f: GGUFFile) -> ModelConfig:
             logit_softcap=float(f.field("final_logit_softcapping", 30.0)),
             attn_scale=qpas,
             **base)
+    elif arch == "granite":
+        # granite3 dense (2b/8b): llama block + four scalar multipliers
+        # (embedding/attention/residual/logits) the conversion records
+        # as granite.*.scale keys; q/k stored llama-permuted
+        extra = {}
+        for key, fld in (("attention.scale", "attn_scale_mult"),
+                         ("embedding.scale", "emb_multiplier"),
+                         ("residual.scale", "residual_multiplier"),
+                         ("logit_scale", "logit_scale")):
+            v = f.field(key)
+            if v:
+                extra[fld] = float(v)
+        cfg = ModelConfig(arch="llama", **extra, **base)
     elif arch == "gemma3":
         if not base.get("sliding_window"):
             raise ValueError(

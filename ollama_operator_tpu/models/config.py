@@ -85,6 +85,15 @@ class ModelConfig:
                                        # scores scale 1/sqrt(this);
                                        # 0 = 1/sqrt(head_dim)
     qk_norm: bool = False              # qwen3/llama4-style per-head RMS on q,k
+    # granite-family scalar multipliers (0 = off)
+    emb_multiplier: float = 0.0        # embeddings scaled by this
+    residual_multiplier: float = 0.0   # block outputs scaled before the
+                                       # residual adds
+    logit_scale: float = 0.0           # final logits DIVIDED by this
+    attn_scale_mult: float = 0.0       # exact score multiplier (granite
+                                       # attention_multiplier); overrides
+                                       # the 1/sqrt(attn_scale|head_dim)
+                                       # convention when set
     # mixture-of-experts (mixtral family); 0 experts = dense MLP
     n_experts: int = 0                 # total routed experts per layer
     n_experts_used: int = 2            # top-k experts per token

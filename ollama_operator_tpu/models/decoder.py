@@ -188,8 +188,11 @@ def _rope_pair(positions, cfg: ModelConfig):
 
 
 def _attn_scale(cfg: ModelConfig) -> float:
-    """Score scale: 1/sqrt(head_dim), or gemma2's
-    1/sqrt(query_pre_attn_scalar) when the config sets one."""
+    """Score scale: 1/sqrt(head_dim), gemma2/gemma3's
+    1/sqrt(query_pre_attn_scalar), or granite's exact attention
+    multiplier when the config sets one."""
+    if cfg.attn_scale_mult:
+        return cfg.attn_scale_mult
     return 1.0 / math.sqrt(cfg.attn_scale or cfg.head_dim)
 
 
@@ -346,17 +349,18 @@ def _proj_out(cfg, lp, attn_out, B, T):
 
 
 def _residual(cfg: ModelConfig, lp, x, h, attn):
+    rm = cfg.residual_multiplier or 1.0   # granite: scaled residual adds
     if cfg.post_norms:
         # gemma2 sandwich norms: attn/mlp OUTPUTS normed before the adds
         attn = _norm(cfg, attn, lp["post_attn_norm_w"])
     if cfg.parallel_block:
         return x + attn + _mlp(cfg, lp, h)
-    x = x + attn
+    x = x + rm * attn
     h2 = _norm(cfg, x, lp["mlp_norm_w"], lp.get("mlp_norm_b"))
     m = _mlp(cfg, lp, h2)
     if cfg.post_norms:
         m = _norm(cfg, m, lp["post_ffw_norm_w"])
-    return x + m
+    return x + rm * m
 
 
 def _block_chunk(cfg: ModelConfig, lp, x, cos, sin, mask, scale,
@@ -420,6 +424,8 @@ def _embed(cfg: ModelConfig, params: Params, tokens):
     x = params["tok_emb"][tokens]
     if cfg.emb_scale:
         x = (x.astype(jnp.float32) * math.sqrt(cfg.dim)).astype(x.dtype)
+    if cfg.emb_multiplier:
+        x = (x.astype(jnp.float32) * cfg.emb_multiplier).astype(x.dtype)
     return x
 
 
@@ -433,6 +439,8 @@ def _unembed(cfg: ModelConfig, params: Params, x):
                             preferred_element_type=jnp.float32)
     if "lm_head_b" in params:
         logits = logits + params["lm_head_b"].astype(jnp.float32)
+    if cfg.logit_scale:
+        logits = logits / cfg.logit_scale   # granite logits_scaling
     if cfg.logit_softcap:
         logits = cfg.logit_softcap * jnp.tanh(logits / cfg.logit_softcap)
     return logits

@@ -490,7 +490,11 @@ class LoadedModel:
                     from ..ops.attention import causal_mask
                     import math
                     B, T = tokens.shape
-                    scale = 1.0 / math.sqrt(cfg.head_dim)
+                    # the model's real score scale (granite's exact
+                    # multiplier, gemma's query_pre_attn_scalar) — a
+                    # hand-rolled 1/sqrt(head_dim) silently mis-scales
+                    # those families' embeddings
+                    scale = D._attn_scale(cfg)
                     from ..ops.rope import rope_angles_cfg
                     positions = jnp.broadcast_to(
                         jnp.arange(T, dtype=jnp.int32), (B, T))

@@ -842,3 +842,53 @@ def test_gemma3_dual_rope_pattern6(tmp_path):
     # 12 tokens exceed the 8-token sliding window, so sliding layers'
     # masks and the local rope both bind
     _check(str(tmp_path / "g3.gguf"), model)
+
+
+def test_granite_scalar_multipliers(tmp_path):
+    """granite3 dense: llama block + the four scalar multipliers
+    (embedding/attention/residual/logits) and llama-permuted q/k —
+    against transformers GraniteForCausalLM with non-trivial multiplier
+    values so each hook must bind."""
+    cfg = transformers.GraniteConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rope_theta=10000.0, pad_token_id=0,
+        embedding_multiplier=6.0, attention_multiplier=0.0625,
+        residual_multiplier=0.5, logits_scaling=4.0,
+        attn_implementation="eager")
+    torch.manual_seed(29)
+    model = transformers.GraniteForCausalLM(cfg).eval()
+    sd = _sd(model)
+    w = W.GGUFWriter(str(tmp_path / "granite.gguf"))
+    _base_meta(w, "granite", cfg)
+    w.add_meta("granite.attention.layer_norm_rms_epsilon",
+               float(cfg.rms_norm_eps))
+    w.add_meta("granite.attention.scale", float(cfg.attention_multiplier))
+    w.add_meta("granite.embedding.scale", float(cfg.embedding_multiplier))
+    w.add_meta("granite.residual.scale", float(cfg.residual_multiplier))
+    w.add_meta("granite.logit_scale", float(cfg.logits_scaling))
+    H, KvH = cfg.num_attention_heads, cfg.num_key_value_heads
+    w.add_tensor_f32("token_embd.weight", sd["model.embed_tokens.weight"])
+    w.add_tensor_f32("output_norm.weight", sd["model.norm.weight"])
+    w.add_tensor_f32("output.weight", sd["lm_head.weight"])
+    for i in range(cfg.num_hidden_layers):
+        p, b = f"model.layers.{i}.", f"blk.{i}."
+        w.add_tensor_f32(b + "attn_norm.weight",
+                         sd[p + "input_layernorm.weight"])
+        w.add_tensor_f32(b + "attn_q.weight",
+                         hf_permute(sd[p + "self_attn.q_proj.weight"], H))
+        w.add_tensor_f32(b + "attn_k.weight",
+                         hf_permute(sd[p + "self_attn.k_proj.weight"], KvH))
+        w.add_tensor_f32(b + "attn_v.weight",
+                         sd[p + "self_attn.v_proj.weight"])
+        w.add_tensor_f32(b + "attn_output.weight",
+                         sd[p + "self_attn.o_proj.weight"])
+        w.add_tensor_f32(b + "ffn_norm.weight",
+                         sd[p + "post_attention_layernorm.weight"])
+        w.add_tensor_f32(b + "ffn_gate.weight",
+                         sd[p + "mlp.gate_proj.weight"])
+        w.add_tensor_f32(b + "ffn_up.weight", sd[p + "mlp.up_proj.weight"])
+        w.add_tensor_f32(b + "ffn_down.weight",
+                         sd[p + "mlp.down_proj.weight"])
+    w.write()
+    _check(str(tmp_path / "granite.gguf"), model)
