@@ -190,16 +190,13 @@ def test_wordpiece_matches_bert_tokenizer(tmp_path):
                             ref_tok.convert_ids_to_tokens(ref))
 
 
-def test_embedding_model_serves_and_rejects_generate(tmp_path):
-    """Server contract over real sockets: pull an embedding image →
-    /api/embed, /api/embeddings, /v1/embeddings work; /api/generate
-    rejects with 400 (embedding-only), /api/ps lists it."""
+def _bert_registry(tmp_path, name="all-minilm"):
+    """(hf_cfg, FakeRegistry, full ref): a started fake registry holding a
+    tiny-BERT GGUF — shared by the serving-contract and keep-alive tests."""
     import sys
     import os
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from fake_registry import FakeRegistry
-
-    from ollama_operator_tpu.server.app import ModelManager, serve
 
     hf_cfg, model = _tiny_bert()
     sd = {k: v.detach().numpy().astype(np.float32)
@@ -208,12 +205,21 @@ def test_embedding_model_serves_and_rejects_generate(tmp_path):
     _write_bert(path, hf_cfg, sd)
     reg = FakeRegistry()
     url = reg.start()
-    reg.add_model("library", "all-minilm", "latest",
-                  open(path, "rb").read())
+    reg.add_model("library", name, "latest", open(path, "rb").read())
+    ref = f"http://{url.split('://')[1]}/library/{name}:latest"
+    return hf_cfg, reg, ref
+
+
+def test_embedding_model_serves_and_rejects_generate(tmp_path):
+    """Server contract over real sockets: pull an embedding image →
+    /api/embed, /api/embeddings, /v1/embeddings work; /api/generate
+    rejects with 400 (embedding-only), /api/ps lists it."""
+    from ollama_operator_tpu.server.app import ModelManager, serve
+
+    hf_cfg, reg, ref = _bert_registry(tmp_path)
     manager = ModelManager(str(tmp_path / "store"))
     httpd = serve(manager, "127.0.0.1", 0)
     base = f"http://127.0.0.1:{httpd.server_address[1]}"
-    ref = f"http://{url.split('://')[1]}/library/all-minilm:latest"
 
     def post(p, d):
         return json.loads(urllib.request.urlopen(urllib.request.Request(
@@ -245,4 +251,33 @@ def test_embedding_model_serves_and_rejects_generate(tmp_path):
             assert e.code == 400
     finally:
         httpd.shutdown()
+        reg.stop()
+
+
+def test_embedding_model_keep_alive_reaps(tmp_path):
+    """The keep-alive reaper must unload an idle embedding model: the
+    idle-scheduler facade carries every field the reaper reads
+    (n_active, _waiting, finished) — a missing one would kill the reaper
+    thread and disable keep_alive server-wide."""
+    import time as _time
+
+    from ollama_operator_tpu.server.app import ModelManager
+
+    hf_cfg, reg, ref = _bert_registry(tmp_path, name="mini")
+    manager = ModelManager(str(tmp_path / "store"),
+                           default_keep_alive=1.0)   # 1s idle unload
+    try:
+        manager.client.pull(ref)
+        lm = manager.require_loaded(ref)
+        assert lm.embed(["the sky"]).shape[1] == hf_cfg.hidden_size
+        deadline = _time.time() + 15
+        while manager.loaded is not None and _time.time() < deadline:
+            _time.sleep(0.3)
+        assert manager.loaded is None, "idle embedding model never reaped"
+        # the reaper thread survived (loading again still works + re-arms)
+        lm2 = manager.require_loaded(ref)
+        assert lm2.embed(["blue"]).shape[1] == hf_cfg.hidden_size
+        assert manager.expires_at is not None   # deadline re-armed
+    finally:
+        manager.shutdown()
         reg.stop()
