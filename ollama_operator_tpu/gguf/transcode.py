@@ -33,7 +33,7 @@ from .store import TensorStore, TensorStoreWriter
 # arches whose GGUF q/k weights are stored in the interleaved-rope (Meta)
 # layout and need un-permuting for half-split rope (mistral/mixtral GGUFs
 # carry arch "llama")
-_INTERLEAVED_ROPE_ARCHES = {"llama", "granite"}
+_INTERLEAVED_ROPE_ARCHES = {"llama", "granite", "command-r"}
 
 
 # ---------------------------------------------------------------------------
@@ -194,6 +194,28 @@ def config_from_gguf(f: GGUFFile) -> ModelConfig:
             logit_softcap=float(f.field("final_logit_softcapping", 30.0)),
             attn_scale=qpas,
             **base)
+    elif arch == "command-r":
+        # cohere command-r: parallel attn+mlp block sharing one BIAS-FREE
+        # LayerNorm, gated-silu MLP, tied embeddings, logits MULTIPLIED
+        # by logit_scale (our field divides — store the reciprocal), and
+        # interleaved-rope weight storage (the same row layout llama
+        # conversions use, so the shared _unpermute_rope applies). The
+        # qk-norm 08-2024 refresh stores per-head norms in the
+        # interleaved layout — unsupported until mapped.
+        base["norm_eps"] = float(f.field("attention.layer_norm_epsilon",
+                                         1e-5))
+        v = f.field("logit_scale")
+        if not v:
+            # the model TRAINS with logits scaled (~0.0625); serving
+            # unscaled logits is near-argmax garbage with no diagnostic
+            raise ValueError("command-r GGUF without logit_scale metadata")
+        if "blk.0.attn_q_norm.weight" in f.tensors:
+            raise NotImplementedError(
+                "command-r variants with q/k norms are not supported yet")
+        cfg = ModelConfig(arch="llama", norm_type="layernorm",
+                          norm_bias=False, parallel_block=True,
+                          tie_embeddings=True,
+                          logit_scale=1.0 / float(v), **base)
     elif arch == "granite":
         # granite3 dense (2b/8b): llama block + four scalar multipliers
         # (embedding/attention/residual/logits) the conversion records
@@ -317,7 +339,7 @@ def load_params(f: GGUFFile, cfg: Optional[ModelConfig] = None,
         "tok_emb": cast(_dq(f, "token_embd.weight")),
         "out_norm_w": cast(_dq(f, "output_norm.weight")),
     }
-    if cfg.norm_type == "layernorm":
+    if cfg.norm_type == "layernorm" and cfg.norm_bias:
         params["out_norm_b"] = cast(_dq(f, "output_norm.bias"))
     if not cfg.tie_embeddings:
         params["lm_head"] = cast(_dq(f, "output.weight").T)
@@ -397,11 +419,11 @@ def load_params(f: GGUFFile, cfg: Optional[ModelConfig] = None,
             layers["bk"] = stack("blk.{}.attn_k.bias", unp_bk)
             layers["bv"] = stack("blk.{}.attn_v.bias")
 
-    if cfg.norm_type == "layernorm":
+    if cfg.norm_type == "layernorm" and cfg.norm_bias:
         layers["attn_norm_b"] = stack("blk.{}.attn_norm.bias")
     if not cfg.parallel_block:
         layers["mlp_norm_w"] = stack("blk.{}.ffn_norm.weight")
-        if cfg.norm_type == "layernorm":
+        if cfg.norm_type == "layernorm" and cfg.norm_bias:
             layers["mlp_norm_b"] = stack("blk.{}.ffn_norm.bias")
     if cfg.n_experts:
         # mixtral: router ffn_gate_inp [E, D] → [D, E]; merged expert

@@ -56,6 +56,8 @@ class ModelConfig:
     sliding_window: int = 0            # 0 = full attention (mistral: 4096)
     # block structure
     norm_type: str = "rmsnorm"         # "rmsnorm" | "layernorm"
+    norm_bias: bool = True             # layernorm only; command-r stores
+                                       # NO norm biases
     norm_weight_offset: float = 0.0    # gemma: weight stored as (w - 1)
     mlp_type: str = "gated"            # "gated" (silu/gelu gate*up) | "plain"
     act: str = "silu"                  # "silu" | "gelu" | "gelu_tanh"

@@ -21,9 +21,13 @@ def rms_norm(x, weight, eps: float, weight_offset: float = 0.0):
 
 
 def layer_norm(x, weight, bias, eps: float):
+    """LayerNorm; ``bias=None`` = bias-free variant (command-r stores no
+    LN biases)."""
     xf = x.astype(jnp.float32)
     mean = jnp.mean(xf, axis=-1, keepdims=True)
     var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
     y = (xf - mean) * lax.rsqrt(var + eps)
-    y = y * weight.astype(jnp.float32) + bias.astype(jnp.float32)
+    y = y * weight.astype(jnp.float32)
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)
     return y.astype(x.dtype)

@@ -892,3 +892,43 @@ def test_granite_scalar_multipliers(tmp_path):
                          sd[p + "mlp.down_proj.weight"])
     w.write()
     _check(str(tmp_path / "granite.gguf"), model)
+
+
+def test_command_r_parallel_biasfree_interleaved(tmp_path):
+    """command-r (cohere): parallel attn+mlp block sharing one BIAS-FREE
+    LayerNorm, gated MLP, tied embeddings, logits MULTIPLIED by
+    logit_scale, and interleaved rope over unpermuted weights (rows
+    re-ordered to half-split at load) — against transformers
+    CohereForCausalLM."""
+    cfg = transformers.CohereConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rope_theta=10000.0, pad_token_id=0,
+        logit_scale=0.0625, attn_implementation="eager")
+    torch.manual_seed(31)
+    model = transformers.CohereForCausalLM(cfg).eval()
+    sd = _sd(model)
+    w = W.GGUFWriter(str(tmp_path / "cmdr.gguf"))
+    _base_meta(w, "command-r", cfg)
+    w.add_meta("command-r.attention.layer_norm_epsilon",
+               float(cfg.layer_norm_eps))
+    w.add_meta("command-r.logit_scale", float(cfg.logit_scale))
+    w.add_tensor_f32("token_embd.weight", sd["model.embed_tokens.weight"])
+    w.add_tensor_f32("output_norm.weight", sd["model.norm.weight"])
+    # tied head: no output.weight
+    for i in range(cfg.num_hidden_layers):
+        p, b = f"model.layers.{i}.", f"blk.{i}."
+        w.add_tensor_f32(b + "attn_norm.weight",
+                         sd[p + "input_layernorm.weight"])
+        for src, dst in (("q_proj", "attn_q"), ("k_proj", "attn_k"),
+                         ("v_proj", "attn_v"), ("o_proj", "attn_output")):
+            # UNPERMUTED — the loader's interleave->half transform runs
+            w.add_tensor_f32(b + dst + ".weight",
+                             sd[p + f"self_attn.{src}.weight"])
+        w.add_tensor_f32(b + "ffn_gate.weight",
+                         sd[p + "mlp.gate_proj.weight"])
+        w.add_tensor_f32(b + "ffn_up.weight", sd[p + "mlp.up_proj.weight"])
+        w.add_tensor_f32(b + "ffn_down.weight",
+                         sd[p + "mlp.down_proj.weight"])
+    w.write()
+    _check(str(tmp_path / "cmdr.gguf"), model)
