@@ -155,6 +155,19 @@ def config_from_gguf(f: GGUFFile) -> ModelConfig:
     elif arch == "qwen3":
         # qwen2 minus the qkv bias, plus per-head RMS on q/k
         cfg = ModelConfig(arch="llama", qk_norm=True, **base)
+    elif arch == "qwen2moe":
+        # qwen2-style attention (qkv bias) + sparse MoE with a SHARED
+        # gated expert (sigmoid-gated, runs for every token) and
+        # UN-renormalised top-k router gates (norm_topk_prob=false —
+        # unlike mixtral/qwen3moe)
+        if not base.get("n_experts"):
+            raise ValueError("qwen2moe GGUF without expert_count metadata")
+        if f.field("expert_used_count") is None:
+            raise ValueError(
+                "qwen2moe GGUF without expert_used_count metadata")
+        shared = int(f.field("expert_shared_feed_forward_length", 0) or 0)
+        cfg = ModelConfig(arch="llama", attn_bias=True, moe_renorm=False,
+                          n_shared_ffn=shared, **base)
     elif arch == "qwen3moe":
         # qwen3 attention (qk norms, no bias) + sparse MoE MLPs
         # (qwen3:30b-a3b etc.). Router convention: softmax renormalised
@@ -447,6 +460,12 @@ def load_params(f: GGUFFile, cfg: Optional[ModelConfig] = None,
             layers["we_gate"] = stack_experts("blk.{}.ffn_gate.{}.weight")
             layers["we_up"] = stack_experts("blk.{}.ffn_up.{}.weight")
             layers["we_down"] = stack_experts("blk.{}.ffn_down.{}.weight")
+        if "blk.0.ffn_gate_shexp.weight" in f.tensors:
+            # qwen2moe shared expert + its sigmoid gate projection
+            layers["we_sh_gate"] = stack("blk.{}.ffn_gate_shexp.weight", T_)
+            layers["we_sh_up"] = stack("blk.{}.ffn_up_shexp.weight", T_)
+            layers["we_sh_down"] = stack("blk.{}.ffn_down_shexp.weight", T_)
+            layers["sh_gate"] = stack("blk.{}.ffn_gate_inp_shexp.weight", T_)
     elif cfg.mlp_type == "gated" and not fused_gate_up:
         layers["w_gate"] = stack("blk.{}.ffn_gate.weight", T_)
     if cfg.out_bias:

@@ -99,6 +99,13 @@ class ModelConfig:
     # mixture-of-experts (mixtral family); 0 experts = dense MLP
     n_experts: int = 0                 # total routed experts per layer
     n_experts_used: int = 2            # top-k experts per token
+    moe_renorm: bool = True            # softmax over the SELECTED top-k
+                                       # (mixtral/qwen3moe); False = full
+                                       # softmax, top-k gates kept as-is
+                                       # (qwen2moe norm_topk_prob=false)
+    n_shared_ffn: int = 0              # qwen2moe: a SHARED gated expert
+                                       # of this ffn width runs for every
+                                       # token, scaled by a sigmoid gate
     moe_impl: str = "auto"             # auto|einsum|scan (models/decoder.py)
     kernels: str = "auto"              # attention impl: auto|pallas|xla|interpret
     mm_kernels: str = "auto"           # quantized-matmul impl. "auto" = XLA

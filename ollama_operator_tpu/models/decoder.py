@@ -96,14 +96,20 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
         layers["we_gate"] = w(next(keys), (L, E, D, F))
         layers["we_up"] = w(next(keys), (L, E, D, F))
         layers["we_down"] = w(next(keys), (L, E, F, D))
+        if cfg.n_shared_ffn:
+            Fs = cfg.n_shared_ffn
+            layers["we_sh_gate"] = w(next(keys), (L, D, Fs))
+            layers["we_sh_up"] = w(next(keys), (L, D, Fs))
+            layers["we_sh_down"] = w(next(keys), (L, Fs, D))
+            layers["sh_gate"] = w(next(keys), (L, D, 1))
     else:
         layers["w_up"] = w(next(keys), (L, D, F))
         layers["w_down"] = w(next(keys), (L, F, D))
-    if cfg.norm_type == "layernorm":
+    if cfg.norm_type == "layernorm" and cfg.norm_bias:
         layers["attn_norm_b"] = jnp.zeros((L, D), dtype)
     if not cfg.parallel_block:
         layers["mlp_norm_w"] = jnp.ones((L, D), dtype)
-        if cfg.norm_type == "layernorm":
+        if cfg.norm_type == "layernorm" and cfg.norm_bias:
             layers["mlp_norm_b"] = jnp.zeros((L, D), dtype)
     if cfg.mlp_type == "gated" and not cfg.n_experts:
         layers["w_gate"] = w(next(keys), (L, D, F))
@@ -127,7 +133,7 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
         "out_norm_w": jnp.ones((D,), dtype),
         "layers": layers,
     }
-    if cfg.norm_type == "layernorm":
+    if cfg.norm_type == "layernorm" and cfg.norm_bias:
         params["out_norm_b"] = jnp.zeros((D,), dtype)
     if not cfg.tie_embeddings:
         params["lm_head"] = w(next(keys), (D, V))
@@ -212,12 +218,19 @@ def _act(cfg: ModelConfig, x):
 
 def _moe_gates(cfg: ModelConfig, lp, xf):
     """Router: top-k softmax gates scattered to a dense [N, E] fp32 matrix
-    (zeros for unselected experts). Softmax over the selected logits ==
-    full softmax renormalised over the top-k (mixtral convention)."""
+    (zeros for unselected experts). With ``moe_renorm`` (mixtral,
+    qwen3moe) the softmax runs over the SELECTED logits — equal to the
+    full softmax renormalised over the top-k; without it (qwen2moe,
+    norm_topk_prob=false) the full-softmax probabilities are kept
+    un-renormalised."""
     logits = jnp.einsum("nd,de->ne", xf, lp["router"],
                         preferred_element_type=jnp.float32)  # [N, E] fp32
-    topw, topi = lax.top_k(logits, cfg.n_experts_used)      # [N, k]
-    topw = jax.nn.softmax(topw, axis=-1)
+    if cfg.moe_renorm:
+        topw, topi = lax.top_k(logits, cfg.n_experts_used)  # [N, k]
+        topw = jax.nn.softmax(topw, axis=-1)
+    else:
+        probs = jax.nn.softmax(logits, axis=-1)
+        topw, topi = lax.top_k(probs, cfg.n_experts_used)
     N = xf.shape[0]
     gates = jnp.zeros((N, cfg.n_experts), jnp.float32)
     return gates.at[jnp.arange(N)[:, None], topi].set(topw)
@@ -261,6 +274,14 @@ def _moe_mlp(cfg: ModelConfig, lp, x):
         acc0 = jnp.zeros((B * T, D), jnp.float32)
         y, _ = lax.scan(body, acc0, (lp["we_gate"], lp["we_up"],
                                      lp["we_down"], gates.T))
+    if "we_sh_gate" in lp:
+        # qwen2moe shared expert: a gated MLP every token runs, its
+        # output scaled by a per-token sigmoid gate (shared_expert_gate)
+        hs = _act(cfg, xf @ lp["we_sh_gate"]) * (xf @ lp["we_sh_up"])
+        sh = (hs @ lp["we_sh_down"]).astype(jnp.float32)
+        sg = jax.nn.sigmoid(
+            (xf @ lp["sh_gate"]).astype(jnp.float32))      # [N, 1]
+        y = y + sg * sh
     return y.astype(x.dtype).reshape(B, T, D)
 
 

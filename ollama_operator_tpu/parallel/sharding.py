@@ -48,6 +48,12 @@ _LAYER_SPECS: Dict[str, P] = {
     "we_gate": P(None, "ep", None, "tp"),
     "we_up": P(None, "ep", None, "tp"),
     "we_down": P(None, "ep", "tp", None),
+    # qwen2moe shared expert: dense Megatron TP like w_gate/w_up/w_down;
+    # the sigmoid gate projection replicates ([L, D, 1])
+    "we_sh_gate": P(None, None, "tp"),
+    "we_sh_up": P(None, None, "tp"),
+    "we_sh_down": P(None, "tp", None),
+    "sh_gate": P(None, None, None),
 }
 
 _TOP_SPECS: Dict[str, P] = {
@@ -84,6 +90,7 @@ def resolve_specs(cfg: Optional[ModelConfig], mesh: Optional[Mesh]
         layer.update(we_gate=P(None, None, None, "tp"),
                      we_up=P(None, None, None, "tp"),
                      we_down=P(None, None, "tp", None))
+        # shared-expert leaves keep their dense-TP specs
     return top, layer
 
 

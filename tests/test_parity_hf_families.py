@@ -932,3 +932,62 @@ def test_command_r_parallel_biasfree_interleaved(tmp_path):
                          sd[p + "mlp.down_proj.weight"])
     w.write()
     _check(str(tmp_path / "cmdr.gguf"), model)
+
+
+def test_qwen2moe_shared_expert_unrenormalised_gates(tmp_path):
+    """qwen2moe (qwen1.5-moe / qwen2-57b-a14b class): qkv-bias attention
+    + sparse MoE with UN-renormalised top-k gates (norm_topk_prob=false)
+    and a sigmoid-gated SHARED expert every token runs — against
+    transformers Qwen2MoeForCausalLM."""
+    cfg = transformers.Qwen2MoeConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=96,
+        moe_intermediate_size=48, shared_expert_intermediate_size=80,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_experts=4, num_experts_per_tok=2, norm_topk_prob=False,
+        decoder_sparse_step=1, mlp_only_layers=[],
+        max_position_embeddings=128, rope_theta=10000.0, pad_token_id=0,
+        attn_implementation="eager")
+    torch.manual_seed(37)
+    model = transformers.Qwen2MoeForCausalLM(cfg).eval()
+    sd = _sd(model)
+    w = W.GGUFWriter(str(tmp_path / "q2moe.gguf"))
+    _base_meta(w, "qwen2moe", cfg)
+    w.add_meta("qwen2moe.attention.layer_norm_rms_epsilon",
+               float(cfg.rms_norm_eps))
+    w.add_meta("qwen2moe.expert_count", cfg.num_experts)
+    w.add_meta("qwen2moe.expert_used_count", cfg.num_experts_per_tok)
+    w.add_meta("qwen2moe.expert_feed_forward_length",
+               cfg.moe_intermediate_size)
+    w.add_meta("qwen2moe.expert_shared_feed_forward_length",
+               cfg.shared_expert_intermediate_size)
+    w.add_tensor_f32("token_embd.weight", sd["model.embed_tokens.weight"])
+    w.add_tensor_f32("output_norm.weight", sd["model.norm.weight"])
+    w.add_tensor_f32("output.weight", sd["lm_head.weight"])
+    E = cfg.num_experts
+    for i in range(cfg.num_hidden_layers):
+        p, b = f"model.layers.{i}.", f"blk.{i}."
+        w.add_tensor_f32(b + "attn_norm.weight",
+                         sd[p + "input_layernorm.weight"])
+        for src, dst in (("q_proj", "attn_q"), ("k_proj", "attn_k"),
+                         ("v_proj", "attn_v")):
+            w.add_tensor_f32(b + dst + ".weight",
+                             sd[p + f"self_attn.{src}.weight"])
+            w.add_tensor_f32(b + dst + ".bias",
+                             sd[p + f"self_attn.{src}.bias"])
+        w.add_tensor_f32(b + "attn_output.weight",
+                         sd[p + "self_attn.o_proj.weight"])
+        w.add_tensor_f32(b + "ffn_norm.weight",
+                         sd[p + "post_attention_layernorm.weight"])
+        w.add_tensor_f32(b + "ffn_gate_inp.weight",
+                         sd[p + "mlp.gate.weight"])
+        for kind, hf in (("gate", "gate_proj"), ("up", "up_proj"),
+                         ("down", "down_proj")):
+            stacked = np.stack([sd[p + f"mlp.experts.{e}.{hf}.weight"]
+                                for e in range(E)])
+            w.add_tensor_f32(b + f"ffn_{kind}_exps.weight", stacked)
+            w.add_tensor_f32(b + f"ffn_{kind}_shexp.weight",
+                             sd[p + f"mlp.shared_expert.{hf}.weight"])
+        w.add_tensor_f32(b + "ffn_gate_inp_shexp.weight",
+                         sd[p + "mlp.shared_expert_gate.weight"])
+    w.write()
+    _check(str(tmp_path / "q2moe.gguf"), model)
