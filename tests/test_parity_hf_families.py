@@ -991,3 +991,26 @@ def test_qwen2moe_shared_expert_unrenormalised_gates(tmp_path):
                          sd[p + "mlp.shared_expert_gate.weight"])
     w.write()
     _check(str(tmp_path / "q2moe.gguf"), model)
+
+
+def test_phi4_shape_through_phi3_arch(tmp_path):
+    """phi4 converts with GGUF arch "phi3" (same fused-tensor layout, no
+    longrope, 16k context so no sliding-window default): the phi3 path
+    must serve it unchanged — parity against transformers Phi3 at
+    phi4-style settings (full attention, plain rope)."""
+    cfg = transformers.Phi3Config(
+        vocab_size=256, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=16384, rope_theta=250000.0,
+        pad_token_id=0, attn_implementation="eager")
+    torch.manual_seed(41)
+    model = transformers.Phi3ForCausalLM(cfg).eval()
+    path = str(tmp_path / "phi4.gguf")
+    _write_phi3(path, cfg, _sd(model))
+    from ollama_operator_tpu.gguf.reader import GGUFFile
+    from ollama_operator_tpu.gguf.transcode import config_from_gguf
+    with GGUFFile(path) as f:
+        mcfg = config_from_gguf(f)
+    # 16k context: the 4k-era sliding-window default must NOT apply
+    assert mcfg.sliding_window == 0
+    _check(path, model)
