@@ -4,8 +4,9 @@ IMG ?= ghcr.io/ollama-operator-tpu/tpu-runtime:v0.1.0
 BACKEND ?= tpu
 PY ?= python
 
-.PHONY: all test test-fast lint native bench docker-build docker-build-cpu \
-        build-installer install uninstall deploy undeploy kind-e2e clean
+.PHONY: all test test-fast lint native bench bench-smoke docker-build \
+        docker-build-cpu build-installer install uninstall deploy undeploy \
+        kind-e2e clean
 
 all: test build-installer
 
@@ -33,6 +34,14 @@ native:  ## build the C++ dequant + grammar libraries
 
 bench:  ## headline decode-throughput benchmark (one JSON line)
 	$(PY) bench.py
+
+# BENCH_XLA_CACHE=0: the CPU-backend persistent-cache deserialization
+# path is unstable on some hosts (wrong tokens, then a native crash) —
+# tiny smoke programs recompile in seconds anyway
+bench-smoke:  ## seconds-scale CPU bench: engine + HTTP arm, one JSON line
+	JAX_PLATFORMS=cpu BENCH_CHILD=1 BENCH_HTTP=1 BENCH_XLA_CACHE=0 \
+	  BENCH_SLOTS=2 BENCH_STEPS=16 BENCH_SEQ=256 BENCH_PROMPT=16 \
+	  BENCH_CAPTURE_LOG=0 $(PY) bench.py
 
 ##@ Build
 

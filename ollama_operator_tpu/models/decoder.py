@@ -45,7 +45,7 @@ from jax import lax
 
 from ..ops import quant as Q
 from ..ops.attention import (attend_hf, cached_attention, causal_mask,
-                             chunk_attention)
+                             chunk_attention, shard_map_compat)
 from ..ops.norms import layer_norm, rms_norm
 from ..ops.rope import apply_rope, rope_angles_cfg
 from .config import ModelConfig
@@ -770,12 +770,12 @@ def _paged_attend(cfg: ModelConfig, q, kp, vp, i, tables, lengths, mask,
                     q, kp, vp, i, tables, lengths, scale, cfg.attn_softcap,
                     cfg.sliding_window, nblk=attn_blocks, interpret=interp)
 
-            out = jax.shard_map(
+            out = shard_map_compat(
                 inner, mesh=mesh,
                 in_specs=(qspec, pool_specs, pool_specs, P(), P(None, None),
                           P(None)),
-                out_specs=qspec, axis_names={"tp"},
-                check_vma=False)(q, kp, vp, i, tables, lengths)
+                out_specs=qspec,
+                axis_names={"tp"})(q, kp, vp, i, tables, lengths)
         else:
             out = paged_decode_attention(
                 q, kp, vp, i, tables, lengths, scale, cfg.attn_softcap,
@@ -880,13 +880,13 @@ def _paged_write_attend_dp(cfg: ModelConfig, q, k, v, kp, vp, i, tables,
             cfg, q, k, v, kp, vp, i, tables, lengths, positions, mask,
             scale, attn_blocks, use_kernel, interp)
 
-    return jax.shard_map(
+    return shard_map_compat(
         inner, mesh=mesh,
         in_specs=(qspec, kvspec, kvspec, pool_specs, pool_specs, P(),
                   P("dp", None), P("dp"), P("dp", None),
                   P("dp", None, None, None)),
         out_specs=(pool_specs, pool_specs, qspec),
-        axis_names={"dp", "tp"}, check_vma=False)(
+        axis_names={"dp", "tp"})(
         q, k, v, kp, vp, i, tables, lengths, positions, mask)
 
 
@@ -910,11 +910,11 @@ def paged_insert_dp(cfg: ModelConfig, k_pool, v_pool, ks, vs, table_rows,
     def inner(kp, vp, ks, vs, trow, n_valid):
         return paged_insert(cfg, kp, vp, ks, vs, trow[0], n_valid)
 
-    return jax.shard_map(
+    return shard_map_compat(
         inner, mesh=mesh,
         in_specs=(pool_specs, pool_specs, kvs, kvs, P("dp", None), P()),
         out_specs=(pool_specs, pool_specs),
-        axis_names={"dp", "tp"}, check_vma=False)(
+        axis_names={"dp", "tp"})(
         k_pool, v_pool, ks, vs, table_rows, n_valid)
 
 
@@ -950,12 +950,12 @@ def paged_extend_dp(params: Params, cfg: ModelConfig, tokens: jax.Array,
         logits = lax.psum(jnp.where(my == owner, logits, 0.0), "dp")
         return logits, kp, vp
 
-    return jax.shard_map(
+    return shard_map_compat(
         inner, mesh=mesh,
         in_specs=(P(None, None), pool_specs, pool_specs, P("dp", None),
                   P(None), P()),
         out_specs=(P(None, None, None), pool_specs, pool_specs),
-        axis_names={"dp"}, check_vma=False)(
+        axis_names={"dp"})(
         tokens, k_pool, v_pool, table_rows, lengths, owner)
 
 

@@ -119,6 +119,44 @@ def _mesh_attn_axes(mesh, B: int, H: int, KvH: int):
     return ("dp" if dp > 1 else None), ("tp" if tp > 1 else None)
 
 
+def shard_map_compat(f, mesh, in_specs, out_specs, axis_names):
+    """jax.shard_map across jax versions: the top-level API (with
+    axis_names/check_vma) landed after 0.4. The old experimental shard_map
+    cannot express partial-manual regions that use ``lax.axis_index`` (its
+    ``auto=`` lowering emits a PartitionId op GSPMD rejects), so the
+    fallback goes fully manual instead: axes outside ``axis_names`` are
+    unmentioned in the specs, so their values — including closed-over
+    params — replicate into the region. Same results, more per-device
+    memory; only the newer-jax path runs partial-manual."""
+    try:
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, axis_names=axis_names,
+                             check_vma=False)
+    except (AttributeError, TypeError):
+        from jax.experimental.shard_map import shard_map
+        return shard_map(f, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_rep=False)
+
+
+def axis_size_compat(axis_name):
+    """Static mesh-axis size inside a shard_map region across jax versions:
+    ``lax.axis_size`` is newer; older jax exposes the same static int via
+    ``core.axis_frame``."""
+    try:
+        return jax.lax.axis_size(axis_name)
+    except AttributeError:
+        return jax.core.axis_frame(axis_name)
+
+
+def pcast_varying_compat(x, axis_name):
+    """``lax.pcast(..., to="varying")`` where available. Older jax's
+    shard_map has no varying-type system (we run it with check_rep=False),
+    so the cast is a no-op there."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, (axis_name,), to="varying")
+    return x
+
+
 def _sharded_kernel_call(mesh, q, KvH: int, tileable, inner, args,
                          with_pos: bool):
     """Run a pallas attention kernel inside a dp/tp-manual shard_map.
@@ -142,9 +180,8 @@ def _sharded_kernel_call(mesh, q, KvH: int, tileable, inner, args,
     qspec = P(b_ax, None, h_ax, None)
     kvspec = P(b_ax, h_ax, None, None)
     in_specs = (qspec, kvspec, kvspec) + ((P(b_ax),) if with_pos else ())
-    return jax.shard_map(inner, mesh=mesh, in_specs=in_specs,
-                         out_specs=qspec, axis_names={"dp", "tp"},
-                         check_vma=False)(*args)
+    return shard_map_compat(inner, mesh=mesh, in_specs=in_specs,
+                            out_specs=qspec, axis_names={"dp", "tp"})(*args)
 
 
 def resolve_kernels(kernels: str) -> str:

@@ -48,6 +48,15 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# the newer pallas API renamed TPUCompilerParams -> CompilerParams
+_CompilerParams = getattr(pltpu, "CompilerParams",
+                          getattr(pltpu, "TPUCompilerParams", None))
+# older pallas has TPUMemorySpace with no HBM member; ANY is its
+# "stays in device memory, kernel DMAs slices itself" space
+_MemorySpace = getattr(pltpu, "MemorySpace",
+                       getattr(pltpu, "TPUMemorySpace", None))
+_HBM = getattr(_MemorySpace, "HBM", _MemorySpace.ANY)
+
 from ..attention import NEG_INF, softcap_scores
 from .flash import _lane_ok
 
@@ -225,7 +234,7 @@ def paged_decode_attention(q, k_pool, v_pool, layer, tables, lengths,
             ],
         ),
         out_shape=jax.ShapeDtypeStruct((B, KvH * Gp, hd), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(jnp.reshape(layer, (1,)).astype(jnp.int32),
@@ -434,7 +443,7 @@ def paged_decode_attention_v4(q, k_pool, v_pool, layer, tables, lengths,
             ],
         ),
         out_shape=jax.ShapeDtypeStruct((B, KvH, Gp, hd), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary",)),
         interpret=interpret,
     )(jnp.reshape(n_total, (1,)).astype(jnp.int32), slot, page, blk,
@@ -574,7 +583,7 @@ def paged_decode_attention_v3(q, k_pool, v_pool, layer, tables, lengths,
     # per-page latency at the cost of depth x page VMEM buffers.
     depth = max(2, int(os.environ.get("TPU_PAGED_DEPTH", "2") or "2"))
 
-    hbm = pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM)
+    hbm = pl.BlockSpec(memory_space=_HBM)
     in_specs = [
         pl.BlockSpec((1, KvH, Gp, hd), lambda b, *pref: (b, 0, 0, 0)),
         hbm, hbm,
@@ -612,7 +621,7 @@ def paged_decode_attention_v3(q, k_pool, v_pool, layer, tables, lengths,
             scratch_shapes=scratch,
         ),
         out_shape=jax.ShapeDtypeStruct((B, KvH, Gp, hd), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary",)),
         interpret=interpret,
     )(jnp.reshape(layer, (1,)).astype(jnp.int32),

@@ -27,6 +27,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# the newer pallas API renamed TPUCompilerParams -> CompilerParams
+_CompilerParams = getattr(pltpu, "CompilerParams",
+                          getattr(pltpu, "TPUCompilerParams", None))
+
 from ..quant import GROUP, qmm, qmm4
 
 _BLOCKS = (512, 256, 128, 64, 32)
@@ -101,7 +105,7 @@ def qmm_pallas(x: jax.Array, q: jax.Array, s: jax.Array,
         out_specs=pl.BlockSpec((Bp, bo), lambda oi, ki: (0, oi)),
         out_shape=jax.ShapeDtypeStruct((Bp, O), jnp.float32),
         scratch_shapes=[pltpu.VMEM((Bp, bo), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(x, q, s.astype(jnp.float32))
@@ -173,7 +177,7 @@ def qmm4_pallas(x: jax.Array, q4: jax.Array, s: jax.Array,
         out_specs=pl.BlockSpec((Bp, bo), lambda oi, ki: (0, oi)),
         out_shape=jax.ShapeDtypeStruct((Bp, O), jnp.float32),
         scratch_shapes=[pltpu.VMEM((Bp, bo), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(x, q4, s.astype(jnp.float32))

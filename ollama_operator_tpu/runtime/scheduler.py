@@ -106,9 +106,21 @@ class Request:
 
     def tokens(self) -> Iterator[int]:
         """Blocking iterator over generated token ids."""
+        for chunk in self.chunks():
+            for tid in chunk:
+                yield tid
+
+    def chunks(self) -> Iterator[List[int]]:
+        """Blocking iterator over per-dispatch batches of token ids.
+
+        The scheduler queues ONE item per decode chunk (plus one for the
+        prefill-sampled token), not one per token — consumers that can
+        batch (detokenisation, HTTP frame assembly) should iterate here
+        instead of tokens() to keep queue/lock traffic per request at
+        O(generated / decode_chunk)."""
         while True:
             kind, payload = self.out.get()
-            if kind == "token":
+            if kind == "tokens":
                 yield payload
             elif kind == "done":
                 return
@@ -222,6 +234,20 @@ class Scheduler:
     def n_active(self) -> int:
         return sum(r is not None for r in self._running)
 
+    @property
+    def qsize(self) -> int:
+        """Requests waiting for a slot (queued + preempted). Public API
+        for metrics and the server's load probes — external code must
+        not reach into `_waiting`."""
+        return self._waiting.qsize() + len(self._preempted)
+
+    @property
+    def has_pending(self) -> bool:
+        """True while any request is running, queued, or preempted —
+        i.e. unloading the model now would strand a caller."""
+        return (self.n_active > 0 or bool(self._preempted)
+                or not self._waiting.empty())
+
     # ------------------------------------------------------------------
     def _finish(self, slot: int, req: Request, reason: str):
         # the LAST sampled token was never fed back through the model, so
@@ -242,17 +268,21 @@ class Scheduler:
                 self.finished = self.finished[-256:]
         req.out.put(("done", reason))
 
-    def _emit(self, req: Request, tid: int) -> bool:
-        """Queue one token; returns False if the request just finished."""
-        now = time.monotonic()
+    def _emit_first(self, req: Request, tid: int) -> bool:
+        """Queue the prefill-sampled token as its own chunk; returns False
+        if the request just finished. This token flushes immediately —
+        it IS the TTFT token, and holding it back to the first decode
+        flush would add a whole chunk dispatch to first-token latency."""
         if req.stats.n_generated == 0:
-            req.stats.t_first_token = now
+            # guard: a preempted request re-admitting must keep its
+            # original first-token stamp
+            req.stats.t_first_token = time.monotonic()
         req.all_tokens.append(tid)  # EOG included: it sits in the KV cache
         if tid in req.eog_ids:
             return False
         req.stats.n_generated += 1
         self.total_generated += 1
-        req.out.put(("token", tid))
+        req.out.put(("tokens", [tid]))
         return req.stats.n_generated < req.max_tokens
 
     def _best_prefix(self, req: Request):
@@ -380,7 +410,7 @@ class Scheduler:
                     and first not in req.eog_ids
                     and not req.constraint.advance(first)):
                 self._finish(slot, req, "stop")
-            elif not self._emit(req, first):
+            elif not self._emit_first(req, first):
                 self._finish(slot, req, "stop")
             elif req.constraint is not None:
                 self.engine.set_mask(slot, req.constraint.mask_row())
@@ -556,6 +586,17 @@ class Scheduler:
         else:
             toks_n = self.engine.decode_n(n_steps)
         self._consecutive_failures = 0
+        # per-slot chunk buffers: ONE queue item (and one monotonic stamp)
+        # per request per dispatch, not per token — at decode_chunk=32 this
+        # cuts queue/lock traffic on the consumer path 32×, which is the
+        # bulk of the HTTP-vs-engine throughput gap (BENCH_r05)
+        pend: dict = {}
+
+        def _flush(slot: int, req: Request):
+            buf = pend.pop(slot, None)
+            if buf:
+                req.out.put(("tokens", buf))
+
         for row_idx, row in enumerate(np.asarray(toks_n)):
             any_running = False
             for slot, req in enumerate(list(self._running)):
@@ -574,16 +615,35 @@ class Scheduler:
                 if (req.constraint is not None
                         and tid not in req.eog_ids
                         and not req.constraint.advance(tid)):
+                    _flush(slot, req)
                     self._finish(slot, req, "stop")
                     continue
-                if not self._emit(req, tid):
+                if req.stats.n_generated == 0:
+                    req.stats.t_first_token = time.monotonic()
+                req.all_tokens.append(tid)  # EOG incl.: it's in the KV cache
+                if tid in req.eog_ids:
+                    _flush(slot, req)
+                    self._finish(slot, req, "stop")
+                    continue
+                req.stats.n_generated += 1
+                self.total_generated += 1
+                pend.setdefault(slot, []).append(tid)
+                if req.stats.n_generated >= req.max_tokens:
+                    _flush(slot, req)
                     self._finish(slot, req, "stop")
                 # host-side length tracking (no device sync): the cache
                 # holds the prompt plus one entry per decode step so far
                 elif (req.stats.n_prompt + req.stats.n_generated
                       >= self.engine.max_seq - 1):
+                    _flush(slot, req)
                     self._finish(slot, req, "length")
                 elif req.constraint is not None:
                     self.engine.set_mask(slot, req.constraint.mask_row())
             if not any_running:
                 break
+        # end of dispatch: flush every still-running slot's chunk
+        for slot, buf in list(pend.items()):
+            req = self._running[slot]
+            if req is not None and buf:
+                req.out.put(("tokens", buf))
+        pend.clear()

@@ -75,6 +75,21 @@ class _OwnedStream:
 _schema_warned = [False]   # once-per-process format-schema downgrade notice
 
 
+class _Piece(str):
+    """A detokenised text piece that remembers how many scheduler tokens
+    produced it. The stream protocol stays (str, final) tuples — existing
+    consumers see a plain str — but the HTTP frame coalescer and bench
+    need token counts per piece, not character counts."""
+
+    n_tokens = 1
+
+    @staticmethod
+    def of(text: str, n: int) -> "_Piece":
+        p = _Piece(text)
+        p.n_tokens = n
+        return p
+
+
 def merge_options(defaults: Dict, request: Optional[Dict]
                   ) -> Tuple[SlotOptions, int, List[str]]:
     """(modelfile params, request options) → (SlotOptions, num_predict, stop)."""
@@ -207,7 +222,7 @@ class LoadedModel:
         METRICS.gauge_fn("tpu_model_queue_depth",
                          lambda: (lm := wself()) is not None
                          and lm.scheduler is not None
-                         and lm.scheduler._waiting.qsize() or 0)
+                         and lm.scheduler.qsize or 0)
         if self.engine.paged:
             # paged-pool pressure signal for autoscaling/alerting (the
             # preemption COUNTER lives in the scheduler — counters survive
@@ -425,14 +440,16 @@ class LoadedModel:
         all_ids: List[int] = []
         finished = False
         try:
-            for tid in req.tokens():
+            # chunk-granular consumption: one queue item, one batched
+            # detokenise, and one StopMatcher pass per decode dispatch
+            for chunk in req.chunks():
                 if cancel_event is not None and cancel_event.is_set():
                     req.cancel()
-                all_ids.append(tid)
-                piece = sm.feed(sd.feed(tid))
+                all_ids.extend(chunk)
+                piece = sm.feed(sd.feed_many(chunk))
                 if piece:
                     result.text += piece
-                    yield piece, None
+                    yield _Piece.of(piece, len(chunk)), None
                 if sm.hit:
                     req.cancel()
                     break
@@ -445,7 +462,7 @@ class LoadedModel:
         tail = sm.feed(sd.flush()) + sm.flush()
         if tail:
             result.text += tail
-            yield tail, None
+            yield _Piece.of(tail, 0), None   # tokens already counted above
         st = req.stats
         result.generated_tokens = st.n_generated
         result.ttft_s = st.ttft_s
@@ -598,23 +615,14 @@ class LoadedModel:
 class _IdleScheduler:
     """Scheduler facade for embedding-only models: always quiet, never
     broken — the manager's keep-alive reaper and load-health checks read
-    these fields (n_active, _waiting, finished, broken) on every
-    resident model."""
+    these fields (n_active, has_pending, qsize, finished, broken) on
+    every resident model."""
     n_active = 0
+    qsize = 0
+    has_pending = False
     broken = False
     n_preemptions = 0
     finished = ()      # reaper: no completed generations to re-arm from
-
-    class _EmptyQ:
-        @staticmethod
-        def empty():
-            return True
-
-        @staticmethod
-        def qsize():
-            return 0
-
-    _waiting = _EmptyQ()
 
     def shutdown(self):
         pass

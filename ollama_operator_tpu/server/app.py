@@ -29,11 +29,13 @@ from __future__ import annotations
 import dataclasses
 import json
 import math
+import os
+import queue
 import threading
 import time
 from datetime import datetime, timezone
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict, Iterator, Optional
+from typing import Dict, Iterator, Optional, Tuple
 
 import numpy as np
 
@@ -75,6 +77,78 @@ def _decode_images(images):
 
 def _now_iso() -> str:
     return datetime.now(timezone.utc).isoformat()
+
+
+# streaming-coalescing defaults: flush a frame every N tokens or T ms,
+# whichever comes first (the first piece always flushes immediately — it
+# carries TTFT). N=16 halves frame count at decode_chunk=8 and is a no-op
+# relative to chunking at decode_chunk=32; 25 ms keeps perceived latency
+# below a display refresh even when tokens trickle.
+STREAM_FLUSH_TOKENS = 16
+STREAM_FLUSH_MS = 25.0
+
+
+def resolve_stream_flush(options: Optional[Dict]) -> Tuple[int, float]:
+    """(tokens-per-frame, seconds-between-frames) for stream coalescing.
+
+    Request options (`stream_flush_tokens`, `stream_flush_ms`) override
+    the env (TPU_STREAM_FLUSH_TOKENS / TPU_STREAM_FLUSH_MS), which
+    overrides the defaults. `stream_flush_tokens: 1` restores per-piece
+    frames."""
+    o = options or {}
+    try:
+        n = int(o.get("stream_flush_tokens",
+                      os.environ.get("TPU_STREAM_FLUSH_TOKENS",
+                                     STREAM_FLUSH_TOKENS)))
+    except (TypeError, ValueError):
+        n = STREAM_FLUSH_TOKENS
+    try:
+        ms = float(o.get("stream_flush_ms",
+                         os.environ.get("TPU_STREAM_FLUSH_MS",
+                                        STREAM_FLUSH_MS)))
+    except (TypeError, ValueError):
+        ms = STREAM_FLUSH_MS
+    return max(1, n), max(0.0, ms) / 1000.0
+
+
+class _StreamCoalescer:
+    """Batches streamed text pieces into wire frames.
+
+    The first piece flushes immediately (it is the TTFT token); after
+    that a frame goes out every `max_tokens` tokens or `max_s` seconds,
+    whichever comes first. Frames are assembled from pre-serialised
+    invariant byte fragments into one reused per-request buffer, so the
+    steady-state cost per frame is one strftime-free timestamp, one
+    json.dumps of the text, and one socket write."""
+
+    def __init__(self, chunk_fn, make_frame, max_tokens: int, max_s: float):
+        self._chunk = chunk_fn
+        self._make = make_frame
+        self.max_tokens = max_tokens
+        self.max_s = max_s
+        self._parts = []
+        self._ntok = 0
+        self._t_last = None     # None → flush the first piece immediately
+        self.frames = 0
+
+    def add(self, piece: str):
+        self._parts.append(piece)
+        self._ntok += getattr(piece, "n_tokens", 1)
+        now = time.monotonic()
+        if (self._t_last is None or self._ntok >= self.max_tokens
+                or now - self._t_last >= self.max_s):
+            self.flush(now)
+
+    def flush(self, now: Optional[float] = None):
+        if not self._parts:
+            return
+        text = "".join(self._parts)
+        self._parts.clear()
+        self._ntok = 0
+        self._t_last = time.monotonic() if now is None else now
+        self._chunk(self._make(text))
+        self.frames += 1
+        METRICS.inc("tpu_model_stream_frames_total")
 
 
 def _fmt_params(n: int) -> str:
@@ -197,8 +271,7 @@ class ModelManager:
                     continue
                 # only unload a quiet model: active slots / queued requests
                 # push the actual unload past the deadline
-                if (lm.scheduler.n_active > 0
-                        or not lm.scheduler._waiting.empty()):
+                if lm.scheduler.has_pending:
                     continue
                 # deadline is armed at request START; a generation longer
                 # than keep_alive must still get its full idle window after
@@ -222,8 +295,7 @@ class ModelManager:
             lm = self.loaded
             if lm is None or lm.name != name.short:
                 return False
-            if (lm.scheduler.n_active > 0
-                    or not lm.scheduler._waiting.empty()):
+            if lm.scheduler.has_pending:
                 self._last_ka = 0.0
                 self.expires_at = time.monotonic()  # reap once drained
                 return True
@@ -668,6 +740,10 @@ class Handler(BaseHTTPRequestHandler):
     manager: ModelManager = None  # set by serve()
     protocol_version = "HTTP/1.1"
     server_version = "tpu-ollama/" + __version__
+    # with a BOUNDED worker pool (_DeepStackHTTPServer), an idle
+    # keep-alive connection parked on readline() must not hold a worker
+    # forever — time it out and let the client reconnect
+    timeout = 75
 
     # -- helpers --------------------------------------------------------
     def log_message(self, fmt, *args):  # quiet by default
@@ -738,6 +814,32 @@ class Handler(BaseHTTPRequestHandler):
 
     def _stream_json(self, obj):
         self._chunk(json.dumps(obj).encode() + b"\n")
+
+    def _coalescer(self, pre: bytes, mid: Optional[bytes], suf: bytes,
+                   options: Optional[Dict]) -> _StreamCoalescer:
+        """Frame coalescer over this response's chunked stream. A frame is
+        `pre + now_iso + mid + json(text) + suf` (NDJSON; the timestamp
+        is the only other varying part) or `pre + json(text) + suf` when
+        ``mid`` is None (SSE chunks carry no per-frame timestamp). The
+        fragments must reproduce json.dumps' default rendering of the
+        full frame dict byte-for-byte — the wire format is unchanged,
+        only how many tokens each frame carries."""
+        n, s = resolve_stream_flush(options)
+        buf = bytearray()
+
+        def make(text: str) -> bytearray:
+            buf.clear()
+            buf.extend(pre)
+            if mid is not None:
+                # an ISO-8601 UTC timestamp is plain ASCII with no JSON
+                # escapes, so splicing it raw equals json.dumps output
+                buf.extend(_now_iso().encode())
+                buf.extend(mid)
+            buf.extend(json.dumps(text).encode())
+            buf.extend(suf)
+            return buf
+
+        return _StreamCoalescer(self._chunk, make, n, s)
 
     # -- routing --------------------------------------------------------
     def do_GET(self):
@@ -900,12 +1002,16 @@ class Handler(BaseHTTPRequestHandler):
                                  format=body.get("format"))
         if stream:
             self._start_stream()
+            co = self._coalescer(
+                b'{"model": ' + json.dumps(model).encode()
+                + b', "created_at": "',
+                b'", "response": ', b', "done": false}\n',
+                body.get("options"))
             for piece, final in gen:
                 if final is None:
-                    self._stream_json({"model": model,
-                                       "created_at": _now_iso(),
-                                       "response": piece, "done": False})
+                    co.add(piece)
                 else:
+                    co.flush()
                     self._stream_json(self._final_chunk(model, final, body))
             self._end_stream()
         else:
@@ -969,13 +1075,17 @@ class Handler(BaseHTTPRequestHandler):
 
         if stream and not tools:
             self._start_stream()
+            co = self._coalescer(
+                b'{"model": ' + json.dumps(model).encode()
+                + b', "created_at": "',
+                b'", "message": {"role": "assistant", "content": ',
+                b'}, "done": false}\n',
+                body.get("options"))
             for piece, final in gen:
                 if final is None:
-                    self._stream_json({
-                        "model": model, "created_at": _now_iso(),
-                        "message": {"role": "assistant", "content": piece},
-                        "done": False})
+                    co.add(piece)
                 else:
+                    co.flush()
                     out = self._final_chunk(model, final, body)
                     out.pop("response", None)
                     out.pop("context", None)
@@ -1250,17 +1360,20 @@ class Handler(BaseHTTPRequestHandler):
                 "choices": [{"index": 0,
                              "delta": {"role": "assistant", "content": ""},
                              "finish_reason": None}]}))
+            co = self._coalescer(
+                b'data: {"id": ' + json.dumps(rid).encode()
+                + b', "object": "chat.completion.chunk", "created": '
+                + str(created).encode() + b', "model": '
+                + json.dumps(model).encode()
+                + b', "choices": [{"index": 0, "delta": {"content": ',
+                None, b'}, "finish_reason": null}]}\n\n', options)
             final = None
             for piece, f in gen:
                 if f is None:
-                    self._chunk(self._sse({
-                        "id": rid, "object": "chat.completion.chunk",
-                        "created": created, "model": model,
-                        "choices": [{"index": 0,
-                                     "delta": {"content": piece},
-                                     "finish_reason": None}]}))
+                    co.add(piece)
                 else:
                     final = f
+            co.flush()
             self._chunk(self._sse({
                 "id": rid, "object": "chat.completion.chunk",
                 "created": created, "model": model,
@@ -1325,27 +1438,79 @@ class Handler(BaseHTTPRequestHandler):
 
 
 class _DeepStackHTTPServer(ThreadingHTTPServer):
-    """ThreadingHTTPServer whose HANDLER threads get a deep stack.
+    """ThreadingHTTPServer with a bounded deep-stack worker pool.
 
-    Handler threads can run XLA compiles (a /api/chat that loads a model
-    warms its buckets on the request thread); LLVM recursion is
-    stack-hungry and a default thread stack invites a native overflow.
-    The bump is scoped to handler-thread creation and restored right
-    after — `threading.stack_size` is process-global, and leaving 64 MiB
-    set would tax every thread the process creates afterwards. (A thread
-    spawned elsewhere during this narrow window also gets the deep
-    stack; that is a virtual reservation, not committed memory.)"""
+    Two departures from stock ThreadingHTTPServer:
+
+    - Worker threads are POOLED and capped (TPU_HTTP_WORKERS, default
+      64): stock spawns one thread per connection, so a load-balancer
+      health-check storm or slow-reading client fleet grows threads
+      without bound, and every spawn pays thread start-up on the request
+      path. Workers here are spawned lazily up to the cap and then
+      reused; excess connections queue until a worker frees.
+    - Workers get a deep (64 MiB) stack: handler threads can run XLA
+      compiles (a /api/chat that loads a model warms its buckets on the
+      request thread), and LLVM recursion overflows a default stack.
+      `threading.stack_size` is process-global, so the bump is scoped to
+      the spawn and restored right after. (A thread spawned elsewhere in
+      this narrow window also gets the deep stack; that is a virtual
+      reservation, not committed memory.)"""
+
+    daemon_threads = True
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._pool_q: queue.Queue = queue.Queue()
+        self._pool_lock = threading.Lock()
+        self._workers = 0
+        self._idle = 0
+        self._max_workers = max(
+            1, int(os.environ.get("TPU_HTTP_WORKERS", "64") or "64"))
+
+    def _worker(self):
+        while True:
+            item = self._pool_q.get()
+            if item is None:
+                return
+            with self._pool_lock:
+                self._idle -= 1
+            request, client_address = item
+            try:
+                self.finish_request(request, client_address)
+            except Exception:  # noqa: BLE001 — mirror ThreadingMixIn
+                self.handle_error(request, client_address)
+            finally:
+                self.shutdown_request(request)
+                with self._pool_lock:
+                    self._idle += 1
 
     def process_request(self, request, client_address):
-        try:
-            old = threading.stack_size(64 << 20)
-        except (ValueError, RuntimeError):
-            old = None
-        try:
-            super().process_request(request, client_address)
-        finally:
-            if old is not None:
-                threading.stack_size(old)
+        with self._pool_lock:
+            # spawn only when no worker will be free to take this item
+            # once the backlog drains, and only below the cap
+            if (self._idle - self._pool_q.qsize() <= 0
+                    and self._workers < self._max_workers):
+                self._workers += 1
+                self._idle += 1   # counted idle until it picks up work
+                try:
+                    old = threading.stack_size(64 << 20)
+                except (ValueError, RuntimeError):
+                    old = None
+                try:
+                    threading.Thread(
+                        target=self._worker, daemon=True,
+                        name=f"http-worker-{self._workers}").start()
+                finally:
+                    if old is not None:
+                        threading.stack_size(old)
+        self._pool_q.put((request, client_address))
+
+    def server_close(self):
+        super().server_close()
+        with self._pool_lock:
+            n = self._workers
+        for _ in range(n):
+            self._pool_q.put(None)
 
 
 def serve(manager: ModelManager, host: str = "0.0.0.0", port: int = 11434

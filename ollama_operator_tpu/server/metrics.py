@@ -125,6 +125,10 @@ GLOBAL.describe("tpu_model_kv_free_pages",
                 "Free pages in the paged KV pool (paged mode)")
 GLOBAL.describe("tpu_model_preemptions_total",
                 "Requests preempted and requeued under KV-pool pressure")
+GLOBAL.describe("tpu_model_stream_frames_total",
+                "Streamed NDJSON/SSE frames written (after coalescing; "
+                "compare to tpu_model_generated_tokens_total for the "
+                "tokens-per-frame ratio)")
 
 
 class Stopwatch:

@@ -379,6 +379,20 @@ class StreamDecoder:
                 continue
         return ""
 
+    def feed_many(self, tids) -> str:
+        """Batch form of feed(): join a whole decode chunk's piece bytes
+        and run ONE valid-prefix scan over the result, instead of one
+        buffer append + scan per token."""
+        self._buf += b"".join(self.tok.piece_bytes(t) for t in tids)
+        for cut in range(len(self._buf), max(len(self._buf) - 4, -1), -1):
+            try:
+                s = self._buf[:cut].decode("utf-8")
+                self._buf = self._buf[cut:]
+                return s
+            except UnicodeDecodeError:
+                continue
+        return ""
+
     def flush(self) -> str:
         s = self._buf.decode("utf-8", errors="replace")
         self._buf = b""

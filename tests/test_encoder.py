@@ -257,8 +257,8 @@ def test_embedding_model_serves_and_rejects_generate(tmp_path):
 def test_embedding_model_keep_alive_reaps(tmp_path):
     """The keep-alive reaper must unload an idle embedding model: the
     idle-scheduler facade carries every field the reaper reads
-    (n_active, _waiting, finished) — a missing one would kill the reaper
-    thread and disable keep_alive server-wide."""
+    (n_active, has_pending, finished) — a missing one would kill the
+    reaper thread and disable keep_alive server-wide."""
     import time as _time
 
     from ollama_operator_tpu.server.app import ModelManager

@@ -14,7 +14,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ollama_operator_tpu.models import config as cfglib
 from ollama_operator_tpu.models import decoder
-from ollama_operator_tpu.ops.attention import attend_hf, causal_mask
+from ollama_operator_tpu.ops.attention import (attend_hf, causal_mask,
+                                               shard_map_compat)
 from ollama_operator_tpu.parallel import MeshPlan, make_mesh, shard_params
 from ollama_operator_tpu.parallel import long_context as lc
 from ollama_operator_tpu.parallel.ring_attention import (
@@ -42,7 +43,7 @@ def _ring_dense_pair(sp, T=32, window=0, seed=0):
     mask = jnp.broadcast_to(mask, (B, 1, T, T))
     ref = attend_hf(q, k, v, mask, scale)
 
-    fn = jax.jit(jax.shard_map(
+    fn = jax.jit(shard_map_compat(
         lambda q, k, v: ring_attention(q, k, v, scale, "sp",
                                        sliding_window=window),
         mesh=mesh,
@@ -79,7 +80,7 @@ def test_sp_decode_attention_matches_dense():
     mask = jnp.where(k_pos <= q_pos[:, :, None], 0.0, -1e30)[:, None]
     ref = attend_hf(q, kc, vc, mask, scale)
 
-    fn = jax.jit(jax.shard_map(
+    fn = jax.jit(shard_map_compat(
         lambda q, kc, vc, qp: sp_decode_attention(q, kc, vc, qp, scale, "sp"),
         mesh=mesh,
         in_specs=(P(), P(None, None, "sp"), P(None, None, "sp"), P()),
@@ -102,7 +103,7 @@ def test_sp_cache_write_places_tokens_on_owner():
     k_new = jnp.ones((B, KvH, T, hd), F32) * vals.transpose(0, 2, 1, 3)
     pos = jnp.array([[3, 4], [12, 13]], jnp.int32)
 
-    fn = jax.jit(jax.shard_map(
+    fn = jax.jit(shard_map_compat(
         lambda kc, vc, kn, vn, p: sp_cache_write(kc, vc, kn, vn, p, "sp"),
         mesh=mesh,
         in_specs=(P(None, None, "sp"), P(None, None, "sp"), P(), P(), P()),

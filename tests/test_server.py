@@ -286,6 +286,54 @@ def test_streaming_backpressure_is_http_503(stack):
         lm.scheduler.submit = orig
 
 
+def test_client_disconnect_mid_stream_frees_slot(stack):
+    """Dropping the socket mid-stream must cancel the request and free the
+    decode slot promptly (the write failure closes the generator, whose
+    cleanup cancels the scheduler request) — a slot burned to max_tokens
+    after a disconnect is capacity stolen from live clients."""
+    import socket
+    import time as _time
+
+    lm = stack["manager"].require_loaded(_model_name(stack))
+    captured = {}
+    orig = lm.scheduler.submit
+
+    def capture_submit(*a, **k):
+        captured["req"] = orig(*a, **k)
+        return captured["req"]
+
+    lm.scheduler.submit = capture_submit
+    host, port = stack["base"].split("://")[1].split(":")
+    body = json.dumps({"model": _model_name(stack), "prompt": "t1",
+                       "stream": True, "raw": True,
+                       "options": {"num_predict": 10_000,
+                                   "temperature": 0.0,
+                                   "stream_flush_tokens": 1}}).encode()
+    s = socket.create_connection((host, int(port)), timeout=60)
+    try:
+        s.sendall(b"POST /api/generate HTTP/1.1\r\n"
+                  b"Host: " + host.encode() + b"\r\n"
+                  b"Content-Type: application/json\r\n"
+                  b"Content-Length: " + str(len(body)).encode() +
+                  b"\r\n\r\n" + body)
+        buf = b""
+        while b'"done": false' not in buf:
+            chunk = s.recv(4096)
+            assert chunk, "stream closed before first frame"
+            buf += chunk
+    finally:
+        # abrupt close mid-stream, without reading the rest
+        s.close()
+        lm.scheduler.submit = orig
+    req = captured["req"]
+    deadline = _time.time() + 60
+    while _time.time() < deadline and lm.scheduler.n_active:
+        _time.sleep(0.02)
+    assert lm.scheduler.n_active == 0
+    # cancelled well before max_tokens, not decoded to completion
+    assert req.stats.n_generated < req.max_tokens
+
+
 def test_broken_scheduler_reloads_on_next_request(stack):
     """A wedged decode loop must not zombie the pod: load() tears down a
     broken scheduler and brings up a fresh engine for the same model."""
