@@ -62,8 +62,27 @@ import gc  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running tests excluded from tier-1 CI")
+    config.addinivalue_line(
+        "markers", "chaos: fault-injection recovery tests (runtime/faults"
+        ".py); the CI chaos-smoke job runs exactly this set")
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _bound_xla_state():
     yield
     jax.clear_caches()
     gc.collect()
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    """No injected fault may leak across tests: the registry is process-
+    global by design (the code under test reaches it via one module
+    attribute), so every test starts and ends clean."""
+    from ollama_operator_tpu.runtime.faults import FAULTS
+    FAULTS.reset()
+    yield
+    FAULTS.reset()

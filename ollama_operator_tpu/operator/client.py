@@ -17,12 +17,15 @@ from __future__ import annotations
 
 import json
 import os
+import random
 import ssl
 import threading
 import time
 import urllib.error
 import urllib.request
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+from ..runtime.faults import FAULTS
 
 SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
@@ -132,6 +135,7 @@ class KubeClient:
             from urllib.parse import urlencode
             url += "?" + urlencode(query)
         data = json.dumps(body).encode() if body is not None else None
+        FAULTS.check("kube.request")
         req = urllib.request.Request(url, data=data, method=method)
         req.add_header("Accept", "application/json")
         if data is not None:
@@ -158,8 +162,8 @@ class KubeClient:
     def get(self, api_version: str, kind: str, namespace: Optional[str],
             name: str) -> Optional[Dict[str, Any]]:
         try:
-            return self._json(
-                "GET", resource_path(api_version, kind, namespace, name))
+            return retry_transient(lambda: self._json(
+                "GET", resource_path(api_version, kind, namespace, name)))
         except NotFound:
             return None
 
@@ -195,8 +199,8 @@ class KubeClient:
         query = {}
         if label_selector:
             query["labelSelector"] = label_selector
-        out = self._json(
-            "GET", resource_path(api_version, kind, namespace), query=query)
+        out = retry_transient(lambda: self._json(
+            "GET", resource_path(api_version, kind, namespace), query=query))
         return out.get("items", [])
 
     # --- watch ----------------------------------------------------------
@@ -219,10 +223,15 @@ class KubeClient:
         req.add_header("Accept", "application/json")
         if self.token:
             req.add_header("Authorization", f"Bearer {self.token}")
+        def _open():
+            # reconnect-with-backoff on transient open failures: a watch
+            # that dies on an apiserver blip otherwise drops events until
+            # the manager's next full relist
+            return urllib.request.urlopen(req, timeout=timeout_seconds + 15,
+                                          context=self._ctx)
+
         try:
-            with urllib.request.urlopen(
-                    req, timeout=timeout_seconds + 15,
-                    context=self._ctx) as resp:
+            with retry_transient(_open) as resp:
                 for line in resp:
                     if stop is not None and stop.is_set():
                         return
@@ -254,3 +263,34 @@ def retry_on_conflict(fn: Callable[[], Any], attempts: int = 5,
             if i == attempts - 1:
                 raise
             time.sleep(backoff * (2 ** i))
+
+
+def _is_transient(e: Exception) -> bool:
+    """Failures worth retrying on READ-ONLY verbs: apiserver 5xx, raw
+    connection errors, and injected kube.request faults. 4xx (incl.
+    NotFound/Conflict, both status < 500) are real answers — never
+    retried. Writes are not retried at all: a timed-out create may have
+    landed, and blind replays would duplicate side effects."""
+    from ..runtime.faults import InjectedFault
+    if isinstance(e, ApiError):
+        return e.status >= 500
+    # HTTPError subclasses URLError — classify by code first
+    if isinstance(e, urllib.error.HTTPError):
+        return e.code >= 500
+    return isinstance(e, (urllib.error.URLError, TimeoutError,
+                          ConnectionError, InjectedFault))
+
+
+def retry_transient(fn: Callable[[], Any], attempts: int = 4,
+                    backoff: float = 0.05, cap: float = 2.0) -> Any:
+    """Capped exponential backoff + full jitter around a read-only call,
+    mirroring retry_on_conflict's shape (client-go's default GET backoff
+    does the same against apiserver blips)."""
+    for i in range(attempts):
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 — filtered by _is_transient
+            if not _is_transient(e) or i == attempts - 1:
+                raise
+            time.sleep(min(cap, backoff * (2 ** i))
+                       * (0.5 + random.random() / 2))

@@ -313,8 +313,19 @@ class Manager:
                 log.warning("resync list failed: %s", e)
 
     # --- reconcile workers ----------------------------------------------
+    # a requeue at/above this is a steady-state POLL (reconciler.POLL is
+    # 5s; KICKOFF and rollout-progress requeues are shorter) and is
+    # eligible for per-model backoff
+    POLL_BACKOFF_FLOOR = 2.0
+    POLL_BACKOFF_CAP = 60.0
+
     def _worker(self) -> None:
         backoff: Dict[Tuple[str, str], float] = {}
+        # consecutive steady-state POLL results per model: a Model stuck
+        # waiting (image pull, scheduling, quota) polls at 5s, then 7.5s,
+        # … capped at 60s instead of hammering the apiserver at a fixed
+        # interval forever; any non-POLL result (progress!) resets it
+        poll_streak: Dict[Tuple[str, str], int] = {}
         while not self._stop.is_set():
             key = self.queue.get(timeout=0.5)
             if key is None:
@@ -326,11 +337,19 @@ class Manager:
             try:
                 res: Result = self.reconciler.reconcile(*key)
                 backoff.pop(key, None)
-                self.queue.done(key, requeue_after=(
-                    res.requeue_after if res.requeue_after is not None
-                    else -1.0))
+                requeue = (res.requeue_after
+                           if res.requeue_after is not None else -1.0)
+                if requeue >= self.POLL_BACKOFF_FLOOR:
+                    streak = poll_streak.get(key, 0)
+                    requeue = min(requeue * (1.5 ** streak),
+                                  self.POLL_BACKOFF_CAP)
+                    poll_streak[key] = streak + 1
+                else:
+                    poll_streak.pop(key, None)
+                self.queue.done(key, requeue_after=requeue)
             except NotFound:
                 backoff.pop(key, None)
+                poll_streak.pop(key, None)
                 self.queue.done(key)
             except Exception as e:  # noqa: BLE001 — keep the loop alive
                 self.reconcile_errors += 1

@@ -1,1 +1,14 @@
-from .engine import Engine, EngineConfig  # noqa: F401
+"""Serving runtime package.
+
+Engine/EngineConfig re-export lazily (PEP 562): the operator's control
+plane imports light runtime modules (faults, errors) for fault injection
+and typed failures, and must not drag jax/XLA into the manager process
+just by touching the package.
+"""
+
+
+def __getattr__(name):
+    if name in ("Engine", "EngineConfig"):
+        from .engine import Engine, EngineConfig
+        return {"Engine": Engine, "EngineConfig": EngineConfig}[name]
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

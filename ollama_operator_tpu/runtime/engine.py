@@ -35,6 +35,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..models.config import ModelConfig
 from ..models import decoder
 from ..ops import sampling
+from .faults import FAULTS
 from ..parallel.sharding import (kv_cache_pspec, params_sharding_tree,
                                  resolve_moe_impl)
 
@@ -1233,6 +1234,7 @@ class Engine:
         requests); the caller then keeps per-step masks flowing via
         ``set_mask``.
         """
+        FAULTS.check("engine.admit")
         assert not self.active[slot], f"slot {slot} busy"
         n = int(prompt.shape[0])
         if n >= self.max_seq:
@@ -1670,6 +1672,7 @@ class Engine:
         Paged mode: callers that want preemption-on-pool-dry run
         ``prepare_decode`` themselves first and requeue the victims; here
         a dry pool raises (tests/bench size their pools adequately)."""
+        FAULTS.check("engine.step")
         n = n or self.ecfg.decode_chunk
         victims = self.prepare_decode(n)
         if victims:

@@ -9,3 +9,28 @@ advisor finding on server/app.py's blanket ValueError handler).
 
 class BadRequest(ValueError):
     """The request is malformed or unsatisfiable; client's fault (HTTP 400)."""
+
+
+class DeadlineExceeded(RuntimeError):
+    """A request ran out of wall-clock budget (``deadline_ms``).
+
+    ``while_queued`` distinguishes the two HTTP mappings: a request shed
+    before it ever held a slot maps to 503 + ``Retry-After`` (the caller
+    lost nothing and should retry elsewhere); a request cut off
+    mid-generation maps to a terminal stream frame with finish reason
+    ``timeout`` (partial output was already sent) or 504 pre-stream.
+    """
+
+    def __init__(self, msg: str, *, while_queued: bool, retry_after_s: int = 1):
+        super().__init__(msg)
+        self.while_queued = while_queued
+        self.retry_after_s = retry_after_s
+
+
+class FollowerLost(RuntimeError):
+    """A multi-host follower connection died; the world is degraded.
+
+    Raised by ``ControlPlane.broadcast`` instead of desyncing the
+    leader/follower worlds mid-dispatch. The serving layer maps it to a
+    500; recovery is a pod-level restart of the replica group.
+    """

@@ -1,0 +1,146 @@
+"""Deterministic fault injection for crash-only serving tests.
+
+Every recovery path in the runtime (supervised engine restarts, request
+deadlines, follower loss, kube retries) is driven in tests by *real*
+injected faults rather than monkeypatched internals.  Code under test
+calls ``FAULTS.check("<point>")`` at a named fault point; the check is a
+no-op (one dict lookup on an empty dict) unless a rule has been armed
+for that point via the test API or the ``TPU_FAULTS`` env var.
+
+Fault points wired through the codebase:
+
+    engine.step     -- top of ``Engine.decode_n`` (the decode hot loop)
+    engine.admit    -- top of ``Engine.admit`` (prefill/admission)
+    detok.feed      -- service detokeniser feed, per chunk
+    follower.send   -- ``ControlPlane._send`` to each follower conn
+    kube.request    -- ``KubeClient._request`` before the HTTP call
+
+Trigger specs (the grammar is intentionally tiny):
+
+    fail            -- raise InjectedFault on every hit
+    fail:once       -- raise on the first hit, then disarm the point
+    fail:n=K        -- raise on the first K hits, then disarm
+    fail:every=K    -- raise on every K-th hit (hit K, 2K, ...)
+    fail:after=K    -- pass K hits, then raise on every later hit
+    delay:50ms      -- sleep 50ms on every hit (also: delay:0.2s)
+
+Env arming: ``TPU_FAULTS="engine.step=fail:once,kube.request=delay:10ms"``.
+Stdlib only; no dependency on jax so the operator can import it too.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+
+class InjectedFault(RuntimeError):
+    """Raised by an armed ``fail`` rule at a fault point."""
+
+    def __init__(self, point: str, spec: str):
+        super().__init__(f"injected fault at {point!r} ({spec})")
+        self.point = point
+        self.spec = spec
+
+
+def _parse_spec(spec: str) -> Tuple[str, Optional[str], float]:
+    """Return (kind, mode, value): kind in {fail, delay}."""
+    spec = spec.strip()
+    kind, _, arg = spec.partition(":")
+    kind = kind.strip()
+    arg = arg.strip()
+    if kind == "fail":
+        if not arg:
+            return "fail", "always", 0.0
+        if arg == "once":
+            return "fail", "n", 1.0
+        mode, _, val = arg.partition("=")
+        if mode in ("n", "every", "after") and val:
+            k = int(val)
+            if k < 1:
+                raise ValueError(f"fault spec {spec!r}: count must be >= 1")
+            return "fail", mode, float(k)
+        raise ValueError(f"unknown fail spec {spec!r}")
+    if kind == "delay":
+        if arg.endswith("ms"):
+            return "delay", "always", float(arg[:-2]) / 1000.0
+        if arg.endswith("s"):
+            return "delay", "always", float(arg[:-1])
+        raise ValueError(f"delay spec {spec!r} needs a ms/s suffix")
+    raise ValueError(f"unknown fault spec {spec!r}")
+
+
+class FaultInjector:
+    """Registry of armed fault rules, keyed by fault-point name."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # point -> (spec string, kind, mode, value)
+        self._rules: Dict[str, Tuple[str, str, str, float]] = {}
+        self._counts: Dict[str, int] = {}
+
+    def arm(self, point: str, spec: str) -> None:
+        rule = _parse_spec(spec)
+        with self._lock:
+            self._rules[point] = (spec, *rule)
+            self._counts[point] = 0
+
+    def disarm(self, point: str) -> None:
+        with self._lock:
+            self._rules.pop(point, None)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._rules.clear()
+            self._counts.clear()
+
+    def hits(self, point: str) -> int:
+        with self._lock:
+            return self._counts.get(point, 0)
+
+    def check(self, point: str) -> None:
+        """Call at a fault point. No-op unless a rule is armed for it."""
+        if not self._rules:  # fast path: nothing armed anywhere
+            return
+        with self._lock:
+            rule = self._rules.get(point)
+            if rule is None:
+                return
+            n = self._counts.get(point, 0) + 1
+            self._counts[point] = n
+            spec, kind, mode, value = rule
+            if kind == "fail":
+                if mode == "always":
+                    fire = True
+                elif mode == "n":
+                    fire = n <= value
+                    if n >= value:
+                        del self._rules[point]
+                elif mode == "every":
+                    fire = n % int(value) == 0
+                else:  # after
+                    fire = n > value
+            else:  # delay
+                fire = True
+        # act outside the lock so a sleep never blocks other points
+        if kind == "fail":
+            if fire:
+                raise InjectedFault(point, spec)
+            return
+        if fire and value > 0:
+            time.sleep(value)
+
+    def arm_from_env(self, env: str = "TPU_FAULTS") -> None:
+        raw = os.environ.get(env, "")
+        for part in raw.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            point, _, spec = part.partition("=")
+            self.arm(point.strip(), spec.strip())
+
+
+FAULTS = FaultInjector()
+FAULTS.arm_from_env()

@@ -27,6 +27,7 @@ by the operator as TPU_DIST_CONTROL (operator/pod.py).
 
 from __future__ import annotations
 
+import os
 import pickle
 import socket
 import struct
@@ -34,6 +35,10 @@ import sys
 import threading
 import time
 from typing import Any, List, Optional
+
+from ..server.metrics import GLOBAL as METRICS
+from .errors import FollowerLost
+from .faults import FAULTS, InjectedFault
 
 CONTROL_PORT_OFFSET = 1      # coordinator port + 1
 
@@ -67,7 +72,8 @@ def _recv(sock: socket.socket) -> Any:
 class ControlPlane:
     """Process 0's broadcast channel to the followers."""
 
-    def __init__(self, n_followers: int, port: int, bind: str = "0.0.0.0"):
+    def __init__(self, n_followers: int, port: int, bind: str = "0.0.0.0",
+                 heartbeat_s: Optional[float] = None):
         self.n = n_followers
         # serializes broadcast+local-dispatch pairs: the follower replays
         # the stream single-threaded in FIFO order, so every leader
@@ -79,16 +85,35 @@ class ControlPlane:
         self._conns: List[socket.socket] = []
         self._lock = threading.Lock()
         self._ready = threading.Event()
+        # set on the first failed send: once any follower is gone the
+        # SPMD world cannot make progress (a collective would hang), so
+        # every later broadcast fails fast with FollowerLost instead of
+        # half-dispatching and desyncing the survivors
+        self.degraded = False
+        self.degraded_reason: Optional[str] = None
+        self._hb_stop = threading.Event()
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._srv.bind((bind, port))
         self._srv.listen(n_followers)
         threading.Thread(target=self._accept_loop, daemon=True).start()
         log(f"awaiting {n_followers} follower(s) on :{port}")
+        # idle-path failure detection: a dead follower pod otherwise goes
+        # unnoticed until the next real dispatch blocks a request. 0
+        # disables (tests drive broadcast() directly).
+        if heartbeat_s is None:
+            heartbeat_s = float(os.environ.get("TPU_CP_HEARTBEAT_S", "10"))
+        self.heartbeat_s = heartbeat_s
+        if heartbeat_s > 0:
+            threading.Thread(target=self._heartbeat_loop,
+                             daemon=True).start()
 
     def _accept_loop(self):
         while len(self._conns) < self.n:
-            conn, addr = self._srv.accept()
+            try:
+                conn, addr = self._srv.accept()
+            except OSError:     # listener closed during shutdown
+                return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             with self._lock:
                 self._conns.append(conn)
@@ -96,15 +121,49 @@ class ControlPlane:
                 f"({len(self._conns)}/{self.n})")
         self._ready.set()
 
+    def _heartbeat_loop(self):
+        self._ready.wait()
+        while not self._hb_stop.wait(self.heartbeat_s):
+            try:
+                with self.dispatch_lock:
+                    self.broadcast(("ping",))
+            except FollowerLost:
+                return          # degraded is set; nothing left to probe
+
+    def _mark_degraded(self, reason: str) -> FollowerLost:
+        if not self.degraded:
+            self.degraded = True
+            self.degraded_reason = reason
+            METRICS.inc("tpu_model_followers_lost_total")
+            log(f"DEGRADED: {reason}")
+        return FollowerLost(reason)
+
     def broadcast(self, msg: tuple) -> None:
         """FIFO broadcast; blocks until the full follower set has joined
-        (a call dispatched before the world is complete would desync)."""
+        (a call dispatched before the world is complete would desync).
+        A send failure closes the dead conn, marks the world degraded,
+        and raises :class:`FollowerLost` — the typed error surfaces to
+        the caller instead of a half-dispatched desync."""
+        if self.degraded:
+            raise FollowerLost(
+                f"control plane degraded: {self.degraded_reason}")
         self._ready.wait()
         with self._lock:
-            for c in self._conns:
-                _send(c, msg)
+            for c in list(self._conns):
+                try:
+                    FAULTS.check("follower.send")
+                    _send(c, msg)
+                except (OSError, InjectedFault) as e:
+                    try:
+                        c.close()
+                    except OSError:
+                        pass
+                    self._conns.remove(c)
+                    raise self._mark_degraded(
+                        f"send to follower failed: {e}") from e
 
     def close(self):
+        self._hb_stop.set()
         with self._lock:
             for c in self._conns:
                 try:
@@ -185,6 +244,8 @@ def run_follower(manager, host: str, port: int,
     while True:
         msg = _recv(sock)
         op = msg[0]
+        if op == "ping":
+            continue             # leader heartbeat; liveness only
         if op == "load":
             lm = manager.load(msg[1])
             engine = lm.engine

@@ -16,6 +16,7 @@ the slot is released on the next loop iteration.
 from __future__ import annotations
 
 import dataclasses
+import os
 import queue
 import sys
 import threading
@@ -27,7 +28,7 @@ import numpy as np
 
 from ..server.metrics import GLOBAL as METRICS
 from .engine import Engine, SlotOptions
-from .errors import BadRequest
+from .errors import BadRequest, DeadlineExceeded
 from .paged import PagesExhausted
 
 
@@ -67,7 +68,8 @@ class Request:
 
     def __init__(self, prompt_ids: Sequence[int], opts: SlotOptions,
                  max_tokens: int, eog_ids: frozenset,
-                 embeds: Optional[np.ndarray] = None, constraint=None):
+                 embeds: Optional[np.ndarray] = None, constraint=None,
+                 deadline: Optional[float] = None):
         with Request._ids_lock:
             self.id = next(Request._ids)
         self.prompt_ids = np.asarray(prompt_ids, np.int32)
@@ -82,6 +84,13 @@ class Request:
                                   t_submit=time.monotonic())
         self.slot: Optional[int] = None
         self.error: Optional[str] = None
+        # absolute time.monotonic() budget, or None for no deadline:
+        # expired while queued → shed (503), expired mid-generation →
+        # terminal frame with finish reason "timeout"
+        self.deadline = deadline
+        # terminal reason from the ("done", reason) frame, readable after
+        # chunks()/tokens() returns — "stop", "length", "timeout", ...
+        self.done_reason: Optional[str] = None
         # every sampled token (incl. EOG), for parking the slot's KV as a
         # reusable prefix after the request finishes
         self.all_tokens: List[int] = []
@@ -123,7 +132,12 @@ class Request:
             if kind == "tokens":
                 yield payload
             elif kind == "done":
+                self.done_reason = payload
                 return
+            elif kind == "shed":
+                msg, retry_after_s = payload
+                raise DeadlineExceeded(msg, while_queued=True,
+                                       retry_after_s=retry_after_s)
             else:  # error
                 raise RuntimeError(payload)
 
@@ -132,9 +146,26 @@ class Scheduler:
     # a parked prefix must beat this many cached tokens to be worth an
     # extend over a fresh admit (tiny reuses still pay a full slice+write)
     MIN_PREFIX_REUSE = 16
+    # ceiling on the supervised-restart backoff (it doubles per
+    # consecutive failure starting from restart_backoff)
+    RESTART_BACKOFF_CAP = 2.0
 
-    def __init__(self, engine: Engine, max_queue: int = 256):
+    def __init__(self, engine: Engine, max_queue: int = 256,
+                 max_restarts: Optional[int] = None,
+                 restart_backoff: Optional[float] = None):
         self.engine = engine
+        # crash-only supervision: after a decode-loop failure the engine
+        # state is rebuilt in-process up to max_restarts consecutive
+        # times before the scheduler goes terminally `broken` (which
+        # needs a model reload / pod restart to clear)
+        self.max_restarts = (
+            max_restarts if max_restarts is not None
+            else int(os.environ.get("TPU_ENGINE_MAX_RESTARTS", "3")))
+        self.restart_backoff = (
+            restart_backoff if restart_backoff is not None
+            else float(os.environ.get("TPU_ENGINE_RESTART_BACKOFF_S",
+                                      "0.05")))
+        self.n_restarts = 0
         # speculative decoding (prompt-lookup, engine.decode_spec): draft
         # up to k tokens per greedy penalty-free slot from n-gram matches
         # in its own context. Opt-in (TPU_SPEC_DECODE=k), and the r4
@@ -186,13 +217,16 @@ class Scheduler:
                max_tokens: int = 128,
                eog_ids: frozenset = frozenset(),
                embeds: Optional[np.ndarray] = None,
-               constraint=None) -> Request:
+               constraint=None,
+               deadline_s: Optional[float] = None) -> Request:
         if len(prompt_ids) >= self.engine.max_seq:
             raise BadRequest(
                 f"prompt of {len(prompt_ids)} tokens exceeds context window "
                 f"{self.engine.max_seq}")
+        deadline = (time.monotonic() + deadline_s
+                    if deadline_s is not None and deadline_s > 0 else None)
         req = Request(prompt_ids, opts, max_tokens, eog_ids, embeds=embeds,
-                      constraint=constraint)
+                      constraint=constraint, deadline=deadline)
         # broken-check + enqueue under the lock: the failure path flips
         # `broken` and drains under the same lock, so a request can never
         # slip into the queue after the final drain (its reader would hang)
@@ -203,6 +237,7 @@ class Scheduler:
             try:
                 self._waiting.put_nowait(req)
             except queue.Full:
+                METRICS.inc("tpu_model_requests_shed_total")
                 raise SchedulerBusy(
                     f"request queue full ({self._waiting.maxsize} waiting)"
                 ) from None
@@ -326,6 +361,56 @@ class Scheduler:
                 return True
         return False
 
+    def _shed(self, req: Request):
+        """Reject a request whose deadline expired while it waited for a
+        slot. The caller never got a token, so this maps to 503 +
+        Retry-After (DeadlineExceeded raised from chunks()) rather than
+        a terminal stream frame."""
+        retry_after = min(30, max(1, self.qsize))
+        req.error = "deadline exceeded while queued"
+        req.stats.t_done = time.monotonic()
+        with self._lock:
+            self.finished.append(req.stats)
+        METRICS.inc("tpu_model_requests_shed_total")
+        req.out.put(("shed", (req.error, retry_after)))
+
+    def _shed_expired(self):
+        """Drop queued/preempted requests whose deadline already passed
+        or that were cancelled while still waiting — without this sweep
+        a request deep in the queue behind busy slots would hold its
+        reader (and its queue slot) until a decode slot finally freed."""
+        now = time.monotonic()
+
+        def expired(r):
+            return r.deadline is not None and now > r.deadline
+
+        def dead(r):
+            return expired(r) or r.cancelled.is_set()
+
+        victims: List[Request] = []
+        with self._waiting.mutex:
+            q = self._waiting.queue  # deque; safe to edit under mutex
+            if any(dead(r) for r in q):
+                victims.extend(r for r in q if dead(r))
+                keep = [r for r in q if not dead(r)]
+                q.clear()
+                q.extend(keep)
+        for req in victims:
+            if req.cancelled.is_set():
+                req.out.put(("done", "cancelled"))
+            else:
+                self._shed(req)
+        # a preempted request already streamed tokens from its first
+        # admission — its expiry is a mid-generation timeout (terminal
+        # frame), not a shed
+        for req in [r for r in self._preempted if expired(r)]:
+            self._preempted.remove(req)
+            req.stats.t_done = time.monotonic()
+            with self._lock:
+                self.finished.append(req.stats)
+            METRICS.inc("tpu_model_request_timeouts_total")
+            req.out.put(("done", "timeout"))
+
     def _admit_waiting(self):
         free = self.engine.free_slots()
         while free:
@@ -334,6 +419,15 @@ class Scheduler:
                 return
             if req.cancelled.is_set():
                 req.out.put(("done", "cancelled"))
+                continue
+            if (req.deadline is not None
+                    and time.monotonic() > req.deadline):
+                # expired between the sweep and this pop
+                if req.resume_ids is not None:
+                    METRICS.inc("tpu_model_request_timeouts_total")
+                    req.out.put(("done", "timeout"))
+                else:
+                    self._shed(req)
                 continue
             reuse_slot, reuse_len = self._best_prefix(req)
             if reuse_slot is not None:
@@ -425,11 +519,43 @@ class Scheduler:
                 traceback.print_exc(file=sys.stderr)
                 self._fail_running(str(e))
                 self._consecutive_failures += 1
-                if self._consecutive_failures >= 3:
+                if self._consecutive_failures > self.max_restarts:
                     with self._lock:
                         self.broken = True
                         self._drain_waiting(("error", f"engine failed: {e}"))
                     return
+                self._supervised_restart()
+
+    def _supervised_restart(self):
+        """Rebuild engine state in-process after a decode-loop failure.
+
+        Crash-only recovery: the requests that were mid-flight on the
+        failing step were already errored by _fail_running; everything
+        still waiting or preempted stays queued and is re-admitted once
+        the engine is clean. Costs a slot-state reset, NOT a model
+        reload or pod restart — the weights and compiled executables are
+        untouched. Goes terminally `broken` only when max_restarts
+        consecutive rebuilds all fail to produce one good step.
+        """
+        # release EVERY slot (not just the running ones): a failing step
+        # leaves cache/page accounting in an unknown state, so parked
+        # prefixes are unsafe to reuse and their pages must go back to
+        # the pool. release() also resets host-side lengths and masks.
+        for slot in range(self.engine.n_slots):
+            try:
+                self.engine.release(slot)
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+        self._parked.clear()
+        self.n_restarts += 1
+        METRICS.inc("tpu_model_engine_restarts_total")
+        # capped exponential backoff before retrying; interruptible so
+        # shutdown() never waits behind a sleeping supervisor
+        delay = min(self.restart_backoff
+                    * (2 ** (self._consecutive_failures - 1)),
+                    self.RESTART_BACKOFF_CAP)
+        if delay > 0:
+            self._stop.wait(delay)
 
     def _fail_running(self, message: str):
         for slot, req in enumerate(self._running):
@@ -545,6 +671,7 @@ class Scheduler:
         return hist[pos: pos + k] or None
 
     def _step(self):
+        self._shed_expired()
         self._admit_waiting()
         active = [(s, r) for s, r in enumerate(self._running)
                   if r is not None]
@@ -552,10 +679,16 @@ class Scheduler:
             self._wake.wait(timeout=0.05)
             self._wake.clear()
             return
-        # drop cancelled before paying for a step
+        # drop cancelled and over-deadline slots before paying for a step
+        now = time.monotonic()
         for slot, req in active:
             if req.cancelled.is_set():
                 self._finish(slot, req, "cancelled")
+            elif req.deadline is not None and now > req.deadline:
+                # mid-generation wall-clock exceeded: clean terminal
+                # frame, slot released and immediately reusable
+                METRICS.inc("tpu_model_request_timeouts_total")
+                self._finish(slot, req, "timeout")
         if self.n_active == 0:
             return
         # chunked decode: ecfg.decode_chunk steps per device round-trip.

@@ -11,6 +11,7 @@ per-request option merging; everything below it is token-level.
 from __future__ import annotations
 
 import dataclasses
+import os
 import sys
 import threading
 import time
@@ -28,7 +29,31 @@ from ..server.template import DEFAULT_TEMPLATE, Template
 from ..tokenizer import StreamDecoder, Tokenizer
 from .engine import Engine, EngineConfig, SlotOptions
 from .errors import BadRequest
+from .faults import FAULTS
 from .scheduler import Scheduler
+
+
+def resolve_deadline_s(defaults: Optional[Dict],
+                       options: Optional[Dict]) -> Optional[float]:
+    """Per-request wall-clock budget in seconds, or None for unlimited.
+
+    Precedence: request ``deadline_ms`` option > modelfile default >
+    ``TPU_REQUEST_DEADLINE_MS`` env. 0 (or absent everywhere) disables.
+    """
+    o = dict(defaults or {})
+    o.update(options or {})
+    raw = o.get("deadline_ms")
+    if raw is None:
+        raw = os.environ.get("TPU_REQUEST_DEADLINE_MS") or None
+    if raw is None:
+        return None
+    try:
+        ms = float(raw)
+    except (TypeError, ValueError) as e:
+        raise BadRequest(f"invalid deadline_ms: {raw!r}") from e
+    if ms < 0:
+        raise BadRequest("deadline_ms must be >= 0")
+    return ms / 1000.0 if ms > 0 else None
 
 
 @dataclasses.dataclass
@@ -422,7 +447,9 @@ class LoadedModel:
                     f"a JSON schema object")
         req = self.scheduler.submit(ids, so, max_new,
                                     eog_ids=frozenset(self.tokenizer.eog_ids),
-                                    embeds=embeds, constraint=constraint)
+                                    embeds=embeds, constraint=constraint,
+                                    deadline_s=resolve_deadline_s(
+                                        self.default_params, options))
         # returned context carries only REAL token ids: a continuation
         # re-prefills from context without the image, so image pad ids
         # must not leak into it (they would re-enter as garbage tokens)
@@ -446,6 +473,7 @@ class LoadedModel:
                 if cancel_event is not None and cancel_event.is_set():
                     req.cancel()
                 all_ids.extend(chunk)
+                FAULTS.check("detok.feed")
                 piece = sm.feed(sd.feed_many(chunk))
                 if piece:
                     result.text += piece
@@ -467,8 +495,15 @@ class LoadedModel:
         result.generated_tokens = st.n_generated
         result.ttft_s = st.ttft_s
         result.total_s = time.monotonic() - t0
-        result.done_reason = "stop" if sm.hit or st.n_generated < max_new \
-            else "length"
+        if getattr(req, "done_reason", None) == "timeout":
+            # deadline_ms expired mid-generation: the scheduler released
+            # the slot and sent a clean terminal frame — surface the real
+            # reason instead of misreporting "stop"
+            result.done_reason = "timeout"
+        else:
+            result.done_reason = ("stop"
+                                  if sm.hit or st.n_generated < max_new
+                                  else "length")
         result.context = ids + all_ids
         METRICS.inc("tpu_model_requests_total")
         METRICS.inc("tpu_model_generated_tokens_total", st.n_generated)
@@ -622,6 +657,7 @@ class _IdleScheduler:
     has_pending = False
     broken = False
     n_preemptions = 0
+    n_restarts = 0
     finished = ()      # reaper: no completed generations to re-arm from
 
     def shutdown(self):

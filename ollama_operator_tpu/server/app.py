@@ -27,6 +27,7 @@ different model swaps it in under a lock.
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import json
 import math
 import os
@@ -43,7 +44,7 @@ from .. import __version__
 from ..gguf.reader import GGUFFile
 from ..gguf.transcode import load_model as transcode_load
 from ..runtime.engine import EngineConfig, resolve_serving_defaults
-from ..runtime.errors import BadRequest
+from ..runtime.errors import BadRequest, DeadlineExceeded, FollowerLost
 from ..runtime.scheduler import SchedulerBroken, SchedulerBusy
 from ..runtime.service import LoadedModel
 from ..tokenizer import Tokenizer
@@ -580,6 +581,19 @@ class ModelManager:
                                       is not None else False)},
                 "expires_at": expires,
                 "size_vram": 0,
+                # crash-only serving status: supervised restarts on THIS
+                # scheduler object plus process-lifetime failure counters
+                # (the same series /metrics exports)
+                "failures": {
+                    "broken": bool(lm.scheduler.broken),
+                    "engine_restarts": lm.scheduler.n_restarts,
+                    "request_timeouts": int(METRICS.get(
+                        "tpu_model_request_timeouts_total")),
+                    "requests_shed": int(METRICS.get(
+                        "tpu_model_requests_shed_total")),
+                    "followers_lost": int(METRICS.get(
+                        "tpu_model_followers_lost_total")),
+                },
             })
         return out
 
@@ -758,11 +772,14 @@ class Handler(BaseHTTPRequestHandler):
         except json.JSONDecodeError as e:
             raise ApiError(400, f"invalid json: {e}") from e
 
-    def _send_json(self, obj, status=200):
+    def _send_json(self, obj, status=200,
+                   headers: Optional[Dict[str, str]] = None):
         body = json.dumps(obj).encode()
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
 
@@ -792,7 +809,8 @@ class Handler(BaseHTTPRequestHandler):
         self.wfile.flush()
         self._streaming = False
 
-    def _send_error(self, message: str, status: int):
+    def _send_error(self, message: str, status: int,
+                    headers: Optional[Dict[str, str]] = None):
         """Error that is safe both before and after a stream started: once
         chunked headers are out, a second status line would corrupt the
         framing — emit the error as a final chunk instead."""
@@ -810,10 +828,25 @@ class Handler(BaseHTTPRequestHandler):
             except (BrokenPipeError, ConnectionResetError):
                 pass
         else:
-            self._send_json({"error": message}, status)
+            self._send_json({"error": message}, status, headers=headers)
 
     def _stream_json(self, obj):
         self._chunk(json.dumps(obj).encode() + b"\n")
+
+    @staticmethod
+    def _pull_first(gen):
+        """Pull the FIRST (piece, final) pair before the caller commits
+        200 + chunked headers. Failures that precede the first token —
+        deadline shed while queued (503 + Retry-After), admission errors
+        — can then surface as real HTTP status codes; once the first
+        item exists the stream is committed and later failures become
+        terminal frames. Returns an iterator replaying that first item."""
+        it = iter(gen)
+        try:
+            first = next(it)
+        except StopIteration:
+            return iter(())
+        return itertools.chain([first], it)
 
     def _coalescer(self, pre: bytes, mid: Optional[bytes], suf: bytes,
                    options: Optional[Dict]) -> _StreamCoalescer:
@@ -952,10 +985,22 @@ class Handler(BaseHTTPRequestHandler):
             # Plain ValueError deliberately falls through to the 500 branch:
             # an internal jax/numpy ValueError is a server bug, not a 400.
             self._send_error(str(e), 400)
+        except DeadlineExceeded as e:
+            # shed while queued: the caller got nothing and should retry
+            # (503 is what load balancers key backpressure on); a
+            # mid-generation expiry normally ends as a terminal stream
+            # frame, so a pre-stream surface here maps to 504
+            if e.while_queued:
+                self._send_error(str(e), 503, headers={
+                    "Retry-After": str(int(e.retry_after_s))})
+            else:
+                self._send_error(str(e), 504)
         except SchedulerBusy as e:
-            self._send_error(str(e), 503)
+            self._send_error(str(e), 503, headers={"Retry-After": "1"})
         except SchedulerBroken as e:
             self._send_error(str(e), 500)
+        except FollowerLost as e:
+            self._send_error(f"multi-host world degraded: {e}", 500)
         except RegistryError as e:
             self._send_error(str(e), 500)
         except (BrokenPipeError, ConnectionResetError):
@@ -1001,6 +1046,7 @@ class Handler(BaseHTTPRequestHandler):
                                  images=_decode_images(body.get("images")),
                                  format=body.get("format"))
         if stream:
+            gen = self._pull_first(gen)
             self._start_stream()
             co = self._coalescer(
                 b'{"model": ' + json.dumps(model).encode()
@@ -1074,6 +1120,7 @@ class Handler(BaseHTTPRequestHandler):
             return msg
 
         if stream and not tools:
+            gen = self._pull_first(gen)
             self._start_stream()
             co = self._coalescer(
                 b'{"model": ' + json.dumps(model).encode()
@@ -1353,6 +1400,7 @@ class Handler(BaseHTTPRequestHandler):
                           final.generated_tokens}})
             return
         if body.get("stream"):
+            gen = self._pull_first(gen)
             self._start_stream(ctype="text/event-stream")
             self._chunk(self._sse({
                 "id": rid, "object": "chat.completion.chunk",

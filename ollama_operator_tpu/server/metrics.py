@@ -69,6 +69,11 @@ class Metrics:
             k = self._key(name, labels)
             self._counters[k] = self._counters.get(k, 0.0) + value
 
+    def get(self, name: str, labels: str = "") -> float:
+        """Current value of a counter (0.0 if never incremented)."""
+        with self._lock:
+            return self._counters.get(self._key(name, labels), 0.0)
+
     def gauge_fn(self, name: str, fn, labels: str = ""):
         with self._lock:
             self._gauges[self._key(name, labels)] = fn
@@ -129,6 +134,25 @@ GLOBAL.describe("tpu_model_stream_frames_total",
                 "Streamed NDJSON/SSE frames written (after coalescing; "
                 "compare to tpu_model_generated_tokens_total for the "
                 "tokens-per-frame ratio)")
+GLOBAL.describe("tpu_model_engine_restarts_total",
+                "Supervised in-process engine restarts after decode-loop "
+                "failures (no pod restart, no model reload)")
+GLOBAL.describe("tpu_model_request_timeouts_total",
+                "Requests cut off mid-generation by deadline_ms "
+                "(terminal frame finish reason 'timeout')")
+GLOBAL.describe("tpu_model_requests_shed_total",
+                "Requests shed before holding a slot: deadline expired "
+                "while queued, or admission queue full (HTTP 503)")
+GLOBAL.describe("tpu_model_followers_lost_total",
+                "Multi-host follower connections lost (send failure or "
+                "missed heartbeat); the world is degraded afterwards")
+# pre-seed the failure counters at 0: alert rules rate() over these, and
+# a series that first appears AT the first failure hides that failure
+for _name in ("tpu_model_engine_restarts_total",
+              "tpu_model_request_timeouts_total",
+              "tpu_model_requests_shed_total",
+              "tpu_model_followers_lost_total"):
+    GLOBAL.inc(_name, 0.0)
 
 
 class Stopwatch:

@@ -1,0 +1,226 @@
+"""Request deadlines over the HTTP surface (ISSUE 2 acceptance tests).
+
+A `deadline_ms` exceeded while QUEUED must map to 503 + Retry-After (the
+client never got a byte, retrying elsewhere is correct); exceeded
+MID-GENERATION must end the already-started stream with a clean terminal
+frame (`done_reason: "timeout"`) and leave the slot reusable.  Slot
+contention is produced with the deterministic `engine.step` delay fault,
+not wall-clock luck.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from ollama_operator_tpu.models import config as cfglib
+from ollama_operator_tpu.models import decoder
+from ollama_operator_tpu.runtime.engine import EngineConfig
+from ollama_operator_tpu.runtime.errors import BadRequest
+from ollama_operator_tpu.runtime.faults import FAULTS
+from ollama_operator_tpu.runtime.service import resolve_deadline_s
+from ollama_operator_tpu.server.app import ModelManager, serve
+
+from fake_registry import FakeRegistry
+from test_transcode import write_tiny_llama_gguf
+
+
+@pytest.fixture(scope="module")
+def stack(tmp_path_factory):
+    """Single-slot server: one in-flight request saturates the engine,
+    so queue-wait behaviour is deterministic."""
+    tmp = tmp_path_factory.mktemp("deadlines")
+    cfg = cfglib.PRESETS["tiny"]
+    params = decoder.init_params(cfg, jax.random.PRNGKey(0),
+                                 dtype=jnp.float32)
+    gguf_path = str(tmp / "tiny.gguf")
+    write_tiny_llama_gguf(gguf_path, cfg, params)
+    with open(gguf_path, "rb") as f:
+        gguf_bytes = f.read()
+
+    reg = FakeRegistry()
+    url = reg.start()
+    reg.add_model("library", "tiny", "latest", gguf_bytes,
+                  template="{{ .System }}|{{ .Prompt }}",
+                  params={"temperature": 0.0, "repeat_penalty": 1.0,
+                          "num_predict": 8})
+
+    manager = ModelManager(str(tmp / "store"), cache_dir=str(tmp / "cache"),
+                           ecfg=EngineConfig(max_slots=1, max_seq_len=192,
+                                             cache_dtype=jnp.float32,
+                                             min_prefill_bucket=16),
+                           engine_dtype="float32")
+    httpd = serve(manager, "127.0.0.1", 0)
+    port = httpd.server_address[1]
+    base = f"http://127.0.0.1:{port}"
+    host = url.split("://")[1]
+    model = f"http://{host}/library/tiny:latest"
+    req = urllib.request.Request(
+        base + "/api/pull", data=json.dumps({"model": model}).encode(),
+        headers={"Content-Type": "application/json"})
+    urllib.request.urlopen(req, timeout=120).read()
+    yield {"base": base, "model": model, "manager": manager}
+    httpd.shutdown()
+    reg.stop()
+
+
+def _post_stream(base, payload, timeout=120):
+    """POST /api/generate, return parsed NDJSON lines."""
+    req = urllib.request.Request(
+        base + "/api/generate", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    resp = urllib.request.urlopen(req, timeout=timeout)
+    return [json.loads(l) for l in resp.read().decode().splitlines()
+            if l.strip()]
+
+
+def _open_stream(base, payload, timeout=120):
+    req = urllib.request.Request(
+        base + "/api/generate", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+# -- resolve_deadline_s unit surface -----------------------------------
+
+def test_resolve_deadline_precedence(monkeypatch):
+    monkeypatch.delenv("TPU_REQUEST_DEADLINE_MS", raising=False)
+    assert resolve_deadline_s(None, None) is None
+    assert resolve_deadline_s({}, {"deadline_ms": 1500}) == 1.5
+    # request option beats modelfile default beats env
+    assert resolve_deadline_s({"deadline_ms": 9000},
+                              {"deadline_ms": 250}) == 0.25
+    assert resolve_deadline_s({"deadline_ms": 9000}, {}) == 9.0
+    monkeypatch.setenv("TPU_REQUEST_DEADLINE_MS", "2000")
+    assert resolve_deadline_s(None, None) == 2.0
+    assert resolve_deadline_s(None, {"deadline_ms": 100}) == 0.1
+    # 0 disables, even over a nonzero env default
+    assert resolve_deadline_s(None, {"deadline_ms": 0}) is None
+
+
+def test_resolve_deadline_invalid():
+    with pytest.raises(BadRequest):
+        resolve_deadline_s(None, {"deadline_ms": "soon"})
+    with pytest.raises(BadRequest):
+        resolve_deadline_s(None, {"deadline_ms": -5})
+
+
+def test_bad_deadline_is_400(stack):
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post_stream(stack["base"],
+                     {"model": stack["model"], "prompt": "x",
+                      "options": {"deadline_ms": "soon"}})
+    assert ei.value.code == 400
+
+
+# -- queued expiry → 503 + Retry-After ---------------------------------
+
+@pytest.mark.chaos
+def test_deadline_while_queued_is_503_with_retry_after(stack):
+    """Saturate the single slot with a slow request; a queued request
+    whose deadline lapses is shed with 503 + Retry-After, while the
+    in-flight holder streams to completion untouched."""
+    FAULTS.arm("engine.step", "delay:80ms")
+    holder_lines = []
+    holder_err = []
+
+    def run_holder(resp):
+        try:
+            holder_lines.extend(
+                json.loads(l) for l in resp.read().decode().splitlines()
+                if l.strip())
+        except Exception as e:          # surfaced in the main thread
+            holder_err.append(e)
+
+    # open the holder and wait for its FIRST frame => it owns the slot
+    resp = _open_stream(stack["base"],
+                        {"model": stack["model"], "prompt": "hold",
+                         "options": {"num_predict": 96}})
+    first = json.loads(resp.readline())
+    assert not first.get("done")
+    t = threading.Thread(target=run_holder, args=(resp,))
+    t.start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post_stream(stack["base"],
+                         {"model": stack["model"], "prompt": "hurry",
+                          "options": {"deadline_ms": 60,
+                                      "num_predict": 4}})
+        assert ei.value.code == 503
+        retry_after = ei.value.headers.get("Retry-After")
+        assert retry_after is not None and int(retry_after) >= 1
+    finally:
+        t.join(timeout=120)
+    assert not holder_err
+    assert holder_lines and holder_lines[-1]["done"]
+    assert holder_lines[-1]["done_reason"] in ("stop", "length")
+
+
+# -- mid-generation expiry → terminal timeout frame --------------------
+
+@pytest.mark.chaos
+def test_deadline_mid_generation_terminal_frame_and_slot_reuse(stack):
+    """Once streaming has started the deadline can't become a status
+    code; the stream must end with done_reason:"timeout" — and the slot
+    must be immediately reusable afterwards."""
+    FAULTS.arm("engine.step", "delay:120ms")
+    lines = _post_stream(stack["base"],
+                         {"model": stack["model"], "prompt": "long one",
+                          "options": {"deadline_ms": 300,
+                                      "num_predict": 150}})
+    final = lines[-1]
+    assert final["done"] is True
+    assert final["done_reason"] == "timeout"
+    # partial output was streamed before the cut
+    assert any(l.get("response") for l in lines[:-1])
+    # fewer tokens than asked: the deadline, not num_predict, ended it
+    assert final["eval_count"] < 150
+
+    FAULTS.reset()
+    lines = _post_stream(stack["base"],
+                         {"model": stack["model"], "prompt": "after",
+                          "options": {"num_predict": 5}})
+    assert lines[-1]["done"] is True
+    assert lines[-1]["done_reason"] in ("stop", "length")
+    assert lines[-1]["eval_count"] == 5
+
+
+# -- detok fault: kills one stream, not the server ---------------------
+
+@pytest.mark.chaos
+def test_detok_fault_errors_one_stream_slot_reusable(stack):
+    """A detokeniser fault before the first byte maps to a 500 for that
+    request only; generator cleanup cancels it and frees the slot."""
+    FAULTS.arm("detok.feed", "fail:once")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post_stream(stack["base"],
+                     {"model": stack["model"], "prompt": "boom",
+                      "options": {"num_predict": 4}})
+    assert ei.value.code == 500
+    lines = _post_stream(stack["base"],
+                         {"model": stack["model"], "prompt": "fine",
+                          "options": {"num_predict": 4}})
+    assert lines[-1]["done"] is True
+    assert lines[-1]["eval_count"] == 4
+
+
+# -- /api/ps surfaces failure counters ---------------------------------
+
+def test_ps_reports_failure_block(stack):
+    # ensure the model is loaded regardless of which tests ran before
+    _post_stream(stack["base"], {"model": stack["model"], "prompt": "warm",
+                                 "options": {"num_predict": 1}})
+    body = urllib.request.urlopen(stack["base"] + "/api/ps",
+                                  timeout=30).read()
+    models = json.loads(body)["models"]
+    assert models, "model should be loaded"
+    fb = models[0]["failures"]
+    assert fb["broken"] is False
+    assert isinstance(fb["engine_restarts"], int)
+    assert isinstance(fb["request_timeouts"], int)
+    assert isinstance(fb["requests_shed"], int)
+    assert isinstance(fb["followers_lost"], int)

@@ -99,3 +99,100 @@ def test_control_address_resolution():
     assert F.control_address(
         {"TPU_DIST_COORDINATOR": "sts-0.svc:8476"}) == ("sts-0.svc", 8477)
     assert F.control_address({}) is None
+
+
+def test_dead_follower_marks_degraded_and_raises():
+    """A send to a closed follower socket raises typed FollowerLost and
+    marks the world degraded; later broadcasts fail FAST (no blocking on
+    a half-dead world) until the pod is restarted."""
+    import pytest
+    from ollama_operator_tpu.runtime.errors import FollowerLost
+    from ollama_operator_tpu.server.metrics import GLOBAL as METRICS
+
+    lost_before = METRICS.get("tpu_model_followers_lost_total")
+    port = _free_port()
+    cp = F.ControlPlane(1, port, bind="127.0.0.1", heartbeat_s=0)
+    c1 = socket.create_connection(("127.0.0.1", port))
+    cp.broadcast(("call", "decode_n", (1,), {}))
+    assert F._recv(c1)[1] == "decode_n"
+    c1.close()
+    try:
+        # closed peer: first or second send hits the broken pipe (the
+        # first may land in the kernel buffer before the RST arrives)
+        with pytest.raises(FollowerLost):
+            for _ in range(50):
+                cp.broadcast(("call", "decode_n", (2,), {}))
+        assert cp.degraded
+        assert cp.degraded_reason
+        assert METRICS.get("tpu_model_followers_lost_total") \
+            == lost_before + 1
+        # degraded world: fail fast, don't half-dispatch
+        with pytest.raises(FollowerLost):
+            cp.broadcast(("ping",))
+        # counted once, not per failed broadcast
+        assert METRICS.get("tpu_model_followers_lost_total") \
+            == lost_before + 1
+    finally:
+        cp.close()
+
+
+def test_follower_send_fault_marks_degraded():
+    """The follower.send fault point drives the same degraded path as a
+    real socket error — InjectedFault is caught like OSError."""
+    import pytest
+    from ollama_operator_tpu.runtime.errors import FollowerLost
+    from ollama_operator_tpu.runtime.faults import FAULTS
+
+    port = _free_port()
+    cp = F.ControlPlane(1, port, bind="127.0.0.1", heartbeat_s=0)
+    c1 = socket.create_connection(("127.0.0.1", port))
+    try:
+        FAULTS.arm("follower.send", "fail:once")
+        with pytest.raises(FollowerLost):
+            cp.broadcast(("call", "decode_n", (1,), {}))
+        assert cp.degraded
+    finally:
+        c1.close()
+        cp.close()
+
+
+def test_heartbeat_pings_and_follower_ignores_them():
+    """The leader's heartbeat thread broadcasts pings; a follower's op
+    loop must treat them as liveness-only no-ops between real ops."""
+    port = _free_port()
+    cp = F.ControlPlane(1, port, bind="127.0.0.1", heartbeat_s=0.02)
+    c1 = socket.create_connection(("127.0.0.1", port))
+    try:
+        got = [F._recv(c1) for _ in range(3)]
+        assert ("ping",) in [tuple(m[:1]) for m in got] or \
+            all(m[0] == "ping" for m in got)
+        # interleave a real broadcast between pings: FIFO preserved
+        cp.broadcast(("call", "decode_n", (7,), {}))
+        while True:
+            m = F._recv(c1)
+            if m[0] != "ping":
+                break
+        assert m[0] == "call" and m[2][0] == 7
+    finally:
+        c1.close()
+        cp.close()
+
+
+def test_heartbeat_detects_silent_follower_death():
+    """With no traffic at all, the heartbeat alone must discover a dead
+    follower and flip the world degraded — this is the watchdog that
+    turns a wedged follower into a fast typed failure."""
+    port = _free_port()
+    cp = F.ControlPlane(1, port, bind="127.0.0.1", heartbeat_s=0.02)
+    c1 = socket.create_connection(("127.0.0.1", port))
+    import time as _time
+    # wait until the heartbeat has started flowing, then kill the peer
+    F._recv(c1)
+    c1.close()
+    deadline = _time.monotonic() + 5
+    while not cp.degraded and _time.monotonic() < deadline:
+        _time.sleep(0.01)
+    try:
+        assert cp.degraded
+    finally:
+        cp.close()

@@ -356,3 +356,47 @@ class TestMetricsAuth:
             assert get("/healthz") == 200
         finally:
             httpd.shutdown()
+
+
+class TestPollBackoff:
+    def test_poll_requeues_back_off_per_model(self, fake):
+        """A Model stuck at steady-state POLL backs off 5 → 7.5 → …
+        capped at 60s; any shorter (progress) requeue resets its streak;
+        other models are unaffected."""
+        from ollama_operator_tpu.operator.reconciler import Result
+        mgr = Manager(fake, namespace="default", server_image="img:t")
+        seen = {}
+        done_evt = threading.Event()
+        real_done = mgr.queue.done
+
+        def spy_done(key, requeue_after=-1.0):
+            seen.setdefault(key, []).append(requeue_after)
+            real_done(key)           # finish WITHOUT the real delay
+            done_evt.set()
+
+        mgr.queue.done = spy_done
+        scripts = {"stuck": iter([5.0] * 9),
+                   "moving": iter([5.0, 5.0, 0.5, 5.0])}
+
+        class StubRec:
+            def reconcile(self, ns, name):
+                return Result(requeue_after=next(scripts[name]))
+
+        mgr.reconciler = StubRec()
+        t = threading.Thread(target=mgr._worker, daemon=True)
+        t.start()
+        try:
+            for name, n in (("stuck", 9), ("moving", 4)):
+                for _ in range(n):
+                    done_evt.clear()
+                    mgr.queue.add(("default", name))
+                    assert done_evt.wait(5)
+        finally:
+            mgr._stop.set()
+            mgr.queue.shutdown()
+            t.join(timeout=5)
+        stuck = seen[("default", "stuck")]
+        assert stuck[:4] == [5.0, 7.5, 11.25, 16.875]
+        assert stuck[-2:] == [60.0, 60.0]          # capped, stays capped
+        # progress (requeue < floor) resets the streak; next POLL starts over
+        assert seen[("default", "moving")] == [5.0, 7.5, 0.5, 5.0]

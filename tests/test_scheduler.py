@@ -15,7 +15,7 @@ from ollama_operator_tpu.runtime.scheduler import Scheduler
 GREEDY = SlotOptions(temperature=0.0, repeat_penalty=1.0)
 
 
-def make_stack(slots=2):
+def make_stack(slots=2, **sched_kw):
     cfg = cfglib.PRESETS["tiny"]
     params = decoder.init_params(cfg, jax.random.PRNGKey(0),
                                  dtype=jnp.float32)
@@ -23,7 +23,7 @@ def make_stack(slots=2):
                  ecfg=EngineConfig(max_slots=slots, max_seq_len=64,
                                    cache_dtype=jnp.float32,
                                    min_prefill_bucket=16))
-    return cfg, params, eng, Scheduler(eng)
+    return cfg, params, eng, Scheduler(eng, **sched_kw)
 
 
 def test_more_requests_than_slots_all_complete():
@@ -136,15 +136,17 @@ def test_engine_failure_fails_requests_not_thread():
 
 
 def test_repeated_engine_failures_mark_broken():
-    cfg, params, eng, sched = make_stack(slots=1)
+    """Terminal `broken` is reached only after max_restarts supervised
+    restarts ALSO fail — and then new submissions are refused."""
+    cfg, params, eng, sched = make_stack(slots=1, max_restarts=2,
+                                         restart_backoff=0.001)
     try:
         def always_fail(n=None):
             raise RuntimeError("dead engine")
 
         eng.decode_n = always_fail
         import pytest
-        from ollama_operator_tpu.runtime.scheduler import (SchedulerBroken,
-                                                           SchedulerBusy)
+        from ollama_operator_tpu.runtime.scheduler import SchedulerBroken
         for _ in range(3):
             r = sched.submit(np.array([1, 2], np.int32), GREEDY, max_tokens=4)
             with pytest.raises(RuntimeError):
@@ -153,8 +155,81 @@ def test_repeated_engine_failures_mark_broken():
         while not sched.broken and time.monotonic() < deadline:
             time.sleep(0.01)
         assert sched.broken
+        assert sched.n_restarts == 2   # two rebuilds tried before giving up
         with pytest.raises(SchedulerBroken):
             sched.submit(np.array([1], np.int32), GREEDY, max_tokens=1)
+        # shutdown after broken must not hang on the already-returned loop
+        t0 = time.monotonic()
+        sched.shutdown()
+        assert time.monotonic() - t0 < 5.0
+    finally:
+        sched.shutdown()   # idempotent
+
+
+def test_fail_running_releases_slots_and_errors_each_stream_once():
+    """_fail_running: every running slot is released and every stream
+    sees exactly ONE error item — then the freed slots serve new work."""
+    cfg, params, eng, sched = make_stack(slots=2)
+    try:
+        calls = {"n": 0}
+        real_decode_n = eng.decode_n
+
+        def flaky(n=None):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("boom step")
+            return real_decode_n(n)
+
+        eng.decode_n = flaky
+        reqs = [sched.submit(np.array([i + 1, i + 2], np.int32), GREEDY,
+                             max_tokens=64) for i in range(2)]
+        import queue as queue_mod
+        for r in reqs:
+            # consume the stream; the error arrives as a raise
+            try:
+                list(r.tokens())
+            except RuntimeError as e:
+                assert "boom step" in str(e)
+            # exactly once: the queue holds nothing after the error item
+            try:
+                extra = r.out.get_nowait()
+                assert False, f"stream got extra item {extra!r}"
+            except queue_mod.Empty:
+                pass
+        deadline = time.monotonic() + 5
+        while sched.n_active and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert sched.n_active == 0          # every slot released
+        assert not any(eng.active)          # engine agrees
+        r2 = sched.submit(np.array([9], np.int32), GREEDY, max_tokens=3)
+        assert len(list(r2.tokens())) == 3
+    finally:
+        sched.shutdown()
+
+
+def test_cancel_queued_request_frees_queue_slot():
+    """cancel() of a still-QUEUED request must free its queue capacity
+    and terminate its stream with done:cancelled."""
+    cfg, params, eng, sched = make_stack(slots=1)
+    sched._waiting.maxsize = 1
+    import pytest
+    from ollama_operator_tpu.runtime.scheduler import SchedulerBusy
+    try:
+        r0 = sched.submit(np.array([1, 2], np.int32), GREEDY,
+                          max_tokens=10_000)
+        it = r0.tokens()
+        next(it)                      # r0 holds the only slot
+        rq = sched.submit(np.array([3], np.int32), GREEDY, max_tokens=1)
+        with pytest.raises(SchedulerBusy):
+            sched.submit(np.array([4], np.int32), GREEDY, max_tokens=1)
+        rq.cancel()
+        assert list(rq.tokens()) == []     # done:cancelled, no tokens
+        assert rq.done_reason == "cancelled"
+        # its queue slot is free again while r0 still runs
+        r2 = sched.submit(np.array([5], np.int32), GREEDY, max_tokens=1)
+        r0.cancel()
+        list(it)
+        list(r2.tokens())
     finally:
         sched.shutdown()
 
