@@ -853,6 +853,237 @@ def measure_http(jax, *, model: str, dtype: str, slots: int, steps: int,
     return rec
 
 
+def measure_mixed(jax, *, model: str, dtype: str, slots: int, steps: int,
+                  seq: int, prompt_len: int, paged: bool, mixed: bool,
+                  chunk: int, page_size: int, n_pages: int | None,
+                  platform: str, params_cache: dict | None = None,
+                  env: dict | None = None) -> dict:
+    """Mixed-load arm for the stall-free batching work (ISSUE 3): a steady
+    background decode batch with Poisson long-prompt arrivals on top, run
+    twice through the REAL scheduler — overlap on (chunked prefill +
+    async double-buffered dispatch) vs overlap off (one-shot prefill,
+    synchronous dispatch). The background streams' ITL p99 is the stall
+    the arrivals inflict; the arrivals' TTFT p95 is what chunking trades
+    for it. Counter deltas (admission_stall_ms, prefill_chunks) come from
+    the same /metrics series production dashboards read."""
+    import gc
+    import threading
+
+    from ollama_operator_tpu.models.config import get_config
+    from ollama_operator_tpu.runtime.engine import (Engine, EngineConfig,
+                                                    SlotOptions,
+                                                    resolve_cache_dtype)
+    from ollama_operator_tpu.runtime.scheduler import Scheduler
+    from ollama_operator_tpu.server.metrics import GLOBAL as METRICS
+
+    on_cpu = platform == "cpu"
+    if on_cpu:
+        dtype = "float32"
+    kv_dtype = resolve_cache_dtype(
+        os.environ.get("BENCH_KV_DTYPE", "float32" if on_cpu else "int8"))
+    cfg = get_config(model)
+    log(f"bench: mixed-load capture model={model} dtype={dtype} "
+        f"slots={slots} steps={steps} seq={seq}")
+    params, param_bytes, dtype = _bench_params(
+        jax, cfg, model, dtype, on_cpu, params_cache)
+    if dtype == "int4":
+        from ollama_operator_tpu.ops.quant import int4_mm_kernels
+        cfg = int4_mm_kernels(cfg, None)
+    # the model config caps the servable context (Engine takes the min),
+    # so size the decode chunk and prefill piece to the REAL context —
+    # at smoke scale (tiny model, 128 ctx) the defaults would leave no
+    # room for a multi-piece prompt and the arm would measure nothing
+    serve_seq = min(seq, cfg.max_seq_len)
+    chunk_eff = min(chunk, max(4, serve_seq // 16))
+    # prefill piece: TPU_PREFILL_CHUNK if set, else small enough that the
+    # arrival prompts below are genuinely multi-piece at smoke scale
+    piece = (int(os.environ.get("TPU_PREFILL_CHUNK", "0") or 0)
+             or chunk_eff * 2)
+    # async double-buffering is dense-only (a recycled page could be
+    # written by the still-in-flight dispatch through its captured block
+    # table), so this arm always measures the dense engine
+    eng = Engine(cfg, params,
+                 ecfg=EngineConfig(max_slots=slots, max_seq_len=seq,
+                                   decode_chunk=chunk_eff,
+                                   cache_dtype=kv_dtype, paged=False,
+                                   min_prefill_bucket=max(16, min(64,
+                                                                  piece))))
+    # AOT-warm the programs BOTH arms dispatch (decode, admit buckets,
+    # batched admit) so neither arm pays compiles in its measured window
+    eng.warm_buckets()
+    piece_b = eng.bucket_for(min(piece, eng.max_seq))
+    # arrival prompts land in the LARGEST prefill bucket (6 pieces floor
+    # puts them past the penultimate one): the off arm then pays a full
+    # whole-context one-shot prefill per admission — the stall this work
+    # removes — while the on arm pays it one piece at a time
+    long_len = min(max(6 * piece_b, prompt_len),
+                   eng.max_seq - piece_b - chunk_eff - 2)
+    n_bg = max(1, min(slots - 2, slots * 3 // 4))
+    n_arr = max(4, min(slots - n_bg, 8))
+    greedy = SlotOptions(temperature=0.0, repeat_penalty=1.0)
+    rng = np.random.default_rng(0)
+    bg_prompts = [rng.integers(1, cfg.vocab_size, size=16,
+                               endpoint=False).astype(np.int32)
+                  for _ in range(n_bg)]
+    arr_prompts = [rng.integers(1, cfg.vocab_size, size=long_len,
+                                endpoint=False).astype(np.int32)
+                   for _ in range(n_arr)]
+    arr_gap_s = float(os.environ.get("BENCH_MIXED_GAP_S", "0.05"))
+
+    def run_arm(overlap: bool) -> dict:
+        sched = Scheduler(eng, prefill_chunk=(piece_b if overlap else 0),
+                          async_dispatch=overlap)
+        try:
+            # warmup: one long admission + a decode chunk so the programs
+            # specific to this arm's admission path (one-shot long bucket
+            # vs chunked extend pieces) compile before the measured
+            # window; everything shared was AOT-warmed above
+            w = sched.submit(list(arr_prompts[0]), greedy,
+                             max_tokens=chunk_eff)
+            for _ in w.chunks():
+                pass
+            # counter snapshots AFTER warmup: compile time is not stall
+            stall0 = METRICS.get("tpu_model_admission_stall_ms_total")
+            chunks0 = METRICS.get("tpu_model_prefill_chunks_total")
+            stop_bg = threading.Event()
+            bg = []
+            readers = []
+
+            def bg_runner(p, rec, box):
+                # respawn on completion: the background batch must keep
+                # decoding for the whole arrival window
+                while not stop_bg.is_set():
+                    try:
+                        r = sched.submit(list(p), greedy,
+                                         max_tokens=eng.max_seq)
+                    except Exception:   # shedding/shutdown at teardown
+                        return
+                    box["req"] = r
+                    try:
+                        for toks in r.chunks():
+                            rec.append((time.perf_counter(), len(toks)))
+                    except Exception:   # cancelled at teardown
+                        return
+
+            for p in bg_prompts:
+                rec: list = []
+                box: dict = {}
+                t = threading.Thread(target=bg_runner, args=(p, rec, box))
+                t.start()
+                bg.append((box, rec))
+                readers.append(t)
+            t_wait = time.perf_counter()
+            while (any(not rec for _, rec in bg)
+                   and time.perf_counter() - t_wait < 120):
+                time.sleep(0.005)
+
+            arr = []
+            arr_threads = []
+
+            def arr_reader(req, out):
+                try:
+                    for _ in req.chunks():
+                        pass
+                    out["ttft"] = req.stats.ttft_s
+                except Exception as e:
+                    out["error"] = f"{type(e).__name__}: {e}"
+
+            rng_arr = np.random.default_rng(7)  # same draw both arms
+            t0 = time.perf_counter()
+            for p in arr_prompts:
+                time.sleep(float(rng_arr.exponential(arr_gap_s)))
+                r = sched.submit(list(p), greedy, max_tokens=chunk)
+                out: dict = {}
+                th = threading.Thread(target=arr_reader, args=(r, out))
+                th.start()
+                arr.append(out)
+                arr_threads.append(th)
+            for th in arr_threads:
+                th.join(timeout=600)
+            t1 = time.perf_counter()
+            stop_bg.set()
+            for box, _ in bg:
+                r = box.get("req")
+                if r is not None:
+                    r.cancel()
+            for t in readers:
+                t.join(timeout=60)
+
+            # per-token ITL from bg frame arrivals inside the arrival
+            # window: a k-token chunk's gap lands on its first token, the
+            # rest arrive in the same write (0 s) — same accounting as
+            # measure_http's itl_samples
+            itls = []
+            n_bg_tokens = 0
+            for _, rec in bg:
+                for (tp, _), (t, k) in zip(rec, rec[1:]):
+                    if tp < t0 or t > t1:
+                        continue
+                    itls.append(t - tp)
+                    itls.extend([0.0] * (k - 1))
+                    n_bg_tokens += k
+            ttfts = [o["ttft"] for o in arr if "ttft" in o]
+            errors = [o["error"] for o in arr if "error" in o]
+            return {
+                "overlap": overlap,
+                "itl_p99_ms": (round(float(np.percentile(itls, 99)) * 1e3,
+                                     2) if itls else None),
+                "itl_p95_ms": (round(float(np.percentile(itls, 95)) * 1e3,
+                                     2) if itls else None),
+                "ttft_p95_ms": (round(float(np.percentile(ttfts, 95))
+                                      * 1e3, 1) if ttfts else None),
+                "bg_tok_s": (round(n_bg_tokens / (t1 - t0), 2)
+                             if t1 > t0 and n_bg_tokens else None),
+                "admission_stall_ms": round(
+                    METRICS.get("tpu_model_admission_stall_ms_total")
+                    - stall0, 1),
+                "stall_ms_per_arrival": round(
+                    (METRICS.get("tpu_model_admission_stall_ms_total")
+                     - stall0) / max(1, len(arr_prompts)), 1),
+                "prefill_chunks": int(
+                    METRICS.get("tpu_model_prefill_chunks_total")
+                    - chunks0),
+                "arrival_errors": errors or None,
+            }
+        finally:
+            sched.shutdown()
+            for s in range(eng.n_slots):
+                try:
+                    eng.release(s)
+                except Exception:
+                    pass
+
+    on = run_arm(True)
+    off = run_arm(False)
+    rec = {
+        "model": model,
+        "mode": "mixed",
+        "overlap_on": on,
+        "overlap_off": off,
+        "itl_p99_ratio": (round(off["itl_p99_ms"] / on["itl_p99_ms"], 2)
+                          if on.get("itl_p99_ms") and off.get("itl_p99_ms")
+                          else None),
+        "bg_tok_s_ratio": (round(on["bg_tok_s"] / off["bg_tok_s"], 3)
+                           if on.get("bg_tok_s") and off.get("bg_tok_s")
+                           else None),
+        "slots": slots,
+        "dtype": dtype,
+        "paged": False,
+        "prompt_len": int(long_len),
+        "prefill_piece": int(piece_b),
+        "decode_chunk": chunk_eff,
+        "seq": seq,
+        "n_background": n_bg,
+        "n_arrivals": n_arr,
+    }
+    if env:
+        rec["env"] = dict(env)
+    log(f"bench: mixed-load capture done: {json.dumps(rec)}")
+    del eng, params
+    gc.collect()
+    return rec
+
+
 def main() -> None:
     import jax
 
@@ -930,7 +1161,9 @@ def main() -> None:
         # server instead of the bare engine
         plan = [dict(model=os.environ["BENCH_MODEL"],
                      dtype=os.environ.get("BENCH_DTYPE", "int8"),
-                     http=os.environ.get("BENCH_HTTP", "") == "1", **knobs)]
+                     http=os.environ.get("BENCH_HTTP", "") == "1",
+                     mixed_arm=os.environ.get("BENCH_MIXED_ARM", "") == "1",
+                     **knobs)]
     elif platform == "cpu":
         # unpinned CPU smoke: tiny model, but every knob still applies
         smoke = dict(model="tiny", dtype="float32",
@@ -942,6 +1175,10 @@ def main() -> None:
             # same config through the real HTTP server so assemble() can
             # report http_vs_engine_pct from a seconds-scale smoke run
             plan.append({**smoke, "http": True})
+        if os.environ.get("BENCH_MIXED_ARM", "") == "1":
+            # stall-free batching A/B (chunked prefill + async dispatch
+            # vs one-shot sync) through the real scheduler
+            plan.append({**smoke, "mixed_arm": True})
     else:
         # the full TPU suite, deadline-ordered so a cut run still records
         # the strongest evidence (VERDICT r4 #1/#2): the round-comparable
@@ -1002,6 +1239,13 @@ def main() -> None:
             # pallas qmm (capacity feature; bandwidth parity tracked)
             dict(model="phi", dtype="int4", slots=8, steps=64, seq=1024,
                  prompt_len=128, paged=False, mixed=False),
+            # stall-free batching A/B through the real scheduler: steady
+            # decode batch + Poisson long-prompt arrivals, chunked prefill
+            # + async double-buffered dispatch vs one-shot sync (dense —
+            # async dispatch is dense-only)
+            dict(model="tinyllama", dtype="int8", slots=16, steps=128,
+                 seq=2048, prompt_len=1024, paged=False, mixed=False,
+                 mixed_arm=True),
         ]
 
     captures = []
@@ -1023,8 +1267,10 @@ def main() -> None:
         os.environ.update(cap_env)
         http = cap.pop("http", False)
         spec = cap.pop("spec", False)
+        mixed_arm = cap.pop("mixed_arm", False)
         try:
-            fn = (measure_http if http
+            fn = (measure_mixed if mixed_arm
+                  else measure_http if http
                   else measure_spec if spec else measure)
             # plan-level keys override the global knobs (a capture may pin
             # its own page_size/n_pages — e.g. the shipped-default arm)
@@ -1083,6 +1329,14 @@ def assemble(captures: list, platform: str, n_devices: int) -> str:
                 http_ttft_ratio = round(
                     h["ttft_p50_ms"] / eng["ttft_p50_ms"], 2)
             break
+    # stall-free batching A/B (ISSUE 3 acceptance: itl_p99_ratio >= 2,
+    # bg_tok_s_ratio >= 1): the mixed-load capture's headline ratios
+    mixed_itl_p99_ratio = mixed_tok_s_ratio = None
+    for c in captures:
+        if c.get("mode") == "mixed":
+            mixed_itl_p99_ratio = c.get("itl_p99_ratio")
+            mixed_tok_s_ratio = c.get("bg_tok_s_ratio")
+            break
     return json.dumps({
         "metric": metric,
         "value": head["tok_s"],
@@ -1097,6 +1351,8 @@ def assemble(captures: list, platform: str, n_devices: int) -> str:
         "decode_step_ms": head.get("decode_step_ms"),
         "http_vs_engine_pct": http_vs_engine_pct,
         "http_ttft_ratio": http_ttft_ratio,
+        "mixed_itl_p99_ratio": mixed_itl_p99_ratio,
+        "mixed_tok_s_ratio": mixed_tok_s_ratio,
         "slots": head["slots"],
         "platform": platform,
         "dtype": head["dtype"],

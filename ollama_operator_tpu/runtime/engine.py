@@ -24,8 +24,9 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import time
 from functools import partial
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -107,9 +108,18 @@ def resolve_serving_defaults(ecfg: "EngineConfig", cfg: ModelConfig,
       so for hd<128 models the auto page count shrinks by hd/hd_pool —
       the BYTE ceiling is what's preserved, not the token count.
     """
+    import os
+
     import jax
     on_tpu = jax.default_backend() == "tpu"
     chunk = ecfg.decode_chunk or resolve_decode_chunk_default()
+    # prefill-bucket floor: smaller buckets mean finer chunked-prefill
+    # pieces (TPU_PREFILL_CHUNK rounds up to a bucket) at the cost of a
+    # few more compiled prefill programs — O(log seq) either way. Mostly
+    # useful on small-context models where the 64 default leaves no room
+    # for a multi-piece admission.
+    minb = (int(os.environ.get("TPU_MIN_PREFILL_BUCKET", "0") or 0)
+            or ecfg.min_prefill_bucket)
     # page_size 128 only pays for GQA (few kv heads → 16 KB pages at 64;
     # doubling them bought +10.5% in the r5 ladder). An MHA page is
     # already KvH× larger — the same window measured ps=128 at −2%
@@ -118,7 +128,8 @@ def resolve_serving_defaults(ecfg: "EngineConfig", cfg: ModelConfig,
     if ecfg.paged is not None and ecfg.max_slots != 0:
         ps = ecfg.page_size or (128 if on_tpu and ecfg.paged and gqa
                                 else 64)
-        return dataclasses.replace(ecfg, decode_chunk=chunk, page_size=ps)
+        return dataclasses.replace(ecfg, decode_chunk=chunk, page_size=ps,
+                                   min_prefill_bucket=minb)
     paged = (resolve_paged_default(cfg, mesh) if ecfg.paged is None
              else ecfg.paged)
     ps = ecfg.page_size or (128 if on_tpu and paged and gqa else 64)
@@ -143,7 +154,7 @@ def resolve_serving_defaults(ecfg: "EngineConfig", cfg: ModelConfig,
                       // hd_pool // ps)
     return dataclasses.replace(ecfg, paged=paged, max_slots=slots,
                                n_pages=n_pages, decode_chunk=chunk,
-                               page_size=ps)
+                               page_size=ps, min_prefill_bucket=minb)
 
 
 def resolve_paged_default(cfg: ModelConfig, mesh) -> bool:
@@ -289,6 +300,34 @@ class SlotOptions:
     # penalty window for THIS request: 0 disables the window, -1 means
     # "engine max"; values above the engine's repeat_last_n capacity clamp
     repeat_last_n: int = 64
+
+
+class DecodeHandle:
+    """An in-flight chunked decode dispatch: the device program is
+    launched and the slot state already advanced, but the sampled tokens
+    are still device-side futures. ``wait()`` materialises them ([n, B]).
+
+    The point is JAX async dispatch: the caller can launch dispatch N+1
+    (or an admission piece) BEFORE waiting on dispatch N, so host-side
+    fan-out/detokenise work overlaps device compute. Donated-state data
+    dependencies keep device programs ordered regardless of when (or
+    whether) wait() runs."""
+
+    __slots__ = ("_engine", "_toks", "_t0", "_out")
+
+    def __init__(self, engine: "Engine", toks, t0: float):
+        self._engine = engine
+        self._toks = toks
+        self._t0 = t0
+        self._out: Optional[np.ndarray] = None
+
+    def wait(self) -> np.ndarray:
+        if self._out is None:
+            self._out = self._engine._fetch(self._toks)
+            self._engine.dispatch_ms["decode"] = (
+                (time.perf_counter() - self._t0) * 1e3)
+            self._toks = None
+        return self._out
 
 
 class Engine:
@@ -502,6 +541,11 @@ class Engine:
         # host mirror of per-slot lengths — lets decode_n pick the static
         # attention bucket without a device sync
         self._host_lengths = np.zeros((B,), np.int64)
+        # last observed wall-clock per dispatch kind (launch→tokens-on-
+        # host), exported as gauges — gives dispatch-dominated regressions
+        # (e.g. the BENCH_r05 623ms/spec-dispatch anomaly) a number
+        self.dispatch_ms = {"decode": 0.0, "admit": 0.0, "extend": 0.0,
+                            "spec": 0.0}
 
         # per-slot sampling params, host mirror + device arrays
         self._opts: Dict[int, SlotOptions] = {}
@@ -718,6 +762,34 @@ class Engine:
                                      last_tokens, pring, mu, logits, ks, vs,
                                      tokens, slot, n_valid, sp_row, key,
                                      mask_row, cflag, rln, table_row)
+
+        def _make_admit_many(m):
+            """Batched fresh admission: prefill ``m`` same-bucket prompts
+            in ONE device program and insert each into its slot. The
+            prefill is batch-generic (causal masking makes each row's
+            logits independent of the others), and the per-slot inserts
+            unroll statically — the program is keyed by (m, bucket)."""
+            def _admit_many(params, k_cache, v_cache, lengths, counts,
+                            last_tokens, pring, mu, tokens, slots,
+                            n_valids, sp_rows, keys_m, mask_row, rlns,
+                            table_rows=None):
+                logits, ks, vs = prefill_impl(params, tokens=tokens)
+                toks = []
+                for i in range(m):
+                    (tok, k_cache, v_cache, lengths, counts, last_tokens,
+                     pring, mu) = _insert_prefilled(
+                        k_cache, v_cache, lengths, counts, last_tokens,
+                        pring, mu, logits[i:i + 1], ks[:, i:i + 1],
+                        vs[:, i:i + 1], tokens[i:i + 1], slots[i],
+                        n_valids[i],
+                        jax.tree_util.tree_map(lambda a: a[i:i + 1],
+                                               sp_rows),
+                        keys_m[i], mask_row, jnp.int32(0), rlns[i],
+                        None if table_rows is None else table_rows[i])
+                    toks.append(tok)
+                return (jnp.stack(toks), k_cache, v_cache, lengths,
+                        counts, last_tokens, pring, mu)
+            return _admit_many
 
         def _admit_embeds(params, k_cache, v_cache, lengths, counts,
                           last_tokens, pring, mu, tokens, embeds, slot,
@@ -1094,6 +1166,15 @@ class Engine:
         self._admit_embeds_fn = _jit(_admit_embeds, (1, 2, 3, 4, 5, 6, 7),
                                      outs=tok_outs)
         self._admit_execs: Dict[int, Any] = {}
+        if state_outs:
+            toksm_sh = repl_sh  # stacked replicated scalars stay replicated
+            many_outs = (toksm_sh,) + state_outs
+        else:
+            many_outs = None
+        self._admit_many_make = lambda m: _jit(
+            _make_admit_many(m), (1, 2, 3, 4, 5, 6, 7), outs=many_outs)
+        self._admit_many_jits: Dict[int, Any] = {}
+        self._admit_many_execs: Dict[Any, Any] = {}
         make_ext = (_make_extend_paged if self.paged
                     else _make_extend_sp if self.sp_size > 1
                     else _make_extend)
@@ -1235,6 +1316,7 @@ class Engine:
         ``set_mask``.
         """
         FAULTS.check("engine.admit")
+        t0 = time.perf_counter()
         assert not self.active[slot], f"slot {slot} busy"
         n = int(prompt.shape[0])
         if n >= self.max_seq:
@@ -1268,7 +1350,9 @@ class Engine:
                 cflag, self._gr(np.int32(self._resolve_rln(opts))),
                 table_row)
         self._commit_slot(slot, n, opts)
-        return int(tok)
+        tok = int(tok)
+        self.dispatch_ms["admit"] = (time.perf_counter() - t0) * 1e3
+        return tok
 
     def _grow_for_admit(self, slot: int, n: int):
         """Paged admission bookkeeping: drop any pages the slot still owns
@@ -1307,6 +1391,153 @@ class Engine:
         # manual region
         return self._g(rows, NamedSharding(self.mesh, P("dp", None))
                        if self.mesh is not None else None)
+
+    @property
+    def supports_admit_many(self) -> bool:
+        """Batched fresh admission (admit_many): single-controller
+        bucketed caches only — sp shards the prefill chunk over sequence
+        (rows are not independent there), paged×dp needs per-slot
+        owner/trash table routing the batched insert doesn't carry, and
+        multi-host replay keeps to the single-admit programs."""
+        return (self.sp_size == 1 and not self._multi
+                and not (self.paged and self._paged_dp > 1))
+
+    def _stack_keys(self, keys: List[Any]):
+        """Stack per-slot replicated PRNG keys into one [m] key array
+        (typed key arrays can't ride np.stack; a jitted stack with a
+        replicated out-sharding can)."""
+        fn = getattr(self, "_stack_keys_fn", None)
+        if fn is None:
+            if self._slot_sh is not None:
+                fn = jax.jit(lambda *ks: jnp.stack(ks),
+                             out_shardings=self._repl_sh)
+            else:
+                fn = jax.jit(lambda *ks: jnp.stack(ks))
+            self._stack_keys_fn = fn
+        return fn(*keys)
+
+    def _sp_many(self, opts_list: Sequence[SlotOptions]):
+        """[m]-row replicated SamplingParams (the batched twin of
+        _sp_row)."""
+        g = self._gr
+
+        def arr(f, dt):
+            return g(np.array([f(o) for o in opts_list], dt))
+        return sampling.SamplingParams(
+            temperature=arr(lambda o: o.temperature, np.float32),
+            top_k=arr(lambda o: o.top_k, np.int32),
+            top_p=arr(lambda o: o.top_p, np.float32),
+            min_p=arr(lambda o: o.min_p, np.float32),
+            typical_p=arr(lambda o: o.typical_p, np.float32),
+            repeat_penalty=arr(lambda o: o.repeat_penalty, np.float32),
+            presence_penalty=arr(lambda o: o.presence_penalty,
+                                 np.float32),
+            frequency_penalty=arr(lambda o: o.frequency_penalty,
+                                  np.float32),
+            mirostat=arr(lambda o: o.mirostat, np.int32),
+            mirostat_tau=arr(lambda o: o.mirostat_tau, np.float32),
+            mirostat_eta=arr(lambda o: o.mirostat_eta, np.float32))
+
+    def _admit_many_jit(self, m: int):
+        fn = self._admit_many_jits.get(m)
+        if fn is None:
+            fn = self._admit_many_make(m)
+            self._admit_many_jits[m] = fn
+        return fn
+
+    def _admit_many_exec(self, m: int, bucket: int):
+        exe = self._admit_many_execs.get((m, bucket))
+        if exe is None:
+            tokens = self._gr(np.zeros((m, bucket), np.int32))
+            table_rows = (self._gr(np.zeros((m, self._nblk), np.int32))
+                          if self.paged else None)
+            gi = lambda a: self._gr(np.asarray(a, np.int32))  # noqa: E731
+            exe = self._admit_many_jit(m).lower(
+                self.params, self.k_cache, self.v_cache, self.lengths,
+                self.counts, self.last_tokens, self.pring, self.mu,
+                tokens, gi(list(range(m))), gi([1] * m),
+                self._sp_many([SlotOptions()] * m),
+                self._stack_keys([self._dummy_key()] * m),
+                self._mask_ones, gi([1] * m), table_rows).compile()
+            self._admit_many_execs[(m, bucket)] = exe
+        return exe
+
+    def admit_many(self, slots: Sequence[int], prompts: Sequence[Any],
+                   opts_list: Optional[Sequence[SlotOptions]] = None
+                   ) -> List[int]:
+        """Admit several prompts padding to the SAME prefill bucket in one
+        batched dispatch; returns each slot's first sampled token, in
+        order. Token-stream-identical to m sequential admit() calls: the
+        per-slot PRNG seeds derive from (slot, seq_len) exactly as in
+        _prep_slot, and causal masking keeps each row's prefill
+        independent of its batch mates. Grammar-constrained and
+        multimodal requests take the single-admit path (the caller
+        routes them there)."""
+        m = len(slots)
+        assert m == len(prompts) >= 2, "admit_many wants >= 2 prompts"
+        assert self.supports_admit_many, "unsupported engine mode"
+        if opts_list is None:
+            opts_list = [SlotOptions()] * m
+        FAULTS.check("engine.admit")
+        t0 = time.perf_counter()
+        ns = [int(np.asarray(p).shape[0]) for p in prompts]
+        for s, n in zip(slots, ns):
+            assert not self.active[s], f"slot {s} busy"
+            if n >= self.max_seq:
+                raise ValueError(f"prompt too long: {n} >= {self.max_seq}")
+        bucket = self.bucket_for(max(ns))
+        assert all(self.bucket_for(n) == bucket for n in ns), \
+            "admit_many is per-bucket (caller groups by bucket)"
+        tokens = np.zeros((m, bucket), np.int32)
+        for i, (p, n) in enumerate(zip(prompts, ns)):
+            tokens[i, :n] = np.asarray(p, np.int32)
+        table_rows = None
+        if self.paged:
+            from .paged import PagesExhausted
+            grown: List[int] = []
+            try:
+                for s, n in zip(slots, ns):
+                    self._grow_for_admit(s, n)
+                    grown.append(s)
+            except PagesExhausted:
+                # roll back so a sequential-fallback pass sees the pool
+                # unchanged (the parked prefixes these slots may have
+                # held are gone either way — the caller already popped
+                # them from its reuse map)
+                for s in grown:
+                    self._pt.release(s)
+                raise
+            table_rows = self._gr(
+                np.stack([self._pt.tables[s] for s in slots]))
+        keys = []
+        for s, o, n in zip(slots, opts_list, ns):
+            key, _, _ = self._prep_slot(s, o, n, None)
+            keys.append(key)
+        gi = lambda a: self._gr(np.asarray(a, np.int32))  # noqa: E731
+        (toks, self.k_cache, self.v_cache, self.lengths, self.counts,
+         self.last_tokens, self.pring, self.mu) = \
+            self._admit_many_exec(m, bucket)(
+                self.params, self.k_cache, self.v_cache, self.lengths,
+                self.counts, self.last_tokens, self.pring, self.mu,
+                self._gr(tokens), gi(list(slots)), gi(ns),
+                self._sp_many(opts_list), self._stack_keys(keys),
+                self._mask_ones,
+                gi([self._resolve_rln(o) for o in opts_list]), table_rows)
+        for s, n, o in zip(slots, ns, opts_list):
+            self.active[s] = True
+            self._host_lengths[s] = n
+            self._opts[s] = o
+            self._repeat_n[s] = self._resolve_rln(o)
+            if self.paged:
+                self._admit_seq += 1
+                self._admit_order[s] = self._admit_seq
+        self._rln_dev = self._g(self._repeat_n, self._slot_sh)
+        self._rebuild_sp()
+        self._active_dev = self._g(self.active.astype(np.int32),
+                                   self._slot_sh)
+        out = [int(t) for t in self._fetch(toks)]
+        self.dispatch_ms["admit"] = (time.perf_counter() - t0) * 1e3
+        return out
 
     @property
     def supports_extend(self) -> bool:
@@ -1369,6 +1600,11 @@ class Engine:
         ids share that prefix — stale entries at positions >= start are
         never attended: masking is position-based and the tail overwrites
         them)."""
+        # same fault point as admit(): an extend IS an admission (prefix
+        # reuse or a chunked-prefill piece), and chaos drills must reach
+        # the chunked path through it
+        FAULTS.check("engine.admit")
+        t0 = time.perf_counter()
         assert not self.active[slot], f"slot {slot} busy"
         full_ids = np.asarray(full_ids, np.int32)
         n_total = int(full_ids.shape[0])
@@ -1441,7 +1677,9 @@ class Engine:
          self.last_tokens, self.pring, self.mu) = \
             self._extend_exec(bucket, attn_a)(*args)
         self._commit_slot(slot, n_total, opts)
-        return int(tok)
+        tok = int(tok)
+        self.dispatch_ms["extend"] = (time.perf_counter() - t0) * 1e3
+        return tok
 
     def _attn_bucket(self, n: int) -> int:
         """Static attended-prefix length covering every active slot for the
@@ -1583,6 +1821,13 @@ class Engine:
             return
         for b in self._buckets:
             self._admit_exec(b)
+        if self.supports_admit_many:
+            # batched-admission programs for the group sizes the
+            # scheduler forms (see Scheduler._admit_waiting)
+            for b in self._buckets:
+                for m in (2, 4):
+                    if m <= self.n_slots:
+                        self._admit_many_exec(m, b)
         import os as _os
         spec_k = int(_os.environ.get("TPU_SPEC_DECODE", "0") or "0")
         if (spec_k > 0 and self.sp_size == 1
@@ -1672,7 +1917,16 @@ class Engine:
         Paged mode: callers that want preemption-on-pool-dry run
         ``prepare_decode`` themselves first and requeue the victims; here
         a dry pool raises (tests/bench size their pools adequately)."""
+        return self.decode_n_launch(n).wait()
+
+    def decode_n_launch(self, n: Optional[int] = None) -> DecodeHandle:
+        """Launch one chunked decode dispatch WITHOUT materialising its
+        tokens: slot state (host lengths included) advances immediately;
+        the returned handle's wait() fetches [n, B]. Double-buffering
+        callers launch dispatch N+1 before waiting on N so fan-out work
+        overlaps device compute (see DecodeHandle)."""
         FAULTS.check("engine.step")
+        t0 = time.perf_counter()
         n = n or self.ecfg.decode_chunk
         victims = self.prepare_decode(n)
         if victims:
@@ -1688,7 +1942,7 @@ class Engine:
             self._rln_dev, self._tables_dev(),
             self._g(budgets, self._slot_sh))
         self._host_lengths[self.active] += budgets[self.active]
-        return self._fetch(toks_n)
+        return DecodeHandle(self, toks_n, t0)
 
     def _spec_exec(self, k: int, attn_len: int):
         key = (k, attn_len)
@@ -1720,6 +1974,7 @@ class Engine:
             "speculative decode: bucketed caches only (no sp meshes)"
         assert not (self.paged and self._paged_dp > 1), \
             "speculative decode: the paged dp-manual region is T=1 only"
+        t0 = time.perf_counter()
         k = int(drafts.shape[1])
         assert k >= 1, "need at least one draft column"
         n = k + 1
@@ -1752,6 +2007,7 @@ class Engine:
         toks = self._fetch(toks)
         n_out = (toks < self.cfg.vocab_size).sum(axis=1)
         self._host_lengths[self.active] += n_out[self.active]
+        self.dispatch_ms["spec"] = (time.perf_counter() - t0) * 1e3
         return toks
 
     def step_budgets(self, n: int) -> np.ndarray:

@@ -182,9 +182,10 @@ class MirroredEngine:
     that dispatches a device program or mutates replay-relevant host
     state (page tables). Everything else delegates transparently."""
 
-    MIRRORED = ("admit", "extend", "decode", "decode_n", "decode_spec",
-                "release", "set_mask", "clear_mask", "warm_buckets",
-                "free_slot_pages", "prepare_decode")
+    MIRRORED = ("admit", "admit_many", "extend", "decode", "decode_n",
+                "decode_n_launch", "decode_spec", "release", "set_mask",
+                "clear_mask", "warm_buckets", "free_slot_pages",
+                "prepare_decode")
 
     def __init__(self, inner, cp: ControlPlane):
         object.__setattr__(self, "_inner", inner)

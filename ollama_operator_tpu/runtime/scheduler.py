@@ -142,6 +142,19 @@ class Request:
                 raise RuntimeError(payload)
 
 
+class _PrefillJob:
+    """A request whose prompt is admitting piece by piece (chunked
+    prefill): ``done`` tokens of ``req.admit_ids`` are already in the
+    slot's KV cache. Between pieces the slot is parked (engine-inactive),
+    so the scheduler — not the engine — must remember it is taken."""
+
+    __slots__ = ("req", "done")
+
+    def __init__(self, req: Request, done: int):
+        self.req = req
+        self.done = done
+
+
 class Scheduler:
     # a parked prefix must beat this many cached tokens to be worth an
     # extend over a fresh admit (tiny reuses still pay a full slice+write)
@@ -152,7 +165,9 @@ class Scheduler:
 
     def __init__(self, engine: Engine, max_queue: int = 256,
                  max_restarts: Optional[int] = None,
-                 restart_backoff: Optional[float] = None):
+                 restart_backoff: Optional[float] = None,
+                 prefill_chunk: Optional[int] = None,
+                 async_dispatch: Optional[bool] = None):
         self.engine = engine
         # crash-only supervision: after a decode-loop failure the engine
         # state is rebuilt in-process up to max_restarts consecutive
@@ -188,6 +203,36 @@ class Scheduler:
                   f"remote dispatch is 0.023x chunked decode (BASELINE.md "
                   f"r4); enable only on colocated hosts after measuring "
                   f"bench.py's spec envelope there", file=_sys.stderr)
+        # stall-free chunked prefill (Sarathi-style): prompts longer than
+        # one piece admit bucket-by-bucket through Engine.extend, one
+        # piece per scheduler step, so the worst-case stall a DECODING
+        # slot sees is one piece's prefill, not one prompt's. 0 disables;
+        # unset derives from decode_chunk (rounded up to a real bucket).
+        if prefill_chunk is None:
+            pc_env = os.environ.get("TPU_PREFILL_CHUNK", "")
+            prefill_chunk = (int(pc_env) if pc_env
+                             else engine.ecfg.decode_chunk * 8)
+        self.prefill_chunk = (
+            engine.bucket_for(min(int(prefill_chunk), engine.max_seq))
+            if prefill_chunk and engine.supports_extend else 0)
+        # double-buffered async dispatch: launch decode dispatch N+1
+        # before materialising N's tokens, so host fan-out/detokenise
+        # overlaps device compute (JAX async dispatch). Grammar and
+        # spec-decode need host work between dispatches and stay
+        # synchronous; paged mode too — recycling a page while an
+        # in-flight program still writes it through a captured block
+        # table would corrupt the new owner.
+        if async_dispatch is None:
+            async_dispatch = os.environ.get(
+                "TPU_ASYNC_DISPATCH", "1").lower() not in ("0", "false")
+        self.async_dispatch = bool(async_dispatch) and not engine.paged
+        # slot → _PrefillJob for requests mid-chunked-prefill (the slot
+        # is engine-inactive between pieces; without this map
+        # free_slots() would hand it to someone else)
+        self._prefilling: dict = {}
+        # (DecodeHandle, {slot: request-at-launch}) of the in-flight
+        # decode dispatch, when double-buffering
+        self._pending = None
         self._waiting: queue.Queue = queue.Queue(maxsize=max_queue)
         # preempted requests (paged pool pressure) re-admit before the
         # waiting queue — they already hold a place in the line
@@ -248,6 +293,10 @@ class Scheduler:
         self._stop.set()
         self._wake.set()
         self._thread.join(timeout=10)
+        # an in-flight dispatch's tokens die with the loop; its owners
+        # are still in _running and drain below
+        self._pending = None
+        self._prefilling.clear()
         # drain everything still attached so no caller blocks forever on
         # req.tokens() after an unload (model swap, server shutdown)
         for slot, req in enumerate(self._running):
@@ -411,103 +460,283 @@ class Scheduler:
             METRICS.inc("tpu_model_request_timeouts_total")
             req.out.put(("done", "timeout"))
 
-    def _admit_waiting(self):
-        free = self.engine.free_slots()
-        while free:
-            req = self._next_waiting()
-            if req is None:
-                return
-            if req.cancelled.is_set():
-                req.out.put(("done", "cancelled"))
-                continue
-            if (req.deadline is not None
-                    and time.monotonic() > req.deadline):
-                # expired between the sweep and this pop
-                if req.resume_ids is not None:
-                    METRICS.inc("tpu_model_request_timeouts_total")
-                    req.out.put(("done", "timeout"))
-                else:
-                    self._shed(req)
-                continue
-            reuse_slot, reuse_len = self._best_prefix(req)
-            if reuse_slot is not None:
-                slot = reuse_slot
-                free.remove(slot)
-            else:
-                # prefer slots that (a) sit on a dp shard whose sub-pool
-                # can actually hold this prompt (paged×dp: shard-blind
-                # picks would raise PagesExhausted and thrash evictions
-                # while another shard idles) and (b) have no parked
-                # prefix, keeping reusable caches alive as slots allow
-                n_tok = len(req.admit_ids)
+    def _request_error(self, req: Request, msg: str):
+        """Terminal error frame for a request that never held (or just
+        lost) a slot."""
+        req.error = msg
+        req.stats.t_done = time.monotonic()
+        with self._lock:
+            self.finished.append(req.stats)
+        req.out.put(("error", msg))
 
-                def _pick():
-                    for cond in (
-                            lambda s: s not in self._parked
-                            and self.engine.can_admit(s, n_tok),
-                            lambda s: self.engine.can_admit(s, n_tok),
-                            lambda s: s not in self._parked):
-                        for s in free:
-                            if cond(s):
-                                return s
-                    return free[0]
-                slot = _pick()
-                free.remove(slot)
-            # the slot's parked cache is spoken for either way: on success
-            # the request owns it; on failure the slot state is unknown and
-            # must not be offered for reuse again (a stale entry would also
-            # crash the NEXT request's free.remove in this same pass)
-            self._parked.pop(slot, None)
-            try:
+    def _post_admit(self, slot: int, req: Request, first: int):
+        """Shared admission tail (one-shot, batched, and the final
+        chunked piece): stats, slot ownership, grammar gate, first-token
+        emit."""
+        req.slot = slot
+        if req.stats.t_admitted == 0:
+            # first admission only — a preempted request re-admitting
+            # must not re-count its prompt in throughput stats
+            self.total_prompt += req.stats.n_prompt
+        req.stats.t_admitted = time.monotonic()
+        self._running[slot] = req
+        # grammar check before emitting (see _fanout)
+        if (req.constraint is not None
+                and first not in req.eog_ids
+                and not req.constraint.advance(first)):
+            self._finish(slot, req, "stop")
+        elif not self._emit_first(req, first):
+            # EOG is a natural stop; an exhausted max_tokens budget is a
+            # truncation — Ollama clients tell them apart by done_reason
+            self._finish(slot, req, "stop"
+                         if req.all_tokens[-1] in req.eog_ids
+                         else "length")
+        elif req.constraint is not None:
+            self.engine.set_mask(slot, req.constraint.mask_row())
+
+    def _admit_one(self, slot: int, req: Request, reuse_len: int) -> bool:
+        """One blocking admission (fresh or prefix-reusing). Returns
+        False when the paged pool ran dry and the request was requeued —
+        the caller should stop admitting this pass."""
+        t0 = time.perf_counter()
+        try:
+            mask_row = (req.constraint.mask_row()
+                        if req.constraint is not None else None)
+            if reuse_len:
+                first = self.engine.extend(slot, req.admit_ids,
+                                           reuse_len, req.opts,
+                                           mask_row=mask_row)
+                req.stats.n_reused = reuse_len
+            else:
+                first = self.engine.admit(slot, req.admit_ids,
+                                          req.opts, embeds=req.embeds,
+                                          mask_row=mask_row)
+        except PagesExhausted as e:
+            # paged pool dry: evict a parked prefix and retry this
+            # request next pass; with nothing to evict it waits for a
+            # finisher (unless it can never fit at all)
+            if not self.engine.admissible(len(req.admit_ids)):
+                self._request_error(
+                    req, f"prompt needs more KV pages than the pool "
+                         f"has: {e}")
+                return True
+            self._evict_one_parked()
+            self._preempted.insert(0, req)
+            return False
+        except Exception as e:  # surfacing engine errors to the caller
+            self._request_error(req, str(e))
+            return True
+        METRICS.inc("tpu_model_admission_stall_ms_total",
+                    (time.perf_counter() - t0) * 1e3)
+        self._post_admit(slot, req, first)
+        return True
+
+    def _start_chunked(self, slot: int, req: Request,
+                       reuse_len: int) -> bool:
+        """First piece of a chunked admission: prefill one
+        prefill_chunk-sized bucket, park the slot, and register the job —
+        the remaining pieces interleave with decode dispatches
+        (_advance_prefill). Returns False when the paged pool ran dry and
+        the request was requeued."""
+        ids = req.admit_ids
+        end = reuse_len + self.prefill_chunk
+        t0 = time.perf_counter()
+        try:
+            if reuse_len:
+                self.engine.extend(slot, ids[:end], reuse_len)
+                req.stats.n_reused = reuse_len
+            else:
+                self.engine.admit(slot, ids[:end])
+            # park between pieces: cache and lengths stay, the slot goes
+            # engine-inactive so decode dispatches skip it
+            self.engine.release(slot, park=True)
+        except PagesExhausted as e:
+            if not self.engine.admissible(len(ids)):
+                self._request_error(
+                    req, f"prompt needs more KV pages than the pool "
+                         f"has: {e}")
+                return True
+            self._evict_one_parked()
+            self._preempted.insert(0, req)
+            return False
+        except Exception as e:
+            self._request_error(req, str(e))
+            return True
+        METRICS.inc("tpu_model_prefill_chunks_total")
+        METRICS.inc("tpu_model_admission_stall_ms_total",
+                    (time.perf_counter() - t0) * 1e3)
+        req.slot = slot
+        self._running[slot] = req
+        self._prefilling[slot] = _PrefillJob(req, end)
+        return True
+
+    def _abort_prefill(self, slot: int, reason: str):
+        job = self._prefilling.pop(slot)
+        req = job.req
+        self._running[slot] = None
+        self.engine.release(slot)
+        req.stats.t_done = time.monotonic()
+        with self._lock:
+            self.finished.append(req.stats)
+        req.out.put(("done", reason))
+
+    def _advance_prefill(self):
+        """One prefill piece for the oldest chunked-admission job — at
+        most one per scheduler step, so decoding slots never stall more
+        than one piece per dispatch. The final piece runs with the
+        request's real options/grammar mask and samples its TTFT token
+        (PRNG-seed-identical to a one-shot admission: the seed derives
+        from (slot, full prompt length))."""
+        if not self._prefilling:
+            return
+        slot = next(iter(self._prefilling))
+        job = self._prefilling[slot]
+        req = job.req
+        if req.cancelled.is_set():
+            self._abort_prefill(slot, "cancelled")
+            return
+        if req.deadline is not None and time.monotonic() > req.deadline:
+            METRICS.inc("tpu_model_request_timeouts_total")
+            self._abort_prefill(slot, "timeout")
+            return
+        ids = req.admit_ids
+        end = min(job.done + self.prefill_chunk, len(ids))
+        final = end == len(ids)
+        t0 = time.perf_counter()
+        try:
+            if final:
                 mask_row = (req.constraint.mask_row()
                             if req.constraint is not None else None)
-                if reuse_slot is not None:
-                    first = self.engine.extend(slot, req.admit_ids,
-                                               reuse_len, req.opts,
-                                               mask_row=mask_row)
-                    req.stats.n_reused = reuse_len
-                else:
-                    first = self.engine.admit(slot, req.admit_ids,
-                                              req.opts, embeds=req.embeds,
-                                              mask_row=mask_row)
-            except PagesExhausted as e:
-                # paged pool dry: evict a parked prefix and retry this
-                # request next pass; with nothing to evict it waits for a
-                # finisher (unless it can never fit at all)
-                if not self.engine.admissible(len(req.admit_ids)):
-                    req.error = (f"prompt needs more KV pages than the "
-                                 f"pool has: {e}")
-                    req.stats.t_done = time.monotonic()
-                    with self._lock:
-                        self.finished.append(req.stats)
-                    req.out.put(("error", req.error))
+                first = self.engine.extend(slot, ids, job.done, req.opts,
+                                           mask_row=mask_row)
+            else:
+                self.engine.extend(slot, ids[:end], job.done)
+                self.engine.release(slot, park=True)
+                job.done = end
+        except PagesExhausted:
+            # mid-prefill pool pressure: back out and requeue; the
+            # re-admission restarts the prompt (no tokens were emitted)
+            self._prefilling.pop(slot, None)
+            self._running[slot] = None
+            req.slot = None
+            self.engine.release(slot)
+            self._evict_one_parked()
+            self._preempted.insert(0, req)
+            return
+        # any other engine failure propagates to the supervisor, which
+        # errors every running request (this one included) exactly once
+        # and restarts — _fail_running clears _prefilling
+        METRICS.inc("tpu_model_prefill_chunks_total")
+        METRICS.inc("tpu_model_admission_stall_ms_total",
+                    (time.perf_counter() - t0) * 1e3)
+        if final:
+            self._prefilling.pop(slot, None)
+            self._post_admit(slot, req, first)
+
+    def _flush_admit_batch(self, batch: dict):
+        """Admit the same-bucket groups collected this pass: groups of 4
+        then 2 take ONE batched dispatch each; leftovers (and any group
+        whose batched dispatch failed) fall back to sequential
+        admission."""
+        for bucket, items in batch.items():
+            while len(items) >= 2:
+                m = 4 if len(items) >= 4 else 2
+                group, items = items[:m], items[m:]
+                t0 = time.perf_counter()
+                try:
+                    toks = self.engine.admit_many(
+                        [s for s, _ in group],
+                        [r.admit_ids for _, r in group],
+                        [r.opts for _, r in group])
+                except Exception:  # noqa: BLE001 — pool dry, injected
+                    # fault, ...: the batched program mutated nothing
+                    # (paged grows roll back), so each request retries
+                    # on the single-admit path with its own error
+                    # handling
+                    for s, r in group:
+                        self._admit_one(s, r, 0)
                     continue
-                self._evict_one_parked()
-                self._preempted.insert(0, req)
-                return
-            except Exception as e:  # surfacing engine errors to the caller
-                req.error = str(e)
-                req.stats.t_done = time.monotonic()
-                with self._lock:
-                    self.finished.append(req.stats)
-                req.out.put(("error", str(e)))
-                continue
-            req.slot = slot
-            if req.stats.t_admitted == 0:
-                # first admission only — a preempted request re-admitting
-                # must not re-count its prompt in throughput stats
-                self.total_prompt += req.stats.n_prompt
-            req.stats.t_admitted = time.monotonic()
-            self._running[slot] = req
-            # grammar check before emitting (see _step)
-            if (req.constraint is not None
-                    and first not in req.eog_ids
-                    and not req.constraint.advance(first)):
-                self._finish(slot, req, "stop")
-            elif not self._emit_first(req, first):
-                self._finish(slot, req, "stop")
-            elif req.constraint is not None:
-                self.engine.set_mask(slot, req.constraint.mask_row())
+                METRICS.inc("tpu_model_admission_stall_ms_total",
+                            (time.perf_counter() - t0) * 1e3)
+                for (s, r), tok in zip(group, toks):
+                    self._post_admit(s, r, tok)
+            for s, r in items:
+                self._admit_one(s, r, 0)
+
+    def _admit_waiting(self):
+        # slots mid-chunked-prefill are engine-inactive but TAKEN
+        free = [s for s in self.engine.free_slots()
+                if s not in self._prefilling]
+        batch: dict = {}   # prefill bucket → [(slot, req)] to batch-admit
+        try:
+            while free:
+                req = self._next_waiting()
+                if req is None:
+                    return
+                if req.cancelled.is_set():
+                    req.out.put(("done", "cancelled"))
+                    continue
+                if (req.deadline is not None
+                        and time.monotonic() > req.deadline):
+                    # expired between the sweep and this pop
+                    if req.resume_ids is not None:
+                        METRICS.inc("tpu_model_request_timeouts_total")
+                        req.out.put(("done", "timeout"))
+                    else:
+                        self._shed(req)
+                    continue
+                reuse_slot, reuse_len = self._best_prefix(req)
+                if reuse_slot is not None:
+                    slot = reuse_slot
+                    free.remove(slot)
+                else:
+                    # prefer slots that (a) sit on a dp shard whose
+                    # sub-pool can actually hold this prompt (paged×dp:
+                    # shard-blind picks would raise PagesExhausted and
+                    # thrash evictions while another shard idles) and
+                    # (b) have no parked prefix, keeping reusable caches
+                    # alive as slots allow
+                    n_tok = len(req.admit_ids)
+
+                    def _pick():
+                        for cond in (
+                                lambda s: s not in self._parked
+                                and self.engine.can_admit(s, n_tok),
+                                lambda s: self.engine.can_admit(s, n_tok),
+                                lambda s: s not in self._parked):
+                            for s in free:
+                                if cond(s):
+                                    return s
+                        return free[0]
+                    slot = _pick()
+                    free.remove(slot)
+                # the slot's parked cache is spoken for either way: on
+                # success the request owns it; on failure the slot state
+                # is unknown and must not be offered for reuse again (a
+                # stale entry would also crash the NEXT request's
+                # free.remove in this same pass)
+                self._parked.pop(slot, None)
+                ids = req.admit_ids
+                piece = self.prefill_chunk
+                if (piece and len(ids) - reuse_len > piece
+                        and req.embeds is None
+                        and len(ids) + piece <= self.engine.max_seq):
+                    # long prompt: admit piecewise, one piece per step
+                    if not self._start_chunked(slot, req, reuse_len):
+                        return
+                    continue
+                if (reuse_slot is None and req.embeds is None
+                        and req.constraint is None
+                        and self.engine.supports_admit_many):
+                    # same-bucket fresh admissions coalesce into one
+                    # batched dispatch at the end of the pass
+                    bucket = self.engine.bucket_for(len(ids))
+                    batch.setdefault(bucket, []).append((slot, req))
+                    continue
+                if not self._admit_one(slot, req, reuse_len):
+                    return
+        finally:
+            self._flush_admit_batch(batch)
 
     def _loop(self):
         while not self._stop.is_set():
@@ -558,6 +787,11 @@ class Scheduler:
             self._stop.wait(delay)
 
     def _fail_running(self, message: str):
+        # the in-flight async dispatch (and any mid-chunked-prefill
+        # state) dies with the engine state; every owner is still in
+        # _running and gets exactly ONE error frame below
+        self._pending = None
+        self._prefilling.clear()
         for slot, req in enumerate(self._running):
             if req is None:
                 continue
@@ -627,7 +861,7 @@ class Scheduler:
         drafts = np.zeros((self.engine.n_slots, k), np.int32)
         n_drafting = n_running = 0
         for slot, req in enumerate(self._running):
-            if req is None:
+            if req is None or slot in self._prefilling:
                 continue
             n_running += 1
             if req.constraint is not None:
@@ -670,18 +904,40 @@ class Scheduler:
             return None
         return hist[pos: pos + k] or None
 
+    def _drain_pending(self):
+        """Materialise and fan out the in-flight async dispatch, if any.
+        Pops BEFORE waiting: if the fetch itself fails (poisoned device
+        state) the supervisor must error the owners, never re-deliver."""
+        if self._pending is None:
+            return
+        handle, snapshot = self._pending
+        self._pending = None
+        toks_n = handle.wait()
+        self._consecutive_failures = 0
+        self._fanout(toks_n, snapshot)
+
+    def _decoding(self) -> dict:
+        """slot → request for every slot the NEXT decode dispatch will
+        advance (mid-chunked-prefill slots are engine-inactive and
+        excluded)."""
+        return {s: r for s, r in enumerate(self._running)
+                if r is not None and s not in self._prefilling}
+
     def _step(self):
         self._shed_expired()
+        self._advance_prefill()
         self._admit_waiting()
-        active = [(s, r) for s, r in enumerate(self._running)
-                  if r is not None]
-        if not active:
-            self._wake.wait(timeout=0.05)
-            self._wake.clear()
+        if not self._decoding():
+            self._drain_pending()
+            if not self._prefilling:
+                self._wake.wait(timeout=0.05)
+                self._wake.clear()
             return
-        # drop cancelled and over-deadline slots before paying for a step
+        # drop cancelled and over-deadline slots before paying for a
+        # step; under double-buffering their in-flight rows are dropped
+        # by _fanout's snapshot identity check
         now = time.monotonic()
-        for slot, req in active:
+        for slot, req in self._decoding().items():
             if req.cancelled.is_set():
                 self._finish(slot, req, "cancelled")
             elif req.deadline is not None and now > req.deadline:
@@ -689,7 +945,9 @@ class Scheduler:
                 # frame, slot released and immediately reusable
                 METRICS.inc("tpu_model_request_timeouts_total")
                 self._finish(slot, req, "timeout")
-        if self.n_active == 0:
+        decoding = self._decoding()
+        if not decoding:
+            self._drain_pending()
             return
         # chunked decode: ecfg.decode_chunk steps per device round-trip.
         # A slot that stops mid-chunk has its remaining rows discarded
@@ -701,9 +959,8 @@ class Scheduler:
         # chunk (round-1 weak #5: one format:"json" request used to drop
         # everyone to n=1). Only when EVERY active slot is constrained is
         # a 1-step dispatch cheaper.
-        running = [r for r in self._running if r is not None]
-        n_steps = (1 if running
-                   and all(r.constraint is not None for r in running)
+        n_steps = (1 if all(r.constraint is not None
+                            for r in decoding.values())
                    else None)
         spec_usable = (self.spec_k > 0 and self.engine.sp_size == 1
                        and not (self.engine.paged
@@ -712,17 +969,56 @@ class Scheduler:
         drafts = self._build_drafts(self.spec_k) if spec_usable else None
         self._relieve_pressure(self.spec_k + 1 if drafts is not None
                                else n_steps)
-        if self.n_active == 0:
+        decoding = self._decoding()
+        if not decoding:
+            self._drain_pending()
             return
-        if drafts is not None:
-            toks_n = self.engine.decode_spec(drafts).T   # [k+1, B] rows
-        else:
-            toks_n = self.engine.decode_n(n_steps)
-        self._consecutive_failures = 0
-        # per-slot chunk buffers: ONE queue item (and one monotonic stamp)
-        # per request per dispatch, not per token — at decode_chunk=32 this
-        # cuts queue/lock traffic on the consumer path 32×, which is the
-        # bulk of the HTTP-vs-engine throughput gap (BENCH_r05)
+        constrained = any(r.constraint is not None
+                          for r in decoding.values())
+        if not (self.async_dispatch and drafts is None
+                and not constrained):
+            # synchronous path: grammar needs a fresh host mask between
+            # dispatches, spec verify reads host-built drafts — the
+            # pipeline must be empty before either dispatches
+            self._drain_pending()
+            if drafts is not None:
+                toks_n = self.engine.decode_spec(drafts).T  # [k+1, B]
+            else:
+                toks_n = self.engine.decode_n(n_steps)
+            self._consecutive_failures = 0
+            self._fanout(toks_n, decoding)
+            return
+        # double-buffered async dispatch: launch dispatch N+1 FIRST,
+        # then materialise and fan out dispatch N — detokenise/queue
+        # work on the host overlaps device compute. Device programs stay
+        # ordered through their donated-state data dependencies.
+        try:
+            handle = self.engine.decode_n_launch()
+        except Exception:
+            # dispatch N's tokens were already computed — deliver them
+            # before the supervisor errors whoever is left
+            self._drain_pending()
+            raise
+        prev, self._pending = self._pending, (handle, decoding)
+        if prev is not None:
+            prev_handle, prev_snapshot = prev
+            toks_n = prev_handle.wait()
+            self._consecutive_failures = 0
+            self._fanout(toks_n, prev_snapshot)
+
+    def _fanout(self, toks_n, snapshot: dict):
+        """Deliver one dispatch's token rows [n, B] to the requests in
+        ``snapshot`` (slot → request AT LAUNCH time). Under
+        double-buffering a slot may have finished, been preempted, or
+        been re-admitted since the dispatch launched — rows for a slot
+        whose occupant changed are dropped (the over-decoded cache
+        positions are never attended; a preempted request resumes from
+        exactly the tokens it was delivered).
+
+        Per-slot chunk buffers: ONE queue item (and one monotonic stamp)
+        per request per dispatch, not per token — at decode_chunk=32 this
+        cuts queue/lock traffic on the consumer path 32×, which is the
+        bulk of the HTTP-vs-engine throughput gap (BENCH_r05)."""
         pend: dict = {}
 
         def _flush(slot: int, req: Request):
@@ -732,9 +1028,10 @@ class Scheduler:
 
         for row_idx, row in enumerate(np.asarray(toks_n)):
             any_running = False
-            for slot, req in enumerate(list(self._running)):
-                if req is None:
-                    continue
+            for slot, req in snapshot.items():
+                if (self._running[slot] is not req
+                        or slot in self._prefilling):
+                    continue   # slot changed hands since launch
                 any_running = True
                 if req.constraint is not None and row_idx >= 1:
                     continue  # frozen after its 1-token budget
@@ -753,7 +1050,7 @@ class Scheduler:
                     continue
                 if req.stats.n_generated == 0:
                     req.stats.t_first_token = time.monotonic()
-                req.all_tokens.append(tid)  # EOG incl.: it's in the KV cache
+                req.all_tokens.append(tid)  # EOG incl.: it's in the cache
                 if tid in req.eog_ids:
                     _flush(slot, req)
                     self._finish(slot, req, "stop")
@@ -763,7 +1060,9 @@ class Scheduler:
                 pend.setdefault(slot, []).append(tid)
                 if req.stats.n_generated >= req.max_tokens:
                     _flush(slot, req)
-                    self._finish(slot, req, "stop")
+                    # budget exhausted = truncation, not natural stop
+                    # (Ollama semantics: clients distinguish the two)
+                    self._finish(slot, req, "length")
                 # host-side length tracking (no device sync): the cache
                 # holds the prompt plus one entry per decode step so far
                 elif (req.stats.n_prompt + req.stats.n_generated

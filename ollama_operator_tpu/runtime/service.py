@@ -255,6 +255,17 @@ class LoadedModel:
             METRICS.gauge_fn("tpu_model_kv_free_pages",
                              lambda: (lm := wself()) is not None
                              and lm.engine.free_pages or 0)
+        # per-program dispatch latency (launch → tokens on host), one
+        # labelled gauge per program kind: decode-chunk, one-shot admit,
+        # extend (prefix reuse / chunked-prefill pieces), spec verify —
+        # the number behind dispatch-dominated regressions like the
+        # BENCH_r05 623ms/spec-dispatch anomaly
+        for _kind in ("decode", "admit", "extend", "spec"):
+            METRICS.gauge_fn(
+                "tpu_model_dispatch_ms",
+                lambda k=_kind: (lm := wself()) is not None
+                and lm.engine.dispatch_ms.get(k, 0.0) or 0.0,
+                labels=f'{{program="{_kind}"}}')
 
     # ------------------------------------------------------------------
     # multimodal (llava): image bytes → projected embeddings → spliced
@@ -645,6 +656,9 @@ class LoadedModel:
         METRICS.remove_gauge("tpu_model_queue_depth")
         if self.engine.paged:
             METRICS.remove_gauge("tpu_model_kv_free_pages")
+        for _kind in ("decode", "admit", "extend", "spec"):
+            METRICS.remove_gauge("tpu_model_dispatch_ms",
+                                 labels=f'{{program="{_kind}"}}')
 
 
 class _IdleScheduler:

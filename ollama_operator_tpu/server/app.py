@@ -594,6 +594,18 @@ class ModelManager:
                     "followers_lost": int(METRICS.get(
                         "tpu_model_followers_lost_total")),
                 },
+                # stall-free batching telemetry: last launch-to-host ms
+                # per device program kind, plus process-lifetime admission
+                # counters (same series /metrics exports)
+                "dispatch": {
+                    "dispatch_ms": (dict(lm.engine.dispatch_ms)
+                                    if getattr(lm, "engine", None)
+                                    is not None else {}),
+                    "prefill_chunks": int(METRICS.get(
+                        "tpu_model_prefill_chunks_total")),
+                    "admission_stall_ms": METRICS.get(
+                        "tpu_model_admission_stall_ms_total"),
+                },
             })
         return out
 

@@ -146,12 +146,29 @@ GLOBAL.describe("tpu_model_requests_shed_total",
 GLOBAL.describe("tpu_model_followers_lost_total",
                 "Multi-host follower connections lost (send failure or "
                 "missed heartbeat); the world is degraded afterwards")
+GLOBAL.describe("tpu_model_dispatch_ms",
+                "Last observed launch-to-tokens-on-host wall-clock per "
+                "device program kind (decode chunk, one-shot admit, "
+                "extend, speculative verify)")
+GLOBAL.describe("tpu_model_admission_stall_ms_total",
+                "Wall-clock milliseconds decode dispatches spent stalled "
+                "behind admission prefill work (one-shot, batched, and "
+                "per chunked-prefill piece); divide by "
+                "tpu_model_prefill_chunks_total for ms/piece")
+GLOBAL.describe("tpu_model_prefill_chunks_total",
+                "Chunked-prefill pieces dispatched (stall-free admission "
+                "of long prompts, one bucket-sized piece per scheduler "
+                "step)")
 # pre-seed the failure counters at 0: alert rules rate() over these, and
 # a series that first appears AT the first failure hides that failure
+# (the stall/chunk counters likewise: a mixed-load dashboard must read 0,
+# not absent, on an idle server)
 for _name in ("tpu_model_engine_restarts_total",
               "tpu_model_request_timeouts_total",
               "tpu_model_requests_shed_total",
-              "tpu_model_followers_lost_total"):
+              "tpu_model_followers_lost_total",
+              "tpu_model_admission_stall_ms_total",
+              "tpu_model_prefill_chunks_total"):
     GLOBAL.inc(_name, 0.0)
 
 

@@ -111,6 +111,7 @@ def test_engine_failure_fails_requests_not_thread():
     try:
         calls = {"n": 0}
         real_decode_n = eng.decode_n
+        real_launch = eng.decode_n_launch
 
         def flaky_decode_n(n=None):
             calls["n"] += 1
@@ -118,7 +119,16 @@ def test_engine_failure_fails_requests_not_thread():
                 raise RuntimeError("injected XLA error")
             return real_decode_n(n)
 
+        def flaky_launch(n=None):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("injected XLA error")
+            return real_launch(n)
+
+        # a dead device step dies on BOTH entry points: the sync path and
+        # the async double-buffered launch the scheduler uses by default
         eng.decode_n = flaky_decode_n
+        eng.decode_n_launch = flaky_launch
         r1 = sched.submit(np.array([1, 2], np.int32), GREEDY, max_tokens=4)
         try:
             toks = list(r1.tokens())
@@ -145,6 +155,7 @@ def test_repeated_engine_failures_mark_broken():
             raise RuntimeError("dead engine")
 
         eng.decode_n = always_fail
+        eng.decode_n_launch = always_fail
         import pytest
         from ollama_operator_tpu.runtime.scheduler import SchedulerBroken
         for _ in range(3):
@@ -173,6 +184,7 @@ def test_fail_running_releases_slots_and_errors_each_stream_once():
     try:
         calls = {"n": 0}
         real_decode_n = eng.decode_n
+        real_launch = eng.decode_n_launch
 
         def flaky(n=None):
             calls["n"] += 1
@@ -180,7 +192,14 @@ def test_fail_running_releases_slots_and_errors_each_stream_once():
                 raise RuntimeError("boom step")
             return real_decode_n(n)
 
+        def flaky_launch(n=None):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("boom step")
+            return real_launch(n)
+
         eng.decode_n = flaky
+        eng.decode_n_launch = flaky_launch
         reqs = [sched.submit(np.array([i + 1, i + 2], np.int32), GREEDY,
                              max_tokens=64) for i in range(2)]
         import queue as queue_mod
