@@ -1084,6 +1084,170 @@ def measure_mixed(jax, *, model: str, dtype: str, slots: int, steps: int,
     return rec
 
 
+def measure_prefix(jax, *, model: str, dtype: str, slots: int, steps: int,
+                   seq: int, prompt_len: int, paged: bool, mixed: bool,
+                   chunk: int, page_size: int, n_pages: int | None,
+                   platform: str, params_cache: dict | None = None,
+                   env: dict | None = None) -> dict:
+    """Shared-system-prompt arm for the radix prefix cache (ISSUE 4):
+    K concurrent requests sharing a long common prefix (the multi-tenant
+    "same system prompt, different question" shape), run twice through
+    the REAL scheduler — cache on (radix page stitch) vs cache off
+    (TPU_PREFIX_CACHE=0, i.e. the parked-slot-only baseline). Headlines:
+    arrival TTFT p95 and the computed-vs-reused prompt-token split from
+    the same tpu_model_prefix_{hit,miss}_tokens_total counters production
+    dashboards read."""
+    import gc
+    import threading
+
+    from ollama_operator_tpu.models.config import get_config
+    from ollama_operator_tpu.runtime.engine import (Engine, EngineConfig,
+                                                    SlotOptions,
+                                                    resolve_cache_dtype)
+    from ollama_operator_tpu.runtime.scheduler import Scheduler
+    from ollama_operator_tpu.server.metrics import GLOBAL as METRICS
+
+    on_cpu = platform == "cpu"
+    if on_cpu:
+        dtype = "float32"
+    kv_dtype = resolve_cache_dtype(
+        os.environ.get("BENCH_KV_DTYPE", "float32" if on_cpu else "int8"))
+    cfg = get_config(model)
+    log(f"bench: prefix-cache capture model={model} dtype={dtype} "
+        f"slots={slots} seq={seq}")
+    params, param_bytes, dtype = _bench_params(
+        jax, cfg, model, dtype, on_cpu, params_cache)
+    if dtype == "int4":
+        from ollama_operator_tpu.ops.quant import int4_mm_kernels
+        cfg = int4_mm_kernels(cfg, None)
+    serve_seq = min(seq, cfg.max_seq_len)
+    # page size small enough that the shared prefix spans several pages
+    # even at smoke scale (radix nodes are page-granular)
+    ps = max(8, min(page_size, serve_seq // 8))
+    # the ISSUE-4 shape: 512-token common prefix where the context allows,
+    # half the servable context otherwise
+    prefix_len = min(512, serve_seq // 2)
+    tail_len = max(8, min(32, serve_seq // 16))
+    gen_tokens = max(4, min(16, steps // 4))
+    k_conc = max(4, min(slots, 8))
+    chunk_eff = min(chunk, max(4, serve_seq // 16))
+    rng = np.random.default_rng(3)
+    prefix = rng.integers(1, cfg.vocab_size, size=prefix_len,
+                          endpoint=False).astype(np.int32)
+    tails = [rng.integers(1, cfg.vocab_size, size=tail_len,
+                          endpoint=False).astype(np.int32)
+             for _ in range(k_conc + 2)]
+    greedy = SlotOptions(temperature=0.0, repeat_penalty=1.0)
+    pool = (n_pages
+            or slots * (-(-serve_seq // ps) + 2) + prefix_len // ps)
+
+    def run_arm(cache_on: bool) -> dict:
+        saved = os.environ.get("TPU_PREFIX_CACHE")
+        if not cache_on:
+            os.environ["TPU_PREFIX_CACHE"] = "0"
+        try:
+            eng = Engine(cfg, params,
+                         ecfg=EngineConfig(max_slots=slots, max_seq_len=seq,
+                                           decode_chunk=chunk_eff,
+                                           cache_dtype=kv_dtype, paged=True,
+                                           page_size=ps, n_pages=pool,
+                                           min_prefill_bucket=16))
+        finally:
+            if saved is None:
+                os.environ.pop("TPU_PREFIX_CACHE", None)
+            else:
+                os.environ["TPU_PREFIX_CACHE"] = saved
+        eng.warm_buckets()
+        sched = Scheduler(eng)
+        try:
+            def run_one(tail, out):
+                r = sched.submit(list(prefix) + list(tail), greedy,
+                                 max_tokens=gen_tokens)
+                try:
+                    for _ in r.chunks():
+                        pass
+                    out["ttft"] = r.stats.ttft_s
+                    out["reused"] = getattr(r.stats, "n_reused", 0)
+                except Exception as e:
+                    out["error"] = f"{type(e).__name__}: {e}"
+
+            # warm request populates the cache (arm A) / parks (arm B);
+            # one more unmeasured follower compiles the stitched-extend
+            # path so neither arm pays compiles in its measured window
+            for t in tails[:2]:
+                run_one(t, {})
+            hit0 = METRICS.get("tpu_model_prefix_hit_tokens_total")
+            miss0 = METRICS.get("tpu_model_prefix_miss_tokens_total")
+            outs = [{} for _ in range(k_conc)]
+            threads = [threading.Thread(target=run_one, args=(t, o))
+                       for t, o in zip(tails[2:], outs)]
+            t0 = time.perf_counter()
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join(timeout=600)
+            t1 = time.perf_counter()
+            hits = METRICS.get("tpu_model_prefix_hit_tokens_total") - hit0
+            misses = (METRICS.get("tpu_model_prefix_miss_tokens_total")
+                      - miss0)
+            ttfts = [o["ttft"] for o in outs if "ttft" in o]
+            errors = [o["error"] for o in outs if "error" in o]
+            return {
+                "cache_on": cache_on,
+                "ttft_p50_ms": (round(float(np.percentile(ttfts, 50)) * 1e3,
+                                      1) if ttfts else None),
+                "ttft_p95_ms": (round(float(np.percentile(ttfts, 95)) * 1e3,
+                                      1) if ttfts else None),
+                "reused_tokens": int(hits),
+                "computed_tokens": int(misses),
+                "hit_rate": (round(hits / (hits + misses), 3)
+                             if hits + misses else None),
+                "wall_s": round(t1 - t0, 2),
+                "radix_nodes": int(getattr(eng, "radix_nodes", 0)),
+                "radix_pages": int(getattr(eng, "radix_pages", 0)),
+                "errors": errors or None,
+            }
+        finally:
+            sched.shutdown()
+            for s in range(eng.n_slots):
+                try:
+                    eng.release(s)
+                except Exception:
+                    pass
+            del eng
+            gc.collect()
+
+    on = run_arm(True)
+    off = run_arm(False)
+    rec = {
+        "model": model,
+        "mode": "prefix",
+        "cache_on": on,
+        "cache_off": off,
+        # >=2.0 on TPU at K>=4 is the ISSUE-4 acceptance bar; the
+        # CPU smoke asserts hit_rate only (TTFT is noise at tiny scale)
+        "prefix_ttft_ratio": (round(off["ttft_p95_ms"] / on["ttft_p95_ms"],
+                                    2)
+                              if on.get("ttft_p95_ms")
+                              and off.get("ttft_p95_ms") else None),
+        "prefix_hit_rate": on.get("hit_rate"),
+        "slots": slots,
+        "dtype": dtype,
+        "paged": True,
+        "page_size": int(ps),
+        "prefix_len": int(prefix_len),
+        "tail_len": int(tail_len),
+        "k_concurrent": int(k_conc),
+        "seq": seq,
+    }
+    if env:
+        rec["env"] = dict(env)
+    log(f"bench: prefix-cache capture done: {json.dumps(rec)}")
+    del params
+    gc.collect()
+    return rec
+
+
 def main() -> None:
     import jax
 
@@ -1163,6 +1327,8 @@ def main() -> None:
                      dtype=os.environ.get("BENCH_DTYPE", "int8"),
                      http=os.environ.get("BENCH_HTTP", "") == "1",
                      mixed_arm=os.environ.get("BENCH_MIXED_ARM", "") == "1",
+                     prefix_arm=os.environ.get("BENCH_PREFIX_ARM",
+                                               "") == "1",
                      **knobs)]
     elif platform == "cpu":
         # unpinned CPU smoke: tiny model, but every knob still applies
@@ -1179,6 +1345,10 @@ def main() -> None:
             # stall-free batching A/B (chunked prefill + async dispatch
             # vs one-shot sync) through the real scheduler
             plan.append({**smoke, "mixed_arm": True})
+        if os.environ.get("BENCH_PREFIX_ARM", "") == "1":
+            # radix prefix cache A/B (shared-system-prompt fan-out,
+            # cache on vs TPU_PREFIX_CACHE=0) through the real scheduler
+            plan.append({**smoke, "prefix_arm": True})
     else:
         # the full TPU suite, deadline-ordered so a cut run still records
         # the strongest evidence (VERDICT r4 #1/#2): the round-comparable
@@ -1246,6 +1416,14 @@ def main() -> None:
             dict(model="tinyllama", dtype="int8", slots=16, steps=128,
                  seq=2048, prompt_len=1024, paged=False, mixed=False,
                  mixed_arm=True),
+            # radix prefix-cache A/B through the real scheduler: K
+            # concurrent requests sharing a 512-token system prompt,
+            # cache on (page stitch) vs off (parked-slot baseline) —
+            # ISSUE-4 acceptance: >=70% prompt tokens from cache and
+            # TTFT p95 >= 2x better with the cache on
+            dict(model="tinyllama", dtype="int8", slots=16, steps=64,
+                 seq=2048, prompt_len=512, paged=True, mixed=False,
+                 prefix_arm=True),
         ]
 
     captures = []
@@ -1268,8 +1446,10 @@ def main() -> None:
         http = cap.pop("http", False)
         spec = cap.pop("spec", False)
         mixed_arm = cap.pop("mixed_arm", False)
+        prefix_arm = cap.pop("prefix_arm", False)
         try:
-            fn = (measure_mixed if mixed_arm
+            fn = (measure_prefix if prefix_arm
+                  else measure_mixed if mixed_arm
                   else measure_http if http
                   else measure_spec if spec else measure)
             # plan-level keys override the global knobs (a capture may pin
@@ -1337,6 +1517,14 @@ def assemble(captures: list, platform: str, n_devices: int) -> str:
             mixed_itl_p99_ratio = c.get("itl_p99_ratio")
             mixed_tok_s_ratio = c.get("bg_tok_s_ratio")
             break
+    # radix prefix-cache A/B (ISSUE 4 acceptance: hit rate >= 0.7,
+    # TTFT p95 ratio >= 2 on TPU): the shared-prefix capture's headlines
+    prefix_hit_rate = prefix_ttft_ratio = None
+    for c in captures:
+        if c.get("mode") == "prefix":
+            prefix_hit_rate = c.get("prefix_hit_rate")
+            prefix_ttft_ratio = c.get("prefix_ttft_ratio")
+            break
     return json.dumps({
         "metric": metric,
         "value": head["tok_s"],
@@ -1353,6 +1541,8 @@ def assemble(captures: list, platform: str, n_devices: int) -> str:
         "http_ttft_ratio": http_ttft_ratio,
         "mixed_itl_p99_ratio": mixed_itl_p99_ratio,
         "mixed_tok_s_ratio": mixed_tok_s_ratio,
+        "prefix_hit_rate": prefix_hit_rate,
+        "prefix_ttft_ratio": prefix_ttft_ratio,
         "slots": head["slots"],
         "platform": platform,
         "dtype": head["dtype"],

@@ -78,6 +78,17 @@ def _bound_xla_state():
 
 
 @pytest.fixture(autouse=True)
+def _page_accounting():
+    """Refcount leaks fail loudly: after EVERY test, each PageTable still
+    alive must satisfy its accounting invariant — every non-trash page
+    free exactly once XOR refcounted as mapped+pinned (ISSUE 4)."""
+    yield
+    from ollama_operator_tpu.runtime.paged import live_tables
+    for pt in live_tables():
+        pt.check()
+
+
+@pytest.fixture(autouse=True)
 def _disarm_faults():
     """No injected fault may leak across tests: the registry is process-
     global by design (the code under test reaches it via one module

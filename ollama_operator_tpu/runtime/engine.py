@@ -440,6 +440,7 @@ class Engine:
         def zeros(shape, dtype, sh):
             return self._g(np.zeros(shape, dtype), sh)
 
+        self._radix = None
         if self.paged:
             from .paged import PageTable, ShardedPageTable
             ps = ecfg.page_size
@@ -501,6 +502,16 @@ class Engine:
             # admission-order stamps for preemption victim choice
             self._admit_order = np.zeros((B,), np.int64)
             self._admit_seq = 0
+            # radix prefix cache: page-granular cross-request KV reuse
+            # (single sub-pool only — a dp-sharded pool's table entries
+            # are shard-LOCAL page ids, so a tree spanning shards would
+            # stitch pages the slot's shard cannot read).
+            # TPU_PREFIX_CACHE=0 falls back to the parked-slot path.
+            if (dp == 1
+                    and _os.environ.get("TPU_PREFIX_CACHE", "1").lower()
+                    not in ("0", "false")):
+                from .radix import RadixCache
+                self._radix = RadixCache(ps)
         elif self.quant_cache:
             from ..ops.quant_cache import empty_cache
 
@@ -1197,6 +1208,26 @@ class Engine:
             outs=((slot_sh, slot_sh2, slot_sh, slot_sh2, slot_sh)
                   if slot_sh else None))
 
+        if self.paged:
+            def _copy_page(k_cache, v_cache, src, dst):
+                """Copy-on-write: physical page ``src`` → ``dst`` across
+                all layers. The page axis is axis 1 in both the code
+                pools and the quant scale arrays, so one tree_map'd
+                slice covers the plain and {"q","s"} layouts."""
+                def cp(c):
+                    page = jax.lax.dynamic_slice_in_dim(c, src, 1, axis=1)
+                    return jax.lax.dynamic_update_slice_in_dim(
+                        c, page, dst, axis=1)
+                k_cache = jax.tree_util.tree_map(cp, k_cache)
+                v_cache = jax.tree_util.tree_map(cp, v_cache)
+                if slot_sh is not None:
+                    wsc = jax.lax.with_sharding_constraint
+                    k_cache = wsc(k_cache, cache_sh)
+                    v_cache = wsc(v_cache, cache_sh)
+                return k_cache, v_cache
+            self._copy_page_fn = _jit(_copy_page, (0, 1),
+                                      outs=(cache_sh, cache_sh))
+
         def _install_key(keys, slot, seed):
             k = jax.random.key(seed)
             return keys.at[slot].set(k), k
@@ -1368,12 +1399,12 @@ class Engine:
         # free_for(slot): on a dp mesh each slot allocates only from its
         # own shard's sub-pool
         ahead = min(n + self.ecfg.decode_chunk, self.max_seq)
-        if self._pt.blocks_for(ahead) > self._pt.free_for(slot):
+        if (self._pt.blocks_for(ahead) > self._pt.free_for(slot)
+                or not self._pt.grow(slot, n)):
             raise PagesExhausted(
                 f"prompt of {n} tokens (+1 chunk headroom) needs "
                 f"{self._pt.blocks_for(ahead)} pages; "
                 f"{self._pt.free_for(slot)} free")
-        self._pt.grow(slot, n)
         return self._table_row_dev(slot)
 
     def _table_row_dev(self, slot: int):
@@ -1903,6 +1934,119 @@ class Engine:
     @property
     def free_pages(self) -> int:
         return self._pt.n_free if self.paged else -1
+
+    # ------------------------------------------------------------------
+    # radix prefix cache (paged, single sub-pool)
+    # ------------------------------------------------------------------
+    @property
+    def radix_enabled(self) -> bool:
+        return self._radix is not None
+
+    @property
+    def radix_nodes(self) -> int:
+        """Resident radix-tree nodes (0 when the cache is off)."""
+        return self._radix.n_nodes if self._radix is not None else 0
+
+    @property
+    def radix_pages(self) -> int:
+        """Physical pages pinned by the radix tree (== nodes: one each)."""
+        return self._radix.n_nodes if self._radix is not None else 0
+
+    def prefix_probe(self, full_ids) -> int:
+        """Non-mutating: how many leading tokens of ``full_ids`` the radix
+        cache could serve (full pages + one partial boundary page), capped
+        at len-1 so at least one tail token remains to prefill. The
+        scheduler uses this to apply its reuse floor and bucket-fit checks
+        BEFORE committing to a stitch. 0 when the cache is off or cold."""
+        if self._radix is None:
+            return 0
+        ids = np.asarray(full_ids)
+        full, _part, q = self._radix.match(ids, int(ids.shape[0]) - 1,
+                                           bump=False)
+        return len(full) * self.ecfg.page_size + q
+
+    def stitch(self, slot: int, full_ids, max_reuse: int) -> int:
+        """Map the radix cache's longest prefix of ``full_ids`` (at most
+        ``max_reuse`` tokens) into ``slot``'s block table ahead of an
+        extend(): whole-page hits are shared READ-ONLY (refcount bump, no
+        copy, no compute); a partially-matched boundary page is copied
+        into a private page first (copy-on-write) because the tail
+        prefill will write the remaining positions of that very page.
+        Any pages the slot still held (stale parked prefix) are dropped
+        first. Returns the reuse length actually stitched (0 = cold).
+        Raises PagesExhausted when the COW page cannot be allocated — the
+        slot is left with NO pages so the caller can fall back cleanly.
+        Deterministic from call order, so follower replay stays in step.
+        """
+        assert self._radix is not None, "radix cache disabled"
+        assert not self.active[slot], f"slot {slot} busy"
+        from .paged import PagesExhausted
+        self._pt.release(slot)
+        ids = np.asarray(full_ids, np.int32)
+        cap = min(int(max_reuse), int(ids.shape[0]) - 1)
+        if cap <= 0:
+            return 0
+        full, part, q = self._radix.match(ids, cap, bump=True)
+        if not full and q == 0:
+            return 0
+        ps = self.ecfg.page_size
+        self._pt.map_shared(slot, [n.page for n in full])
+        reuse = len(full) * ps
+        if part is not None and q > 0:
+            if not self._pt.grow(slot, reuse + q):
+                self._pt.release(slot)
+                raise PagesExhausted(
+                    f"no page for the copy-on-write boundary "
+                    f"({self._pt.n_free} free)")
+            dst = self._pt.slot_pages(slot)[-1]
+            self.k_cache, self.v_cache = self._copy_page_fn(
+                self.k_cache, self.v_cache,
+                self._gr(np.int32(part.page)), self._gr(np.int32(dst)))
+            reuse += q
+        return reuse
+
+    def donate_prefix(self, slot: int, token_ids) -> int:
+        """Insert ``slot``'s full-page-aligned KV prefix for ``token_ids``
+        into the radix tree, then release the slot. Chunks the tree did
+        not yet hold adopt the slot's physical pages (pinned — they
+        survive the release); chunks already cached keep the tree's
+        existing page and the slot's duplicate goes back to the pool.
+        Replaces slot-parking in radix mode: any number of later requests
+        can stitch the prefix concurrently. Returns tokens donated."""
+        if self._radix is None:
+            self.release(slot)
+            return 0
+        ids = np.asarray(token_ids, np.int32)
+        ps = self.ecfg.page_size
+        k = min(int(ids.shape[0]) // ps, self._pt.owned_blocks(slot))
+        if k > 0:
+            adopted = self._radix.insert(ids[:k * ps],
+                                         self._pt.slot_pages(slot)[:k])
+            for node in adopted:
+                self._pt.pin(node.page)
+        self.release(slot)
+        return k * ps
+
+    def radix_evict(self, n_pages: int = 1) -> int:
+        """Evict up to ``n_pages`` least-recently-used radix leaves whose
+        pages no slot currently maps, page-by-page (children before
+        parents), returning their pages to the pool. Replaces the
+        all-or-nothing parked-slot eviction. Returns pages freed."""
+        if self._radix is None:
+            return 0
+        pages = self._radix.evict(
+            n_pages, lambda pg: self._pt.shared_refs(pg) == 0)
+        for pg in pages:
+            self._pt.unpin(pg)
+        return len(pages)
+
+    def radix_reset(self):
+        """Drop the whole radix tree (supervised restart: cache contents
+        are unknown after a failed step, so nothing may be reused)."""
+        if self._radix is None:
+            return
+        for pg in self._radix.reset():
+            self._pt.unpin(pg)
 
     def decode_n(self, n: Optional[int] = None) -> np.ndarray:
         """n decode steps in one device program; returns tokens [n, B].

@@ -11,6 +11,10 @@ Fault points wired through the codebase:
 
     engine.step     -- top of ``Engine.decode_n`` (the decode hot loop)
     engine.admit    -- top of ``Engine.admit`` (prefill/admission)
+    pages.alloc     -- ``PageTable.grow`` page allocation; an armed fail
+                       makes grow return False (simulated pool
+                       exhaustion), so callers exercise their REAL
+                       dry-pool paths (preempt/evict/cold-fallback)
     detok.feed      -- service detokeniser feed, per chunk
     follower.send   -- ``ControlPlane._send`` to each follower conn
     kube.request    -- ``KubeClient._request`` before the HTTP call

@@ -185,7 +185,12 @@ class MirroredEngine:
     MIRRORED = ("admit", "admit_many", "extend", "decode", "decode_n",
                 "decode_n_launch", "decode_spec", "release", "set_mask",
                 "clear_mask", "warm_buckets", "free_slot_pages",
-                "prepare_decode")
+                "prepare_decode",
+                # radix prefix cache: stitching/donation/eviction mutate
+                # page refcounts and (for COW) dispatch a page copy, so
+                # every host must replay them in order; prefix_probe is
+                # read-only and deliberately NOT mirrored
+                "stitch", "donate_prefix", "radix_evict", "radix_reset")
 
     def __init__(self, inner, cp: ControlPlane):
         object.__setattr__(self, "_inner", inner)

@@ -10,21 +10,45 @@ Page 0 is the **trash page**: bucket-padding positions beyond a prompt's
 valid length scatter their garbage K/V there, so admissions only allocate
 pages for real tokens and no masking depends on page contents.
 
+Pages are **reference counted** so the radix prefix cache
+(``runtime/radix.py``) can map one physical page into many slots at once:
+a page's refcount is the number of slot block-table entries mapping it
+plus the number of radix-tree pins holding it. ``grow`` allocates private
+pages (rc=1); ``map_shared`` stitches an already-resident page into
+another slot read-only (rc+=1); ``pin``/``unpin`` are the tree's share.
+A page returns to the free list exactly when its refcount hits zero —
+``check()`` asserts that accounting invariant and the test suite runs it
+after every test (autouse fixture in conftest.py).
+
 Design notes vs the reference: llama.cpp's unified KV cell pool inside the
 delegated `ollama/ollama` image plays this role
 (/root/reference/pkg/model/pod.go:11); here the allocator is explicit so
 the engine can admit many more concurrent slots than dense max_slots ×
-max_seq_len HBM would allow, and preempt (victim-select) when the pool
-runs dry (SURVEY.md §7 hard-part 2).
+max_seq_len HBM would allow, preempt (victim-select) when the pool runs
+dry (SURVEY.md §7 hard-part 2), and share prefix pages across requests
+the way vLLM/SGLang block pools do.
 """
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+import weakref
+from collections import Counter
+from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from .faults import FAULTS, InjectedFault
+
 TRASH_PAGE = 0
+
+# every live PageTable, so the test suite can sweep the accounting
+# invariant after each test without plumbing engine internals around
+_LIVE: "weakref.WeakSet[PageTable]" = weakref.WeakSet()
+
+
+def live_tables() -> List["PageTable"]:
+    """Snapshot of every PageTable still referenced anywhere (test hook)."""
+    return list(_LIVE)
 
 
 class PagesExhausted(RuntimeError):
@@ -45,6 +69,11 @@ class PageTable:
         self._free: List[int] = list(range(n_pages - 1, TRASH_PAGE, -1))
         self._owned: Dict[int, List[int]] = {s: [] for s in range(n_slots)}
         self.tables = np.full((n_slots, max_blocks), TRASH_PAGE, np.int32)
+        # per-page refcount = slot mappings + radix pins; _pins is the
+        # radix tree's share of it (rc - pins = live slot mappings)
+        self._rc = np.zeros((n_pages,), np.int32)
+        self._pins = np.zeros((n_pages,), np.int32)
+        _LIVE.add(self)
 
     @property
     def n_free(self) -> int:
@@ -61,6 +90,12 @@ class PageTable:
         need = self.blocks_for(n_tokens) - len(owned)
         if need <= 0:
             return True
+        try:
+            # chaos hook: an injected fault here behaves exactly like a
+            # dry pool, so callers exercise their real exhaustion paths
+            FAULTS.check("pages.alloc")
+        except InjectedFault:
+            return False
         if need > len(self._free):
             return False
         if len(owned) + need > self.max_blocks:
@@ -69,16 +104,66 @@ class PageTable:
                 f"{self.max_blocks} blocks of {self.page_size}")
         for _ in range(need):
             pg = self._free.pop()
+            assert self._rc[pg] == 0, f"free page {pg} had rc {self._rc[pg]}"
+            self._rc[pg] = 1
             self.tables[slot, len(owned)] = pg
             owned.append(pg)
         return True
 
-    def release(self, slot: int):
-        """Free all of ``slot``'s pages (table row resets to trash)."""
+    def map_shared(self, slot: int, pages: Sequence[int]):
+        """Stitch already-resident ``pages`` (radix prefix hits) into
+        ``slot``'s block table read-only, after its current blocks, in
+        order. Each page's refcount is bumped — the slot is now one of
+        its co-owners and MUST NOT write into it (copy-on-write first)."""
         owned = self._owned[slot]
-        self._free.extend(owned)
+        if len(owned) + len(pages) > self.max_blocks:
+            raise ValueError(
+                f"slot {slot}: {len(owned)}+{len(pages)} shared blocks "
+                f"exceed {self.max_blocks}")
+        for pg in pages:
+            assert pg != TRASH_PAGE and self._rc[pg] >= 1, \
+                f"page {pg} is not live (rc={int(self._rc[pg])})"
+            self._rc[pg] += 1
+            self.tables[slot, len(owned)] = pg
+            owned.append(pg)
+
+    def release(self, slot: int):
+        """Drop all of ``slot``'s page mappings (table row resets to
+        trash); pages whose refcount reaches zero return to the pool."""
+        owned = self._owned[slot]
+        for pg in owned:
+            self._rc[pg] -= 1
+            assert self._rc[pg] >= 0, f"double free of page {pg}"
+            if self._rc[pg] == 0:
+                self._free.append(pg)
         owned.clear()
         self.tables[slot, :] = TRASH_PAGE
+
+    def pin(self, pg: int):
+        """Take a radix-tree reference on a live page: it survives the
+        owning slot's release until ``unpin``."""
+        assert pg != TRASH_PAGE and self._rc[pg] >= 1, \
+            f"cannot pin dead page {pg}"
+        self._rc[pg] += 1
+        self._pins[pg] += 1
+
+    def unpin(self, pg: int):
+        """Drop a radix-tree reference; frees the page at rc zero."""
+        assert self._pins[pg] >= 1, f"page {pg} is not pinned"
+        self._pins[pg] -= 1
+        self._rc[pg] -= 1
+        if self._rc[pg] == 0:
+            self._free.append(pg)
+
+    def shared_refs(self, pg: int) -> int:
+        """Slot mappings of ``pg`` beyond the tree's pins — a pinned page
+        with shared_refs == 0 is referenced only by the radix tree and is
+        safe to evict (unpin frees it immediately)."""
+        return int(self._rc[pg]) - int(self._pins[pg])
+
+    def slot_pages(self, slot: int) -> List[int]:
+        """The physical pages backing ``slot``, in block order (copy)."""
+        return list(self._owned[slot])
 
     def owned_blocks(self, slot: int) -> int:
         return len(self._owned[slot])
@@ -92,6 +177,37 @@ class PageTable:
     def data_pages(self) -> int:
         """Max pages one slot could ever hold (pool minus the trash page)."""
         return self.n_pages - 1
+
+    def check(self):
+        """Accounting invariant: every non-trash page is EITHER on the
+        free list exactly once with no references, OR referenced with
+        rc == slot mappings + pins ≥ 1 — nothing leaked, nothing double
+        freed, block-table rows consistent with the ownership lists.
+        Debug/test hook (an autouse fixture runs it after every test)."""
+        free = Counter(self._free)
+        mapped: Counter = Counter()
+        for owned in self._owned.values():
+            mapped.update(owned)
+        assert free[TRASH_PAGE] == 0, "trash page on the free list"
+        assert mapped[TRASH_PAGE] == 0, "trash page mapped to a slot"
+        for pg in range(TRASH_PAGE + 1, self.n_pages):
+            f, m, p = free[pg], mapped[pg], int(self._pins[pg])
+            rc = int(self._rc[pg])
+            assert f <= 1, f"page {pg} on the free list {f} times"
+            if f:
+                assert rc == 0 and m == 0 and p == 0, (
+                    f"page {pg} free but referenced "
+                    f"(rc={rc}, mapped={m}, pins={p})")
+            else:
+                assert rc == m + p and rc >= 1, (
+                    f"page {pg} leaked or miscounted "
+                    f"(rc={rc}, mapped={m}, pins={p})")
+        for slot, owned in self._owned.items():
+            row = self.tables[slot]
+            assert list(row[:len(owned)]) == owned, (
+                f"slot {slot}: table row disagrees with ownership")
+            assert (row[len(owned):] == TRASH_PAGE).all(), (
+                f"slot {slot}: stale table entries past owned blocks")
 
 
 class ShardedPageTable:
@@ -154,3 +270,7 @@ class ShardedPageTable:
 
     def shard_of(self, slot: int) -> int:
         return slot // self._slots_per
+
+    def check(self):
+        for pt in self._pts:
+            pt.check()

@@ -1,0 +1,158 @@
+"""Radix-tree prefix cache over physical KV pages.
+
+SGLang's RadixAttention adapted to the paged pool (``runtime/paged.py``):
+one tree node = one ``page_size``-aligned token chunk backed by exactly
+ONE physical page, so matching, insertion and eviction are all
+page-granular. The tree stores only page *ids* plus an LRU stamp — the
+KV bytes live in the device pool and refcounts live in the PageTable
+(each resident node holds one ``pin`` on its page).
+
+Ownership protocol (driven by Engine.stitch/donate_prefix/radix_evict):
+
+- ``match`` is read-only: the longest cached chunk path for a token
+  sequence, plus at most one *partial* boundary node whose first ``q``
+  tokens match (the engine copies that page before the new slot writes
+  its tail into it — copy-on-write).
+- ``insert`` walks/creates nodes for a finished request's full-page
+  chunks and returns the nodes it newly created; the engine pins those
+  nodes' pages (chunks already present keep the tree's original page and
+  the donor's duplicate page is simply freed by its release).
+- ``evict`` pops least-recently-used LEAF nodes one page at a time —
+  children always leave before parents, so every resident path stays
+  contiguous from the root — skipping pages some slot still maps.
+
+A logical clock (bumped per match/insert) orders recency; no wall time,
+so multi-host replays stay deterministic.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+
+class _Node:
+    __slots__ = ("chunk", "page", "parent", "children", "stamp")
+
+    def __init__(self, chunk: Tuple[int, ...], page: int,
+                 parent: Optional["_Node"], stamp: int):
+        self.chunk = chunk
+        self.page = page
+        self.parent = parent
+        self.children: Dict[Tuple[int, ...], "_Node"] = {}
+        self.stamp = stamp
+
+
+class RadixCache:
+    """Trie keyed on page_size token chunks; nodes hold physical pages."""
+
+    def __init__(self, page_size: int):
+        assert page_size >= 1
+        self.page_size = page_size
+        self._root = _Node((), -1, None, 0)
+        self._clock = 0
+        self._n = 0
+
+    @property
+    def n_nodes(self) -> int:
+        """Resident nodes == resident pages (one page per node)."""
+        return self._n
+
+    def _tick(self) -> int:
+        self._clock += 1
+        return self._clock
+
+    def match(self, ids: Sequence[int], limit: int, bump: bool = True
+              ) -> Tuple[List[_Node], Optional[_Node], int]:
+        """Longest cached prefix of ``ids`` within ``limit`` tokens:
+        ``(full_nodes, partial_node, partial_len)`` — full-chunk path
+        nodes in order, then optionally ONE boundary node whose first
+        ``partial_len`` (1 ≤ q < page_size) tokens extend the match.
+        ``bump=False`` probes without touching LRU recency."""
+        ps = self.page_size
+        limit = min(limit, len(ids))
+        node = self._root
+        full: List[_Node] = []
+        pos = 0
+        while pos + ps <= limit:
+            child = node.children.get(tuple(int(t) for t in ids[pos:pos + ps]))
+            if child is None:
+                break
+            full.append(child)
+            node = child
+            pos += ps
+        part, part_q = None, 0
+        room = min(ps, limit - pos)
+        if room > 0:
+            head = [int(t) for t in ids[pos:pos + room]]
+            for chunk, child in node.children.items():
+                q = 0
+                while q < room and chunk[q] == head[q]:
+                    q += 1
+                if q > part_q:
+                    part, part_q = child, q
+        if bump and (full or part is not None):
+            stamp = self._tick()
+            for n in full:
+                n.stamp = stamp
+            if part is not None:
+                part.stamp = stamp
+        return full, part, part_q
+
+    def insert(self, ids: Sequence[int], pages: Sequence[int]) -> List[_Node]:
+        """Walk/create the chunk path for ``ids`` (page-aligned,
+        ``len(pages)`` chunks); chunk ``i`` is backed by ``pages[i]`` when
+        newly created. Returns the NEW nodes — the caller must pin their
+        pages; chunks already resident keep the tree's existing page."""
+        ps = self.page_size
+        assert len(ids) >= len(pages) * ps
+        node = self._root
+        stamp = self._tick()
+        adopted: List[_Node] = []
+        for i, pg in enumerate(pages):
+            chunk = tuple(int(t) for t in ids[i * ps:(i + 1) * ps])
+            child = node.children.get(chunk)
+            if child is None:
+                child = _Node(chunk, int(pg), node, stamp)
+                node.children[chunk] = child
+                self._n += 1
+                adopted.append(child)
+            child.stamp = stamp
+            node = child
+        return adopted
+
+    def evict(self, n_pages: int, evictable: Callable[[int], bool]
+              ) -> List[int]:
+        """Pop up to ``n_pages`` least-recently-used leaves whose page
+        satisfies ``evictable`` (e.g. no slot maps it). Page-by-page:
+        each removal may expose its parent as the next leaf. Returns the
+        evicted page ids (caller unpins them)."""
+        freed: List[int] = []
+        while len(freed) < n_pages:
+            lru: Optional[_Node] = None
+            stack = [self._root]
+            while stack:
+                node = stack.pop()
+                for child in node.children.values():
+                    if child.children:
+                        stack.append(child)
+                    elif evictable(child.page) and (
+                            lru is None or child.stamp < lru.stamp):
+                        lru = child
+            if lru is None:
+                break
+            del lru.parent.children[lru.chunk]
+            self._n -= 1
+            freed.append(lru.page)
+        return freed
+
+    def reset(self) -> List[int]:
+        """Drop every node; returns all resident pages (caller unpins)."""
+        pages: List[int] = []
+        stack = list(self._root.children.values())
+        while stack:
+            node = stack.pop()
+            pages.append(node.page)
+            stack.extend(node.children.values())
+        self._root.children.clear()
+        self._n = 0
+        return pages

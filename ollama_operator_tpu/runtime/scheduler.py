@@ -169,6 +169,18 @@ class Scheduler:
                  prefill_chunk: Optional[int] = None,
                  async_dispatch: Optional[bool] = None):
         self.engine = engine
+        # reuse floor (TPU_MIN_PREFIX_REUSE): prefixes shorter than this
+        # admit cold — a tiny reuse still pays a full extend dispatch, so
+        # raising the floor trades cache hits for fewer small programs;
+        # lowering it helps only when dispatch is near-free (colocated
+        # host). Parked-slot reuse and radix stitches honor the same
+        # floor.
+        self.min_prefix_reuse = int(os.environ.get(
+            "TPU_MIN_PREFIX_REUSE", "") or self.MIN_PREFIX_REUSE)
+        # radix prefix cache (paged, single sub-pool): finished prefixes
+        # are donated to a shared page-granular tree instead of parked in
+        # one slot, so N concurrent requests can hit the same prefix
+        self._use_radix = bool(getattr(engine, "radix_enabled", False))
         # crash-only supervision: after a decode-loop failure the engine
         # state is rebuilt in-process up to max_restarts consecutive
         # times before the scheduler goes terminally `broken` (which
@@ -339,11 +351,20 @@ class Scheduler:
         parkable = (list(req.prompt_ids) + req.all_tokens)[:-1]
         park = (self.engine.supports_extend and req.embeds is None
                 and reason in ("stop", "length") and len(parkable) > 0)
-        self.engine.release(slot, park=park)
-        if park:
-            self._parked[slot] = parkable
+        if self._use_radix:
+            # radix mode: donate the full-page-aligned prefix to the
+            # shared tree (pages pinned, slot freed) instead of parking
+            # the whole thing in this one slot
+            if park:
+                self.engine.donate_prefix(slot, parkable)
+            else:
+                self.engine.release(slot)
         else:
-            self._parked.pop(slot, None)
+            self.engine.release(slot, park=park)
+            if park:
+                self._parked[slot] = parkable
+            else:
+                self._parked.pop(slot, None)
         self._running[slot] = None
         req.stats.t_done = time.monotonic()
         with self._lock:
@@ -374,7 +395,8 @@ class Scheduler:
         prefix with the request, or (None, 0). At least one tail token must
         remain to prefill (the parked last position has no cached logits),
         and the tail's bucket must fit above the reused prefix."""
-        if req.embeds is not None or not self.engine.supports_extend:
+        if (self._use_radix or req.embeds is not None
+                or not self.engine.supports_extend):
             return None, 0
         ids = req.admit_ids
         best, best_m = None, 0
@@ -385,7 +407,7 @@ class Scheduler:
                 m += 1
             if m > best_m:
                 best, best_m = slot, m
-        if best is None or best_m < self.MIN_PREFIX_REUSE:
+        if best is None or best_m < self.min_prefix_reuse:
             return None, 0
         tail_bucket = self.engine.bucket_for(len(ids) - best_m)
         if best_m + tail_bucket > self.engine.max_seq:
@@ -400,15 +422,49 @@ class Scheduler:
         except queue.Empty:
             return None
 
-    def _evict_one_parked(self) -> bool:
-        """Drop one parked prefix cache to return its pages to the pool
-        (paged mode; oldest parked first). False when nothing is parked."""
+    def _evict_one_parked(self, n_pages: int = 1) -> bool:
+        """Return cached pages to the pool under pressure. Radix mode:
+        evict up to ``n_pages`` LRU-unreferenced radix leaves (page
+        granular — cold tails of cold prefixes go first). Parked-slot
+        mode: drop one whole parked prefix (oldest parked first). False
+        when there was nothing to evict."""
+        if self._use_radix:
+            return self.engine.radix_evict(n_pages) > 0
         for slot in list(self._parked):
             if self._running[slot] is None:
                 self._parked.pop(slot)
                 self.engine.free_slot_pages(slot)
                 return True
         return False
+
+    def _stitch_admission(self, slot: int, req: Request) -> int:
+        """Radix-mode admission prep: probe the tree, apply the reuse
+        floor and the tail-bucket fit (trimming page-by-page keeps the
+        stitch page-aligned — the partial boundary drops first), then
+        stitch the shared pages into ``slot``. A dry pool during the
+        copy-on-write falls back to a cold admit (stitch leaves the slot
+        clean) after nudging eviction along."""
+        ids = req.admit_ids
+        want = self.engine.prefix_probe(ids)
+        ps = self.engine.ecfg.page_size
+        while (want >= self.min_prefix_reuse
+               and want + self.engine.bucket_for(len(ids) - want)
+               > self.engine.max_seq):
+            want = (want - 1) // ps * ps
+        if want < self.min_prefix_reuse:
+            return 0
+        try:
+            return self.engine.stitch(slot, ids, want)
+        except PagesExhausted:
+            self._evict_one_parked()
+            return 0
+
+    def _pages_for(self, n_tokens: int) -> int:
+        """Eviction sizing hint: pages a prompt of ``n_tokens`` needs
+        (+1 headroom). Radix eviction is page-granular, so freeing one
+        page per failed admission would thrash retry passes."""
+        ps = getattr(self.engine.ecfg, "page_size", 1) or 1
+        return -(-n_tokens // ps) + 1
 
     def _shed(self, req: Request):
         """Reject a request whose deadline expired while it waited for a
@@ -479,6 +535,14 @@ class Scheduler:
             # must not re-count its prompt in throughput stats
             self.total_prompt += req.stats.n_prompt
         req.stats.t_admitted = time.monotonic()
+        # prefix-cache accounting per ADMISSION (re-admissions re-count:
+        # a preempted request's second prefill is real compute): hit =
+        # tokens served from cache (radix stitch or parked-slot extend),
+        # miss = tokens actually prefilled
+        n_re = min(req.stats.n_reused, len(req.admit_ids))
+        METRICS.inc("tpu_model_prefix_hit_tokens_total", float(n_re))
+        METRICS.inc("tpu_model_prefix_miss_tokens_total",
+                    float(len(req.admit_ids) - n_re))
         self._running[slot] = req
         # grammar check before emitting (see _fanout)
         if (req.constraint is not None
@@ -502,25 +566,36 @@ class Scheduler:
         try:
             mask_row = (req.constraint.mask_row()
                         if req.constraint is not None else None)
-            if reuse_len:
-                first = self.engine.extend(slot, req.admit_ids,
-                                           reuse_len, req.opts,
-                                           mask_row=mask_row)
-                req.stats.n_reused = reuse_len
-            else:
-                first = self.engine.admit(slot, req.admit_ids,
-                                          req.opts, embeds=req.embeds,
+            try:
+                if reuse_len:
+                    first = self.engine.extend(slot, req.admit_ids,
+                                               reuse_len, req.opts,
+                                               mask_row=mask_row)
+                else:
+                    first = self.engine.admit(slot, req.admit_ids,
+                                              req.opts, embeds=req.embeds,
+                                              mask_row=mask_row)
+            except PagesExhausted:
+                if not (reuse_len and self._use_radix):
+                    raise
+                # the stitched tail ran dry (extend already rolled the
+                # shared mappings back): fall back to a COLD admit once —
+                # a genuinely dry pool raises again and requeues below
+                reuse_len = 0
+                first = self.engine.admit(slot, req.admit_ids, req.opts,
+                                          embeds=req.embeds,
                                           mask_row=mask_row)
+            req.stats.n_reused = reuse_len
         except PagesExhausted as e:
-            # paged pool dry: evict a parked prefix and retry this
-            # request next pass; with nothing to evict it waits for a
-            # finisher (unless it can never fit at all)
+            # paged pool dry: evict cached pages and retry this request
+            # next pass; with nothing to evict it waits for a finisher
+            # (unless it can never fit at all)
             if not self.engine.admissible(len(req.admit_ids)):
                 self._request_error(
                     req, f"prompt needs more KV pages than the pool "
                          f"has: {e}")
                 return True
-            self._evict_one_parked()
+            self._evict_one_parked(self._pages_for(len(req.admit_ids)))
             self._preempted.insert(0, req)
             return False
         except Exception as e:  # surfacing engine errors to the caller
@@ -542,11 +617,20 @@ class Scheduler:
         end = reuse_len + self.prefill_chunk
         t0 = time.perf_counter()
         try:
-            if reuse_len:
-                self.engine.extend(slot, ids[:end], reuse_len)
-                req.stats.n_reused = reuse_len
-            else:
+            try:
+                if reuse_len:
+                    self.engine.extend(slot, ids[:end], reuse_len)
+                else:
+                    self.engine.admit(slot, ids[:end])
+            except PagesExhausted:
+                if not (reuse_len and self._use_radix):
+                    raise
+                # stitched first piece ran dry mid-COW/tail: cold-start
+                # the chunked prefill once (stitch/extend rolled the
+                # shared mappings back)
+                reuse_len, end = 0, self.prefill_chunk
                 self.engine.admit(slot, ids[:end])
+            req.stats.n_reused = reuse_len
             # park between pieces: cache and lengths stay, the slot goes
             # engine-inactive so decode dispatches skip it
             self.engine.release(slot, park=True)
@@ -556,7 +640,7 @@ class Scheduler:
                     req, f"prompt needs more KV pages than the pool "
                          f"has: {e}")
                 return True
-            self._evict_one_parked()
+            self._evict_one_parked(self._pages_for(len(ids)))
             self._preempted.insert(0, req)
             return False
         except Exception as e:
@@ -620,7 +704,7 @@ class Scheduler:
             self._running[slot] = None
             req.slot = None
             self.engine.release(slot)
-            self._evict_one_parked()
+            self._evict_one_parked(self._pages_for(len(ids)))
             self._preempted.insert(0, req)
             return
         # any other engine failure propagates to the supervisor, which
@@ -659,6 +743,10 @@ class Scheduler:
                 METRICS.inc("tpu_model_admission_stall_ms_total",
                             (time.perf_counter() - t0) * 1e3)
                 for (s, r), tok in zip(group, toks):
+                    # batched admissions are always cold (a resumed
+                    # request must not re-report its first admission's
+                    # reuse as a fresh cache hit)
+                    r.stats.n_reused = 0
                     self._post_admit(s, r, tok)
             for s, r in items:
                 self._admit_one(s, r, 0)
@@ -717,6 +805,11 @@ class Scheduler:
                 # free.remove in this same pass)
                 self._parked.pop(slot, None)
                 ids = req.admit_ids
+                if self._use_radix and req.embeds is None:
+                    # radix mode: stitch the tree's longest usable prefix
+                    # into the slot; the tail admits via extend below
+                    # (reuse 0 = cold admit, slot left clean)
+                    reuse_len = self._stitch_admission(slot, req)
                 piece = self.prefill_chunk
                 if (piece and len(ids) - reuse_len > piece
                         and req.embeds is None
@@ -725,7 +818,7 @@ class Scheduler:
                     if not self._start_chunked(slot, req, reuse_len):
                         return
                     continue
-                if (reuse_slot is None and req.embeds is None
+                if (not reuse_len and req.embeds is None
                         and req.constraint is None
                         and self.engine.supports_admit_many):
                     # same-bucket fresh admissions coalesce into one
@@ -776,6 +869,16 @@ class Scheduler:
             except Exception:  # noqa: BLE001 — best-effort teardown
                 pass
         self._parked.clear()
+        # the radix tree's pages were released with the slots above only
+        # if nothing pinned them — drop every tree reference too, or the
+        # rebuilt engine would stitch prefixes whose cache contents are
+        # unknown (and the pins would leak pool pages forever)
+        radix_reset = getattr(self.engine, "radix_reset", None)
+        if radix_reset is not None:
+            try:
+                radix_reset()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
         self.n_restarts += 1
         METRICS.inc("tpu_model_engine_restarts_total")
         # capped exponential backoff before retrying; interruptible so

@@ -255,6 +255,16 @@ class LoadedModel:
             METRICS.gauge_fn("tpu_model_kv_free_pages",
                              lambda: (lm := wself()) is not None
                              and lm.engine.free_pages or 0)
+        if getattr(self.engine, "radix_enabled", False):
+            # radix prefix-cache residency: nodes == chunks, pages ==
+            # pool pages the tree pins (hit/miss counters live in the
+            # scheduler path and survive unload)
+            METRICS.gauge_fn("tpu_model_radix_nodes",
+                             lambda: (lm := wself()) is not None
+                             and lm.engine.radix_nodes or 0)
+            METRICS.gauge_fn("tpu_model_radix_pages",
+                             lambda: (lm := wself()) is not None
+                             and lm.engine.radix_pages or 0)
         # per-program dispatch latency (launch → tokens on host), one
         # labelled gauge per program kind: decode-chunk, one-shot admit,
         # extend (prefix reuse / chunked-prefill pieces), spec verify —
@@ -656,6 +666,9 @@ class LoadedModel:
         METRICS.remove_gauge("tpu_model_queue_depth")
         if self.engine.paged:
             METRICS.remove_gauge("tpu_model_kv_free_pages")
+        if getattr(self.engine, "radix_enabled", False):
+            METRICS.remove_gauge("tpu_model_radix_nodes")
+            METRICS.remove_gauge("tpu_model_radix_pages")
         for _kind in ("decode", "admit", "extend", "spec"):
             METRICS.remove_gauge("tpu_model_dispatch_ms",
                                  labels=f'{{program="{_kind}"}}')

@@ -606,6 +606,24 @@ class ModelManager:
                     "admission_stall_ms": METRICS.get(
                         "tpu_model_admission_stall_ms_total"),
                 },
+                # radix prefix cache: process-lifetime hit/miss token
+                # counters + live tree residency (same series /metrics
+                # exports; nodes/pages are 0 when the cache is off)
+                "prefix_cache": {
+                    "enabled": bool(getattr(lm, "engine", None) is not None
+                                    and getattr(lm.engine, "radix_enabled",
+                                                False)),
+                    "hit_tokens": int(METRICS.get(
+                        "tpu_model_prefix_hit_tokens_total")),
+                    "miss_tokens": int(METRICS.get(
+                        "tpu_model_prefix_miss_tokens_total")),
+                    "radix_nodes": (int(lm.engine.radix_nodes)
+                                    if getattr(lm, "engine", None)
+                                    is not None else 0),
+                    "radix_pages": (int(lm.engine.radix_pages)
+                                    if getattr(lm, "engine", None)
+                                    is not None else 0),
+                },
             })
         return out
 

@@ -159,6 +159,18 @@ GLOBAL.describe("tpu_model_prefill_chunks_total",
                 "Chunked-prefill pieces dispatched (stall-free admission "
                 "of long prompts, one bucket-sized piece per scheduler "
                 "step)")
+GLOBAL.describe("tpu_model_prefix_hit_tokens_total",
+                "Prompt tokens served from the prefix cache at admission "
+                "(radix page stitch or parked-slot extend) instead of "
+                "being prefilled")
+GLOBAL.describe("tpu_model_prefix_miss_tokens_total",
+                "Prompt tokens actually prefilled at admission; "
+                "hit / (hit + miss) is the prefix-cache hit rate")
+GLOBAL.describe("tpu_model_radix_nodes",
+                "Radix prefix-cache tree nodes resident (one cached "
+                "page_size token chunk each)")
+GLOBAL.describe("tpu_model_radix_pages",
+                "Physical KV pages pinned by the radix prefix cache")
 # pre-seed the failure counters at 0: alert rules rate() over these, and
 # a series that first appears AT the first failure hides that failure
 # (the stall/chunk counters likewise: a mixed-load dashboard must read 0,
@@ -168,7 +180,9 @@ for _name in ("tpu_model_engine_restarts_total",
               "tpu_model_requests_shed_total",
               "tpu_model_followers_lost_total",
               "tpu_model_admission_stall_ms_total",
-              "tpu_model_prefill_chunks_total"):
+              "tpu_model_prefill_chunks_total",
+              "tpu_model_prefix_hit_tokens_total",
+              "tpu_model_prefix_miss_tokens_total"):
     GLOBAL.inc(_name, 0.0)
 
 

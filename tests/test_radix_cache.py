@@ -1,0 +1,342 @@
+"""Radix prefix cache: page-granular, refcounted cross-request KV reuse.
+
+ISSUE 4 coverage: PageTable refcount/pin accounting + the check()
+invariant, RadixCache match/insert/LRU-evict semantics, stitched-vs-cold
+stream parity across tail buckets (greedy AND derived-seed sampling),
+copy-on-write divergence on a shared boundary page, LRU eviction under
+pool pressure, refcounts across preempt-readmit and supervised restart,
+and the pages.alloc chaos drill (injected exhaustion mid-stitched
+admission falls back to a cold prefill with no leaked pages).
+"""
+
+import dataclasses
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ollama_operator_tpu.models import decoder
+from ollama_operator_tpu.models.config import PRESETS
+from ollama_operator_tpu.runtime.engine import Engine, EngineConfig, SlotOptions
+from ollama_operator_tpu.runtime.faults import FAULTS
+from ollama_operator_tpu.runtime.paged import PageTable
+from ollama_operator_tpu.runtime.radix import RadixCache
+from ollama_operator_tpu.runtime.scheduler import Scheduler
+from ollama_operator_tpu.server.metrics import GLOBAL as METRICS
+
+BASE = PRESETS["tiny"]
+XLA = dataclasses.replace(BASE, kernels="xla")
+GREEDY = SlotOptions(temperature=0.0)
+DENSE = EngineConfig(max_slots=4, max_seq_len=64, cache_dtype=jnp.float32,
+                     min_prefill_bucket=16)
+PAGED = dataclasses.replace(DENSE, paged=True, page_size=8)
+
+PREFIX = np.arange(1, 25, dtype=np.int32)          # 24 tokens = 3 pages
+PROMPT = np.array([3, 1, 4, 1, 5, 9, 2, 6], np.int32)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return decoder.init_params(BASE, jax.random.key(0), jnp.float32)
+
+
+def _gen(eng, slot, full, opts, n):
+    """Cold admission + n decode steps on one slot (slot left active)."""
+    first = eng.admit(slot, np.asarray(full, np.int32), opts)
+    return [first] + [int(eng.decode()[slot]) for _ in range(n)]
+
+
+def _drain(sched, deadline_s=5.0):
+    t1 = time.monotonic() + deadline_s
+    while sched.n_active and time.monotonic() < t1:
+        time.sleep(0.01)
+    assert sched.n_active == 0
+
+
+# ---------------------------------------------------------------------------
+# host accounting units (no engine)
+# ---------------------------------------------------------------------------
+
+def test_page_table_refcounts_shared_and_pinned():
+    pt = PageTable(n_slots=3, n_pages=6, page_size=8, max_blocks=8)
+    assert pt.grow(0, 16)                      # 2 private pages, rc=1
+    pages = pt.slot_pages(0)
+    pt.pin(pages[0])
+    pt.pin(pages[1])                           # the tree adopts both
+    pt.release(0)
+    assert pt.n_free == 3                      # pinned pages stay resident
+    pt.map_shared(1, pages)                    # stitched read-only
+    pt.map_shared(2, pages[:1])
+    assert pt.shared_refs(pages[0]) == 2
+    assert pt.shared_refs(pages[1]) == 1
+    pt.check()
+    pt.release(1)
+    pt.release(2)
+    assert pt.n_free == 3                      # pins still hold them
+    pt.unpin(pages[0])
+    pt.unpin(pages[1])
+    assert pt.n_free == 5                      # rc hit zero -> pool
+    pt.check()
+
+
+def test_page_table_check_catches_a_leak():
+    pt = PageTable(n_slots=1, n_pages=4, page_size=8, max_blocks=4)
+    assert pt.grow(0, 8)
+    pt.check()
+    # simulate a lost mapping without the matching decref
+    pt._owned[0].clear()
+    pt.tables[0, :] = 0
+    with pytest.raises(AssertionError):
+        pt.check()
+    pt._free.append(1)  # restore sanity for the autouse sweep
+    pt._rc[1] = 0
+
+
+def test_page_table_alloc_fault_is_a_dry_pool():
+    pt = PageTable(n_slots=2, n_pages=5, page_size=8, max_blocks=8)
+    FAULTS.arm("pages.alloc", "fail:once")
+    assert not pt.grow(0, 8)                   # injected exhaustion
+    assert pt.owned_blocks(0) == 0 and pt.n_free == 4
+    assert pt.grow(0, 8)                       # disarmed after :once
+    pt.check()
+    pt.release(0)
+
+
+def test_radix_match_insert_evict_lru():
+    rc = RadixCache(page_size=4)
+    ids = list(range(1, 13))                   # 3 chunks
+    assert [n.page for n in rc.insert(ids, [10, 11, 12])] == [10, 11, 12]
+    assert rc.n_nodes == 3
+    assert rc.insert(ids, [20, 21, 22]) == []  # dedup keeps tree pages
+    full, part, q = rc.match(ids + [99], 12, bump=False)
+    assert [n.page for n in full] == [10, 11, 12] and part is None and q == 0
+    # partial boundary: 6 shared tokens = 1 full chunk + 2 into the next
+    full, part, q = rc.match(ids[:6] + [77, 78], 8)
+    assert [n.page for n in full] == [10] and part.page == 11 and q == 2
+    # LRU: a second branch, then bump the first -> branch leaf is oldest
+    assert [n.page for n in rc.insert(ids[:4] + [50, 51, 52, 53], [13, 14])
+            ] == [14]
+    rc.match(ids, 12)
+    assert rc.evict(1, lambda pg: True) == [14]
+    # page-by-page: children leave before parents
+    assert rc.evict(10, lambda pg: True) == [12, 11, 10]
+    assert rc.n_nodes == 0
+    rc.insert(ids, [10, 11, 12])
+    assert sorted(rc.reset()) == [10, 11, 12] and rc.n_nodes == 0
+
+
+# ---------------------------------------------------------------------------
+# engine: stitch / donate / COW parity
+# ---------------------------------------------------------------------------
+
+def test_stitch_matches_cold_across_buckets(params):
+    """Stitched admission must be bit-identical to a cold prefill for
+    greedy AND derived-seed sampling, across tail buckets (16 and 32).
+    Same slot + same n_total -> same PRNG seed, so only the KV reuse
+    differs between the two paths."""
+    eng = Engine(XLA, params, ecfg=PAGED)
+    assert eng.radix_enabled
+    seeded = SlotOptions(temperature=0.9, top_k=40)
+    tails = [np.array([70], np.int32),                 # tail bucket 16
+             np.arange(90, 110, dtype=np.int32)]       # tail bucket 32
+    cold = {}
+    for t in tails:
+        full = np.concatenate([PREFIX, t])
+        for opts in (GREEDY, seeded):
+            cold[(len(t), opts is GREEDY)] = _gen(eng, 0, full, opts, 3)
+            eng.release(0)                             # no donation: cold
+    assert eng.radix_nodes == 0
+    donor = np.concatenate([PREFIX, np.array([60, 61], np.int32)])
+    toks = _gen(eng, 0, donor, GREEDY, 2)
+    eng.donate_prefix(0, list(donor) + toks[:-1])
+    assert eng.radix_nodes == 3                        # the PREFIX chunks
+    for t in tails:
+        full = np.concatenate([PREFIX, t])
+        for opts in (GREEDY, seeded):
+            want = eng.prefix_probe(full)
+            assert want >= 24
+            got = eng.stitch(0, full, want)
+            assert got >= 24
+            first = eng.extend(0, full, got, opts)
+            out = [first] + [int(eng.decode()[0]) for _ in range(3)]
+            assert out == cold[(len(t), opts is GREEDY)], (len(t), opts)
+            eng.release(0)
+
+
+def test_cow_divergence_on_shared_boundary(params):
+    """A request diverging INSIDE a cached page gets a private copy: its
+    stream matches a cold run of the divergent prompt, and the tree's
+    page still serves the original continuation bit-identically."""
+    eng = Engine(XLA, params, ecfg=PAGED)
+    donor = np.arange(1, 29, dtype=np.int32)           # 28 tokens
+    toks = _gen(eng, 0, donor, GREEDY, 6)
+    donated = list(donor) + toks[:-1]                  # 34 -> 4 full pages
+    eng.donate_prefix(0, donated)
+    assert eng.radix_nodes == 4
+    div = np.asarray(donated[:28] + [77, 78, 79], np.int32)
+    want = eng.prefix_probe(div)
+    assert want == 28                       # 3 full pages + 4-token partial
+    got = eng.stitch(0, div, want)
+    assert got == 28
+    first = eng.extend(0, div, got, GREEDY)
+    out_div = [first] + [int(eng.decode()[0]) for _ in range(3)]
+    eng.release(0)
+    cold = _gen(eng, 1, div, GREEDY, 3)
+    eng.release(1)
+    assert out_div == cold
+    # the divergent writer copied before writing: replaying the DONOR's
+    # exact prompt through the (partially re-shared) tree still yields
+    # the donor's original tokens
+    want = eng.prefix_probe(donor)
+    got = eng.stitch(0, donor, want)
+    assert got == 27                        # 24 full + 3 into page 3 (COW)
+    first = eng.extend(0, donor, got, GREEDY)
+    replay = [first] + [int(eng.decode()[0]) for _ in range(2)]
+    assert replay == toks[:3]
+    eng.release(0)
+
+
+# ---------------------------------------------------------------------------
+# scheduler: hits, eviction, preemption, restart
+# ---------------------------------------------------------------------------
+
+def test_scheduler_radix_hits_shared_prefix_concurrently(params):
+    """N requests sharing a prefix all hit the tree (the parked-slot
+    design served exactly one), streams stay bit-identical, and the
+    hit/miss token counters add up."""
+    eng = Engine(XLA, params, ecfg=PAGED)
+    sched = Scheduler(eng)
+    try:
+        full = np.concatenate([PREFIX, np.array([70, 71, 72], np.int32)])
+        out1 = list(sched.submit(full, max_tokens=4, opts=GREEDY).tokens())
+        h0 = METRICS.get("tpu_model_prefix_hit_tokens_total")
+        r2 = sched.submit(full, max_tokens=4, opts=GREEDY)
+        r3 = sched.submit(np.concatenate([PREFIX, [90, 91]]),
+                          max_tokens=4, opts=GREEDY)
+        assert list(r2.tokens()) == out1
+        assert len(list(r3.tokens())) == 4
+        assert r2.error is None and r3.error is None
+        assert r2.stats.n_reused >= 16
+        assert r3.stats.n_reused >= 16      # concurrent second consumer
+        hits = METRICS.get("tpu_model_prefix_hit_tokens_total") - h0
+        assert hits == r2.stats.n_reused + r3.stats.n_reused
+    finally:
+        sched.shutdown()
+
+
+def test_min_prefix_reuse_env_knob(params, monkeypatch):
+    """TPU_MIN_PREFIX_REUSE floors radix stitches exactly like parked
+    reuse: a floor above the shared prefix forces cold admissions."""
+    monkeypatch.setenv("TPU_MIN_PREFIX_REUSE", "48")
+    eng = Engine(XLA, params, ecfg=PAGED)
+    sched = Scheduler(eng)
+    try:
+        assert sched.min_prefix_reuse == 48
+        full = np.concatenate([PREFIX, np.array([70, 71], np.int32)])
+        list(sched.submit(full, max_tokens=4, opts=GREEDY).tokens())
+        r2 = sched.submit(full, max_tokens=4, opts=GREEDY)
+        list(r2.tokens())
+        assert r2.stats.n_reused == 0       # 25 matchable < 48 floor
+    finally:
+        sched.shutdown()
+
+
+def test_radix_lru_eviction_under_pressure(params):
+    """A pool smaller than the working set: donations keep pinning pages
+    until admissions run dry, eviction trims LRU leaves page-by-page,
+    and every request still finishes with its full budget."""
+    eng = Engine(XLA, params, ecfg=dataclasses.replace(
+        PAGED, max_slots=2, n_pages=8))
+    sched = Scheduler(eng)
+    try:
+        outs = []
+        for i in range(4):
+            prompt = np.arange(1 + 20 * i, 17 + 20 * i, dtype=np.int32)
+            r = sched.submit(prompt, max_tokens=4, opts=GREEDY)
+            outs.append(list(r.tokens()))
+            assert r.error is None
+        assert all(len(o) == 4 for o in outs)
+        _drain(sched)
+        # 4 donations x 2 pages > the 8-page pool: eviction must have run
+        assert 0 < eng.radix_pages <= 6
+        assert eng.free_pages == eng._pt.data_pages - eng.radix_pages
+        eng._pt.check()
+    finally:
+        sched.shutdown()
+
+
+def test_refcounts_across_preempt_readmit(params):
+    """Pool pressure with concurrent requests: preempted requests resume
+    on the same stream with full budgets, and when the dust settles every
+    page is either free or pinned by the tree — no refcount drift."""
+    eng = Engine(XLA, params, ecfg=dataclasses.replace(
+        PAGED, max_slots=3, n_pages=6))
+    sched = Scheduler(eng)
+    try:
+        reqs = [sched.submit(PROMPT + i, max_tokens=12, opts=GREEDY)
+                for i in range(3)]
+        outs = [list(r.tokens()) for r in reqs]
+        for r, out in zip(reqs, outs):
+            assert r.error is None
+            assert len(out) == 12, (len(out), r.error)
+        assert sched.n_preemptions >= 1
+        _drain(sched)
+        assert eng.free_pages == eng._pt.data_pages - eng.radix_pages
+        eng._pt.check()
+    finally:
+        sched.shutdown()
+
+
+@pytest.mark.chaos
+def test_radix_reset_on_supervised_restart(params):
+    """A decode-loop failure rebuilds the engine state: the tree must be
+    dropped with it (its cache contents are unknown) and its pins
+    returned, then serving continues and re-populates the cache."""
+    eng = Engine(XLA, params, ecfg=PAGED)
+    sched = Scheduler(eng, restart_backoff=0.001)
+    try:
+        r1 = sched.submit(PROMPT, max_tokens=6, opts=GREEDY)
+        assert len(list(r1.tokens())) == 6
+        assert eng.radix_nodes >= 1          # donated on finish
+        FAULTS.arm("engine.step", "fail:once")
+        r2 = sched.submit(PROMPT + 1, max_tokens=6, opts=GREEDY)
+        with pytest.raises(RuntimeError):
+            list(r2.tokens())
+        t1 = time.monotonic() + 5
+        while sched.n_restarts < 1 and time.monotonic() < t1:
+            time.sleep(0.01)
+        assert sched.n_restarts >= 1 and not sched.broken
+        assert eng.radix_nodes == 0
+        assert eng.free_pages == eng._pt.data_pages   # nothing pinned
+        r3 = sched.submit(PROMPT, max_tokens=6, opts=GREEDY)
+        assert len(list(r3.tokens())) == 6
+        assert eng.radix_nodes >= 1          # cache re-populates
+    finally:
+        sched.shutdown()
+
+
+@pytest.mark.chaos
+def test_pages_alloc_fault_mid_stitch_falls_back_cold(params):
+    """CI chaos drill (ISSUE 4): inject pool exhaustion into the
+    copy-on-write allocation of a stitched admission. The admission must
+    fall back to a cold prefill with a bit-identical stream, and no page
+    may leak (free + tree-pinned covers the whole pool)."""
+    eng = Engine(XLA, params, ecfg=PAGED)
+    sched = Scheduler(eng)
+    try:
+        full = np.concatenate([PREFIX, np.array([70, 71, 72], np.int32)])
+        out1 = list(sched.submit(full, max_tokens=4, opts=GREEDY).tokens())
+        assert eng.prefix_probe(full) >= 16  # a stitch WOULD hit
+        FAULTS.arm("pages.alloc", "fail:once")
+        r2 = sched.submit(full, max_tokens=4, opts=GREEDY)
+        out2 = list(r2.tokens())
+        assert r2.error is None
+        assert out2 == out1                  # cold fallback, same stream
+        assert r2.stats.n_reused == 0        # it really went cold
+        _drain(sched)
+        assert eng.free_pages == eng._pt.data_pages - eng.radix_pages
+        eng._pt.check()
+    finally:
+        sched.shutdown()
