@@ -40,7 +40,7 @@ bench:  ## headline decode-throughput benchmark (one JSON line)
 # tiny smoke programs recompile in seconds anyway
 bench-smoke:  ## seconds-scale CPU bench: engine + HTTP + mixed + prefix arms
 	JAX_PLATFORMS=cpu BENCH_CHILD=1 BENCH_HTTP=1 BENCH_MIXED_ARM=1 \
-	  BENCH_PREFIX_ARM=1 BENCH_XLA_CACHE=0 \
+	  BENCH_PREFIX_ARM=1 BENCH_PAGED_ASYNC_ARM=1 BENCH_XLA_CACHE=0 \
 	  BENCH_SLOTS=4 BENCH_STEPS=16 BENCH_SEQ=512 BENCH_PROMPT=16 \
 	  BENCH_CAPTURE_LOG=0 $(PY) bench.py
 

@@ -899,15 +899,26 @@ def measure_mixed(jax, *, model: str, dtype: str, slots: int, steps: int,
     # arrival prompts below are genuinely multi-piece at smoke scale
     piece = (int(os.environ.get("TPU_PREFILL_CHUNK", "0") or 0)
              or chunk_eff * 2)
-    # async double-buffering is dense-only (a recycled page could be
-    # written by the still-in-flight dispatch through its captured block
-    # table), so this arm always measures the dense engine
-    eng = Engine(cfg, params,
-                 ecfg=EngineConfig(max_slots=slots, max_seq_len=seq,
-                                   decode_chunk=chunk_eff,
-                                   cache_dtype=kv_dtype, paged=False,
-                                   min_prefill_bucket=max(16, min(64,
-                                                                  piece))))
+    # paged=True runs the same A/B on the paged engine (ISSUE 5): the
+    # overlap arm then double-buffers through the epoch fence — frees,
+    # evictions and preemptions during an in-flight dispatch ride the
+    # page quarantine instead of returning to the pool immediately.
+    # Pool sized generously so preemption churn stays out of the ITL
+    # signal and the arm measures dispatch overlap, not page pressure.
+    if paged:
+        ps = max(8, min(page_size, serve_seq // 8))
+        pool = n_pages or slots * (-(-serve_seq // ps) + 2)
+        ecfg = EngineConfig(max_slots=slots, max_seq_len=seq,
+                            decode_chunk=chunk_eff,
+                            cache_dtype=kv_dtype, paged=True,
+                            page_size=ps, n_pages=pool,
+                            min_prefill_bucket=max(16, min(64, piece)))
+    else:
+        ecfg = EngineConfig(max_slots=slots, max_seq_len=seq,
+                            decode_chunk=chunk_eff,
+                            cache_dtype=kv_dtype, paged=False,
+                            min_prefill_bucket=max(16, min(64, piece)))
+    eng = Engine(cfg, params, ecfg=ecfg)
     # AOT-warm the programs BOTH arms dispatch (decode, admit buckets,
     # batched admit) so neither arm pays compiles in its measured window
     eng.warm_buckets()
@@ -1057,7 +1068,9 @@ def measure_mixed(jax, *, model: str, dtype: str, slots: int, steps: int,
     off = run_arm(False)
     rec = {
         "model": model,
-        "mode": "mixed",
+        # "mixed_paged" is the ISSUE-5 headline capture: its
+        # itl_p99_ratio is the paged async-vs-sync dispatch ratio
+        "mode": "mixed_paged" if paged else "mixed",
         "overlap_on": on,
         "overlap_off": off,
         "itl_p99_ratio": (round(off["itl_p99_ms"] / on["itl_p99_ms"], 2)
@@ -1068,7 +1081,7 @@ def measure_mixed(jax, *, model: str, dtype: str, slots: int, steps: int,
                            else None),
         "slots": slots,
         "dtype": dtype,
-        "paged": False,
+        "paged": paged,
         "prompt_len": int(long_len),
         "prefill_piece": int(piece_b),
         "decode_chunk": chunk_eff,
@@ -1141,7 +1154,7 @@ def measure_prefix(jax, *, model: str, dtype: str, slots: int, steps: int,
     pool = (n_pages
             or slots * (-(-serve_seq // ps) + 2) + prefix_len // ps)
 
-    def run_arm(cache_on: bool) -> dict:
+    def run_arm(cache_on: bool, overlap: bool = True) -> dict:
         saved = os.environ.get("TPU_PREFIX_CACHE")
         if not cache_on:
             os.environ["TPU_PREFIX_CACHE"] = "0"
@@ -1158,7 +1171,10 @@ def measure_prefix(jax, *, model: str, dtype: str, slots: int, steps: int,
             else:
                 os.environ["TPU_PREFIX_CACHE"] = saved
         eng.warm_buckets()
-        sched = Scheduler(eng)
+        # overlap=False pins the arm to synchronous dispatch (the
+        # TPU_ASYNC_DISPATCH=0 baseline of the ISSUE-5 A/B); otherwise
+        # the paged scheduler double-buffers through the epoch fence
+        sched = Scheduler(eng, async_dispatch=overlap)
         try:
             def run_one(tail, out):
                 r = sched.submit(list(prefix) + list(tail), greedy,
@@ -1194,6 +1210,7 @@ def measure_prefix(jax, *, model: str, dtype: str, slots: int, steps: int,
             errors = [o["error"] for o in outs if "error" in o]
             return {
                 "cache_on": cache_on,
+                "async": overlap,
                 "ttft_p50_ms": (round(float(np.percentile(ttfts, 50)) * 1e3,
                                       1) if ttfts else None),
                 "ttft_p95_ms": (round(float(np.percentile(ttfts, 95)) * 1e3,
@@ -1219,11 +1236,15 @@ def measure_prefix(jax, *, model: str, dtype: str, slots: int, steps: int,
 
     on = run_arm(True)
     off = run_arm(False)
+    # third arm (ISSUE 5): cache on, synchronous dispatch — isolates the
+    # epoch-fenced double-buffering win on the radix-hit serving shape
+    sync = run_arm(True, overlap=False)
     rec = {
         "model": model,
         "mode": "prefix",
         "cache_on": on,
         "cache_off": off,
+        "cache_on_sync": sync,
         # >=2.0 on TPU at K>=4 is the ISSUE-4 acceptance bar; the
         # CPU smoke asserts hit_rate only (TTFT is noise at tiny scale)
         "prefix_ttft_ratio": (round(off["ttft_p95_ms"] / on["ttft_p95_ms"],
@@ -1231,6 +1252,12 @@ def measure_prefix(jax, *, model: str, dtype: str, slots: int, steps: int,
                               if on.get("ttft_p95_ms")
                               and off.get("ttft_p95_ms") else None),
         "prefix_hit_rate": on.get("hit_rate"),
+        # sync/async TTFT on the same cache-on shape: >1 means the
+        # overlapped dispatch is ahead even with radix hits in play
+        "paged_async_ttft_ratio": (round(
+            sync["ttft_p95_ms"] / on["ttft_p95_ms"], 2)
+            if on.get("ttft_p95_ms") and sync.get("ttft_p95_ms")
+            else None),
         "slots": slots,
         "dtype": dtype,
         "paged": True,
@@ -1345,6 +1372,11 @@ def main() -> None:
             # stall-free batching A/B (chunked prefill + async dispatch
             # vs one-shot sync) through the real scheduler
             plan.append({**smoke, "mixed_arm": True})
+        if os.environ.get("BENCH_PAGED_ASYNC_ARM", "") == "1":
+            # the same A/B on the PAGED engine (ISSUE 5): async dispatch
+            # double-buffers through the epoch-fenced page quarantine —
+            # reported as paged_async_itl_ratio in the summary
+            plan.append({**smoke, "mixed_arm": True, "paged": True})
         if os.environ.get("BENCH_PREFIX_ARM", "") == "1":
             # radix prefix cache A/B (shared-system-prompt fan-out,
             # cache on vs TPU_PREFIX_CACHE=0) through the real scheduler
@@ -1411,10 +1443,17 @@ def main() -> None:
                  prompt_len=128, paged=False, mixed=False),
             # stall-free batching A/B through the real scheduler: steady
             # decode batch + Poisson long-prompt arrivals, chunked prefill
-            # + async double-buffered dispatch vs one-shot sync (dense —
-            # async dispatch is dense-only)
+            # + async double-buffered dispatch vs one-shot sync, dense
             dict(model="tinyllama", dtype="int8", slots=16, steps=128,
                  seq=2048, prompt_len=1024, paged=False, mixed=False,
+                 mixed_arm=True),
+            # the same A/B on the PAGED engine (ISSUE 5): async dispatch
+            # now double-buffers in paged mode through the epoch-fenced
+            # page quarantine — itl_p99_ratio here is the summary's
+            # paged_async_itl_ratio (acceptance: paged async keeps the
+            # stall-free win instead of silently falling back to sync)
+            dict(model="tinyllama", dtype="int8", slots=16, steps=128,
+                 seq=2048, prompt_len=1024, paged=True, mixed=False,
                  mixed_arm=True),
             # radix prefix-cache A/B through the real scheduler: K
             # concurrent requests sharing a 512-token system prompt,
@@ -1525,6 +1564,19 @@ def assemble(captures: list, platform: str, n_devices: int) -> str:
             prefix_hit_rate = c.get("prefix_hit_rate")
             prefix_ttft_ratio = c.get("prefix_ttft_ratio")
             break
+    # paged async dispatch A/B (ISSUE 5): the paged mixed-load capture's
+    # sync/async ITL ratio, plus the prefix capture's sync/async TTFT
+    # ratio on the radix-hit shape — both >= 1 means epoch-fenced
+    # double-buffering holds its win in paged mode
+    paged_async_itl_ratio = paged_async_ttft_ratio = None
+    for c in captures:
+        if c.get("mode") == "mixed_paged":
+            paged_async_itl_ratio = c.get("itl_p99_ratio")
+            break
+    for c in captures:
+        if c.get("mode") == "prefix":
+            paged_async_ttft_ratio = c.get("paged_async_ttft_ratio")
+            break
     return json.dumps({
         "metric": metric,
         "value": head["tok_s"],
@@ -1543,6 +1595,8 @@ def assemble(captures: list, platform: str, n_devices: int) -> str:
         "mixed_tok_s_ratio": mixed_tok_s_ratio,
         "prefix_hit_rate": prefix_hit_rate,
         "prefix_ttft_ratio": prefix_ttft_ratio,
+        "paged_async_itl_ratio": paged_async_itl_ratio,
+        "paged_async_ttft_ratio": paged_async_ttft_ratio,
         "slots": head["slots"],
         "platform": platform,
         "dtype": head["dtype"],

@@ -81,11 +81,19 @@ def _bound_xla_state():
 def _page_accounting():
     """Refcount leaks fail loudly: after EVERY test, each PageTable still
     alive must satisfy its accounting invariant — every non-trash page
-    free exactly once XOR refcounted as mapped+pinned (ISSUE 4)."""
+    free exactly once XOR quarantined exactly once XOR refcounted as
+    mapped+pinned (ISSUE 4). With the scheduler idle (every test ends
+    that way) the epoch-fence quarantine must also be EMPTY: a page
+    parked there forever is a pool leak the refcount check alone cannot
+    see (ISSUE 5) — the idle scheduler loop and shutdown() both drain it,
+    so residue here means a fence ack went missing."""
     yield
     from ollama_operator_tpu.runtime.paged import live_tables
     for pt in live_tables():
         pt.check()
+        assert pt.quarantined == 0, (
+            f"{pt.quarantined} page(s) leaked in epoch quarantine "
+            f"after test teardown")
 
 
 @pytest.fixture(autouse=True)

@@ -311,15 +311,24 @@ class DecodeHandle:
     (or an admission piece) BEFORE waiting on dispatch N, so host-side
     fan-out/detokenise work overlaps device compute. Donated-state data
     dependencies keep device programs ordered regardless of when (or
-    whether) wait() runs."""
+    whether) wait() runs.
 
-    __slots__ = ("_engine", "_toks", "_t0", "_out")
+    ``epoch`` is the paged-mode dispatch epoch this launch was stamped
+    with (0 for dense engines). Once wait() returns, the program is
+    materialised and the caller may pass the epoch back into the next
+    ``decode_n_launch(retire=...)`` to unfence pages quarantined up to
+    it — wait() itself must NOT retire, because multi-host followers
+    replay launches without ever waiting and the free-list order has to
+    stay bit-identical across hosts (runtime/paged.py docstring)."""
 
-    def __init__(self, engine: "Engine", toks, t0: float):
+    __slots__ = ("_engine", "_toks", "_t0", "_out", "epoch")
+
+    def __init__(self, engine: "Engine", toks, t0: float, epoch: int = 0):
         self._engine = engine
         self._toks = toks
         self._t0 = t0
         self._out: Optional[np.ndarray] = None
+        self.epoch = epoch
 
     def wait(self) -> np.ndarray:
         if self._out is None:
@@ -2048,6 +2057,27 @@ class Engine:
         for pg in self._radix.reset():
             self._pt.unpin(pg)
 
+    @property
+    def quarantined_pages(self) -> int:
+        """Pages fenced in the page-table quarantine (0 when dense)."""
+        return self._pt.quarantined if self.paged else 0
+
+    def fence_quiesce(self) -> int:
+        """Materialise every launched device program, then drain the page
+        quarantine entirely; returns the number of pages reclaimed.
+        Dense engines: no-op. Device programs are serialized by their
+        donated cache data dependencies, so blocking on the latest
+        ``lengths`` output proves no in-flight program can still read any
+        quarantined page through a captured block table. MIRRORED across
+        hosts (each blocks on its OWN devices), so callers must invoke it
+        only at deterministic call-stream positions guarded by
+        deterministic state — e.g. ``quarantined_pages > 0`` — never from
+        timing-dependent branches."""
+        if not self.paged:
+            return 0
+        jax.block_until_ready(self.lengths)
+        return self._pt.drain_quarantine()
+
     def decode_n(self, n: Optional[int] = None) -> np.ndarray:
         """n decode steps in one device program; returns tokens [n, B].
 
@@ -2061,17 +2091,38 @@ class Engine:
         Paged mode: callers that want preemption-on-pool-dry run
         ``prepare_decode`` themselves first and requeue the victims; here
         a dry pool raises (tests/bench size their pools adequately)."""
-        return self.decode_n_launch(n).wait()
+        handle = self.decode_n_launch(n)
+        toks = handle.wait()
+        if self.paged:
+            # synchronous flow self-retires: the program just
+            # materialised, so its quarantined pages are reclaimable NOW
+            # and epoch == retired at every free point — sync paged mode
+            # keeps exactly its pre-fence free-list order (and followers
+            # replay this call, waiting on their own devices, so the
+            # retirement is lockstep across hosts)
+            self._pt.retire_epoch(handle.epoch)
+        return toks
 
-    def decode_n_launch(self, n: Optional[int] = None) -> DecodeHandle:
+    def decode_n_launch(self, n: Optional[int] = None,
+                        retire: Optional[int] = None) -> DecodeHandle:
         """Launch one chunked decode dispatch WITHOUT materialising its
         tokens: slot state (host lengths included) advances immediately;
         the returned handle's wait() fetches [n, B]. Double-buffering
         callers launch dispatch N+1 before waiting on N so fan-out work
-        overlaps device compute (see DecodeHandle)."""
+        overlaps device compute (see DecodeHandle).
+
+        Paged mode: each successful launch advances the page-table
+        dispatch epoch; ``retire`` (the ``.epoch`` of the newest handle
+        the caller has ALREADY waited on) first unfences pages
+        quarantined at or before that epoch, making them allocatable for
+        this very launch. The kwarg rides the multi-host mirror
+        broadcast, so followers retire at the identical call-stream
+        position without ever waiting on a handle themselves."""
         FAULTS.check("engine.step")
         t0 = time.perf_counter()
         n = n or self.ecfg.decode_chunk
+        if self.paged and retire is not None:
+            self._pt.retire_epoch(retire)
         victims = self.prepare_decode(n)
         if victims:
             from .paged import PagesExhausted
@@ -2086,7 +2137,11 @@ class Engine:
             self._rln_dev, self._tables_dev(),
             self._g(budgets, self._slot_sh))
         self._host_lengths[self.active] += budgets[self.active]
-        return DecodeHandle(self, toks_n, t0)
+        # stamp AFTER the successful launch: a raise above leaves the
+        # epoch untouched, so later frees aren't fenced behind a program
+        # that never existed
+        epoch = self._pt.advance_epoch() if self.paged else 0
+        return DecodeHandle(self, toks_n, t0, epoch)
 
     def _spec_exec(self, k: int, attn_len: int):
         key = (k, attn_len)

@@ -9,7 +9,12 @@ for that point via the test API or the ``TPU_FAULTS`` env var.
 
 Fault points wired through the codebase:
 
-    engine.step     -- top of ``Engine.decode_n`` (the decode hot loop)
+    engine.step     -- top of ``Engine.decode_n_launch`` (the decode hot
+                       loop; covers sync ``decode_n`` too, and in
+                       paged+async mode fires BEFORE the launch advances
+                       the dispatch epoch — the chaos drills assert the
+                       restart drains the page quarantine and errors the
+                       in-flight dispatch's owners exactly once)
     engine.admit    -- top of ``Engine.admit`` (prefill/admission)
     pages.alloc     -- ``PageTable.grow`` page allocation; an armed fail
                        makes grow return False (simulated pool

@@ -190,7 +190,13 @@ class MirroredEngine:
                 # page refcounts and (for COW) dispatch a page copy, so
                 # every host must replay them in order; prefix_probe is
                 # read-only and deliberately NOT mirrored
-                "stitch", "donate_prefix", "radix_evict", "radix_reset")
+                "stitch", "donate_prefix", "radix_evict", "radix_reset",
+                # epoch fence: quiesce blocks on each host's OWN devices
+                # and drains that host's quarantine — replayed at the
+                # same call-stream position, every host's free list
+                # stays bit-identical (DecodeHandle.wait, which followers
+                # never run, deliberately does NOT retire epochs)
+                "fence_quiesce")
 
     def __init__(self, inner, cp: ControlPlane):
         object.__setattr__(self, "_inner", inner)

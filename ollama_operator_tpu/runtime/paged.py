@@ -20,6 +20,24 @@ A page returns to the free list exactly when its refcount hits zero —
 ``check()`` asserts that accounting invariant and the test suite runs it
 after every test (autouse fixture in conftest.py).
 
+**Epoch-fenced reclamation** (ISSUE 5): double-buffered async dispatch
+launches decode program N+1 before materialising N's tokens, so a page
+freed between the two launches may still be read (or written, for the
+slot's new positions) by the in-flight program through the block table it
+captured at launch. The table therefore carries a monotonic dispatch
+epoch: ``advance_epoch()`` stamps each ``decode_n_launch``; while any
+launched epoch is un-retired, a page whose refcount hits zero goes to a
+FIFO **quarantine** stamped with the current epoch instead of the free
+list, and becomes allocatable only once ``retire_epoch(e)`` certifies the
+program launched at its stamp has been materialised (vLLM's deferred
+block reclamation / SGLang's radix fencing, host-side). Retirement is
+driven by CALLERS at deterministic call-stream positions (the scheduler
+after waiting a handle, supervised restart via ``drain_quarantine``) so
+multi-host follower replay — which never materialises tokens — keeps
+byte-identical free lists. When no dispatch is outstanding
+(epoch == retired, the synchronous path) frees hit the pool directly,
+exactly as before.
+
 Design notes vs the reference: llama.cpp's unified KV cell pool inside the
 delegated `ollama/ollama` image plays this role
 (/root/reference/pkg/model/pod.go:11); here the allocator is explicit so
@@ -73,6 +91,12 @@ class PageTable:
         # radix tree's share of it (rc - pins = live slot mappings)
         self._rc = np.zeros((n_pages,), np.int32)
         self._pins = np.zeros((n_pages,), np.int32)
+        # epoch fence: dispatches launched / known-materialised, plus the
+        # FIFO of (launch-epoch stamp, page) entries whose reclamation is
+        # deferred until their stamp retires (module docstring)
+        self._epoch = 0
+        self._retired = 0
+        self._quarantine: List[tuple] = []
         _LIVE.add(self)
 
     @property
@@ -127,15 +151,26 @@ class PageTable:
             self.tables[slot, len(owned)] = pg
             owned.append(pg)
 
+    def _reclaim(self, pg: int):
+        """A page's refcount just hit zero: return it to the pool — via
+        the epoch quarantine while a launched dispatch is un-retired (its
+        captured block table may still reference the page), directly
+        otherwise (synchronous flow, today's semantics)."""
+        if self._epoch > self._retired:
+            self._quarantine.append((self._epoch, pg))
+        else:
+            self._free.append(pg)
+
     def release(self, slot: int):
         """Drop all of ``slot``'s page mappings (table row resets to
-        trash); pages whose refcount reaches zero return to the pool."""
+        trash); pages whose refcount reaches zero return to the pool
+        (through the epoch fence while a dispatch is in flight)."""
         owned = self._owned[slot]
         for pg in owned:
             self._rc[pg] -= 1
             assert self._rc[pg] >= 0, f"double free of page {pg}"
             if self._rc[pg] == 0:
-                self._free.append(pg)
+                self._reclaim(pg)
         owned.clear()
         self.tables[slot, :] = TRASH_PAGE
 
@@ -148,12 +183,54 @@ class PageTable:
         self._pins[pg] += 1
 
     def unpin(self, pg: int):
-        """Drop a radix-tree reference; frees the page at rc zero."""
+        """Drop a radix-tree reference; frees the page at rc zero
+        (through the epoch fence while a dispatch is in flight — radix
+        eviction must not recycle a page an in-flight program reads)."""
         assert self._pins[pg] >= 1, f"page {pg} is not pinned"
         self._pins[pg] -= 1
         self._rc[pg] -= 1
         if self._rc[pg] == 0:
-            self._free.append(pg)
+            self._reclaim(pg)
+
+    # ------------------------------------------------------------------
+    # dispatch-epoch fence (async double-buffering; module docstring)
+    # ------------------------------------------------------------------
+    @property
+    def quarantined(self) -> int:
+        """Pages parked in the epoch quarantine (not yet allocatable)."""
+        return len(self._quarantine)
+
+    def advance_epoch(self) -> int:
+        """Stamp one launched dispatch; returns its epoch. Pages freed
+        from now on quarantine under this stamp until it retires."""
+        self._epoch += 1
+        return self._epoch
+
+    def retire_epoch(self, epoch: int):
+        """The program launched at ``epoch`` (and, by the donated-state
+        device ordering, every earlier one) has been materialised: drain
+        quarantine entries stamped at or before it into the free list, in
+        FIFO order — deterministic from call order alone, so follower
+        replay reproduces the exact free list."""
+        e = min(int(epoch), self._epoch)
+        if e <= self._retired:
+            return
+        self._retired = e
+        q = self._quarantine
+        i = 0
+        while i < len(q) and q[i][0] <= e:
+            self._free.append(q[i][1])
+            i += 1
+        if i:
+            del q[:i]
+
+    def drain_quarantine(self) -> int:
+        """Retire everything outstanding (supervised restart / verified-
+        idle pipeline: no launched program can still read these pages).
+        Returns the number of pages returned to the pool."""
+        n = len(self._quarantine)
+        self.retire_epoch(self._epoch)
+        return n
 
     def shared_refs(self, pg: int) -> int:
         """Slot mappings of ``pg`` beyond the tree's pins — a pinned page
@@ -179,25 +256,39 @@ class PageTable:
         return self.n_pages - 1
 
     def check(self):
-        """Accounting invariant: every non-trash page is EITHER on the
-        free list exactly once with no references, OR referenced with
-        rc == slot mappings + pins ≥ 1 — nothing leaked, nothing double
-        freed, block-table rows consistent with the ownership lists.
-        Debug/test hook (an autouse fixture runs it after every test)."""
+        """Accounting invariant: every non-trash page is EXACTLY ONE of —
+        on the free list once with no references, in the epoch quarantine
+        once with no references (rc 0, unmapped, unpinned: a quarantined
+        page is dead to every slot and to the radix tree, merely not yet
+        reallocatable), or referenced with rc == slot mappings + pins ≥ 1.
+        Nothing leaked, nothing double freed, block-table rows consistent
+        with the ownership lists, quarantine stamps sane. Debug/test hook
+        (an autouse fixture runs it after every test)."""
         free = Counter(self._free)
+        quar = Counter(pg for _, pg in self._quarantine)
         mapped: Counter = Counter()
         for owned in self._owned.values():
             mapped.update(owned)
         assert free[TRASH_PAGE] == 0, "trash page on the free list"
+        assert quar[TRASH_PAGE] == 0, "trash page in quarantine"
         assert mapped[TRASH_PAGE] == 0, "trash page mapped to a slot"
+        assert self._retired <= self._epoch, (
+            f"retired epoch {self._retired} ahead of launched "
+            f"{self._epoch}")
+        stamps = [e for e, _ in self._quarantine]
+        assert stamps == sorted(stamps), "quarantine stamps out of order"
+        assert all(self._retired < e <= self._epoch for e in stamps), (
+            f"quarantine stamp outside ({self._retired}, {self._epoch}]")
         for pg in range(TRASH_PAGE + 1, self.n_pages):
             f, m, p = free[pg], mapped[pg], int(self._pins[pg])
-            rc = int(self._rc[pg])
+            rc, qn = int(self._rc[pg]), quar[pg]
             assert f <= 1, f"page {pg} on the free list {f} times"
-            if f:
+            assert qn <= 1, f"page {pg} quarantined {qn} times"
+            assert not (f and qn), f"page {pg} both free and quarantined"
+            if f or qn:
                 assert rc == 0 and m == 0 and p == 0, (
-                    f"page {pg} free but referenced "
-                    f"(rc={rc}, mapped={m}, pins={p})")
+                    f"page {pg} {'free' if f else 'quarantined'} but "
+                    f"referenced (rc={rc}, mapped={m}, pins={p})")
             else:
                 assert rc == m + p and rc >= 1, (
                     f"page {pg} leaked or miscounted "
@@ -270,6 +361,25 @@ class ShardedPageTable:
 
     def shard_of(self, slot: int) -> int:
         return slot // self._slots_per
+
+    # -- epoch fence (delegated per shard) --------------------------------
+    # dp > 1 stays on synchronous dispatch (scheduler gates async off with
+    # cause="paged_dp"), so these only ever see epoch == retired — but the
+    # fence API must exist so engine/conftest code is layout-agnostic.
+
+    @property
+    def quarantined(self) -> int:
+        return sum(pt.quarantined for pt in self._pts)
+
+    def advance_epoch(self) -> int:
+        return max(pt.advance_epoch() for pt in self._pts)
+
+    def retire_epoch(self, epoch: int):
+        for pt in self._pts:
+            pt.retire_epoch(epoch)
+
+    def drain_quarantine(self) -> int:
+        return sum(pt.drain_quarantine() for pt in self._pts)
 
     def check(self):
         for pt in self._pts:

@@ -23,6 +23,14 @@ Ownership protocol (driven by Engine.stitch/donate_prefix/radix_evict):
 
 A logical clock (bumped per match/insert) orders recency; no wall time,
 so multi-host replays stay deterministic.
+
+Epoch-fence interplay (ISSUE 5): the tree itself never frees a page —
+eviction hands page ids back to the engine, whose ``unpin`` routes any
+refcount-zero page through the PageTable's epoch fence. Under async
+dispatch an evicted page therefore sits in quarantine until the decode
+dispatch whose block tables captured it materialises, so LRU eviction is
+safe to run with a program in flight; under sync dispatch the fence is
+pass-through and eviction frees immediately, exactly as before.
 """
 
 from __future__ import annotations

@@ -230,14 +230,28 @@ class Scheduler:
         # double-buffered async dispatch: launch decode dispatch N+1
         # before materialising N's tokens, so host fan-out/detokenise
         # overlaps device compute (JAX async dispatch). Grammar and
-        # spec-decode need host work between dispatches and stay
-        # synchronous; paged mode too — recycling a page while an
-        # in-flight program still writes it through a captured block
-        # table would corrupt the new owner.
+        # spec-decode need host work between dispatches and fall back to
+        # sync per-dispatch. Paged mode double-buffers too: the page
+        # table's epoch fence quarantines freed pages until the dispatch
+        # that captured their block table materialises, so recycling can
+        # never corrupt an in-flight program's reads (runtime/paged.py).
+        # Only dp-sharded paged (ShardedPageTable) stays synchronous:
+        # per-shard pools make the pressure-relief stall path ambiguous
+        # about WHICH shard's fence to drain, and no measured deployment
+        # runs paged dp>1 yet.
         if async_dispatch is None:
             async_dispatch = os.environ.get(
                 "TPU_ASYNC_DISPATCH", "1").lower() not in ("0", "false")
-        self.async_dispatch = bool(async_dispatch) and not engine.paged
+        paged_dp = engine.paged and getattr(engine, "_paged_dp", 1) > 1
+        self.async_dispatch = bool(async_dispatch) and not paged_dp
+        if async_dispatch and paged_dp:
+            METRICS.inc("tpu_model_async_fallback_total", 1.0,
+                        '{cause="paged_dp"}')
+        # epoch of the newest decode handle already materialised — the
+        # next launch passes it back as retire= so the engine unfences
+        # pages quarantined at or before it (and so followers, which
+        # never wait on handles, retire at the identical call position)
+        self._fence_ack = 0
         # slot → _PrefillJob for requests mid-chunked-prefill (the slot
         # is engine-inactive between pieces; without this map
         # free_slots() would hand it to someone else)
@@ -309,6 +323,14 @@ class Scheduler:
         # are still in _running and drain below
         self._pending = None
         self._prefilling.clear()
+        # unfence anything the dropped dispatch was holding: the engine
+        # may outlive this scheduler (model swap builds a fresh one), and
+        # a page parked in quarantine forever is a pool leak
+        try:
+            if self.engine.quarantined_pages:
+                self.engine.fence_quiesce()
+        except Exception:  # noqa: BLE001 — engine may already be torn down
+            pass
         # drain everything still attached so no caller blocks forever on
         # req.tokens() after an unload (model swap, server shutdown)
         for slot, req in enumerate(self._running):
@@ -456,7 +478,12 @@ class Scheduler:
         try:
             return self.engine.stitch(slot, ids, want)
         except PagesExhausted:
-            self._evict_one_parked()
+            if self._pending is not None or self.engine.quarantined_pages:
+                # likely fenced, not dry: unfence instead of evicting
+                self._drain_pending()
+                self.engine.fence_quiesce()
+            else:
+                self._evict_one_parked()
             return 0
 
     def _pages_for(self, n_tokens: int) -> int:
@@ -587,15 +614,22 @@ class Scheduler:
                                           mask_row=mask_row)
             req.stats.n_reused = reuse_len
         except PagesExhausted as e:
-            # paged pool dry: evict cached pages and retry this request
-            # next pass; with nothing to evict it waits for a finisher
+            # paged pool dry: under async dispatch first drain the
+            # pipeline and unfence quarantined pages (they may merely be
+            # fenced behind the in-flight dispatch, not truly gone), then
+            # evict cached pages; either way retry this request next
+            # pass — with nothing to reclaim it waits for a finisher
             # (unless it can never fit at all)
             if not self.engine.admissible(len(req.admit_ids)):
                 self._request_error(
                     req, f"prompt needs more KV pages than the pool "
                          f"has: {e}")
                 return True
-            self._evict_one_parked(self._pages_for(len(req.admit_ids)))
+            if self._pending is not None or self.engine.quarantined_pages:
+                self._drain_pending()
+                self.engine.fence_quiesce()
+            else:
+                self._evict_one_parked(self._pages_for(len(req.admit_ids)))
             self._preempted.insert(0, req)
             return False
         except Exception as e:  # surfacing engine errors to the caller
@@ -640,7 +674,12 @@ class Scheduler:
                     req, f"prompt needs more KV pages than the pool "
                          f"has: {e}")
                 return True
-            self._evict_one_parked(self._pages_for(len(ids)))
+            if self._pending is not None or self.engine.quarantined_pages:
+                # fenced, not dry (see _admit_one): unfence, don't evict
+                self._drain_pending()
+                self.engine.fence_quiesce()
+            else:
+                self._evict_one_parked(self._pages_for(len(ids)))
             self._preempted.insert(0, req)
             return False
         except Exception as e:
@@ -906,6 +945,20 @@ class Scheduler:
                 self.engine.release(slot)
             except Exception:  # noqa: BLE001 — best-effort slot reset
                 pass
+        # the releases above (and the restart's parked/radix teardown
+        # next) must not strand pages in quarantine — the failed epoch
+        # will never be acked by a wait. Drain via the fence if the
+        # devices still answer, else reclaim host-side: device programs
+        # are serialized by donated-cache data dependencies, so any
+        # zombie dispatch finishes before a post-restart program could
+        # touch a recycled page.
+        try:
+            self.engine.fence_quiesce()
+        except Exception:  # noqa: BLE001 — poisoned device state
+            pt = getattr(self.engine, "_pt", None)
+            if pt is not None:
+                pt.drain_quarantine()
+        self._fence_ack = 0
 
     def _drain_waiting(self, msg):
         for req in self._preempted:
@@ -930,6 +983,16 @@ class Scheduler:
             victims = self.engine.prepare_decode(n_steps)
             if not victims:
                 return
+            # pipeline stall beats sacrifice: under async dispatch the
+            # missing pages may merely be FENCED behind the in-flight
+            # dispatch (quarantined until it materialises), not truly
+            # exhausted — drain the pipeline and unfence before evicting
+            # anyone's cache or preempting a generation. One stall per
+            # pool-dry event, vs a re-prefill per needless preemption.
+            if self._pending is not None or self.engine.quarantined_pages:
+                self._drain_pending()
+                self.engine.fence_quiesce()
+                continue
             if self._evict_one_parked():
                 continue
             cand = [s for s in victims if self._running[s] is not None]
@@ -1016,6 +1079,7 @@ class Scheduler:
         handle, snapshot = self._pending
         self._pending = None
         toks_n = handle.wait()
+        self._fence_ack = handle.epoch
         self._consecutive_failures = 0
         self._fanout(toks_n, snapshot)
 
@@ -1032,6 +1096,11 @@ class Scheduler:
         self._admit_waiting()
         if not self._decoding():
             self._drain_pending()
+            # idle with pages still fenced (the last dispatch's frees):
+            # unfence now so a quiet scheduler never parks pool capacity
+            # in quarantine (and the conftest leak check sees zero)
+            if self.engine.quarantined_pages:
+                self.engine.fence_quiesce()
             if not self._prefilling:
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
@@ -1082,7 +1151,13 @@ class Scheduler:
                 and not constrained):
             # synchronous path: grammar needs a fresh host mask between
             # dispatches, spec verify reads host-built drafts — the
-            # pipeline must be empty before either dispatches
+            # pipeline must be empty before either dispatches. (In paged
+            # mode decode_n self-retires its epoch, so these dispatches
+            # also drain any quarantine the async stretch left behind.)
+            if self.async_dispatch:
+                METRICS.inc("tpu_model_async_fallback_total", 1.0,
+                            '{cause="spec"}' if drafts is not None
+                            else '{cause="grammar"}')
             self._drain_pending()
             if drafts is not None:
                 toks_n = self.engine.decode_spec(drafts).T  # [k+1, B]
@@ -1094,9 +1169,13 @@ class Scheduler:
         # double-buffered async dispatch: launch dispatch N+1 FIRST,
         # then materialise and fan out dispatch N — detokenise/queue
         # work on the host overlaps device compute. Device programs stay
-        # ordered through their donated-state data dependencies.
+        # ordered through their donated-state data dependencies. The
+        # retire= ack unfences pages freed behind dispatches we have
+        # already materialised (paged mode; no-op dense).
         try:
-            handle = self.engine.decode_n_launch()
+            handle = (self.engine.decode_n_launch(retire=self._fence_ack)
+                      if self.engine.paged
+                      else self.engine.decode_n_launch())
         except Exception:
             # dispatch N's tokens were already computed — deliver them
             # before the supervisor errors whoever is left
@@ -1106,6 +1185,7 @@ class Scheduler:
         if prev is not None:
             prev_handle, prev_snapshot = prev
             toks_n = prev_handle.wait()
+            self._fence_ack = prev_handle.epoch
             self._consecutive_failures = 0
             self._fanout(toks_n, prev_snapshot)
 

@@ -598,6 +598,11 @@ class ModelManager:
                 # per device program kind, plus process-lifetime admission
                 # counters (same series /metrics exports)
                 "dispatch": {
+                    # whether decode double-buffers (false = forced sync:
+                    # TPU_ASYNC_DISPATCH=0 or paged dp>1; per-dispatch
+                    # grammar/spec fallbacks count in
+                    # tpu_model_async_fallback_total, not here)
+                    "async": bool(lm.scheduler.async_dispatch),
                     "dispatch_ms": (dict(lm.engine.dispatch_ms)
                                     if getattr(lm, "engine", None)
                                     is not None else {}),

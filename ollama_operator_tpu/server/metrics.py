@@ -171,6 +171,12 @@ GLOBAL.describe("tpu_model_radix_nodes",
                 "page_size token chunk each)")
 GLOBAL.describe("tpu_model_radix_pages",
                 "Physical KV pages pinned by the radix prefix cache")
+GLOBAL.describe("tpu_model_async_fallback_total",
+                "Decode dispatches that fell back to synchronous while "
+                "TPU_ASYNC_DISPATCH was on: per-dispatch for grammar "
+                "(host PDA mask between dispatches) and spec (host-built "
+                "drafts), once at startup for paged_dp (dp-sharded page "
+                "pools stay sync); a silently-sync deployment shows here")
 # pre-seed the failure counters at 0: alert rules rate() over these, and
 # a series that first appears AT the first failure hides that failure
 # (the stall/chunk counters likewise: a mixed-load dashboard must read 0,
@@ -184,6 +190,12 @@ for _name in ("tpu_model_engine_restarts_total",
               "tpu_model_prefix_hit_tokens_total",
               "tpu_model_prefix_miss_tokens_total"):
     GLOBAL.inc(_name, 0.0)
+# the async-fallback counter is labelled, so pre-seed every cause — an
+# alert on rate(cause="grammar") must read 0, not absent, while async
+# dispatch is running clean
+for _cause in ("grammar", "spec", "paged_dp"):
+    GLOBAL.inc("tpu_model_async_fallback_total", 0.0,
+               f'{{cause="{_cause}"}}')
 
 
 class Stopwatch:

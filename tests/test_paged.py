@@ -412,18 +412,20 @@ def test_preemption_then_engine_error_stays_consistent(params):
     eng = Engine(XLA, params, ecfg=dataclasses.replace(
         PAGED, max_slots=3, n_pages=6))
     sched = Scheduler(eng, restart_backoff=0.001)
-    real_decode_n = eng.decode_n
+    real_launch = eng.decode_n_launch
     fired = {"x": False}
 
-    def post_preempt_boom(n=None):
+    def post_preempt_boom(n=None, **kw):
         # fail exactly once, at the first decode AFTER a preemption has
-        # happened — deterministically exercises restart-with-preempted
+        # happened — deterministically exercises restart-with-preempted.
+        # Patched at the LAUNCH point so both the sync path (decode_n
+        # calls through it) and paged async double-buffering hit it.
         if sched.n_preemptions >= 1 and not fired["x"]:
             fired["x"] = True
             raise RuntimeError("post-preempt boom")
-        return real_decode_n(n)
+        return real_launch(n, **kw)
 
-    eng.decode_n = post_preempt_boom
+    eng.decode_n_launch = post_preempt_boom
     try:
         reqs = [sched.submit(PROMPT + i, max_tokens=12,
                              opts=SlotOptions(temperature=0.0))

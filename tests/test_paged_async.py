@@ -1,0 +1,389 @@
+"""Paged async dispatch: epoch-fenced page reclamation (ISSUE 5).
+
+Coverage: PageTable quarantine lifecycle (stamp/retire/drain, sync
+pass-through, check() invariants), engine-level fencing of release /
+donation / radix eviction while a dispatch is in flight, the scheduler
+double-buffering in paged mode with bit-identical async-vs-sync streams
+(greedy AND seeded, with and without a radix hit), quarantine
+convergence under pool pressure (preemption in flight), the async
+fallback observability counter, and the engine.step chaos drill
+(fail:after=1 in paged+async: owners errored exactly once, restart
+drains the quarantine, accounting stays clean).
+"""
+
+import dataclasses
+import queue as queue_mod
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ollama_operator_tpu.models import decoder
+from ollama_operator_tpu.models.config import PRESETS
+from ollama_operator_tpu.runtime.engine import Engine, EngineConfig, SlotOptions
+from ollama_operator_tpu.runtime.faults import FAULTS
+from ollama_operator_tpu.runtime.paged import PageTable
+from ollama_operator_tpu.runtime.scheduler import Scheduler
+from ollama_operator_tpu.server.metrics import GLOBAL as METRICS
+
+BASE = PRESETS["tiny"]
+XLA = dataclasses.replace(BASE, kernels="xla")
+GREEDY = SlotOptions(temperature=0.0)
+SEEDED = SlotOptions(temperature=0.9, top_k=40)
+DENSE = EngineConfig(max_slots=4, max_seq_len=64, cache_dtype=jnp.float32,
+                     min_prefill_bucket=16)
+PAGED = dataclasses.replace(DENSE, paged=True, page_size=8)
+
+PREFIX = np.arange(1, 25, dtype=np.int32)          # 24 tokens = 3 pages
+PROMPT = np.array([3, 1, 4, 1, 5, 9, 2, 6], np.int32)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return decoder.init_params(BASE, jax.random.key(0), jnp.float32)
+
+
+def _drain(sched, deadline_s=5.0):
+    t1 = time.monotonic() + deadline_s
+    while ((sched.n_active or sched.engine.quarantined_pages)
+           and time.monotonic() < t1):
+        time.sleep(0.01)
+    assert sched.n_active == 0
+    assert sched.engine.quarantined_pages == 0
+
+
+# ---------------------------------------------------------------------------
+# quarantine lifecycle on the bare page table (no engine)
+# ---------------------------------------------------------------------------
+
+def test_sync_reclaim_is_passthrough():
+    """With no dispatch in flight (epoch == retired) frees keep today's
+    exact semantics: straight to the free list, quarantine untouched."""
+    pt = PageTable(n_slots=2, n_pages=6, page_size=8, max_blocks=8)
+    assert pt.grow(0, 16)
+    pt.release(0)
+    assert pt.quarantined == 0 and pt.n_free == 5
+    # retiring up to date keeps the fence open
+    e = pt.advance_epoch()
+    pt.retire_epoch(e)
+    assert pt.grow(0, 8)
+    pt.release(0)
+    assert pt.quarantined == 0 and pt.n_free == 5
+    pt.check()
+
+
+def test_quarantine_stamps_and_partial_retire():
+    """Frees during epoch N are stamped N and become allocatable only
+    when N retires — retiring e1 must not release e2's pages."""
+    pt = PageTable(n_slots=2, n_pages=6, page_size=8, max_blocks=8)
+    assert pt.grow(0, 8) and pt.grow(1, 8)
+    e1 = pt.advance_epoch()
+    pt.release(0)                              # stamped e1
+    e2 = pt.advance_epoch()
+    pt.release(1)                              # stamped e2
+    assert pt.quarantined == 2 and pt.n_free == 3
+    pt.check()
+    pt.retire_epoch(e1)
+    assert pt.quarantined == 1 and pt.n_free == 4
+    pt.retire_epoch(e2)
+    assert pt.quarantined == 0 and pt.n_free == 5
+    # retire clamps to the launched epoch and is idempotent
+    pt.retire_epoch(999)
+    pt.check()
+
+
+def test_drain_quarantine_reclaims_everything():
+    pt = PageTable(n_slots=2, n_pages=6, page_size=8, max_blocks=8)
+    assert pt.grow(0, 16)
+    pt.advance_epoch()
+    pt.release(0)
+    assert pt.quarantined == 2
+    assert pt.drain_quarantine() == 2
+    assert pt.quarantined == 0 and pt.n_free == 5
+    pt.check()
+
+
+def test_unpin_routes_through_the_fence():
+    """Radix eviction frees via unpin: with a dispatch in flight the page
+    must quarantine, not return to the pool."""
+    pt = PageTable(n_slots=2, n_pages=6, page_size=8, max_blocks=8)
+    assert pt.grow(0, 8)
+    pg = pt.slot_pages(0)[0]
+    pt.pin(pg)                                 # the tree adopts it
+    pt.release(0)
+    assert pt.n_free == 4                      # pinned: stays resident
+    pt.advance_epoch()                         # a dispatch is in flight
+    pt.unpin(pg)                               # LRU eviction
+    assert pt.quarantined == 1 and pt.n_free == 4
+    pt.check()
+    pt.drain_quarantine()
+    assert pt.n_free == 5
+    pt.check()
+
+
+def test_check_catches_free_and_quarantined():
+    pt = PageTable(n_slots=1, n_pages=4, page_size=8, max_blocks=4)
+    assert pt.grow(0, 8)
+    pg = pt.slot_pages(0)[0]
+    pt.advance_epoch()
+    pt.release(0)
+    assert pt.quarantined == 1
+    pt._free.append(pg)                        # corrupt: free AND fenced
+    with pytest.raises(AssertionError):
+        pt.check()
+    pt._free.pop()                             # restore sanity
+    pt.drain_quarantine()
+    pt.check()
+
+
+def test_check_catches_referenced_while_quarantined():
+    pt = PageTable(n_slots=1, n_pages=4, page_size=8, max_blocks=4)
+    assert pt.grow(0, 8)
+    pg = pt.slot_pages(0)[0]
+    pt.advance_epoch()
+    pt.release(0)
+    pt._rc[pg] = 1                             # corrupt: live ref in fence
+    with pytest.raises(AssertionError):
+        pt.check()
+    pt._rc[pg] = 0                             # restore sanity
+    pt.drain_quarantine()
+    pt.check()
+
+
+# ---------------------------------------------------------------------------
+# engine: frees while a dispatch is genuinely in flight
+# ---------------------------------------------------------------------------
+
+def test_release_in_flight_quarantines_then_retires(params):
+    """A slot released while a launched chunk is still un-materialised
+    must fence its pages; the next launch's retire= ack (the epoch the
+    caller already waited on) unfences them."""
+    eng = Engine(XLA, params, ecfg=PAGED)
+    eng.admit(0, PROMPT, GREEDY)
+    eng.admit(1, PROMPT + 1, GREEDY)
+    h1 = eng.decode_n_launch()
+    assert h1.epoch == 1
+    eng.release(1)                             # in flight: must fence
+    assert eng.quarantined_pages >= 1
+    eng._pt.check()
+    h1.wait()
+    h2 = eng.decode_n_launch(retire=h1.epoch)  # ack unfences stamp<=1
+    assert eng.quarantined_pages == 0
+    h2.wait()
+    assert eng.fence_quiesce() == 0            # nothing left to drain
+    eng.release(0)                             # sync again: direct free
+    assert eng.quarantined_pages == 0
+    assert eng.free_pages == eng._pt.data_pages
+    eng._pt.check()
+
+
+def test_donate_and_evict_in_flight_route_through_fence(params):
+    """Radix donation (duplicate/tail frees) and LRU eviction (unpins)
+    while a chunk is in flight must quarantine; fence_quiesce reclaims
+    the whole pool once the program materialises."""
+    eng = Engine(XLA, params, ecfg=PAGED)
+    assert eng.radix_enabled
+    donor = np.arange(1, 29, dtype=np.int32)   # 28 tokens
+    first = eng.admit(0, donor, GREEDY)
+    rows = eng.decode_n(4)                     # sync: epoch==retired
+    gen = [first] + [int(r[0]) for r in rows]
+    handle = eng.decode_n_launch()             # NOW a program is in flight
+    eng.donate_prefix(0, list(donor) + gen[:-1])   # 32 tokens = 4 pages
+    assert eng.radix_nodes == 4
+    assert eng.quarantined_pages >= 1          # the slot's tail pages
+    eng._pt.check()
+    n_evicted = eng.radix_evict(10)            # unpin all 4 tree pages
+    assert n_evicted == 4
+    assert eng.radix_nodes == 0
+    assert eng.quarantined_pages >= 5
+    eng._pt.check()
+    handle.wait()
+    assert eng.fence_quiesce() >= 5
+    assert eng.quarantined_pages == 0
+    assert eng.free_pages == eng._pt.data_pages
+    eng._pt.check()
+
+
+# ---------------------------------------------------------------------------
+# scheduler: double-buffered paged decode, stream parity
+# ---------------------------------------------------------------------------
+
+def test_paged_scheduler_double_buffers_by_default(params):
+    """The `and not engine.paged` gate is gone: a paged scheduler with
+    TPU_ASYNC_DISPATCH unset/on runs double-buffered."""
+    eng = Engine(XLA, params, ecfg=PAGED)
+    sched = Scheduler(eng, async_dispatch=True)
+    try:
+        assert sched.async_dispatch
+        out = list(sched.submit(PROMPT, max_tokens=6, opts=GREEDY).tokens())
+        assert len(out) == 6
+        _drain(sched)
+    finally:
+        sched.shutdown()
+
+
+def _arm(params, async_on, warm):
+    """One scheduler arm: optional warm donor (radix hit for the probes),
+    then greedy + seeded probes sharing PREFIX. Returns all streams."""
+    eng = Engine(XLA, params, ecfg=PAGED)
+    sched = Scheduler(eng, async_dispatch=async_on)
+    try:
+        assert sched.async_dispatch is async_on
+        outs = []
+        if warm:
+            donor = np.concatenate([PREFIX, np.array([60, 61], np.int32)])
+            outs.append(list(sched.submit(donor, max_tokens=4,
+                                          opts=GREEDY).tokens()))
+        probes = [
+            (np.concatenate([PREFIX, np.array([70], np.int32)]), GREEDY),
+            (np.concatenate([PREFIX, np.array([70], np.int32)]), SEEDED),
+            (PROMPT, GREEDY),
+        ]
+        reqs = [sched.submit(p, max_tokens=8, opts=o) for p, o in probes]
+        outs += [list(r.tokens()) for r in reqs]
+        for r in reqs:
+            assert r.error is None
+        if warm:
+            assert any(r.stats.n_reused >= 16 for r in reqs)
+        _drain(sched)
+        return outs
+    finally:
+        sched.shutdown()
+
+
+@pytest.mark.parametrize("warm", [False, True],
+                         ids=["cold", "radix-hit"])
+def test_paged_async_streams_match_sync(params, warm):
+    """The acceptance bar: paged async streams are bit-identical to the
+    sync path — greedy and seeded, cold and with a radix stitch."""
+    assert _arm(params, True, warm) == _arm(params, False, warm)
+
+
+def test_preempt_under_pressure_in_flight_converges(params):
+    """Pool pressure with async double-buffering: preemption and
+    eviction route through the fence (drain-then-unfence before any
+    sacrifice), every stream still gets its full budget, and the
+    quarantine is empty once the dust settles."""
+    eng = Engine(XLA, params, ecfg=dataclasses.replace(
+        PAGED, max_slots=3, n_pages=6))
+    sched = Scheduler(eng, async_dispatch=True)
+    try:
+        assert sched.async_dispatch
+        reqs = [sched.submit(PROMPT + i, max_tokens=12, opts=GREEDY)
+                for i in range(3)]
+        outs = [list(r.tokens()) for r in reqs]
+        for r, out in zip(reqs, outs):
+            assert r.error is None
+            assert len(out) == 12, (len(out), r.error)
+        _drain(sched)
+        assert eng.free_pages == eng._pt.data_pages - eng.radix_pages
+        eng._pt.check()
+    finally:
+        sched.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# fallback observability
+# ---------------------------------------------------------------------------
+
+def test_async_fallback_counter_preseeded():
+    """Every cause label exists at 0 before any fallback fires: alert
+    rules rate() over these and a series that first appears AT the first
+    fallback hides it."""
+    text = METRICS.render()
+    for cause in ("grammar", "spec", "paged_dp"):
+        assert f'tpu_model_async_fallback_total{{cause="{cause}"}}' in text
+
+
+def test_paged_dp_stays_sync_and_counts_fallback(params):
+    """dp-sharded paged pools keep synchronous dispatch; the gate is
+    visible as one cause="paged_dp" increment at scheduler build."""
+    from ollama_operator_tpu.parallel.mesh import MeshPlan, make_mesh
+    mesh = make_mesh(MeshPlan(dp=2), jax.devices()[:2])
+    eng = Engine(XLA, params, mesh=mesh,
+                 ecfg=dataclasses.replace(PAGED, n_pages=8))
+    before = METRICS.get("tpu_model_async_fallback_total",
+                         '{cause="paged_dp"}')
+    sched = Scheduler(eng, async_dispatch=True)
+    try:
+        assert not sched.async_dispatch
+        assert METRICS.get("tpu_model_async_fallback_total",
+                           '{cause="paged_dp"}') == before + 1
+        out = list(sched.submit(PROMPT, max_tokens=4, opts=GREEDY).tokens())
+        assert len(out) == 4
+        _drain(sched)
+    finally:
+        sched.shutdown()
+
+
+def test_grammar_dispatch_counts_fallback(params):
+    """A grammar-constrained slot forces per-dispatch sync fallbacks
+    (host PDA mask between dispatches) — visible on the counter while
+    unconstrained traffic keeps double-buffering."""
+    from ollama_operator_tpu.ops.constrain import JsonConstraint
+    from test_constrain import EOS, make_table
+    eng = Engine(XLA, params, ecfg=dataclasses.replace(
+        PAGED, max_seq_len=128))
+    sched = Scheduler(eng, async_dispatch=True)
+    try:
+        assert sched.async_dispatch
+        before = METRICS.get("tpu_model_async_fallback_total",
+                             '{cause="grammar"}')
+        req = sched.submit([5, 9, 2],
+                           SlotOptions(temperature=0.9, seed=1,
+                                       repeat_penalty=1.0),
+                           max_tokens=12, eog_ids=frozenset([EOS]),
+                           constraint=JsonConstraint(make_table()))
+        assert len(list(req.tokens())) >= 1
+        assert METRICS.get("tpu_model_async_fallback_total",
+                           '{cause="grammar"}') > before
+        _drain(sched)
+    finally:
+        sched.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# chaos: exactly-once errors + clean accounting through restart
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_engine_step_fault_paged_async_exactly_once(params):
+    """CI chaos drill (ISSUE 5): engine.step fail:after=1 in paged+async.
+    The first launch succeeds and its in-flight tokens are delivered; the
+    second raises with a dispatch pending. Every owner gets exactly ONE
+    terminal error, the supervised restart drains the quarantine, and the
+    page table checks clean — then serving resumes."""
+    eng = Engine(XLA, params, ecfg=PAGED)
+    sched = Scheduler(eng, restart_backoff=0.001, async_dispatch=True)
+    try:
+        assert sched.async_dispatch
+        FAULTS.arm("engine.step", "fail:after=1")
+        reqs = [sched.submit(PROMPT + i, max_tokens=48, opts=GREEDY)
+                for i in range(2)]
+        errs = 0
+        for r in reqs:
+            try:
+                assert len(list(r.tokens())) <= 48
+            except RuntimeError as e:
+                assert "engine.step" in str(e)
+                errs += 1
+            # exactly once: nothing queued after the terminal item
+            with pytest.raises(queue_mod.Empty):
+                r.out.get_nowait()
+        assert errs == 2                       # both owners errored
+        FAULTS.disarm("engine.step")
+        t1 = time.monotonic() + 5
+        while sched.n_restarts < 1 and time.monotonic() < t1:
+            time.sleep(0.01)
+        assert sched.n_restarts >= 1 and not sched.broken
+        # the restart drained everything: whole pool reclaimable
+        assert eng.quarantined_pages == 0
+        assert eng.free_pages == eng._pt.data_pages
+        eng._pt.check()
+        r2 = sched.submit(PROMPT, max_tokens=6, opts=GREEDY)
+        assert len(list(r2.tokens())) == 6
+        _drain(sched)
+    finally:
+        sched.shutdown()

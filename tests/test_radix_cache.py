@@ -49,10 +49,15 @@ def _gen(eng, slot, full, opts, n):
 
 
 def _drain(sched, deadline_s=5.0):
+    # quiescent = no active slots AND the epoch-fence quarantine drained
+    # (the idle scheduler loop unfences within one iteration; free-page
+    # assertions below would otherwise race the last dispatch's frees)
     t1 = time.monotonic() + deadline_s
-    while sched.n_active and time.monotonic() < t1:
+    while ((sched.n_active or sched.engine.quarantined_pages)
+           and time.monotonic() < t1):
         time.sleep(0.01)
     assert sched.n_active == 0
+    assert sched.engine.quarantined_pages == 0
 
 
 # ---------------------------------------------------------------------------
