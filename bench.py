@@ -546,17 +546,26 @@ def measure_spec(jax, *, model: str, dtype: str, slots: int, steps: int,
                  chunk: int, page_size: int, n_pages: int | None,
                  platform: str, params_cache: dict | None = None,
                  env: dict | None = None, spec_k: int = 4) -> dict:
-    """Speculative-decoding envelope (VERDICT r3 #7): greedy slots driven
-    through engine.decode_spec with (a) known-correct drafts — accept-all,
-    the scheme's ceiling — and (b) garbage drafts — reject-all, its floor —
-    against the plain decode_n baseline. Prompt-lookup's real acceptance
-    rate lands between these depending on how repetitive the workload is;
-    the envelope is what a serving default can be decided from."""
+    """Fused speculative-decoding arm (ISSUE 6): greedy slots driven
+    through the ONE production dispatch surface —
+    ``decode_n_launch(drafts=)`` + ``wait`` + ``spec_ack`` — on a
+    repetition-heavy workload, in three sub-arms:
+
+      lookup     — real prompt-lookup drafts (runtime/drafter.py), the
+                   number the serving default is decided from
+      accept_all — oracle drafts replayed from the recorded baseline
+                   continuation: the scheme's ceiling
+      reject_all — garbage drafts: its floor, pure dispatch overhead
+
+    A chunk dispatch advances `chunk` steps sequentially; a spec dispatch
+    scores k+1 positions in ONE forward, so ms_per_dispatch vs the
+    baseline dispatch separates "the spec program is slow" from "the
+    model forward dominates" — the CI gate asserts the lookup arm stays
+    within 1.2x of the baseline dispatch AND beats its tok/s."""
     import gc
 
-    import jax.numpy as jnp
-
     from ollama_operator_tpu.models.config import get_config
+    from ollama_operator_tpu.runtime import drafter
     from ollama_operator_tpu.runtime.engine import (Engine, EngineConfig,
                                                     SlotOptions,
                                                     resolve_cache_dtype)
@@ -579,50 +588,81 @@ def measure_spec(jax, *, model: str, dtype: str, slots: int, steps: int,
                                    decode_chunk=chunk,
                                    cache_dtype=kv_dtype))
     greedy = SlotOptions(temperature=0.0, repeat_penalty=1.0)
+    k = spec_k
+    prompt_len = min(prompt_len, eng.max_seq // 2)
+    calls = max(1, steps // chunk)
+    # the whole run must fit the context: prompt + first token + warm
+    # chunk + measured steps + the transient k+1 launch over-advance
+    if prompt_len + 1 + chunk + calls * chunk + k + 2 > eng.max_seq:
+        steps = max(chunk, (eng.max_seq - prompt_len - chunk - k - 3)
+                    // chunk * chunk)
+        calls = max(1, steps // chunk)
+        log(f"bench: clamping spec steps to {steps} to fit context "
+            f"{eng.max_seq}")
+    n_steps = calls * chunk
     rng = np.random.default_rng(0)
-    prompts = [rng.integers(1, cfg.vocab_size, size=prompt_len,
-                            endpoint=False).astype(np.int32)
-               for _ in range(slots)]
+    # repetition-heavy workload — the regime prompt-lookup targets
+    # (code, JSON, summarisation): each slot's prompt cycles a short
+    # random pattern, so the drafter finds its first match immediately
+    # and greedy continuations stay periodic
+    pats = [rng.integers(1, cfg.vocab_size, size=8,
+                         endpoint=False).astype(np.int32)
+            for _ in range(slots)]
+    prompts = [np.tile(p, prompt_len // len(p) + 1)[:prompt_len]
+               for p in pats]
 
     def admit_all():
-        return [eng.admit(s, prompts[s], greedy) for s in range(slots)]
+        return [int(eng.admit(s, prompts[s], greedy))
+                for s in range(slots)]
 
-    admit_all()
-    eng.warm_buckets()
-    # record the true greedy continuation — the accept-all draft source —
+    firsts = admit_all()
+    # warm every program the timed loops can touch: chunk programs for
+    # the reachable buckets, and the spec verify program per bucket —
+    # a bucket crossing mid-run must swap executables, never compile
+    # (the BENCH_r05 623ms/spec-dispatch anomaly)
+    ctx_lo, ctx_hi = prompt_len, prompt_len + 1 + chunk + n_steps + k + 2
+    eng.warm_buckets(ctx_lo=ctx_lo, ctx_hi=ctx_hi, full=False)
+    if eng._bucketed_attn:
+        lo = eng.bucket_for(min(ctx_lo + chunk, eng.max_seq))
+        hi = eng.bucket_for(min(ctx_hi, eng.max_seq))
+        spec_buckets = [b for b in eng._buckets if lo <= b <= hi] or [hi]
+    else:
+        spec_buckets = [eng.max_seq]
+    for b in spec_buckets:
+        eng._spec_exec(k, b)
+    # record the true greedy continuation — the accept_all draft oracle —
     # and time the plain decode_n baseline on the same work
-    calls = max(1, steps // chunk)
-    eng.decode_n()                      # warm the chunk program
+    eng.decode_n()                      # first-dispatch runtime setup
     t0 = time.perf_counter()
     recs = [eng.decode_n() for _ in range(calls)]
     base_dt = time.perf_counter() - t0
-    n_steps = calls * chunk
     base_tok_s = n_steps * slots / base_dt
     # continuation per slot, starting right after the warm chunk
     cont = np.concatenate(recs, axis=0).T          # [B, n_steps]
 
-    k = spec_k
-    exp_steps = (n_steps // (k + 1)) * (k + 1)
-
-    def run_spec(draft_fn, label):
+    def run_spec(make_arm, label):
         for s in range(slots):
             eng.release(s)
-        admit_all()
-        eng.decode_n()                  # same warm chunk → positions align
+        first = admit_all()
+        warm = eng.decode_n()           # same warm chunk → positions align
+        draft_fn, feed = make_arm(first, warm)
         pos = np.zeros(slots, np.int64)
-        # warm the spec program on a throwaway dispatch, then rewind by
-        # re-admitting (compile must not land in the timing)
-        eng.decode_spec(draft_fn(pos))
-        for s in range(slots):
-            eng.release(s)
-        admit_all()
-        eng.decode_n()
-        pos = np.zeros(slots, np.int64)
-        dispatches = 0
+        drafted_tot = accepted_tot = dispatches = 0
         t0 = time.perf_counter()
-        while pos.min() < exp_steps and dispatches < 4 * n_steps:
-            toks = eng.decode_spec(draft_fn(pos))
-            pos = pos + (toks < cfg.vocab_size).sum(axis=1)
+        while pos.min() < n_steps and dispatches < 4 * n_steps:
+            drafts, drafted = draft_fn(pos)
+            h = eng.decode_n_launch(drafts=drafts)
+            toks = h.wait()                        # [k+1, B]
+            rollback = np.maximum(h.budgets - h.accepted, 0)
+            if rollback.any():
+                eng.spec_ack(rollback)
+            emit = h.accepted.astype(np.int64)     # tokens emitted/slot
+            pos += emit
+            drafted_tot += int(drafted.sum())
+            accepted_tot += int(np.minimum(np.maximum(emit - 1, 0),
+                                           drafted).sum())
+            if feed is not None:
+                feed(toks)
             dispatches += 1
         dt = time.perf_counter() - t0
         emitted = int(pos.sum())
@@ -630,46 +670,78 @@ def measure_spec(jax, *, model: str, dtype: str, slots: int, steps: int,
                "dispatches": dispatches,
                "ms_per_dispatch": round(dt / max(dispatches, 1) * 1e3, 2),
                "tokens_per_dispatch": round(emitted / max(dispatches, 1),
-                                            2)}
+                                            2),
+               "acceptance_rate": round(accepted_tot / drafted_tot, 4)
+               if drafted_tot else 0.0}
         log(f"bench: spec {label}: {json.dumps(rec)}")
         return rec
 
-    def true_drafts(pos):
-        d = np.zeros((slots, k), np.int32)
-        for b in range(slots):
-            p = int(pos[b])
-            seg = cont[b, p:p + k]
-            d[b, :len(seg)] = seg
-        return d
+    def lookup_arm(first, warm):
+        # per-slot incremental bigram index over prompt + emitted stream,
+        # exactly what Scheduler._lookup_draft maintains per request
+        hists = [list(map(int, prompts[s])) + [first[s]]
+                 + [int(t) for t in warm[:, s]] for s in range(slots)]
+        idxs = [{} for _ in range(slots)]
+        upto = [0] * slots
 
-    def junk_drafts(pos):
-        return np.full((slots, k), cfg.vocab_size - 1, np.int32)
+        def draft_fn(pos):
+            d = np.zeros((slots, k), np.int32)
+            dr = np.zeros(slots, np.int32)
+            for b in range(slots):
+                prop, upto[b] = drafter.propose(hists[b], idxs[b],
+                                                upto[b], k)
+                if prop:
+                    d[b, :len(prop)] = prop
+                    dr[b] = len(prop)
+            return d, dr
 
-    best = run_spec(true_drafts, "accept_all")
-    worst = run_spec(junk_drafts, "reject_all")
-    # A decode_n dispatch advances `chunk` steps; a decode_spec dispatch
-    # advances at most k+1.  Comparing wall-time per dispatch separates
-    # "the spec program itself is slow" from "the model forward dominates":
-    # when even accept-all drafts cost >=2x the baseline dispatch, a low
-    # speedup_ceiling is dispatch overhead, not verification compute.
+        def feed(toks):
+            for b in range(slots):
+                hists[b] += [int(t) for t in toks[:, b]
+                             if int(t) < cfg.vocab_size]
+        return draft_fn, feed
+
+    def oracle_arm(first, warm):
+        def draft_fn(pos):
+            d = np.zeros((slots, k), np.int32)
+            for b in range(slots):
+                seg = cont[b, int(pos[b]):int(pos[b]) + k]
+                d[b, :len(seg)] = seg
+            return d, np.full(slots, k, np.int32)
+        return draft_fn, None
+
+    def junk_arm(first, warm):
+        def draft_fn(pos):
+            return (np.full((slots, k), cfg.vocab_size - 1, np.int32),
+                    np.full(slots, k, np.int32))
+        return draft_fn, None
+
+    lookup = run_spec(lookup_arm, "lookup")
+    best = run_spec(oracle_arm, "accept_all")
+    worst = run_spec(junk_arm, "reject_all")
     base_ms_per_dispatch = round(base_dt / calls * 1e3, 2)
-    dispatch_overhead = round(
-        best["ms_per_dispatch"] / max(base_ms_per_dispatch, 1e-9), 3)
+    dispatch_ratio = round(
+        lookup["ms_per_dispatch"] / max(base_ms_per_dispatch, 1e-9), 3)
     rec = {
         "model": model,
-        "mode": f"spec_decode_k{k}",
-        "tok_s": best["tok_s"],                  # headline: the ceiling
+        "mode": f"spec_fused_k{k}",
+        "tok_s": lookup["tok_s"],          # headline: the REAL drafter
         "baseline_tok_s": round(base_tok_s, 2),
         "baseline_ms_per_dispatch": base_ms_per_dispatch,
+        "lookup": lookup,
         "accept_all": best,
         "reject_all": worst,
+        "spec_acceptance": lookup["acceptance_rate"],
+        "speedup": round(lookup["tok_s"] / base_tok_s, 3),
         "speedup_ceiling": round(best["tok_s"] / base_tok_s, 3),
         "overhead_floor": round(worst["tok_s"] / base_tok_s, 3),
-        "dispatch_overhead": dispatch_overhead,
-        "ceiling_cause": ("spec_dispatch_overhead"
-                          if dispatch_overhead >= 2.0 else "model_compute"),
+        # per-dispatch: a spec verify (ONE forward over k+1 positions)
+        # vs a chunk dispatch (`chunk` sequential forwards) — must stay
+        # near or below 1.0; >= 2.0 means launch overhead, not compute
+        "dispatch_ratio": dispatch_ratio,
         "slots": slots, "steps": n_steps, "dtype": dtype,
-        "decode_chunk": chunk,
+        "decode_chunk": chunk, "spec_k": k,
+        "prompt_len": prompt_len,
     }
     if env:
         rec["env"] = dict(env)
@@ -1381,6 +1453,16 @@ def main() -> None:
             # radix prefix cache A/B (shared-system-prompt fan-out,
             # cache on vs TPU_PREFIX_CACHE=0) through the real scheduler
             plan.append({**smoke, "prefix_arm": True})
+        if os.environ.get("BENCH_SPEC_ARM", "") == "1":
+            # fused prompt-lookup speculation (ISSUE 6): lookup /
+            # accept_all / reject_all sub-arms on a repetition-heavy
+            # workload vs the chunked-decode baseline — the summary's
+            # spec_* ratios gate per-dispatch cost and tok/s speedup.
+            # The arm needs enough steps that the drafter's warm-up miss
+            # phase (before the greedy stream settles into its loop)
+            # amortises — short runs under-report the steady-state win.
+            plan.append({**smoke, "spec": True,
+                         "steps": max(96, envi("BENCH_STEPS", 32))})
     else:
         # the full TPU suite, deadline-ordered so a cut run still records
         # the strongest evidence (VERDICT r4 #1/#2): the round-comparable
@@ -1577,6 +1659,16 @@ def assemble(captures: list, platform: str, n_devices: int) -> str:
         if c.get("mode") == "prefix":
             paged_async_ttft_ratio = c.get("paged_async_ttft_ratio")
             break
+    # fused prompt-lookup speculation (ISSUE 6 acceptance: the REAL
+    # lookup arm's per-dispatch cost <= 1.2x a baseline chunk dispatch,
+    # tok/s speedup > 1 on the repetition-heavy workload)
+    spec_tok_s_ratio = spec_dispatch_ratio = spec_acceptance = None
+    for c in captures:
+        if str(c.get("mode", "")).startswith("spec_fused"):
+            spec_tok_s_ratio = c.get("speedup")
+            spec_dispatch_ratio = c.get("dispatch_ratio")
+            spec_acceptance = c.get("spec_acceptance")
+            break
     return json.dumps({
         "metric": metric,
         "value": head["tok_s"],
@@ -1597,6 +1689,9 @@ def assemble(captures: list, platform: str, n_devices: int) -> str:
         "prefix_ttft_ratio": prefix_ttft_ratio,
         "paged_async_itl_ratio": paged_async_itl_ratio,
         "paged_async_ttft_ratio": paged_async_ttft_ratio,
+        "spec_tok_s_ratio": spec_tok_s_ratio,
+        "spec_dispatch_ratio": spec_dispatch_ratio,
+        "spec_acceptance": spec_acceptance,
         "slots": head["slots"],
         "platform": platform,
         "dtype": head["dtype"],

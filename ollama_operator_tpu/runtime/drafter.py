@@ -1,0 +1,68 @@
+"""Host-side prompt-lookup drafter for fused speculative decoding.
+
+Prompt-lookup / n-gram drafting (llama.cpp lookup decoding, Saxena 2023):
+the candidate continuation for a slot is the run of tokens that followed
+the most recent earlier occurrence of the context's final n-gram, taken
+from the slot's OWN prompt + generated history. No draft model, no extra
+HBM — repetition-heavy streams (code, JSON, summarisation, the loops
+greedy decoding itself falls into) accept long runs, and a miss costs
+nothing but the proposal loop.
+
+The n-gram → continuation-position index is maintained incrementally by
+the caller (one dict per request), so proposing after a dispatch costs
+O(new tokens + k), not O(context). The index maps each n-gram to its
+LATEST occurrence, matching the recency bias of the generated stream.
+
+Drafts are verified device-side against the model's own argmax
+(``ops/sampling.spec_accept`` inside the engine's fused spec program), so
+draft QUALITY only affects speed, never output content — a wrong draft
+is rejected by the same comparison that makes a right one free.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+NGRAM = 2      # bigram keys: cheapest index with useful recall
+
+
+def extend_index(idx: Dict[Tuple[int, ...], int], hist: Sequence[int],
+                 indexed_upto: int, ngram: int = NGRAM) -> int:
+    """Fold ``hist[indexed_upto:]`` into the n-gram index and return the
+    new high-water mark. The key for continuation position ``i`` is the
+    n-gram ENDING at ``i - 1``, so the context's own final n-gram (whose
+    continuation would sit past the end) is structurally unindexable —
+    every gram with an in-range continuation is fair game, including the
+    one ending at the second-to-last position (a period-1 loop like
+    ``... x x x`` matches through exactly that entry)."""
+    upto = len(hist)
+    for i in range(max(indexed_upto, ngram), upto):
+        idx[tuple(int(t) for t in hist[i - ngram: i])] = i
+    return max(indexed_upto, upto)
+
+
+def propose(hist: Sequence[int], idx: Dict[Tuple[int, ...], int],
+            indexed_upto: int, k: int,
+            ngram: int = NGRAM) -> Tuple[Optional[List[int]], int]:
+    """Draft up to ``k`` tokens continuing ``hist``, or None when the
+    final n-gram has no earlier occurrence. Returns (draft, new
+    indexed_upto); the caller stores the high-water mark back so the
+    next call only indexes tokens appended since."""
+    indexed_upto = extend_index(idx, hist, indexed_upto, ngram)
+    if len(hist) < ngram + 1:
+        return None, indexed_upto
+    key = tuple(int(t) for t in hist[-ngram:])
+    pos = idx.get(key)
+    if pos is None:
+        return None, indexed_upto
+    draft = [int(t) for t in hist[pos: pos + k]]
+    if draft and len(draft) < k:
+        # the matched continuation runs off the end of hist, which means
+        # the tail repeats with period len(hist) - pos — keep unrolling
+        # the loop instead of proposing a truncated draft (greedy
+        # streams stuck in short cycles then accept all k every
+        # dispatch; a wrong guess still costs nothing but the slack)
+        period = len(hist) - pos
+        while len(draft) < k:
+            draft.append(int(hist[pos + len(draft) % period]))
+    return (draft or None), indexed_upto

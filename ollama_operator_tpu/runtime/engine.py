@@ -319,22 +319,46 @@ class DecodeHandle:
     ``decode_n_launch(retire=...)`` to unfence pages quarantined up to
     it — wait() itself must NOT retire, because multi-host followers
     replay launches without ever waiting and the free-list order has to
-    stay bit-identical across hosts (runtime/paged.py docstring)."""
+    stay bit-identical across hosts (runtime/paged.py docstring).
 
-    __slots__ = ("_engine", "_toks", "_t0", "_out", "epoch")
+    A speculative launch (``decode_n_launch(drafts=...)``) additionally
+    sets ``budgets`` — the per-slot host-length advance taken at launch,
+    an upper bound since accept counts are still device-side futures —
+    and wait() fills ``accepted`` (tokens actually emitted per slot) and
+    returns rows transposed to [k+1, B] so fan-out sees the same
+    row-major layout as a chunked dispatch. The caller acks the
+    overshoot back with ``Engine.spec_ack(budgets - accepted)``; the ack
+    rides the broadcast call stream, which is what lets followers (who
+    never wait) keep bit-identical host lengths."""
 
-    def __init__(self, engine: "Engine", toks, t0: float, epoch: int = 0):
+    __slots__ = ("_engine", "_toks", "_t0", "_out", "epoch", "budgets",
+                 "accepted")
+
+    def __init__(self, engine: "Engine", toks, t0: float, epoch: int = 0,
+                 budgets: Optional[np.ndarray] = None):
         self._engine = engine
         self._toks = toks
         self._t0 = t0
         self._out: Optional[np.ndarray] = None
         self.epoch = epoch
+        self.budgets = budgets
+        self.accepted: Optional[np.ndarray] = None
 
     def wait(self) -> np.ndarray:
         if self._out is None:
-            self._out = self._engine._fetch(self._toks)
-            self._engine.dispatch_ms["decode"] = (
-                (time.perf_counter() - self._t0) * 1e3)
+            toks = self._engine._fetch(self._toks)
+            if self.budgets is not None:
+                # [B, k+1] sentinel-padded: valid entries per row are the
+                # accepted draft prefix + bonus token, in order
+                self.accepted = (
+                    toks < self._engine.cfg.vocab_size).sum(axis=1)
+                toks = toks.T
+                self._engine.dispatch_ms["spec"] = (
+                    (time.perf_counter() - self._t0) * 1e3)
+            else:
+                self._engine.dispatch_ms["decode"] = (
+                    (time.perf_counter() - self._t0) * 1e3)
+            self._out = toks
             self._toks = None
         return self._out
 
@@ -563,7 +587,14 @@ class Engine:
         self._host_lengths = np.zeros((B,), np.int64)
         # last observed wall-clock per dispatch kind (launch→tokens-on-
         # host), exported as gauges — gives dispatch-dominated regressions
-        # (e.g. the BENCH_r05 623ms/spec-dispatch anomaly) a number
+        # a number. The BENCH_r05 623ms/spec-dispatch anomaly was exactly
+        # this gauge catching mid-serving XLA compiles: spec executables
+        # were only warmed for one attention bucket, so every bucket
+        # crossing recompiled inside a timed dispatch. warm_buckets now
+        # compiles every (k, bucket) spec program AND pre-seeds
+        # dispatch_ms["spec"] from a no-op dispatch over the empty batch,
+        # so the first real request pays neither compile nor first-run
+        # setup.
         self.dispatch_ms = {"decode": 0.0, "admit": 0.0, "extend": 0.0,
                             "spec": 0.0}
 
@@ -955,10 +986,7 @@ class Engine:
                     params, tokens=tokens_in, k_cache=k_cache,
                     v_cache=v_cache, lengths=lengths, **kw)
             greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            match = (drafts == greedy[:, :-1]).astype(jnp.int32)
-            n_acc = jnp.sum(jnp.cumprod(match, axis=1), axis=1)
             ok = (active == 1) & (is_greedy == 1)
-            n_acc = jnp.where(ok, n_acc, 0)
             bi = jnp.arange(B)
             step_keys = jax.vmap(jax.random.fold_in)(keys, lengths)
             l0 = logits[:, 0]
@@ -970,12 +998,11 @@ class Engine:
             # greedy (accepting) slots never run mirostat; only the
             # sampled path's slots absorb the surprise update
             mu = jnp.where((active == 1) & ~ok, mu_new, mu)
-            bonus = jnp.where(ok, greedy[bi, n_acc], sampled0)
-            t_idx = jnp.arange(kk + 1, dtype=jnp.int32)[None, :]
-            dpad = jnp.concatenate(
-                [drafts, jnp.zeros((B, 1), jnp.int32)], axis=1)
-            out = jnp.where(t_idx < n_acc[:, None], dpad, jnp.int32(V))
-            out = out.at[bi, n_acc].set(bonus)
+            # vectorized accept/rollback (ops/sampling.spec_accept):
+            # accepted draft prefix + bonus token per row, sentinel
+            # padding at and beyond the first mismatch
+            n_acc, out = sampling.spec_accept(drafts, greedy, ok,
+                                              sampled0, V)
             out = jnp.where((active == 1)[:, None], out, jnp.int32(V))
 
             def push(carry, t):
@@ -1874,8 +1901,25 @@ class Engine:
                 and not (self.paged and self._paged_dp > 1)):
             # speculative verify programs per attention bucket — a bucket
             # crossing must swap programs, never recompile mid-serving
+            # (the BENCH_r05 623ms/spec-dispatch anomaly was exactly this
+            # warm missing: one warmed bucket, compiles on every cross)
             for b in buckets:
                 self._spec_exec(spec_k, b)
+            if not self.active.any():
+                # pre-seed dispatch_ms["spec"] from a no-op dispatch
+                # over the empty batch (every slot inactive → the push
+                # scan advances nothing and inactive-slot KV writes land
+                # above/outside attended lengths): the gauge starts at
+                # steady-state launch cost instead of 0, and the first
+                # REAL spec dispatch pays neither compile nor first-run
+                # executable setup. Bypasses decode_n_launch so warm
+                # never consumes an armed engine.step fault.
+                h = self._spec_launch(
+                    np.zeros((self.n_slots, spec_k), np.int32), None,
+                    time.perf_counter())
+                h.wait()
+                if self.paged:
+                    self._pt.retire_epoch(h.epoch)
         if self.supports_extend:
             # (tail, attended) bucket pairs; the max_seq tail bucket is
             # unreachable (extend requires start >= 1 and start + bucket
@@ -2104,12 +2148,26 @@ class Engine:
         return toks
 
     def decode_n_launch(self, n: Optional[int] = None,
-                        retire: Optional[int] = None) -> DecodeHandle:
-        """Launch one chunked decode dispatch WITHOUT materialising its
-        tokens: slot state (host lengths included) advances immediately;
-        the returned handle's wait() fetches [n, B]. Double-buffering
+                        retire: Optional[int] = None,
+                        drafts: Optional[np.ndarray] = None
+                        ) -> DecodeHandle:
+        """Launch one decode dispatch WITHOUT materialising its tokens:
+        slot state (host lengths included) advances immediately; the
+        returned handle's wait() fetches [n, B]. Double-buffering
         callers launch dispatch N+1 before waiting on N so fan-out work
         overlaps device compute (see DecodeHandle).
+
+        ``drafts`` [B, k] switches the dispatch to the fused speculative
+        draft+verify program (prompt-lookup decoding): ONE dispatch
+        scores k+1 positions per slot, greedy-accepts each eligible
+        slot's longest matching draft prefix plus a bonus token, and
+        advances every other slot exactly one decode-identical token —
+        rejection costs a sentinel mask and a host-length rollback
+        (``spec_ack``), never a second dispatch or a KV copy. wait()
+        then returns [k+1, B] sentinel-padded rows and fills the
+        handle's ``accepted`` counts. Zeros are fine for slots with
+        nothing to propose; this is the ONLY speculative entry point
+        (the standalone decode_spec surface is gone).
 
         Paged mode: each successful launch advances the page-table
         dispatch epoch; ``retire`` (the ``.epoch`` of the newest handle
@@ -2117,9 +2175,15 @@ class Engine:
         quarantined at or before that epoch, making them allocatable for
         this very launch. The kwarg rides the multi-host mirror
         broadcast, so followers retire at the identical call-stream
-        position without ever waiting on a handle themselves."""
+        position without ever waiting on a handle themselves.
+        Speculative launches need no extra fence states: draft tokens
+        write into pages already mapped by prepare_decode, and the
+        accept mask only moves ``lengths``."""
         FAULTS.check("engine.step")
         t0 = time.perf_counter()
+        if drafts is not None:
+            return self._spec_launch(np.asarray(drafts, np.int32),
+                                     retire, t0)
         n = n or self.ecfg.decode_chunk
         if self.paged and retire is not None:
             self._pt.retire_epoch(retire)
@@ -2160,54 +2224,85 @@ class Engine:
             self._spec_execs[key] = exe
         return exe
 
-    def decode_spec(self, drafts: np.ndarray) -> np.ndarray:
-        """Speculative verify step (prompt-lookup decoding): ``drafts``
-        [B, k] int32 are candidate continuations per slot (zeros are fine
-        for slots with nothing to propose). ONE dispatch verifies all
-        drafts and emits, per slot, its accepted prefix plus one model
-        token — up to k+1 tokens for a greedy slot, exactly 1 otherwise
-        (non-greedy slots sample their token identically to decode()).
-        Returns [B, k+1] with vocab_size sentinel padding; row b's valid
-        tokens are the entries < vocab_size, in order."""
+    def _spec_flags(self) -> np.ndarray:
+        """Per-slot eligibility for exact speculative acceptance:
+        acceptance compares raw argmax, so it is exact ONLY for active,
+        unconstrained, greedy slots with neutral penalties (sample()
+        would otherwise adjust logits by the evolving counts); everyone
+        else takes the single-token sampled path inside the same
+        dispatch. Derived from host-mirrored slot state alone, so every
+        host computes identical flags at the same call-stream
+        position."""
+        flags = np.zeros((self.n_slots,), np.int32)
+        for s in range(self.n_slots):
+            if not self.active[s] or self._constrained[s]:
+                continue
+            o = self._opts.get(s, SlotOptions())
+            if (o.temperature <= 0.0 and o.repeat_penalty == 1.0
+                    and o.presence_penalty == 0.0
+                    and o.frequency_penalty == 0.0):
+                flags[s] = 1
+        return flags
+
+    def _spec_launch(self, drafts: np.ndarray, retire: Optional[int],
+                     t0: float) -> DecodeHandle:
+        """Fused speculative dispatch body (see decode_n_launch).
+
+        Host lengths advance by each slot's UPPER BOUND (k+1 for
+        eligible slots, 1 for the rest) at launch — the accept counts
+        are still device-side futures, and followers replay launches
+        without waiting, so the advance must be deterministic from the
+        call args alone. Over-estimation is safe everywhere host
+        lengths are read (attention buckets grow monotonically with
+        them; prepare_decode maps at most one page early); the caller
+        reconciles to the exact value by passing the waited handle's
+        overshoot back through ``spec_ack``, which rides the broadcast
+        stream like ``retire`` does."""
         assert self.sp_size == 1, \
             "speculative decode: bucketed caches only (no sp meshes)"
         assert not (self.paged and self._paged_dp > 1), \
             "speculative decode: the paged dp-manual region is T=1 only"
-        t0 = time.perf_counter()
         k = int(drafts.shape[1])
         assert k >= 1, "need at least one draft column"
         n = k + 1
+        if self.paged and retire is not None:
+            self._pt.retire_epoch(retire)
         victims = self.prepare_decode(n)
         if victims:
             from .paged import PagesExhausted
             raise PagesExhausted(f"pool dry; victims {victims}")
-        attn = self._attn_bucket(n)
-        # acceptance compares raw argmax, so it is exact ONLY for greedy
-        # slots with neutral penalties (sample() would otherwise adjust
-        # logits by the evolving counts); everything else takes the
-        # single-token path inside the same dispatch
-        def _spec_ok(o: SlotOptions) -> bool:
-            return (o.temperature <= 0.0 and o.repeat_penalty == 1.0
-                    and o.presence_penalty == 0.0
-                    and o.frequency_penalty == 0.0)
-        is_greedy = np.array(
-            [1 if (self.active[s] and not self._constrained[s]
-                   and _spec_ok(self._opts.get(s, SlotOptions())))
-             else 0 for s in range(self.n_slots)], np.int32)
-        exe = self._spec_exec(k, attn)
+        flags = self._spec_flags()
+        exe = self._spec_exec(k, self._attn_bucket(n))
         (toks, self.k_cache, self.v_cache, self.lengths, self.counts,
          self.last_tokens, self.pring, self.mu, self.keys) = exe(
             self.params, self.k_cache, self.v_cache, self.lengths,
             self.counts, self.last_tokens, self.pring, self.mu, self.sp,
             self.keys, self._active_dev, self.mask_bits, self._constr_dev,
-            self._rln_dev, self._g(is_greedy, self._slot_sh),
-            self._g(np.asarray(drafts, np.int32), self._slot_sh2),
-            self._tables_dev())
-        toks = self._fetch(toks)
-        n_out = (toks < self.cfg.vocab_size).sum(axis=1)
-        self._host_lengths[self.active] += n_out[self.active]
-        self.dispatch_ms["spec"] = (time.perf_counter() - t0) * 1e3
-        return toks
+            self._rln_dev, self._g(flags, self._slot_sh),
+            self._g(drafts, self._slot_sh2), self._tables_dev())
+        # inactive slots get budget 0, not 1: they neither advance at
+        # launch nor emit, so their rollback is exactly zero — a slot
+        # that goes inactive AND is re-admitted between launch and ack
+        # must never absorb the old occupant's overshoot
+        budgets = np.where(self.active,
+                           np.where(flags == 1, n, 1), 0).astype(np.int32)
+        self._host_lengths[self.active] += budgets[self.active]
+        epoch = self._pt.advance_epoch() if self.paged else 0
+        return DecodeHandle(self, toks, t0, epoch, budgets=budgets)
+
+    def spec_ack(self, rollback: np.ndarray) -> None:
+        """Reconcile host lengths after a speculative dispatch
+        materialises: subtract the per-slot overshoot (launch budget
+        minus tokens actually emitted — the rejected draft tail). Called
+        by the scheduler right after wait() and BEFORE any release/admit
+        can reuse a slot; MIRRORED, so followers roll back at the same
+        call-stream position without ever waiting themselves. Slots
+        released since launch are masked out (their lengths were already
+        reset), and the clamp keeps a stale ack from ever driving a
+        length negative."""
+        rb = np.asarray(rollback, np.int64)
+        rb = np.minimum(np.where(self.active, rb, 0), self._host_lengths)
+        self._host_lengths -= rb
 
     def step_budgets(self, n: int) -> np.ndarray:
         """Per-slot decode-step budget for a chunk of ``n``: constrained

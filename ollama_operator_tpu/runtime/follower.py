@@ -183,7 +183,14 @@ class MirroredEngine:
     state (page tables). Everything else delegates transparently."""
 
     MIRRORED = ("admit", "admit_many", "extend", "decode", "decode_n",
-                "decode_n_launch", "decode_spec", "release", "set_mask",
+                # decode_n_launch is the ONE decode dispatch surface —
+                # its drafts= kwarg covers fused speculative dispatches
+                # (the standalone decode_spec op is gone); spec_ack
+                # reconciles speculative host-length overshoot at the
+                # exact call-stream position the leader waited, so
+                # followers never need to wait a handle to stay
+                # bit-identical
+                "decode_n_launch", "spec_ack", "release", "set_mask",
                 "clear_mask", "warm_buckets", "free_slot_pages",
                 "prepare_decode",
                 # radix prefix cache: stitching/donation/eviction mutate

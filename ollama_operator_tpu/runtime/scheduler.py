@@ -27,6 +27,7 @@ from typing import Iterator, List, Optional, Sequence
 import numpy as np
 
 from ..server.metrics import GLOBAL as METRICS
+from . import drafter
 from .engine import Engine, SlotOptions
 from .errors import BadRequest, DeadlineExceeded
 from .paged import PagesExhausted
@@ -193,28 +194,29 @@ class Scheduler:
             else float(os.environ.get("TPU_ENGINE_RESTART_BACKOFF_S",
                                       "0.05")))
         self.n_restarts = 0
-        # speculative decoding (prompt-lookup, engine.decode_spec): draft
-        # up to k tokens per greedy penalty-free slot from n-gram matches
-        # in its own context. Opt-in (TPU_SPEC_DECODE=k), and the r4
-        # envelope capture is why it STAYS opt-in: on the remote-dispatch
-        # v5e even the accept-ALL ceiling measured 0.023x the chunked
-        # decode_n baseline (823 ms per spec dispatch vs 32 tokens per
-        # chunk dispatch — BASELINE.md r4). It can only win where
-        # dispatch is near-free (colocated host) AND per-token streaming
-        # latency matters more than throughput.
-        import os as _os
-        self.spec_k = int(_os.environ.get("TPU_SPEC_DECODE", "0") or "0")
-        if self.spec_k > 0:
-            # EXPERIMENTAL, and say so at enable time: no measured
-            # deployment currently benefits (the ceiling is 0.023x under
-            # remote dispatch); the knob exists for colocated-host setups
-            # to measure their own envelope
-            import sys as _sys
-            print(f"warning: TPU_SPEC_DECODE={self.spec_k} is "
-                  f"EXPERIMENTAL — the measured accept-all CEILING under "
-                  f"remote dispatch is 0.023x chunked decode (BASELINE.md "
-                  f"r4); enable only on colocated hosts after measuring "
-                  f"bench.py's spec envelope there", file=_sys.stderr)
+        # fused prompt-lookup speculative decoding (TPU_SPEC_DECODE=k):
+        # draft up to k tokens PER SLOT from bigram matches in that
+        # slot's own prompt+generated history (runtime/drafter.py); ONE
+        # bucketed dispatch (engine.decode_n_launch(drafts=...)) then
+        # verifies every draft and advances every slot — greedy
+        # penalty-free slots accept their matching prefix + a bonus
+        # token, everyone else steps exactly one decode-identical token
+        # inside the same program. Rejection costs a sentinel mask and a
+        # host-length ack (engine.spec_ack), never a second dispatch,
+        # and the path double-buffers like dense/paged decode — no
+        # cause="spec" sync fallback remains. The old standalone
+        # decode_spec surface (623 ms/dispatch in BENCH_r05, compiling
+        # per bucket crossing mid-request) is gone; its anomaly is now a
+        # warm-pass concern (engine.warm_buckets pre-compiles every
+        # (k, bucket) spec program). Opt-in: acceptance is workload-
+        # dependent — watch the spec block in /api/ps and keep it
+        # enabled only when the acceptance rate holds (docs give
+        # guidance).
+        self.spec_k = int(os.environ.get("TPU_SPEC_DECODE", "0") or "0")
+        # drafted/accepted running totals back the /api/ps acceptance-
+        # rate block (counters also exported via metrics)
+        self.spec_drafted = 0
+        self.spec_accepted = 0
         # stall-free chunked prefill (Sarathi-style): prompts longer than
         # one piece admit bucket-by-bucket through Engine.extend, one
         # piece per scheduler step, so the worst-case stall a DECODING
@@ -229,12 +231,14 @@ class Scheduler:
             if prefill_chunk and engine.supports_extend else 0)
         # double-buffered async dispatch: launch decode dispatch N+1
         # before materialising N's tokens, so host fan-out/detokenise
-        # overlaps device compute (JAX async dispatch). Grammar and
-        # spec-decode need host work between dispatches and fall back to
-        # sync per-dispatch. Paged mode double-buffers too: the page
-        # table's epoch fence quarantines freed pages until the dispatch
-        # that captured their block table materialises, so recycling can
-        # never corrupt an in-flight program's reads (runtime/paged.py).
+        # overlaps device compute (JAX async dispatch). Grammar is the
+        # ONE remaining sync fallback (a fresh host PDA mask per token);
+        # fused speculation double-buffers with its stages reordered —
+        # see the spec branch in _step. Paged mode double-buffers too:
+        # the page table's epoch fence quarantines freed pages until
+        # the dispatch that captured their block table materialises, so
+        # recycling can never corrupt an in-flight program's reads
+        # (runtime/paged.py).
         # Only dp-sharded paged (ShardedPageTable) stays synchronous:
         # per-shard pools make the pressure-relief stall path ambiguous
         # about WHICH shard's fence to drain, and no measured deployment
@@ -256,8 +260,10 @@ class Scheduler:
         # is engine-inactive between pieces; without this map
         # free_slots() would hand it to someone else)
         self._prefilling: dict = {}
-        # (DecodeHandle, {slot: request-at-launch}) of the in-flight
-        # decode dispatch, when double-buffering
+        # (DecodeHandle, {slot: request-at-launch}, per-slot drafted
+        # counts or None) of the in-flight decode dispatch, when
+        # double-buffering — drafted counts feed the acceptance metrics
+        # when the handle materialises
         self._pending = None
         self._waiting: queue.Queue = queue.Queue(maxsize=max_queue)
         # preempted requests (paged pool pressure) re-admit before the
@@ -1019,17 +1025,24 @@ class Scheduler:
                     self.finished.append(req.stats)
                 req.out.put(("error", req.error))
 
-    def _build_drafts(self, k: int):
-        """Prompt-lookup drafts [B, k] (zeros where nothing to propose),
-        or None when no eligible slot found an n-gram match — the loop
-        then takes the normal chunked path. Only greedy penalty-free
-        unconstrained slots draft (engine acceptance is exact there)."""
+    def _build_drafts(self, k: int, tails: Optional[dict] = None):
+        """Prompt-lookup drafts [B, k] (zero-padded past each slot's
+        proposal) plus per-slot drafted counts [B], or (None, None) when
+        no eligible slot found an n-gram match — the loop then takes the
+        normal chunked path. Per-slot: only greedy penalty-free
+        unconstrained slots draft (device acceptance is exact there);
+        every other active slot still advances one decode-identical
+        token inside the same fused dispatch, and an eligible slot with
+        no match drafts nothing and costs nothing. ``tails`` carries
+        tokens from a dispatch that has materialised but not yet fanned
+        out (async spec pipelining), so drafts always extend the slot's
+        true tip."""
         drafts = np.zeros((self.engine.n_slots, k), np.int32)
-        n_drafting = n_running = 0
+        drafted = np.zeros((self.engine.n_slots,), np.int32)
+        n_drafting = 0
         for slot, req in enumerate(self._running):
             if req is None or slot in self._prefilling:
                 continue
-            n_running += 1
             if req.constraint is not None:
                 continue
             o = req.opts
@@ -1037,38 +1050,91 @@ class Scheduler:
                     or o.presence_penalty != 0.0
                     or o.frequency_penalty != 0.0):
                 continue
-            d = self._lookup_draft(req, k)
+            extra = tails.get(slot) if tails else None
+            d = self._lookup_draft(req, k, extra=extra)
             if d:
                 drafts[slot, :len(d)] = d
+                drafted[slot] = len(d)
                 n_drafting += 1
-        # a spec dispatch caps every NON-drafting slot at 1 token (vs a
-        # full decode_chunk on the chunked path) — only worth it when at
-        # least half the batch is drafting
-        if n_drafting == 0 or n_drafting * 2 < n_running:
-            return None
-        return drafts
+        if n_drafting == 0:
+            return None, None
+        return drafts, drafted
 
     @staticmethod
-    def _lookup_draft(req: Request, k: int, ngram: int = 2):
-        """Latest earlier occurrence of the context's final bigram → the
-        k tokens that followed it (llama.cpp-style lookup decoding; no
-        draft model needed). The bigram→continuation-position index is
-        maintained incrementally on the request, so a step costs O(new
-        tokens + k), not O(context)."""
+    def _lookup_draft(req: Request, k: int, ngram: int = drafter.NGRAM,
+                      extra: Optional[Sequence[int]] = None):
+        """Latest earlier occurrence of the context's final n-gram → the
+        k tokens that followed it (runtime/drafter.py; llama.cpp-style
+        lookup decoding, no draft model needed). The n-gram →
+        continuation-position index is maintained incrementally on the
+        request, so a step costs O(new tokens + k), not O(context).
+        ``extra`` appends tokens a materialised-but-unfanned dispatch
+        already produced — the index positions it creates stay valid
+        because _fanout appends exactly those tokens to all_tokens."""
         hist = list(req.prompt_ids) + req.all_tokens
-        if len(hist) < ngram + 1:
-            return None
-        # index bigrams ENDING strictly before the final position (the
-        # final bigram itself must not match its own occurrence)
-        upto = len(hist) - 1
-        for i in range(max(req._indexed_upto, ngram), upto):
-            req._bigram_idx[(int(hist[i - 2]), int(hist[i - 1]))] = i
-        req._indexed_upto = max(req._indexed_upto, upto)
-        key = (int(hist[-2]), int(hist[-1]))
-        pos = req._bigram_idx.get(key)
-        if pos is None:
-            return None
-        return hist[pos: pos + k] or None
+        if extra:
+            hist += [int(t) for t in extra]
+        d, req._indexed_upto = drafter.propose(
+            hist, req._bigram_idx, req._indexed_upto, k, ngram=ngram)
+        return d
+
+    def _wait_handle(self, handle, snapshot=None,
+                     drafted=None) -> np.ndarray:
+        """Materialise a launched dispatch and reconcile host state: the
+        paged fence ack, and — for speculative dispatches — the
+        spec_ack rollback of the launch-time length over-advance
+        (budgets − accepted), broadcast so followers reconcile at the
+        identical call-stream position. The rollback is masked by
+        ``snapshot`` occupancy IDENTITY: a slot whose occupant finished
+        and was replaced between launch and wait must not have the old
+        occupant's overshoot subtracted from the new request's fresh
+        length (a parked/donated predecessor's length was already
+        reset or is repaired at reuse). Folds per-slot drafted/accepted
+        counts into the acceptance metrics."""
+        toks_n = handle.wait()
+        self._fence_ack = handle.epoch
+        self._consecutive_failures = 0
+        if handle.budgets is not None:
+            rollback = np.maximum(handle.budgets - handle.accepted, 0)
+            if snapshot is not None:
+                stable = np.zeros((self.engine.n_slots,), bool)
+                for s, r in snapshot.items():
+                    stable[s] = (self._running[s] is r
+                                 and s not in self._prefilling)
+                rollback = np.where(stable, rollback, 0)
+            if rollback.any():
+                self.engine.spec_ack(rollback)
+            if drafted is not None:
+                # a slot emits its accepted draft prefix + 1 bonus (or
+                # ordinary) token, so accepted drafts = emitted − 1;
+                # clamping by drafted keeps zero-pad columns that
+                # happened to match the argmax out of the rate
+                acc = np.minimum(
+                    np.maximum(handle.accepted - 1, 0), drafted)
+                d, a = int(drafted.sum()), int(acc.sum())
+                if d:
+                    self.spec_drafted += d
+                    self.spec_accepted += a
+                    METRICS.inc("tpu_model_spec_drafted_tokens_total",
+                                float(d))
+                    METRICS.inc("tpu_model_spec_accepted_tokens_total",
+                                float(a))
+        return toks_n
+
+    def _pending_tails(self, toks_n, snapshot: dict) -> dict:
+        """slot → token tail of a materialised-but-not-yet-fanned-out
+        dispatch, for drafting the NEXT dispatch before _fanout runs.
+        Only identity-stable slots count (same occupant, not back in
+        prefill); sentinel columns (spec padding past the accepted
+        prefix) are dropped."""
+        vocab = self.engine.cfg.vocab_size
+        tails: dict = {}
+        for slot, req in snapshot.items():
+            if self._running[slot] is not req or slot in self._prefilling:
+                continue
+            tails[slot] = [int(t) for t in np.asarray(toks_n)[:, slot]
+                           if int(t) < vocab]
+        return tails
 
     def _drain_pending(self):
         """Materialise and fan out the in-flight async dispatch, if any.
@@ -1076,11 +1142,9 @@ class Scheduler:
         state) the supervisor must error the owners, never re-deliver."""
         if self._pending is None:
             return
-        handle, snapshot = self._pending
+        handle, snapshot, drafted = self._pending
         self._pending = None
-        toks_n = handle.wait()
-        self._fence_ack = handle.epoch
-        self._consecutive_failures = 0
+        toks_n = self._wait_handle(handle, snapshot, drafted)
         self._fanout(toks_n, snapshot)
 
     def _decoding(self) -> dict:
@@ -1138,33 +1202,86 @@ class Scheduler:
                        and not (self.engine.paged
                                 and self.engine._paged_dp > 1)
                        and n_steps is None)
-        drafts = self._build_drafts(self.spec_k) if spec_usable else None
-        self._relieve_pressure(self.spec_k + 1 if drafts is not None
-                               else n_steps)
+        # drafts are built AFTER the in-flight dispatch lands (they must
+        # extend each slot's true tip), so pressure relief sizes for the
+        # worst case the coming dispatch could need: spec_k+1 mapped
+        # positions for a spec dispatch, decode_chunk for a chunked one
+        self._relieve_pressure(
+            max(self.engine.ecfg.decode_chunk, self.spec_k + 1)
+            if spec_usable else n_steps)
         decoding = self._decoding()
         if not decoding:
             self._drain_pending()
             return
         constrained = any(r.constraint is not None
                           for r in decoding.values())
-        if not (self.async_dispatch and drafts is None
-                and not constrained):
-            # synchronous path: grammar needs a fresh host mask between
-            # dispatches, spec verify reads host-built drafts — the
-            # pipeline must be empty before either dispatches. (In paged
-            # mode decode_n self-retires its epoch, so these dispatches
-            # also drain any quarantine the async stretch left behind.)
+        if not self.async_dispatch or constrained:
+            # synchronous path: grammar needs a fresh host PDA mask
+            # between dispatches, so the pipeline must be empty before
+            # this one dispatches. Fused speculation still works here —
+            # the spec program advances constrained slots exactly one
+            # (masked) token while drafting slots verify k+1. (In paged
+            # mode decode_n self-retires its epoch and the spec launch
+            # threads retire=, so sync dispatches also drain any
+            # quarantine the async stretch left behind.)
             if self.async_dispatch:
                 METRICS.inc("tpu_model_async_fallback_total", 1.0,
-                            '{cause="spec"}' if drafts is not None
-                            else '{cause="grammar"}')
+                            '{cause="grammar"}')
             self._drain_pending()
+            drafts = drafted = None
+            if spec_usable:
+                drafts, drafted = self._build_drafts(self.spec_k)
             if drafts is not None:
-                toks_n = self.engine.decode_spec(drafts).T  # [k+1, B]
+                handle = self.engine.decode_n_launch(
+                    retire=(self._fence_ack if self.engine.paged
+                            else None),
+                    drafts=drafts)
+                toks_n = self._wait_handle(handle, decoding,
+                                           drafted)         # [k+1, B]
             else:
                 toks_n = self.engine.decode_n(n_steps)
-            self._consecutive_failures = 0
+                self._consecutive_failures = 0
             self._fanout(toks_n, decoding)
+            return
+        if spec_usable:
+            # fused speculation double-buffers with the stages
+            # REORDERED: drafts for dispatch N+1 must extend dispatch
+            # N's tokens, so the loop waits N first (spec_ack
+            # reconciling the launch-time length over-advance), drafts
+            # from the just-landed tails, launches N+1, and only then
+            # fans N out — detokenise/queue host work still overlaps
+            # N+1's device compute, which is the half of
+            # double-buffering that pays. No cause="spec" sync fallback
+            # remains.
+            prev, self._pending = self._pending, None
+            toks_prev = tails = prev_snapshot = None
+            if prev is not None:
+                prev_handle, prev_snapshot, prev_drafted = prev
+                toks_prev = self._wait_handle(prev_handle, prev_snapshot,
+                                              prev_drafted)
+                tails = self._pending_tails(toks_prev, prev_snapshot)
+            drafts, drafted = self._build_drafts(self.spec_k, tails)
+            try:
+                if drafts is not None:
+                    handle = self.engine.decode_n_launch(
+                        retire=(self._fence_ack if self.engine.paged
+                                else None),
+                        drafts=drafts)
+                else:   # no slot found a match this round: full chunk
+                    handle = (self.engine.decode_n_launch(
+                                  retire=self._fence_ack)
+                              if self.engine.paged
+                              else self.engine.decode_n_launch())
+            except Exception:
+                # dispatch N's tokens were already materialised —
+                # deliver them before the supervisor errors whoever is
+                # left
+                if toks_prev is not None:
+                    self._fanout(toks_prev, prev_snapshot)
+                raise
+            self._pending = (handle, decoding, drafted)
+            if toks_prev is not None:
+                self._fanout(toks_prev, prev_snapshot)
             return
         # double-buffered async dispatch: launch dispatch N+1 FIRST,
         # then materialise and fan out dispatch N — detokenise/queue
@@ -1181,12 +1298,11 @@ class Scheduler:
             # before the supervisor errors whoever is left
             self._drain_pending()
             raise
-        prev, self._pending = self._pending, (handle, decoding)
+        prev, self._pending = self._pending, (handle, decoding, None)
         if prev is not None:
-            prev_handle, prev_snapshot = prev
-            toks_n = prev_handle.wait()
-            self._fence_ack = prev_handle.epoch
-            self._consecutive_failures = 0
+            prev_handle, prev_snapshot, prev_drafted = prev
+            toks_n = self._wait_handle(prev_handle, prev_snapshot,
+                                       prev_drafted)
             self._fanout(toks_n, prev_snapshot)
 
     def _fanout(self, toks_n, snapshot: dict):
@@ -1220,8 +1336,8 @@ class Scheduler:
                     continue  # frozen after its 1-token budget
                 tid = int(row[slot])
                 if tid >= self.engine.cfg.vocab_size:
-                    continue   # spec-step padding beyond the slot's
-                               # accepted prefix (engine.decode_spec)
+                    continue   # sentinel padding past the slot's
+                               # accepted prefix (fused spec verify)
                 # grammar check BEFORE emitting: a dead-end state (empty
                 # mask → uniform sampling over -inf logits) must not leak
                 # an illegal token into the client's JSON stream

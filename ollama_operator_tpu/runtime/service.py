@@ -686,6 +686,12 @@ class _IdleScheduler:
     n_preemptions = 0
     n_restarts = 0
     finished = ()      # reaper: no completed generations to re-arm from
+    # /api/ps reads these off every resident model's scheduler; an
+    # encoder has no decode loop, so they are permanently "off"
+    async_dispatch = False
+    spec_k = 0
+    spec_drafted = 0
+    spec_accepted = 0
 
     def shutdown(self):
         pass

@@ -174,9 +174,20 @@ GLOBAL.describe("tpu_model_radix_pages",
 GLOBAL.describe("tpu_model_async_fallback_total",
                 "Decode dispatches that fell back to synchronous while "
                 "TPU_ASYNC_DISPATCH was on: per-dispatch for grammar "
-                "(host PDA mask between dispatches) and spec (host-built "
-                "drafts), once at startup for paged_dp (dp-sharded page "
-                "pools stay sync); a silently-sync deployment shows here")
+                "(host PDA mask between dispatches), once at startup for "
+                "paged_dp (dp-sharded page pools stay sync); a "
+                "silently-sync deployment shows here. cause=\"spec\" is "
+                "retired — fused speculation double-buffers — and kept "
+                "pre-seeded at 0 to prove it stays that way")
+GLOBAL.describe("tpu_model_spec_drafted_tokens_total",
+                "Prompt-lookup draft tokens submitted to fused "
+                "speculative verification (TPU_SPEC_DECODE=k); divide "
+                "accepted by drafted for the acceptance rate")
+GLOBAL.describe("tpu_model_spec_accepted_tokens_total",
+                "Draft tokens accepted by speculative verification — "
+                "each one is an output token that skipped a decode "
+                "dispatch; accepted/drafted below ~0.3 means lookup "
+                "misses are paying dispatch overhead for nothing")
 # pre-seed the failure counters at 0: alert rules rate() over these, and
 # a series that first appears AT the first failure hides that failure
 # (the stall/chunk counters likewise: a mixed-load dashboard must read 0,
@@ -188,7 +199,9 @@ for _name in ("tpu_model_engine_restarts_total",
               "tpu_model_admission_stall_ms_total",
               "tpu_model_prefill_chunks_total",
               "tpu_model_prefix_hit_tokens_total",
-              "tpu_model_prefix_miss_tokens_total"):
+              "tpu_model_prefix_miss_tokens_total",
+              "tpu_model_spec_drafted_tokens_total",
+              "tpu_model_spec_accepted_tokens_total"):
     GLOBAL.inc(_name, 0.0)
 # the async-fallback counter is labelled, so pre-seed every cause — an
 # alert on rate(cause="grammar") must read 0, not absent, while async
