@@ -942,6 +942,7 @@ def measure_mixed(jax, *, model: str, dtype: str, slots: int, steps: int,
     import threading
 
     from ollama_operator_tpu.models.config import get_config
+    from ollama_operator_tpu.runtime import trace as trace_mod
     from ollama_operator_tpu.runtime.engine import (Engine, EngineConfig,
                                                     SlotOptions,
                                                     resolve_cache_dtype)
@@ -1013,7 +1014,13 @@ def measure_mixed(jax, *, model: str, dtype: str, slots: int, steps: int,
                    for _ in range(n_arr)]
     arr_gap_s = float(os.environ.get("BENCH_MIXED_GAP_S", "0.05"))
 
-    def run_arm(overlap: bool) -> dict:
+    def run_arm(overlap: bool, tracing: bool = True) -> dict:
+        # request-lifecycle tracing (runtime/trace.py) is on by default;
+        # the tracing=False arm flips the module switch so its Scheduler
+        # hands every request the shared NULL_TRACE — the A/B for the
+        # ≤2% tok/s overhead budget tracing must stay under
+        prev_tracing = trace_mod.TRACE_ENABLED
+        trace_mod.TRACE_ENABLED = tracing
         sched = Scheduler(eng, prefill_chunk=(piece_b if overlap else 0),
                           async_dispatch=overlap)
         try:
@@ -1129,6 +1136,7 @@ def measure_mixed(jax, *, model: str, dtype: str, slots: int, steps: int,
                 "arrival_errors": errors or None,
             }
         finally:
+            trace_mod.TRACE_ENABLED = prev_tracing
             sched.shutdown()
             for s in range(eng.n_slots):
                 try:
@@ -1138,6 +1146,24 @@ def measure_mixed(jax, *, model: str, dtype: str, slots: int, steps: int,
 
     on = run_arm(True)
     off = run_arm(False)
+    # tracing overhead arm: same overlap-on load with per-request span
+    # tracing disabled. bg tok/s with tracing on must stay within 2% of
+    # this — the budget the ISSUE-7 tracing layer was designed to (an
+    # event append is one GIL-atomic list.append per *chunk*, not per
+    # token). Set BENCH_ASSERT_TRACE_OVERHEAD=1 to hard-fail the run on
+    # a violation (smoke-scale CPU arms are too noisy to gate by
+    # default; the TPU bench job opts in).
+    notrace = run_arm(True, tracing=False)
+    trace_ratio = (round(on["bg_tok_s"] / notrace["bg_tok_s"], 3)
+                   if on.get("bg_tok_s") and notrace.get("bg_tok_s")
+                   else None)
+    if trace_ratio is not None and trace_ratio < 0.98:
+        log(f"bench: WARNING tracing-on bg tok/s is {trace_ratio} of "
+            f"tracing-off (budget: >= 0.98)")
+        if os.environ.get("BENCH_ASSERT_TRACE_OVERHEAD") == "1":
+            raise AssertionError(
+                f"tracing overhead over budget: tok/s ratio {trace_ratio}"
+                f" < 0.98 (on={on['bg_tok_s']} off={notrace['bg_tok_s']})")
     rec = {
         "model": model,
         # "mixed_paged" is the ISSUE-5 headline capture: its
@@ -1151,6 +1177,12 @@ def measure_mixed(jax, *, model: str, dtype: str, slots: int, steps: int,
         "bg_tok_s_ratio": (round(on["bg_tok_s"] / off["bg_tok_s"], 3)
                            if on.get("bg_tok_s") and off.get("bg_tok_s")
                            else None),
+        # tracing-on vs tracing-off throughput on the same overlap-on
+        # load; >= 0.98 is the tracing overhead budget
+        "trace_tok_s_ratio": trace_ratio,
+        "trace_overhead_ok": (trace_ratio >= 0.98
+                              if trace_ratio is not None else None),
+        "overlap_on_notrace": notrace,
         "slots": slots,
         "dtype": dtype,
         "paged": paged,

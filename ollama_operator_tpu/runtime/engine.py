@@ -332,7 +332,7 @@ class DecodeHandle:
     never wait) keep bit-identical host lengths."""
 
     __slots__ = ("_engine", "_toks", "_t0", "_out", "epoch", "budgets",
-                 "accepted")
+                 "accepted", "t_done")
 
     def __init__(self, engine: "Engine", toks, t0: float, epoch: int = 0,
                  budgets: Optional[np.ndarray] = None):
@@ -343,10 +343,20 @@ class DecodeHandle:
         self.epoch = epoch
         self.budgets = budgets
         self.accepted: Optional[np.ndarray] = None
+        # perf_counter() when wait() materialised the tokens; with
+        # t_launch this makes the async launch→materialize overlap
+        # visible to the tracing layer (runtime/trace.py)
+        self.t_done: Optional[float] = None
+
+    @property
+    def t_launch(self) -> float:
+        """perf_counter() at launch time (set by decode_n_launch)."""
+        return self._t0
 
     def wait(self) -> np.ndarray:
         if self._out is None:
             toks = self._engine._fetch(self._toks)
+            self.t_done = time.perf_counter()
             if self.budgets is not None:
                 # [B, k+1] sentinel-padded: valid entries per row are the
                 # accepted draft prefix + bonus token, in order
@@ -354,10 +364,10 @@ class DecodeHandle:
                     toks < self._engine.cfg.vocab_size).sum(axis=1)
                 toks = toks.T
                 self._engine.dispatch_ms["spec"] = (
-                    (time.perf_counter() - self._t0) * 1e3)
+                    (self.t_done - self._t0) * 1e3)
             else:
                 self._engine.dispatch_ms["decode"] = (
-                    (time.perf_counter() - self._t0) * 1e3)
+                    (self.t_done - self._t0) * 1e3)
             self._out = toks
             self._toks = None
         return self._out

@@ -136,6 +136,12 @@ class FaultInjector:
         # act outside the lock so a sleep never blocks other points
         if kind == "fail":
             if fire:
+                # flight-recorder breadcrumb BEFORE the raise: a chaos
+                # drill's post-mortem dump must show the injected fault
+                # ahead of the failure cascade it triggers
+                from .trace import FLIGHT
+                FLIGHT.record("fault_injected", point=point, spec=spec,
+                              hit=n)
                 raise InjectedFault(point, spec)
             return
         if fire and value > 0:

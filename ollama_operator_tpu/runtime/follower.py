@@ -39,6 +39,10 @@ from typing import Any, List, Optional
 from ..server.metrics import GLOBAL as METRICS
 from .errors import FollowerLost
 from .faults import FAULTS, InjectedFault
+# flight-recorder events here are strictly host-side observability —
+# they never enter the broadcast stream, so leader tracing can never
+# desync a follower's replay (each process records into its OWN ring)
+from .trace import FLIGHT
 
 CONTROL_PORT_OFFSET = 1      # coordinator port + 1
 
@@ -135,6 +139,7 @@ class ControlPlane:
             self.degraded = True
             self.degraded_reason = reason
             METRICS.inc("tpu_model_followers_lost_total")
+            FLIGHT.record("follower_lost", reason=reason[:200])
             log(f"DEGRADED: {reason}")
         return FollowerLost(reason)
 
@@ -288,6 +293,8 @@ def run_follower(manager, host: str, port: int,
                 # replaying them (incl. their page-table side effects)
                 # keeps host state in lockstep; anything else will show
                 # up here loudly and then desync visibly
+                FLIGHT.record("replay_error", method=method,
+                              error=f"{type(e).__name__}: {e}"[:200])
                 log(f"replayed {method} raised {type(e).__name__}: {e}")
         elif op == "shutdown":
             log("leader shut down")

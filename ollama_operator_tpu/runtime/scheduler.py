@@ -31,6 +31,7 @@ from . import drafter
 from .engine import Engine, SlotOptions
 from .errors import BadRequest, DeadlineExceeded
 from .paged import PagesExhausted
+from .trace import FLIGHT, TRACER
 
 
 class SchedulerBusy(RuntimeError):
@@ -83,6 +84,13 @@ class Request:
         self.cancelled = threading.Event()
         self.stats = RequestStats(n_prompt=len(self.prompt_ids),
                                   t_submit=time.monotonic())
+        # span timeline (runtime/trace.py): queued → admit/stitch →
+        # prefill pieces → decode dispatches → detok → HTTP flush.
+        # begin() returns the shared no-op trace when TPU_TRACE=0.
+        self.trace = TRACER.begin(self.id)
+        # monotonic stamp of the last token chunk delivered, for the
+        # chunk-normalized tpu_model_itl_seconds observation in _fanout
+        self._t_last_emit = 0.0
         self.slot: Optional[int] = None
         self.error: Optional[str] = None
         # absolute time.monotonic() budget, or None for no deadline:
@@ -251,6 +259,7 @@ class Scheduler:
         if async_dispatch and paged_dp:
             METRICS.inc("tpu_model_async_fallback_total", 1.0,
                         '{cause="paged_dp"}')
+            FLIGHT.record("async_fallback", cause="paged_dp")
         # epoch of the newest decode handle already materialised — the
         # next launch passes it back as retire= so the engine unfences
         # pages quarantined at or before it (and so followers, which
@@ -315,9 +324,13 @@ class Scheduler:
                 self._waiting.put_nowait(req)
             except queue.Full:
                 METRICS.inc("tpu_model_requests_shed_total")
+                FLIGHT.record("shed", rid=req.id, cause="queue_full",
+                              qsize=self._waiting.maxsize)
                 raise SchedulerBusy(
                     f"request queue full ({self._waiting.maxsize} waiting)"
                 ) from None
+        req.trace.event("queued", n_prompt=len(prompt_ids),
+                        max_tokens=max_tokens)
         self._wake.set()
         return req
 
@@ -395,6 +408,8 @@ class Scheduler:
                 self._parked.pop(slot, None)
         self._running[slot] = None
         req.stats.t_done = time.monotonic()
+        req.trace.event("finish", reason=reason, slot=slot,
+                        n_generated=req.stats.n_generated)
         with self._lock:
             self.finished.append(req.stats)
             if len(self.finished) > 512:
@@ -415,6 +430,8 @@ class Scheduler:
             return False
         req.stats.n_generated += 1
         self.total_generated += 1
+        req._t_last_emit = time.monotonic()
+        req.trace.event("first_token")
         req.out.put(("tokens", [tid]))
         return req.stats.n_generated < req.max_tokens
 
@@ -441,6 +458,17 @@ class Scheduler:
         if best_m + tail_bucket > self.engine.max_seq:
             return None, 0
         return best, best_m
+
+    def _quiesce(self, cause: str) -> int:
+        """engine.fence_quiesce with a flight-recorder breadcrumb:
+        quarantine transitions are exactly the events that explain a
+        mysterious pool-dry stall after the fact."""
+        n_q = self.engine.quarantined_pages
+        freed = self.engine.fence_quiesce()
+        if n_q or freed:
+            FLIGHT.record("fence_quiesce", cause=cause,
+                          quarantined=n_q, freed=freed)
+        return freed
 
     def _next_waiting(self) -> Optional[Request]:
         if self._preempted:
@@ -482,12 +510,15 @@ class Scheduler:
         if want < self.min_prefix_reuse:
             return 0
         try:
-            return self.engine.stitch(slot, ids, want)
+            got = self.engine.stitch(slot, ids, want)
+            if got:
+                req.trace.event("stitch", slot=slot, reused=got)
+            return got
         except PagesExhausted:
             if self._pending is not None or self.engine.quarantined_pages:
                 # likely fenced, not dry: unfence instead of evicting
                 self._drain_pending()
-                self.engine.fence_quiesce()
+                self._quiesce("pool_dry_stitch")
             else:
                 self._evict_one_parked()
             return 0
@@ -507,6 +538,9 @@ class Scheduler:
         retry_after = min(30, max(1, self.qsize))
         req.error = "deadline exceeded while queued"
         req.stats.t_done = time.monotonic()
+        req.trace.event("shed", cause="deadline_queued")
+        FLIGHT.record("shed", rid=req.id, cause="deadline_queued",
+                      retry_after_s=retry_after)
         with self._lock:
             self.finished.append(req.stats)
         METRICS.inc("tpu_model_requests_shed_total")
@@ -565,9 +599,18 @@ class Scheduler:
         req.slot = slot
         if req.stats.t_admitted == 0:
             # first admission only — a preempted request re-admitting
-            # must not re-count its prompt in throughput stats
+            # must not re-count its prompt in throughput stats (nor
+            # re-observe its queue wait: that wait already happened)
             self.total_prompt += req.stats.n_prompt
+            METRICS.observe("tpu_model_queue_wait_seconds",
+                            max(time.monotonic() - req.stats.t_submit,
+                                0.0))
         req.stats.t_admitted = time.monotonic()
+        req.trace.event("admitted", slot=slot,
+                        reused=int(req.stats.n_reused))
+        FLIGHT.record("admit", rid=req.id, slot=slot,
+                      n_prompt=int(req.stats.n_prompt),
+                      reused=int(req.stats.n_reused))
         # prefix-cache accounting per ADMISSION (re-admissions re-count:
         # a preempted request's second prefill is real compute): hit =
         # tokens served from cache (radix stitch or parked-slot extend),
@@ -633,7 +676,7 @@ class Scheduler:
                 return True
             if self._pending is not None or self.engine.quarantined_pages:
                 self._drain_pending()
-                self.engine.fence_quiesce()
+                self._quiesce("pool_dry_admit")
             else:
                 self._evict_one_parked(self._pages_for(len(req.admit_ids)))
             self._preempted.insert(0, req)
@@ -641,8 +684,13 @@ class Scheduler:
         except Exception as e:  # surfacing engine errors to the caller
             self._request_error(req, str(e))
             return True
-        METRICS.inc("tpu_model_admission_stall_ms_total",
-                    (time.perf_counter() - t0) * 1e3)
+        dur = time.perf_counter() - t0
+        METRICS.inc("tpu_model_admission_stall_ms_total", dur * 1e3)
+        kind = "extend" if reuse_len else "admit"
+        METRICS.observe("tpu_model_dispatch_seconds", dur,
+                        f'{{kind="{kind}"}}')
+        req.trace.event("prefill", kind=kind, dur_ms=round(dur * 1e3, 3),
+                        n_tokens=len(req.admit_ids) - reuse_len)
         self._post_admit(slot, req, first)
         return True
 
@@ -683,7 +731,7 @@ class Scheduler:
             if self._pending is not None or self.engine.quarantined_pages:
                 # fenced, not dry (see _admit_one): unfence, don't evict
                 self._drain_pending()
-                self.engine.fence_quiesce()
+                self._quiesce("pool_dry_admit")
             else:
                 self._evict_one_parked(self._pages_for(len(ids)))
             self._preempted.insert(0, req)
@@ -691,9 +739,14 @@ class Scheduler:
         except Exception as e:
             self._request_error(req, str(e))
             return True
+        dur = time.perf_counter() - t0
         METRICS.inc("tpu_model_prefill_chunks_total")
-        METRICS.inc("tpu_model_admission_stall_ms_total",
-                    (time.perf_counter() - t0) * 1e3)
+        METRICS.inc("tpu_model_admission_stall_ms_total", dur * 1e3)
+        kind = "extend" if reuse_len else "admit"
+        METRICS.observe("tpu_model_dispatch_seconds", dur,
+                        f'{{kind="{kind}"}}')
+        req.trace.event("prefill_piece", kind=kind, done=end,
+                        of=len(ids), dur_ms=round(dur * 1e3, 3))
         req.slot = slot
         self._running[slot] = req
         self._prefilling[slot] = _PrefillJob(req, end)
@@ -755,9 +808,13 @@ class Scheduler:
         # any other engine failure propagates to the supervisor, which
         # errors every running request (this one included) exactly once
         # and restarts — _fail_running clears _prefilling
+        dur = time.perf_counter() - t0
         METRICS.inc("tpu_model_prefill_chunks_total")
-        METRICS.inc("tpu_model_admission_stall_ms_total",
-                    (time.perf_counter() - t0) * 1e3)
+        METRICS.inc("tpu_model_admission_stall_ms_total", dur * 1e3)
+        METRICS.observe("tpu_model_dispatch_seconds", dur,
+                        '{kind="extend"}')
+        req.trace.event("prefill_piece", kind="extend", done=end,
+                        of=len(ids), dur_ms=round(dur * 1e3, 3))
         if final:
             self._prefilling.pop(slot, None)
             self._post_admit(slot, req, first)
@@ -785,13 +842,19 @@ class Scheduler:
                     for s, r in group:
                         self._admit_one(s, r, 0)
                     continue
+                dur = time.perf_counter() - t0
                 METRICS.inc("tpu_model_admission_stall_ms_total",
-                            (time.perf_counter() - t0) * 1e3)
+                            dur * 1e3)
+                METRICS.observe("tpu_model_dispatch_seconds", dur,
+                                '{kind="admit"}')
                 for (s, r), tok in zip(group, toks):
                     # batched admissions are always cold (a resumed
                     # request must not re-report its first admission's
                     # reuse as a fresh cache hit)
                     r.stats.n_reused = 0
+                    r.trace.event("prefill", kind="admit", batched=m,
+                                  dur_ms=round(dur * 1e3, 3),
+                                  n_tokens=len(r.admit_ids))
                     self._post_admit(s, r, tok)
             for s, r in items:
                 self._admit_one(s, r, 0)
@@ -884,6 +947,8 @@ class Scheduler:
                 # kill the daemon thread: that would leave every in-flight
                 # tokens() reader blocked forever while /healthz stays green.
                 traceback.print_exc(file=sys.stderr)
+                FLIGHT.record("engine_failure", error=str(e)[:200],
+                              consecutive=self._consecutive_failures + 1)
                 self._fail_running(str(e))
                 self._consecutive_failures += 1
                 if self._consecutive_failures > self.max_restarts:
@@ -926,6 +991,13 @@ class Scheduler:
                 pass
         self.n_restarts += 1
         METRICS.inc("tpu_model_engine_restarts_total")
+        # black-box post-mortem: record the restart itself, then dump
+        # the ring so the job log shows the last N structured events
+        # (admissions, the injected fault, the failure) BEFORE this
+        # recovery — chaos CI greps for this block
+        FLIGHT.record("restart", n=self.n_restarts,
+                      consecutive=self._consecutive_failures)
+        FLIGHT.dump(f"supervised restart #{self.n_restarts}")
         # capped exponential backoff before retrying; interruptible so
         # shutdown() never waits behind a sleeping supervisor
         delay = min(self.restart_backoff
@@ -938,6 +1010,8 @@ class Scheduler:
         # the in-flight async dispatch (and any mid-chunked-prefill
         # state) dies with the engine state; every owner is still in
         # _running and gets exactly ONE error frame below
+        FLIGHT.record("fail_running", error=message[:200],
+                      n_running=self.n_active)
         self._pending = None
         self._prefilling.clear()
         for slot, req in enumerate(self._running):
@@ -997,7 +1071,7 @@ class Scheduler:
             # pool-dry event, vs a re-prefill per needless preemption.
             if self._pending is not None or self.engine.quarantined_pages:
                 self._drain_pending()
-                self.engine.fence_quiesce()
+                self._quiesce("pool_dry_decode")
                 continue
             if self._evict_one_parked():
                 continue
@@ -1016,6 +1090,10 @@ class Scheduler:
                 req.slot = None
                 self.n_preemptions += 1
                 METRICS.inc("tpu_model_preemptions_total")
+                req.trace.event("preempted", slot=slot,
+                                n_generated=req.stats.n_generated)
+                FLIGHT.record("preempt", rid=req.id, slot=slot,
+                              n_generated=req.stats.n_generated)
                 self._preempted.append(req)
             else:
                 req.error = ("preempted under KV-pool pressure; multimodal "
@@ -1094,6 +1172,30 @@ class Scheduler:
         toks_n = handle.wait()
         self._fence_ack = handle.epoch
         self._consecutive_failures = 0
+        # dispatch latency: launch → tokens-on-host, per program kind.
+        # The handle stamped both ends, so the span event's launch-time
+        # anchor makes async overlap visible (a launch far before its
+        # materialize = host work hidden behind device compute).
+        kind = "spec" if handle.budgets is not None else "decode"
+        dur = ((handle.t_done - handle.t_launch)
+               if handle.t_done is not None else 0.0)
+        METRICS.observe("tpu_model_dispatch_seconds", dur,
+                        f'{{kind="{kind}"}}')
+        if snapshot is not None:
+            for s, r in snapshot.items():
+                if self._running[s] is not r:
+                    continue
+                acc = (int(handle.accepted[s])
+                       if handle.accepted is not None else None)
+                if acc is not None:
+                    r.trace.event_at(handle.t_launch, "dispatch",
+                                     kind=kind, epoch=handle.epoch,
+                                     dur_ms=round(dur * 1e3, 3),
+                                     accepted=acc)
+                else:
+                    r.trace.event_at(handle.t_launch, "dispatch",
+                                     kind=kind, epoch=handle.epoch,
+                                     dur_ms=round(dur * 1e3, 3))
         if handle.budgets is not None:
             rollback = np.maximum(handle.budgets - handle.accepted, 0)
             if snapshot is not None:
@@ -1164,7 +1266,7 @@ class Scheduler:
             # unfence now so a quiet scheduler never parks pool capacity
             # in quarantine (and the conftest leak check sees zero)
             if self.engine.quarantined_pages:
-                self.engine.fence_quiesce()
+                self._quiesce("idle")
             if not self._prefilling:
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
@@ -1227,6 +1329,7 @@ class Scheduler:
             if self.async_dispatch:
                 METRICS.inc("tpu_model_async_fallback_total", 1.0,
                             '{cause="grammar"}')
+                FLIGHT.record("async_fallback", cause="grammar")
             self._drain_pending()
             drafts = drafted = None
             if spec_usable:
@@ -1239,8 +1342,17 @@ class Scheduler:
                 toks_n = self._wait_handle(handle, decoding,
                                            drafted)         # [k+1, B]
             else:
+                t0 = time.perf_counter()
                 toks_n = self.engine.decode_n(n_steps)
                 self._consecutive_failures = 0
+                dur = time.perf_counter() - t0
+                METRICS.observe("tpu_model_dispatch_seconds", dur,
+                                '{kind="decode"}')
+                for s, r in decoding.items():
+                    if self._running[s] is r:
+                        r.trace.event_at(t0, "dispatch", kind="decode",
+                                         sync=True,
+                                         dur_ms=round(dur * 1e3, 3))
             self._fanout(toks_n, decoding)
             return
         if spec_usable:
@@ -1323,6 +1435,16 @@ class Scheduler:
         def _flush(slot: int, req: Request):
             buf = pend.pop(slot, None)
             if buf:
+                # chunk-normalized inter-token latency: one observation
+                # per delivered chunk, spread over its tokens — the
+                # per-token ITL a client actually experiences under
+                # chunked decode, at 1/decode_chunk the observe() cost
+                now = time.monotonic()
+                if req._t_last_emit:
+                    METRICS.observe(
+                        "tpu_model_itl_seconds",
+                        max(now - req._t_last_emit, 0.0) / len(buf))
+                req._t_last_emit = now
                 req.out.put(("tokens", buf))
 
         for row_idx, row in enumerate(np.asarray(toks_n)):
@@ -1373,8 +1495,8 @@ class Scheduler:
             if not any_running:
                 break
         # end of dispatch: flush every still-running slot's chunk
-        for slot, buf in list(pend.items()):
+        for slot in list(pend):
             req = self._running[slot]
-            if req is not None and buf:
-                req.out.put(("tokens", buf))
+            if req is not None:
+                _flush(slot, req)
         pend.clear()

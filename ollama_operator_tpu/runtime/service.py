@@ -65,6 +65,12 @@ class GenerateResult:
     total_s: float = 0.0
     done_reason: str = "stop"
     context: List[int] = dataclasses.field(default_factory=list)
+    # scheduler request id — the handle for GET /debug/trace?id=
+    request_id: int = 0
+    # per-stage span summary (runtime/trace.py), filled only when the
+    # request asked for it (options.trace=true) — rides into the final
+    # NDJSON frame as the "timings" block
+    timings: Optional[Dict] = None
 
 
 class _OwnedStream:
@@ -77,6 +83,9 @@ class _OwnedStream:
     def __init__(self, it, req):
         self._it, self._req = it, req
         self._started = False
+        # span timeline handle, so the HTTP layer can stamp its flush
+        # events onto the same timeline the scheduler writes to
+        self.trace = req.trace
 
     def __iter__(self):
         return self
@@ -471,14 +480,20 @@ class LoadedModel:
                                     embeds=embeds, constraint=constraint,
                                     deadline_s=resolve_deadline_s(
                                         self.default_params, options))
+        # opt-in span summary in the final frame: options.trace=true
+        # (merge_options ignores unknown keys, so "trace" never reaches
+        # SlotOptions)
+        want_timings = bool((options or {}).get("trace"))
         # returned context carries only REAL token ids: a continuation
         # re-prefills from context without the image, so image pad ids
         # must not leak into it (they would re-enter as garbage tokens)
         return _OwnedStream(
-            self._stream(req, stops, context_ids, max_new, t0, cancel_event),
+            self._stream(req, stops, context_ids, max_new, t0, cancel_event,
+                         want_timings),
             req)
 
-    def _stream(self, req, stops, ids, max_new, t0, cancel_event
+    def _stream(self, req, stops, ids, max_new, t0, cancel_event,
+                want_timings: bool = False
                 ) -> Iterator[Tuple[str, Optional[GenerateResult]]]:
         sd = StreamDecoder(self.tokenizer)
         sm = StopMatcher(stops)
@@ -495,6 +510,7 @@ class LoadedModel:
                     req.cancel()
                 all_ids.extend(chunk)
                 FAULTS.check("detok.feed")
+                req.trace.event("detok", n=len(chunk))
                 piece = sm.feed(sd.feed_many(chunk))
                 if piece:
                     result.text += piece
@@ -536,6 +552,9 @@ class LoadedModel:
         if st.decode_tok_s > 0:
             METRICS.observe("tpu_model_decode_tokens_per_second",
                             st.decode_tok_s)
+        result.request_id = req.id
+        if want_timings:
+            result.timings = req.trace.timings()
         yield "", result
 
     def generate(self, prompt_text: str, options: Optional[Dict] = None,

@@ -47,6 +47,7 @@ from ..runtime.engine import EngineConfig, resolve_serving_defaults
 from ..runtime.errors import BadRequest, DeadlineExceeded, FollowerLost
 from ..runtime.scheduler import SchedulerBroken, SchedulerBusy
 from ..runtime.service import LoadedModel
+from ..runtime.trace import FLIGHT, TRACER
 from ..tokenizer import Tokenizer
 from .metrics import GLOBAL as METRICS
 from .modelfile import Modelfile, parse_modelfile, params_json
@@ -122,7 +123,8 @@ class _StreamCoalescer:
     steady-state cost per frame is one strftime-free timestamp, one
     json.dumps of the text, and one socket write."""
 
-    def __init__(self, chunk_fn, make_frame, max_tokens: int, max_s: float):
+    def __init__(self, chunk_fn, make_frame, max_tokens: int, max_s: float,
+                 trace=None):
         self._chunk = chunk_fn
         self._make = make_frame
         self.max_tokens = max_tokens
@@ -131,6 +133,9 @@ class _StreamCoalescer:
         self._ntok = 0
         self._t_last = None     # None → flush the first piece immediately
         self.frames = 0
+        # request span timeline (runtime/trace.py) — the HTTP flush is
+        # the last hop of the request's path, stamped per frame
+        self._trace = trace
 
     def add(self, piece: str):
         self._parts.append(piece)
@@ -144,12 +149,16 @@ class _StreamCoalescer:
         if not self._parts:
             return
         text = "".join(self._parts)
+        n_tok = self._ntok
         self._parts.clear()
         self._ntok = 0
         self._t_last = time.monotonic() if now is None else now
         self._chunk(self._make(text))
         self.frames += 1
         METRICS.inc("tpu_model_stream_frames_total")
+        if self._trace is not None:
+            self._trace.event("http_flush", n_tokens=n_tok,
+                              chars=len(text))
 
 
 def _fmt_params(n: int) -> str:
@@ -902,7 +911,7 @@ class Handler(BaseHTTPRequestHandler):
         return itertools.chain([first], it)
 
     def _coalescer(self, pre: bytes, mid: Optional[bytes], suf: bytes,
-                   options: Optional[Dict]) -> _StreamCoalescer:
+                   options: Optional[Dict], trace=None) -> _StreamCoalescer:
         """Frame coalescer over this response's chunked stream. A frame is
         `pre + now_iso + mid + json(text) + suf` (NDJSON; the timestamp
         is the only other varying part) or `pre + json(text) + suf` when
@@ -925,7 +934,68 @@ class Handler(BaseHTTPRequestHandler):
             buf.extend(suf)
             return buf
 
-        return _StreamCoalescer(self._chunk, make, n, s)
+        return _StreamCoalescer(self._chunk, make, n, s, trace=trace)
+
+    # -- debug introspection -------------------------------------------
+    def _query(self) -> Dict[str, str]:
+        """Last value per key of the request's query string."""
+        from urllib.parse import parse_qs
+        qs = parse_qs(self.path.partition("?")[2])
+        return {k: v[-1] for k, v in qs.items()}
+
+    def _debug_trace(self):
+        """Span timeline of one recent request (runtime/trace.py). With
+        no id, lists the ids the tracer still holds (newest last)."""
+        q = self._query()
+        rid = q.get("id")
+        if rid is None:
+            self._send_json({"ids": TRACER.ids()})
+            return
+        tr = TRACER.get(rid)
+        if tr is None:
+            self._send_json({"error": f"no trace for id {rid!r} "
+                             "(evicted, or TPU_TRACE=0)"}, 404)
+            return
+        self._send_json(tr.to_dict())
+
+    def _debug_events(self):
+        """The flight-recorder ring: last TPU_FLIGHT_EVENTS structured
+        scheduler/engine events, oldest first. ?last=N trims to the
+        newest N."""
+        events = FLIGHT.snapshot()
+        try:
+            last = int(self._query().get("last", "0"))
+        except ValueError:
+            last = 0
+        if last > 0:
+            events = events[-last:]
+        self._send_json({"events": events, "dumps": FLIGHT.dumps})
+
+    def _debug_profile(self):
+        """Capture a jax.profiler trace for ?seconds= (default 2, max
+        30) into a temp dir and report its path. Opt-in via
+        TPU_DEBUG_PROFILE=1 — profiling stalls the device queue, so it
+        must never be reachable on an unguarded production port."""
+        if os.environ.get("TPU_DEBUG_PROFILE") != "1":
+            self._send_json(
+                {"error": "profiling disabled (set TPU_DEBUG_PROFILE=1)"},
+                403)
+            return
+        try:
+            seconds = float(self._query().get("seconds", "2"))
+        except ValueError:
+            seconds = 2.0
+        seconds = min(max(seconds, 0.1), 30.0)
+        import tempfile
+
+        import jax
+        out_dir = tempfile.mkdtemp(prefix="tpu-profile-")
+        jax.profiler.start_trace(out_dir)
+        try:
+            time.sleep(seconds)
+        finally:
+            jax.profiler.stop_trace()
+        self._send_json({"seconds": seconds, "trace_dir": out_dir})
 
     # -- routing --------------------------------------------------------
     def do_GET(self):
@@ -949,6 +1019,12 @@ class Handler(BaseHTTPRequestHandler):
                                 ctype="text/plain; version=0.0.4")
             elif path == "/healthz":
                 self._send_text("ok")
+            elif path == "/debug/trace":
+                self._debug_trace()
+            elif path == "/debug/events":
+                self._debug_events()
+            elif path == "/debug/profile":
+                self._debug_profile()
             elif path in ("/readyz", "/livez"):
                 # livez fails too: a broken scheduler self-heals on the next
                 # load(), but an idle pod would otherwise stay wedged with
@@ -1099,13 +1175,14 @@ class Handler(BaseHTTPRequestHandler):
                                  images=_decode_images(body.get("images")),
                                  format=body.get("format"))
         if stream:
+            trace = getattr(gen, "trace", None)
             gen = self._pull_first(gen)
             self._start_stream()
             co = self._coalescer(
                 b'{"model": ' + json.dumps(model).encode()
                 + b', "created_at": "',
                 b'", "response": ', b', "done": false}\n',
-                body.get("options"))
+                body.get("options"), trace=trace)
             for piece, final in gen:
                 if final is None:
                     co.add(piece)
@@ -1135,6 +1212,12 @@ class Handler(BaseHTTPRequestHandler):
         }
         if body.get("context") is not None or not body.get("raw"):
             out["context"] = res.context
+        if getattr(res, "timings", None) is not None:
+            # opt-in (options.trace=true): per-span first/last/count
+            # summary of the request's trace, plus the id to fetch the
+            # full timeline from /debug/trace
+            out["timings"] = dict(res.timings,
+                                  request_id=getattr(res, "request_id", 0))
         return out
 
     def _api_chat(self, body: Dict):
@@ -1173,6 +1256,7 @@ class Handler(BaseHTTPRequestHandler):
             return msg
 
         if stream and not tools:
+            trace = getattr(gen, "trace", None)
             gen = self._pull_first(gen)
             self._start_stream()
             co = self._coalescer(
@@ -1180,7 +1264,7 @@ class Handler(BaseHTTPRequestHandler):
                 + b', "created_at": "',
                 b'", "message": {"role": "assistant", "content": ',
                 b'}, "done": false}\n',
-                body.get("options"))
+                body.get("options"), trace=trace)
             for piece, final in gen:
                 if final is None:
                     co.add(piece)
@@ -1453,6 +1537,7 @@ class Handler(BaseHTTPRequestHandler):
                           final.generated_tokens}})
             return
         if body.get("stream"):
+            trace = getattr(gen, "trace", None)
             gen = self._pull_first(gen)
             self._start_stream(ctype="text/event-stream")
             self._chunk(self._sse({
@@ -1467,7 +1552,8 @@ class Handler(BaseHTTPRequestHandler):
                 + str(created).encode() + b', "model": '
                 + json.dumps(model).encode()
                 + b', "choices": [{"index": 0, "delta": {"content": ',
-                None, b'}, "finish_reason": null}]}\n\n', options)
+                None, b'}, "finish_reason": null}]}\n\n', options,
+                trace=trace)
             final = None
             for piece, f in gen:
                 if f is None:
@@ -1614,10 +1700,30 @@ class _DeepStackHTTPServer(ThreadingHTTPServer):
             self._pool_q.put(None)
 
 
+def _hbm_bytes_in_use() -> float:
+    """Live accelerator memory on local device 0, via whichever of the
+    backend's memory_stats keys exists (TPU reports bytes_in_use; some
+    backends report none at all — then this reads 0, and the gauge-error
+    counter stays untouched because we return rather than raise)."""
+    import jax
+    devs = jax.local_devices()
+    if not devs:
+        return 0.0
+    stats = devs[0].memory_stats()
+    if not stats:
+        return 0.0
+    return float(stats.get("bytes_in_use", 0.0))
+
+
 def serve(manager: ModelManager, host: str = "0.0.0.0", port: int = 11434
           ) -> ThreadingHTTPServer:
     handler = type("BoundHandler", (Handler,), {"manager": manager})
     httpd = _DeepStackHTTPServer((host, port), handler)
+    METRICS.gauge_fn("tpu_model_hbm_bytes_in_use", _hbm_bytes_in_use)
+    METRICS.gauge_fn("tpu_model_flight_recorder_events",
+                     lambda: float(FLIGHT.seq))
+    METRICS.gauge_fn("tpu_model_flight_recorder_dumps",
+                     lambda: float(FLIGHT.dumps))
     t = threading.Thread(target=httpd.serve_forever, daemon=True,
                          name="http-server")
     t.start()

@@ -91,6 +91,20 @@ class Metrics:
 
     def render(self) -> str:
         with self._lock:
+            # evaluate gauge callables FIRST: a failing one is counted in
+            # tpu_model_metrics_gauge_errors_total (a silently-vanishing
+            # series is how a dead weakref or a torn-down engine hides
+            # from dashboards), and counters render after this pass so
+            # the drop is visible in the SAME scrape. Direct dict
+            # mutation, NOT self.inc(): the lock is non-reentrant.
+            gauge_vals: List[Tuple[str, str, float]] = []
+            for (name, labels), fn in sorted(self._gauges.items()):
+                try:
+                    gauge_vals.append((name, labels, float(fn())))
+                except Exception:
+                    k = self._key("tpu_model_metrics_gauge_errors_total",
+                                  "")
+                    self._counters[k] = self._counters.get(k, 0.0) + 1.0
             lines: List[str] = []
             seen = set()
 
@@ -104,12 +118,9 @@ class Metrics:
             for (name, labels), v in sorted(self._counters.items()):
                 header(name, "counter")
                 lines.append(f"{name}{labels} {v}")
-            for (name, labels), fn in sorted(self._gauges.items()):
+            for name, labels, v in gauge_vals:
                 header(name, "gauge")
-                try:
-                    lines.append(f"{name}{labels} {float(fn())}")
-                except Exception:
-                    pass
+                lines.append(f"{name}{labels} {v}")
             for (name, labels), h in sorted(self._hists.items()):
                 header(name, "histogram")
                 lines.extend(h.render(name, labels))
@@ -188,6 +199,38 @@ GLOBAL.describe("tpu_model_spec_accepted_tokens_total",
                 "each one is an output token that skipped a decode "
                 "dispatch; accepted/drafted below ~0.3 means lookup "
                 "misses are paying dispatch overhead for nothing")
+GLOBAL.describe("tpu_model_prefix_reused_tokens_total",
+                "Prompt tokens served from a parked prefix cache on the "
+                "request's FIRST admission (per-request view of the "
+                "hit/miss token counters)")
+GLOBAL.describe("tpu_model_itl_seconds",
+                "Inter-token latency histogram, chunk-normalized: each "
+                "delivered decode chunk observes (gap since previous "
+                "delivery) / (tokens in chunk) — the per-token cadence "
+                "a streaming client actually experiences")
+GLOBAL.describe("tpu_model_queue_wait_seconds",
+                "Submit-to-first-admission wait histogram (first "
+                "admission only; a preempted request's re-admission "
+                "does not re-observe)")
+GLOBAL.describe("tpu_model_dispatch_seconds",
+                "Device dispatch latency histogram by program kind "
+                "(kind=decode|admit|extend|spec): launch to tokens on "
+                "host — the distribution behind the last-value "
+                "tpu_model_dispatch_ms gauges")
+GLOBAL.describe("tpu_model_metrics_gauge_errors_total",
+                "Gauge callables that raised during /metrics render; a "
+                "nonzero rate means a series is silently missing from "
+                "scrapes (dead weakref, torn-down engine)")
+GLOBAL.describe("tpu_model_hbm_bytes_in_use",
+                "Accelerator memory in use on local device 0 "
+                "(jax memory_stats; 0 when the backend reports none)")
+GLOBAL.describe("tpu_model_flight_recorder_events",
+                "Structured events recorded into the flight-recorder "
+                "ring so far (runtime/trace.py); the ring keeps only "
+                "the last TPU_FLIGHT_EVENTS of them")
+GLOBAL.describe("tpu_model_flight_recorder_dumps",
+                "Flight-recorder dumps written to stderr (supervised "
+                "restarts and chaos-drill post-mortems)")
 # pre-seed the failure counters at 0: alert rules rate() over these, and
 # a series that first appears AT the first failure hides that failure
 # (the stall/chunk counters likewise: a mixed-load dashboard must read 0,
@@ -201,7 +244,20 @@ for _name in ("tpu_model_engine_restarts_total",
               "tpu_model_prefix_hit_tokens_total",
               "tpu_model_prefix_miss_tokens_total",
               "tpu_model_spec_drafted_tokens_total",
-              "tpu_model_spec_accepted_tokens_total"):
+              "tpu_model_spec_accepted_tokens_total",
+              # traffic counters: an idle (or freshly-restarted) server
+              # must scrape 0, not absent — a dashboard rate() over an
+              # absent series renders "no data" exactly when someone is
+              # checking whether the server serves at all
+              "tpu_model_preemptions_total",
+              "tpu_model_requests_total",
+              "tpu_model_generated_tokens_total",
+              "tpu_model_prompt_tokens_total",
+              "tpu_model_stream_frames_total",
+              "tpu_model_prefix_reused_tokens_total",
+              # render() itself maintains this one; pre-seeded so the
+              # zero-error steady state is a visible 0
+              "tpu_model_metrics_gauge_errors_total"):
     GLOBAL.inc(_name, 0.0)
 # the async-fallback counter is labelled, so pre-seed every cause — an
 # alert on rate(cause="grammar") must read 0, not absent, while async

@@ -6,6 +6,7 @@ reference's probes and clients depend on (/api/tags probe at pod.go:44,
 generate/chat/OpenAI from the getting-started docs)."""
 
 import json
+import os
 import urllib.error
 import urllib.request
 
@@ -722,3 +723,86 @@ def test_blob_upload_eof_mid_body_does_not_hang(stack):
     assert not stack["manager"].store.has_blob(digest)
     r = post(stack["base"], "/api/show", {"model": _model_name(stack)})
     assert "parameters" in r or "template" in r
+
+
+# -- observability surface (ISSUE 7) -----------------------------------
+
+def test_metrics_pass_strict_prometheus_validator(stack):
+    """A live /metrics scrape — after real traffic — satisfies the strict
+    text-format contract: HELP/TYPE on every series, monotone cumulative
+    buckets, consistent _count/_sum (the CI metrics-lint check)."""
+    from test_observability import validate_prometheus_text
+    post(stack["base"], "/api/generate",
+         {"model": _model_name(stack), "prompt": "warm",
+          "options": {"num_predict": 3}}, stream=True)
+    text = get(stack["base"], "/metrics")
+    assert validate_prometheus_text(text) > 20
+    # traffic + failure counters scrape as values even when idle
+    for name in ("tpu_model_requests_total",
+                 "tpu_model_preemptions_total",
+                 "tpu_model_stream_frames_total",
+                 "tpu_model_metrics_gauge_errors_total"):
+        assert f"\n{name} " in text or text.startswith(f"{name} ")
+    # the ISSUE-7 gauges registered in serve()
+    assert "tpu_model_hbm_bytes_in_use" in text
+    assert "tpu_model_flight_recorder_events" in text
+
+
+def test_generate_timings_block_opt_in(stack):
+    """options.trace=true adds a per-request timings summary to the final
+    NDJSON frame; without it the frame shape is unchanged."""
+    plain = post(stack["base"], "/api/generate",
+                 {"model": _model_name(stack), "prompt": "a b",
+                  "options": {"num_predict": 4}}, stream=True)
+    assert "timings" not in plain[-1]
+    lines = post(stack["base"], "/api/generate",
+                 {"model": _model_name(stack), "prompt": "a b",
+                  "options": {"num_predict": 4, "trace": True}},
+                 stream=True)
+    tm = lines[-1]["timings"]
+    evs = {s["ev"] for s in tm["spans"]}
+    assert {"queued", "admitted", "first_token", "finish"} <= evs
+    assert "http_flush" in evs          # span reaches the HTTP write
+    assert tm["queue_wait_ms"] >= 0
+    assert tm["request_id"] >= 1
+
+
+def test_debug_trace_endpoint(stack):
+    lines = post(stack["base"], "/api/generate",
+                 {"model": _model_name(stack), "prompt": "x y",
+                  "options": {"num_predict": 3, "trace": True}},
+                 stream=True)
+    rid = lines[-1]["timings"]["request_id"]
+    ids = json.loads(get(stack["base"], "/debug/trace"))["ids"]
+    assert str(rid) in ids
+    tl = json.loads(get(stack["base"], f"/debug/trace?id={rid}"))
+    assert tl["id"] == str(rid)
+    names = [e["ev"] for e in tl["events"]]
+    assert "queued" in names and "finish" in names
+    assert tl["events"][0]["t_ms"] >= 0
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        get(stack["base"], "/debug/trace?id=99999999")
+    assert ei.value.code == 404
+
+
+def test_debug_events_endpoint(stack):
+    post(stack["base"], "/api/generate",
+         {"model": _model_name(stack), "prompt": "e1",
+          "options": {"num_predict": 2}}, stream=True)
+    body = json.loads(get(stack["base"], "/debug/events"))
+    kinds = [e["kind"] for e in body["events"]]
+    assert "admit" in kinds
+    assert isinstance(body["dumps"], int)
+    two = json.loads(get(stack["base"], "/debug/events?last=2"))["events"]
+    assert len(two) == 2
+    assert two == body["events"][-2:] or two[-1]["seq"] >= \
+        body["events"][-1]["seq"]       # racing traffic may append
+
+
+def test_debug_profile_guarded(stack):
+    """Profiling stalls the device queue: the endpoint must 403 unless
+    TPU_DEBUG_PROFILE=1 opted the deployment in."""
+    assert os.environ.get("TPU_DEBUG_PROFILE") != "1"
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        get(stack["base"], "/debug/profile?seconds=0.2")
+    assert ei.value.code == 403
