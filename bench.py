@@ -1379,6 +1379,254 @@ def measure_prefix(jax, *, model: str, dtype: str, slots: int, steps: int,
     return rec
 
 
+def measure_overload(jax, *, model: str, dtype: str, slots: int, steps: int,
+                     seq: int, prompt_len: int, paged: bool, mixed: bool,
+                     chunk: int, page_size: int, n_pages: int | None,
+                     platform: str, params_cache: dict | None = None,
+                     env: dict | None = None) -> dict:
+    """Overload-discipline arm (ISSUE 8): drive the REAL scheduler at
+    ~5x slot capacity with a 20/30/50 high/normal/best_effort mix across
+    3 tenants, against an unloaded baseline of solo high-priority
+    requests. Acceptance: high-class p99 TTFT stays within 2x of the
+    unloaded baseline (priority preemption + strict-priority dequeue do
+    the work) while best_effort absorbs the overload as shed/throttled
+    — not errors — and every SLO early-reject carries a finite computed
+    Retry-After. ``tpu_model_shed_total{class="high"}`` must stay 0.
+    BENCH_ASSERT_OVERLOAD=1 hard-fails on a violation (CPU smoke asserts
+    included — the invariants are scheduling policy, not device perf)."""
+    import gc
+    import threading
+
+    from ollama_operator_tpu.models.config import get_config
+    from ollama_operator_tpu.runtime.admission import shed_labels
+    from ollama_operator_tpu.runtime.engine import (Engine, EngineConfig,
+                                                    SlotOptions,
+                                                    resolve_cache_dtype)
+    from ollama_operator_tpu.runtime.errors import DeadlineExceeded
+    from ollama_operator_tpu.runtime.scheduler import (Scheduler,
+                                                       SchedulerBusy,
+                                                       SchedulerOverloaded)
+    from ollama_operator_tpu.server.metrics import GLOBAL as METRICS
+
+    on_cpu = platform == "cpu"
+    if on_cpu:
+        dtype = "float32"
+    kv_dtype = resolve_cache_dtype(
+        os.environ.get("BENCH_KV_DTYPE", "float32" if on_cpu else "int8"))
+    cfg = get_config(model)
+    log(f"bench: overload capture model={model} dtype={dtype} "
+        f"slots={slots} seq={seq}")
+    params, param_bytes, dtype = _bench_params(
+        jax, cfg, model, dtype, on_cpu, params_cache)
+    serve_seq = min(seq, cfg.max_seq_len)
+    # short decode chunks: the preemption quantum is one dispatch, and a
+    # high arrival's TTFT rides on how fast the current dispatch retires
+    chunk_eff = max(4, min(chunk, 8))
+    ecfg = EngineConfig(max_slots=slots, max_seq_len=seq,
+                        decode_chunk=chunk_eff, cache_dtype=kv_dtype,
+                        paged=False,
+                        min_prefill_bucket=16)
+    eng = Engine(cfg, params, ecfg=ecfg)
+    eng.warm_buckets()
+    greedy = SlotOptions(temperature=0.0, repeat_penalty=1.0)
+    rng = np.random.default_rng(11)
+    p_len = max(16, min(prompt_len, serve_seq // 4))
+    max_new = max(12, min(24, serve_seq // 8))
+    prompt_of = lambda: rng.integers(  # noqa: E731
+        1, cfg.vocab_size, size=p_len, endpoint=False).astype(np.int32)
+
+    # -- unloaded baseline: solo high-priority requests, one at a time --
+    def run_baseline(sched) -> list:
+        ttfts = []
+        for _ in range(6):
+            r = sched.submit(list(prompt_of()), greedy,
+                             max_tokens=max_new, priority="high")
+            for _ in r.chunks():
+                pass
+            ttfts.append(r.stats.ttft_s)
+        return ttfts
+
+    # -- overload arm: closed-loop workers at ~5x slot capacity --------
+    CLASSES = (["high"] * 2 + ["normal"] * 3 + ["best_effort"] * 5)
+    TENANTS = ("alpha", "beta", "gamma")
+
+    def run_overload(sched, n_workers: int, reqs_per_worker: int) -> dict:
+        res = {c: {"ttfts": [], "done": 0, "shed": 0, "early": 0,
+                   "errors": 0, "retry_afters": []}
+               for c in ("high", "normal", "best_effort")}
+        lock = threading.Lock()
+
+        def worker(wid: int):
+            cls = CLASSES[wid % len(CLASSES)]
+            tenant = TENANTS[wid % len(TENANTS)]
+            # half the best_effort load declares a tight TTFT SLO so the
+            # queue model's early-reject path is exercised under real
+            # backlog (the other half rides the queue to completion)
+            slo = 0.001 if (cls == "best_effort" and wid % 2 == 0) else None
+            wrng = np.random.default_rng(100 + wid)
+            for _ in range(reqs_per_worker):
+                p = wrng.integers(1, cfg.vocab_size, size=p_len,
+                                  endpoint=False).astype(np.int32)
+                try:
+                    r = sched.submit(list(p), greedy, max_tokens=max_new,
+                                     priority=cls, tenant=tenant,
+                                     ttft_slo_s=slo)
+                except SchedulerOverloaded as e:
+                    with lock:
+                        res[cls]["early"] += 1
+                        res[cls]["retry_afters"].append(
+                            getattr(e, "retry_after_s", None))
+                    continue
+                except SchedulerBusy:
+                    with lock:
+                        res[cls]["shed"] += 1
+                    continue
+                try:
+                    for _ in r.chunks():
+                        pass
+                    with lock:
+                        res[cls]["done"] += 1
+                        res[cls]["ttfts"].append(r.stats.ttft_s)
+                except DeadlineExceeded as e:
+                    with lock:
+                        res[cls]["shed"] += 1
+                        res[cls]["retry_afters"].append(
+                            getattr(e, "retry_after_s", None))
+                except Exception:
+                    with lock:
+                        res[cls]["errors"] += 1
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(n_workers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+        return res
+
+    shed0 = {c: {k: METRICS.get("tpu_model_shed_total", shed_labels(c, k))
+                 for k in ("queue_full", "deadline", "slo_predict",
+                           "tenant_cap")}
+             for c in ("high", "normal", "best_effort")}
+    tok0 = {t: METRICS.get("tpu_model_tenant_decode_tokens_total",
+                           f'{{tenant="{t}"}}') for t in TENANTS}
+
+    sched = Scheduler(eng, max_queue=3 * slots, prefill_chunk=0,
+                      async_dispatch=False)
+    try:
+        # warmup: populate the dispatch histograms the queue model reads
+        w = sched.submit(list(prompt_of()), greedy, max_tokens=chunk_eff)
+        for _ in w.chunks():
+            pass
+        base_ttfts = run_baseline(sched)
+        n_workers = 5 * slots
+        over = run_overload(sched, n_workers,
+                            reqs_per_worker=int(os.environ.get(
+                                "BENCH_OVERLOAD_REQS", "4")))
+        base_after = run_baseline(sched)   # recovery: drained queue
+    finally:
+        sched.shutdown()
+        for s in range(eng.n_slots):
+            try:
+                eng.release(s)
+            except Exception:
+                pass
+
+    shed_delta = {
+        c: {k: int(METRICS.get("tpu_model_shed_total", shed_labels(c, k))
+                   - shed0[c][k])
+            for k in ("queue_full", "deadline", "slo_predict",
+                      "tenant_cap")}
+        for c in ("high", "normal", "best_effort")}
+    tok_delta = {t: METRICS.get("tpu_model_tenant_decode_tokens_total",
+                                f'{{tenant="{t}"}}') - tok0[t]
+                 for t in TENANTS}
+    tok_total = sum(tok_delta.values())
+    tenant_share = {t: (round(v / tok_total, 3) if tok_total else None)
+                    for t, v in tok_delta.items()}
+
+    def p99(xs):
+        return (round(float(np.percentile(xs, 99)) * 1e3, 1)
+                if xs else None)
+
+    base_p99 = p99(base_ttfts)
+    high_p99 = p99(over["high"]["ttfts"])
+    # CPU smoke grace: one decode-dispatch quantum of absolute headroom —
+    # at tiny scale a single 20ms dispatch is a large TTFT multiple
+    grace_ms = 150.0 if on_cpu else 0.0
+    high_ratio = (round(max(high_p99 - grace_ms, 0.0)
+                        / max(base_p99, 1e-6), 2)
+                  if high_p99 is not None and base_p99 else None)
+    be = over["best_effort"]
+    be_shed = be["shed"] + be["early"]   # client-observed rejections
+    early_rejects = sum(res["early"] for res in over.values())
+    retry_afters = [ra for res in over.values()
+                    for ra in res["retry_afters"] if ra is not None]
+    high_shed = sum(shed_delta["high"].values())
+    per_class = {
+        c: {"done": over[c]["done"], "shed": over[c]["shed"],
+            "early_rejects": over[c]["early"], "errors": over[c]["errors"],
+            "ttft_p50_ms": (round(float(np.percentile(
+                over[c]["ttfts"], 50)) * 1e3, 1)
+                if over[c]["ttfts"] else None),
+            "ttft_p99_ms": p99(over[c]["ttfts"]),
+            "shed_counters": shed_delta[c]}
+        for c in ("high", "normal", "best_effort")}
+    rec = {
+        "model": model,
+        "mode": "overload",
+        "offered_x_capacity": 5,
+        "baseline_ttft_p99_ms": base_p99,
+        "baseline_after_ttft_p99_ms": p99(base_after),
+        "overload_high_p99_ttft_ms": high_p99,
+        "overload_high_p99_ttft_ratio": high_ratio,
+        "overload_high_p99_ttft_ratio_raw": (
+            round(high_p99 / max(base_p99, 1e-6), 2)
+            if high_p99 is not None and base_p99 else None),
+        "overload_high_shed": high_shed,
+        "overload_best_effort_shed": be_shed,
+        "overload_early_rejects": early_rejects,
+        "retry_after_finite": (all(isinstance(ra, (int, float))
+                                   and 1 <= ra <= 120
+                                   for ra in retry_afters)
+                               if retry_afters else None),
+        "tenant_token_share": tenant_share,
+        "per_class": per_class,
+        "slots": slots,
+        "n_workers": 5 * slots,
+        "dtype": dtype,
+        "prompt_len": int(p_len),
+        "max_tokens": int(max_new),
+        "decode_chunk": chunk_eff,
+        "seq": seq,
+    }
+    if env:
+        rec["env"] = dict(env)
+    log(f"bench: overload capture done: {json.dumps(rec)}")
+    if os.environ.get("BENCH_ASSERT_OVERLOAD") == "1":
+        problems = []
+        if high_ratio is None or high_ratio > 2.0:
+            problems.append(
+                f"high p99 TTFT ratio {high_ratio} > 2.0 "
+                f"(base={base_p99}ms overload={high_p99}ms)")
+        if high_shed != 0:
+            problems.append(f"shed_total{{class=high}} = {high_shed} != 0")
+        if be_shed <= 0:
+            problems.append("no best_effort shed under 5x overload")
+        if sum(res["errors"] for res in over.values()):
+            problems.append(
+                f"hard errors under overload: "
+                f"{ {c: r['errors'] for c, r in over.items()} }")
+        if early_rejects and not rec["retry_after_finite"]:
+            problems.append(f"non-finite Retry-After among {retry_afters}")
+        if problems:
+            raise AssertionError("overload arm failed: "
+                                 + "; ".join(problems))
+    del eng, params
+    gc.collect()
+    return rec
+
+
 def main() -> None:
     import jax
 
@@ -1460,6 +1708,8 @@ def main() -> None:
                      mixed_arm=os.environ.get("BENCH_MIXED_ARM", "") == "1",
                      prefix_arm=os.environ.get("BENCH_PREFIX_ARM",
                                                "") == "1",
+                     overload_arm=os.environ.get("BENCH_OVERLOAD_ARM",
+                                                 "") == "1",
                      **knobs)]
     elif platform == "cpu":
         # unpinned CPU smoke: tiny model, but every knob still applies
@@ -1485,6 +1735,13 @@ def main() -> None:
             # radix prefix cache A/B (shared-system-prompt fan-out,
             # cache on vs TPU_PREFIX_CACHE=0) through the real scheduler
             plan.append({**smoke, "prefix_arm": True})
+        if os.environ.get("BENCH_OVERLOAD_ARM", "") == "1":
+            # overload-discipline A/B (ISSUE 8): closed-loop 5x-capacity
+            # mixed-priority load vs an unloaded high-priority baseline
+            # through the real scheduler; the policy invariants (high p99
+            # flat, best_effort shed not erroring, shed{high}=0) hold at
+            # CPU smoke scale — BENCH_ASSERT_OVERLOAD=1 gates on them
+            plan.append({**smoke, "overload_arm": True, "slots": 2})
         if os.environ.get("BENCH_SPEC_ARM", "") == "1":
             # fused prompt-lookup speculation (ISSUE 6): lookup /
             # accept_all / reject_all sub-arms on a repetition-heavy
@@ -1577,6 +1834,12 @@ def main() -> None:
             dict(model="tinyllama", dtype="int8", slots=16, steps=64,
                  seq=2048, prompt_len=512, paged=True, mixed=False,
                  prefix_arm=True),
+            # overload discipline (ISSUE 8): 5x-capacity mixed-priority
+            # closed loop vs unloaded baseline — the summary's
+            # overload_high_p99_ttft_ratio must hold <= 2.0 at TPU scale
+            dict(model="tinyllama", dtype="int8", slots=16, steps=64,
+                 seq=1024, prompt_len=128, paged=False, mixed=False,
+                 overload_arm=True),
         ]
 
     captures = []
@@ -1600,8 +1863,10 @@ def main() -> None:
         spec = cap.pop("spec", False)
         mixed_arm = cap.pop("mixed_arm", False)
         prefix_arm = cap.pop("prefix_arm", False)
+        overload_arm = cap.pop("overload_arm", False)
         try:
-            fn = (measure_prefix if prefix_arm
+            fn = (measure_overload if overload_arm
+                  else measure_prefix if prefix_arm
                   else measure_mixed if mixed_arm
                   else measure_http if http
                   else measure_spec if spec else measure)
@@ -1701,6 +1966,18 @@ def assemble(captures: list, platform: str, n_devices: int) -> str:
             spec_dispatch_ratio = c.get("dispatch_ratio")
             spec_acceptance = c.get("spec_acceptance")
             break
+    # overload discipline (ISSUE 8 acceptance: high p99 TTFT ratio <= 2
+    # at 5x load, best_effort shed > 0 while shed{class=high} stays 0,
+    # finite Retry-After on every early reject)
+    overload_high_ratio = overload_be_shed = overload_high_shed = None
+    overload_retry_finite = None
+    for c in captures:
+        if c.get("mode") == "overload":
+            overload_high_ratio = c.get("overload_high_p99_ttft_ratio")
+            overload_be_shed = c.get("overload_best_effort_shed")
+            overload_high_shed = c.get("overload_high_shed")
+            overload_retry_finite = c.get("retry_after_finite")
+            break
     return json.dumps({
         "metric": metric,
         "value": head["tok_s"],
@@ -1724,6 +2001,10 @@ def assemble(captures: list, platform: str, n_devices: int) -> str:
         "spec_tok_s_ratio": spec_tok_s_ratio,
         "spec_dispatch_ratio": spec_dispatch_ratio,
         "spec_acceptance": spec_acceptance,
+        "overload_high_p99_ttft_ratio": overload_high_ratio,
+        "overload_best_effort_shed": overload_be_shed,
+        "overload_high_shed": overload_high_shed,
+        "overload_retry_after_finite": overload_retry_finite,
         "slots": head["slots"],
         "platform": platform,
         "dtype": head["dtype"],

@@ -23,6 +23,10 @@ Fault points wired through the codebase:
     detok.feed      -- service detokeniser feed, per chunk
     follower.send   -- ``ControlPlane._send`` to each follower conn
     kube.request    -- ``KubeClient._request`` before the HTTP call
+    admission.predict -- ``admission.predict_queue_wait_s`` (the TTFT
+                       queue model; an armed fail proves the predictor
+                       fails OPEN — requests are admitted and covered
+                       by the deadline machinery, never 500ed)
 
 Trigger specs (the grammar is intentionally tiny):
 

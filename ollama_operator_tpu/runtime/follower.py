@@ -21,6 +21,13 @@ buckets, page tables, penalty windows, and PRNG seeds derive from the
 call arguments alone (engine.py avoids per-process `hash()`), so replayed
 calls produce byte-identical device programs and inputs.
 
+Admission/fairness policy state (priority queues, WDRR deficits, tenant
+rate buckets, the TTFT queue model — runtime/admission.py) lives on
+process 0 ONLY: followers see just the admit/extend/decode calls that
+survive admission. Policy decisions must never enter the broadcast
+stream — they depend on wall-clock throughput observations that differ
+per process and would desynchronise the replay.
+
 The control port is the jax.distributed coordinator's port + 1, rendered
 by the operator as TPU_DIST_CONTROL (operator/pod.py).
 """

@@ -28,6 +28,10 @@ import numpy as np
 
 from ..server.metrics import GLOBAL as METRICS
 from . import drafter
+from .admission import (DEFAULT_TENANT, PRIORITY_RANK, AdmissionQueue,
+                        TenantRateLimited, TenantRateLimiter,
+                        observed_throughput_tps, predict_queue_wait_s,
+                        retry_after_s, shed_labels)
 from .engine import Engine, SlotOptions
 from .errors import BadRequest, DeadlineExceeded
 from .paged import PagesExhausted
@@ -35,7 +39,20 @@ from .trace import FLIGHT, TRACER
 
 
 class SchedulerBusy(RuntimeError):
-    """Raised by submit() when the waiting queue is full (backpressure)."""
+    """Raised by submit() when the waiting queue is full (backpressure).
+    ``retry_after_s`` rides into the HTTP 503's Retry-After header —
+    computed from the admission queue model when one is available."""
+
+    def __init__(self, msg: str, *, retry_after_s: int = 1):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+
+
+class SchedulerOverloaded(SchedulerBusy):
+    """Raised by submit() when the admission queue model predicts the
+    request would miss its TTFT SLO — rejected up front (503 + computed
+    Retry-After) instead of burning a queue slot and prefill work on a
+    doomed request."""
 
 
 class SchedulerBroken(RuntimeError):
@@ -71,9 +88,21 @@ class Request:
     def __init__(self, prompt_ids: Sequence[int], opts: SlotOptions,
                  max_tokens: int, eog_ids: frozenset,
                  embeds: Optional[np.ndarray] = None, constraint=None,
-                 deadline: Optional[float] = None):
+                 deadline: Optional[float] = None,
+                 priority: str = "normal",
+                 tenant: str = DEFAULT_TENANT):
         with Request._ids_lock:
             self.id = next(Request._ids)
+        # admission-policy state (host-side only — never broadcast):
+        # priority class, fairness tenant, and the WDRR token cost
+        # (prompt + predicted decode tokens, refined by submit())
+        self.priority = priority
+        self.rank = PRIORITY_RANK.get(priority, 1)
+        self.tenant = tenant
+        self.cost = float(len(prompt_ids) + max_tokens)
+        # throttle-preemption resume gate: _next_waiting must not hand
+        # this request a slot again before this monotonic stamp
+        self.resume_at = 0.0
         self.prompt_ids = np.asarray(prompt_ids, np.int32)
         self.embeds = embeds          # [n_prompt, D] multimodal embeddings
         self.constraint = constraint  # ops/constrain.py grammar state
@@ -274,7 +303,28 @@ class Scheduler:
         # double-buffering — drafted counts feed the acceptance metrics
         # when the handle materialises
         self._pending = None
-        self._waiting: queue.Queue = queue.Queue(maxsize=max_queue)
+        # the waiting line: strict-priority classes + per-tenant WDRR
+        # over token budgets + SLO-aware early rejection
+        # (runtime/admission.py). Host-side policy state only — nothing
+        # here is ever mirrored to multi-host followers.
+        self._admission = AdmissionQueue(max_queue=max_queue)
+        # per-tenant decode-token rate limiting (TPU_TENANT_TOKEN_RATE);
+        # over-rate best-effort requests are throttle-preempted into
+        # _throttled and resume on the same stream once their bucket
+        # refills
+        self._limiter = TenantRateLimiter.from_env()
+        self._throttled: List[Request] = []
+        self.n_throttles = 0
+        # priority preemption: a queued high-class request may evict a
+        # running strictly-lower-class one (resumable preempt) instead
+        # of waiting a full generation for a slot — the mechanism that
+        # keeps high-priority TTFT flat at 5× offered load
+        self._priority_preempt = os.environ.get(
+            "TPU_PRIORITY_PREEMPT", "1").lower() not in ("0", "false")
+        # EWMA of generated tokens per finished request — the "predicted
+        # decode tokens" half of a request's WDRR token cost (max_tokens
+        # alone over-charges every short completion)
+        self._avg_decode = 64.0
         # preempted requests (paged pool pressure) re-admit before the
         # waiting queue — they already hold a place in the line
         self._preempted: List[Request] = []
@@ -298,13 +348,22 @@ class Scheduler:
         self._thread.start()
 
     # ------------------------------------------------------------------
+    def _tokens_done(self) -> float:
+        """Tokens the engine has pushed through so far (prompt +
+        generated), live — the numerator of the queue model's observed
+        throughput."""
+        return float(self.total_prompt + self.total_generated)
+
     def submit(self, prompt_ids: Sequence[int],
                opts: SlotOptions = SlotOptions(),
                max_tokens: int = 128,
                eog_ids: frozenset = frozenset(),
                embeds: Optional[np.ndarray] = None,
                constraint=None,
-               deadline_s: Optional[float] = None) -> Request:
+               deadline_s: Optional[float] = None,
+               priority: str = "normal",
+               tenant: str = DEFAULT_TENANT,
+               ttft_slo_s: Optional[float] = None) -> Request:
         if len(prompt_ids) >= self.engine.max_seq:
             raise BadRequest(
                 f"prompt of {len(prompt_ids)} tokens exceeds context window "
@@ -312,27 +371,103 @@ class Scheduler:
         deadline = (time.monotonic() + deadline_s
                     if deadline_s is not None and deadline_s > 0 else None)
         req = Request(prompt_ids, opts, max_tokens, eog_ids, embeds=embeds,
-                      constraint=constraint, deadline=deadline)
+                      constraint=constraint, deadline=deadline,
+                      priority=priority, tenant=tenant)
+        # WDRR token cost: prompt + predicted decode tokens (EWMA of
+        # recent completions, capped by this request's own budget)
+        req.cost = float(len(prompt_ids)
+                         + min(max_tokens, max(16, int(self._avg_decode))))
         # broken-check + enqueue under the lock: the failure path flips
         # `broken` and drains under the same lock, so a request can never
         # slip into the queue after the final drain (its reader would hang)
+        victim = None
         with self._lock:
             if self.broken:
                 raise SchedulerBroken(
                     "scheduler stopped after repeated engine failures")
-            try:
-                self._waiting.put_nowait(req)
-            except queue.Full:
+            cap = int(os.environ.get("TPU_TENANT_MAX_QUEUED", "0") or 0)
+            if cap > 0 and self._admission.queued_for(tenant) >= cap:
+                # this tenant specifically is over its share: 429, not
+                # 503 — global backpressure signals would be a lie
                 METRICS.inc("tpu_model_requests_shed_total")
+                METRICS.inc("tpu_model_shed_total",
+                            labels=shed_labels(priority, "tenant_cap"))
+                FLIGHT.record("shed", rid=req.id, cause="tenant_cap",
+                              cls=priority, tenant=tenant, cap=cap)
+                raise TenantRateLimited(
+                    f"tenant {tenant!r} already has {cap} requests "
+                    f"queued", retry_after_s=min(30, max(1, cap)))
+            if ttft_slo_s is not None:
+                # queue model: token backlog at equal-or-higher priority
+                # ÷ observed throughput. A request predicted to miss its
+                # TTFT SLO is rejected NOW, with a Retry-After computed
+                # from how long that backlog needs to drain — not after
+                # wasting a queue slot and prefill work on a timeout.
+                backlog = self._admission.backlog_tokens(req.rank)
+                try:
+                    predicted = predict_queue_wait_s(backlog,
+                                                     self._tokens_done())
+                except Exception as e:  # noqa: BLE001 — incl. injected
+                    # faults at admission.predict: the predictor is an
+                    # optimisation, so it fails OPEN (admit; the
+                    # deadline machinery still covers the request)
+                    FLIGHT.record("admission_predict_failed",
+                                  rid=req.id, error=str(e)[:120])
+                    predicted = 0.0
+                if predicted > ttft_slo_s:
+                    tps = observed_throughput_tps(self._tokens_done())
+                    retry = retry_after_s(predicted, ttft_slo_s, tps)
+                    METRICS.inc("tpu_model_requests_shed_total")
+                    METRICS.inc("tpu_model_shed_total",
+                                labels=shed_labels(priority,
+                                                   "slo_predict"))
+                    FLIGHT.record(
+                        "early_reject", rid=req.id, cls=priority,
+                        tenant=tenant,
+                        predicted_ms=int(predicted * 1e3),
+                        slo_ms=int(ttft_slo_s * 1e3), retry_after_s=retry)
+                    raise SchedulerOverloaded(
+                        f"predicted queue wait {predicted:.2f}s exceeds "
+                        f"ttft_slo {ttft_slo_s:.2f}s",
+                        retry_after_s=retry)
+            accepted, victim = self._admission.offer(req)
+            if not accepted:
+                # full and nothing lower-priority to displace: reject
+                # the incoming request with a computed Retry-After and
+                # record its (zero-length) queue wait — the same
+                # accounting every other shed path gets
+                retry = self._retry_after_estimate(req.rank)
+                self._observe_wait(req)
+                METRICS.inc("tpu_model_requests_shed_total")
+                METRICS.inc("tpu_model_shed_total",
+                            labels=shed_labels(priority, "queue_full"))
                 FLIGHT.record("shed", rid=req.id, cause="queue_full",
-                              qsize=self._waiting.maxsize)
+                              cls=priority, tenant=tenant,
+                              qsize=self._admission.max_queue,
+                              retry_after_s=retry)
                 raise SchedulerBusy(
-                    f"request queue full ({self._waiting.maxsize} waiting)"
-                ) from None
+                    f"request queue full ({self._admission.max_queue} "
+                    f"waiting)", retry_after_s=retry) from None
+        if victim is not None:
+            # queue pressure displaced a strictly lower-priority queued
+            # request (shed-lowest-first); outside the lock — _shed
+            # takes it for the finished ring
+            self._shed(victim, cause="queue_full")
         req.trace.event("queued", n_prompt=len(prompt_ids),
-                        max_tokens=max_tokens)
+                        max_tokens=max_tokens, cls=priority,
+                        tenant=tenant)
         self._wake.set()
         return req
+
+    def _retry_after_estimate(self, rank: int) -> int:
+        """Retry-After for a rejected request: queue-model drain time of
+        the backlog at its priority, floored at 1s (falls back to a
+        depth heuristic when the model has no throughput signal yet)."""
+        backlog = self._admission.backlog_tokens(rank)
+        tps = observed_throughput_tps(self._tokens_done())
+        if tps > 0:
+            return int(min(max(1, round(backlog / tps + 0.5)), 120))
+        return min(30, max(1, self.qsize))
 
     def shutdown(self):
         self._stop.set()
@@ -357,14 +492,11 @@ class Scheduler:
                 self._running[slot] = None
                 req.stats.t_done = time.monotonic()
                 req.out.put(("done", "unloaded"))
-        for req in self._preempted:
+        for req in self._preempted + self._throttled:
             req.out.put(("done", "unloaded"))
         self._preempted.clear()
-        while True:
-            try:
-                req = self._waiting.get_nowait()
-            except queue.Empty:
-                break
+        self._throttled.clear()
+        for req in self._admission.drain():
             req.out.put(("done", "unloaded"))
 
     @property
@@ -373,17 +505,40 @@ class Scheduler:
 
     @property
     def qsize(self) -> int:
-        """Requests waiting for a slot (queued + preempted). Public API
-        for metrics and the server's load probes — external code must
-        not reach into `_waiting`."""
-        return self._waiting.qsize() + len(self._preempted)
+        """Requests waiting for a slot (queued + preempted + throttled).
+        Public API for metrics and the server's load probes — external
+        code must not reach into the admission queue."""
+        return (len(self._admission) + len(self._preempted)
+                + len(self._throttled))
 
     @property
     def has_pending(self) -> bool:
-        """True while any request is running, queued, or preempted —
-        i.e. unloading the model now would strand a caller."""
+        """True while any request is running, queued, preempted, or
+        throttled — i.e. unloading the model now would strand a caller."""
         return (self.n_active > 0 or bool(self._preempted)
-                or not self._waiting.empty())
+                or bool(self._throttled) or not self._admission.empty())
+
+    def admission_stats(self) -> dict:
+        """Live admission-policy snapshot for /api/ps: per-class queue
+        depth/backlog, throttle state, and the policy knobs in force."""
+        out = self._admission.stats()
+        out.update({
+            "default_priority": os.environ.get("TPU_DEFAULT_PRIORITY",
+                                               "normal") or "normal",
+            "ttft_slo_ms": float(os.environ.get("TPU_TTFT_SLO_MS", "0")
+                                 or 0),
+            "priority_preempt": self._priority_preempt,
+            "rate_limited_tenants": self._limiter.enabled,
+            "throttled": len(self._throttled),
+            "throttles": self.n_throttles,
+            "shed_by_class": {
+                p: int(sum(METRICS.get("tpu_model_shed_total",
+                                       shed_labels(p, c))
+                           for c in ("queue_full", "deadline",
+                                     "slo_predict", "tenant_cap")))
+                for p in PRIORITY_RANK},
+        })
+        return out
 
     # ------------------------------------------------------------------
     def _finish(self, slot: int, req: Request, reason: str):
@@ -408,6 +563,9 @@ class Scheduler:
                 self._parked.pop(slot, None)
         self._running[slot] = None
         req.stats.t_done = time.monotonic()
+        # EWMA of decode lengths feeds the admission cost model (token
+        # budget = prompt + predicted decode, not request counts)
+        self._avg_decode += 0.2 * (req.stats.n_generated - self._avg_decode)
         req.trace.event("finish", reason=reason, slot=slot,
                         n_generated=req.stats.n_generated)
         with self._lock:
@@ -432,6 +590,9 @@ class Scheduler:
         self.total_generated += 1
         req._t_last_emit = time.monotonic()
         req.trace.event("first_token")
+        self._limiter.debit(req.tenant, 1)
+        METRICS.inc("tpu_model_tenant_decode_tokens_total", 1.0,
+                    f'{{tenant="{req.tenant}"}}')
         req.out.put(("tokens", [tid]))
         return req.stats.n_generated < req.max_tokens
 
@@ -471,12 +632,21 @@ class Scheduler:
         return freed
 
     def _next_waiting(self) -> Optional[Request]:
-        if self._preempted:
-            return self._preempted.pop(0)
-        try:
-            return self._waiting.get_nowait()
-        except queue.Empty:
-            return None
+        """Priority-aware head of the waiting line. Preempted requests
+        still re-admit ahead of queued ones OF THE SAME CLASS (they
+        already held a place in line), but a queued higher-priority
+        request now beats a preempted lower-priority one — the FIFO
+        version of this method is what made overload ordering
+        arbitrary."""
+        best_i = None
+        for i, r in enumerate(self._preempted):
+            if best_i is None or r.rank < self._preempted[best_i].rank:
+                best_i = i
+        qrank = self._admission.peek_rank()
+        if best_i is not None:
+            if qrank is None or self._preempted[best_i].rank <= qrank:
+                return self._preempted.pop(best_i)
+        return self._admission.pop()
 
     def _evict_one_parked(self, n_pages: int = 1) -> bool:
         """Return cached pages to the pool under pressure. Radix mode:
@@ -530,20 +700,39 @@ class Scheduler:
         ps = getattr(self.engine.ecfg, "page_size", 1) or 1
         return -(-n_tokens // ps) + 1
 
-    def _shed(self, req: Request):
-        """Reject a request whose deadline expired while it waited for a
-        slot. The caller never got a token, so this maps to 503 +
-        Retry-After (DeadlineExceeded raised from chunks()) rather than
-        a terminal stream frame."""
-        retry_after = min(30, max(1, self.qsize))
-        req.error = "deadline exceeded while queued"
+    def _observe_wait(self, req: Request):
+        """Record the request's queue wait (global + per-class series).
+        Every way out of the waiting line observes exactly once: first
+        admission (_post_admit) or any shed — a shed IS the end of that
+        request's wait, and a wait histogram that drops its worst
+        entries under overload reads dangerously healthy."""
+        wait = max(time.monotonic() - req.stats.t_submit, 0.0)
+        METRICS.observe("tpu_model_queue_wait_seconds", wait)
+        METRICS.observe("tpu_model_class_queue_wait_seconds", wait,
+                        f'{{class="{req.priority}"}}')
+
+    def _shed(self, req: Request, cause: str = "deadline"):
+        """Reject a request that will never hold a slot: deadline
+        expired while it waited (cause="deadline") or it was displaced
+        by a higher-priority arrival under queue pressure
+        (cause="queue_full"). The caller never got a token, so this
+        maps to 503 + Retry-After (DeadlineExceeded raised from
+        chunks()) rather than a terminal stream frame."""
+        retry_after = self._retry_after_estimate(req.rank)
+        req.error = ("deadline exceeded while queued"
+                     if cause == "deadline"
+                     else "shed under queue pressure by a "
+                          "higher-priority request")
         req.stats.t_done = time.monotonic()
-        req.trace.event("shed", cause="deadline_queued")
-        FLIGHT.record("shed", rid=req.id, cause="deadline_queued",
-                      retry_after_s=retry_after)
+        req.trace.event("shed", cause=cause)
+        FLIGHT.record("shed", rid=req.id, cause=cause, cls=req.priority,
+                      tenant=req.tenant, retry_after_s=retry_after)
         with self._lock:
             self.finished.append(req.stats)
+        self._observe_wait(req)
         METRICS.inc("tpu_model_requests_shed_total")
+        METRICS.inc("tpu_model_shed_total",
+                    labels=shed_labels(req.priority, cause))
         req.out.put(("shed", (req.error, retry_after)))
 
     def _shed_expired(self):
@@ -559,29 +748,28 @@ class Scheduler:
         def dead(r):
             return expired(r) or r.cancelled.is_set()
 
-        victims: List[Request] = []
-        with self._waiting.mutex:
-            q = self._waiting.queue  # deque; safe to edit under mutex
-            if any(dead(r) for r in q):
-                victims.extend(r for r in q if dead(r))
-                keep = [r for r in q if not dead(r)]
-                q.clear()
-                q.extend(keep)
-        for req in victims:
+        for req in self._admission.sweep(dead):
             if req.cancelled.is_set():
                 req.out.put(("done", "cancelled"))
             else:
                 self._shed(req)
-        # a preempted request already streamed tokens from its first
-        # admission — its expiry is a mid-generation timeout (terminal
-        # frame), not a shed
-        for req in [r for r in self._preempted if expired(r)]:
-            self._preempted.remove(req)
-            req.stats.t_done = time.monotonic()
-            with self._lock:
-                self.finished.append(req.stats)
-            METRICS.inc("tpu_model_request_timeouts_total")
-            req.out.put(("done", "timeout"))
+        # throttled requests whose rate-limit debt has drained become
+        # ordinary preempted requests again (same resume machinery)
+        ripe = [r for r in self._throttled if r.resume_at <= now]
+        for req in ripe:
+            self._throttled.remove(req)
+            self._preempted.append(req)
+        # a preempted/throttled request already streamed tokens from its
+        # first admission — its expiry is a mid-generation timeout
+        # (terminal frame), not a shed
+        for pool in (self._preempted, self._throttled):
+            for req in [r for r in pool if expired(r)]:
+                pool.remove(req)
+                req.stats.t_done = time.monotonic()
+                with self._lock:
+                    self.finished.append(req.stats)
+                METRICS.inc("tpu_model_request_timeouts_total")
+                req.out.put(("done", "timeout"))
 
     def _request_error(self, req: Request, msg: str):
         """Terminal error frame for a request that never held (or just
@@ -602,9 +790,7 @@ class Scheduler:
             # must not re-count its prompt in throughput stats (nor
             # re-observe its queue wait: that wait already happened)
             self.total_prompt += req.stats.n_prompt
-            METRICS.observe("tpu_model_queue_wait_seconds",
-                            max(time.monotonic() - req.stats.t_submit,
-                                0.0))
+            self._observe_wait(req)
         req.stats.t_admitted = time.monotonic()
         req.trace.event("admitted", slot=slot,
                         reused=int(req.stats.n_reused))
@@ -634,10 +820,33 @@ class Scheduler:
         elif req.constraint is not None:
             self.engine.set_mask(slot, req.constraint.mask_row())
 
+    def _expired_at_admission(self, req: Request) -> bool:
+        """Deadline re-check at the moment a request is about to touch
+        the engine. A request can expire AFTER the `_next_waiting` pop —
+        earlier admissions in the same pass block on prefill dispatches —
+        and admitting it anyway wastes a full prefill before a
+        mid-generation `timeout`. A fresh request (never emitted a
+        token) sheds with 503 + Retry-After; a resumed one already
+        streamed tokens, so its expiry stays a terminal timeout frame.
+        Returns True when the request was terminated here."""
+        if req.deadline is None or time.monotonic() <= req.deadline:
+            return False
+        if req.resume_ids is not None:
+            METRICS.inc("tpu_model_request_timeouts_total")
+            req.stats.t_done = time.monotonic()
+            with self._lock:
+                self.finished.append(req.stats)
+            req.out.put(("done", "timeout"))
+        else:
+            self._shed(req)
+        return True
+
     def _admit_one(self, slot: int, req: Request, reuse_len: int) -> bool:
         """One blocking admission (fresh or prefix-reusing). Returns
         False when the paged pool ran dry and the request was requeued —
         the caller should stop admitting this pass."""
+        if self._expired_at_admission(req):
+            return True
         t0 = time.perf_counter()
         try:
             mask_row = (req.constraint.mask_row()
@@ -701,6 +910,8 @@ class Scheduler:
         the remaining pieces interleave with decode dispatches
         (_advance_prefill). Returns False when the paged pool ran dry and
         the request was requeued."""
+        if self._expired_at_admission(req):
+            return True
         ids = req.admit_ids
         end = reuse_len + self.prefill_chunk
         t0 = time.perf_counter()
@@ -778,8 +989,17 @@ class Scheduler:
             self._abort_prefill(slot, "cancelled")
             return
         if req.deadline is not None and time.monotonic() > req.deadline:
-            METRICS.inc("tpu_model_request_timeouts_total")
-            self._abort_prefill(slot, "timeout")
+            if req.resume_ids is None:
+                # no token ever reached the client: this is a shed
+                # (503 + Retry-After), not a mid-generation timeout
+                self._prefilling.pop(slot)
+                self._running[slot] = None
+                req.slot = None
+                self.engine.release(slot)
+                self._shed(req)
+            else:
+                METRICS.inc("tpu_model_request_timeouts_total")
+                self._abort_prefill(slot, "timeout")
             return
         ids = req.admit_ids
         end = min(job.done + self.prefill_chunk, len(ids))
@@ -825,6 +1045,10 @@ class Scheduler:
         whose batched dispatch failed) fall back to sequential
         admission."""
         for bucket, items in batch.items():
+            # deadlines re-checked here too: earlier groups' dispatches
+            # may have burned this batch's remaining budget
+            items = [(s, r) for s, r in items
+                     if not self._expired_at_admission(r)]
             while len(items) >= 2:
                 m = 4 if len(items) >= 4 else 2
                 group, items = items[:m], items[m:]
@@ -1041,14 +1265,11 @@ class Scheduler:
         self._fence_ack = 0
 
     def _drain_waiting(self, msg):
-        for req in self._preempted:
+        for req in self._preempted + self._throttled:
             req.out.put(msg)
         self._preempted.clear()
-        while True:
-            try:
-                req = self._waiting.get_nowait()
-            except queue.Empty:
-                return
+        self._throttled.clear()
+        for req in self._admission.drain():
             req.out.put(msg)
 
     def _relieve_pressure(self, n_steps: Optional[int]):
@@ -1079,29 +1300,105 @@ class Scheduler:
             if not cand:
                 return  # nothing actionable; decode_n will surface it
             non_mm = [s for s in cand if self._running[s].embeds is None]
-            slot = (non_mm or cand)[0]
-            req = self._running[slot]
-            self._running[slot] = None
-            self.engine.release(slot)
-            if req.embeds is None:
-                req.resume_ids = np.concatenate(
-                    [req.prompt_ids,
-                     np.asarray(req.all_tokens, np.int32)])
-                req.slot = None
-                self.n_preemptions += 1
-                METRICS.inc("tpu_model_preemptions_total")
-                req.trace.event("preempted", slot=slot,
-                                n_generated=req.stats.n_generated)
-                FLIGHT.record("preempt", rid=req.id, slot=slot,
-                              n_generated=req.stats.n_generated)
-                self._preempted.append(req)
+            if non_mm:
+                # priority-aware sacrifice: lowest class first, newest
+                # admission within a class — a best_effort straggler
+                # yields its pages before any high request does
+                slot = max(non_mm,
+                           key=lambda s: (self._running[s].rank,
+                                          self._running[s].stats.t_admitted))
+                self._preempt_slot(slot, cause="pool_pressure")
             else:
+                slot = cand[0]
+                req = self._running[slot]
+                self._running[slot] = None
+                self.engine.release(slot)
                 req.error = ("preempted under KV-pool pressure; multimodal "
                              "requests cannot resume")
                 req.stats.t_done = time.monotonic()
                 with self._lock:
                     self.finished.append(req.stats)
                 req.out.put(("error", req.error))
+
+    def _preempt_slot(self, slot: int, cause: str,
+                      resume_delay: float = 0.0) -> Request:
+        """Evict a running (non-multimodal) request from its slot,
+        recording resume_ids so re-admission re-prefills prompt+generated
+        onto the same output stream (seed-identical for greedy). With
+        ``resume_delay`` the request parks in _throttled and only
+        becomes admissible once its rate-limit debt drains."""
+        req = self._running[slot]
+        self._running[slot] = None
+        self.engine.release(slot)
+        req.resume_ids = np.concatenate(
+            [req.prompt_ids, np.asarray(req.all_tokens, np.int32)])
+        req.slot = None
+        self.n_preemptions += 1
+        METRICS.inc("tpu_model_preemptions_total")
+        req.trace.event("preempted", slot=slot, cause=cause,
+                        n_generated=req.stats.n_generated)
+        FLIGHT.record("preempt", rid=req.id, slot=slot, cause=cause,
+                      n_generated=req.stats.n_generated)
+        if resume_delay > 0.0:
+            req.resume_at = time.monotonic() + resume_delay
+            self._throttled.append(req)
+        else:
+            self._preempted.append(req)
+        return req
+
+    def _preempt_for_priority(self):
+        """With every slot busy and a strictly-higher-priority request
+        waiting, evict ONE lowest-priority running request (newest
+        admission breaks ties) so the high request's TTFT doesn't hide
+        behind a best_effort generation. At most one victim per step —
+        the freed slot is admitted this same pass, so pressure converges
+        without thrashing. Gated by TPU_PRIORITY_PREEMPT (default on)."""
+        if not self._priority_preempt:
+            return
+        if any(s not in self._prefilling
+               for s in self.engine.free_slots()):
+            return
+        ranks = [r.rank for r in self._preempted]
+        qrank = self._admission.peek_rank()
+        if qrank is not None:
+            ranks.append(qrank)
+        if not ranks:
+            return
+        want = min(ranks)
+        cand = [s for s, r in enumerate(self._running)
+                if r is not None and s not in self._prefilling
+                and r.embeds is None and r.rank > want]
+        if not cand:
+            return
+        slot = max(cand, key=lambda s: (self._running[s].rank,
+                                        self._running[s].stats.t_admitted))
+        self._preempt_slot(slot, cause="priority")
+
+    def _throttle_over_limit(self):
+        """Mid-stream rate limiting: a best_effort slot whose tenant's
+        decode-token bucket has gone negative is preempted (same
+        resume machinery — the surviving stream is bit-identical for
+        greedy sampling) and parks in _throttled until the debt drains.
+        Higher classes are debited but never throttled."""
+        if not self._limiter.enabled:
+            return
+        for slot, req in list(self._decoding().items()):
+            if (req.priority != "best_effort" or req.embeds is not None
+                    or req.stats.n_generated <= 0):
+                continue
+            delay = self._limiter.debt_delay(req.tenant)
+            if delay <= 0.0:
+                continue
+            self.n_throttles += 1
+            METRICS.inc(
+                "tpu_model_tenant_throttles_total",
+                labels=f'{{class="{req.priority}",tenant="{req.tenant}"}}')
+            req.trace.event("throttled", tenant=req.tenant,
+                            delay_ms=round(delay * 1e3, 1))
+            FLIGHT.record("throttle", rid=req.id, slot=slot,
+                          tenant=req.tenant, cls=req.priority,
+                          delay_ms=round(delay * 1e3, 1))
+            self._preempt_slot(slot, cause="throttle", resume_delay=delay)
 
     def _build_drafts(self, k: int, tails: Optional[dict] = None):
         """Prompt-lookup drafts [B, k] (zero-padded past each slot's
@@ -1258,6 +1555,8 @@ class Scheduler:
 
     def _step(self):
         self._shed_expired()
+        self._throttle_over_limit()
+        self._preempt_for_priority()
         self._advance_prefill()
         self._admit_waiting()
         if not self._decoding():
@@ -1445,6 +1744,12 @@ class Scheduler:
                         "tpu_model_itl_seconds",
                         max(now - req._t_last_emit, 0.0) / len(buf))
                 req._t_last_emit = now
+                # tenant accounting at delivery time — every class pays
+                # into its bucket; only best_effort is throttled on debt
+                self._limiter.debit(req.tenant, len(buf))
+                METRICS.inc("tpu_model_tenant_decode_tokens_total",
+                            float(len(buf)),
+                            f'{{tenant="{req.tenant}"}}')
                 req.out.put(("tokens", buf))
 
         for row_idx, row in enumerate(np.asarray(toks_n)):

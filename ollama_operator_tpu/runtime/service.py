@@ -27,6 +27,8 @@ from ..models.config import ModelConfig
 from ..server.metrics import GLOBAL as METRICS
 from ..server.template import DEFAULT_TEMPLATE, Template
 from ..tokenizer import StreamDecoder, Tokenizer
+from .admission import (resolve_priority, resolve_tenant,
+                        resolve_ttft_slo_s)
 from .engine import Engine, EngineConfig, SlotOptions
 from .errors import BadRequest
 from .faults import FAULTS
@@ -479,6 +481,11 @@ class LoadedModel:
                                     eog_ids=frozenset(self.tokenizer.eog_ids),
                                     embeds=embeds, constraint=constraint,
                                     deadline_s=resolve_deadline_s(
+                                        self.default_params, options),
+                                    priority=resolve_priority(
+                                        self.default_params, options),
+                                    tenant=resolve_tenant(options),
+                                    ttft_slo_s=resolve_ttft_slo_s(
                                         self.default_params, options))
         # opt-in span summary in the final frame: options.trace=true
         # (merge_options ignores unknown keys, so "trace" never reaches
@@ -711,6 +718,10 @@ class _IdleScheduler:
     spec_k = 0
     spec_drafted = 0
     spec_accepted = 0
+    n_throttles = 0
+
+    def admission_stats(self) -> dict:
+        return {}   # encoders have no waiting line to police
 
     def shutdown(self):
         pass

@@ -44,6 +44,7 @@ from .. import __version__
 from ..gguf.reader import GGUFFile
 from ..gguf.transcode import load_model as transcode_load
 from ..runtime.engine import EngineConfig, resolve_serving_defaults
+from ..runtime.admission import TenantRateLimited, tenant_from_key
 from ..runtime.errors import BadRequest, DeadlineExceeded, FollowerLost
 from ..runtime.scheduler import SchedulerBroken, SchedulerBusy
 from ..runtime.service import LoadedModel
@@ -656,6 +657,11 @@ class ModelManager:
                               / lm.scheduler.spec_drafted, 4)
                         if lm.scheduler.spec_drafted else 0.0),
                 },
+                # overload discipline: live admission-policy snapshot —
+                # per-class queue depth / token backlog, WDRR tenant
+                # state, throttles, and the knobs in force (empty for
+                # encoder models, which have no waiting line)
+                "admission": lm.scheduler.admission_stats(),
             })
         return out
 
@@ -1124,8 +1130,16 @@ class Handler(BaseHTTPRequestHandler):
                     "Retry-After": str(int(e.retry_after_s))})
             else:
                 self._send_error(str(e), 504)
+        except TenantRateLimited as e:
+            # THIS tenant is over its share; everyone else is fine —
+            # 429, so client-side backoff stays per-tenant
+            self._send_error(str(e), 429, headers={
+                "Retry-After": str(int(getattr(e, "retry_after_s", 1)))})
         except SchedulerBusy as e:
-            self._send_error(str(e), 503, headers={"Retry-After": "1"})
+            # queue-full and SLO early rejects both carry a computed
+            # Retry-After (queue-model drain estimate), not a flat 1s
+            self._send_error(str(e), 503, headers={
+                "Retry-After": str(int(getattr(e, "retry_after_s", 1)))})
         except SchedulerBroken as e:
             self._send_error(str(e), 500)
         except FollowerLost as e:
@@ -1145,6 +1159,23 @@ class Handler(BaseHTTPRequestHandler):
         if not model:
             raise ApiError(400, "missing 'model'")
         return model
+
+    def _inject_tenant(self, options: Optional[Dict]) -> Optional[Dict]:
+        """Fair-queuing tenant from transport headers when the body
+        didn't name one: ``X-Tenant`` verbatim, else a stable hash of
+        the API key (``X-API-Key`` / ``Authorization``) — keyed clients
+        get per-key fairness without any body change. Returns the
+        options dict (possibly unchanged) for generate_stream."""
+        o = dict(options or {})
+        if not o.get("tenant"):
+            t = self.headers.get("X-Tenant")
+            if not t:
+                key = (self.headers.get("X-API-Key")
+                       or self.headers.get("Authorization"))
+                t = tenant_from_key(key) if key else None
+            if t:
+                o["tenant"] = t
+        return o or None
 
     def _api_generate(self, body: Dict):
         model = self._model_arg(body)
@@ -1170,7 +1201,9 @@ class Handler(BaseHTTPRequestHandler):
         text_prompt = prompt if raw else lm.render_prompt(
             prompt, system=body.get("system"),
             template=body.get("template"), suffix=body.get("suffix"))
-        gen = lm.generate_stream(text_prompt, options=body.get("options"),
+        gen = lm.generate_stream(text_prompt,
+                                 options=self._inject_tenant(
+                                     body.get("options")),
                                  context=body.get("context"), raw=raw,
                                  images=_decode_images(body.get("images")),
                                  format=body.get("format"))
@@ -1238,7 +1271,9 @@ class Handler(BaseHTTPRequestHandler):
         images = []
         for m in messages:
             images.extend(m.get("images") or [])
-        gen = lm.generate_stream(prompt, options=body.get("options"),
+        gen = lm.generate_stream(prompt,
+                                 options=self._inject_tenant(
+                                     body.get("options")),
                                  images=_decode_images(images),
                                  format=body.get("format"))
 
@@ -1481,7 +1516,9 @@ class Handler(BaseHTTPRequestHandler):
                        else None) or "json"
             elif rf.get("type") == "json_object":
                 fmt = "json"
-        gen = lm.generate_stream(prompt, options=options, format=fmt)
+        gen = lm.generate_stream(prompt,
+                                 options=self._inject_tenant(options),
+                                 format=fmt)
         if tools:
             # buffer and answer as one completion: tool invocations are
             # parsed from the full output
@@ -1607,7 +1644,8 @@ class Handler(BaseHTTPRequestHandler):
             options["temperature"] = body["temperature"]
         if body.get("stop"):
             options["stop"] = body["stop"]
-        final = lm.generate(body.get("prompt", ""), options=options)
+        final = lm.generate(body.get("prompt", ""),
+                            options=self._inject_tenant(options))
         self._send_json({
             "id": f"cmpl-{int(time.time() * 1000)}",
             "object": "text_completion", "created": int(time.time()),

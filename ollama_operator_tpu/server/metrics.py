@@ -89,6 +89,19 @@ class Metrics:
                 self._hists[k] = Histogram()
             self._hists[k].observe(v)
 
+    def hist_totals(self, name: str) -> Tuple[int, float]:
+        """(observation count, value sum) aggregated across every label
+        set of a histogram — e.g. total device busy-seconds across all
+        tpu_model_dispatch_seconds program kinds, for the admission
+        queue model's throughput estimate. (0, 0.0) when never observed."""
+        with self._lock:
+            n, total = 0, 0.0
+            for (hname, _labels), h in self._hists.items():
+                if hname == name:
+                    n += h.n
+                    total += h.total
+            return n, total
+
     def render(self) -> str:
         with self._lock:
             # evaluate gauge callables FIRST: a failing one is counted in
@@ -211,7 +224,28 @@ GLOBAL.describe("tpu_model_itl_seconds",
 GLOBAL.describe("tpu_model_queue_wait_seconds",
                 "Submit-to-first-admission wait histogram (first "
                 "admission only; a preempted request's re-admission "
-                "does not re-observe)")
+                "does not re-observe). Shed requests observe their "
+                "submit-to-shed wait here too — a shed IS the end of "
+                "that request's queue wait")
+GLOBAL.describe("tpu_model_class_queue_wait_seconds",
+                "Queue wait histogram by priority class "
+                "(class=high|normal|best_effort): same observation "
+                "points as tpu_model_queue_wait_seconds, labelled — "
+                "the per-class p99 the overload SLO gates on")
+GLOBAL.describe("tpu_model_shed_total",
+                "Requests shed before holding a slot, by priority "
+                "class and cause (cause=queue_full|deadline|"
+                "slo_predict|tenant_cap); class=\"high\" staying 0 "
+                "under overload is the admission policy's contract")
+GLOBAL.describe("tpu_model_tenant_throttles_total",
+                "Mid-stream throttle preemptions of over-rate tenants "
+                "(per-tenant decode-token rate limits; best-effort "
+                "class only — the request resumes on the same stream "
+                "once the token bucket refills)")
+GLOBAL.describe("tpu_model_tenant_decode_tokens_total",
+                "Decode tokens delivered per tenant "
+                "(tenant=\"default\" is the no-key bucket) — the "
+                "series behind WDRR fairness dashboards")
 GLOBAL.describe("tpu_model_dispatch_seconds",
                 "Device dispatch latency histogram by program kind "
                 "(kind=decode|admit|extend|spec): launch to tokens on "
@@ -265,6 +299,19 @@ for _name in ("tpu_model_engine_restarts_total",
 for _cause in ("grammar", "spec", "paged_dp"):
     GLOBAL.inc("tpu_model_async_fallback_total", 0.0,
                f'{{cause="{_cause}"}}')
+# admission-control counters: every class × cause combination pre-seeded
+# so overload alert rules (and the tpu_model_shed_total{class="high"}==0
+# invariant check) read 0, not absent, on a healthy server. Label keys
+# are rendered in sorted order (class before cause) — reads via
+# METRICS.get must use the identical string (admission.shed_labels)
+for _class in ("high", "normal", "best_effort"):
+    for _cause in ("queue_full", "deadline", "slo_predict", "tenant_cap"):
+        GLOBAL.inc("tpu_model_shed_total", 0.0,
+                   f'{{class="{_class}",cause="{_cause}"}}')
+GLOBAL.inc("tpu_model_tenant_throttles_total", 0.0,
+           '{class="best_effort",tenant="default"}')
+GLOBAL.inc("tpu_model_tenant_decode_tokens_total", 0.0,
+           '{tenant="default"}')
 
 
 class Stopwatch:

@@ -1,0 +1,500 @@
+"""Admission control: priority classes, WDRR tenant fairness, SLO-aware
+early rejection, and per-tenant rate limiting (runtime/admission.py).
+
+The invariants under test (ISSUE 8 acceptance):
+- strict-priority dequeue (high before normal before best_effort) and
+  shed-lowest-first displacement when the queue is full;
+- weighted deficit round-robin serves tenants by TOKEN budget, not
+  request count — 3 equal tenants each get 33±10% of the served tokens
+  even when their per-request costs differ, and 2:1:1 weights track a
+  2:1:1 token split;
+- early-reject Retry-After is finite, clamped to [1, 120], and monotone
+  in the backlog it is computed from;
+- a best-effort stream throttled mid-generation by a tenant rate limit
+  resumes on the same output queue with BIT-IDENTICAL greedy tokens;
+- a queued request whose deadline expires at the admission boundary is
+  shed with 503 + Retry-After, never admitted into a doomed prefill;
+- chaos: an engine failure mid-overload restarts supervised, queued
+  requests keep their class ordering, and the in-flight request errors
+  exactly once.
+"""
+
+import itertools
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+from ollama_operator_tpu.runtime.admission import (
+    PRIORITIES, PRIORITY_RANK, AdmissionQueue, TenantRateLimiter,
+    resolve_priority, resolve_tenant, resolve_ttft_slo_s, retry_after_s,
+    shed_labels, tenant_from_key)
+from ollama_operator_tpu.runtime.errors import BadRequest, DeadlineExceeded
+from ollama_operator_tpu.runtime.faults import FAULTS
+from ollama_operator_tpu.runtime.scheduler import (SchedulerBusy,
+                                                   SchedulerOverloaded)
+from ollama_operator_tpu.server.metrics import GLOBAL as METRICS
+
+from test_scheduler import GREEDY, make_stack
+from test_stall_free import manual
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    FAULTS.reset()
+
+
+# -- fake requests for queue-only unit tests ---------------------------
+
+_seq = itertools.count()
+
+
+def fake(priority="normal", tenant="default", cost=32.0):
+    """The slice of Request the AdmissionQueue actually touches."""
+    r = types.SimpleNamespace(
+        priority=priority, rank=PRIORITY_RANK[priority], tenant=tenant,
+        cost=float(cost),
+        stats=types.SimpleNamespace(t_submit=float(next(_seq))))
+    return r
+
+
+# -- option resolution -------------------------------------------------
+
+def test_resolve_priority_precedence(monkeypatch):
+    assert resolve_priority(None, None) == "normal"
+    monkeypatch.setenv("TPU_DEFAULT_PRIORITY", "best_effort")
+    assert resolve_priority(None, None) == "best_effort"
+    # Modelfile default beats env; request beats Modelfile
+    assert resolve_priority({"priority": "normal"}, None) == "normal"
+    assert resolve_priority({"priority": "normal"},
+                            {"priority": "HIGH"}) == "high"
+    with pytest.raises(BadRequest):
+        resolve_priority(None, {"priority": "urgent"})
+
+
+def test_resolve_tenant_sanitises():
+    assert resolve_tenant(None) == "default"
+    assert resolve_tenant({"tenant": "team-a"}) == "team-a"
+    hashed = resolve_tenant({"tenant": "spaces and \n junk"})
+    assert hashed.startswith("t-") and len(hashed) == 14
+    # hashing is stable — the same ugly name lands in the same bucket
+    assert hashed == resolve_tenant({"tenant": "spaces and \n junk"})
+
+
+def test_tenant_from_key_never_leaks_the_key():
+    t = tenant_from_key("Bearer super-secret-key")
+    assert "super-secret-key" not in t
+    assert t.startswith("key-")
+    assert t == tenant_from_key("super-secret-key")  # prefix-insensitive
+    assert tenant_from_key("   ") == "default"
+
+
+def test_resolve_ttft_slo(monkeypatch):
+    assert resolve_ttft_slo_s(None, None) is None
+    assert resolve_ttft_slo_s(None, {"ttft_slo_ms": 250}) == 0.25
+    assert resolve_ttft_slo_s(None, {"ttft_slo_ms": 0}) is None
+    monkeypatch.setenv("TPU_TTFT_SLO_MS", "500")
+    assert resolve_ttft_slo_s(None, None) == 0.5
+    with pytest.raises(BadRequest):
+        resolve_ttft_slo_s(None, {"ttft_slo_ms": "soon"})
+
+
+# -- strict-priority dequeue and displacement --------------------------
+
+def test_priority_dequeue_ordering():
+    q = AdmissionQueue(max_queue=16, weights={}, quantum=64)
+    # arrival order deliberately inverted vs priority
+    order_in = ["best_effort", "normal", "high", "best_effort", "high",
+                "normal"]
+    for p in order_in:
+        q.offer(fake(p))
+    out = []
+    while True:
+        r = q.pop()
+        if r is None:
+            break
+        out.append(r.priority)
+    assert out == ["high", "high", "normal", "normal",
+                   "best_effort", "best_effort"]
+
+
+def test_offer_displaces_newest_lowest_class():
+    q = AdmissionQueue(max_queue=3, weights={}, quantum=64)
+    be_old = fake("best_effort")
+    nm = fake("normal")
+    be_new = fake("best_effort")
+    for r in (be_old, nm, be_new):
+        assert q.offer(r) == (True, None)
+    # full: a high arrival displaces the NEWEST best_effort, not the old
+    accepted, victim = q.offer(fake("high"))
+    assert accepted and victim is be_new
+    # full of equal-or-higher classes: the lowest incoming is rejected
+    accepted, victim = q.offer(fake("best_effort"))
+    assert (accepted, victim) == (False, None)
+    # ...and rank counts: a normal cannot displace another normal
+    q2 = AdmissionQueue(max_queue=1, weights={}, quantum=64)
+    q2.offer(fake("normal"))
+    assert q2.offer(fake("normal")) == (False, None)
+
+
+def test_backlog_tokens_counts_equal_or_higher_priority():
+    q = AdmissionQueue(max_queue=16, weights={}, quantum=64)
+    q.offer(fake("high", cost=100))
+    q.offer(fake("normal", cost=10))
+    q.offer(fake("best_effort", cost=1))
+    assert q.backlog_tokens(PRIORITY_RANK["high"]) == 100
+    assert q.backlog_tokens(PRIORITY_RANK["normal"]) == 110
+    assert q.backlog_tokens(PRIORITY_RANK["best_effort"]) == 111
+
+
+# -- WDRR token-budget fairness ----------------------------------------
+
+def _served_shares(q, tenants, n_pops):
+    served = {t: 0.0 for t in tenants}
+    for _ in range(n_pops):
+        r = q.pop()
+        assert r is not None
+        served[r.tenant] += r.cost
+    total = sum(served.values())
+    return {t: served[t] / total for t in tenants}
+
+
+def test_wdrr_equal_weights_equal_token_shares():
+    """Equal weights, UNEQUAL request costs: tenant a sends 64-token
+    requests, b and c send 32-token ones — token shares still equalise
+    (a is served half as many requests). Request-count round-robin
+    would give a a 50% token share here."""
+    q = AdmissionQueue(max_queue=10_000, weights={}, quantum=32)
+    for _ in range(40):
+        q.offer(fake("normal", "a", cost=64))
+        q.offer(fake("normal", "b", cost=32))
+        q.offer(fake("normal", "c", cost=32))
+    # measure inside the backlogged window only (all tenants nonempty)
+    shares = _served_shares(q, "abc", 60)
+    for t in "abc":
+        assert abs(shares[t] - 1 / 3) <= 0.05, \
+            f"tenant {t} token share {shares[t]:.3f} not ~1/3"
+
+
+def test_wdrr_weighted_2_1_1():
+    q = AdmissionQueue(max_queue=10_000,
+                       weights={"a": 2.0, "b": 1.0, "c": 1.0}, quantum=32)
+    for _ in range(60):
+        for t in "abc":
+            q.offer(fake("normal", t, cost=32))
+    shares = _served_shares(q, "abc", 80)
+    assert abs(shares["a"] - 0.50) <= 0.05, shares
+    assert abs(shares["b"] - 0.25) <= 0.05, shares
+    assert abs(shares["c"] - 0.25) <= 0.05, shares
+
+
+def test_wdrr_idle_tenant_accrues_no_credit():
+    """Classic DRR: a tenant that drains and re-enters starts from a
+    clean deficit — idling must not bank a burst allowance."""
+    q = AdmissionQueue(max_queue=10_000, weights={}, quantum=32)
+    q.offer(fake("normal", "a", cost=32))
+    assert q.pop().tenant == "a"          # a drains and goes idle
+    for _ in range(10):
+        q.offer(fake("normal", "b", cost=32))
+    q.offer(fake("normal", "a", cost=32))  # a re-enters
+    got = [q.pop().tenant for _ in range(6)]
+    # a gets its fair alternating share, not a catch-up burst
+    assert got.count("a") == 1
+
+
+# -- Retry-After: clamped, monotone ------------------------------------
+
+def test_retry_after_unit_monotone_and_clamped():
+    waits = [0.0, 0.5, 2.0, 10.0, 50.0, 1e9]
+    vals = [retry_after_s(w, 1.0, 100.0) for w in waits]
+    assert vals == sorted(vals)
+    assert vals[0] == 1                    # floor
+    assert vals[-1] == 120                 # ceiling
+    assert all(1 <= v <= 120 for v in vals)
+
+
+def test_early_reject_retry_after_monotone_in_backlog(monkeypatch):
+    """Scheduler-level: with throughput pinned, a growing backlog must
+    produce non-decreasing (and eventually growing) Retry-After values
+    on consecutive early rejections."""
+    monkeypatch.setenv("TPU_ADMIT_THROUGHPUT_TPS", "50")
+    sched = manual(make_stack(slots=1)[3])
+    try:
+        retries = []
+        for _ in range(3):
+            for _ in range(5):   # grow the backlog by ~5 requests
+                sched.submit(np.arange(1, 9, dtype=np.int32), GREEDY,
+                             max_tokens=32)
+            with pytest.raises(SchedulerOverloaded) as ei:
+                sched.submit(np.arange(1, 9, dtype=np.int32), GREEDY,
+                             max_tokens=32, ttft_slo_s=0.001)
+            retries.append(ei.value.retry_after_s)
+        assert retries == sorted(retries)
+        assert retries[-1] > retries[0]
+        assert all(1 <= r <= 120 for r in retries)
+    finally:
+        sched.shutdown()
+
+
+def test_slo_predictor_fails_open(monkeypatch):
+    """An armed admission.predict fault must ADMIT the request (the
+    predictor is an optimisation), never 500 it."""
+    monkeypatch.setenv("TPU_ADMIT_THROUGHPUT_TPS", "50")
+    sched = manual(make_stack(slots=1)[3])
+    try:
+        sched.submit(np.arange(1, 9, dtype=np.int32), GREEDY,
+                     max_tokens=32)  # backlog > 0
+        FAULTS.arm("admission.predict", "fail")
+        r = sched.submit(np.arange(1, 9, dtype=np.int32), GREEDY,
+                         max_tokens=32, ttft_slo_s=0.001)
+        assert sched.qsize == 2 and r.error is None
+    finally:
+        FAULTS.reset()
+        sched.shutdown()
+
+
+# -- satellite 1: queue-full shed carries Retry-After + observes wait --
+
+def test_queue_full_rejection_retry_after_and_wait_observed():
+    sched = manual(make_stack(slots=1)[3])
+    sched._admission.max_queue = 2
+    try:
+        for i in range(2):
+            sched.submit(np.array([i + 1], np.int32), GREEDY,
+                         max_tokens=8, priority="best_effort")
+        h0 = METRICS._hists.get(("tpu_model_queue_wait_seconds", ""))
+        n0 = h0.n if h0 else 0
+        c0 = METRICS.get("tpu_model_shed_total",
+                         shed_labels("best_effort", "queue_full"))
+        with pytest.raises(SchedulerBusy) as ei:
+            sched.submit(np.array([9], np.int32), GREEDY, max_tokens=8,
+                         priority="best_effort")
+        assert 1 <= ei.value.retry_after_s <= 120
+        h1 = METRICS._hists.get(("tpu_model_queue_wait_seconds", ""))
+        assert h1 is not None and h1.n == n0 + 1
+        assert METRICS.get("tpu_model_shed_total",
+                           shed_labels("best_effort",
+                                       "queue_full")) == c0 + 1
+    finally:
+        sched.shutdown()
+
+
+def test_queue_full_displacement_sheds_victim_with_retry_after():
+    sched = manual(make_stack(slots=1)[3])
+    sched._admission.max_queue = 2
+    try:
+        sched.submit(np.array([1], np.int32), GREEDY, max_tokens=8,
+                     priority="normal")
+        victim = sched.submit(np.array([2], np.int32), GREEDY,
+                              max_tokens=8, priority="best_effort")
+        high = sched.submit(np.array([3], np.int32), GREEDY, max_tokens=8,
+                            priority="high")
+        # the displaced best_effort request sees a 503-shaped shed
+        with pytest.raises(DeadlineExceeded) as ei:
+            list(victim.chunks())
+        assert ei.value.while_queued
+        assert 1 <= ei.value.retry_after_s <= 120
+        # ...and the high request took its place in the line
+        assert sched._admission.queued_for("default") == 2
+        assert high.error is None
+    finally:
+        sched.shutdown()
+
+
+# -- satellite 2: deadline re-checked at the admission boundary --------
+
+def test_deadline_expiry_swept_while_queued_is_shed_503():
+    sched = manual(make_stack(slots=1)[3])
+    try:
+        r = sched.submit(np.array([1, 2], np.int32), GREEDY, max_tokens=8,
+                         deadline_s=0.01)
+        time.sleep(0.03)
+        sched._shed_expired()
+        with pytest.raises(DeadlineExceeded) as ei:
+            list(r.chunks())
+        assert ei.value.while_queued
+        assert ei.value.retry_after_s >= 1
+    finally:
+        sched.shutdown()
+
+
+def test_deadline_recheck_at_admission_boundary():
+    """A request can expire BETWEEN the queue pop and the engine touch
+    (earlier admissions in the same pass block on prefill dispatches).
+    The boundary re-check must shed it — a fresh request never burns a
+    prefill on a guaranteed timeout."""
+    sched = manual(make_stack(slots=1)[3])
+    try:
+        r = sched.submit(np.array([1, 2], np.int32), GREEDY, max_tokens=8,
+                         deadline_s=0.01)
+        popped = sched._admission.pop()
+        assert popped is r
+        time.sleep(0.03)                      # expires post-pop
+        assert sched._expired_at_admission(r) is True
+        with pytest.raises(DeadlineExceeded) as ei:
+            list(r.chunks())
+        assert ei.value.while_queued
+        # a RESUMED request already streamed tokens: its expiry is a
+        # terminal timeout frame, not a shed
+        r2 = sched.submit(np.array([3, 4], np.int32), GREEDY, max_tokens=8,
+                          deadline_s=0.01)
+        sched._admission.pop()
+        r2.resume_ids = np.array([3, 4, 5], np.int32)
+        time.sleep(0.03)
+        assert sched._expired_at_admission(r2) is True
+        chunks = list(r2.chunks())
+        assert chunks == [] and r2.done_reason == "timeout"
+    finally:
+        sched.shutdown()
+
+
+# -- tenant caps and rate limiting -------------------------------------
+
+def test_tenant_queued_cap_is_429_not_503(monkeypatch):
+    from ollama_operator_tpu.runtime.admission import TenantRateLimited
+    monkeypatch.setenv("TPU_TENANT_MAX_QUEUED", "2")
+    sched = manual(make_stack(slots=1)[3])
+    try:
+        for i in range(2):
+            sched.submit(np.array([i + 1], np.int32), GREEDY, max_tokens=8,
+                         tenant="greedy-team")
+        with pytest.raises(TenantRateLimited) as ei:
+            sched.submit(np.array([9], np.int32), GREEDY, max_tokens=8,
+                         tenant="greedy-team")
+        assert not isinstance(ei.value, SchedulerBusy)  # 429, not 503
+        assert ei.value.retry_after_s >= 1
+        # OTHER tenants are unaffected — that is the whole point of 429
+        sched.submit(np.array([7], np.int32), GREEDY, max_tokens=8,
+                     tenant="polite-team")
+    finally:
+        sched.shutdown()
+
+
+def test_rate_limiter_debt_delay():
+    lim = TenantRateLimiter(rate_tps=10.0, burst_s=1.0)
+    assert lim.enabled
+    assert lim.debt_delay("t") == 0.0
+    lim.debit("t", 30)                     # 10-token bucket, 30 spent
+    d = lim.debt_delay("t")
+    assert 1.5 <= d <= 2.1                 # ~20 tokens of debt at 10 tps
+    assert lim.debt_delay("other") == 0.0  # per-tenant buckets
+    off = TenantRateLimiter(rate_tps=0.0)
+    off.debit("t", 1000)
+    assert not off.enabled and off.debt_delay("t") == 0.0
+
+
+def test_throttle_resume_bit_parity(monkeypatch):
+    """A best-effort stream throttled mid-generation (tenant over its
+    decode-token rate) must resume on the same output queue and deliver
+    the EXACT tokens of an unthrottled run."""
+    ids = np.array([3, 1, 4, 1, 5], np.int32)
+    cfg, params, eng, sched = make_stack(slots=1)
+    try:
+        base = list(sched.submit(ids, GREEDY, max_tokens=10,
+                                 priority="best_effort",
+                                 tenant="tt").tokens())
+        assert len(base) == 10
+    finally:
+        sched.shutdown()
+    monkeypatch.setenv("TPU_TENANT_TOKEN_RATE", "8")
+    monkeypatch.setenv("TPU_TENANT_BURST_S", "0.25")
+    cfg, params, eng, sched = make_stack(slots=1)
+    try:
+        r = sched.submit(ids, GREEDY, max_tokens=10,
+                         priority="best_effort", tenant="tt")
+        throttled = list(r.tokens())
+        assert throttled == base
+        assert r.done_reason in ("stop", "length")
+        assert sched.n_throttles >= 1, \
+            "rate limit never engaged — the parity check proved nothing"
+        assert METRICS.get(
+            "tpu_model_tenant_throttles_total",
+            '{class="best_effort",tenant="tt"}') >= 1
+    finally:
+        sched.shutdown()
+
+
+# -- chaos: engine failure mid-overload --------------------------------
+
+@pytest.mark.chaos
+def test_restart_mid_overload_preserves_class_order_errors_once():
+    """Engine dies mid-decode with a multi-class, multi-tenant backlog
+    queued behind it: the supervised restart must (a) error the
+    in-flight request EXACTLY once, (b) keep every queued request —
+    class and tenant intact — and (c) admit the survivors in strict
+    class order."""
+    cfg, params, eng, sched = make_stack(slots=1, restart_backoff=0.001)
+    try:
+        # the in-flight request is high-class: the queued "hi" request
+        # must not priority-preempt it out of the slot before the fault
+        # fires (preemption only evicts strictly lower classes)
+        victim = sched.submit(np.array([9, 9], np.int32), GREEDY,
+                              max_tokens=10_000, priority="high")
+        it = victim.chunks()
+        next(it)                           # decoding for sure
+        queued = {
+            "be_a": sched.submit(np.array([1], np.int32), GREEDY,
+                                 max_tokens=4, priority="best_effort",
+                                 tenant="a"),
+            "be_b": sched.submit(np.array([2], np.int32), GREEDY,
+                                 max_tokens=4, priority="best_effort",
+                                 tenant="b"),
+            "nm": sched.submit(np.array([3], np.int32), GREEDY,
+                               max_tokens=4, priority="normal"),
+            "hi": sched.submit(np.array([4], np.int32), GREEDY,
+                               max_tokens=4, priority="high"),
+        }
+        FAULTS.arm("engine.step", "fail:once")
+        frames = []
+        with pytest.raises(RuntimeError, match="injected fault"):
+            for chunk in it:
+                frames.append(chunk)
+        # exactly once: the stream is terminal after the error frame
+        assert victim.out.qsize() == 0
+
+        outs = {}
+        def drain(name, r):
+            outs[name] = list(r.tokens())
+        threads = [threading.Thread(target=drain, args=(n, r))
+                   for n, r in queued.items()]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert set(outs) == set(queued)
+        assert all(len(v) == 4 for v in outs.values()), outs
+        # class metadata survived the restart...
+        assert queued["hi"].priority == "high"
+        assert queued["be_a"].tenant == "a"
+        # ...and admission order after recovery is strict priority
+        t_hi = queued["hi"].stats.t_admitted
+        t_nm = queued["nm"].stats.t_admitted
+        t_be = min(queued["be_a"].stats.t_admitted,
+                   queued["be_b"].stats.t_admitted)
+        assert t_hi <= t_nm <= t_be, (t_hi, t_nm, t_be)
+        assert sched.n_restarts == 1 and not sched.broken
+    finally:
+        FAULTS.reset()
+        sched.shutdown()
+
+
+# -- /api/ps admission block -------------------------------------------
+
+def test_admission_stats_snapshot():
+    sched = manual(make_stack(slots=1)[3])
+    try:
+        sched.submit(np.array([1], np.int32), GREEDY, max_tokens=8,
+                     priority="high", tenant="a")
+        sched.submit(np.array([2], np.int32), GREEDY, max_tokens=8,
+                     priority="best_effort", tenant="b")
+        st = sched.admission_stats()
+        assert st["queued_by_class"]["high"] == 1
+        assert st["queued_by_class"]["best_effort"] == 1
+        assert st["tenants_queued"] == 2
+        assert st["backlog_tokens_by_class"]["high"] > 0
+        assert set(st["shed_by_class"]) == set(PRIORITIES)
+    finally:
+        sched.shutdown()

@@ -245,6 +245,47 @@ def test_preseeded_counters_present_when_idle():
             f"{name} absent from an idle scrape"
 
 
+def test_shed_counter_preseeds_full_label_matrix():
+    """ISSUE 8: every {class,cause} combination of tpu_model_shed_total
+    must exist at 0 before the first shed — a PromQL rate() over a
+    series that appears mid-incident reads as a counter reset. Same for
+    the per-tenant throttle/token series (default bucket)."""
+    from ollama_operator_tpu.runtime.admission import (PRIORITIES,
+                                                       SHED_CAUSES,
+                                                       shed_labels)
+    text = METRICS.render()
+    for p in PRIORITIES:
+        for c in SHED_CAUSES:
+            series = f"tpu_model_shed_total{shed_labels(p, c)}"
+            assert re.search(rf"^{re.escape(series)} [0-9.]+$", text,
+                             re.M), f"{series} not pre-seeded"
+    for series in (
+            'tpu_model_tenant_throttles_total'
+            '{class="best_effort",tenant="default"}',
+            'tpu_model_tenant_decode_tokens_total{tenant="default"}'):
+        assert re.search(rf"^{re.escape(series)} [0-9.]+$", text, re.M), \
+            f"{series} not pre-seeded"
+
+
+def test_admission_label_sets_pass_strict_validator():
+    """Sheds, per-class queue-wait observations, and per-tenant series
+    must render as parseable, HELP/TYPE-covered samples — label sets
+    with {class,tenant,cause} go through the same strict contract as
+    everything else."""
+    from ollama_operator_tpu.runtime.admission import shed_labels
+    METRICS.inc("tpu_model_shed_total",
+                labels=shed_labels("best_effort", "queue_full"))
+    METRICS.inc("tpu_model_tenant_throttles_total",
+                labels='{class="best_effort",tenant="unit-t"}')
+    METRICS.inc("tpu_model_tenant_decode_tokens_total", 5.0,
+                '{tenant="unit-t"}')
+    METRICS.observe("tpu_model_class_queue_wait_seconds", 0.002,
+                    '{class="high"}')
+    text = METRICS.render()
+    validate_prometheus_text(text)
+    assert 'tpu_model_class_queue_wait_seconds_bucket{class="high"' in text
+
+
 # -- strict Prometheus text-format validator ---------------------------
 
 _SAMPLE_RE = re.compile(

@@ -230,7 +230,7 @@ def test_cancel_queued_request_frees_queue_slot():
     """cancel() of a still-QUEUED request must free its queue capacity
     and terminate its stream with done:cancelled."""
     cfg, params, eng, sched = make_stack(slots=1)
-    sched._waiting.maxsize = 1
+    sched._admission.max_queue = 1
     import pytest
     from ollama_operator_tpu.runtime.scheduler import SchedulerBusy
     try:
@@ -255,7 +255,7 @@ def test_cancel_queued_request_frees_queue_slot():
 
 def test_queue_full_raises_busy():
     cfg, params, eng, sched = make_stack(slots=1)
-    sched._waiting.maxsize = 2
+    sched._admission.max_queue = 2
     import pytest
     from ollama_operator_tpu.runtime.scheduler import SchedulerBusy
     try:

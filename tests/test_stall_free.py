@@ -240,7 +240,7 @@ def test_scheduler_batches_same_bucket_admissions(eng, monkeypatch):
             sched._step()
             if (all(sched._running[s] is None
                     for s in range(eng.n_slots))
-                    and sched._waiting.empty()
+                    and sched._admission.empty()
                     and not sched._prefilling):
                 break
         outs = [list(r.tokens()) for r in reqs]
@@ -269,7 +269,7 @@ def test_admit_many_fault_falls_back_to_single(eng):
                 sched._step()
                 if all(sched._running[s] is None
                        for s in range(eng.n_slots)) \
-                        and sched._waiting.empty():
+                        and sched._admission.empty():
                     break
             outs = [list(r.tokens()) for r in reqs]
         finally:
