@@ -1627,6 +1627,177 @@ def measure_overload(jax, *, model: str, dtype: str, slots: int, steps: int,
     return rec
 
 
+def measure_restart(jax, *, model: str, dtype: str, slots: int, steps: int,
+                    seq: int, prompt_len: int, paged: bool, mixed: bool,
+                    chunk: int, page_size: int, n_pages: int | None,
+                    platform: str, params_cache: dict | None = None,
+                    env: dict | None = None) -> dict:
+    """Restart-recovery arm (ISSUE 9): steady greedy serving with an
+    engine.step kill injected mid-stream. With restart replay on (the
+    default) every in-flight stream must continue on its own queue with
+    ZERO client-visible errors and the bit-identical token sequence of
+    an uninterrupted reference pass; the cost shows up only as one
+    inter-token stall covering restart + re-prefill. Reports
+    client_error_rate, bit_identical, recovery_ms (worst inter-token
+    gap across the fault), stall p95, and the replayed request/token
+    counter deltas. BENCH_ASSERT_RESTART=1 hard-fails on any
+    client-visible error or divergence — the invariant is scheduler
+    policy, not device perf, so it gates on the CPU smoke too."""
+    import gc
+    import threading
+
+    from ollama_operator_tpu.models.config import get_config
+    from ollama_operator_tpu.runtime.engine import (Engine, EngineConfig,
+                                                    SlotOptions,
+                                                    resolve_cache_dtype)
+    from ollama_operator_tpu.runtime.faults import FAULTS
+    from ollama_operator_tpu.runtime.scheduler import Scheduler
+    from ollama_operator_tpu.server.metrics import GLOBAL as METRICS
+
+    on_cpu = platform == "cpu"
+    if on_cpu:
+        dtype = "float32"
+    kv_dtype = resolve_cache_dtype(
+        os.environ.get("BENCH_KV_DTYPE", "float32" if on_cpu else "int8"))
+    cfg = get_config(model)
+    log(f"bench: restart capture model={model} dtype={dtype} "
+        f"slots={slots} seq={seq} paged={paged}")
+    params, param_bytes, dtype = _bench_params(
+        jax, cfg, model, dtype, on_cpu, params_cache)
+    serve_seq = min(seq, cfg.max_seq_len)
+    # short decode chunks so the kill lands mid-stream, not on a
+    # stream's final dispatch, and the gap timeline has resolution
+    chunk_eff = max(4, min(chunk, 8))
+    ecfg = EngineConfig(max_slots=slots, max_seq_len=seq,
+                       decode_chunk=chunk_eff, cache_dtype=kv_dtype,
+                       paged=paged, page_size=page_size,
+                       n_pages=n_pages,
+                       min_prefill_bucket=16)
+    eng = Engine(cfg, params, ecfg=ecfg)
+    eng.warm_buckets()
+    greedy = SlotOptions(temperature=0.0, repeat_penalty=1.0)
+    rng = np.random.default_rng(23)
+    p_len = max(16, min(prompt_len, serve_seq // 4))
+    max_new = max(12, min(32, serve_seq // 8))
+    prompts = [rng.integers(1, cfg.vocab_size, size=p_len,
+                            endpoint=False).astype(np.int32)
+               for _ in range(slots)]
+
+    def run_pass(sched, fault: bool) -> tuple:
+        outs = [[] for _ in prompts]
+        stamps = [[] for _ in prompts]
+        errs = [0] * len(prompts)
+
+        def worker(i: int):
+            try:
+                r = sched.submit(list(prompts[i]), greedy,
+                                 max_tokens=max_new)
+                for tok in r.tokens():
+                    outs[i].append(int(tok))
+                    stamps[i].append(time.monotonic())
+            except Exception:
+                errs[i] = 1
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        if fault:
+            # kill the engine once every stream is demonstrably
+            # mid-generation — the restart then has the full resident
+            # batch to classify and replay
+            t0 = time.monotonic()
+            while (any(len(o) < 2 for o in outs)
+                   and time.monotonic() - t0 < 120):
+                time.sleep(0.005)
+            FAULTS.arm("engine.step", "fail:once")
+        for t in threads:
+            t.join(timeout=600)
+        return outs, stamps, errs
+
+    replay0 = METRICS.get("tpu_model_replayed_requests_total")
+    rtok0 = METRICS.get("tpu_model_replayed_tokens_total")
+    sched = Scheduler(eng, restart_backoff=0.05, async_dispatch=True)
+    try:
+        # warmup (also populates the dispatch histograms the watchdog's
+        # auto timeout derives from)
+        w = sched.submit(list(prompts[0]), greedy, max_tokens=chunk_eff)
+        for _ in w.chunks():
+            pass
+        restarts0 = sched.n_restarts
+        ref, _, ref_errs = run_pass(sched, fault=False)
+        out, stamps, errs = run_pass(sched, fault=True)
+        # serving must resume on the rebuilt engine: one probe request
+        probe = list(sched.submit(list(prompts[0]), greedy,
+                                  max_tokens=8).tokens())
+        n_restarts = sched.n_restarts - restarts0
+        n_replays = sched.n_replays
+        broken = sched.broken
+    finally:
+        FAULTS.disarm("engine.step")
+        sched.shutdown()
+        for s in range(eng.n_slots):
+            try:
+                eng.release(s)
+            except Exception:
+                pass
+
+    gaps = [b - a for ts in stamps for a, b in zip(ts, ts[1:])]
+    err_rate = sum(errs) / max(1, len(errs))
+    bit_identical = (not any(errs) and not any(ref_errs)
+                     and all(o == r for o, r in zip(out, ref)))
+    rec = {
+        "model": model,
+        "mode": "restart",
+        "streams": len(prompts),
+        "client_error_rate": round(err_rate, 4),
+        "bit_identical": bit_identical,
+        "probe_served": len(probe) == 8,
+        "n_restarts": int(n_restarts),
+        "n_replays": int(n_replays),
+        "broken": bool(broken),
+        "recovery_ms": (round(max(gaps) * 1e3, 1) if gaps else None),
+        "stall_p95_ms": (round(float(np.percentile(gaps, 95)) * 1e3, 1)
+                         if gaps else None),
+        "replayed_requests": int(
+            METRICS.get("tpu_model_replayed_requests_total") - replay0),
+        "replayed_tokens": int(
+            METRICS.get("tpu_model_replayed_tokens_total") - rtok0),
+        "slots": slots,
+        "dtype": dtype,
+        "paged": paged,
+        "prompt_len": int(p_len),
+        "max_tokens": int(max_new),
+        "decode_chunk": chunk_eff,
+        "seq": seq,
+    }
+    if env:
+        rec["env"] = dict(env)
+    log(f"bench: restart capture done: {json.dumps(rec)}")
+    if os.environ.get("BENCH_ASSERT_RESTART") == "1":
+        problems = []
+        if sum(errs):
+            problems.append(f"client-visible errors: {sum(errs)} of "
+                            f"{len(errs)} streams")
+        if not bit_identical:
+            problems.append("replayed streams diverged from the "
+                            "uninterrupted reference")
+        if n_restarts < 1:
+            problems.append("fault did not force a supervised restart")
+        if rec["replayed_requests"] < 1:
+            problems.append("no stream was replayed")
+        if not rec["probe_served"]:
+            problems.append("serving did not resume after the restart")
+        if broken:
+            problems.append("scheduler marked broken")
+        if problems:
+            raise AssertionError("restart arm failed: "
+                                 + "; ".join(problems))
+    del eng, params
+    gc.collect()
+    return rec
+
+
 def main() -> None:
     import jax
 
@@ -1710,6 +1881,8 @@ def main() -> None:
                                                "") == "1",
                      overload_arm=os.environ.get("BENCH_OVERLOAD_ARM",
                                                  "") == "1",
+                     restart_arm=os.environ.get("BENCH_RESTART_ARM",
+                                                "") == "1",
                      **knobs)]
     elif platform == "cpu":
         # unpinned CPU smoke: tiny model, but every knob still applies
@@ -1742,6 +1915,13 @@ def main() -> None:
             # flat, best_effort shed not erroring, shed{high}=0) hold at
             # CPU smoke scale — BENCH_ASSERT_OVERLOAD=1 gates on them
             plan.append({**smoke, "overload_arm": True, "slots": 2})
+        if os.environ.get("BENCH_RESTART_ARM", "") == "1":
+            # restart recovery (ISSUE 9): mid-stream engine kill with
+            # replay on — zero client-visible errors, bit-identical
+            # continuation, recovery time in the summary.
+            # BENCH_ASSERT_RESTART=1 gates on it (policy, not perf)
+            plan.append({**smoke, "restart_arm": True, "slots": 2,
+                         "paged": True})
         if os.environ.get("BENCH_SPEC_ARM", "") == "1":
             # fused prompt-lookup speculation (ISSUE 6): lookup /
             # accept_all / reject_all sub-arms on a repetition-heavy
@@ -1840,6 +2020,13 @@ def main() -> None:
             dict(model="tinyllama", dtype="int8", slots=16, steps=64,
                  seq=1024, prompt_len=128, paged=False, mixed=False,
                  overload_arm=True),
+            # restart recovery (ISSUE 9): mid-stream engine kill on the
+            # paged engine with replay on — the summary's
+            # restart_client_error_rate must stay 0 and recovery_ms
+            # bounds the one stall clients see across a TPU restart
+            dict(model="tinyllama", dtype="int8", slots=16, steps=64,
+                 seq=1024, prompt_len=128, paged=True, mixed=False,
+                 restart_arm=True),
         ]
 
     captures = []
@@ -1864,8 +2051,10 @@ def main() -> None:
         mixed_arm = cap.pop("mixed_arm", False)
         prefix_arm = cap.pop("prefix_arm", False)
         overload_arm = cap.pop("overload_arm", False)
+        restart_arm = cap.pop("restart_arm", False)
         try:
-            fn = (measure_overload if overload_arm
+            fn = (measure_restart if restart_arm
+                  else measure_overload if overload_arm
                   else measure_prefix if prefix_arm
                   else measure_mixed if mixed_arm
                   else measure_http if http
@@ -1978,6 +2167,16 @@ def assemble(captures: list, platform: str, n_devices: int) -> str:
             overload_high_shed = c.get("overload_high_shed")
             overload_retry_finite = c.get("retry_after_finite")
             break
+    # restart recovery (ISSUE 9 acceptance: zero client-visible errors
+    # and bit-identical continuation across a mid-stream engine kill
+    # with replay on; recovery_ms is the one stall clients see)
+    restart_err_rate = restart_bit_identical = restart_recovery_ms = None
+    for c in captures:
+        if c.get("mode") == "restart":
+            restart_err_rate = c.get("client_error_rate")
+            restart_bit_identical = c.get("bit_identical")
+            restart_recovery_ms = c.get("recovery_ms")
+            break
     return json.dumps({
         "metric": metric,
         "value": head["tok_s"],
@@ -2005,6 +2204,9 @@ def assemble(captures: list, platform: str, n_devices: int) -> str:
         "overload_best_effort_shed": overload_be_shed,
         "overload_high_shed": overload_high_shed,
         "overload_retry_after_finite": overload_retry_finite,
+        "restart_client_error_rate": restart_err_rate,
+        "restart_bit_identical": restart_bit_identical,
+        "restart_recovery_ms": restart_recovery_ms,
         "slots": head["slots"],
         "platform": platform,
         "dtype": head["dtype"],

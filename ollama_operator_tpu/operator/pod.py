@@ -32,6 +32,17 @@ PORT = 11434
 # minutes, not hours, but a cold pull still dominates — keep the window.
 PROBE_FAILURE_THRESHOLD = 2500
 
+# Graceful-termination geometry.  On SIGTERM the server drains: /readyz
+# flips to 503, new submits shed with Retry-After, running streams finish
+# within TPU_DRAIN_TIMEOUT_S (runtime/scheduler.py drain()).  The preStop
+# sleep holds the container alive while the endpoints controller
+# deprograms the pod from the Service, so no connection is routed to a
+# server that is already draining; the grace period must cover
+# preStop + drain + engine teardown or the kubelet SIGKILLs mid-drain.
+PRESTOP_SLEEP_S = 5
+DRAIN_TIMEOUT_S = 30
+TERMINATION_GRACE_S = PRESTOP_SLEEP_S + DRAIN_TIMEOUT_S + 25
+
 
 def _probe(path: str, initial_delay: int = 5,
            failure_threshold: int = PROBE_FAILURE_THRESHOLD
@@ -90,6 +101,9 @@ def new_server_container(
         env.append({"name": "TPU_EXPECT_PLATFORM", "value": "tpu"})
     if tp:
         env.append({"name": "TPU_TENSOR_PARALLEL", "value": str(tp)})
+    # keep the server's drain window in lockstep with the pod's
+    # terminationGracePeriodSeconds (workload._pod_template)
+    env.append({"name": "TPU_DRAIN_TIMEOUT_S", "value": str(DRAIN_TIMEOUT_S)})
     env.extend(extra_env or [])
 
     mounts = [{
@@ -120,6 +134,17 @@ def new_server_container(
         "startupProbe": _probe("/healthz"),
         "readinessProbe": _probe("/api/tags"),
         "livenessProbe": _probe("/livez", failure_threshold=3),
+        # preStop runs before SIGTERM: the sleep keeps the pod serving
+        # while kube-proxy/endpoints converge on its removal, then the
+        # server's own SIGTERM handler drains (readyz 503 + shed +
+        # stream-preserving finish).  /livez stays ok while draining so
+        # the kubelet never restarts a pod mid-drain.
+        "lifecycle": {
+            "preStop": {
+                "exec": {"command": ["sh", "-c",
+                                     f"sleep {PRESTOP_SLEEP_S}"]},
+            },
+        },
     }
     if placement is not None:
         container["resources"] = {

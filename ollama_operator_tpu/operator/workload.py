@@ -153,6 +153,10 @@ def _pod_template(model: Dict[str, Any], spec: ModelSpecView,
         "initContainers": [puller],
         "containers": [server],
         "volumes": [_store_volume(spec)],
+        # must cover preStop sleep + the server's SIGTERM drain window +
+        # engine teardown, or rollouts SIGKILL pods with streams still
+        # finishing (pod.TERMINATION_GRACE_S keeps the three in lockstep)
+        "terminationGracePeriodSeconds": podf.TERMINATION_GRACE_S,
     }
     if spec.image_pull_secrets:
         pod_spec["imagePullSecrets"] = copy.deepcopy(spec.image_pull_secrets)

@@ -739,8 +739,17 @@ class Engine:
             allowed = unpack_mask(mask_row, cfg.vocab_size)
             last = jnp.where((cflag == 1) & ~allowed, sampling.NEG_INF, last)
             mu_row = 2.0 * sp_row.mirostat_tau
-            tok, mu_row = sampling.sample(last[None], counts_row[None],
-                                          sp_row, key[None], mu_row)
+            # position-folded key, SAME stream as the decode steps (which
+            # fold the pre-increment length: the token installed at index
+            # total-1 would have used fold_in(key, total-1) had it been
+            # decoded). Admission and decode drawing from one keystream is
+            # what makes a seeded stream resume bit-identically after a
+            # preemption or a restart replay — the re-prefill's first
+            # sample lands on exactly the fold the uninterrupted decode
+            # would have used at that position.
+            tok, mu_row = sampling.sample(
+                last[None], counts_row[None], sp_row,
+                jax.random.fold_in(key, total - 1)[None], mu_row)
             tok = tok[0]
             mu = mu.at[slot].set(mu_row[0])
             rmod = jnp.maximum(rln, 1)

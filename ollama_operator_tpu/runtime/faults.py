@@ -27,6 +27,14 @@ Fault points wired through the codebase:
                        queue model; an armed fail proves the predictor
                        fails OPEN — requests are admitted and covered
                        by the deadline machinery, never 500ed)
+    scheduler.replay -- per replayable stream in ``_fail_running``
+                       restart classification; an armed fail forces the
+                       stream down the fail-safe exactly-once error
+                       path (fallback cause="faulted")
+    engine.watchdog -- inside the scheduler's watchdog-bounded dispatch
+                       wait, ON the waiter thread; an armed delay:Nms
+                       simulates a wedged device (the wait stalls, the
+                       watchdog fires, supervised restart + replay)
 
 Trigger specs (the grammar is intentionally tiny):
 
@@ -36,6 +44,9 @@ Trigger specs (the grammar is intentionally tiny):
     fail:every=K    -- raise on every K-th hit (hit K, 2K, ...)
     fail:after=K    -- pass K hits, then raise on every later hit
     delay:50ms      -- sleep 50ms on every hit (also: delay:0.2s)
+    delay:50ms:once / :n=K / :every=K / :after=K
+                    -- delays take the same trigger modes as fail, so a
+                       drill can wedge exactly one dispatch
 
 Env arming: ``TPU_FAULTS="engine.step=fail:once,kube.request=delay:10ms"``.
 Stdlib only; no dependency on jax so the operator can import it too.
@@ -58,30 +69,42 @@ class InjectedFault(RuntimeError):
         self.spec = spec
 
 
-def _parse_spec(spec: str) -> Tuple[str, Optional[str], float]:
-    """Return (kind, mode, value): kind in {fail, delay}."""
+def _parse_mode(spec: str, arg: str) -> Tuple[str, float]:
+    """Shared trigger-mode grammar: '' | once | n=K | every=K | after=K."""
+    if not arg:
+        return "always", 0.0
+    if arg == "once":
+        return "n", 1.0
+    mode, _, val = arg.partition("=")
+    if mode in ("n", "every", "after") and val:
+        k = int(val)
+        if k < 1:
+            raise ValueError(f"fault spec {spec!r}: count must be >= 1")
+        return mode, float(k)
+    raise ValueError(f"unknown fault spec {spec!r}")
+
+
+def _parse_spec(spec: str) -> Tuple[str, Optional[str], float, float]:
+    """Return (kind, mode, count, seconds): kind in {fail, delay};
+    ``seconds`` is the sleep for delay rules (0 for fail)."""
     spec = spec.strip()
     kind, _, arg = spec.partition(":")
     kind = kind.strip()
     arg = arg.strip()
     if kind == "fail":
-        if not arg:
-            return "fail", "always", 0.0
-        if arg == "once":
-            return "fail", "n", 1.0
-        mode, _, val = arg.partition("=")
-        if mode in ("n", "every", "after") and val:
-            k = int(val)
-            if k < 1:
-                raise ValueError(f"fault spec {spec!r}: count must be >= 1")
-            return "fail", mode, float(k)
-        raise ValueError(f"unknown fail spec {spec!r}")
+        mode, count = _parse_mode(spec, arg)
+        return "fail", mode, count, 0.0
     if kind == "delay":
-        if arg.endswith("ms"):
-            return "delay", "always", float(arg[:-2]) / 1000.0
-        if arg.endswith("s"):
-            return "delay", "always", float(arg[:-1])
-        raise ValueError(f"delay spec {spec!r} needs a ms/s suffix")
+        dur, _, modearg = arg.partition(":")
+        dur = dur.strip()
+        if dur.endswith("ms"):
+            seconds = float(dur[:-2]) / 1000.0
+        elif dur.endswith("s"):
+            seconds = float(dur[:-1])
+        else:
+            raise ValueError(f"delay spec {spec!r} needs a ms/s suffix")
+        mode, count = _parse_mode(spec, modearg.strip())
+        return "delay", mode, count, seconds
     raise ValueError(f"unknown fault spec {spec!r}")
 
 
@@ -90,8 +113,8 @@ class FaultInjector:
 
     def __init__(self):
         self._lock = threading.Lock()
-        # point -> (spec string, kind, mode, value)
-        self._rules: Dict[str, Tuple[str, str, str, float]] = {}
+        # point -> (spec string, kind, mode, count, seconds)
+        self._rules: Dict[str, Tuple[str, str, str, float, float]] = {}
         self._counts: Dict[str, int] = {}
 
     def arm(self, point: str, spec: str) -> None:
@@ -123,20 +146,17 @@ class FaultInjector:
                 return
             n = self._counts.get(point, 0) + 1
             self._counts[point] = n
-            spec, kind, mode, value = rule
-            if kind == "fail":
-                if mode == "always":
-                    fire = True
-                elif mode == "n":
-                    fire = n <= value
-                    if n >= value:
-                        del self._rules[point]
-                elif mode == "every":
-                    fire = n % int(value) == 0
-                else:  # after
-                    fire = n > value
-            else:  # delay
+            spec, kind, mode, count, seconds = rule
+            if mode == "always":
                 fire = True
+            elif mode == "n":
+                fire = n <= count
+                if n >= count:
+                    del self._rules[point]
+            elif mode == "every":
+                fire = n % int(count) == 0
+            else:  # after
+                fire = n > count
         # act outside the lock so a sleep never blocks other points
         if kind == "fail":
             if fire:
@@ -148,8 +168,11 @@ class FaultInjector:
                               hit=n)
                 raise InjectedFault(point, spec)
             return
-        if fire and value > 0:
-            time.sleep(value)
+        if fire and seconds > 0:
+            from .trace import FLIGHT
+            FLIGHT.record("fault_injected", point=point, spec=spec,
+                          hit=n)
+            time.sleep(seconds)
 
     def arm_from_env(self, env: str = "TPU_FAULTS") -> None:
         raw = os.environ.get(env, "")

@@ -34,6 +34,7 @@ from .admission import (DEFAULT_TENANT, PRIORITY_RANK, AdmissionQueue,
                         retry_after_s, shed_labels)
 from .engine import Engine, SlotOptions
 from .errors import BadRequest, DeadlineExceeded
+from .faults import FAULTS, InjectedFault
 from .paged import PagesExhausted
 from .trace import FLIGHT, TRACER
 
@@ -57,6 +58,39 @@ class SchedulerOverloaded(SchedulerBusy):
 
 class SchedulerBroken(RuntimeError):
     """Raised by submit() after repeated engine failures wedged the loop."""
+
+
+class WatchdogTimeout(RuntimeError):
+    """Raised on the scheduler thread when a dispatch wait exceeds the
+    hung-dispatch watchdog budget (TPU_DISPATCH_WATCHDOG_MS, or the
+    auto-derived ceiling from the dispatch histograms). Treated exactly
+    like an engine failure: supervised restart, then replay."""
+
+
+# Lifecycle knobs are read per call, not cached at construction: a test
+# (or an operator live-tuning a deployment) can flip them on a running
+# scheduler and the next restart/drain honors the new value.
+
+def replay_max_streams() -> int:
+    """TPU_RESTART_REPLAY_MAX: streams replayed per restart (0 = replay
+    disabled — every in-flight stream errors exactly once, PR 2
+    semantics)."""
+    return int(os.environ.get("TPU_RESTART_REPLAY_MAX", "64") or "0")
+
+
+def replay_token_budget() -> int:
+    """TPU_RESTART_REPLAY_TOKENS: aggregate prompt+generated tokens the
+    replay prefill may re-process per restart — bounds the recovery
+    stall a restart can add before fail-safe erroring kicks in."""
+    return int(os.environ.get("TPU_RESTART_REPLAY_TOKENS", "65536")
+               or "0")
+
+
+def drain_timeout_s() -> float:
+    """TPU_DRAIN_TIMEOUT_S: how long drain() lets running streams finish
+    before shedding stragglers (the operator sizes the pod's
+    terminationGracePeriodSeconds from this plus shutdown slack)."""
+    return float(os.environ.get("TPU_DRAIN_TIMEOUT_S", "30") or "0")
 
 
 @dataclasses.dataclass
@@ -329,6 +363,25 @@ class Scheduler:
         # waiting queue — they already hold a place in the line
         self._preempted: List[Request] = []
         self.n_preemptions = 0
+        # restart replay (stream-preserving recovery): _fail_running
+        # moves replayable in-flight requests here instead of erroring
+        # them; _supervised_restart re-admits them through the preempt
+        # resume machinery once the engine is rebuilt. Scheduler-thread
+        # owned between shutdown()/drain() joins.
+        self._recovering: List[Request] = []
+        self.n_replays = 0
+        self.n_replay_fallbacks = 0
+        # graceful drain: submit() sheds (503 + Retry-After) while set;
+        # running streams keep going until drain()'s timeout
+        self.draining = False
+        # hung-dispatch watchdog: a persistent helper thread runs each
+        # blocking dispatch wait so the scheduler thread can bound it;
+        # on a fire the worker is abandoned (fresh queues next time — a
+        # late result must never be delivered to the wrong generation)
+        self._wd_thread: Optional[threading.Thread] = None
+        self._wd_req: Optional[queue.Queue] = None
+        self._wd_resp: Optional[queue.Queue] = None
+        self.n_watchdog_fires = 0
         self._running: List[Optional[Request]] = [None] * engine.n_slots
         # slot → token ids (prompt + generated) still resident in its KV
         # cache; candidates for prefix-cache reuse (ollama keeps the same
@@ -385,6 +438,18 @@ class Scheduler:
             if self.broken:
                 raise SchedulerBroken(
                     "scheduler stopped after repeated engine failures")
+            if self.draining:
+                # graceful drain: running streams finish, NEW work goes
+                # to the next replica — 503 + Retry-After sized to the
+                # drain window so the client's retry lands post-rollout
+                retry = min(120, max(1, int(drain_timeout_s())))
+                METRICS.inc("tpu_model_requests_shed_total")
+                METRICS.inc("tpu_model_drain_shed_total")
+                FLIGHT.record("shed", rid=req.id, cause="draining",
+                              cls=priority, tenant=tenant,
+                              retry_after_s=retry)
+                raise SchedulerBusy("server draining",
+                                    retry_after_s=retry)
             cap = int(os.environ.get("TPU_TENANT_MAX_QUEUED", "0") or 0)
             if cap > 0 and self._admission.queued_for(tenant) >= cap:
                 # this tenant specifically is over its share: 429, not
@@ -473,6 +538,11 @@ class Scheduler:
         self._stop.set()
         self._wake.set()
         self._thread.join(timeout=10)
+        # idle watchdog worker exits on the sentinel; an ABANDONED one
+        # (post-fire) is a daemon parked on a dead queue — harmless
+        if self._wd_req is not None:
+            self._wd_req.put(None)
+            self._wd_thread = None
         # an in-flight dispatch's tokens die with the loop; its owners
         # are still in _running and drain below
         self._pending = None
@@ -492,12 +562,81 @@ class Scheduler:
                 self._running[slot] = None
                 req.stats.t_done = time.monotonic()
                 req.out.put(("done", "unloaded"))
-        for req in self._preempted + self._throttled:
+        for req in self._preempted + self._throttled + self._recovering:
             req.out.put(("done", "unloaded"))
         self._preempted.clear()
         self._throttled.clear()
+        self._recovering.clear()
         for req in self._admission.drain():
             req.out.put(("done", "unloaded"))
+
+    def begin_drain(self):
+        """Flip into draining (the SIGTERM path): new submits shed with
+        503 + Retry-After, running streams keep generating. Idempotent;
+        cleared only by tearing the scheduler down."""
+        with self._lock:
+            if self.draining or self.broken:
+                return
+            self.draining = True
+        METRICS.inc("tpu_model_drain_started_total")
+        FLIGHT.record("drain", phase="begin", running=self.n_active,
+                      queued=self.qsize)
+
+    def drain(self, timeout_s: Optional[float] = None) -> int:
+        """Graceful drain: begin_drain(), wait up to ``timeout_s``
+        (default TPU_DRAIN_TIMEOUT_S) for every attached stream to
+        finish, then shed stragglers — running streams get a terminal
+        ``("done", "drain")`` frame (partial output stands, finish
+        reason tells the client it was a rollout, not a stop token),
+        waiting ones shed 503. Returns the straggler count. The decode
+        loop is stopped before straggler teardown (drain is always
+        followed by shutdown), so the teardown can't race a dispatch."""
+        self.begin_drain()
+        if timeout_s is None:
+            timeout_s = drain_timeout_s()
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        while time.monotonic() < deadline:
+            if not self.has_pending:
+                break
+            time.sleep(0.02)
+        shed = 0
+        if self.has_pending:
+            self._stop.set()
+            self._wake.set()
+            self._thread.join(timeout=10)
+            self._pending = None
+            self._prefilling.clear()
+            try:
+                if self.engine.quarantined_pages:
+                    self.engine.fence_quiesce()
+            except Exception:  # noqa: BLE001 — engine may be torn down
+                pass
+            retry = min(120, max(1, int(timeout_s) or 1))
+            for slot, req in enumerate(self._running):
+                if req is None:
+                    continue
+                self._running[slot] = None
+                req.stats.t_done = time.monotonic()
+                req.out.put(("done", "drain"))
+                try:
+                    self.engine.release(slot)
+                except Exception:  # noqa: BLE001 — best-effort teardown
+                    pass
+                shed += 1
+            for req in (self._preempted + self._throttled
+                        + self._recovering):
+                req.out.put(("shed", ("server draining", retry)))
+                shed += 1
+            self._preempted.clear()
+            self._throttled.clear()
+            self._recovering.clear()
+            for req in self._admission.drain():
+                req.out.put(("shed", ("server draining", retry)))
+                shed += 1
+            if shed:
+                METRICS.inc("tpu_model_drain_shed_total", float(shed))
+        FLIGHT.record("drain", phase="complete", shed=shed)
+        return shed
 
     @property
     def n_active(self) -> int:
@@ -505,18 +644,20 @@ class Scheduler:
 
     @property
     def qsize(self) -> int:
-        """Requests waiting for a slot (queued + preempted + throttled).
-        Public API for metrics and the server's load probes — external
-        code must not reach into the admission queue."""
+        """Requests waiting for a slot (queued + preempted + throttled +
+        recovering). Public API for metrics and the server's load probes
+        — external code must not reach into the admission queue."""
         return (len(self._admission) + len(self._preempted)
-                + len(self._throttled))
+                + len(self._throttled) + len(self._recovering))
 
     @property
     def has_pending(self) -> bool:
-        """True while any request is running, queued, preempted, or
-        throttled — i.e. unloading the model now would strand a caller."""
+        """True while any request is running, queued, preempted,
+        throttled, or awaiting restart replay — i.e. unloading the model
+        now would strand a caller."""
         return (self.n_active > 0 or bool(self._preempted)
-                or bool(self._throttled) or not self._admission.empty())
+                or bool(self._throttled) or bool(self._recovering)
+                or not self._admission.empty())
 
     def admission_stats(self) -> dict:
         """Live admission-policy snapshot for /api/ps: per-class queue
@@ -539,6 +680,27 @@ class Scheduler:
                 for p in PRIORITY_RANK},
         })
         return out
+
+    def lifecycle_stats(self) -> dict:
+        """Lifecycle snapshot for /api/ps: serving/draining/broken state,
+        the restart-replay budget in force, and watchdog posture."""
+        return {
+            "state": ("broken" if self.broken
+                      else "draining" if self.draining else "serving"),
+            "restarts": self.n_restarts,
+            "replay": {
+                "enabled": replay_max_streams() > 0,
+                "max_streams": replay_max_streams(),
+                "token_budget": replay_token_budget(),
+                "replayed_streams": self.n_replays,
+                "fallbacks": self.n_replay_fallbacks,
+                "recovering": len(self._recovering),
+            },
+            "watchdog": {
+                "timeout_s": round(self._watchdog_timeout_s(), 3),
+                "fires": self.n_watchdog_fires,
+            },
+        }
 
     # ------------------------------------------------------------------
     def _finish(self, slot: int, req: Request, reason: str):
@@ -1173,9 +1335,13 @@ class Scheduler:
                 traceback.print_exc(file=sys.stderr)
                 FLIGHT.record("engine_failure", error=str(e)[:200],
                               consecutive=self._consecutive_failures + 1)
-                self._fail_running(str(e))
                 self._consecutive_failures += 1
-                if self._consecutive_failures > self.max_restarts:
+                final = self._consecutive_failures > self.max_restarts
+                # no replay on the terminal failure: a stream parked in
+                # _recovering would only be errored again by the broken
+                # drain below — classify it straight to the error frame
+                self._fail_running(str(e), replay=not final)
+                if final:
                     with self._lock:
                         self.broken = True
                         self._drain_waiting(("error", f"engine failed: {e}"))
@@ -1229,22 +1395,96 @@ class Scheduler:
                     self.RESTART_BACKOFF_CAP)
         if delay > 0:
             self._stop.wait(delay)
+        # re-admit the replayable streams ahead of the waiting queue:
+        # resume_ids is already set, so the normal preempt/resume path
+        # re-prefills prompt+generated (chunked for long contexts) and
+        # generation continues from the next token on the same output
+        # queue — bit-identical for greedy and seeded streams
+        if self._recovering:
+            recov, self._recovering = self._recovering, []
+            self._preempted[:0] = recov
+            FLIGHT.record("replay_readmit", n=len(recov))
+            self._wake.set()
 
-    def _fail_running(self, message: str):
+    @staticmethod
+    def _replay_ineligible(req: Request) -> Optional[str]:
+        """Why a stream can NOT be replayed bit-identically, or None.
+
+        The determinism contract (engine.py): greedy streams
+        (temperature == 0) and seeded streams (opts.seed >= 0, base key
+        slot-independent, per-step keys fold_in(key, position)) resume
+        byte-identical through the preempt/resume machinery. Unseeded
+        temperature sampling derives its base key from (slot, seq_len) —
+        both change on resume — and mirostat's mu state is re-seeded at
+        admission, so neither can promise the same continuation.
+        Multimodal prompts can't re-prefill from token ids at all."""
+        if req.embeds is not None:
+            return "multimodal"
+        o = req.opts
+        if o.temperature > 0.0 and o.seed < 0:
+            return "nondeterministic"
+        if o.mirostat:
+            return "nondeterministic"
+        return None
+
+    def _fail_running(self, message: str, replay: bool = True):
         # the in-flight async dispatch (and any mid-chunked-prefill
         # state) dies with the engine state; every owner is still in
-        # _running and gets exactly ONE error frame below
+        # _running. Replayable streams move to _recovering — after the
+        # supervised rebuild they re-admit through the preempt/resume
+        # machinery and continue on the same output queue, so the client
+        # sees a stall, never an error. Everything else (non-
+        # deterministic, multimodal, over the replay budget, injected
+        # replay fault, or ``replay=False`` because the loop is going
+        # terminally broken) falls back to today's exactly-ONE error
+        # frame.
         FLIGHT.record("fail_running", error=message[:200],
                       n_running=self.n_active)
         self._pending = None
         self._prefilling.clear()
+        budget = replay_token_budget()
+        max_streams = replay_max_streams() if replay else 0
+        taken = 0
         for slot, req in enumerate(self._running):
             if req is None:
                 continue
             self._running[slot] = None
-            req.error = message
-            req.stats.t_done = time.monotonic()
-            req.out.put(("error", message))
+            cause = (self._replay_ineligible(req) if replay
+                     else "broken")
+            cost = len(req.prompt_ids) + len(req.all_tokens)
+            if cause is None and (taken >= max_streams or cost > budget):
+                cause = "over_budget"
+            if cause is None:
+                try:
+                    FAULTS.check("scheduler.replay")
+                except InjectedFault:
+                    cause = "faulted"
+            if cause is None:
+                budget -= cost
+                taken += 1
+                req.resume_ids = np.concatenate(
+                    [req.prompt_ids,
+                     np.asarray(req.all_tokens, np.int32)])
+                req.slot = None
+                self._recovering.append(req)
+                self.n_replays += 1
+                METRICS.inc("tpu_model_replayed_requests_total")
+                METRICS.inc("tpu_model_replayed_tokens_total",
+                            float(cost))
+                req.trace.event("replay", slot=slot,
+                                n_generated=req.stats.n_generated)
+                FLIGHT.record("replay", rid=req.id, slot=slot,
+                              outcome="recovered", tokens=cost,
+                              n_generated=req.stats.n_generated)
+            else:
+                self.n_replay_fallbacks += 1
+                METRICS.inc("tpu_model_replay_fallback_total",
+                            labels=f'{{cause="{cause}"}}')
+                FLIGHT.record("replay", rid=req.id, slot=slot,
+                              outcome="fallback", cause=cause)
+                req.error = message
+                req.stats.t_done = time.monotonic()
+                req.out.put(("error", message))
             try:
                 self.engine.release(slot)
             except Exception:  # noqa: BLE001 — best-effort slot reset
@@ -1265,10 +1505,11 @@ class Scheduler:
         self._fence_ack = 0
 
     def _drain_waiting(self, msg):
-        for req in self._preempted + self._throttled:
+        for req in self._preempted + self._throttled + self._recovering:
             req.out.put(msg)
         self._preempted.clear()
         self._throttled.clear()
+        self._recovering.clear()
         for req in self._admission.drain():
             req.out.put(msg)
 
@@ -1453,6 +1694,78 @@ class Scheduler:
             hist, req._bigram_idx, req._indexed_upto, k, ngram=ngram)
         return d
 
+    def _watchdog_timeout_s(self) -> float:
+        """Dispatch-wait budget in seconds; 0 disables the watchdog.
+
+        Explicit TPU_DISPATCH_WATCHDOG_MS wins (0 = off). Otherwise the
+        ceiling derives from the PR 7 dispatch histograms: once enough
+        dispatches are observed, 100x the mean launch-to-host latency
+        (clamped to [15s, 120s]) — generous enough that GC pauses and
+        bucket recompiles never fire it, tight enough that a wedged
+        device stops hiding behind a green /healthz. Before the
+        histograms warm up (first dispatches compile) a fixed 120s
+        floor applies."""
+        ms = os.environ.get("TPU_DISPATCH_WATCHDOG_MS", "").strip()
+        if ms:
+            v = float(ms)
+            return v / 1e3 if v > 0 else 0.0
+        n, total = METRICS.hist_totals("tpu_model_dispatch_seconds")
+        if n >= 64:
+            return min(max(100.0 * (total / n), 15.0), 120.0)
+        return 120.0
+
+    @staticmethod
+    def _wd_worker(req_q: queue.Queue, resp_q: queue.Queue):
+        while True:
+            fn = req_q.get()
+            if fn is None:
+                return
+            try:
+                resp_q.put((True, fn()))
+            except BaseException as e:  # noqa: BLE001 — ferried to caller
+                resp_q.put((False, e))
+
+    def _watched(self, fn):
+        """Run a blocking dispatch wait under the hung-dispatch
+        watchdog: the wait executes on a persistent helper thread while
+        the scheduler thread waits on the response queue with a
+        timeout. On expiry the worker is abandoned (its eventual result
+        goes to queues nothing reads — a fresh worker+queues serve the
+        next wait) and WatchdogTimeout rides the normal supervisor
+        path: restart, then replay. The engine.watchdog fault point
+        runs ON the worker so an armed delay:Nms simulates a wedge."""
+        timeout = self._watchdog_timeout_s()
+        if timeout <= 0:
+            FAULTS.check("engine.watchdog")
+            return fn()
+
+        def task():
+            FAULTS.check("engine.watchdog")
+            return fn()
+
+        if self._wd_thread is None or not self._wd_thread.is_alive():
+            self._wd_req = queue.Queue()
+            self._wd_resp = queue.Queue()
+            self._wd_thread = threading.Thread(
+                target=self._wd_worker, args=(self._wd_req, self._wd_resp),
+                daemon=True, name="tpu-dispatch-watchdog")
+            self._wd_thread.start()
+        self._wd_req.put(task)
+        try:
+            ok, val = self._wd_resp.get(timeout=timeout)
+        except queue.Empty:
+            self.n_watchdog_fires += 1
+            METRICS.inc("tpu_model_watchdog_fires_total")
+            FLIGHT.record("watchdog", timeout_s=round(timeout, 3),
+                          fires=self.n_watchdog_fires)
+            self._wd_thread = None      # abandon: never reuse its queues
+            raise WatchdogTimeout(
+                f"dispatch wait exceeded watchdog budget "
+                f"{timeout:.1f}s (wedged device?)") from None
+        if ok:
+            return val
+        raise val
+
     def _wait_handle(self, handle, snapshot=None,
                      drafted=None) -> np.ndarray:
         """Materialise a launched dispatch and reconcile host state: the
@@ -1466,7 +1779,7 @@ class Scheduler:
         length (a parked/donated predecessor's length was already
         reset or is repaired at reuse). Folds per-slot drafted/accepted
         counts into the acceptance metrics."""
-        toks_n = handle.wait()
+        toks_n = self._watched(handle.wait)
         self._fence_ack = handle.epoch
         self._consecutive_failures = 0
         # dispatch latency: launch → tokens-on-host, per program kind.
@@ -1642,7 +1955,8 @@ class Scheduler:
                                            drafted)         # [k+1, B]
             else:
                 t0 = time.perf_counter()
-                toks_n = self.engine.decode_n(n_steps)
+                toks_n = self._watched(
+                    lambda: self.engine.decode_n(n_steps))
                 self._consecutive_failures = 0
                 dur = time.perf_counter() - t0
                 METRICS.observe("tpu_model_dispatch_seconds", dur,

@@ -539,11 +539,15 @@ class LoadedModel:
         result.generated_tokens = st.n_generated
         result.ttft_s = st.ttft_s
         result.total_s = time.monotonic() - t0
-        if getattr(req, "done_reason", None) == "timeout":
-            # deadline_ms expired mid-generation: the scheduler released
-            # the slot and sent a clean terminal frame — surface the real
-            # reason instead of misreporting "stop"
-            result.done_reason = "timeout"
+        if getattr(req, "done_reason", None) in ("timeout", "drain"):
+            # deadline_ms expired mid-generation ("timeout"), or the
+            # graceful-drain window closed around a running stream
+            # ("drain"): the scheduler released the slot and sent a
+            # clean terminal frame — surface the real reason instead of
+            # misreporting "stop" (a client seeing "drain" knows its
+            # partial output was cut by a rollout and can resume via
+            # context)
+            result.done_reason = req.done_reason
         else:
             result.done_reason = ("stop"
                                   if sm.hit or st.n_generated < max_new
@@ -719,9 +723,21 @@ class _IdleScheduler:
     spec_drafted = 0
     spec_accepted = 0
     n_throttles = 0
+    draining = False
+    n_replays = 0
+    n_watchdog_fires = 0
 
     def admission_stats(self) -> dict:
         return {}   # encoders have no waiting line to police
+
+    def lifecycle_stats(self) -> dict:
+        return {}   # no decode loop: nothing to replay, drain, or watch
+
+    def begin_drain(self):
+        pass        # encoders hold no streams; drain is instant
+
+    def drain(self, timeout_s=None) -> int:
+        return 0
 
     def shutdown(self):
         pass

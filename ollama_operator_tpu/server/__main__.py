@@ -225,7 +225,43 @@ def main(argv=None):
                            [signal.SIGINT, signal.SIGTERM])
     stop = signal.sigwait([signal.SIGINT, signal.SIGTERM])
     print(f"signal {stop}, shutting down", file=sys.stderr)
+    # graceful shutdown sequence (rollouts must be zero-error):
+    #   1. drain — /readyz goes 503 "draining" so the Service pulls this
+    #      endpoint, new submits shed 503+Retry-After, running streams
+    #      finish within TPU_DRAIN_TIMEOUT_S (stragglers get a terminal
+    #      "drain" frame). The operator's preStop hook + grace period
+    #      (operator/workload.py) size the kube side to match.
+    #   2. stop the listener — in-flight handlers already got their
+    #      terminal frames in step 1.
+    #   3. unload — scheduler shutdown (fence_quiesce, queue drain) and,
+    #      multi-host, the FIFO ("unload",) broadcast to followers.
+    #   4. release the followers with ("shutdown",) so their replay
+    #      loops return instead of dying on a closed socket.
+    #   5. stop the reaper and dump the flight recorder — the black box
+    #      of the shutdown itself lands in the pod's final log lines.
+    # Every step is bounded and best-effort: a wedged engine must never
+    # turn SIGTERM into a SIGKILL at the grace-period cliff.
+    from ..runtime.trace import FLIGHT
+    try:
+        shed = manager.drain()
+        if shed:
+            print(f"drain: shed {shed} straggler(s)", file=sys.stderr)
+    except Exception as e:  # noqa: BLE001
+        print(f"drain failed: {e}", file=sys.stderr)
     httpd.shutdown()
+    try:
+        manager.unload_now()
+    except Exception as e:  # noqa: BLE001
+        print(f"unload failed: {e}", file=sys.stderr)
+    if control_plane is not None:
+        try:
+            with control_plane.dispatch_lock:
+                control_plane.broadcast(("shutdown",))
+        except Exception:  # noqa: BLE001 — follower already gone
+            pass
+        control_plane.close()
+    manager.shutdown()
+    FLIGHT.dump("shutdown")
 
 
 if __name__ == "__main__":

@@ -251,6 +251,10 @@ class ModelManager:
         self.expires_at: Optional[float] = None
         self._last_ka: Optional[float] = self.default_keep_alive
         self._reaper_stop = threading.Event()
+        # graceful drain (SIGTERM / preStop): /readyz flips 503 so the
+        # Service pulls this endpoint, new submits shed 503+Retry-After,
+        # running streams finish within TPU_DRAIN_TIMEOUT_S
+        self.draining = False
         # followers unload on the leader's ("unload",) broadcast, never on
         # their own clock
         if serve_models and not follower:
@@ -325,6 +329,31 @@ class ModelManager:
 
     def shutdown(self):
         self._reaper_stop.set()
+
+    def begin_drain(self):
+        """Enter draining: readiness goes 503 (the operator's Service
+        stops routing here), the scheduler sheds new submits, running
+        streams keep generating. Idempotent."""
+        with self._lock:
+            already, self.draining = self.draining, True
+            lm = self.loaded
+        if not already:
+            FLIGHT.record("drain", phase="manager",
+                          model=lm.name if lm is not None else None)
+        if lm is not None:
+            lm.scheduler.begin_drain()
+
+    def drain(self, timeout_s: Optional[float] = None) -> int:
+        """Graceful drain for SIGTERM: begin_drain(), then let the
+        resident model's streams finish within ``timeout_s`` (default
+        TPU_DRAIN_TIMEOUT_S) before stragglers are shed. Returns the
+        straggler count."""
+        self.begin_drain()
+        with self._lock:
+            lm = self.loaded
+        if lm is None:
+            return 0
+        return lm.scheduler.drain(timeout_s)
 
     # ------------------------------------------------------------------
     def model_details(self, name: ModelName) -> Dict:
@@ -662,6 +691,10 @@ class ModelManager:
                 # state, throttles, and the knobs in force (empty for
                 # encoder models, which have no waiting line)
                 "admission": lm.scheduler.admission_stats(),
+                # lifecycle: serving/draining/broken state, the restart-
+                # replay budget in force, and hung-dispatch watchdog
+                # posture (empty for encoder models)
+                "lifecycle": lm.scheduler.lifecycle_stats(),
             })
         return out
 
@@ -1038,6 +1071,12 @@ class Handler(BaseHTTPRequestHandler):
                 lm = self.manager.loaded
                 if lm is not None and lm.scheduler.broken:
                     self._send_text("engine failed", status=503)
+                elif path == "/readyz" and self.manager.draining:
+                    # draining: readiness fails so the Service stops
+                    # routing here, but liveness stays ok — the kubelet
+                    # must NOT restart a pod mid-drain (that would cut
+                    # the very streams the drain is protecting)
+                    self._send_text("draining", status=503)
                 else:
                     self._send_text("ok")
             else:

@@ -265,6 +265,32 @@ GLOBAL.describe("tpu_model_flight_recorder_events",
 GLOBAL.describe("tpu_model_flight_recorder_dumps",
                 "Flight-recorder dumps written to stderr (supervised "
                 "restarts and chaos-drill post-mortems)")
+GLOBAL.describe("tpu_model_replayed_requests_total",
+                "In-flight streams recovered across a supervised engine "
+                "restart by replay (re-prefill of prompt+generated, "
+                "bit-identical continuation on the same stream) instead "
+                "of an error frame")
+GLOBAL.describe("tpu_model_replayed_tokens_total",
+                "Prompt+generated tokens re-prefilled by restart "
+                "replay; bounded per restart by "
+                "TPU_RESTART_REPLAY_TOKENS")
+GLOBAL.describe("tpu_model_replay_fallback_total",
+                "In-flight streams that could NOT be replayed across a "
+                "restart and got the exactly-once error instead, by "
+                "cause (cause=nondeterministic|multimodal|over_budget|"
+                "faulted|broken)")
+GLOBAL.describe("tpu_model_drain_started_total",
+                "Graceful-drain activations (SIGTERM / preStop): new "
+                "submits shed 503 while running streams finish")
+GLOBAL.describe("tpu_model_drain_shed_total",
+                "Requests shed by graceful drain: new submits refused "
+                "while draining, plus stragglers cut at "
+                "TPU_DRAIN_TIMEOUT_S")
+GLOBAL.describe("tpu_model_watchdog_fires_total",
+                "Hung-dispatch watchdog fires (dispatch wait exceeded "
+                "TPU_DISPATCH_WATCHDOG_MS or the histogram-derived "
+                "ceiling); each one forces a supervised restart + "
+                "replay")
 # pre-seed the failure counters at 0: alert rules rate() over these, and
 # a series that first appears AT the first failure hides that failure
 # (the stall/chunk counters likewise: a mixed-load dashboard must read 0,
@@ -289,10 +315,24 @@ for _name in ("tpu_model_engine_restarts_total",
               "tpu_model_prompt_tokens_total",
               "tpu_model_stream_frames_total",
               "tpu_model_prefix_reused_tokens_total",
+              # lifecycle counters (restart replay / drain / watchdog):
+              # the whole point is alerting on rare events, so the
+              # series must exist from the first scrape
+              "tpu_model_replayed_requests_total",
+              "tpu_model_replayed_tokens_total",
+              "tpu_model_drain_started_total",
+              "tpu_model_drain_shed_total",
+              "tpu_model_watchdog_fires_total",
               # render() itself maintains this one; pre-seeded so the
               # zero-error steady state is a visible 0
               "tpu_model_metrics_gauge_errors_total"):
     GLOBAL.inc(_name, 0.0)
+# replay fallbacks are labelled by cause; pre-seed every cause so a
+# rate() alert on any of them reads 0, not absent, on a healthy server
+for _cause in ("nondeterministic", "multimodal", "over_budget",
+               "faulted", "broken"):
+    GLOBAL.inc("tpu_model_replay_fallback_total", 0.0,
+               f'{{cause="{_cause}"}}')
 # the async-fallback counter is labelled, so pre-seed every cause — an
 # alert on rate(cause="grammar") must read 0, not absent, while async
 # dispatch is running clean

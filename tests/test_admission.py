@@ -420,12 +420,13 @@ def test_throttle_resume_bit_parity(monkeypatch):
 # -- chaos: engine failure mid-overload --------------------------------
 
 @pytest.mark.chaos
-def test_restart_mid_overload_preserves_class_order_errors_once():
+def test_restart_mid_overload_preserves_class_order_errors_once(monkeypatch):
     """Engine dies mid-decode with a multi-class, multi-tenant backlog
     queued behind it: the supervised restart must (a) error the
     in-flight request EXACTLY once, (b) keep every queued request —
     class and tenant intact — and (c) admit the survivors in strict
     class order."""
+    monkeypatch.setenv("TPU_RESTART_REPLAY_MAX", "0")
     cfg, params, eng, sched = make_stack(slots=1, restart_backoff=0.001)
     try:
         # the in-flight request is high-class: the queued "hi" request

@@ -22,14 +22,23 @@ from test_scheduler import GREEDY, make_stack
 # -- spec grammar ------------------------------------------------------
 
 def test_spec_parsing():
-    assert _parse_spec("fail") == ("fail", "always", 0.0)
-    assert _parse_spec("fail:once") == ("fail", "n", 1.0)
-    assert _parse_spec("fail:n=2") == ("fail", "n", 2.0)
-    assert _parse_spec("fail:every=3") == ("fail", "every", 3.0)
-    assert _parse_spec("fail:after=4") == ("fail", "after", 4.0)
-    assert _parse_spec("delay:50ms") == ("delay", "always", 0.05)
-    assert _parse_spec("delay:0.2s") == ("delay", "always", 0.2)
-    for bad in ("fail:sometimes", "delay:50", "jitter:1ms", "fail:n=0"):
+    assert _parse_spec("fail") == ("fail", "always", 0.0, 0.0)
+    assert _parse_spec("fail:once") == ("fail", "n", 1.0, 0.0)
+    assert _parse_spec("fail:n=2") == ("fail", "n", 2.0, 0.0)
+    assert _parse_spec("fail:every=3") == ("fail", "every", 3.0, 0.0)
+    assert _parse_spec("fail:after=4") == ("fail", "after", 4.0, 0.0)
+    assert _parse_spec("delay:50ms") == ("delay", "always", 0.0, 0.05)
+    assert _parse_spec("delay:0.2s") == ("delay", "always", 0.0, 0.2)
+    # delays take the same trigger modes as fail (a drill can wedge
+    # exactly one dispatch)
+    assert _parse_spec("delay:50ms:once") == ("delay", "n", 1.0, 0.05)
+    assert _parse_spec("delay:1s:n=2") == ("delay", "n", 2.0, 1.0)
+    assert _parse_spec("delay:5ms:every=3") == ("delay", "every", 3.0,
+                                                0.005)
+    assert _parse_spec("delay:5ms:after=4") == ("delay", "after", 4.0,
+                                                0.005)
+    for bad in ("fail:sometimes", "delay:50", "jitter:1ms", "fail:n=0",
+                "delay:1ms:sometimes", "delay:1ms:n=0"):
         with pytest.raises(ValueError):
             _parse_spec(bad)
 
@@ -57,6 +66,16 @@ def test_injector_modes():
     with pytest.raises(InjectedFault):
         f.check("r")
 
+    # delay modes share the trigger grammar: :once sleeps on the first
+    # hit only (the sleep itself is what fires — assert via wall clock)
+    f.arm("d", "delay:30ms:once")
+    t0 = time.monotonic()
+    f.check("d")
+    assert time.monotonic() - t0 >= 0.025
+    t0 = time.monotonic()
+    f.check("d")                     # disarmed: no sleep
+    assert time.monotonic() - t0 < 0.025
+
     f.reset()
     f.check("q")                     # everything disarmed
 
@@ -80,11 +99,14 @@ def test_unarmed_check_is_noop():
 # -- chaos: supervised engine restart ----------------------------------
 
 @pytest.mark.chaos
-def test_engine_step_fault_supervised_restart():
+def test_engine_step_fault_supervised_restart(monkeypatch):
     """ISSUE 2 acceptance: engine.step fail:once errors only the
     in-flight request, the supervisor rebuilds in-process, a subsequent
     request completes on the SAME scheduler object, and
     tpu_model_engine_restarts_total increments."""
+    # replay off: this drill pins the pre-replay error path (the
+    # replay-on drill lives in test_lifecycle.py)
+    monkeypatch.setenv("TPU_RESTART_REPLAY_MAX", "0")
     cfg, params, eng, sched = make_stack(slots=2, restart_backoff=0.001)
     restarts_before = METRICS.get("tpu_model_engine_restarts_total")
     try:
@@ -110,9 +132,10 @@ def test_engine_step_fault_supervised_restart():
 
 
 @pytest.mark.chaos
-def test_engine_step_fault_spares_waiting_requests():
+def test_engine_step_fault_spares_waiting_requests(monkeypatch):
     """Queued requests survive the restart: only the in-flight request
     errors; the waiting one is admitted after the rebuild and completes."""
+    monkeypatch.setenv("TPU_RESTART_REPLAY_MAX", "0")
     cfg, params, eng, sched = make_stack(slots=1, restart_backoff=0.001)
     try:
         r1 = sched.submit(np.array([1, 2], np.int32), GREEDY,

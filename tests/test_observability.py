@@ -182,10 +182,11 @@ def _hist_count(name, labels=""):
 
 
 @pytest.mark.chaos
-def test_supervised_restart_dumps_flight_recorder(capsys):
+def test_supervised_restart_dumps_flight_recorder(capsys, monkeypatch):
     """ISSUE 7 acceptance: the chaos drill's supervised restart dumps a
     flight-recorder post-mortem — >= 10 structured events including the
     injected fault and the restart itself."""
+    monkeypatch.setenv("TPU_RESTART_REPLAY_MAX", "0")
     dumps0 = FLIGHT.dumps
     cfg, params, eng, sched = make_stack(slots=2, restart_backoff=0.001)
     try:

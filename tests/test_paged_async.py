@@ -349,12 +349,15 @@ def test_grammar_dispatch_counts_fallback(params):
 # ---------------------------------------------------------------------------
 
 @pytest.mark.chaos
-def test_engine_step_fault_paged_async_exactly_once(params):
+def test_engine_step_fault_paged_async_exactly_once(params, monkeypatch):
     """CI chaos drill (ISSUE 5): engine.step fail:after=1 in paged+async.
     The first launch succeeds and its in-flight tokens are delivered; the
     second raises with a dispatch pending. Every owner gets exactly ONE
     terminal error, the supervised restart drains the quarantine, and the
     page table checks clean — then serving resumes."""
+    # replay off: this drill pins the exactly-once ERROR contract (the
+    # zero-error replay drill lives in test_lifecycle.py)
+    monkeypatch.setenv("TPU_RESTART_REPLAY_MAX", "0")
     eng = Engine(XLA, params, ecfg=PAGED)
     sched = Scheduler(eng, restart_backoff=0.001, async_dispatch=True)
     try:

@@ -295,10 +295,11 @@ def test_refcounts_across_preempt_readmit(params):
 
 
 @pytest.mark.chaos
-def test_radix_reset_on_supervised_restart(params):
+def test_radix_reset_on_supervised_restart(params, monkeypatch):
     """A decode-loop failure rebuilds the engine state: the tree must be
     dropped with it (its cache contents are unknown) and its pins
     returned, then serving continues and re-populates the cache."""
+    monkeypatch.setenv("TPU_RESTART_REPLAY_MAX", "0")
     eng = Engine(XLA, params, ecfg=PAGED)
     sched = Scheduler(eng, restart_backoff=0.001)
     try:

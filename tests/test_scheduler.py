@@ -145,9 +145,11 @@ def test_engine_failure_fails_requests_not_thread():
         sched.shutdown()
 
 
-def test_repeated_engine_failures_mark_broken():
+def test_repeated_engine_failures_mark_broken(monkeypatch):
     """Terminal `broken` is reached only after max_restarts supervised
     restarts ALSO fail — and then new submissions are refused."""
+    # replay off: this test pins the pre-replay exactly-once error path
+    monkeypatch.setenv("TPU_RESTART_REPLAY_MAX", "0")
     cfg, params, eng, sched = make_stack(slots=1, max_restarts=2,
                                          restart_backoff=0.001)
     try:
@@ -177,9 +179,11 @@ def test_repeated_engine_failures_mark_broken():
         sched.shutdown()   # idempotent
 
 
-def test_fail_running_releases_slots_and_errors_each_stream_once():
+def test_fail_running_releases_slots_and_errors_each_stream_once(monkeypatch):
     """_fail_running: every running slot is released and every stream
     sees exactly ONE error item — then the freed slots serve new work."""
+    # replay off: this test pins the fail-safe exactly-once error path
+    monkeypatch.setenv("TPU_RESTART_REPLAY_MAX", "0")
     cfg, params, eng, sched = make_stack(slots=2)
     try:
         calls = {"n": 0}

@@ -358,6 +358,43 @@ def test_broken_scheduler_reloads_on_next_request(stack):
     assert r["done"] is True
 
 
+def test_drain_flips_readyz_and_sheds_submits(stack):
+    """Graceful drain over HTTP: begin_drain() flips /readyz to 503
+    "draining" while /livez stays ok (the kubelet must not restart a
+    pod mid-drain), new generates shed 503 + Retry-After, and /api/ps
+    reports the lifecycle state."""
+    mgr = stack["manager"]
+    name = _model_name(stack)
+    lm = mgr.require_loaded(name)
+    try:
+        mgr.begin_drain()
+        mgr.begin_drain()                      # idempotent
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            get(stack["base"], "/readyz")
+        assert ei.value.code == 503
+        assert "draining" in ei.value.read().decode()
+        assert get(stack["base"], "/livez") == "ok"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post(stack["base"], "/api/generate",
+                 {"model": name, "prompt": "t1", "stream": False,
+                  "options": {"num_predict": 2}})
+        assert ei.value.code == 503
+        assert int(ei.value.headers.get("Retry-After", "0")) >= 1
+        ps = json.loads(get(stack["base"], "/api/ps"))
+        assert ps["models"][0]["lifecycle"]["state"] == "draining"
+        assert ps["models"][0]["lifecycle"]["replay"]["enabled"] is True
+    finally:
+        # the stack fixture is module-scoped: undo the (normally
+        # terminal) drain so later tests see a serving pod
+        mgr.draining = False
+        lm.scheduler.draining = False
+    assert get(stack["base"], "/readyz") == "ok"
+    r = post(stack["base"], "/api/generate",
+             {"model": name, "prompt": "t1", "stream": False,
+              "options": {"num_predict": 2}})
+    assert r["done"] is True
+
+
 def test_v1_embeddings_endpoint(stack):
     out = post(stack["base"], "/v1/embeddings",
                {"model": _model_name(stack), "input": ["hello", "world"]})

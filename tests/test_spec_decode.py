@@ -449,6 +449,9 @@ def test_engine_step_fault_during_spec_exactly_once(params, monkeypatch):
     drains the quarantine, the page table checks clean, and serving
     (still speculating) resumes."""
     monkeypatch.setenv("TPU_SPEC_DECODE", "3")
+    # replay off: this drill pins the exactly-once ERROR contract (the
+    # zero-error replay drill lives in test_lifecycle.py)
+    monkeypatch.setenv("TPU_RESTART_REPLAY_MAX", "0")
     eng = Engine(CFG, params, ecfg=dataclasses.replace(
         ECFG, paged=True, page_size=8))
     sched = Scheduler(eng, restart_backoff=0.001, async_dispatch=True)

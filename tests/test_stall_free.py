@@ -309,10 +309,11 @@ def test_dispatch_latency_gauges_populate(eng):
 # ----------------------------------------------------------------- chaos
 
 @pytest.mark.chaos
-def test_restart_mid_async_pipeline_errors_once(eng):
+def test_restart_mid_async_pipeline_errors_once(eng, monkeypatch):
     """engine.step dies with a dispatch in flight: the already-computed
     dispatch is delivered, the owner gets exactly ONE error frame, the
     supervisor restarts, and the next request serves."""
+    monkeypatch.setenv("TPU_RESTART_REPLAY_MAX", "0")
     sched = Scheduler(eng, prefill_chunk=0, async_dispatch=True,
                       restart_backoff=0.001)
     try:
@@ -343,10 +344,11 @@ def test_restart_mid_async_pipeline_errors_once(eng):
 
 
 @pytest.mark.chaos
-def test_restart_mid_chunked_prefill_errors_once(eng):
+def test_restart_mid_chunked_prefill_errors_once(eng, monkeypatch):
     """engine.admit dies on an INTERLEAVED prefill piece (fail:after=1
     lets the first piece through): the supervisor restarts and the
     mid-prefill request errors exactly once."""
+    monkeypatch.setenv("TPU_RESTART_REPLAY_MAX", "0")
     sched = Scheduler(eng, prefill_chunk=16, async_dispatch=False,
                       restart_backoff=0.001)
     try:
