@@ -352,6 +352,57 @@ def _bench_params(jax, cfg, model: str, dtype: str, on_cpu: bool,
     return params, param_bytes, dtype
 
 
+def _sched_utilization(sched, recompiles0: int = 0) -> dict:
+    """Compact utilization block for a scheduler-driven arm — every bench
+    arm's summary reports mfu/occupancy/waste_pct plus the recompiles that
+    landed in the MEASURED window (recompiles0 is the post-warmup
+    snapshot; the CI smoke asserts the delta stays 0)."""
+    try:
+        snap = sched.utilization_stats(window_s=600)
+    except Exception:  # noqa: BLE001 — summaries must never kill a capture
+        return {}
+    rc = snap.get("recompiles") or {}
+    out = {"enabled": bool(snap.get("enabled")),
+           "recompiles": int(sum(rc.values())) - int(recompiles0)}
+    if out["enabled"]:
+        # aggregate over LIFETIME totals, not the per-second window: a
+        # seconds-scale arm can finish inside the in-progress second,
+        # which snapshot() deliberately excludes from windowed rates —
+        # the arm's honest aggregate is totals over its own wall clock
+        tot = snap.get("totals") or {}
+        useful = float(sum((tot.get("useful_tokens") or {}).values()))
+        padded = float(sum((tot.get("padded_tokens") or {}).values()))
+        issued = useful + padded
+        wall = float((snap.get("breakdown") or {}).get("wall_s") or 0.0)
+        peak = snap.get("peak_flops")
+        flops = float(tot.get("model_flops") or 0.0)
+        out.update(
+            mfu=(round(flops / wall / peak, 6)
+                 if peak and wall > 0 else None),
+            occupancy=round(useful / issued, 4) if issued else None,
+            waste_pct=(round(100.0 * padded / issued, 2)
+                       if issued else 0.0),
+            goodput_tok_s=round(useful / wall, 2) if wall > 0 else 0.0)
+    return out
+
+
+def _analytic_utilization(cfg, *, dt_s: float, flops: float, useful: float,
+                          issued: float) -> dict:
+    """Utilization block for engine-level captures (no scheduler in the
+    loop): same closed-form FLOPs model as runtime/accounting.py, grid
+    geometry supplied by the capture itself."""
+    from ollama_operator_tpu.runtime.accounting import detect_peak_flops
+    peak, kind = detect_peak_flops()
+    waste = max(0.0, issued - useful)
+    return {
+        "mfu": (round(flops / dt_s / peak, 6)
+                if peak and dt_s > 0 else None),
+        "occupancy": round(useful / issued, 4) if issued else None,
+        "waste_pct": round(100.0 * waste / issued, 2) if issued else 0.0,
+        "device_kind": kind,
+    }
+
+
 def measure(jax, *, model: str, dtype: str, slots: int, steps: int,
             seq: int, prompt_len: int, paged: bool, mixed: bool,
             chunk: int, page_size: int, n_pages: int | None,
@@ -516,6 +567,16 @@ def measure(jax, *, model: str, dtype: str, slots: int, steps: int,
         "bytes_per_step_gb": round(bytes_per_step / 1e9, 3),
         "hbm_gb_s": round(hbm_gbs, 1),
     }
+    # analytic utilization: this capture decodes the full resident batch
+    # (every slot active, no padding) so occupancy is 1.0 by construction;
+    # MFU is the closed-form FLOPs model over the measured wall time
+    from ollama_operator_tpu.runtime.accounting import decode_flops
+    ctx0 = plens.astype(np.int64) + 1 + chunk   # prompt + first tok + warm
+    model_flops = float(sum(decode_flops(cfg, int(c), n_steps)
+                            for c in ctx0))
+    rec["utilization"] = _analytic_utilization(
+        cfg, dt_s=dt, flops=model_flops,
+        useful=float(n_steps * slots), issued=float(n_steps * slots))
     if paged:
         rec["page_size"] = page_size
         rec["n_pages"] = n_pages or eng._pt.n_pages
@@ -666,13 +727,24 @@ def measure_spec(jax, *, model: str, dtype: str, slots: int, steps: int,
             dispatches += 1
         dt = time.perf_counter() - t0
         emitted = int(pos.sum())
+        # utilization: every spec dispatch runs all slots over k+1
+        # positions; useful = tokens that advanced streams, the rest of
+        # the issued grid (rejected drafts) is waste. FLOPs estimated at
+        # the mid-run context (exact would need per-dispatch ctx capture)
+        from ollama_operator_tpu.runtime.accounting import spec_verify_flops
+        issued = float(dispatches * slots * (k + 1))
+        ctx_mid = int(prompt_len + 1 + chunk + emitted / (2 * slots))
+        flops = dispatches * slots * spec_verify_flops(cfg, ctx_mid, k)
         rec = {"label": label, "tok_s": round(emitted / dt, 2),
                "dispatches": dispatches,
                "ms_per_dispatch": round(dt / max(dispatches, 1) * 1e3, 2),
                "tokens_per_dispatch": round(emitted / max(dispatches, 1),
                                             2),
                "acceptance_rate": round(accepted_tot / drafted_tot, 4)
-               if drafted_tot else 0.0}
+               if drafted_tot else 0.0,
+               "utilization": _analytic_utilization(
+                   cfg, dt_s=dt, flops=flops, useful=float(emitted),
+                   issued=issued)}
         log(f"bench: spec {label}: {json.dumps(rec)}")
         return rec
 
@@ -739,6 +811,8 @@ def measure_spec(jax, *, model: str, dtype: str, slots: int, steps: int,
         # vs a chunk dispatch (`chunk` sequential forwards) — must stay
         # near or below 1.0; >= 2.0 means launch overhead, not compute
         "dispatch_ratio": dispatch_ratio,
+        # headline utilization follows the headline arm (the real drafter)
+        "utilization": lookup.get("utilization"),
         "slots": slots, "steps": n_steps, "dtype": dtype,
         "decode_chunk": chunk, "spec_k": k,
         "prompt_len": prompt_len,
@@ -877,6 +951,8 @@ def measure_http(jax, *, model: str, dtype: str, slots: int, steps: int,
         return samples
 
     generate(2, int(lens[0]))          # warm the serving path end to end
+    # recompile snapshot after warmup: the measured window must compile 0
+    rc0 = sum(getattr(lm.scheduler.engine, "recompiles", {}).values())
 
     results = [dict() for _ in range(slots)]
     threads = [threading.Thread(target=generate,
@@ -913,6 +989,7 @@ def measure_http(jax, *, model: str, dtype: str, slots: int, steps: int,
         "prompt_len": int(np.max(lens)),
         "total_tokens": total_tokens,
         "wall_s": round(wall, 2),
+        "utilization": _sched_utilization(lm.scheduler, rc0),
     }
     if env:
         rec["env"] = dict(env)
@@ -942,6 +1019,7 @@ def measure_mixed(jax, *, model: str, dtype: str, slots: int, steps: int,
     import threading
 
     from ollama_operator_tpu.models.config import get_config
+    from ollama_operator_tpu.runtime import accounting as acct_mod
     from ollama_operator_tpu.runtime import trace as trace_mod
     from ollama_operator_tpu.runtime.engine import (Engine, EngineConfig,
                                                     SlotOptions,
@@ -1014,13 +1092,19 @@ def measure_mixed(jax, *, model: str, dtype: str, slots: int, steps: int,
                    for _ in range(n_arr)]
     arr_gap_s = float(os.environ.get("BENCH_MIXED_GAP_S", "0.05"))
 
-    def run_arm(overlap: bool, tracing: bool = True) -> dict:
+    def run_arm(overlap: bool, tracing: bool = True,
+                acct: bool = True) -> dict:
         # request-lifecycle tracing (runtime/trace.py) is on by default;
         # the tracing=False arm flips the module switch so its Scheduler
         # hands every request the shared NULL_TRACE — the A/B for the
-        # ≤2% tok/s overhead budget tracing must stay under
+        # ≤2% tok/s overhead budget tracing must stay under. The
+        # acct=False arm does the same for utilization accounting
+        # (runtime/accounting.py): its Scheduler gets NULL_ACCOUNTING,
+        # the A/B for the accounting overhead budget.
         prev_tracing = trace_mod.TRACE_ENABLED
+        prev_acct = acct_mod.ACCOUNTING_ENABLED
         trace_mod.TRACE_ENABLED = tracing
+        acct_mod.ACCOUNTING_ENABLED = acct
         sched = Scheduler(eng, prefill_chunk=(piece_b if overlap else 0),
                           async_dispatch=overlap)
         try:
@@ -1032,9 +1116,12 @@ def measure_mixed(jax, *, model: str, dtype: str, slots: int, steps: int,
                              max_tokens=chunk_eff)
             for _ in w.chunks():
                 pass
-            # counter snapshots AFTER warmup: compile time is not stall
+            # counter snapshots AFTER warmup: compile time is not stall,
+            # and arm-specific warmup compiles are not recompiles — the
+            # measured window's recompile delta must stay 0
             stall0 = METRICS.get("tpu_model_admission_stall_ms_total")
             chunks0 = METRICS.get("tpu_model_prefill_chunks_total")
+            rc0 = sum(getattr(eng, "recompiles", {}).values())
             stop_bg = threading.Event()
             bg = []
             readers = []
@@ -1134,9 +1221,11 @@ def measure_mixed(jax, *, model: str, dtype: str, slots: int, steps: int,
                     METRICS.get("tpu_model_prefill_chunks_total")
                     - chunks0),
                 "arrival_errors": errors or None,
+                "utilization": _sched_utilization(sched, rc0),
             }
         finally:
             trace_mod.TRACE_ENABLED = prev_tracing
+            acct_mod.ACCOUNTING_ENABLED = prev_acct
             sched.shutdown()
             for s in range(eng.n_slots):
                 try:
@@ -1164,6 +1253,25 @@ def measure_mixed(jax, *, model: str, dtype: str, slots: int, steps: int,
             raise AssertionError(
                 f"tracing overhead over budget: tok/s ratio {trace_ratio}"
                 f" < 0.98 (on={on['bg_tok_s']} off={notrace['bg_tok_s']})")
+    # accounting overhead arm: same overlap-on load with utilization
+    # accounting disabled (the Scheduler gets NULL_ACCOUNTING). bg tok/s
+    # with accounting on must stay within 2% of this — the budget the
+    # closed-form FLOPs model was designed to (one arithmetic-series
+    # evaluation per *dispatch*, not per token). Set
+    # BENCH_ASSERT_ACCOUNTING=1 to hard-fail on a violation (smoke-scale
+    # CPU arms are too noisy to gate by default; the TPU job opts in).
+    noacct = run_arm(True, acct=False)
+    acct_ratio = (round(on["bg_tok_s"] / noacct["bg_tok_s"], 3)
+                  if on.get("bg_tok_s") and noacct.get("bg_tok_s")
+                  else None)
+    if acct_ratio is not None and acct_ratio < 0.98:
+        log(f"bench: WARNING accounting-on bg tok/s is {acct_ratio} of "
+            f"accounting-off (budget: >= 0.98)")
+        if os.environ.get("BENCH_ASSERT_ACCOUNTING") == "1":
+            raise AssertionError(
+                f"accounting overhead over budget: tok/s ratio "
+                f"{acct_ratio} < 0.98 (on={on['bg_tok_s']} "
+                f"off={noacct['bg_tok_s']})")
     rec = {
         "model": model,
         # "mixed_paged" is the ISSUE-5 headline capture: its
@@ -1183,6 +1291,13 @@ def measure_mixed(jax, *, model: str, dtype: str, slots: int, steps: int,
         "trace_overhead_ok": (trace_ratio >= 0.98
                               if trace_ratio is not None else None),
         "overlap_on_notrace": notrace,
+        # accounting-on vs accounting-off throughput on the same
+        # overlap-on load; >= 0.98 is the accounting overhead budget
+        "acct_tok_s_ratio": acct_ratio,
+        "acct_overhead_ok": (acct_ratio >= 0.98
+                             if acct_ratio is not None else None),
+        "overlap_on_noacct": noacct,
+        "utilization": on.get("utilization"),
         "slots": slots,
         "dtype": dtype,
         "paged": paged,
@@ -1298,6 +1413,7 @@ def measure_prefix(jax, *, model: str, dtype: str, slots: int, steps: int,
                 run_one(t, {})
             hit0 = METRICS.get("tpu_model_prefix_hit_tokens_total")
             miss0 = METRICS.get("tpu_model_prefix_miss_tokens_total")
+            rc0 = sum(getattr(eng, "recompiles", {}).values())
             outs = [{} for _ in range(k_conc)]
             threads = [threading.Thread(target=run_one, args=(t, o))
                        for t, o in zip(tails[2:], outs)]
@@ -1327,6 +1443,7 @@ def measure_prefix(jax, *, model: str, dtype: str, slots: int, steps: int,
                 "radix_nodes": int(getattr(eng, "radix_nodes", 0)),
                 "radix_pages": int(getattr(eng, "radix_pages", 0)),
                 "errors": errors or None,
+                "utilization": _sched_utilization(sched, rc0),
             }
         finally:
             sched.shutdown()
@@ -1362,6 +1479,7 @@ def measure_prefix(jax, *, model: str, dtype: str, slots: int, steps: int,
             sync["ttft_p95_ms"] / on["ttft_p95_ms"], 2)
             if on.get("ttft_p95_ms") and sync.get("ttft_p95_ms")
             else None),
+        "utilization": on.get("utilization"),
         "slots": slots,
         "dtype": dtype,
         "paged": True,
@@ -1518,12 +1636,14 @@ def measure_overload(jax, *, model: str, dtype: str, slots: int, steps: int,
         w = sched.submit(list(prompt_of()), greedy, max_tokens=chunk_eff)
         for _ in w.chunks():
             pass
+        rc0 = sum(getattr(eng, "recompiles", {}).values())
         base_ttfts = run_baseline(sched)
         n_workers = 5 * slots
         over = run_overload(sched, n_workers,
                             reqs_per_worker=int(os.environ.get(
                                 "BENCH_OVERLOAD_REQS", "4")))
         base_after = run_baseline(sched)   # recovery: drained queue
+        util = _sched_utilization(sched, rc0)
     finally:
         sched.shutdown()
         for s in range(eng.n_slots):
@@ -1592,6 +1712,7 @@ def measure_overload(jax, *, model: str, dtype: str, slots: int, steps: int,
                                if retry_afters else None),
         "tenant_token_share": tenant_share,
         "per_class": per_class,
+        "utilization": util,
         "slots": slots,
         "n_workers": 5 * slots,
         "dtype": dtype,
@@ -1733,6 +1854,11 @@ def measure_restart(jax, *, model: str, dtype: str, slots: int, steps: int,
         n_restarts = sched.n_restarts - restarts0
         n_replays = sched.n_replays
         broken = sched.broken
+        # no post-warmup recompile baseline here: restart replay
+        # re-prefills interrupted streams, and any bucket that compiles
+        # during that recovery is a REAL mid-serving recompile this arm
+        # should surface, not warmup noise
+        util = _sched_utilization(sched)
     finally:
         FAULTS.disarm("engine.step")
         sched.shutdown()
@@ -1763,6 +1889,7 @@ def measure_restart(jax, *, model: str, dtype: str, slots: int, steps: int,
             METRICS.get("tpu_model_replayed_requests_total") - replay0),
         "replayed_tokens": int(
             METRICS.get("tpu_model_replayed_tokens_total") - rtok0),
+        "utilization": util,
         "slots": slots,
         "dtype": dtype,
         "paged": paged,

@@ -294,3 +294,18 @@ def retry_transient(fn: Callable[[], Any], attempts: int = 4,
                 raise
             time.sleep(min(cap, backoff * (2 ** i))
                        * (0.5 + random.random() / 2))
+
+
+def fetch_replica_ps(url: str, timeout: float = 2.0) -> Optional[Dict]:
+    """GET a model server's /api/ps and return the parsed body, or None
+    on any failure. This is the reconciler's replica-stats scrape (plain
+    pod-network HTTP, not an apiserver call): utilization mirroring is an
+    optimisation, so it must never be able to wedge the control loop —
+    short timeout, no retries, every error collapses to None."""
+    try:
+        req = urllib.request.Request(url, headers={"Accept":
+                                                   "application/json"})
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return json.loads(resp.read().decode())
+    except Exception:  # noqa: BLE001 — best-effort scrape by design
+        return None

@@ -29,8 +29,8 @@ import logging
 from typing import Any, Dict, Optional
 
 from . import workload
-from .client import KubeClient, NotFound
-from .pod import SERVER_BASE_IMAGE
+from .client import KubeClient, NotFound, fetch_replica_ps
+from .pod import PORT, SERVER_BASE_IMAGE
 from .recorder import Recorder
 from .types import (API_VERSION, CONDITION_AVAILABLE, CONDITION_PROGRESSING,
                     CONDITION_REPLICA_FAILURE, KIND, ModelSpecView)
@@ -113,10 +113,14 @@ class ModelReconciler:
     workqueue, manager.py)."""
 
     def __init__(self, client: KubeClient, recorder: Recorder,
-                 server_image: str = SERVER_BASE_IMAGE):
+                 server_image: str = SERVER_BASE_IMAGE,
+                 ps_fetch=None):
         self.c = client
         self.rec = recorder
         self.server_image = server_image
+        # replica-stats scrape (GET <pod>/api/ps): injectable so the
+        # fake-kube e2e can hand back canned bodies without a server
+        self.ps_fetch = ps_fetch or fetch_replica_ps
 
     # --- status writers -------------------------------------------------
     def _write_status(self, model: Dict[str, Any]) -> Dict[str, Any]:
@@ -166,6 +170,54 @@ class ModelReconciler:
         if c1 or c2:
             self._write_status(model)
             self.rec.event(model, "Warning", "ReplicaFailure", message)
+
+    # --- replica utilization mirror -------------------------------------
+    def _replica_utilization(self, namespace: str,
+                             app: str) -> list:
+        """Scrape every pod of the model workload for its /api/ps and
+        condense the utilization/health block into one compact entry per
+        replica — the data ROADMAP item 4's utilization-aware router
+        routes on. Best-effort by design: unreachable pods are marked,
+        a failed pod list yields [] and the mirror is simply skipped."""
+        try:
+            pods = self.c.list("v1", "Pod", namespace,
+                               label_selector=f"app={app}")
+        except Exception:  # noqa: BLE001 — mirror must never wedge
+            return []
+        out = []
+        for pod in sorted(pods, key=lambda p: (p.get("metadata") or {})
+                          .get("name", "")):
+            st = pod.get("status") or {}
+            ip = st.get("podIP")
+            if not ip:
+                continue
+            entry = {"pod": (pod.get("metadata") or {}).get("name", ""),
+                     "ip": ip}
+            body = self.ps_fetch(f"http://{ip}:{PORT}/api/ps")
+            served = None
+            for m in (body or {}).get("models") or []:
+                if m.get("utilization"):
+                    served = m
+                    break
+            if body is None:
+                entry["state"] = "unreachable"
+            elif served is None:
+                entry["state"] = "no_model"
+            else:
+                util = served.get("utilization") or {}
+                life = served.get("lifecycle") or {}
+                rec = util.get("recompiles") or {}
+                entry.update({
+                    "state": life.get("state") or "serving",
+                    "model": served.get("name", ""),
+                    "mfu": util.get("mfu"),
+                    "goodputTokS": util.get("goodput_tok_s"),
+                    "occupancy": util.get("occupancy"),
+                    "wastePct": util.get("waste_pct"),
+                    "recompiles": int(sum(rec.values())) if rec else 0,
+                })
+            out.append(entry)
+        return out
 
     # --- the ladder -----------------------------------------------------
     def reconcile(self, namespace: str, name: str) -> Result:
@@ -262,6 +314,17 @@ class ModelReconciler:
             self._write_status(model)
             return POLL
 
-        # 5) available — and *stay* correct if replicas later fail
+        # 5) per-replica utilization mirror + available. The scrape rides
+        # the converged pass only (pods are ready here); it stays DONE —
+        # the mirror refreshes on the next watch-driven reconcile, it
+        # must not turn a settled Model into a perpetual requeue
+        stats = self._replica_utilization(namespace, app)
+        if stats:
+            status_obj = model.setdefault("status", {})
+            prev = (status_obj.get("replicaStats") or {}).get("replicas")
+            if prev != stats:
+                status_obj["replicaStats"] = {"scrapedAt": _now(),
+                                              "replicas": stats}
+                self._write_status(model)
         self.set_available(model)
         return DONE

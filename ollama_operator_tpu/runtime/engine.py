@@ -37,8 +37,10 @@ from ..models.config import ModelConfig
 from ..models import decoder
 from ..ops import sampling
 from .faults import FAULTS
+from .trace import FLIGHT
 from ..parallel.sharding import (kv_cache_pspec, params_sharding_tree,
                                  resolve_moe_impl)
+from ..server.metrics import GLOBAL as METRICS
 
 
 @dataclasses.dataclass(frozen=True)
@@ -607,6 +609,15 @@ class Engine:
         # setup.
         self.dispatch_ms = {"decode": 0.0, "admit": 0.0, "extend": 0.0,
                             "spec": 0.0}
+        # mid-serving recompile detector: warm_buckets registers every
+        # AOT-warmed executable signature; an executable-cache miss
+        # outside warming is an XLA compile inside a timed dispatch —
+        # counted per program kind (the BENCH_r05 incident as a counter)
+        self._warming = False
+        self._warmed_sigs: set = set()
+        self.recompiles: Dict[str, int] = {
+            "decode": 0, "admit": 0, "admit_many": 0, "extend": 0,
+            "spec": 0}
 
         # per-slot sampling params, host mirror + device arrays
         self._opts: Dict[int, SlotOptions] = {}
@@ -1534,6 +1545,7 @@ class Engine:
     def _admit_many_exec(self, m: int, bucket: int):
         exe = self._admit_many_execs.get((m, bucket))
         if exe is None:
+            self._note_compile("admit_many", (m, bucket))
             tokens = self._gr(np.zeros((m, bucket), np.int32))
             table_rows = (self._gr(np.zeros((m, self._nblk), np.int32))
                           if self.paged else None)
@@ -1654,6 +1666,7 @@ class Engine:
         A = self._canon_attn(A)
         exe = self._extend_execs.get((bucket, A))
         if exe is None:
+            self._note_compile("extend", (bucket, A))
             tokens = self._gr(np.zeros((1, bucket), np.int32))
             W = max(1, self.ecfg.repeat_last_n)
             zi = lambda v: self._gr(np.int32(v))  # noqa: E731
@@ -1832,10 +1845,27 @@ class Engine:
         self._host_lengths[self.active] += 1
         return self._fetch(toks)
 
+    def _note_compile(self, kind: str, key: Any) -> None:
+        """Called from every executable-cache miss. While warm_buckets is
+        running the signature is merely registered; outside it, a miss is
+        a mid-serving XLA compile paid inside a timed dispatch — count it
+        (once per signature) and drop a flight-recorder event."""
+        sig = (kind, key)
+        if self._warming:
+            self._warmed_sigs.add(sig)
+            return
+        if sig in self._warmed_sigs:
+            return
+        self._warmed_sigs.add(sig)
+        self.recompiles[kind] = self.recompiles.get(kind, 0) + 1
+        METRICS.inc("tpu_model_recompiles_total", 1.0, f'{{kind="{kind}"}}')
+        FLIGHT.record("recompile", program=kind, key=str(key))
+
     def _decode_n_exec(self, n: int, attn_len: int):
         key = (n, attn_len)
         exe = self._decode_execs.get(key)
         if exe is None:
+            self._note_compile("decode", key)
             budgets = self._g(np.full((self.n_slots,), n, np.int32),
                               self._slot_sh)
             exe = self._decode_n_fn.lower(
@@ -1850,6 +1880,7 @@ class Engine:
     def _admit_exec(self, bucket: int):
         exe = self._admit_execs.get(bucket)
         if exe is None:
+            self._note_compile("admit", bucket)
             tokens = self._gr(np.zeros((1, bucket), np.int32))
             if not self.paged:
                 table_row = None
@@ -1875,6 +1906,22 @@ class Engine:
                      ctx_lo: Optional[int] = None,
                      ctx_hi: Optional[int] = None,
                      full: bool = True):
+        """Public warm entry: every executable compiled inside is
+        registered as an AOT-warmed signature (not a recompile) — the
+        recompile detector only counts cache misses OUTSIDE this scope.
+        See _warm_buckets for the warm plan itself."""
+        prev = self._warming
+        self._warming = True
+        try:
+            return self._warm_buckets(n, ctx_lo=ctx_lo, ctx_hi=ctx_hi,
+                                      full=full)
+        finally:
+            self._warming = prev
+
+    def _warm_buckets(self, n: Optional[int] = None, *,
+                      ctx_lo: Optional[int] = None,
+                      ctx_hi: Optional[int] = None,
+                      full: bool = True):
         """AOT-compile the chunked decode program for every attention
         bucket AND the admission program for every prefill bucket, so
         serving never pays an XLA compile mid-request. Non-bucketed paths
@@ -2230,6 +2277,7 @@ class Engine:
         key = (k, attn_len)
         exe = self._spec_execs.get(key)
         if exe is None:
+            self._note_compile("spec", key)
             drafts = self._g(np.zeros((self.n_slots, k), np.int32),
                              self._slot_sh2)
             flags = self._g(np.zeros((self.n_slots,), np.int32),

@@ -27,6 +27,7 @@ from typing import Iterator, List, Optional, Sequence
 import numpy as np
 
 from ..server.metrics import GLOBAL as METRICS
+from . import accounting
 from . import drafter
 from .admission import (DEFAULT_TENANT, PRIORITY_RANK, AdmissionQueue,
                         TenantRateLimited, TenantRateLimiter,
@@ -395,6 +396,11 @@ class Scheduler:
         self._consecutive_failures = 0
         self.total_generated = 0
         self.total_prompt = 0
+        # utilization & goodput accounting (runtime/accounting.py):
+        # per-dispatch FLOPs/goodput splits + the dispatch-wait/host/idle
+        # wall-clock breakdown. make_accounting honors TPU_ACCOUNTING=0
+        # at construction (bench A/B flips the module flag between arms).
+        self.acct = accounting.make_accounting(getattr(engine, "cfg", None))
         self.finished: List[RequestStats] = []  # ring of recent stats
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="tpu-scheduler")
@@ -516,8 +522,15 @@ class Scheduler:
         if victim is not None:
             # queue pressure displaced a strictly lower-priority queued
             # request (shed-lowest-first); outside the lock — _shed
-            # takes it for the finished ring
+            # takes it for the finished ring. The dedicated "displaced"
+            # event (distinct from the victim's own "shed") puts the
+            # *eviction* in the flight-recorder timeline with both sides'
+            # identities.
+            FLIGHT.record("displaced", rid=victim.id, cls=victim.priority,
+                          tenant=victim.tenant, by=req.id,
+                          by_cls=req.priority)
             self._shed(victim, cause="queue_full")
+        req.trace.set_identity(priority, tenant)
         req.trace.event("queued", n_prompt=len(prompt_ids),
                         max_tokens=max_tokens, cls=priority,
                         tenant=tenant)
@@ -701,6 +714,14 @@ class Scheduler:
                 "fires": self.n_watchdog_fires,
             },
         }
+
+    def utilization_stats(self, window_s: float = 60.0) -> dict:
+        """Utilization snapshot for /api/ps (and the operator's Model CR
+        status mirror): MFU, goodput, occupancy/waste, wall-clock
+        breakdown, and the engine's mid-serving recompile counts."""
+        out = self.acct.snapshot(window_s)
+        out["recompiles"] = dict(getattr(self.engine, "recompiles", {}))
+        return out
 
     # ------------------------------------------------------------------
     def _finish(self, slot: int, req: Request, reason: str):
@@ -1060,8 +1081,12 @@ class Scheduler:
         kind = "extend" if reuse_len else "admit"
         METRICS.observe("tpu_model_dispatch_seconds", dur,
                         f'{{kind="{kind}"}}')
+        n_new = len(req.admit_ids) - reuse_len
+        self.acct.on_prefill(dur, reuse_len, n_new,
+                             self.engine.bucket_for(n_new))
+        self.acct.on_wait(dur)
         req.trace.event("prefill", kind=kind, dur_ms=round(dur * 1e3, 3),
-                        n_tokens=len(req.admit_ids) - reuse_len)
+                        n_tokens=n_new)
         self._post_admit(slot, req, first)
         return True
 
@@ -1118,6 +1143,10 @@ class Scheduler:
         kind = "extend" if reuse_len else "admit"
         METRICS.observe("tpu_model_dispatch_seconds", dur,
                         f'{{kind="{kind}"}}')
+        n_new = end - reuse_len
+        self.acct.on_prefill(dur, reuse_len, n_new,
+                             self.engine.bucket_for(n_new))
+        self.acct.on_wait(dur)
         req.trace.event("prefill_piece", kind=kind, done=end,
                         of=len(ids), dur_ms=round(dur * 1e3, 3))
         req.slot = slot
@@ -1164,6 +1193,7 @@ class Scheduler:
                 self._abort_prefill(slot, "timeout")
             return
         ids = req.admit_ids
+        start = job.done
         end = min(job.done + self.prefill_chunk, len(ids))
         final = end == len(ids)
         t0 = time.perf_counter()
@@ -1195,6 +1225,9 @@ class Scheduler:
         METRICS.inc("tpu_model_admission_stall_ms_total", dur * 1e3)
         METRICS.observe("tpu_model_dispatch_seconds", dur,
                         '{kind="extend"}')
+        self.acct.on_prefill(dur, start, end - start,
+                             self.engine.bucket_for(end - start))
+        self.acct.on_wait(dur)
         req.trace.event("prefill_piece", kind="extend", done=end,
                         of=len(ids), dur_ms=round(dur * 1e3, 3))
         if final:
@@ -1233,6 +1266,12 @@ class Scheduler:
                             dur * 1e3)
                 METRICS.observe("tpu_model_dispatch_seconds", dur,
                                 '{kind="admit"}')
+                # one batched dispatch: split its wall time evenly so the
+                # ring's busy_s doesn't count the dispatch m times
+                for _, r in group:
+                    self.acct.on_prefill(dur / m, 0, len(r.admit_ids),
+                                         bucket)
+                self.acct.on_wait(dur)
                 for (s, r), tok in zip(group, toks):
                     # batched admissions are always cold (a resumed
                     # request must not re-report its first admission's
@@ -1779,7 +1818,12 @@ class Scheduler:
         length (a parked/donated predecessor's length was already
         reset or is repaired at reuse). Folds per-slot drafted/accepted
         counts into the acceptance metrics."""
+        tw0 = time.perf_counter()
         toks_n = self._watched(handle.wait)
+        # breakdown: only the time the scheduler actually BLOCKED here is
+        # dispatch-wait (under async overlap the device may already be
+        # done); `dur` below is the full launch→host device span
+        self.acct.on_wait(time.perf_counter() - tw0)
         self._fence_ack = handle.epoch
         self._consecutive_failures = 0
         # dispatch latency: launch → tokens-on-host, per program kind.
@@ -1791,6 +1835,21 @@ class Scheduler:
                if handle.t_done is not None else 0.0)
         METRICS.observe("tpu_model_dispatch_seconds", dur,
                         f'{{kind="{kind}"}}')
+        if self.acct.enabled:
+            # goodput/FLOPs split of the dispatch grid: active slots'
+            # host-mirrored lengths as contexts, the full slot batch as
+            # the padded capacity
+            hl, act = self.engine._host_lengths, self.engine.active
+            ctxs = [int(hl[s]) for s in range(len(act)) if act[s]]
+            n_rows = int(np.asarray(toks_n).shape[0])
+            if kind == "spec":
+                emitted = (float(np.asarray(handle.accepted).sum())
+                           if handle.accepted is not None else 0.0)
+                self.acct.on_spec(dur, ctxs, max(0, n_rows - 1), emitted,
+                                  self.engine.n_slots)
+            else:
+                self.acct.on_decode(dur, ctxs, n_rows,
+                                    self.engine.n_slots)
         if snapshot is not None:
             for s, r in snapshot.items():
                 if self._running[s] is not r:
@@ -1880,7 +1939,9 @@ class Scheduler:
             if self.engine.quarantined_pages:
                 self._quiesce("idle")
             if not self._prefilling:
+                t_idle = time.perf_counter()
                 self._wake.wait(timeout=0.05)
+                self.acct.on_idle(time.perf_counter() - t_idle)
                 self._wake.clear()
             return
         # drop cancelled and over-deadline slots before paying for a
@@ -1961,6 +2022,13 @@ class Scheduler:
                 dur = time.perf_counter() - t0
                 METRICS.observe("tpu_model_dispatch_seconds", dur,
                                 '{kind="decode"}')
+                self.acct.on_wait(dur)
+                if self.acct.enabled:
+                    hl = self.engine._host_lengths
+                    self.acct.on_decode(
+                        dur, [int(hl[s]) for s in decoding],
+                        int(np.asarray(toks_n).shape[0]),
+                        self.engine.n_slots)
                 for s, r in decoding.items():
                     if self._running[s] is r:
                         r.trace.event_at(t0, "dispatch", kind="decode",

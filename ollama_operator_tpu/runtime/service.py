@@ -288,6 +288,24 @@ class LoadedModel:
                 and lm.engine.dispatch_ms.get(k, 0.0) or 0.0,
                 labels=f'{{program="{_kind}"}}')
 
+        # utilization gauges (runtime/accounting.py): 60s-window MFU,
+        # occupancy, goodput and waste read from the scheduler's
+        # accounting snapshot; None (no peak known / idle) renders 0
+        def _util(field):
+            lm = wself()
+            if lm is None or lm.scheduler is None:
+                return 0.0
+            acct = getattr(lm.scheduler, "acct", None)
+            if acct is None or not acct.enabled:
+                return 0.0
+            return float(acct.snapshot().get(field) or 0.0)
+        METRICS.gauge_fn("tpu_model_mfu", lambda: _util("mfu"))
+        METRICS.gauge_fn("tpu_model_occupancy", lambda: _util("occupancy"))
+        METRICS.gauge_fn("tpu_model_goodput_tokens_per_second",
+                         lambda: _util("goodput_tok_s"))
+        METRICS.gauge_fn("tpu_model_padding_waste_pct",
+                         lambda: _util("waste_pct"))
+
     # ------------------------------------------------------------------
     # multimodal (llava): image bytes → projected embeddings → spliced
     # prompt embedding sequence handed to the engine's embeds admission
@@ -702,6 +720,10 @@ class LoadedModel:
         for _kind in ("decode", "admit", "extend", "spec"):
             METRICS.remove_gauge("tpu_model_dispatch_ms",
                                  labels=f'{{program="{_kind}"}}')
+        for _g in ("tpu_model_mfu", "tpu_model_occupancy",
+                   "tpu_model_goodput_tokens_per_second",
+                   "tpu_model_padding_waste_pct"):
+            METRICS.remove_gauge(_g)
 
 
 class _IdleScheduler:
@@ -732,6 +754,9 @@ class _IdleScheduler:
 
     def lifecycle_stats(self) -> dict:
         return {}   # no decode loop: nothing to replay, drain, or watch
+
+    def utilization_stats(self, window_s: float = 60.0) -> dict:
+        return {}   # no dispatches: nothing to account
 
     def begin_drain(self):
         pass        # encoders hold no streams; drain is instant

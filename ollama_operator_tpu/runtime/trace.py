@@ -53,13 +53,24 @@ class RequestTrace:
     spans subtract cleanly).  `fields` is a small dict or None.
     """
 
-    __slots__ = ("rid", "t_wall", "_t0", "events")
+    __slots__ = ("rid", "t_wall", "_t0", "events", "cls", "tenant")
 
     def __init__(self, rid: str):
         self.rid = rid
         self.t_wall = time.time()
         self._t0 = time.perf_counter()
         self.events: List[tuple] = []
+        # admission identity (PR 8 priority class / tenant), set by the
+        # scheduler at submit() so a slow span is attributable to a class
+        self.cls: Optional[str] = None
+        self.tenant: Optional[str] = None
+
+    def set_identity(self, cls: Optional[str] = None,
+                     tenant: Optional[str] = None) -> None:
+        if cls:
+            self.cls = cls
+        if tenant:
+            self.tenant = tenant
 
     def event(self, name: str, **fields: Any) -> None:
         self.events.append(
@@ -77,7 +88,12 @@ class RequestTrace:
             if fields:
                 e.update(fields)
             evs.append(e)
-        return {"id": self.rid, "t_start_unix": self.t_wall, "events": evs}
+        out = {"id": self.rid, "t_start_unix": self.t_wall, "events": evs}
+        if self.cls:
+            out["class"] = self.cls
+        if self.tenant:
+            out["tenant"] = self.tenant
+        return out
 
     def timings(self) -> Dict[str, Any]:
         """Condensed per-stage summary for the opt-in `timings` block in the
@@ -106,6 +122,12 @@ class _NullTrace:
     __slots__ = ()
     rid = ""
     events: List[tuple] = []
+    cls: Optional[str] = None
+    tenant: Optional[str] = None
+
+    def set_identity(self, cls: Optional[str] = None,
+                     tenant: Optional[str] = None) -> None:
+        pass
 
     def event(self, name: str, **fields: Any) -> None:
         pass

@@ -695,6 +695,12 @@ class ModelManager:
                 # replay budget in force, and hung-dispatch watchdog
                 # posture (empty for encoder models)
                 "lifecycle": lm.scheduler.lifecycle_stats(),
+                # utilization accounting (runtime/accounting.py): 60s
+                # MFU/goodput/occupancy window, dispatch-wait/host/idle
+                # breakdown, and mid-serving recompile counts — the
+                # block the operator mirrors into the Model CR status
+                # (empty for encoder models)
+                "utilization": lm.scheduler.utilization_stats(),
             })
         return out
 
@@ -999,9 +1005,13 @@ class Handler(BaseHTTPRequestHandler):
 
     def _debug_events(self):
         """The flight-recorder ring: last TPU_FLIGHT_EVENTS structured
-        scheduler/engine events, oldest first. ?last=N trims to the
-        newest N."""
+        scheduler/engine events, oldest first. ?kind=K keeps only one
+        event type (applied BEFORE the trim, so ?kind=shed&last=10 is
+        the newest 10 sheds); ?last=N trims to the newest N."""
         events = FLIGHT.snapshot()
+        kind = self._query().get("kind", "")
+        if kind:
+            events = [e for e in events if e.get("kind") == kind]
         try:
             last = int(self._query().get("last", "0"))
         except ValueError:
@@ -1009,6 +1019,30 @@ class Handler(BaseHTTPRequestHandler):
         if last > 0:
             events = events[-last:]
         self._send_json({"events": events, "dumps": FLIGHT.dumps})
+
+    def _debug_utilization(self):
+        """Per-second utilization aggregates from the loaded model's
+        accounting ring (?last=N seconds, default 60) plus the windowed
+        snapshot — the payload behind the /api/ps utilization block."""
+        lm = self.manager.loaded
+        if lm is None or getattr(lm, "scheduler", None) is None:
+            self._send_json({"error": "no generative model loaded"}, 404)
+            return
+        acct = getattr(lm.scheduler, "acct", None)
+        if acct is None or not acct.enabled:
+            self._send_json(
+                {"enabled": False,
+                 "error": "accounting disabled (TPU_ACCOUNTING=0)"}, 200)
+            return
+        try:
+            last = int(self._query().get("last", "60"))
+        except ValueError:
+            last = 60
+        self._send_json({
+            "model": lm.name,
+            "snapshot": lm.scheduler.utilization_stats(),
+            "ring": acct.ring(last=max(1, min(last, 600))),
+        })
 
     def _debug_profile(self):
         """Capture a jax.profiler trace for ?seconds= (default 2, max
@@ -1062,6 +1096,8 @@ class Handler(BaseHTTPRequestHandler):
                 self._debug_trace()
             elif path == "/debug/events":
                 self._debug_events()
+            elif path == "/debug/utilization":
+                self._debug_utilization()
             elif path == "/debug/profile":
                 self._debug_profile()
             elif path in ("/readyz", "/livez"):

@@ -291,6 +291,45 @@ GLOBAL.describe("tpu_model_watchdog_fires_total",
                 "TPU_DISPATCH_WATCHDOG_MS or the histogram-derived "
                 "ceiling); each one forces a supervised restart + "
                 "replay")
+GLOBAL.describe("tpu_model_recompiles_total",
+                "Mid-serving XLA compiles, by program kind (kind=decode|"
+                "admit|admit_many|extend|spec): an executable-cache miss "
+                "OUTSIDE warm_buckets, paid inside a timed dispatch. "
+                "Nonzero after warmup means the warm plan missed a "
+                "signature (the BENCH_r05 623ms spec-dispatch incident "
+                "as a counter)")
+GLOBAL.describe("tpu_model_useful_tokens_total",
+                "Useful token positions computed per dispatch kind "
+                "(kind=decode|prefill|spec): active slots' steps, real "
+                "prompt positions, emitted speculative tokens — the "
+                "goodput numerator (runtime/accounting.py)")
+GLOBAL.describe("tpu_model_padded_tokens_total",
+                "Padding-waste token positions per dispatch kind: empty "
+                "batch slots x steps, prefill bucket positions past the "
+                "prompt chunk, rejected speculative drafts — the waste "
+                "half of the goodput split")
+GLOBAL.describe("tpu_model_model_flops_total",
+                "Analytic model FLOPs issued for active slots (matmul "
+                "terms only, MFU convention of Chowdhery et al.); rate() "
+                "over this / peak = MFU over any window")
+GLOBAL.describe("tpu_model_breakdown_seconds_total",
+                "Scheduler wall-clock classified by phase "
+                "(phase=dispatch_wait|host|idle): where the serving "
+                "thread's time goes between device programs")
+GLOBAL.describe("tpu_model_mfu",
+                "Achieved model-FLOPs utilization vs device peak over "
+                "the last 60s (0..1; 0 when no peak is known — CPU "
+                "without TPU_PEAK_FLOPS)")
+GLOBAL.describe("tpu_model_occupancy",
+                "Useful fraction of issued token positions over the "
+                "last 60s (active slots / padded grid, Orca-style "
+                "continuous-batching efficiency)")
+GLOBAL.describe("tpu_model_goodput_tokens_per_second",
+                "Useful tokens per second over the last 60s (decode + "
+                "prefill + accepted speculative)")
+GLOBAL.describe("tpu_model_padding_waste_pct",
+                "Percent of issued token positions that were padding "
+                "over the last 60s (100 - 100*occupancy)")
 # pre-seed the failure counters at 0: alert rules rate() over these, and
 # a series that first appears AT the first failure hides that failure
 # (the stall/chunk counters likewise: a mixed-load dashboard must read 0,
@@ -352,6 +391,19 @@ GLOBAL.inc("tpu_model_tenant_throttles_total", 0.0,
            '{class="best_effort",tenant="default"}')
 GLOBAL.inc("tpu_model_tenant_decode_tokens_total", 0.0,
            '{tenant="default"}')
+# utilization accounting (runtime/accounting.py): the recompile alert and
+# the goodput/waste dashboards must read 0, not absent, from the first
+# scrape — a recompile series that first appears AT the first mid-serving
+# compile hides exactly the event it exists to expose
+for _kind in ("decode", "admit", "admit_many", "extend", "spec"):
+    GLOBAL.inc("tpu_model_recompiles_total", 0.0, f'{{kind="{_kind}"}}')
+for _kind in ("decode", "prefill", "spec"):
+    GLOBAL.inc("tpu_model_useful_tokens_total", 0.0, f'{{kind="{_kind}"}}')
+    GLOBAL.inc("tpu_model_padded_tokens_total", 0.0, f'{{kind="{_kind}"}}')
+GLOBAL.inc("tpu_model_model_flops_total", 0.0)
+for _phase in ("dispatch_wait", "host", "idle"):
+    GLOBAL.inc("tpu_model_breakdown_seconds_total", 0.0,
+               f'{{phase="{_phase}"}}')
 
 
 class Stopwatch:

@@ -58,8 +58,22 @@ def test_request_trace_timings_summary():
 def test_null_trace_is_inert():
     NULL_TRACE.event("x", a=1)
     NULL_TRACE.event_at(0.0, "y")
+    NULL_TRACE.set_identity("high", "acme")
     assert NULL_TRACE.to_dict()["events"] == []
     assert NULL_TRACE.timings() == {"spans": []}
+    assert NULL_TRACE.cls is None and NULL_TRACE.tenant is None
+
+
+def test_request_trace_identity_labels():
+    tr = RequestTrace("9")
+    d = tr.to_dict()
+    assert "class" not in d and "tenant" not in d   # unset → omitted
+    tr.set_identity("high", "acme")
+    d = tr.to_dict()
+    assert d["class"] == "high" and d["tenant"] == "acme"
+    # falsy args never clobber an identity already set
+    tr.set_identity(None, None)
+    assert tr.cls == "high" and tr.tenant == "acme"
 
 
 # -- Tracer registry ---------------------------------------------------
@@ -143,6 +157,46 @@ def test_scheduler_traces_request_lifecycle():
         tm = tr.timings()
         assert tm["queue_wait_ms"] >= 0
         assert {s["ev"] for s in tm["spans"]} >= {"queued", "finish"}
+    finally:
+        sched.shutdown()
+
+
+def test_scheduler_threads_identity_into_trace():
+    cfg, params, eng, sched = make_stack(slots=2)
+    try:
+        r = sched.submit(np.array([1, 2], np.int32), GREEDY, max_tokens=3,
+                         priority="high", tenant="acme")
+        list(r.tokens())
+        d = TRACER.get(r.id).to_dict()
+        assert d["class"] == "high" and d["tenant"] == "acme"
+    finally:
+        sched.shutdown()
+
+
+def test_displacement_records_flight_event():
+    """Satellite 2: queue-full displacement leaves a dedicated
+    'displaced' event carrying both sides' class/tenant, distinct from
+    the victim's own shed."""
+    from test_stall_free import manual
+    seq0 = FLIGHT.seq
+    sched = manual(make_stack(slots=1)[3])
+    sched._admission.max_queue = 2
+    try:
+        sched.submit(np.array([1], np.int32), GREEDY, max_tokens=8,
+                     priority="normal")
+        victim = sched.submit(np.array([2], np.int32), GREEDY,
+                              max_tokens=8, priority="best_effort",
+                              tenant="acme")
+        high = sched.submit(np.array([3], np.int32), GREEDY, max_tokens=8,
+                            priority="high")
+        evs = [e for e in FLIGHT.snapshot()
+               if e["seq"] > seq0 and e["kind"] == "displaced"]
+        assert evs, "no displaced event recorded"
+        assert evs[0]["rid"] == victim.id
+        assert evs[0]["cls"] == "best_effort"
+        assert evs[0]["tenant"] == "acme"
+        assert evs[0]["by"] == high.id
+        assert evs[0]["by_cls"] == "high"
     finally:
         sched.shutdown()
 
@@ -266,6 +320,39 @@ def test_shed_counter_preseeds_full_label_matrix():
             'tpu_model_tenant_decode_tokens_total{tenant="default"}'):
         assert re.search(rf"^{re.escape(series)} [0-9.]+$", text, re.M), \
             f"{series} not pre-seeded"
+
+
+def test_utilization_metric_families_preseeded():
+    """PR 10: every utilization/goodput series must exist at 0 on an
+    idle scrape — rate() over a series that first appears mid-serving
+    reads as a counter reset (same discipline as the shed matrix)."""
+    text = METRICS.render()
+    series = ([f'tpu_model_recompiles_total{{kind="{k}"}}'
+               for k in ("decode", "admit", "admit_many", "extend", "spec")]
+              + [f'tpu_model_useful_tokens_total{{kind="{k}"}}'
+                 for k in ("decode", "prefill", "spec")]
+              + [f'tpu_model_padded_tokens_total{{kind="{k}"}}'
+                 for k in ("decode", "prefill", "spec")]
+              + [f'tpu_model_breakdown_seconds_total{{phase="{p}"}}'
+                 for p in ("dispatch_wait", "host", "idle")])
+    for s in series:
+        assert re.search(rf"^{re.escape(s)} [0-9.]+$", text, re.M), \
+            f"{s} not pre-seeded"
+    assert re.search(r"^tpu_model_model_flops_total [0-9.eE+]+$", text,
+                     re.M), "tpu_model_model_flops_total not pre-seeded"
+
+
+def test_utilization_series_pass_strict_validator():
+    from ollama_operator_tpu.models.config import PRESETS
+    from ollama_operator_tpu.runtime.accounting import UtilizationAccounting
+    acct = UtilizationAccounting(PRESETS["tiny"], peak_flops=1e12,
+                                 device_kind="unit")
+    acct.on_decode(0.01, ctxs=[4, 6], n_steps=2, capacity=4)
+    acct.on_prefill(0.01, 0, 5, 16)
+    acct.on_spec(0.01, ctxs=[8], k=2, emitted=1.0, capacity=1)
+    acct.on_wait(0.005)
+    acct.on_idle(0.005)
+    validate_prometheus_text(METRICS.render())
 
 
 def test_admission_label_sets_pass_strict_validator():

@@ -193,6 +193,103 @@ class TestDriftAndFailure:
         assert {"Available", "Progressing"} <= types
 
 
+class TestReplicaUtilizationMirror:
+    """PR 10 e2e: the converged pass scrapes every workload pod's
+    /api/ps (via the injectable ps_fetch) and mirrors a compact
+    utilization summary into the Model CR status."""
+
+    def _pod(self, kube, app, name, ip=None, namespace="default"):
+        pod = {"apiVersion": "v1", "kind": "Pod",
+               "metadata": {"name": name, "namespace": namespace,
+                            "labels": {"app": app}},
+               "status": {"phase": "Running"}}
+        if ip:
+            pod["status"]["podIP"] = ip
+        return kube.create(pod)
+
+    def test_status_carries_replica_utilization(self, kube, rec):
+        make_model(kube)
+        app = workload.model_app_name("phi")
+        self._pod(kube, app, f"{app}-a", "10.1.0.5")
+        calls = []
+
+        def fake_ps(url):
+            calls.append(url)
+            return {"models": [{
+                "name": "phi:latest",
+                "lifecycle": {"state": "serving"},
+                "utilization": {
+                    "enabled": True, "mfu": 0.41, "goodput_tok_s": 1234.5,
+                    "occupancy": 0.9, "waste_pct": 10.0,
+                    "recompiles": {"decode": 1, "admit": 0}},
+            }]}
+
+        recon = ModelReconciler(kube, rec, server_image="runtime:test",
+                                ps_fetch=fake_ps)
+        assert drive(recon, kube) == DONE
+        assert calls and calls[0] == "http://10.1.0.5:11434/api/ps"
+        m = kube.get(API_VERSION, KIND, "default", "phi")
+        rs = m["status"]["replicaStats"]
+        assert rs["scrapedAt"]
+        (entry,) = rs["replicas"]
+        assert entry["pod"] == f"{app}-a" and entry["ip"] == "10.1.0.5"
+        assert entry["state"] == "serving"
+        assert entry["model"] == "phi:latest"
+        assert entry["mfu"] == 0.41
+        assert entry["goodputTokS"] == 1234.5
+        assert entry["occupancy"] == 0.9
+        assert entry["wastePct"] == 10.0
+        assert entry["recompiles"] == 1
+        # the CR stays Available — the mirror must not demote it
+        assert is_condition_true(m, "Available")
+
+    def test_unreachable_and_empty_pods_are_marked(self, kube, rec):
+        make_model(kube)
+        app = workload.model_app_name("phi")
+        self._pod(kube, app, f"{app}-a", "10.1.0.5")   # unreachable
+        self._pod(kube, app, f"{app}-b", "10.1.0.6")   # no model loaded
+        self._pod(kube, app, f"{app}-c")               # no IP yet: skipped
+
+        def fake_ps(url):
+            if "10.1.0.5" in url:
+                return None
+            return {"models": []}
+
+        recon = ModelReconciler(kube, rec, server_image="runtime:test",
+                                ps_fetch=fake_ps)
+        drive(recon, kube)
+        m = kube.get(API_VERSION, KIND, "default", "phi")
+        states = {e["pod"]: e["state"]
+                  for e in m["status"]["replicaStats"]["replicas"]}
+        assert states == {f"{app}-a": "unreachable",
+                          f"{app}-b": "no_model"}
+
+    def test_unchanged_stats_do_not_rewrite_status(self, kube, rec):
+        make_model(kube)
+        app = workload.model_app_name("phi")
+        self._pod(kube, app, f"{app}-a", "10.1.0.5")
+        recon = ModelReconciler(
+            kube, rec, server_image="runtime:test",
+            ps_fetch=lambda url: {"models": [{
+                "name": "phi", "lifecycle": {"state": "serving"},
+                "utilization": {"mfu": 0.1, "goodput_tok_s": 1.0,
+                                "occupancy": 1.0, "waste_pct": 0.0,
+                                "recompiles": {}}}]})
+        drive(recon, kube)
+        m1 = kube.get(API_VERSION, KIND, "default", "phi")
+        assert recon.reconcile("default", "phi") == DONE
+        m2 = kube.get(API_VERSION, KIND, "default", "phi")
+        # identical scrape → no status write, scrapedAt untouched
+        assert m2["status"]["replicaStats"] == m1["status"]["replicaStats"]
+
+    def test_no_pods_skips_mirror(self, reconciler, kube):
+        make_model(kube)
+        drive(reconciler, kube)
+        m = kube.get(API_VERSION, KIND, "default", "phi")
+        assert "replicaStats" not in m["status"]
+        assert is_condition_true(m, "Available")
+
+
 class TestMultiHostLadder:
     def test_v5e16_creates_statefulset_world(self, reconciler, kube):
         make_model(kube, name="llama70b", image="llama2:70b", runtime="tpu",

@@ -836,6 +836,52 @@ def test_debug_events_endpoint(stack):
         body["events"][-1]["seq"]       # racing traffic may append
 
 
+def test_debug_events_kind_filter(stack):
+    post(stack["base"], "/api/generate",
+         {"model": _model_name(stack), "prompt": "e2",
+          "options": {"num_predict": 2}}, stream=True)
+    body = json.loads(get(stack["base"], "/debug/events?kind=admit"))
+    assert body["events"], "no admit events after a generate"
+    assert all(e["kind"] == "admit" for e in body["events"])
+    # kind filter applies BEFORE the last= trim: one admit-only row even
+    # when the newest raw events are of other kinds
+    one = json.loads(get(stack["base"],
+                         "/debug/events?kind=admit&last=1"))["events"]
+    assert len(one) == 1 and one[0]["kind"] == "admit"
+    none = json.loads(get(stack["base"],
+                          "/debug/events?kind=no_such_kind"))["events"]
+    assert none == []
+
+
+def test_debug_utilization_endpoint(stack):
+    post(stack["base"], "/api/generate",
+         {"model": _model_name(stack), "prompt": "u1 u2",
+          "options": {"num_predict": 4}}, stream=True)
+    body = json.loads(get(stack["base"], "/debug/utilization"))
+    snap = body["snapshot"]
+    assert snap["enabled"] is True
+    assert snap["totals"]["useful_tokens"]["decode"] >= 4
+    assert {"mfu", "occupancy", "waste_pct", "goodput_tok_s",
+            "breakdown", "recompiles"} <= set(snap)
+    # per-second ring rows are present and bounded by ?last=
+    assert isinstance(body["ring"], list)
+    short = json.loads(get(stack["base"], "/debug/utilization?last=3"))
+    assert len(short["ring"]) <= 3
+
+
+def test_api_ps_carries_utilization_block(stack):
+    post(stack["base"], "/api/generate",
+         {"model": _model_name(stack), "prompt": "p1",
+          "options": {"num_predict": 2}}, stream=True)
+    ps = json.loads(get(stack["base"], "/api/ps"))
+    (m,) = [m for m in ps["models"] if m.get("utilization")]
+    util = m["utilization"]
+    assert util["enabled"] is True
+    assert "mfu" in util and "occupancy" in util and "waste_pct" in util
+    assert isinstance(util["recompiles"], dict)
+    assert util["breakdown"]["wall_s"] > 0
+
+
 def test_debug_profile_guarded(stack):
     """Profiling stalls the device queue: the endpoint must 403 unless
     TPU_DEBUG_PROFILE=1 opted the deployment in."""
