@@ -1925,6 +1925,128 @@ def measure_restart(jax, *, model: str, dtype: str, slots: int, steps: int,
     return rec
 
 
+def measure_coldstart(jax, *, model: str, dtype: str, slots: int,
+                      steps: int, seq: int, prompt_len: int, paged: bool,
+                      mixed: bool, chunk: int, page_size: int,
+                      n_pages: int | None, platform: str,
+                      params_cache: dict | None = None,
+                      env: dict | None = None) -> dict:
+    """Scale-to-zero cold-start arm (ISSUE 11): the wake path restores
+    the AOT warm-bucket cache from a snapshot instead of re-running
+    warm_buckets(). Times the donor's full warm pass vs the woken
+    engine's restore, then dispatches on the woken engine and reports
+    the recompile count — the acceptance bar is ZERO recompiles after a
+    restore (delta vs the no-snapshot control, which must recompile).
+    BENCH_ASSERT_COLDSTART=1 hard-fails on a recompiling wake; the
+    invariant is engine policy, not device perf, so it gates on CPU."""
+    import gc
+
+    from ollama_operator_tpu.models.config import get_config
+    from ollama_operator_tpu.runtime.engine import (Engine, EngineConfig,
+                                                    SlotOptions,
+                                                    resolve_cache_dtype)
+
+    on_cpu = platform == "cpu"
+    saved_execs = os.environ.get("TPU_WARM_SNAPSHOT_EXECS")
+    if on_cpu:
+        dtype = "float32"
+        # the CPU backend's executable deserialization is unstable (see
+        # conftest.py's persistent-cache note); the sig-replay path is
+        # the portable contract and what this arm gates on
+        os.environ["TPU_WARM_SNAPSHOT_EXECS"] = "0"
+    kv_dtype = resolve_cache_dtype(
+        os.environ.get("BENCH_KV_DTYPE", "float32" if on_cpu else "int8"))
+    cfg = get_config(model)
+    log(f"bench: coldstart capture model={model} dtype={dtype} "
+        f"slots={slots} seq={seq} paged={paged}")
+    params, param_bytes, dtype = _bench_params(
+        jax, cfg, model, dtype, on_cpu, params_cache)
+    serve_seq = min(seq, cfg.max_seq_len)
+    ecfg = EngineConfig(max_slots=slots, max_seq_len=serve_seq,
+                       decode_chunk=max(4, min(chunk, 8)),
+                       cache_dtype=kv_dtype, paged=paged,
+                       page_size=page_size, n_pages=n_pages,
+                       min_prefill_bucket=16)
+    greedy = SlotOptions(temperature=0.0, repeat_penalty=1.0)
+    rng = np.random.default_rng(31)
+    prompt = rng.integers(1, cfg.vocab_size,
+                          size=max(16, min(prompt_len, serve_seq // 4)),
+                          endpoint=False).astype(np.int32)
+
+    def first_dispatch(eng):
+        eng.admit(0, prompt, greedy)
+        for _ in range(3):
+            eng.decode_n()
+        eng.release(0)
+
+    try:
+        donor = Engine(cfg, params, ecfg=ecfg)
+        t0 = time.monotonic()
+        donor.warm_buckets()
+        warm_ms = (time.monotonic() - t0) * 1e3
+        blob = donor.warm_snapshot()
+        n_sigs = len(donor._warmed_sigs)
+        del donor
+        gc.collect()
+
+        woken = Engine(cfg, params, ecfg=ecfg)
+        t0 = time.monotonic()
+        out = woken.restore_warm(blob)
+        restore_ms = (time.monotonic() - t0) * 1e3
+        first_dispatch(woken)
+        woken_recompiles = int(sum(woken.recompiles.values()))
+        del woken
+        gc.collect()
+
+        control = Engine(cfg, params, ecfg=ecfg)   # no snapshot, no warm
+        first_dispatch(control)
+        control_recompiles = int(sum(control.recompiles.values()))
+        del control
+        gc.collect()
+    finally:
+        if saved_execs is None:
+            os.environ.pop("TPU_WARM_SNAPSHOT_EXECS", None)
+        else:
+            os.environ["TPU_WARM_SNAPSHOT_EXECS"] = saved_execs
+
+    rec = {
+        "model": model,
+        "mode": "coldstart",
+        "warm_ms": round(warm_ms, 1),
+        "restore_ms": round(restore_ms, 1),
+        "restore_speedup": round(warm_ms / max(restore_ms, 1e-6), 2),
+        "snapshot_bytes": len(blob),
+        "warm_sigs": n_sigs,
+        "restored_execs": int(out["restored"]),
+        "recompiled_sigs": int(out["compiled"]),
+        "recompiles_after_restore": woken_recompiles,
+        "control_recompiles": control_recompiles,
+        "slots": slots,
+        "dtype": dtype,
+        "paged": paged,
+        "seq": serve_seq,
+    }
+    if env:
+        rec["env"] = dict(env)
+    log(f"bench: coldstart capture done: {json.dumps(rec)}")
+    if os.environ.get("BENCH_ASSERT_COLDSTART") == "1":
+        problems = []
+        if out["restored"] + out["compiled"] != n_sigs:
+            problems.append(f"restore covered {out} of {n_sigs} sigs")
+        if woken_recompiles:
+            problems.append(f"woken engine recompiled "
+                            f"{woken_recompiles}x on first dispatch")
+        if not control_recompiles:
+            problems.append("no-snapshot control did not recompile — "
+                            "the A/B measures nothing")
+        if problems:
+            raise AssertionError("coldstart arm failed: "
+                                 + "; ".join(problems))
+    del params
+    gc.collect()
+    return rec
+
+
 def main() -> None:
     import jax
 
@@ -2010,6 +2132,8 @@ def main() -> None:
                                                  "") == "1",
                      restart_arm=os.environ.get("BENCH_RESTART_ARM",
                                                 "") == "1",
+                     coldstart_arm=os.environ.get("BENCH_COLDSTART_ARM",
+                                                  "") == "1",
                      **knobs)]
     elif platform == "cpu":
         # unpinned CPU smoke: tiny model, but every knob still applies
@@ -2049,6 +2173,13 @@ def main() -> None:
             # BENCH_ASSERT_RESTART=1 gates on it (policy, not perf)
             plan.append({**smoke, "restart_arm": True, "slots": 2,
                          "paged": True})
+        if os.environ.get("BENCH_COLDSTART_ARM", "") == "1":
+            # scale-to-zero cold start (ISSUE 11): warm-snapshot restore
+            # vs the full warm_buckets pass — the woken engine's first
+            # dispatch must not recompile. BENCH_ASSERT_COLDSTART=1
+            # gates on it (engine policy, not perf)
+            plan.append({**smoke, "coldstart_arm": True, "slots": 2,
+                         "seq": 128})
         if os.environ.get("BENCH_SPEC_ARM", "") == "1":
             # fused prompt-lookup speculation (ISSUE 6): lookup /
             # accept_all / reject_all sub-arms on a repetition-heavy
@@ -2154,6 +2285,14 @@ def main() -> None:
             dict(model="tinyllama", dtype="int8", slots=16, steps=64,
                  seq=1024, prompt_len=128, paged=True, mixed=False,
                  restart_arm=True),
+            # scale-to-zero cold start (ISSUE 11): on the TPU the warm
+            # snapshot carries serialized executables, so restore_ms is
+            # deserialize time, not compile time — the summary's
+            # coldstart_speedup is the wake-latency win and
+            # coldstart_recompiles must stay 0
+            dict(model="tinyllama", dtype="int8", slots=16, steps=64,
+                 seq=1024, prompt_len=128, paged=True, mixed=False,
+                 coldstart_arm=True),
         ]
 
     captures = []
@@ -2179,8 +2318,10 @@ def main() -> None:
         prefix_arm = cap.pop("prefix_arm", False)
         overload_arm = cap.pop("overload_arm", False)
         restart_arm = cap.pop("restart_arm", False)
+        coldstart_arm = cap.pop("coldstart_arm", False)
         try:
-            fn = (measure_restart if restart_arm
+            fn = (measure_coldstart if coldstart_arm
+                  else measure_restart if restart_arm
                   else measure_overload if overload_arm
                   else measure_prefix if prefix_arm
                   else measure_mixed if mixed_arm
@@ -2304,6 +2445,16 @@ def assemble(captures: list, platform: str, n_devices: int) -> str:
             restart_bit_identical = c.get("bit_identical")
             restart_recovery_ms = c.get("recovery_ms")
             break
+    # scale-to-zero cold start (ISSUE 11 acceptance: a wake served from
+    # the warm snapshot dispatches with ZERO recompiles; the speedup is
+    # restore time vs the from-scratch warm_buckets pass)
+    coldstart_restore_ms = coldstart_speedup = coldstart_recompiles = None
+    for c in captures:
+        if c.get("mode") == "coldstart":
+            coldstart_restore_ms = c.get("restore_ms")
+            coldstart_speedup = c.get("restore_speedup")
+            coldstart_recompiles = c.get("recompiles_after_restore")
+            break
     return json.dumps({
         "metric": metric,
         "value": head["tok_s"],
@@ -2334,6 +2485,9 @@ def assemble(captures: list, platform: str, n_devices: int) -> str:
         "restart_client_error_rate": restart_err_rate,
         "restart_bit_identical": restart_bit_identical,
         "restart_recovery_ms": restart_recovery_ms,
+        "coldstart_restore_ms": coldstart_restore_ms,
+        "coldstart_speedup": coldstart_speedup,
+        "coldstart_recompiles": coldstart_recompiles,
         "slots": head["slots"],
         "platform": platform,
         "dtype": head["dtype"],

@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import json
 import os
-from typing import Dict, Iterator, Tuple
+from typing import Dict, Iterator, Optional, Tuple
 
 import numpy as np
 import ml_dtypes
@@ -103,3 +103,34 @@ class TensorStore:
     def items(self) -> Iterator[Tuple[str, np.ndarray]]:
         for name in self._index:
             yield name, self.get(name)
+
+
+# --- warm-snapshot persistence (scale-to-zero fast cold-start) -------------
+# The AOT warm-bucket executable cache (engine.warm_snapshot()) lands next
+# to the weight cache on the image-store PVC, keyed by the serving identity
+# (digest + engine config + jax version/backend). Same atomic-write
+# discipline as TensorStoreWriter: unique tmp name per writer, os.replace
+# so concurrent drains of identical replicas race harmlessly — the last
+# finisher wins a complete file, readers never see a torn one.
+
+def warm_snapshot_path(cache_dir: str, key: str) -> str:
+    return os.path.join(cache_dir, "warm", f"{key}.warmsnap")
+
+
+def save_warm_snapshot(cache_dir: str, key: str, blob: bytes) -> str:
+    path = warm_snapshot_path(cache_dir, key)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = path + f".tmp.{os.getpid()}.{os.urandom(4).hex()}"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+    os.replace(tmp, path)
+    return path
+
+
+def load_warm_snapshot(cache_dir: str, key: str) -> Optional[bytes]:
+    path = warm_snapshot_path(cache_dir, key)
+    try:
+        with open(path, "rb") as f:
+            return f.read()
+    except OSError:
+        return None

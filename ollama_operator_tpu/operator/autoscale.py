@@ -1,0 +1,421 @@
+"""Damped, fail-safe horizontal autoscaler for Model fleets.
+
+Closes the control loop left open by PRs 8-10: `status.replicaStats`
+(PR 10) carries per-replica occupancy/goodput/backlog, the admission
+layer (PR 8) exposes the TTFT-SLO queue model, and graceful drain
+(PR 9) makes replica removal stream-preserving. This module turns those
+observations into a desired replica count; the reconciler owns the
+actuation (drain-first shrink, Deployment sync, pod remediation).
+
+Design rules, in order of precedence:
+
+1. **Fail static, not closed.** A stale scrape, a missing scrape, or a
+   scrape where every replica is unreachable is *no evidence* — the
+   scrape path itself is the most likely fault. The loop holds its last
+   decision and counts a hold; it never scales on partial data.
+2. **Damped.** Hysteresis (sustained-streak thresholds per direction),
+   per-direction cooldowns, single-step moves, and a flap detector that
+   freezes the loop when direction flips too often inside a window.
+3. **Zero-error scale-down.** The autoscaler only *proposes* a lower
+   count; the reconciler drains the victim (readyz flips, streams
+   finish) before the Deployment shrinks.
+4. **Floors.** Desired never drops below ``minReplicas`` except via the
+   explicit idle-TTL scale-to-zero path, and remediation replaces pods
+   one at a time under exponential backoff — it never shrinks the fleet.
+
+All knobs resolve spec-over-env: `spec.autoscale` fields win, then
+`TPU_AUTOSCALE_*` environment defaults, then the constants below.
+Counters (`tpu_model_autoscale_*`, `tpu_model_remediation_*`) are
+pre-seeded in server/metrics.py and asserted by the metrics-lint job.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from collections import deque
+from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
+
+from ..server.metrics import GLOBAL as METRICS
+
+# Action vocabulary for tpu_model_autoscale_decisions_total{action=...}.
+ACTIONS = ("up", "down", "to_zero", "wake")
+# Hold-cause vocabulary for tpu_model_autoscale_holds_total{cause=...}.
+HOLD_CAUSES = ("no_data", "stale", "flap", "cooldown")
+# Remediation causes for tpu_model_remediation_replacements_total{cause=...}.
+REMEDIATION_CAUSES = ("unreachable", "crash_loop")
+
+
+def _env_f(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def _env_i(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    """Resolved autoscale knobs for one Model (spec over env)."""
+
+    enabled: bool = False
+    min_replicas: int = 1
+    max_replicas: int = 8
+    target_occupancy: float = 0.75   # sustained >= this -> scale up
+    low_occupancy: float = 0.30      # sustained <= this (idle queue) -> down
+    up_cooldown_s: float = 30.0
+    down_cooldown_s: float = 120.0
+    up_streak: int = 2               # consecutive hot observations
+    down_streak: int = 3             # consecutive cold observations
+    idle_ttl_s: float = 0.0          # 0 disables scale-to-zero
+    backlog_tokens_per_replica: int = 4096
+    stale_s: float = 30.0            # scrape freshness bound (fail static)
+    flap_window_s: float = 300.0
+    flap_max_flips: int = 4          # direction changes in window -> freeze
+    flap_hold_s: float = 180.0
+    remediation_backoff_s: float = 10.0
+    remediation_backoff_cap_s: float = 300.0
+
+
+def resolve_policy(spec_block: Dict[str, Any]) -> Policy:
+    """Merge `spec.autoscale` over `TPU_AUTOSCALE_*` env defaults."""
+    b = spec_block or {}
+
+    def pick_f(key: str, env: str, default: float) -> float:
+        v = b.get(key)
+        if v is not None:
+            try:
+                return float(v)
+            except (TypeError, ValueError):
+                pass
+        return _env_f(env, default)
+
+    def pick_i(key: str, env: str, default: int) -> int:
+        v = b.get(key)
+        if v is not None:
+            try:
+                return int(v)
+            except (TypeError, ValueError):
+                pass
+        return _env_i(env, default)
+
+    enabled = b.get("enabled")
+    if enabled is None:
+        enabled = os.environ.get("TPU_AUTOSCALE", "0") == "1"
+    pol = Policy(
+        enabled=bool(enabled),
+        min_replicas=max(0, pick_i("minReplicas", "TPU_AUTOSCALE_MIN", 1)),
+        max_replicas=max(1, pick_i("maxReplicas", "TPU_AUTOSCALE_MAX", 8)),
+        target_occupancy=pick_f("targetOccupancy",
+                                "TPU_AUTOSCALE_TARGET_OCCUPANCY", 0.75),
+        low_occupancy=pick_f("lowOccupancy",
+                             "TPU_AUTOSCALE_LOW_OCCUPANCY", 0.30),
+        up_cooldown_s=pick_f("upCooldownSeconds",
+                             "TPU_AUTOSCALE_UP_COOLDOWN_S", 30.0),
+        down_cooldown_s=pick_f("downCooldownSeconds",
+                               "TPU_AUTOSCALE_DOWN_COOLDOWN_S", 120.0),
+        up_streak=max(1, pick_i("upStreak", "TPU_AUTOSCALE_UP_STREAK", 2)),
+        down_streak=max(1, pick_i("downStreak",
+                                  "TPU_AUTOSCALE_DOWN_STREAK", 3)),
+        idle_ttl_s=pick_f("idleTTLSeconds", "TPU_AUTOSCALE_IDLE_TTL_S", 0.0),
+        backlog_tokens_per_replica=pick_i(
+            "backlogTokensPerReplica", "TPU_AUTOSCALE_BACKLOG_TOKENS", 4096),
+        stale_s=pick_f("staleSeconds", "TPU_AUTOSCALE_STALE_S", 30.0),
+        flap_window_s=pick_f("flapWindowSeconds",
+                             "TPU_AUTOSCALE_FLAP_WINDOW_S", 300.0),
+        flap_max_flips=max(2, pick_i("flapMaxFlips",
+                                     "TPU_AUTOSCALE_FLAP_MAX_FLIPS", 4)),
+        flap_hold_s=pick_f("flapHoldSeconds",
+                           "TPU_AUTOSCALE_FLAP_HOLD_S", 180.0),
+        remediation_backoff_s=pick_f("remediationBackoffSeconds",
+                                     "TPU_REMEDIATION_BACKOFF_S", 10.0),
+        remediation_backoff_cap_s=pick_f("remediationBackoffCapSeconds",
+                                         "TPU_REMEDIATION_BACKOFF_CAP_S",
+                                         300.0),
+    )
+    return pol
+
+
+@dataclasses.dataclass
+class Observation:
+    """One scrape pass distilled for the control law.
+
+    ``fresh`` is the fail-static gate: False when the scrape is missing,
+    stale, or carries zero reachable replicas while pods exist.
+    """
+
+    current: int                 # Deployment's current intent (spec.replicas)
+    fresh: bool
+    reachable: int = 0
+    draining: int = 0
+    occupancy: float = 0.0       # mean over reachable non-draining replicas
+    queue_depth: int = 0         # queued requests, summed
+    backlog_tokens: int = 0      # queued prompt tokens, summed
+    goodput_tok_s: float = 0.0   # aggregate useful tokens/s
+    ttft_slo_ms: float = 0.0     # 0 = no SLO configured
+    busy: bool = False           # any active stream / queue / occupancy
+    stale_cause: str = "no_data"  # which hold cause when not fresh
+
+
+def observe_stats(current: int, stats: Optional[List[Dict[str, Any]]],
+                  scraped_age_s: Optional[float], policy: Policy
+                  ) -> Observation:
+    """Distil a replicaStats list (reconciler mirror schema) into an
+    Observation. ``scraped_age_s`` is seconds since the scrape; None
+    means the scrape never happened."""
+    if stats is None or scraped_age_s is None:
+        return Observation(current=current, fresh=False, stale_cause="no_data")
+    if scraped_age_s > policy.stale_s:
+        return Observation(current=current, fresh=False, stale_cause="stale")
+    reachable = [e for e in stats if e.get("state") not in ("unreachable",)]
+    draining = [e for e in reachable if e.get("state") == "draining"]
+    serving = [e for e in reachable if e.get("state") != "draining"]
+    if current > 0 and not reachable:
+        # Pods exist but nothing answered: the scrape path (or the whole
+        # fleet) is down. No evidence either way -> fail static.
+        return Observation(current=current, fresh=False, stale_cause="no_data")
+    occ = [float(e.get("occupancy") or 0.0) for e in serving]
+    q = sum(int(e.get("queueDepth") or 0) for e in serving)
+    bt = sum(int(e.get("backlogTokens") or 0) for e in serving)
+    gp = sum(float(e.get("goodputTokS") or 0.0) for e in serving)
+    slo = max((float(e.get("ttftSloMs") or 0.0) for e in serving),
+              default=0.0)
+    active = sum(int(e.get("activeStreams") or 0) for e in reachable)
+    busy = bool(active or q or bt or any(o > 0.0 for o in occ))
+    return Observation(
+        current=current, fresh=True, reachable=len(reachable),
+        draining=len(draining),
+        occupancy=(sum(occ) / len(occ)) if occ else 0.0,
+        queue_depth=q, backlog_tokens=bt, goodput_tok_s=gp,
+        ttft_slo_ms=slo, busy=busy)
+
+
+@dataclasses.dataclass(frozen=True)
+class Decision:
+    desired: int
+    action: str      # "up" | "down" | "to_zero" | "wake" | "hold" | "steady"
+    reason: str
+
+
+class _ModelState:
+    __slots__ = ("desired", "hot_streak", "cold_streak", "idle_since",
+                 "last_up_at", "last_down_at", "moves", "frozen_until",
+                 "remed_backoff_s", "remed_next_ok_at")
+
+    def __init__(self) -> None:
+        self.desired: Optional[int] = None
+        self.hot_streak = 0
+        self.cold_streak = 0
+        self.idle_since: Optional[float] = None
+        self.last_up_at = float("-inf")
+        self.last_down_at = float("-inf")
+        self.moves: Deque[Tuple[float, int]] = deque()  # (t, +1|-1)
+        self.frozen_until = float("-inf")
+        self.remed_backoff_s = 0.0
+        self.remed_next_ok_at = float("-inf")
+
+
+class Autoscaler:
+    """Per-Model damped control law. Stateful across reconcile passes;
+    the authoritative desired count is also persisted in
+    ``status.autoscale.desiredReplicas`` so an operator restart fails
+    static (fleet keeps its size) rather than snapping to spec."""
+
+    def __init__(self, now: Callable[[], float] = time.monotonic):
+        self._now = now
+        self._state: Dict[Tuple[str, str], _ModelState] = {}
+
+    # -- helpers ---------------------------------------------------------
+    def _st(self, key: Tuple[str, str]) -> _ModelState:
+        st = self._state.get(key)
+        if st is None:
+            st = self._state[key] = _ModelState()
+        return st
+
+    def forget(self, key: Tuple[str, str]) -> None:
+        self._state.pop(key, None)
+
+    @staticmethod
+    def _hold(cause: str, desired: int, reason: str) -> Decision:
+        METRICS.inc("tpu_model_autoscale_holds_total", 1.0,
+                    f'{{cause="{cause}"}}')
+        return Decision(desired=desired, action="hold", reason=reason)
+
+    def _record_move(self, st: _ModelState, now: float, direction: int,
+                     policy: Policy) -> None:
+        st.moves.append((now, direction))
+        horizon = now - policy.flap_window_s
+        while st.moves and st.moves[0][0] < horizon:
+            st.moves.popleft()
+
+    def _flapping(self, st: _ModelState, now: float, policy: Policy) -> bool:
+        horizon = now - policy.flap_window_s
+        flips = 0
+        prev = 0
+        for t, d in st.moves:
+            if t < horizon:
+                continue
+            if prev and d != prev:
+                flips += 1
+            prev = d
+        return flips >= policy.flap_max_flips
+
+    # -- control law -----------------------------------------------------
+    def observe(self, key: Tuple[str, str], policy: Policy,
+                obs: Observation, wake: bool = False) -> Decision:
+        """One control-law step. Returns the Decision; desired is always
+        clamped to [min, max] except the explicit to_zero path."""
+        now = self._now()
+        st = self._st(key)
+        if st.desired is None:
+            st.desired = obs.current
+        desired = st.desired
+
+        # Wake beats everything: a sleeping model with demand must come
+        # back even through cooldowns, freezes, or a stale scrape.
+        if wake and desired <= 0:
+            st.desired = max(1, policy.min_replicas)
+            st.idle_since = None
+            st.hot_streak = st.cold_streak = 0
+            st.last_up_at = now
+            self._record_move(st, now, +1, policy)
+            METRICS.inc("tpu_model_autoscale_decisions_total", 1.0,
+                        '{action="wake"}')
+            return Decision(st.desired, "wake", "wake annotation")
+
+        # Fail static: no usable evidence -> hold the last decision.
+        if not obs.fresh:
+            if desired <= 0 and obs.current <= 0:
+                # Sleeping model with no pods: nothing to scrape, not a
+                # fault. Steady state until a wake signal arrives.
+                return Decision(desired, "steady", "sleeping")
+            return self._hold(obs.stale_cause, desired,
+                              f"scrape {obs.stale_cause}; holding {desired}")
+
+        # Flap freeze: too many direction changes inside the window.
+        if now < st.frozen_until:
+            return self._hold("flap", desired,
+                              f"flap freeze until +{st.frozen_until - now:.0f}s")
+        if self._flapping(st, now, policy):
+            st.frozen_until = now + policy.flap_hold_s
+            return self._hold("flap", desired, "flap detected; freezing")
+
+        # Signal extraction. "hot" mirrors the PR 8 queue model: either
+        # sustained occupancy at target, raw backlog beyond what the
+        # fleet can absorb, or predicted TTFT (backlog / goodput) past
+        # the SLO.
+        per_rep = policy.backlog_tokens_per_replica * max(1, obs.current)
+        slo_risk = False
+        if obs.ttft_slo_ms > 0 and obs.backlog_tokens > 0:
+            gp = max(obs.goodput_tok_s, 1e-6)
+            slo_risk = (obs.backlog_tokens / gp) * 1000.0 > obs.ttft_slo_ms
+        hot = (obs.occupancy >= policy.target_occupancy
+               or obs.backlog_tokens > per_rep
+               or slo_risk)
+        cold = (obs.occupancy <= policy.low_occupancy
+                and obs.queue_depth == 0 and obs.backlog_tokens == 0)
+        st.hot_streak = st.hot_streak + 1 if hot else 0
+        st.cold_streak = st.cold_streak + 1 if cold else 0
+        if obs.busy:
+            st.idle_since = None
+        elif st.idle_since is None:
+            st.idle_since = now
+
+        # Scale up: sustained hot, cooldown passed, headroom left.
+        if st.hot_streak >= policy.up_streak and desired < policy.max_replicas:
+            if now - st.last_up_at < policy.up_cooldown_s:
+                return self._hold("cooldown", desired,
+                                  "hot but inside up-cooldown")
+            st.desired = min(policy.max_replicas, max(desired, obs.current) + 1)
+            st.last_up_at = now
+            st.hot_streak = 0
+            st.idle_since = None
+            self._record_move(st, now, +1, policy)
+            METRICS.inc("tpu_model_autoscale_decisions_total", 1.0,
+                        '{action="up"}')
+            return Decision(st.desired, "up",
+                            f"occ={obs.occupancy:.2f} backlog="
+                            f"{obs.backlog_tokens} slo_risk={slo_risk}")
+
+        # Scale to zero: fully idle past the TTL (and the TTL is set).
+        if (policy.idle_ttl_s > 0 and desired > 0 and st.idle_since is not None
+                and now - st.idle_since >= policy.idle_ttl_s):
+            if now - st.last_down_at < policy.down_cooldown_s:
+                return self._hold("cooldown", desired,
+                                  "idle but inside down-cooldown")
+            st.desired = 0
+            st.last_down_at = now
+            st.cold_streak = 0
+            self._record_move(st, now, -1, policy)
+            METRICS.inc("tpu_model_autoscale_decisions_total", 1.0,
+                        '{action="to_zero"}')
+            return Decision(0, "to_zero",
+                            f"idle {now - st.idle_since:.0f}s >= ttl")
+
+        # Scale down: sustained cold, cooldown passed, above the floor.
+        # Going below 1 is only ever the idle-TTL path above — a cold
+        # but non-idle fleet keeps at least max(minReplicas, 1).
+        floor = max(policy.min_replicas, 1)
+        if st.cold_streak >= policy.down_streak and desired > floor:
+            if now - st.last_down_at < policy.down_cooldown_s:
+                return self._hold("cooldown", desired,
+                                  "cold but inside down-cooldown")
+            st.desired = desired - 1
+            st.last_down_at = now
+            st.cold_streak = 0
+            self._record_move(st, now, -1, policy)
+            METRICS.inc("tpu_model_autoscale_decisions_total", 1.0,
+                        '{action="down"}')
+            return Decision(st.desired, "down",
+                            f"occ={obs.occupancy:.2f} idle queue")
+
+        return Decision(desired, "steady", "within band")
+
+    # -- remediation backoff --------------------------------------------
+    def remediation_due(self, key: Tuple[str, str], policy: Policy) -> bool:
+        """Gate a replacement behind the exponential backoff. Counts a
+        backoff hold when the gate is closed."""
+        st = self._st(key)
+        if self._now() >= st.remed_next_ok_at:
+            return True
+        METRICS.inc("tpu_model_remediation_backoff_holds_total", 1.0)
+        return False
+
+    def note_remediation(self, key: Tuple[str, str], policy: Policy,
+                         cause: str) -> None:
+        """Record one replacement: count it and double the backoff."""
+        st = self._st(key)
+        base = max(policy.remediation_backoff_s, 0.1)
+        st.remed_backoff_s = (base if st.remed_backoff_s <= 0
+                              else min(st.remed_backoff_s * 2.0,
+                                       policy.remediation_backoff_cap_s))
+        st.remed_next_ok_at = self._now() + st.remed_backoff_s
+        METRICS.inc("tpu_model_remediation_replacements_total", 1.0,
+                    f'{{cause="{cause}"}}')
+
+    def note_clean_pass(self, key: Tuple[str, str]) -> None:
+        """A fresh scrape with every replica healthy resets the backoff."""
+        st = self._st(key)
+        st.remed_backoff_s = 0.0
+        st.remed_next_ok_at = float("-inf")
+
+    def remediation_backoff_s(self, key: Tuple[str, str]) -> float:
+        return self._st(key).remed_backoff_s
+
+    def desired(self, key: Tuple[str, str]) -> Optional[int]:
+        st = self._state.get(key)
+        return None if st is None else st.desired
+
+    def seed_desired(self, key: Tuple[str, str], desired: int) -> None:
+        """Adopt a persisted desired count (status.autoscale) after an
+        operator restart so the loop fails static across restarts."""
+        st = self._st(key)
+        if st.desired is None:
+            st.desired = desired

@@ -296,16 +296,76 @@ def retry_transient(fn: Callable[[], Any], attempts: int = 4,
                        * (0.5 + random.random() / 2))
 
 
+def update_status_with_retry(c: "KubeClient", obj: Dict[str, Any],
+                             attempts: int = 4, backoff: float = 0.05,
+                             cap: float = 2.0) -> Dict[str, Any]:
+    """Conflict-aware, transient-tolerant status-subresource write.
+
+    retry_transient deliberately refuses writes (a replayed create can
+    duplicate side effects), which left status PUTs during scale churn
+    dying on the first apiserver blip. A status PUT is the one write
+    where replay is safe: it is a full-replace of a subresource only
+    this controller owns, so sending the same payload twice converges
+    to the same state. On 409 the live resourceVersion is re-read and
+    the same status reapplied — during scale churn the spec and
+    workload mirror race us constantly, but the status content itself
+    is never contended.
+
+    Returns the written object, or ``obj`` unchanged if the resource
+    vanished (deletion races a status write; not an error).
+    """
+    meta = obj.get("metadata") or {}
+    for i in range(attempts):
+        try:
+            return retry_transient(lambda: c.update_status(obj),
+                                   attempts=attempts, backoff=backoff,
+                                   cap=cap)
+        except Conflict:
+            if i == attempts - 1:
+                raise
+            fresh = c.get(obj.get("apiVersion"), obj.get("kind"),
+                          meta.get("namespace"), meta.get("name"))
+            if fresh is None:
+                return obj
+            obj["metadata"]["resourceVersion"] = \
+                (fresh.get("metadata") or {}).get("resourceVersion")
+        except NotFound:
+            return obj
+    return obj
+
+
 def fetch_replica_ps(url: str, timeout: float = 2.0) -> Optional[Dict]:
     """GET a model server's /api/ps and return the parsed body, or None
     on any failure. This is the reconciler's replica-stats scrape (plain
     pod-network HTTP, not an apiserver call): utilization mirroring is an
     optimisation, so it must never be able to wedge the control loop —
-    short timeout, no retries, every error collapses to None."""
+    short timeout, no retries, every error collapses to None. The
+    autoscaler treats a None (unreachable replica) as missing evidence
+    and fails static. `operator.scrape` is the chaos hook: fail modes
+    collapse to None like a real network fault, delay modes stall like
+    a slow pod."""
     try:
+        FAULTS.check("operator.scrape")
         req = urllib.request.Request(url, headers={"Accept":
                                                    "application/json"})
         with urllib.request.urlopen(req, timeout=timeout) as resp:
             return json.loads(resp.read().decode())
     except Exception:  # noqa: BLE001 — best-effort scrape by design
         return None
+
+
+def post_replica_drain(url: str, timeout: float = 2.0) -> bool:
+    """POST a model server's /api/drain (idempotent: begins graceful
+    drain, readyz flips, streams finish). Returns True when the pod
+    acknowledged. Same best-effort contract as the scrape: an
+    unreachable pod reads as False and the reconciler retries on the
+    next poll."""
+    try:
+        req = urllib.request.Request(url, data=b"{}", method="POST",
+                                     headers={"Accept": "application/json",
+                                              "Content-Type":
+                                              "application/json"})
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return 200 <= resp.status < 300
+    except Exception:  # noqa: BLE001 — retried on next reconcile poll
+        return False

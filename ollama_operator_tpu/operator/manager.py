@@ -397,7 +397,17 @@ class Manager:
                         "leader_election_master_status "
                         f"{int(not mgr._elector or mgr._elector.is_leader.is_set())}",
                     ]
-                    body, code = ("\n".join(lines) + "\n").encode(), 200
+                    # the shared registry carries the autoscale/remediation
+                    # counters the reconciler increments — without this the
+                    # closed-loop decisions would be invisible from the
+                    # operator's own scrape endpoint
+                    try:
+                        from ..server.metrics import GLOBAL as _G
+                        shared = _G.render()
+                    except Exception:  # noqa: BLE001 — scrape must not 500
+                        shared = ""
+                    body = ("\n".join(lines) + "\n" + shared).encode()
+                    code = 200
                 else:
                     body, code = b"not found", 404
                 self.send_response(code)

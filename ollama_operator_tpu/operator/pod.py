@@ -104,6 +104,12 @@ def new_server_container(
     # keep the server's drain window in lockstep with the pod's
     # terminationGracePeriodSeconds (workload._pod_template)
     env.append({"name": "TPU_DRAIN_TIMEOUT_S", "value": str(DRAIN_TIMEOUT_S)})
+    if not store_only:
+        # scale-to-zero fast cold-start: the AOT warm-bucket executable
+        # cache is snapshotted into the shared cache volume at drain time
+        # and restored on wake (runtime/service.py warm snapshot; the
+        # cache subpath is the same PVC the transcoded weights live on)
+        env.append({"name": "TPU_WARM_SNAPSHOT", "value": "1"})
     env.extend(extra_env or [])
 
     mounts = [{

@@ -28,8 +28,9 @@ import datetime
 import logging
 from typing import Any, Dict, Optional
 
-from . import workload
-from .client import KubeClient, NotFound, fetch_replica_ps
+from . import autoscale, workload
+from .client import (KubeClient, NotFound, fetch_replica_ps,
+                     post_replica_drain, update_status_with_retry)
 from .pod import PORT, SERVER_BASE_IMAGE
 from .recorder import Recorder
 from .types import (API_VERSION, CONDITION_AVAILABLE, CONDITION_PROGRESSING,
@@ -55,6 +56,20 @@ KICKOFF = Result(requeue_after=1.0)  # model_controller.go:78
 def _now() -> str:
     return datetime.datetime.now(datetime.timezone.utc).strftime(
         "%Y-%m-%dT%H:%M:%SZ")
+
+
+def _age_s(stamp: Optional[str]) -> Optional[float]:
+    """Seconds since an RFC3339 stamp written by _now(); None if unparseable."""
+    if not stamp:
+        return None
+    try:
+        t = datetime.datetime.strptime(
+            stamp, "%Y-%m-%dT%H:%M:%SZ").replace(
+                tzinfo=datetime.timezone.utc)
+    except ValueError:
+        return None
+    return max(0.0, (datetime.datetime.now(datetime.timezone.utc)
+                     - t).total_seconds())
 
 
 # --- condition helpers ------------------------------------------------------
@@ -114,33 +129,27 @@ class ModelReconciler:
 
     def __init__(self, client: KubeClient, recorder: Recorder,
                  server_image: str = SERVER_BASE_IMAGE,
-                 ps_fetch=None):
+                 ps_fetch=None, drain_post=None, autoscaler=None):
         self.c = client
         self.rec = recorder
         self.server_image = server_image
         # replica-stats scrape (GET <pod>/api/ps): injectable so the
         # fake-kube e2e can hand back canned bodies without a server
         self.ps_fetch = ps_fetch or fetch_replica_ps
+        # drain trigger (POST <pod>/api/drain): injectable for the same
+        # reason — the fake-kube e2e drains simulated replicas
+        self.drain_post = drain_post or post_replica_drain
+        # per-Model control-law state; injectable so tests drive the
+        # cooldown/idle clocks deterministically
+        self.scaler = autoscaler or autoscale.Autoscaler()
 
     # --- status writers -------------------------------------------------
     def _write_status(self, model: Dict[str, Any]) -> Dict[str, Any]:
-        """Status update with refetch-on-conflict (controller-runtime's
-        client.Status().Update + RetryOnConflict idiom)."""
-        from .client import Conflict
-        for _ in range(4):
-            try:
-                return self.c.update_status(model)
-            except Conflict:
-                spec = ModelSpecView(model)
-                fresh = self.c.get(API_VERSION, KIND, spec.namespace,
-                                   spec.name)
-                if fresh is None:
-                    return model
-                model["metadata"]["resourceVersion"] = \
-                    (fresh["metadata"] or {}).get("resourceVersion")
-            except NotFound:
-                return model
-        return model
+        """Status update: conflict-aware refetch AND transient-5xx retry
+        (client.update_status_with_retry — during scale churn the spec
+        and workload mirror race us constantly, and a status write that
+        dies on an apiserver blip would drop a scale decision)."""
+        return update_status_with_retry(self.c, model)
 
     def set_progressing(self, model: Dict[str, Any], reason: str,
                         message: str = "") -> None:
@@ -193,6 +202,8 @@ class ModelReconciler:
                 continue
             entry = {"pod": (pod.get("metadata") or {}).get("name", ""),
                      "ip": ip}
+            if workload.pod_is_drain_victim(pod):
+                entry["drainRequested"] = True
             body = self.ps_fetch(f"http://{ip}:{PORT}/api/ps")
             served = None
             for m in (body or {}).get("models") or []:
@@ -206,7 +217,10 @@ class ModelReconciler:
             else:
                 util = served.get("utilization") or {}
                 life = served.get("lifecycle") or {}
+                adm = served.get("admission") or {}
                 rec = util.get("recompiles") or {}
+                q = adm.get("queued_by_class") or {}
+                bt = adm.get("backlog_tokens_by_class") or {}
                 entry.update({
                     "state": life.get("state") or "serving",
                     "model": served.get("name", ""),
@@ -215,6 +229,12 @@ class ModelReconciler:
                     "occupancy": util.get("occupancy"),
                     "wastePct": util.get("waste_pct"),
                     "recompiles": int(sum(rec.values())) if rec else 0,
+                    # control-law inputs (PR 8 queue model + PR 9 drain):
+                    # queued work, backlog tokens, live streams, SLO bound
+                    "activeStreams": int(life.get("active_streams") or 0),
+                    "queueDepth": int(sum(q.values())) if q else 0,
+                    "backlogTokens": int(sum(bt.values())) if bt else 0,
+                    "ttftSloMs": float(adm.get("ttft_slo_ms") or 0.0),
                 })
             out.append(entry)
         return out
@@ -254,6 +274,16 @@ class ModelReconciler:
         multi_host = placement is not None and placement.multi_host
         app = workload.model_app_name(name)
         image = spec.server_image or self.server_image  # per-CR pin wins
+        # autoscaling (single-host Deployments only: a multi-host replica
+        # group is ONE jax.distributed world; its size is the topology)
+        policy = autoscale.resolve_policy(spec.autoscale)
+        scaling = policy.enabled and not multi_host
+        asc_status = (model.get("status") or {}).get("autoscale") or {}
+        if scaling and asc_status.get("desiredReplicas") is not None:
+            # adopt the persisted count so an operator restart fails
+            # static (keeps the fleet size) instead of snapping to spec
+            self.scaler.seed_desired((namespace, name),
+                                     int(asc_status["desiredReplicas"]))
         if multi_host:
             want = workload.build_model_statefulset(model, image)
             workload._ensure(self.c, workload.build_headless_service(model))
@@ -261,6 +291,20 @@ class ModelReconciler:
             want = workload.build_model_deployment(model, image)
         workload.stamp_spec_hash(want)
         cur = self.c.get("apps/v1", want["kind"], namespace, app)
+        if scaling:
+            desired0 = self.scaler.desired((namespace, name))
+            if desired0 is None:
+                desired0 = spec.replicas
+            cur_replicas = (int((cur.get("spec") or {}).get("replicas")
+                                or 0) if cur is not None else None)
+            # Growth syncs through the normal ladder; shrink ONLY via the
+            # drain protocol (_scale_down_step decrements after the
+            # victim's streams finish — never let the plain replica sync
+            # kill a serving pod).
+            if cur_replicas is None or desired0 >= cur_replicas:
+                want["spec"]["replicas"] = max(0, int(desired0))
+            else:
+                want["spec"]["replicas"] = cur_replicas
         if cur is None:
             self.c.create(want)
             self.rec.event(model, "Normal", "WorkloadCreated",
@@ -271,13 +315,22 @@ class ModelReconciler:
         if workload.update_model_workload(self.c, self.rec, model, cur, want):
             return POLL
 
-        # replica failure surfacing (the reference never does this)
+        # replica failure surfacing (the reference never does this) +
+        # crash-loop remediation when the control loop owns the fleet
         failure = workload.deployment_replica_failure(cur)
         if failure:
+            if scaling:
+                self._remediate_crash_loop(model, policy, namespace, app)
             self.set_replica_failure(model, failure)
             return POLL
 
         want_ready = placement.hosts if multi_host else spec.replicas
+        if scaling:
+            # readiness tracks the autoscaler's intent, not spec.replicas;
+            # drain victims are intentionally not-ready and must not read
+            # as "workload not ready" (that would wedge the shrink)
+            want_ready = max(0, int(want["spec"].get("replicas") or 0)
+                             - len(asc_status.get("draining") or []))
         if multi_host:
             ready = workload.is_statefulset_ready(self.c, namespace, app,
                                                   want=want_ready)
@@ -315,9 +368,11 @@ class ModelReconciler:
             return POLL
 
         # 5) per-replica utilization mirror + available. The scrape rides
-        # the converged pass only (pods are ready here); it stays DONE —
-        # the mirror refreshes on the next watch-driven reconcile, it
-        # must not turn a settled Model into a perpetual requeue
+        # the converged pass only (pods are ready here); without
+        # autoscaling it stays DONE — the mirror refreshes on the next
+        # watch-driven reconcile, it must not turn a settled Model into
+        # a perpetual requeue. With autoscaling the loop IS the point:
+        # the pass ends in POLL so the fleet keeps breathing.
         stats = self._replica_utilization(namespace, app)
         if stats:
             status_obj = model.setdefault("status", {})
@@ -326,5 +381,254 @@ class ModelReconciler:
                 status_obj["replicaStats"] = {"scrapedAt": _now(),
                                               "replicas": stats}
                 self._write_status(model)
+        if scaling:
+            return self._autoscale_pass(model, spec, policy, namespace,
+                                        app, cur, stats)
         self.set_available(model)
         return DONE
+
+    # --- closed-loop fleet control --------------------------------------
+    def _autoscale_pass(self, model: Dict[str, Any], spec: ModelSpecView,
+                        policy: "autoscale.Policy", namespace: str, app: str,
+                        dep: Dict[str, Any], stats: list) -> Result:
+        """One control-loop step on the converged ladder: remediate broken
+        replicas, run the damped control law, actuate (grow via the
+        normal replica sync; shrink strictly drain-first). Always POLLs —
+        the autoscaled Model is a live loop, not a settled object."""
+        key = (namespace, spec.name)
+        status_obj = model.setdefault("status", {})
+        cur_replicas = int((dep.get("spec") or {}).get("replicas") or 0)
+
+        # Remediation first: a fleet with a dead member gets repaired
+        # before any sizing decision (and sizing on a degraded fleet is
+        # suppressed — the scrape hole already fails the freshness gate).
+        if self._remediate_unreachable(model, policy, key, namespace, stats):
+            return POLL
+
+        obs = autoscale.observe_stats(cur_replicas, stats, 0.0, policy)
+        if not obs.fresh:
+            # distinguish a persistent outage (stale) from a fresh hole
+            age = _age_s((status_obj.get("replicaStats") or {})
+                         .get("scrapedAt"))
+            if age is not None and age > policy.stale_s:
+                obs = dataclasses.replace(obs, stale_cause="stale")
+
+        anns = (model.get("metadata") or {}).get("annotations") or {}
+        wake = workload.WAKE_ANNOTATION in anns
+        decision = self.scaler.observe(key, policy, obs, wake=wake)
+        if wake and decision.action == "wake":
+            self._clear_wake(model)
+            self.rec.event(model, "Normal", "AutoscaleWake",
+                           f"waking to {decision.desired} replicas")
+        elif wake and decision.desired > 0:
+            # stale wake: the gateway re-annotated while pods were still
+            # starting. Consume it now or it would fire a spurious wake
+            # the instant the model next scales to zero.
+            self._clear_wake(model)
+        desired = decision.desired
+
+        pending_drains = list((status_obj.get("autoscale") or {})
+                              .get("draining") or [])
+        if desired < cur_replicas or pending_drains:
+            # a marked victim is doomed (PR 9 drain is one-way): finish
+            # its removal even if the law flipped back up meanwhile —
+            # the next pass re-grows with a fresh pod
+            return self._scale_down_step(model, policy, namespace, app,
+                                         dep, stats, desired, decision)
+        if desired > cur_replicas:
+            dep.setdefault("spec", {})["replicas"] = desired
+            self.c.update(dep)
+            self.rec.event(model, "Normal", "AutoscaleUp",
+                           f"{cur_replicas} -> {desired} replicas "
+                           f"({decision.reason})")
+            self._update_autoscale_status(model, desired, decision, [])
+            return POLL
+
+        self._update_autoscale_status(model, desired, decision, [])
+        self.set_available(model)
+        return POLL
+
+    def _scale_down_step(self, model: Dict[str, Any],
+                         policy: "autoscale.Policy", namespace: str,
+                         app: str, dep: Dict[str, Any], stats: list,
+                         desired: int, decision: "autoscale.Decision"
+                         ) -> Result:
+        """Drain-first shrink, re-entrant across polls: mark one victim,
+        tell its server to drain (readyz flips, streams finish), and only
+        shrink the Deployment once the victim reports zero active work.
+        Zero client-visible error frames by construction."""
+        try:
+            pods = self.c.list("v1", "Pod", namespace,
+                               label_selector=f"app={app}")
+        except Exception:  # noqa: BLE001 — retry next poll
+            return POLL
+        pods = sorted(pods, key=lambda p: (p.get("metadata") or {})
+                      .get("name", ""))
+        by_name = {e.get("pod"): e for e in stats or []}
+        victims = [p for p in pods if workload.pod_is_drain_victim(p)]
+        cur_replicas = int((dep.get("spec") or {}).get("replicas") or 0)
+        excess = cur_replicas - desired
+        if len(victims) < excess:
+            # one new victim per pass (damped): the least-loaded
+            # non-victim pod finishes its streams fastest
+            candidates = [p for p in pods
+                          if not workload.pod_is_drain_victim(p)]
+
+            def _load(p):
+                name = (p.get("metadata") or {}).get("name", "")
+                e = by_name.get(name) or {}
+                return (int(e.get("activeStreams") or 0),
+                        float(e.get("occupancy") or 0.0), name)
+
+            candidates.sort(key=_load)
+            if candidates:
+                victim = candidates[0]
+                workload.mark_drain_victim(self.c, victim)
+                victims.append(victim)
+                vname = (victim.get("metadata") or {}).get("name", "")
+                self.rec.event(model, "Normal", "AutoscaleDrainStarted",
+                               f"draining {vname} ({decision.reason})")
+
+        # fail-static guard for the shortcut below: "unreachable victim"
+        # only means "dead pod" when at least one replica DID answer this
+        # pass — in a total scrape outage everything reads unreachable
+        # and a still-streaming victim must not be killed on no evidence
+        scrape_ok = any(e.get("state") != "unreachable"
+                        for e in (stats or []))
+        completed, pending = [], []
+        for v in victims:
+            vname = (v.get("metadata") or {}).get("name", "")
+            ip = (v.get("status") or {}).get("podIP")
+            e = by_name.get(vname) or {}
+            drained = (e.get("state") == "draining"
+                       and not int(e.get("activeStreams") or 0)
+                       and not int(e.get("queueDepth") or 0))
+            # an unreachable victim can't be serving anyone; holding the
+            # shrink for a dead pod helps nobody
+            if scrape_ok and (e.get("state") == "unreachable" or not e):
+                drained = True
+            if drained:
+                completed.append(v)
+                continue
+            pending.append(vname)
+            if ip:
+                # idempotent: /api/drain re-POSTs are no-ops server-side
+                self.drain_post(f"http://{ip}:{PORT}/api/drain")
+
+        if completed:
+            dep.setdefault("spec", {})["replicas"] = \
+                max(0, cur_replicas - len(completed))
+            self.c.update(dep)
+            for v in completed:
+                vname = (v.get("metadata") or {}).get("name", "")
+                self.c.delete("v1", "Pod", namespace, vname)
+                self.rec.event(model, "Normal", "AutoscaleDown",
+                               f"removed drained replica {vname}")
+        self._update_autoscale_status(model, desired, decision, pending)
+        return POLL
+
+    def _remediate_unreachable(self, model: Dict[str, Any],
+                               policy: "autoscale.Policy", key,
+                               namespace: str, stats: list) -> bool:
+        """Replace ONE unreachable replica (delete; the ReplicaSet
+        recreates — the Deployment size never shrinks, so the
+        minReplicas floor holds structurally). Quorum-gated: when NO
+        replica answers, the scrape path itself is suspect and the loop
+        fails static instead. Exponential backoff between replacements."""
+        entries = stats or []
+        reachable = [e for e in entries if e.get("state") != "unreachable"]
+        unreachable = [e for e in entries
+                       if e.get("state") == "unreachable"
+                       and not e.get("drainRequested")]
+        if not unreachable:
+            if entries and reachable:
+                self.scaler.note_clean_pass(key)
+            return False
+        if not reachable:
+            return False  # zero evidence -> fail static, no action
+        if not self.scaler.remediation_due(key, policy):
+            return False
+        victim = unreachable[0]
+        self.c.delete("v1", "Pod", namespace, victim.get("pod", ""))
+        self.scaler.note_remediation(key, policy, "unreachable")
+        self.rec.event(model, "Warning", "ReplicaRemediated",
+                       f"replaced unreachable replica {victim.get('pod')}"
+                       f" (backoff "
+                       f"{self.scaler.remediation_backoff_s(key):.0f}s)")
+        return True
+
+    def _remediate_crash_loop(self, model: Dict[str, Any],
+                              policy: "autoscale.Policy", namespace: str,
+                              app: str) -> bool:
+        """Replace ONE crash-looping pod under the same backoff gate.
+        Detected from pod containerStatuses (not scrapes — a crash-looping
+        pod has no server to scrape), triggered by the Deployment's
+        ReplicaFailure condition in the ladder."""
+        key = (namespace, ModelSpecView(model).name)
+        try:
+            pods = self.c.list("v1", "Pod", namespace,
+                               label_selector=f"app={app}")
+        except Exception:  # noqa: BLE001 — retry next poll
+            return False
+        looping = []
+        for p in sorted(pods, key=lambda p: (p.get("metadata") or {})
+                        .get("name", "")):
+            for cs in (p.get("status") or {}).get("containerStatuses") or []:
+                waiting = (cs.get("state") or {}).get("waiting") or {}
+                if (waiting.get("reason") == "CrashLoopBackOff"
+                        or int(cs.get("restartCount") or 0) >= 3):
+                    looping.append(p)
+                    break
+        if not looping:
+            return False
+        if not self.scaler.remediation_due(key, policy):
+            return False
+        victim = looping[0]
+        vname = (victim.get("metadata") or {}).get("name", "")
+        self.c.delete("v1", "Pod", namespace, vname)
+        self.scaler.note_remediation(key, policy, "crash_loop")
+        self.rec.event(model, "Warning", "ReplicaRemediated",
+                       f"replaced crash-looping replica {vname} (backoff "
+                       f"{self.scaler.remediation_backoff_s(key):.0f}s)")
+        return True
+
+    def _update_autoscale_status(self, model: Dict[str, Any], desired: int,
+                                 decision: "autoscale.Decision",
+                                 draining: list) -> None:
+        """Persist the control loop's intent in status.autoscale (the
+        fail-static anchor across operator restarts) — written only on
+        change so steady passes don't churn resourceVersions."""
+        status_obj = model.setdefault("status", {})
+        prev = status_obj.get("autoscale") or {}
+        new = {"desiredReplicas": desired,
+               "lastAction": decision.action,
+               "lastReason": decision.reason,
+               "lastActionAt": prev.get("lastActionAt"),
+               "draining": sorted(draining),
+               "sleeping": desired == 0}
+        if decision.action in autoscale.ACTIONS and (
+                prev.get("lastAction") != decision.action
+                or prev.get("desiredReplicas") != desired):
+            new["lastActionAt"] = _now()
+        if new != prev:
+            status_obj["autoscale"] = new
+            self._write_status(model)
+
+    def _clear_wake(self, model: Dict[str, Any]) -> None:
+        """Best-effort removal of the wake annotation (a Conflict just
+        means someone else wrote the CR; the annotation survives and the
+        next pass clears it — wake is idempotent)."""
+        from .client import Conflict
+        spec = ModelSpecView(model)
+        fresh = self.c.get(API_VERSION, KIND, spec.namespace, spec.name)
+        if fresh is None:
+            return
+        anns = (fresh.get("metadata") or {}).get("annotations") or {}
+        if workload.WAKE_ANNOTATION not in anns:
+            return
+        anns.pop(workload.WAKE_ANNOTATION, None)
+        fresh["metadata"]["annotations"] = anns
+        try:
+            self.c.update(fresh)
+        except (Conflict, NotFound):
+            pass

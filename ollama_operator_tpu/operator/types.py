@@ -147,6 +147,19 @@ class ModelSpecView:
         """Override for the runtime container image (spec.serverImage)."""
         return self._spec.get("serverImage")
 
+    @property
+    def autoscale(self) -> Dict[str, Any]:
+        """`spec.autoscale` block (absent = autoscaling off).
+
+        Fields (all optional, env `TPU_AUTOSCALE_*` supplies defaults —
+        see operator/autoscale.py): enabled, minReplicas, maxReplicas,
+        targetOccupancy, lowOccupancy, upCooldownSeconds,
+        downCooldownSeconds, upStreak, downStreak, idleTTLSeconds,
+        backlogTokensPerReplica, staleSeconds, flapWindowSeconds,
+        flapMaxFlips, flapHoldSeconds.
+        """
+        return self._spec.get("autoscale") or {}
+
     def tpu_placement(self) -> Optional[TpuPlacement]:
         if self.runtime != "tpu":
             return None

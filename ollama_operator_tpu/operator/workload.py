@@ -367,6 +367,35 @@ def deployment_replica_failure(dep: Dict[str, Any]) -> Optional[str]:
 
 SPEC_HASH_ANNOTATION = "ollama.ayaka.io/spec-hash"
 
+# Drain-first scale-down protocol (PR 9 drain made stream-preserving
+# removal possible; the autoscaler uses it for every shrink). The victim
+# pod is annotated, its server is told to drain (readyz flips, streams
+# finish), and only then does the Deployment shrink — the deletion-cost
+# annotation steers the ReplicaSet controller to remove OUR victim, not
+# a random healthy pod.
+DRAIN_ANNOTATION = "ollama.ayaka.io/draining"
+POD_DELETION_COST = "controller.kubernetes.io/pod-deletion-cost"
+# Wake signal for scale-to-zero: the gateway/router (or an admin) sets
+# this annotation on the Model CR; the reconciler scales to
+# max(1, minReplicas) and clears it.
+WAKE_ANNOTATION = "ollama.ayaka.io/wake"
+
+
+def pod_is_drain_victim(pod: Dict[str, Any]) -> bool:
+    anns = (pod.get("metadata") or {}).get("annotations") or {}
+    return anns.get(DRAIN_ANNOTATION) == "true"
+
+
+def mark_drain_victim(c: KubeClient, pod: Dict[str, Any]) -> None:
+    """Annotate the victim (idempotent) so the choice survives operator
+    restarts and the ReplicaSet controller deletes it first."""
+    anns = pod.setdefault("metadata", {}).setdefault("annotations", {})
+    if anns.get(DRAIN_ANNOTATION) == "true":
+        return
+    anns[DRAIN_ANNOTATION] = "true"
+    anns[POD_DELETION_COST] = "-999"
+    c.update(pod)
+
 
 def spec_hash(want: Dict[str, Any]) -> str:
     """Stable digest of the pod template we intend. Drift detection

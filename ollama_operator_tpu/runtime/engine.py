@@ -2001,6 +2001,160 @@ class Engine:
                 for a in attns:
                     self._extend_exec(b, a)
 
+    # --- warm-snapshot (scale-to-zero fast cold-start) -----------------
+    def _exec_cache_items(self):
+        """Yield ((kind, key), executable) over every AOT exec cache —
+        the same (kind, key) vocabulary _note_compile registers."""
+        for key, exe in self._decode_execs.items():
+            yield ("decode", key), exe
+        for b, exe in self._admit_execs.items():
+            yield ("admit", b), exe
+        for k, exe in self._admit_many_execs.items():
+            yield ("admit_many", k), exe
+        for k, exe in self._extend_execs.items():
+            yield ("extend", k), exe
+        for k, exe in self._spec_execs.items():
+            yield ("spec", k), exe
+
+    def _install_exec(self, sig, exe) -> bool:
+        kind, key = sig
+        if kind == "decode":
+            self._decode_execs[key] = exe
+        elif kind == "admit":
+            self._admit_execs[key] = exe
+        elif kind == "admit_many":
+            self._admit_many_execs[key] = exe
+        elif kind == "extend":
+            self._extend_execs[key] = exe
+        elif kind == "spec":
+            self._spec_execs[key] = exe
+        else:
+            return False
+        return True
+
+    def _compile_sig(self, sig) -> bool:
+        """Recompile one recorded warm signature through its normal
+        cache-miss path. Only ever called inside the warming scope, so
+        the recompile counter stays untouched by construction."""
+        kind, key = sig
+        try:
+            if kind == "decode":
+                self._decode_n_exec(*key)
+            elif kind == "admit":
+                self._admit_exec(key)
+            elif kind == "admit_many":
+                self._admit_many_exec(*key)
+            elif kind == "extend":
+                self._extend_exec(*key)
+            elif kind == "spec":
+                self._spec_exec(*key)
+            else:
+                return False
+        except Exception:  # noqa: BLE001 — a sig the current config
+            return False   # disallows (e.g. spec off) is simply skipped
+        return True
+
+    def warm_snapshot(self) -> bytes:
+        """Serialize the AOT warm state: every warmed (kind, key)
+        signature plus — where the backend supports it — the compiled
+        executables themselves (jax.experimental.serialize_executable).
+        Saved to the image-store PVC at drain time so a scale-to-zero
+        wake restores warmth instead of recompiling the warm plan.
+
+        Executable payloads are per-entry best-effort: an entry that
+        fails to serialize is covered by its recorded signature (restore
+        recompiles it inside the warming scope — slower wake, identical
+        recompile-counter outcome of zero).
+
+        Payloads default to accelerator backends only: the XLA CPU
+        executable-deserialization path miscompiles on some hosts (the
+        same instability that keeps the persistent compile cache opt-in
+        for tests), and on CPU a sig replay is cheap anyway.
+        TPU_WARM_SNAPSHOT_EXECS=1 forces payloads on, =0 forces off."""
+        import os as _os
+        import pickle
+        execs = {}
+        if self._snapshot_execs_ok():
+            try:
+                from jax.experimental import serialize_executable as _se
+            except ImportError:
+                _se = None
+            if _se is not None:
+                for sig, exe in self._exec_cache_items():
+                    try:
+                        payload, in_tree, out_tree = _se.serialize(exe)
+                        execs[sig] = (payload,
+                                      pickle.dumps((in_tree, out_tree)))
+                    except Exception:  # noqa: BLE001 — sig replay covers it
+                        continue
+        return pickle.dumps(
+            {"version": 1,
+             "jax": jax.__version__,
+             "backend": jax.default_backend(),
+             "sigs": sorted(self._warmed_sigs, key=repr),
+             "execs": execs},
+            protocol=pickle.HIGHEST_PROTOCOL)
+
+    @staticmethod
+    def _snapshot_execs_ok() -> bool:
+        """Tri-state TPU_WARM_SNAPSHOT_EXECS: unset = executable
+        payloads on accelerator backends only (CPU deserialization is
+        unstable on some hosts), "1" forces on, "0" forces off."""
+        import os as _os
+        want = _os.environ.get("TPU_WARM_SNAPSHOT_EXECS", "")
+        if want == "0":
+            return False
+        return want == "1" or jax.default_backend() != "cpu"
+
+    def restore_warm(self, blob: bytes) -> Dict[str, int]:
+        """Install a warm_snapshot() blob into a fresh engine: load
+        serialized executables where the backend/version still match,
+        then recompile any remaining signatures inside the warming scope.
+        Either way the engine comes up with the full warm plan registered
+        and `tpu_model_recompiles_total` untouched — the scale-to-zero
+        wake contract. Returns {"restored": n, "compiled": n}."""
+        import pickle
+        snap = pickle.loads(blob)
+        if int(snap.get("version") or 0) != 1:
+            raise ValueError("unknown warm snapshot version")
+        restored = compiled = 0
+        prev = self._warming
+        self._warming = True
+        try:
+            execs = snap.get("execs") or {}
+            compat = (snap.get("jax") == jax.__version__
+                      and snap.get("backend") == jax.default_backend())
+            # same tri-state as the save side: a CPU wake never
+            # deserializes executables unless explicitly forced — the
+            # sigs below cover every entry either way
+            if execs and compat and self._snapshot_execs_ok():
+                try:
+                    from jax.experimental import serialize_executable as _se
+                except ImportError:
+                    _se = None
+                if _se is not None:
+                    for sig, (payload, trees) in execs.items():
+                        try:
+                            in_tree, out_tree = pickle.loads(trees)
+                            exe = _se.deserialize_and_load(
+                                payload, in_tree, out_tree)
+                        except Exception:  # noqa: BLE001 — fall through
+                            continue       # to the recompile path below
+                        if self._install_exec(sig, exe):
+                            self._warmed_sigs.add(sig)
+                            restored += 1
+            for sig in snap.get("sigs") or []:
+                sig = (sig[0], tuple(sig[1]) if isinstance(sig[1], list)
+                       else sig[1])
+                if sig in self._warmed_sigs:
+                    continue
+                if self._compile_sig(sig):
+                    compiled += 1
+        finally:
+            self._warming = prev
+        FLIGHT.record("warm_restore", restored=restored, compiled=compiled)
+        return {"restored": restored, "compiled": compiled}
+
     def prepare_decode(self, n: Optional[int] = None) -> list:
         """Paged mode: grow every active slot's block table to cover
         lengths + n upcoming tokens (pages must exist BEFORE the chunk —

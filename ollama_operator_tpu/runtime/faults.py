@@ -35,6 +35,13 @@ Fault points wired through the codebase:
                        wait, ON the waiter thread; an armed delay:Nms
                        simulates a wedged device (the wait stalls, the
                        watchdog fires, supervised restart + replay)
+    operator.scrape -- ``client.fetch_replica_ps`` before the replica
+                       /api/ps GET; an armed fail collapses the scrape
+                       to None exactly like a network fault (replica
+                       reads as unreachable), an armed delay stalls
+                       like a slow pod — the autoscaler chaos drills
+                       assert the control loop holds its last decision
+                       (fails static) instead of scaling on the hole
 
 Trigger specs (the grammar is intentionally tiny):
 

@@ -700,6 +700,10 @@ class Scheduler:
         return {
             "state": ("broken" if self.broken
                       else "draining" if self.draining else "serving"),
+            # live work counters: the operator's drain-first scale-down
+            # polls these to know when a victim replica is empty
+            "active_streams": self.n_active,
+            "queued": self.qsize,
             "restarts": self.n_restarts,
             "replay": {
                 "enabled": replay_max_streams() > 0,

@@ -208,7 +208,8 @@ class LoadedModel:
                  default_params: Optional[Dict] = None,
                  mesh=None, ecfg: Optional[EngineConfig] = None,
                  digest: str = "", vision: Optional[Tuple] = None,
-                 control_plane=None, follower: bool = False):
+                 control_plane=None, follower: bool = False,
+                 warm_cache_dir: Optional[str] = None):
         self.name = name
         self.cfg = cfg
         # (VisionConfig, vision params) for multimodal models (llava) —
@@ -237,9 +238,15 @@ class LoadedModel:
         # serving must never pay an XLA compile at a bucket crossing (the
         # persistent compilation cache makes this near-free on restarts).
         # Followers warm via the leader's replayed warm_buckets call.
+        # When a warm snapshot exists on the weight-cache volume (saved
+        # by a drain before scale-to-zero), restore it instead: the
+        # woken replica re-enters serving with the full warm plan and
+        # tpu_model_recompiles_total untouched.
         import os as _os
+        self._warm_cache_dir = warm_cache_dir if not follower else None
         if not follower and _os.environ.get("TPU_WARM_BUCKETS", "1") != "0":
-            self.engine.warm_buckets()
+            if not self._restore_warm_snapshot():
+                self.engine.warm_buckets()
         # followers replay engine calls from the control stream — they
         # never schedule on their own
         self.scheduler = None if follower else Scheduler(self.engine)
@@ -305,6 +312,64 @@ class LoadedModel:
                          lambda: _util("goodput_tok_s"))
         METRICS.gauge_fn("tpu_model_padding_waste_pct",
                          lambda: _util("waste_pct"))
+
+    # ------------------------------------------------------------------
+    # warm-snapshot (scale-to-zero fast cold-start): the AOT warm-bucket
+    # executable cache persists on the weight-cache volume across pod
+    # generations — saved at drain time, restored at load
+    # ------------------------------------------------------------------
+    def warm_snapshot_key(self) -> str:
+        """Serving-identity hash the snapshot is keyed by: a snapshot is
+        only valid for the exact digest + engine geometry + jax backend
+        that produced it (the warm plan itself also varies with
+        TPU_SPEC_DECODE, so that rides along)."""
+        import hashlib
+        import os as _os
+        import jax
+        payload = "|".join([
+            self.digest or self.name, repr(self.ecfg), jax.__version__,
+            jax.default_backend(),
+            _os.environ.get("TPU_SPEC_DECODE", "0") or "0"])
+        return hashlib.sha256(payload.encode()).hexdigest()[:24]
+
+    def _restore_warm_snapshot(self) -> bool:
+        """Try to warm from a persisted snapshot; False falls back to a
+        normal warm_buckets pass (never an error — the snapshot is an
+        optimisation, not a dependency)."""
+        import os as _os
+        if (self._warm_cache_dir is None
+                or _os.environ.get("TPU_WARM_SNAPSHOT", "1") == "0"
+                or not hasattr(self.engine, "restore_warm")):
+            return False
+        from ..gguf.store import load_warm_snapshot
+        try:
+            blob = load_warm_snapshot(self._warm_cache_dir,
+                                      self.warm_snapshot_key())
+            if blob is None:
+                return False
+            self.engine.restore_warm(blob)
+        except Exception:  # noqa: BLE001 — corrupt/incompatible snapshot
+            return False
+        METRICS.inc("tpu_model_warm_snapshot_restores_total", 1.0)
+        return True
+
+    def save_warm_snapshot(self) -> bool:
+        """Persist the warm state (drain path: the operator snapshots
+        before a scale-to-zero so the wake is warm). Best-effort."""
+        import os as _os
+        if (self.follower or self._warm_cache_dir is None
+                or _os.environ.get("TPU_WARM_SNAPSHOT", "1") == "0"
+                or not hasattr(self.engine, "warm_snapshot")):
+            return False
+        from ..gguf.store import save_warm_snapshot
+        try:
+            blob = self.engine.warm_snapshot()
+            save_warm_snapshot(self._warm_cache_dir,
+                               self.warm_snapshot_key(), blob)
+        except Exception:  # noqa: BLE001 — never let a snapshot fail a drain
+            return False
+        METRICS.inc("tpu_model_warm_snapshot_saves_total", 1.0)
+        return True
 
     # ------------------------------------------------------------------
     # multimodal (llava): image bytes → projected embeddings → spliced

@@ -118,9 +118,12 @@ def main(argv=None):
         # the backend (operator-rendered env; no-op single-host)
         from ..parallel.distributed import maybe_initialize
         joined = maybe_initialize()
-        if args.cache:
+        if args.cache and os.environ.get("TPU_XLA_CACHE", "1") != "0":
             # persistent XLA compilation cache beside the weight cache: pod
-            # restarts skip the multi-program warm-up compiles
+            # restarts skip the multi-program warm-up compiles.
+            # TPU_XLA_CACHE=0 opts out: some CPU hosts miscompile on the
+            # executable-deserialization path (wrong decode tokens), the
+            # same instability that keeps the test-suite cache opt-in
             xla_cache = os.path.join(args.cache, "xla-cache")
             os.makedirs(xla_cache, exist_ok=True)
             jax.config.update("jax_compilation_cache_dir", xla_cache)

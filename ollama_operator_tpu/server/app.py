@@ -333,7 +333,10 @@ class ModelManager:
     def begin_drain(self):
         """Enter draining: readiness goes 503 (the operator's Service
         stops routing here), the scheduler sheds new submits, running
-        streams keep generating. Idempotent."""
+        streams keep generating. Idempotent. A draining replica is a
+        scale-down or scale-to-zero candidate, so the AOT warm state is
+        snapshotted to the shared cache volume here — the next wake
+        restores it instead of recompiling the warm plan."""
         with self._lock:
             already, self.draining = self.draining, True
             lm = self.loaded
@@ -342,6 +345,8 @@ class ModelManager:
                           model=lm.name if lm is not None else None)
         if lm is not None:
             lm.scheduler.begin_drain()
+            if not already and hasattr(lm, "save_warm_snapshot"):
+                lm.save_warm_snapshot()
 
     def drain(self, timeout_s: Optional[float] = None) -> int:
         """Graceful drain for SIGTERM: begin_drain(), then let the
@@ -554,7 +559,8 @@ class ModelManager:
                 name.short, cfg, params, tokenizer, template=template,
                 system=system, default_params=default_params,
                 mesh=self.mesh, ecfg=ecfg, digest=digest, vision=vision,
-                control_plane=self.control_plane, follower=self.follower)
+                control_plane=self.control_plane, follower=self.follower,
+                warm_cache_dir=self.cache_dir)
             # effective serving config, for /api/ps observability (the
             # auto-resolved dtype is otherwise invisible to clients)
             self.loaded.serving_dtype = engine_dtype
@@ -1179,6 +1185,7 @@ class Handler(BaseHTTPRequestHandler):
                 "/api/delete": self._api_delete,
                 "/api/embeddings": self._api_embeddings,
                 "/api/embed": self._api_embed,
+                "/api/drain": self._api_drain,
                 "/v1/chat/completions": self._oai_chat,
                 "/v1/completions": self._oai_completions,
                 "/v1/embeddings": self._oai_embeddings,
@@ -1537,6 +1544,21 @@ class Handler(BaseHTTPRequestHandler):
     def _api_delete(self, body: Dict):
         self.manager.delete(self._model_arg(body))
         self._send_json({})
+
+    def _api_drain(self, body: Dict):
+        """Operator-initiated graceful drain (the drain-first scale-down
+        protocol): readyz flips 503, new submits shed, running streams
+        finish, and the AOT warm state is snapshotted for the next wake.
+        Idempotent — the operator re-POSTs on every poll until the
+        replica reports zero active work via /api/ps."""
+        self.manager.begin_drain()
+        lm = self.manager.loaded
+        sched = lm.scheduler if lm is not None else None
+        self._send_json({
+            "status": "draining",
+            "active_streams": int(getattr(sched, "n_active", 0) or 0),
+            "queued": int(getattr(sched, "qsize", 0) or 0),
+        })
 
     def _api_embeddings(self, body: Dict):
         lm = self.manager.require_loaded(self._model_arg(body),

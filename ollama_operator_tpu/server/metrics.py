@@ -330,6 +330,36 @@ GLOBAL.describe("tpu_model_goodput_tokens_per_second",
 GLOBAL.describe("tpu_model_padding_waste_pct",
                 "Percent of issued token positions that were padding "
                 "over the last 60s (100 - 100*occupancy)")
+GLOBAL.describe("tpu_model_autoscale_decisions_total",
+                "Autoscaler scale actions taken, by action "
+                "(action=up|down|to_zero|wake): each is one damped "
+                "single-step move of the desired replica count "
+                "(operator/autoscale.py)")
+GLOBAL.describe("tpu_model_autoscale_holds_total",
+                "Autoscaler passes that held the last decision instead "
+                "of scaling, by cause (cause=no_data|stale|flap|"
+                "cooldown): no_data/stale are the fail-static guard — "
+                "a missing or stale replica scrape must never produce "
+                "a scale action")
+GLOBAL.describe("tpu_model_remediation_replacements_total",
+                "Broken replicas replaced by the operator, by cause "
+                "(cause=unreachable|crash_loop): the pod is deleted and "
+                "the ReplicaSet recreates it — the fleet never shrinks "
+                "below minReplicas")
+GLOBAL.describe("tpu_model_remediation_backoff_holds_total",
+                "Remediation opportunities skipped because the "
+                "exponential replacement backoff was still closed "
+                "(doubles per replacement up to the cap; resets on a "
+                "clean scrape pass)")
+GLOBAL.describe("tpu_model_warm_snapshot_saves_total",
+                "AOT warm-bucket executable cache snapshots persisted "
+                "to the image-store PVC at drain time (scale-to-zero "
+                "fast cold-start)")
+GLOBAL.describe("tpu_model_warm_snapshot_restores_total",
+                "Engine warm-ups served from a persisted warm snapshot "
+                "instead of a from-scratch warm_buckets compile pass — "
+                "a woken replica's first request must not trip "
+                "tpu_model_recompiles_total")
 # pre-seed the failure counters at 0: alert rules rate() over these, and
 # a series that first appears AT the first failure hides that failure
 # (the stall/chunk counters likewise: a mixed-load dashboard must read 0,
@@ -404,6 +434,22 @@ GLOBAL.inc("tpu_model_model_flops_total", 0.0)
 for _phase in ("dispatch_wait", "host", "idle"):
     GLOBAL.inc("tpu_model_breakdown_seconds_total", 0.0,
                f'{{phase="{_phase}"}}')
+# closed-loop fleet control (operator/autoscale.py): scale decisions,
+# fail-static holds, and remediation are exactly the rare events alert
+# rules watch — every labelled combination pre-seeded so rate() reads 0,
+# not absent, on a fleet that has never scaled or broken
+for _action in ("up", "down", "to_zero", "wake"):
+    GLOBAL.inc("tpu_model_autoscale_decisions_total", 0.0,
+               f'{{action="{_action}"}}')
+for _cause in ("no_data", "stale", "flap", "cooldown"):
+    GLOBAL.inc("tpu_model_autoscale_holds_total", 0.0,
+               f'{{cause="{_cause}"}}')
+for _cause in ("unreachable", "crash_loop"):
+    GLOBAL.inc("tpu_model_remediation_replacements_total", 0.0,
+               f'{{cause="{_cause}"}}')
+GLOBAL.inc("tpu_model_remediation_backoff_holds_total", 0.0)
+GLOBAL.inc("tpu_model_warm_snapshot_saves_total", 0.0)
+GLOBAL.inc("tpu_model_warm_snapshot_restores_total", 0.0)
 
 
 class Stopwatch:

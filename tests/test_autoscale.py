@@ -1,0 +1,842 @@
+"""Closed-loop fleet control: autoscaler law, reconciler actuation,
+remediation, and the scale-event chaos drills.
+
+The FleetHarness plays every cluster actor the reconciler doesn't own —
+ReplicaSet (pods converge on Deployment spec.replicas), kubelet
+(readiness; a hung server keeps its lagging Ready condition, mirroring
+the 2500-failure probe tolerance in operator/pod.py), the model servers
+(/api/ps bodies, /api/drain), and the gateway (routing, wake annotation,
+PR 9 stream replay on replica death). Error-frame accounting is the
+contract under test: a stream killed on a live, non-draining replica is
+a client-visible error; drained and replayed streams are not.
+"""
+
+import copy
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from ollama_operator_tpu.operator import autoscale, workload
+from ollama_operator_tpu.operator.autoscale import (Autoscaler, Observation,
+                                                    Policy, observe_stats,
+                                                    resolve_policy)
+from ollama_operator_tpu.operator.client import (KubeClient,
+                                                 fetch_replica_ps,
+                                                 update_status_with_retry)
+from ollama_operator_tpu.operator.pod import PORT
+from ollama_operator_tpu.operator.reconciler import (DONE, POLL,
+                                                     ModelReconciler,
+                                                     is_condition_true)
+from ollama_operator_tpu.operator.types import API_VERSION, KIND
+from ollama_operator_tpu.runtime.faults import FAULTS
+from ollama_operator_tpu.server.metrics import GLOBAL as METRICS
+
+from fake_kube import FakeKube, serve_http
+from test_operator_reconciler import RecordingRecorder, make_model
+
+
+class Clock:
+    """Injected monotonic time: the control law's cooldowns, TTLs, and
+    backoffs all advance only when a test says so."""
+
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _policy(**kw) -> Policy:
+    base = dict(enabled=True, min_replicas=1, max_replicas=4,
+                target_occupancy=0.75, low_occupancy=0.30,
+                up_cooldown_s=10.0, down_cooldown_s=10.0,
+                up_streak=2, down_streak=2, idle_ttl_s=30.0,
+                flap_window_s=120.0, flap_max_flips=4, flap_hold_s=60.0,
+                remediation_backoff_s=1.0, remediation_backoff_cap_s=4.0)
+    base.update(kw)
+    return Policy(**base)
+
+
+def _obs(current, occ=0.0, q=0, bt=0, gp=0.0, slo=0.0, busy=None,
+         fresh=True, cause="no_data"):
+    if not fresh:
+        return Observation(current=current, fresh=False, stale_cause=cause)
+    if busy is None:
+        busy = bool(q or bt or occ > 0.0)
+    return Observation(current=current, fresh=True, reachable=max(current, 1),
+                       occupancy=occ, queue_depth=q, backlog_tokens=bt,
+                       goodput_tok_s=gp, ttft_slo_ms=slo, busy=busy)
+
+
+# -- policy resolution -------------------------------------------------
+
+class TestPolicyResolution:
+    def test_defaults_disabled(self):
+        pol = resolve_policy({})
+        assert not pol.enabled
+        assert pol.min_replicas == 1 and pol.max_replicas == 8
+
+    def test_spec_wins_over_env(self, monkeypatch):
+        monkeypatch.setenv("TPU_AUTOSCALE", "0")
+        monkeypatch.setenv("TPU_AUTOSCALE_MAX", "3")
+        monkeypatch.setenv("TPU_AUTOSCALE_IDLE_TTL_S", "600")
+        pol = resolve_policy({"enabled": True, "maxReplicas": 6,
+                              "minReplicas": 0,
+                              "targetOccupancy": 0.5})
+        assert pol.enabled
+        assert pol.max_replicas == 6 and pol.min_replicas == 0
+        assert pol.target_occupancy == 0.5
+        # unset in spec -> env default flows through
+        assert pol.idle_ttl_s == 600.0
+
+    def test_env_enables(self, monkeypatch):
+        monkeypatch.setenv("TPU_AUTOSCALE", "1")
+        monkeypatch.setenv("TPU_AUTOSCALE_MIN", "2")
+        pol = resolve_policy({})
+        assert pol.enabled and pol.min_replicas == 2
+
+
+# -- observation distillation ------------------------------------------
+
+class TestObserveStats:
+    POL = _policy(stale_s=30.0)
+
+    def test_aggregates_serving_replicas(self):
+        stats = [
+            {"state": "serving", "occupancy": 0.8, "queueDepth": 2,
+             "backlogTokens": 100, "goodputTokS": 50.0, "ttftSloMs": 400.0,
+             "activeStreams": 3},
+            {"state": "serving", "occupancy": 0.4, "queueDepth": 1,
+             "backlogTokens": 50, "goodputTokS": 25.0, "activeStreams": 1},
+            {"state": "draining", "occupancy": 1.0, "queueDepth": 9,
+             "activeStreams": 2},
+        ]
+        o = observe_stats(3, stats, 0.0, self.POL)
+        assert o.fresh and o.reachable == 3 and o.draining == 1
+        # draining replicas are excluded from the sizing signal
+        assert o.occupancy == pytest.approx(0.6)
+        assert o.queue_depth == 3 and o.backlog_tokens == 150
+        assert o.goodput_tok_s == pytest.approx(75.0)
+        assert o.ttft_slo_ms == 400.0 and o.busy
+
+    def test_missing_or_stale_is_not_fresh(self):
+        assert not observe_stats(2, None, 0.0, self.POL).fresh
+        assert not observe_stats(2, [], None, self.POL).fresh
+        o = observe_stats(2, [{"state": "serving"}], 31.0, self.POL)
+        assert not o.fresh and o.stale_cause == "stale"
+
+    def test_all_unreachable_fails_static(self):
+        stats = [{"state": "unreachable"}, {"state": "unreachable"}]
+        o = observe_stats(2, stats, 0.0, self.POL)
+        assert not o.fresh and o.stale_cause == "no_data"
+        # ...but a fleet of zero pods is legitimately idle, not a fault
+        assert observe_stats(0, [], 0.0, self.POL).fresh
+
+
+# -- the damped control law --------------------------------------------
+
+class TestControlLaw:
+    def setup_method(self):
+        self.clock = Clock()
+        self.asc = Autoscaler(now=self.clock)
+        self.key = ("default", "phi")
+
+    def test_hysteresis_needs_sustained_hot(self):
+        pol = _policy(up_streak=2, up_cooldown_s=0.0)
+        d = self.asc.observe(self.key, pol, _obs(1, occ=0.9))
+        assert d.action == "steady" and d.desired == 1
+        d = self.asc.observe(self.key, pol, _obs(1, occ=0.9))
+        assert d.action == "up" and d.desired == 2
+
+    def test_up_cooldown_holds(self):
+        pol = _policy(up_streak=1, up_cooldown_s=10.0)
+        hold0 = METRICS.get("tpu_model_autoscale_holds_total",
+                            '{cause="cooldown"}')
+        assert self.asc.observe(self.key, pol, _obs(1, occ=0.9)).action == "up"
+        d = self.asc.observe(self.key, pol, _obs(2, occ=0.9))
+        assert d.action == "hold" and d.desired == 2
+        assert METRICS.get("tpu_model_autoscale_holds_total",
+                           '{cause="cooldown"}') == hold0 + 1
+        self.clock.advance(10.1)
+        assert self.asc.observe(self.key, pol,
+                                _obs(2, occ=0.9)).action == "up"
+
+    def test_max_replicas_clamps(self):
+        pol = _policy(up_streak=1, up_cooldown_s=0.0, max_replicas=2)
+        assert self.asc.observe(self.key, pol, _obs(1, occ=0.9)).desired == 2
+        assert self.asc.observe(self.key, pol, _obs(2, occ=0.9)).desired == 2
+
+    def test_backlog_and_slo_risk_count_as_hot(self):
+        pol = _policy(up_streak=1, up_cooldown_s=0.0,
+                      backlog_tokens_per_replica=100)
+        d = self.asc.observe(self.key, pol, _obs(1, occ=0.1, bt=500))
+        assert d.action == "up"
+        # predicted TTFT = backlog/goodput = 2s >> 500ms SLO, low occupancy
+        asc2 = Autoscaler(now=self.clock)
+        d = asc2.observe(("default", "o"),
+                         _policy(up_streak=1, up_cooldown_s=0.0),
+                         _obs(1, occ=0.1, bt=200, gp=100.0, slo=500.0))
+        assert d.action == "up"
+
+    def test_scale_down_floor_and_streak(self):
+        pol = _policy(down_streak=2, down_cooldown_s=0.0, min_replicas=1,
+                      idle_ttl_s=0.0)
+        self.asc.seed_desired(self.key, 3)
+        cold = _obs(3, occ=0.05, busy=True)
+        assert self.asc.observe(self.key, pol, cold).action == "steady"
+        assert self.asc.observe(self.key, pol, cold).desired == 2
+        self.asc.observe(self.key, pol, cold)
+        assert self.asc.observe(self.key, pol, cold).desired == 1
+        # at the floor: cold forever, never below max(minReplicas, 1)
+        for _ in range(5):
+            assert self.asc.observe(self.key, pol, cold).desired == 1
+
+    def test_idle_ttl_scales_to_zero_and_wake_restores(self):
+        pol = _policy(idle_ttl_s=30.0, down_cooldown_s=0.0, down_streak=99)
+        self.asc.seed_desired(self.key, 1)
+        idle = _obs(1, occ=0.0, busy=False)
+        assert self.asc.observe(self.key, pol, idle).action == "steady"
+        self.clock.advance(29.0)
+        assert self.asc.observe(self.key, pol, idle).action == "steady"
+        self.clock.advance(1.5)
+        d = self.asc.observe(self.key, pol, idle)
+        assert d.action == "to_zero" and d.desired == 0
+        # a sleeping fleet with no pods is steady, not a hold
+        d = self.asc.observe(self.key, pol, _obs(0, fresh=False))
+        assert d.action == "steady" and d.reason == "sleeping"
+        # wake beats everything
+        d = self.asc.observe(self.key, pol, _obs(0, fresh=False), wake=True)
+        assert d.action == "wake" and d.desired == 1
+
+    def test_fail_static_holds_last_decision(self):
+        pol = _policy(up_streak=1, up_cooldown_s=0.0)
+        hold0 = METRICS.get("tpu_model_autoscale_holds_total",
+                            '{cause="no_data"}')
+        assert self.asc.observe(self.key, pol, _obs(1, occ=0.9)).desired == 2
+        for _ in range(3):
+            d = self.asc.observe(self.key, pol, _obs(2, fresh=False))
+            assert d.action == "hold" and d.desired == 2
+        assert METRICS.get("tpu_model_autoscale_holds_total",
+                           '{cause="no_data"}') == hold0 + 3
+        d = self.asc.observe(self.key, pol,
+                             _obs(2, fresh=False, cause="stale"))
+        assert d.action == "hold" and d.desired == 2
+
+    def test_flap_detector_freezes(self):
+        pol = _policy(up_streak=1, down_streak=1, up_cooldown_s=0.0,
+                      down_cooldown_s=0.0, idle_ttl_s=0.0,
+                      flap_max_flips=2, flap_hold_s=60.0)
+        hot = _obs(2, occ=0.9)
+        cold = _obs(2, occ=0.05, busy=True)
+        self.asc.seed_desired(self.key, 2)
+        assert self.asc.observe(self.key, pol, hot).action == "up"      # +1
+        self.clock.advance(1)
+        assert self.asc.observe(self.key, pol, cold).action == "down"   # flip
+        self.clock.advance(1)
+        assert self.asc.observe(self.key, pol, hot).action == "up"      # flip
+        self.clock.advance(1)
+        d = self.asc.observe(self.key, pol, cold)
+        assert d.action == "hold" and "flap" in d.reason
+        # frozen for flap_hold_s regardless of signal
+        self.clock.advance(30)
+        assert self.asc.observe(self.key, pol, hot).action == "hold"
+        self.clock.advance(31)
+        # window (120s) still holds the old moves but the freeze expired
+        # and the flip count decays as moves age out
+        d = self.asc.observe(self.key, pol, hot)
+        assert d.action in ("up", "hold")
+
+    def test_remediation_backoff_doubles_to_cap(self):
+        pol = _policy(remediation_backoff_s=1.0, remediation_backoff_cap_s=4.0)
+        assert self.asc.remediation_due(self.key, pol)
+        self.asc.note_remediation(self.key, pol, "unreachable")
+        assert self.asc.remediation_backoff_s(self.key) == 1.0
+        hold0 = METRICS.get("tpu_model_remediation_backoff_holds_total")
+        assert not self.asc.remediation_due(self.key, pol)
+        assert METRICS.get(
+            "tpu_model_remediation_backoff_holds_total") == hold0 + 1
+        for expect in (2.0, 4.0, 4.0):           # doubles, then caps
+            self.clock.advance(5.0)
+            assert self.asc.remediation_due(self.key, pol)
+            self.asc.note_remediation(self.key, pol, "crash_loop")
+            assert self.asc.remediation_backoff_s(self.key) == expect
+        # a clean pass resets the ladder
+        self.asc.note_clean_pass(self.key)
+        assert self.asc.remediation_due(self.key, pol)
+        self.asc.note_remediation(self.key, pol, "unreachable")
+        assert self.asc.remediation_backoff_s(self.key) == 1.0
+
+
+# -- fleet harness ------------------------------------------------------
+
+class _Stream:
+    __slots__ = ("left",)
+
+    def __init__(self, ttl: int):
+        self.left = ttl
+
+
+class _Replica:
+    """One fake model server: bounded slots, a local queue, and the
+    /api/ps body shape the PR 10 mirror scrapes."""
+
+    CAP = 4
+
+    def __init__(self, pod: str, ip: str):
+        self.pod, self.ip = pod, ip
+        self.active, self.queued = [], []
+        self.draining = False
+        self.alive = True
+
+    def ps_body(self):
+        occ = min(1.0, len(self.active) / self.CAP)
+        nq = len(self.queued)
+        return {"models": [{
+            "name": "phi",
+            "lifecycle": {"state": "draining" if self.draining else "serving",
+                          "active_streams": len(self.active), "queued": nq},
+            "utilization": {"mfu": 0.5, "occupancy": occ, "waste_pct": 0.0,
+                            "goodput_tok_s": 50.0 * len(self.active),
+                            "recompiles": {}},
+            "admission": {
+                "queued_by_class": {"default": nq} if nq else {},
+                "backlog_tokens_by_class": {"default": 64 * nq} if nq else {},
+                "ttft_slo_ms": 0.0},
+        }]}
+
+
+class FleetHarness:
+    STREAM_TICKS = 2          # ticks a stream occupies a slot
+
+    def __init__(self, kube: FakeKube, name="phi", namespace="default"):
+        self.kube, self.name, self.namespace = kube, name, namespace
+        self.app = workload.model_app_name(name)
+        self.by_ip = {}
+        self.by_pod = {}
+        self._seq = 0
+        self.error_frames = 0    # streams killed on a live serving replica
+        self.completed = 0
+        self.replayed = 0
+        self.offered = 0
+        self.replay_pool = []    # PR 9: streams rescued from a dead replica
+        self.pending = []        # gateway queue while the fleet sleeps
+
+    # -- reconciler wiring (mirrors client.fetch_replica_ps's contract) --
+    def ps_fetch(self, url):
+        try:
+            FAULTS.check("operator.scrape")
+        except Exception:        # noqa: BLE001 — collapses to None
+            return None
+        r = self.by_ip.get(url.split("//", 1)[1].split(":", 1)[0])
+        if r is None or not r.alive:
+            return None
+        return r.ps_body()
+
+    def drain_post(self, url):
+        r = self.by_ip.get(url.split("//", 1)[1].split(":", 1)[0])
+        if r is None or not r.alive:
+            return False
+        r.draining = True
+        return True
+
+    # -- cluster actors ---------------------------------------------------
+    def _spawn(self):
+        self._seq += 1
+        pod, ip = f"{self.app}-{self._seq:04d}", f"10.1.0.{self._seq}"
+        self.kube.create({
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": pod, "namespace": self.namespace,
+                         "labels": {"app": self.app}},
+            "status": {"phase": "Running", "podIP": ip}})
+        r = _Replica(pod, ip)
+        while self.replay_pool:          # replacement adopts replayed work
+            s = self.replay_pool.pop()
+            (r.active if len(r.active) < r.CAP else r.queued).append(s)
+            self.replayed += 1
+        self.by_pod[pod], self.by_ip[ip] = r, r
+
+    def sync(self):
+        """Play ReplicaSet + kubelet: pods converge on spec.replicas; a
+        deleted pod's replica dies with it (streams it was actively
+        serving become error frames unless drained or replayed)."""
+        dep = self.kube.get("apps/v1", "Deployment", self.namespace, self.app)
+        if dep is None:
+            return
+        want = int(dep["spec"].get("replicas", 1) or 0)
+        pods = self.kube.list("v1", "Pod", self.namespace,
+                              label_selector=f"app={self.app}")
+        names = {(p.get("metadata") or {}).get("name") for p in pods}
+        for pod_name in list(self.by_pod):
+            if pod_name not in names:
+                r = self.by_pod.pop(pod_name)
+                self.by_ip.pop(r.ip, None)
+                if r.alive and not r.draining:
+                    self.error_frames += len(r.active) + len(r.queued)
+        while len(self.by_pod) < want:
+            self._spawn()
+        # kubelet: draining servers fail readiness (readyz flips 503);
+        # a hung server keeps its lagging Ready (pod.py's 2500-failure
+        # probe tolerance) — the scrape path is the fast detector.
+        n = len(self.by_pod)
+        ready = sum(1 for r in self.by_pod.values() if not r.draining)
+        self.kube.set_status("apps/v1", "Deployment", self.namespace,
+                             self.app, {"replicas": n, "readyReplicas": ready,
+                                        "availableReplicas": ready})
+
+    def targets(self):
+        return [r for r in self.by_pod.values()
+                if r.alive and not r.draining]
+
+    def route(self):
+        ts = self.targets()
+        if not ts:
+            if self.pending:
+                self.set_wake()
+            return
+        while self.pending:
+            t = min(ts, key=lambda r: len(r.active) + len(r.queued))
+            s = self.pending.pop(0)
+            (t.active if len(t.active) < t.CAP else t.queued).append(s)
+
+    def offer(self, n: int):
+        self.offered += n
+        self.pending.extend(_Stream(self.STREAM_TICKS) for _ in range(n))
+        self.route()
+
+    def step(self):
+        """One serving tick: streams progress and complete, queues drain."""
+        for r in self.by_pod.values():
+            if not r.alive:
+                continue
+            self.completed += sum(1 for s in r.active if s.left <= 1)
+            for s in r.active:
+                s.left -= 1
+            r.active = [s for s in r.active if s.left > 0]
+            while r.queued and len(r.active) < r.CAP:
+                r.active.append(r.queued.pop(0))
+        self.route()
+
+    def kill(self, pod_name: str):
+        """Crash a replica mid-stream. PR 9's transcript replay rescues
+        its in-flight work onto the replacement — not error frames."""
+        r = self.by_pod[pod_name]
+        r.alive = False
+        self.replay_pool.extend(r.active + r.queued)
+        r.active, r.queued = [], []
+
+    def set_wake(self):
+        m = self.kube.get(API_VERSION, KIND, self.namespace, self.name)
+        anns = m.setdefault("metadata", {}).setdefault("annotations", {})
+        if anns.get(workload.WAKE_ANNOTATION) != "true":
+            anns[workload.WAKE_ANNOTATION] = "true"
+            self.kube.update(m)
+
+    @property
+    def in_flight(self) -> int:
+        return (len(self.pending) + len(self.replay_pool)
+                + sum(len(r.active) + len(r.queued)
+                      for r in self.by_pod.values()))
+
+    @property
+    def replica_count(self) -> int:
+        return len(self.by_pod)
+
+
+def boot(recon, kube, harness, steps=12):
+    """Drive the ladder up (store, services) until the fleet serves."""
+    res = None
+    for _ in range(steps):
+        res = recon.reconcile(harness.namespace, harness.name)
+        if kube.get("apps/v1", "StatefulSet", harness.namespace,
+                    workload.IMAGE_STORE_NAME):
+            kube.set_status("apps/v1", "StatefulSet", harness.namespace,
+                            workload.IMAGE_STORE_NAME, {"readyReplicas": 1})
+        for svc_name, ip in ((workload.IMAGE_STORE_SERVICE, "10.0.0.1"),
+                             (harness.app, "10.0.0.2")):
+            svc = kube.get("v1", "Service", harness.namespace, svc_name)
+            if svc is not None and not svc["spec"].get("clusterIP"):
+                svc["spec"]["clusterIP"] = ip
+                kube.update(svc)
+        harness.sync()
+    return res
+
+
+def tick(recon, harness, clock, dt=1.0, passes=3):
+    """One wall-clock tick: serve, then let the control loop breathe."""
+    clock.advance(dt)
+    harness.step()
+    for _ in range(passes):
+        recon.reconcile(harness.namespace, harness.name)
+        harness.sync()
+
+
+DIURNAL_SPEC = {
+    "enabled": True, "minReplicas": 1, "maxReplicas": 4,
+    "targetOccupancy": 0.6, "lowOccupancy": 0.3,
+    "upCooldownSeconds": 2, "downCooldownSeconds": 2,
+    "upStreak": 2, "downStreak": 2, "idleTTLSeconds": 3,
+    "staleSeconds": 10000, "flapWindowSeconds": 10000,
+    "flapMaxFlips": 99, "remediationBackoffSeconds": 1,
+}
+
+
+def make_fleet(spec_autoscale=DIURNAL_SPEC, **model_kw):
+    kube = FakeKube()
+    rec = RecordingRecorder()
+    harness = FleetHarness(kube)
+    make_model(kube, autoscale=dict(spec_autoscale), **model_kw)
+    clock = Clock()
+    recon = ModelReconciler(kube, rec, server_image="runtime:test",
+                            ps_fetch=harness.ps_fetch,
+                            drain_post=harness.drain_post,
+                            autoscaler=Autoscaler(now=clock))
+    return kube, rec, harness, clock, recon
+
+
+# -- end-to-end: the diurnal cycle --------------------------------------
+
+class TestFleetAutoscaling:
+    def test_diurnal_cycle_zero_error_frames(self):
+        kube, rec, harness, clock, recon = make_fleet()
+        d0 = {a: METRICS.get("tpu_model_autoscale_decisions_total",
+                             f'{{action="{a}"}}') for a in autoscale.ACTIONS}
+        assert boot(recon, kube, harness) == POLL
+        assert harness.replica_count == 1
+
+        timeline = []
+
+        def run(ticks, load_fn):
+            for i in range(ticks):
+                harness.offer(load_fn(i))
+                tick(recon, harness, clock)
+                timeline.append({"t": clock.t, "in_flight": harness.in_flight,
+                                 "replicas": harness.replica_count})
+
+        # morning ramp: sustained pressure -> fleet grows toward max
+        run(12, lambda i: max(0, 12 - harness.in_flight))
+        peak = harness.replica_count
+        assert 3 <= peak <= 4
+
+        # afternoon trickle: cold but busy -> damped stepwise shrink,
+        # strictly drain-first (any abrupt kill shows up as error frames)
+        run(16, lambda i: 1 if i % 2 == 0 else 0)
+        assert harness.replica_count == 1
+
+        # night: fully idle past the TTL -> scale to zero
+        run(10, lambda i: 0)
+        assert harness.replica_count == 0
+        m = kube.get(API_VERSION, KIND, "default", "phi")
+        asc = m["status"]["autoscale"]
+        assert asc["sleeping"] and asc["desiredReplicas"] == 0
+
+        # dawn: demand against a sleeping fleet -> wake, serve, and
+        # (the cycle closing) drift back to sleep once idle again
+        dawn = len(timeline)
+        run(8, lambda i: 3 if i == 0 else 0)
+        assert max(e["replicas"] for e in timeline[dawn:]) >= 1
+        assert not harness.pending
+
+        assert harness.error_frames == 0
+        assert harness.completed == harness.offered
+        for action in autoscale.ACTIONS:
+            assert METRICS.get("tpu_model_autoscale_decisions_total",
+                               f'{{action="{action}"}}') > d0[action], action
+        assert ("Normal", "AutoscaleUp") in rec.events
+        assert ("Normal", "AutoscaleDrainStarted") in rec.events
+        assert ("Normal", "AutoscaleDown") in rec.events
+        assert ("Normal", "AutoscaleWake") in rec.events
+        # scale events never exceeded the configured ceiling
+        assert max(e["replicas"] for e in timeline) <= 4
+
+        out = os.environ.get("AUTOSCALE_TIMELINE")
+        if out:
+            with open(out, "w") as f:
+                json.dump(timeline, f)
+
+    def test_desired_persisted_and_readopted_across_restart(self):
+        kube, rec, harness, clock, recon = make_fleet()
+        boot(recon, kube, harness)
+        for _ in range(10):
+            harness.offer(max(0, 12 - harness.in_flight))
+            tick(recon, harness, clock)
+        assert harness.replica_count >= 2
+        m = kube.get(API_VERSION, KIND, "default", "phi")
+        persisted = m["status"]["autoscale"]["desiredReplicas"]
+        assert persisted >= 2
+
+        # "restart": a fresh reconciler with an empty Autoscaler must
+        # adopt the persisted desired count, not snap back to spec (1).
+        # The scrape outage pins the law: fail-static means the adopted
+        # count is exactly what survives.
+        clock2 = Clock()
+        recon2 = ModelReconciler(kube, rec, server_image="runtime:test",
+                                 ps_fetch=harness.ps_fetch,
+                                 drain_post=harness.drain_post,
+                                 autoscaler=Autoscaler(now=clock2))
+        FAULTS.arm("operator.scrape", "fail")
+        for _ in range(3):
+            recon2.reconcile("default", "phi")
+            harness.sync()
+        FAULTS.reset()
+        assert recon2.scaler.desired(("default", "phi")) == persisted
+        dep = kube.get("apps/v1", "Deployment", "default", harness.app)
+        assert int(dep["spec"]["replicas"]) >= persisted
+
+    @pytest.mark.chaos
+    def test_scrape_outage_fails_static(self):
+        """Chaos drill: the operator.scrape fault point takes out every
+        replica scrape. The loop must hold its last decision — no scale
+        action, no remediation — and count the holds."""
+        kube, rec, harness, clock, recon = make_fleet()
+        boot(recon, kube, harness)
+        for _ in range(8):
+            harness.offer(max(0, 12 - harness.in_flight))
+            tick(recon, harness, clock)
+        assert harness.replica_count >= 2
+        pods_before = set(harness.by_pod)
+        dep = kube.get("apps/v1", "Deployment", "default", harness.app)
+        replicas_before = int(dep["spec"]["replicas"])
+        hold0 = METRICS.get("tpu_model_autoscale_holds_total",
+                            '{cause="no_data"}')
+        rem0 = METRICS.get("tpu_model_remediation_replacements_total",
+                           '{cause="unreachable"}')
+
+        FAULTS.arm("operator.scrape", "fail")
+        for _ in range(6):
+            harness.offer(max(0, 12 - harness.in_flight))
+            tick(recon, harness, clock)
+        dep = kube.get("apps/v1", "Deployment", "default", harness.app)
+        assert int(dep["spec"]["replicas"]) == replicas_before
+        assert set(harness.by_pod) == pods_before        # nobody remediated
+        assert METRICS.get("tpu_model_autoscale_holds_total",
+                           '{cause="no_data"}') > hold0
+        assert METRICS.get("tpu_model_remediation_replacements_total",
+                           '{cause="unreachable"}') == rem0
+
+        FAULTS.reset()
+        for _ in range(4):
+            tick(recon, harness, clock)
+        assert harness.error_frames == 0
+        m = kube.get(API_VERSION, KIND, "default", "phi")
+        assert is_condition_true(m, "Available")
+
+    @pytest.mark.chaos
+    def test_drill8_replica_killed_mid_stream_is_replaced(self):
+        """Chaos drill 8: kill a replica mid-stream under autoscaling.
+        Remediation replaces it (delete -> ReplicaSet recreates, fleet
+        size never shrinks), PR 9 replay carries its in-flight streams
+        to the replacement, and the client sees zero error frames."""
+        kube, rec, harness, clock, recon = make_fleet()
+        boot(recon, kube, harness)
+        # a steady 5 streams/tick (each living 2 ticks) keeps occupancy
+        # pinned above target: the fleet grows to max and STAYS there,
+        # so no pod carries a drain mark when the kill lands
+        for _ in range(8):
+            harness.offer(5)
+            tick(recon, harness, clock)
+        assert harness.replica_count >= 2
+        fleet_size = harness.replica_count
+        rem0 = METRICS.get("tpu_model_remediation_replacements_total",
+                           '{cause="unreachable"}')
+
+        def drain_marked(pod_name):
+            p = kube.get("v1", "Pod", harness.namespace, pod_name)
+            return p is None or workload.pod_is_drain_victim(p)
+
+        victim = next(p for p, r in harness.by_pod.items()
+                      if r.active and not drain_marked(p))
+        harness.kill(victim)
+        for _ in range(6):
+            harness.offer(5)
+            tick(recon, harness, clock)
+
+        assert victim not in harness.by_pod           # replaced, not lingering
+        assert harness.replica_count >= fleet_size    # floor held
+        assert METRICS.get("tpu_model_remediation_replacements_total",
+                           '{cause="unreachable"}') == rem0 + 1
+        assert ("Warning", "ReplicaRemediated") in rec.events
+        assert harness.replayed > 0
+
+        # let everything in flight finish
+        for _ in range(6):
+            tick(recon, harness, clock)
+        assert harness.error_frames == 0
+        assert harness.completed == harness.offered
+
+    def test_all_replicas_dead_is_fail_static_not_massacre(self):
+        """Zero reachable replicas is evidence about the scrape path, not
+        the fleet: remediation must not delete anything."""
+        kube, rec, harness, clock, recon = make_fleet()
+        boot(recon, kube, harness)
+        for _ in range(8):
+            harness.offer(max(0, 12 - harness.in_flight))
+            tick(recon, harness, clock)
+        assert harness.replica_count >= 2
+        pods_before = set(harness.by_pod)
+        for p in pods_before:
+            harness.by_pod[p].alive = False
+        for _ in range(4):
+            tick(recon, harness, clock)
+        assert set(harness.by_pod) == pods_before
+
+
+# -- crash-loop remediation --------------------------------------------
+
+class TestCrashLoopRemediation:
+    SPEC = {"enabled": True, "minReplicas": 2, "maxReplicas": 4,
+            "remediationBackoffSeconds": 1, "remediationBackoffCapSeconds": 4}
+
+    def _crash_pod(self, kube, app, name):
+        return kube.create({
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": name, "namespace": "default",
+                         "labels": {"app": app}},
+            "status": {"phase": "Running", "containerStatuses": [
+                {"name": "server", "restartCount": 5,
+                 "state": {"waiting": {"reason": "CrashLoopBackOff"}}}]}})
+
+    def test_replacement_backoff_cap_and_floor(self):
+        kube, rec, harness, clock, recon = make_fleet(self.SPEC, replicas=2)
+        boot(recon, kube, harness)
+        app = harness.app
+        dep = kube.get("apps/v1", "Deployment", "default", app)
+        assert int(dep["spec"]["replicas"]) == 2
+        kube.set_status("apps/v1", "Deployment", "default", app, {
+            "replicas": 2, "readyReplicas": 1,
+            "conditions": [{"type": "ReplicaFailure", "status": "True",
+                            "message": "pods \"x\" is forbidden"}]})
+        rem0 = METRICS.get("tpu_model_remediation_replacements_total",
+                           '{cause="crash_loop"}')
+        hold0 = METRICS.get("tpu_model_remediation_backoff_holds_total")
+
+        # prime past the Available -> ReplicaFailure condition flip: the
+        # pass right after the flip restarts the ladder with a KICKOFF
+        # and never reaches the failure branch
+        recon.reconcile("default", "phi")
+        recon.reconcile("default", "phi")
+
+        expected_backoff = [1.0, 2.0, 4.0, 4.0]      # doubles, then caps
+        for i, backoff in enumerate(expected_backoff):
+            name = f"{app}-crash-{i}"
+            self._crash_pod(kube, app, name)
+            assert recon.reconcile("default", "phi") == POLL
+            assert kube.get("v1", "Pod", "default", name) is None
+            assert recon.scaler.remediation_backoff_s(
+                ("default", "phi")) == backoff
+            # inside the backoff window the next victim is NOT replaced
+            name2 = f"{app}-held-{i}"
+            self._crash_pod(kube, app, name2)
+            recon.reconcile("default", "phi")
+            assert kube.get("v1", "Pod", "default", name2) is not None
+            kube.delete("v1", "Pod", "default", name2)
+            clock.advance(backoff + 0.1)
+
+        assert METRICS.get("tpu_model_remediation_replacements_total",
+                           '{cause="crash_loop"}') == rem0 + 4
+        assert METRICS.get(
+            "tpu_model_remediation_backoff_holds_total") >= hold0 + 4
+        assert rec.events.count(("Warning", "ReplicaRemediated")) >= 4
+        # remediation deletes pods, never the Deployment: the
+        # minReplicas floor holds structurally
+        dep = kube.get("apps/v1", "Deployment", "default", app)
+        assert int(dep["spec"]["replicas"]) == 2
+
+    def test_healthy_pods_not_remediated(self):
+        kube, rec, harness, clock, recon = make_fleet(self.SPEC, replicas=2)
+        boot(recon, kube, harness)
+        app = harness.app
+        kube.set_status("apps/v1", "Deployment", "default", app, {
+            "replicas": 2, "readyReplicas": 1,
+            "conditions": [{"type": "ReplicaFailure", "status": "True",
+                            "message": "quota"}]})
+        pods = kube.list("v1", "Pod", "default", label_selector=f"app={app}")
+        assert pods
+        recon.reconcile("default", "phi")
+        assert kube.list("v1", "Pod", "default",
+                         label_selector=f"app={app}") == pods
+
+
+# -- status writes under churn ------------------------------------------
+
+@pytest.fixture()
+def http_kube():
+    fake = FakeKube()
+    httpd = serve_http(fake)
+    client = KubeClient(f"http://127.0.0.1:{httpd.server_address[1]}",
+                        timeout=5)
+    yield fake, client
+    httpd.shutdown()
+
+
+class TestStatusWriteRetry:
+    def _model(self, client, name="phi"):
+        return client.create({"apiVersion": API_VERSION, "kind": KIND,
+                              "metadata": {"name": name,
+                                           "namespace": "default"},
+                              "spec": {"image": "phi", "runtime": "cpu"}})
+
+    def test_transient_blip_is_retried(self, http_kube):
+        fake, client = http_kube
+        obj = self._model(client)
+        obj["status"] = {"autoscale": {"desiredReplicas": 3}}
+        FAULTS.arm("kube.request", "fail:once")
+        update_status_with_retry(client, obj, backoff=0.001)
+        got = fake.get(API_VERSION, KIND, "default", "phi")
+        assert got["status"]["autoscale"]["desiredReplicas"] == 3
+
+    def test_conflict_rereads_and_reapplies(self, http_kube):
+        fake, client = http_kube
+        obj = self._model(client)
+        stale = copy.deepcopy(obj)
+        # someone else bumps the resourceVersion under us (scale churn)
+        obj["metadata"]["labels"] = {"touched": "yes"}
+        client.update(obj)
+        stale["status"] = {"autoscale": {"desiredReplicas": 2}}
+        update_status_with_retry(client, stale, backoff=0.001)
+        got = fake.get(API_VERSION, KIND, "default", "phi")
+        assert got["status"]["autoscale"]["desiredReplicas"] == 2
+        assert got["metadata"]["labels"] == {"touched": "yes"}
+
+    def test_vanished_resource_is_not_an_error(self, http_kube):
+        fake, client = http_kube
+        obj = self._model(client)
+        client.delete(API_VERSION, KIND, "default", "phi")
+        obj["status"] = {"x": 1}
+        assert update_status_with_retry(client, obj,
+                                        backoff=0.001) is obj
+
+
+# -- the scrape fault point ---------------------------------------------
+
+class TestScrapeFaultPoint:
+    @pytest.mark.chaos
+    def test_fetch_replica_ps_fault_collapses_to_none(self):
+        body = json.dumps({"models": []}).encode()
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        url = f"http://127.0.0.1:{httpd.server_address[1]}/api/ps"
+        try:
+            assert fetch_replica_ps(url) == {"models": []}
+            FAULTS.arm("operator.scrape", "fail")
+            assert fetch_replica_ps(url) is None
+            FAULTS.reset()
+            assert fetch_replica_ps(url) == {"models": []}
+        finally:
+            httpd.shutdown()
