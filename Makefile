@@ -4,7 +4,7 @@ IMG ?= ghcr.io/ollama-operator-tpu/tpu-runtime:v0.1.0
 BACKEND ?= tpu
 PY ?= python
 
-.PHONY: all test test-fast lint native bench bench-smoke docker-build \
+.PHONY: all test test-fast lint lint-verbose native bench bench-smoke docker-build \
         docker-build-cpu build-installer install uninstall deploy undeploy \
         kind-e2e clean
 
@@ -19,9 +19,13 @@ test-fast:  ## operator + serving tiers only (no engine compiles)
 	$(PY) -m pytest tests/test_operator_*.py tests/test_registry.py \
 	  tests/test_modelfile.py tests/test_template.py -q
 
-lint:
+lint:  ## pyflakes (or py_compile) + the invariant linter (tools/invariant_lint)
 	$(PY) -m pyflakes ollama_operator_tpu tests 2>/dev/null || \
 	  $(PY) -m py_compile $$(git ls-files '*.py')
+	$(PY) -m tools.invariant_lint --root .
+
+lint-verbose:  ## invariant linter incl. suppressed findings + per-pass table
+	$(PY) -m tools.invariant_lint --root . --verbose
 
 # (grammar otherwise builds lazily at the first format:"json" request —
 # a latency spike)

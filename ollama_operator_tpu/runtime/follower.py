@@ -137,6 +137,10 @@ class ControlPlane:
         while not self._hb_stop.wait(self.heartbeat_s):
             try:
                 with self.dispatch_lock:
+                    # the heartbeat rides the same FIFO stream as
+                    # mirrored ops — holding dispatch_lock across the
+                    # send IS the ordering guarantee
+                    # lint: allow(lock-order): FIFO heartbeat send by design
                     self.broadcast(("ping",))
             except FollowerLost:
                 return          # degraded is set; nothing left to probe
@@ -164,6 +168,9 @@ class ControlPlane:
             for c in list(self._conns):
                 try:
                     FAULTS.check("follower.send")
+                    # serialising sends under _lock is the point — the
+                    # per-follower byte streams must not interleave
+                    # lint: allow(lock-order): frame send serialised by design
                     _send(c, msg)
                 except (OSError, InjectedFault) as e:
                     try:
@@ -300,6 +307,7 @@ def run_follower(manager, host: str, port: int,
                 # replaying them (incl. their page-table side effects)
                 # keeps host state in lockstep; anything else will show
                 # up here loudly and then desync visibly
+                # lint: allow(follower-purity): own per-process flight ring — local diagnosis, never broadcast back
                 FLIGHT.record("replay_error", method=method,
                               error=f"{type(e).__name__}: {e}"[:200])
                 log(f"replayed {method} raised {type(e).__name__}: {e}")

@@ -104,7 +104,7 @@ class _OwnedStream:
     def __del__(self):
         try:
             self.close()
-        except Exception:  # noqa: BLE001 — never raise from GC
+        except Exception:  # lint: allow(exception-hygiene): never raise from GC
             pass
 
 
@@ -752,6 +752,7 @@ class LoadedModel:
             with cp.dispatch_lock:
                 if self._unloaded:
                     raise RuntimeError("model unloaded")
+                # lint: allow(lock-order): broadcast under dispatch_lock keeps FIFO replay order
                 cp.broadcast(("lm_call", "embed", (list(texts),)))
                 dispatch()
         return np.stack(outs)
@@ -774,6 +775,7 @@ class LoadedModel:
             # refuse instead of dispatching into a dead world
             with self.control_plane.dispatch_lock:
                 self._unloaded = True
+                # lint: allow(lock-order): unload must be FIFO-after the last mirrored call
                 self.control_plane.broadcast(("unload",))
         METRICS.remove_gauge("tpu_model_active_slots")
         METRICS.remove_gauge("tpu_model_queue_depth")

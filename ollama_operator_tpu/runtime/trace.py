@@ -232,8 +232,8 @@ class FlightRecorder:
                 out.write(json.dumps(ev, default=str) + "\n")
             out.write(f"--- end flight recorder dump: {reason} ---\n")
             out.flush()
-        except Exception:
-            pass  # a broken stderr must never mask the original failure
+        except Exception:  # lint: allow(exception-hygiene): a broken stderr must never mask the original failure
+            pass
         return len(evs)
 
 

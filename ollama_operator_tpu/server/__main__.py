@@ -260,7 +260,7 @@ def main(argv=None):
         try:
             with control_plane.dispatch_lock:
                 control_plane.broadcast(("shutdown",))
-        except Exception:  # noqa: BLE001 — follower already gone
+        except Exception:  # lint: allow(exception-hygiene): follower already gone
             pass
         control_plane.close()
     manager.shutdown()

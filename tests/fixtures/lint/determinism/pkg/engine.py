@@ -1,0 +1,20 @@
+"""determinism fixture: wall clock, process entropy, unsorted set
+iteration — plus the allowed forms."""
+
+import random
+import time
+
+PAGES = set([3, 1, 2])
+
+
+def replayed():
+    t = time.time()
+    r = random.random()
+    for x in {1, 2}:
+        pass
+    for y in PAGES:
+        pass
+    for z in sorted(PAGES):
+        pass
+    ok = time.monotonic()
+    return t, r, ok

@@ -1,0 +1,29 @@
+"""exception-hygiene fixture: bare except, silent swallow, justified
+suppression, reasonless suppression, and a legal narrow handler."""
+
+
+def g():
+    raise ValueError("boom")
+
+
+def f():
+    try:
+        g()
+    except:
+        pass
+    try:
+        g()
+    except Exception:
+        pass
+    try:
+        g()
+    except Exception:  # lint: allow(exception-hygiene): fixture-justified teardown
+        pass
+    try:
+        g()
+    except Exception:  # lint: allow(exception-hygiene)
+        pass
+    try:
+        g()
+    except ValueError:
+        pass

@@ -1,0 +1,17 @@
+"""follower-purity fixture: a handler reaching a forbidden singleton
+through a helper."""
+
+FLIGHT = None
+
+
+def run_follower(sock):
+    while True:
+        handle_op(sock)
+
+
+def handle_op(sock):
+    FLIGHT.record("replay_error")
+
+
+def unrelated():
+    FLIGHT.record("fine here — not reachable from the handler")

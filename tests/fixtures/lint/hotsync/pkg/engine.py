@@ -1,0 +1,26 @@
+"""host-sync fixture: violations in the hot call graph, a sanctioned
+wait boundary, a suppressed site, and a cold function that must NOT be
+flagged."""
+
+import numpy as np
+
+
+class Engine:
+    def decode_n_launch(self):
+        self._helper()
+        return Handle()
+
+    def _helper(self):
+        a = self.scalar.item()
+        b = np.asarray(self.buf)
+        c = int(self.lengths[0])
+        d = self.arr.block_until_ready()  # lint: allow(host-sync-hot-path): fixture exercises suppression
+        return a, b, c, d
+
+    def cold(self):
+        return self.scalar.item()
+
+
+class Handle:
+    def wait(self):
+        return self.fut.item()

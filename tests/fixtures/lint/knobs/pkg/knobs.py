@@ -1,0 +1,9 @@
+"""Fixture knob registry: one live declaration, one stale."""
+
+
+def declare(name, type, default, subsystem, doc):
+    return name
+
+
+declare("TPU_FIX_A", "bool", 1, "fixture", "declared and read")
+declare("TPU_FIX_STALE", "int", 0, "fixture", "declared, never mentioned")

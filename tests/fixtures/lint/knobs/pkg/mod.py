@@ -1,0 +1,10 @@
+"""knob-registry fixture: one declared read, one undeclared read, one
+suppressed undeclared read."""
+
+import os
+
+DECLARED = os.environ.get("TPU_FIX_A", "1")
+
+UNDECLARED = os.environ["TPU_FIX_B"]
+
+SUPPRESSED = os.getenv("TPU_FIX_SUPP", "")  # lint: allow(knob-registry): fixture exercises suppression

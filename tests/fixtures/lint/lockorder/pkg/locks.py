@@ -1,0 +1,47 @@
+"""lock-order fixture: an ABBA cycle, direct and transitive blocking
+calls under a lock, and a legal RLock re-entry."""
+
+import threading
+import time
+
+
+class A:
+    def __init__(self):
+        self._la = threading.Lock()
+        self.sock = None
+
+    def one(self, b):
+        with self._la:
+            with b._lb:
+                pass
+
+    def sleepy(self):
+        with self._la:
+            time.sleep(1)
+
+    def indirect(self):
+        with self._la:
+            self._push()
+
+    def _push(self):
+        self.sock.sendall(b"x")
+
+
+class B:
+    def __init__(self):
+        self._lb = threading.Lock()
+
+    def two(self, a):
+        with self._lb:
+            with a._la:
+                pass
+
+
+class R:
+    def __init__(self):
+        self._lr = threading.RLock()
+
+    def reenter(self):
+        with self._lr:
+            with self._lr:
+                pass

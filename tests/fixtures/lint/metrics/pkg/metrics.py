@@ -1,0 +1,21 @@
+"""Fixture metric registry: describes + pre-seeds two families."""
+
+
+class Metrics:
+    def describe(self, name, text):
+        pass
+
+    def inc(self, name, value=1.0, labels=""):
+        pass
+
+
+GLOBAL = Metrics()
+
+GLOBAL.describe("tpu_model_fix_ok_total", "plain counter")
+GLOBAL.describe("tpu_model_fix_labeled_total", "labeled counter")
+
+for _n in ("tpu_model_fix_ok_total",):
+    GLOBAL.inc(_n, 0.0)
+
+for _cause in ("a", "b"):
+    GLOBAL.inc("tpu_model_fix_labeled_total", 0.0, f'{{cause="{_cause}"}}')

@@ -1,0 +1,11 @@
+"""metrics-discipline fixture: good increments, an undescribed+unseeded
+family, and a label-key-set mismatch."""
+
+from .metrics import GLOBAL
+
+
+def record(cause):
+    GLOBAL.inc("tpu_model_fix_ok_total")
+    GLOBAL.inc("tpu_model_fix_labeled_total", 1.0, f'{{cause="{cause}"}}')
+    GLOBAL.inc("tpu_model_fix_missing_total")
+    GLOBAL.inc("tpu_model_fix_labeled_total", 1.0, f'{{other="{cause}"}}')

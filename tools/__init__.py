@@ -1,0 +1,1 @@
+# repo-local developer tooling; `python -m tools.invariant_lint` needs this

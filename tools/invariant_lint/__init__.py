@@ -1,0 +1,10 @@
+"""Invariant linter: project-specific AST passes enforcing the runtime's
+hand-maintained invariants at diff time (see core.py for the model and
+passes/ for the catalog).  Run with ``python -m tools.invariant_lint``
+or ``make lint``."""
+
+from .core import Finding, LintConfig, Pass, Project, run_passes
+from .passes import ALL_PASSES
+
+__all__ = ["Finding", "LintConfig", "Pass", "Project", "run_passes",
+           "ALL_PASSES"]

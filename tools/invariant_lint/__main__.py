@@ -1,0 +1,78 @@
+"""CLI: ``python -m tools.invariant_lint [options]``.
+
+Exit code 0 when every finding is suppressed (or none exist), 1
+otherwise — `make lint` and the static-analysis CI job gate on it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from .core import (LintConfig, render_github, render_json,
+                   render_summary_markdown, render_text, run_passes,
+                   summarize)
+from .passes import ALL_PASSES
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="invariant_lint",
+        description="Project invariant linter (7 AST passes; see "
+                    "CONTRIBUTING.md 'Invariant linter')")
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: two levels above this "
+                         "package)")
+    ap.add_argument("--format", choices=("text", "json", "github"),
+                    default="text")
+    ap.add_argument("--only", default=None,
+                    help="comma-separated pass ids to run")
+    ap.add_argument("--verbose", action="store_true",
+                    help="text format: include suppressed findings")
+    ap.add_argument("--summary", default=None, metavar="FILE",
+                    help="append a per-pass markdown summary table "
+                         "(GitHub job summary)")
+    ap.add_argument("--list-passes", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_passes:
+        for p in ALL_PASSES:
+            print(f"{p.id:22s} {p.summary}")
+        return 0
+
+    root = Path(args.root) if args.root else \
+        Path(__file__).resolve().parents[2]
+    config = LintConfig(root=root)
+    only = args.only.split(",") if args.only else None
+    findings = run_passes(config, ALL_PASSES, only=only)
+
+    if args.format == "json":
+        print(render_json(ALL_PASSES, findings))
+    elif args.format == "github":
+        out = render_github(findings)
+        if out:
+            print(out)
+    else:
+        out = render_text(findings, verbose=args.verbose)
+        if out:
+            print(out)
+
+    rows = summarize(ALL_PASSES, findings)
+    unsuppressed = sum(r["findings"] for r in rows)
+    suppressed = sum(r["suppressed"] for r in rows)
+    if args.format == "text":
+        print(f"invariant-lint: {unsuppressed} finding(s), "
+              f"{suppressed} suppressed, "
+              f"{len([r for r in rows if r['id'] not in ('suppression', 'parse')])} passes",
+              file=sys.stderr)
+
+    if args.summary:
+        with open(args.summary, "a", encoding="utf-8") as f:
+            f.write(render_summary_markdown(ALL_PASSES, findings) + "\n")
+
+    return 1 if unsuppressed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

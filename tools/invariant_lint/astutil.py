@@ -1,0 +1,191 @@
+"""Shared AST helpers: function indexing and name-based call resolution.
+
+The passes that reason about call graphs (host-sync-hot-path, lock-order)
+resolve calls *by name*: ``self.foo()`` or ``x.foo()`` reaches every
+function/method named ``foo`` defined in the analyzed scope.  That is
+deliberately conservative — Python offers no static dispatch — and works
+well here because the runtime uses distinct method names for distinct
+roles.  Receivers that are clearly library modules (np/jax/os/...) are
+excluded so the graph doesn't absorb library internals.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+# receivers that are library namespaces, never project objects
+IGNORED_RECEIVERS = {
+    "np", "jnp", "jax", "numpy", "os", "time", "math", "re", "json",
+    "threading", "queue", "struct", "pickle", "socket", "sys", "logging",
+    "itertools", "functools", "collections", "random", "dataclasses",
+    "weakref", "http", "urllib", "subprocess", "signal", "ast",
+}
+
+# Method names shared with builtin containers/IO objects.  A call like
+# ``self._rules.pop(...)`` or ``c.close()`` on a non-self receiver is
+# overwhelmingly a dict/list/socket operation; resolving it by bare name
+# to a runtime class's ``pop``/``close`` manufactures call edges (and
+# with them lock-order cycles) that don't exist.  Non-self attribute
+# calls with these names are therefore not resolved.
+GENERIC_METHODS = {
+    "get", "pop", "popitem", "setdefault", "clear", "remove", "discard",
+    "append", "appendleft", "extend", "add", "update", "insert", "index",
+    "count", "sort", "reverse", "copy", "items", "keys", "values",
+    "close", "open", "read", "write", "flush", "send", "recv", "put",
+    "join", "wait", "set", "start", "cancel", "done", "empty", "full",
+    "qsize", "acquire", "release",
+}
+
+FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    rel: str                    # module path, repo-relative
+    name: str                   # bare function/method name
+    qualname: str               # Class.method or module-level name
+    cls: Optional[str]          # enclosing class name, if a method
+    node: ast.AST               # the FunctionDef
+
+
+def index_functions(sources: Dict[str, "object"],
+                    scope_rels: List[str]) -> Dict[str, List[FuncInfo]]:
+    """name -> FuncInfos for every top-level function and class method in
+    the given modules.  Nested defs are NOT indexed (in this codebase
+    they are overwhelmingly jit-traced device code)."""
+    index: Dict[str, List[FuncInfo]] = {}
+    for rel in scope_rels:
+        src = sources[rel]
+        for node in src.tree.body:
+            if isinstance(node, FUNC_NODES):
+                fi = FuncInfo(rel, node.name, node.name, None, node)
+                index.setdefault(node.name, []).append(fi)
+            elif isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, FUNC_NODES):
+                        fi = FuncInfo(rel, sub.name,
+                                      f"{node.name}.{sub.name}",
+                                      node.name, sub)
+                        index.setdefault(sub.name, []).append(fi)
+    return index
+
+
+def own_statements(func: ast.AST) -> Iterator[ast.AST]:
+    """Walk a function's body, skipping nested function/class defs (jit
+    bodies trace on-device; a host-sync primitive there is tracing, not
+    a sync).  Lambdas ARE included — they run host-side when called."""
+    stack: List[ast.AST] = list(getattr(func, "body", []))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, FUNC_NODES + (ast.ClassDef,)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def receiver_root(expr: ast.AST) -> Optional[str]:
+    """Leftmost name of an attribute chain: a.b.c -> 'a'."""
+    while isinstance(expr, ast.Attribute):
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return None
+
+
+def callee_name(call: ast.Call) -> Tuple[Optional[str], Optional[str]]:
+    """(name, receiver_root) of a call.  receiver_root is None for bare
+    calls; library receivers return (None, root) so the caller skips."""
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id, None
+    if isinstance(f, ast.Attribute):
+        root = receiver_root(f.value)
+        if root in IGNORED_RECEIVERS:
+            return None, root
+        return f.attr, root
+    return None, None
+
+
+def calls_in(func: ast.AST) -> Iterator[ast.Call]:
+    for node in own_statements(func):
+        if isinstance(node, ast.Call):
+            yield node
+
+
+def resolve_call(call: ast.Call, enclosing_cls: Optional[str],
+                 index: Dict[str, List[FuncInfo]]) -> List[FuncInfo]:
+    """Candidate targets of a call, name-resolved with three precision
+    tiers:
+
+    - ``helper()`` (bare name) — every indexed function of that name;
+    - ``self.foo()`` — the enclosing class's own ``foo`` when it defines
+      one, else the name-wide candidates if they all live on one class;
+    - ``obj.foo()`` — skipped for library receivers and
+      GENERIC_METHODS names; otherwise resolved only when every
+      candidate lives on the same class (an ambiguous name like a
+      ``pop`` defined on two classes yields nothing — a deliberate
+      under-approximation that keeps the lock graph honest).
+    """
+    f = call.func
+    if isinstance(f, ast.Name):
+        return list(index.get(f.id, ()))
+    if not isinstance(f, ast.Attribute):
+        return []
+    cands = index.get(f.attr, ())
+    if not cands:
+        return []
+    if isinstance(f.value, ast.Name) and f.value.id == "self":
+        if enclosing_cls is not None:
+            own = [fi for fi in cands if fi.cls == enclosing_cls]
+            if own:
+                return own
+    else:
+        root = receiver_root(f.value)
+        if root in IGNORED_RECEIVERS or f.attr in GENERIC_METHODS:
+            return []
+    classes = {fi.cls for fi in cands}
+    if len(classes) == 1:
+        return list(cands)
+    return []
+
+
+def reachable(index: Dict[str, List[FuncInfo]],
+              roots: List[FuncInfo],
+              stop_names: Set[str]) -> List[FuncInfo]:
+    """BFS over the name-resolved call graph.  ``stop_names`` are
+    traversed-to but not through (sanctioned boundaries)."""
+    seen: Set[int] = set()
+    order: List[FuncInfo] = []
+    work = list(roots)
+    while work:
+        fi = work.pop()
+        if id(fi.node) in seen:
+            continue
+        seen.add(id(fi.node))
+        order.append(fi)
+        if fi.name in stop_names:
+            continue
+        for call in calls_in(fi.node):
+            for target in resolve_call(call, fi.cls, index):
+                if id(target.node) not in seen:
+                    work.append(target)
+    return order
+
+
+def fstring_static_text(node: ast.AST) -> Optional[str]:
+    """The constant parts of a string literal or f-string, or None when
+    the node is not string-like.  Used to extract label KEYS (static)
+    from label strings whose VALUES are interpolated."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for v in node.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                parts.append(v.value)
+            else:
+                parts.append("\x00")      # interpolation marker
+        return "".join(parts)
+    return None

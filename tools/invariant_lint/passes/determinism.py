@@ -1,0 +1,131 @@
+"""determinism: replay-relevant modules stay bit-identical across runs.
+
+PR 9's restart recovery replays in-flight streams bit-identically; the
+follower (PR 1) replays the leader's whole call stream.  Both depend on
+the engine/follower modules being deterministic functions of their call
+arguments.  Flagged here:
+
+- ``time.time()`` — wall clock differs across processes and restarts
+  (``time.monotonic`` for durations/metrics is fine: it never feeds
+  token or page decisions);
+- stdlib ``random.*`` / ``np.random.*`` — per-process entropy
+  (``jax.random`` is keyed and explicitly derived, always allowed);
+- iteration over *sets* of slots/pages/signatures without ``sorted()``
+  — set iteration order is salted per process, so a loop over a set
+  that touches device state replays in a different order on the
+  follower.  Detected for set literals, ``set(...)`` calls, set
+  comprehensions, and attributes assigned from them.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from ..astutil import receiver_root
+from ..core import Finding, Pass, Project
+
+
+class DeterminismPass(Pass):
+    id = "determinism"
+    summary = ("no wall-clock/process entropy/unsorted set iteration in "
+               "replay-relevant modules")
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for rel in project.config.determinism_modules:
+            src = project.source(rel)
+            if src is None:
+                continue
+            set_names = self._set_names(src.tree)
+            for node in ast.walk(src.tree):
+                if isinstance(node, ast.Call):
+                    msg = self._call_violation(node)
+                    if msg:
+                        findings.append(Finding(rel, node.lineno,
+                                                self.id, msg))
+                elif isinstance(node, (ast.For, ast.AsyncFor)):
+                    msg = self._iter_violation(node.iter, set_names)
+                    if msg:
+                        findings.append(Finding(rel, node.lineno,
+                                                self.id, msg))
+                elif isinstance(node, (ast.ListComp, ast.SetComp,
+                                       ast.GeneratorExp, ast.DictComp)):
+                    for gen in node.generators:
+                        msg = self._iter_violation(gen.iter, set_names)
+                        if msg:
+                            findings.append(Finding(rel, node.lineno,
+                                                    self.id, msg))
+        return findings
+
+    @staticmethod
+    def _set_names(tree: ast.AST) -> Set[str]:
+        """Bare/attr names assigned from set constructors anywhere in
+        the module (tracked by terminal name only)."""
+        names: Set[str] = set()
+
+        def is_set_expr(v: ast.AST) -> bool:
+            if isinstance(v, (ast.Set, ast.SetComp)):
+                return True
+            if isinstance(v, ast.Call) and isinstance(v.func, ast.Name):
+                return v.func.id in ("set", "frozenset")
+            return False
+
+        for node in ast.walk(tree):
+            targets = []
+            if isinstance(node, ast.Assign) and is_set_expr(node.value):
+                targets = node.targets
+            elif (isinstance(node, ast.AnnAssign) and node.value is not None
+                  and is_set_expr(node.value)):
+                targets = [node.target]
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+                elif isinstance(t, ast.Attribute):
+                    names.add(t.attr)
+        return names
+
+    @staticmethod
+    def _call_violation(call: ast.Call) -> str:
+        f = call.func
+        if isinstance(f, ast.Attribute):
+            root = receiver_root(f.value)
+            if f.attr == "time" and root == "time":
+                return ("time.time() is wall clock — replay across "
+                        "restart/follower diverges; use call arguments "
+                        "or time.monotonic for durations")
+            if root in ("random",):
+                return (f"stdlib random.{f.attr} is per-process entropy "
+                        f"— use jax.random with an explicit key")
+            if (isinstance(f.value, ast.Attribute)
+                    and f.value.attr == "random"
+                    and receiver_root(f.value) in ("np", "numpy")):
+                return (f"np.random.{f.attr} is per-process entropy — "
+                        f"use jax.random with an explicit key")
+        return ""
+
+    @staticmethod
+    def _iter_violation(it: ast.AST, set_names: Set[str]) -> str:
+        def describe(expr: ast.AST) -> str:
+            if isinstance(expr, ast.Set):
+                return "a set literal"
+            if isinstance(expr, ast.SetComp):
+                return "a set comprehension"
+            if (isinstance(expr, ast.Call)
+                    and isinstance(expr.func, ast.Name)
+                    and expr.func.id == "set"):
+                return "set(...)"
+            name = None
+            if isinstance(expr, ast.Name):
+                name = expr.id
+            elif isinstance(expr, ast.Attribute):
+                name = expr.attr
+            if name in set_names:
+                return f"the set {name!r}"
+            return ""
+
+        what = describe(it)
+        if what:
+            return (f"iteration over {what} is salted per process — "
+                    f"wrap in sorted() so replay order is deterministic")
+        return ""

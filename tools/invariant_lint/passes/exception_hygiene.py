@@ -1,0 +1,71 @@
+"""exception-hygiene: no silently swallowed exceptions.
+
+PR 2 made the serving path crash-only — failures are supposed to reach
+the supervisor, the flight recorder, or a typed error, never vanish.
+In ``runtime/``, ``server/`` and ``operator/``:
+
+- a bare ``except:`` is always an error (it eats KeyboardInterrupt and
+  SystemExit too);
+- ``except Exception:`` (or ``BaseException``) whose body only
+  ``pass``/``continue``-es requires a justified inline suppression —
+  best-effort teardown is legitimate, but the reason must be written
+  down at the site.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..core import Finding, Pass, Project
+
+BROAD = ("Exception", "BaseException")
+
+
+def _is_broad(type_node) -> bool:
+    if type_node is None:
+        return True
+    if isinstance(type_node, ast.Name):
+        return type_node.id in BROAD
+    if isinstance(type_node, ast.Tuple):
+        return any(_is_broad(e) for e in type_node.elts)
+    return False
+
+
+def _swallows(body) -> bool:
+    for stmt in body:
+        if isinstance(stmt, (ast.Pass, ast.Continue)):
+            continue
+        if (isinstance(stmt, ast.Expr)
+                and isinstance(stmt.value, ast.Constant)):
+            continue            # docstring / ellipsis
+        return False
+    return True
+
+
+class ExceptionHygienePass(Pass):
+    id = "exception-hygiene"
+    summary = ("no bare except; swallowed broad excepts need a "
+               "justified suppression")
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for rel, src in project.sources.items():
+            if not project.in_scope(rel, project.config.exception_scopes):
+                continue
+            for node in ast.walk(src.tree):
+                if not isinstance(node, ast.ExceptHandler):
+                    continue
+                if node.type is None:
+                    findings.append(Finding(
+                        rel, node.lineno, self.id,
+                        "bare except: swallows KeyboardInterrupt/"
+                        "SystemExit — catch a typed exception"))
+                elif _is_broad(node.type) and _swallows(node.body):
+                    findings.append(Finding(
+                        rel, node.lineno, self.id,
+                        "except Exception with an empty body swallows "
+                        "failures silently — narrow it, handle it, or "
+                        "justify with # lint: allow(exception-hygiene): "
+                        "<why>"))
+        return findings

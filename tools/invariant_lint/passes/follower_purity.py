@@ -1,0 +1,67 @@
+"""follower-purity: broadcast op handlers touch no host-only singletons.
+
+PR 7 pinned it in a docstring; this pass pins it in CI: the follower's
+broadcast-replay loop (``run_follower`` and everything it calls inside
+``runtime/follower.py``) must not touch host-only singletons — the
+flight recorder, tracers, admission policy state, the metrics registry.
+Followers replay the leader's call stream; anything keyed to leader-side
+wall-clock or policy state would desynchronise the replay, and
+flight-recorder events must never enter the broadcast stream.
+
+A follower recording into its *own* per-process ring is legitimate
+observability — that one site carries an inline suppression saying so,
+which is exactly the invariant made reviewable.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..astutil import FUNC_NODES, callee_name, index_functions, reachable
+from ..core import Finding, Pass, Project
+
+
+class FollowerPurityPass(Pass):
+    id = "follower-purity"
+    summary = ("broadcast op handlers must not touch FLIGHT/Tracer/"
+               "admission/metrics singletons")
+
+    def run(self, project: Project) -> List[Finding]:
+        cfg = project.config
+        src = project.source(cfg.follower_module)
+        if src is None:
+            return []
+        index = index_functions(project.sources, [cfg.follower_module])
+        roots = [fi for name in cfg.follower_handlers
+                 for fi in index.get(name, ())]
+        handlers = reachable(index, roots, set())
+
+        forbidden = set(cfg.follower_forbidden)
+        findings: List[Finding] = []
+        for fi in handlers:
+            for node in ast.walk(fi.node):
+                if isinstance(node, FUNC_NODES + (ast.ClassDef,)):
+                    if node is not fi.node:
+                        continue
+                name = None
+                if isinstance(node, ast.Name):
+                    name = node.id
+                elif isinstance(node, ast.Attribute):
+                    name = node.attr
+                if name in forbidden:
+                    findings.append(Finding(
+                        fi.rel, node.lineno, self.id,
+                        f"broadcast handler {fi.qualname} touches "
+                        f"host-only singleton {name} — policy/"
+                        f"observability state must never enter the "
+                        f"follower replay path"))
+        # dedup attribute+name double hits on the same reference
+        seen = set()
+        out = []
+        for f in findings:
+            key = (f.path, f.line, f.message)
+            if key not in seen:
+                seen.add(key)
+                out.append(f)
+        return out

@@ -1,0 +1,87 @@
+"""host-sync-hot-path: no device->host sync inside the dispatch path.
+
+The dispatch-critical call graph — rooted at ``engine.decode_n_launch``,
+``engine.step``, and ``scheduler._fanout`` — must never synchronise with
+the device: a ``.item()``, ``jax.device_get``, ``block_until_ready``, or
+``np.asarray`` on a device array stalls the double-buffered pipeline
+(PR 3/PR 5) and shows up as the dispatch-overhead cliffs BENCH_r05
+recorded.  ``DecodeHandle.wait`` is THE sanctioned materialisation point
+and bounds the traversal (``hot_stop_names``).
+
+Flagged inside the reachable graph (each function's own statements only;
+nested defs are jit-traced device code):
+
+- ``x.item()``
+- ``jax.device_get(...)`` / bare ``device_get``
+- ``x.block_until_ready()`` / ``jax.block_until_ready(x)``
+- ``np.asarray(...)`` — a transfer when the argument lives on device;
+  suppress with a reason when the argument is provably host data
+- ``float(x[i])`` / ``int(x[i])`` — the classic device-scalar read
+
+Name resolution is conservative (see astutil); when a finding is a
+false positive because the data is host-side, the suppression reason
+documents exactly that, which is the invariant made visible.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..astutil import (calls_in, callee_name, index_functions,
+                       own_statements, reachable, receiver_root)
+from ..core import Finding, Pass, Project
+
+
+class HostSyncPass(Pass):
+    id = "host-sync-hot-path"
+    summary = ("no .item()/device_get/block_until_ready/np.asarray/"
+               "scalar reads in the dispatch-critical call graph")
+
+    def run(self, project: Project) -> List[Finding]:
+        cfg = project.config
+        scope = [rel for rel in project.sources
+                 if project.in_scope(rel, cfg.graph_scopes)]
+        index = index_functions(project.sources, scope)
+        roots = []
+        for rel, name in cfg.hot_roots:
+            roots.extend(fi for fi in index.get(name, ())
+                         if fi.rel == rel)
+        hot = reachable(index, roots, set(cfg.hot_stop_names))
+
+        findings: List[Finding] = []
+        for fi in hot:
+            if fi.name in cfg.hot_stop_names:
+                continue        # the sanctioned sync boundary itself
+            for node in own_statements(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                msg = self._violation(node)
+                if msg:
+                    findings.append(Finding(
+                        fi.rel, node.lineno, self.id,
+                        f"{msg} in dispatch hot path "
+                        f"({fi.qualname}, reachable from "
+                        f"{'/'.join(r for _m, r in cfg.hot_roots)})"))
+        return findings
+
+    @staticmethod
+    def _violation(call: ast.Call) -> str:
+        f = call.func
+        if isinstance(f, ast.Attribute):
+            root = receiver_root(f.value)
+            if f.attr == "item" and not call.args:
+                return "host sync .item()"
+            if f.attr == "block_until_ready":
+                return "host sync block_until_ready"
+            if f.attr == "device_get":
+                return "host transfer device_get"
+            if f.attr == "asarray" and root in ("np", "numpy"):
+                return "host transfer np.asarray"
+        elif isinstance(f, ast.Name):
+            if f.id == "device_get":
+                return "host transfer device_get"
+            if (f.id in ("float", "int") and len(call.args) == 1
+                    and isinstance(call.args[0], ast.Subscript)):
+                return f"device-scalar read {f.id}(x[...])"
+        return ""

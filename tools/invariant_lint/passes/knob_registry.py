@@ -1,0 +1,173 @@
+"""knob-registry: every TPU_* env var read must be declared once.
+
+``runtime/knobs.py`` is the single declaration point for every ``TPU_*``
+environment variable (name, type, default, subsystem, one-line doc).
+This pass cross-checks three surfaces:
+
+- **undeclared read** — code reads a ``TPU_*`` env var that knobs.py
+  does not declare (the 88-read-vs-76-documented drift this PR closes);
+- **stale declaration** — knobs.py declares a knob no code mentions;
+- **undocumented knob** — a declared knob is absent from a docs tree's
+  knob tables (docs/en AND docs/zh-CN must both list every knob);
+- **stray docs knob** — the docs mention a ``TPU_*`` name that is not
+  declared (e.g. a renamed or removed knob the tables kept).
+
+Reads are detected structurally (``os.environ.get("TPU_X")``,
+``os.environ["TPU_X"]``, ``os.getenv``, dict-style ``e.get`` on an env
+mapping); the stale check is deliberately looser — any literal mention
+in code keeps a declaration alive — so indirection like
+``arm_from_env(env="TPU_FAULTS")`` doesn't false-positive.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Set, Tuple
+
+from ..core import Finding, Pass, Project
+
+ENV_GETTERS = {"get", "getenv", "pop", "setdefault"}
+
+
+class KnobRegistryPass(Pass):
+    id = "knob-registry"
+    summary = ("TPU_* env reads declared in runtime/knobs.py and listed "
+               "in both docs knob tables")
+
+    def run(self, project: Project) -> List[Finding]:
+        cfg = project.config
+        prefix = cfg.knob_prefix
+        # Lookbehind keeps substrings of longer identifiers out —
+        # OLLAMA_TPU_KERNELS is not a mention of TPU_KERNELS.
+        knob_re = re.compile(
+            rf"(?<![A-Z0-9_]){re.escape(prefix)}[A-Z0-9_]*[A-Z0-9]")
+        findings: List[Finding] = []
+
+        declared = self._declarations(project)
+        if not declared:
+            src = project.source(cfg.knobs_module)
+            findings.append(Finding(
+                cfg.knobs_module, 1, self.id,
+                "knob registry is missing or declares nothing"
+                if src is None else
+                "no declare(...) calls found in the knob registry"))
+            declared = {}
+
+        reads, mentions = self._scan_code(project, knob_re)
+
+        for name, sites in sorted(reads.items()):
+            if name not in declared:
+                rel, line = sites[0]
+                findings.append(Finding(
+                    rel, line, self.id,
+                    f"{name} is read here but not declared in "
+                    f"{cfg.knobs_module} — declare(name, type, default, "
+                    f"subsystem, doc) it first"))
+
+        for name, line in sorted(declared.items()):
+            if name not in mentions:
+                findings.append(Finding(
+                    cfg.knobs_module, line, self.id,
+                    f"{name} is declared but no code mentions it — "
+                    f"remove the stale declaration"))
+
+        docs = self._docs_mentions(project, knob_re)
+        for root, (mentioned, _sites) in docs.items():
+            for name, line in sorted(declared.items()):
+                if name not in mentioned:
+                    findings.append(Finding(
+                        cfg.knobs_module, line, self.id,
+                        f"{name} is declared but missing from the "
+                        f"{root} knob tables"))
+        for root, (_mentioned, sites) in docs.items():
+            for name, (rel, line) in sorted(sites.items()):
+                if name not in declared:
+                    findings.append(Finding(
+                        rel, line, self.id,
+                        f"docs mention {name} but {project.config.knobs_module} "
+                        f"does not declare it — stale or misspelled knob"))
+        return findings
+
+    # -- declarations ---------------------------------------------------
+
+    def _declarations(self, project: Project) -> Dict[str, int]:
+        src = project.source(project.config.knobs_module)
+        if src is None:
+            return {}
+        out: Dict[str, int] = {}
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            name = f.id if isinstance(f, ast.Name) else (
+                f.attr if isinstance(f, ast.Attribute) else None)
+            if name != "declare" or not node.args:
+                continue
+            first = node.args[0]
+            if isinstance(first, ast.Constant) and isinstance(first.value,
+                                                             str):
+                out[first.value] = node.lineno
+        return out
+
+    # -- code reads & mentions ------------------------------------------
+
+    def _scan_code(self, project: Project, knob_re) -> Tuple[
+            Dict[str, List[Tuple[str, int]]], Set[str]]:
+        cfg = project.config
+        reads: Dict[str, List[Tuple[str, int]]] = {}
+        mentions: Set[str] = set()
+        for rel, src in project.sources.items():
+            if rel == cfg.knobs_module:
+                continue
+            for m in knob_re.finditer(src.text):
+                mentions.add(m.group(0))
+            for node in ast.walk(src.tree):
+                for name, line in self._env_reads(node, knob_re):
+                    reads.setdefault(name, []).append((rel, line))
+        return reads, mentions
+
+    def _env_reads(self, node: ast.AST, knob_re):
+        def literal(arg):
+            if (isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)
+                    and knob_re.fullmatch(arg.value)):
+                return arg.value
+            return None
+
+        if isinstance(node, ast.Call):
+            f = node.func
+            getter = (f.attr if isinstance(f, ast.Attribute)
+                      else f.id if isinstance(f, ast.Name) else None)
+            if getter in ENV_GETTERS and node.args:
+                name = literal(node.args[0])
+                if name:
+                    yield name, node.lineno
+        elif isinstance(node, ast.Subscript):
+            if isinstance(getattr(node, "ctx", None), ast.Load):
+                name = literal(node.slice)
+                if name:
+                    yield name, node.lineno
+
+    # -- docs -----------------------------------------------------------
+
+    def _docs_mentions(self, project: Project, knob_re) -> Dict[
+            str, Tuple[Set[str], Dict[str, Tuple[str, int]]]]:
+        out: Dict[str, Tuple[Set[str], Dict[str, Tuple[str, int]]]] = {}
+        for root in project.config.docs_roots:
+            base = project.config.root / root
+            mentioned: Set[str] = set()
+            sites: Dict[str, Tuple[str, int]] = {}
+            if base.is_dir():
+                for md in sorted(base.rglob("*.md")):
+                    rel = md.relative_to(project.config.root).as_posix()
+                    try:
+                        text = md.read_text(encoding="utf-8")
+                    except UnicodeDecodeError:
+                        continue
+                    for i, line in enumerate(text.splitlines(), start=1):
+                        for m in knob_re.finditer(line):
+                            mentioned.add(m.group(0))
+                            sites.setdefault(m.group(0), (rel, i))
+            out[root] = (mentioned, sites)
+        return out

@@ -1,0 +1,329 @@
+"""lock-order: static lock-acquisition graph, cycles and blocking calls.
+
+The runtime holds ~24 ``threading.Lock``/``RLock`` sites across
+scheduler/metrics/trace/admission/follower.  This pass:
+
+- collects every lock *object* (``self.x = threading.Lock()`` instance
+  attributes, class attributes, module-level ``X = threading.Lock()``),
+  identified as ``Class.attr`` or a module-global name;
+- records acquisition order: inside a ``with lockA:`` body, a nested
+  ``with lockB:`` or a call into a function that (transitively) acquires
+  lockB adds the edge A -> B;
+- errors on cycles in that graph (the classic ABBA deadlock); a lock
+  re-acquired while already held is only an error for non-reentrant
+  ``Lock`` (RLock self-edges are by design);
+- flags blocking calls made while holding any lock: ``time.sleep``,
+  thread ``join``, untimed ``queue.get``/``Event.wait``, socket I/O,
+  ``urlopen``, ``subprocess``.
+
+Lock identity resolution: ``self.X`` binds to the enclosing class's
+``Class.X`` when that class declares it, else to the unique declaring
+class; ambiguous non-self receivers are skipped rather than merged —
+merging distinct ``_lock`` attributes would manufacture false cycles.
+Call resolution uses astutil.resolve_call (same-class ``self`` dispatch,
+single-class name matches, container-method names skipped); intentional
+holds (e.g. the follower control plane serialising socket sends under
+its dispatch lock) carry an inline suppression explaining why the hold
+is the point.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..astutil import (FUNC_NODES, FuncInfo, index_functions,
+                       own_statements, receiver_root, resolve_call)
+from ..core import Finding, Pass, Project
+
+BLOCKING_SOCKET = {"sendall", "recv", "accept", "connect"}
+UNTIMED_WAIT_RECV = ("queue", "_q", "ready", "event", "stop", "done")
+SKIP_NODES = FUNC_NODES + (ast.ClassDef, ast.Lambda)
+
+
+def _walk_calls(node: ast.AST):
+    """Calls in an expression/statement, skipping nested defs+lambdas."""
+    work = [node]
+    while work:
+        n = work.pop()
+        if isinstance(n, SKIP_NODES):
+            continue
+        if isinstance(n, ast.Call):
+            yield n
+        work.extend(ast.iter_child_nodes(n))
+
+
+class LockOrderPass(Pass):
+    id = "lock-order"
+    summary = ("no cycles in the static lock-acquisition graph; no "
+               "blocking calls while holding a lock")
+
+    def run(self, project: Project) -> List[Finding]:
+        cfg = project.config
+        scope = [rel for rel in project.sources
+                 if project.in_scope(rel, cfg.graph_scopes)]
+        index = index_functions(project.sources, scope)
+
+        # lock registry: attr name -> {owner class or "" (module-global)}
+        self.lock_owners: Dict[str, Set[str]] = {}
+        self.reentrant: Set[str] = set()
+        for rel in scope:
+            self._collect_locks(project.sources[rel].tree)
+
+        funcs: List[FuncInfo] = [fi for fis in index.values() for fi in fis]
+        direct: Dict[int, Set[str]] = {}
+        held_calls: List[Tuple[FuncInfo, str, ast.Call]] = []
+        with_edges: List[Tuple[FuncInfo, str, int, str]] = []
+        for fi in funcs:
+            acquired: Set[str] = set()
+            self._scan(fi, fi.node.body, [], acquired, held_calls,
+                       with_edges)
+            direct[id(fi.node)] = acquired
+
+        # transitive lock sets (fixpoint over the name-resolved graph)
+        trans = {id(fi.node): set(direct[id(fi.node)]) for fi in funcs}
+        changed = True
+        while changed:
+            changed = False
+            for fi in funcs:
+                mine = trans[id(fi.node)]
+                for call in self._own_calls(fi.node):
+                    for target in resolve_call(call, fi.cls, index):
+                        extra = trans[id(target.node)] - mine
+                        if extra:
+                            mine |= extra
+                            changed = True
+
+        edges: Dict[str, Set[str]] = {}
+        edge_sites: Dict[Tuple[str, str], Tuple[str, int]] = {}
+        for fi, held, line, inner in with_edges:
+            edges.setdefault(held, set()).add(inner)
+            edge_sites.setdefault((held, inner), (fi.rel, line))
+        for fi, held, call in held_calls:
+            for target in resolve_call(call, fi.cls, index):
+                for inner in trans[id(target.node)]:
+                    edges.setdefault(held, set()).add(inner)
+                    edge_sites.setdefault((held, inner),
+                                          (fi.rel, call.lineno))
+
+        findings: List[Finding] = []
+        for a, b in self._cycle_edges(edges):
+            rel, line = edge_sites.get((a, b), ("<unknown>", 1))
+            findings.append(Finding(
+                rel, line, self.id,
+                f"lock-order cycle: acquiring {b} while holding {a} "
+                f"participates in a cycle in the static acquisition "
+                f"graph (potential deadlock)"))
+
+        # transitive blocking ops: a held call into a function whose call
+        # graph performs socket I/O / sleeps / untimed waits blocks just
+        # as surely as doing it inline
+        block: Dict[int, Set[str]] = {}
+        for fi in funcs:
+            block[id(fi.node)] = {m for c in self._own_calls(fi.node)
+                                  for m in (self._blocking(c),) if m}
+        changed = True
+        while changed:
+            changed = False
+            for fi in funcs:
+                mine = block[id(fi.node)]
+                for call in self._own_calls(fi.node):
+                    for target in resolve_call(call, fi.cls, index):
+                        extra = {f"{m.split(' (via')[0]} "
+                                 f"(via {target.qualname})"
+                                 for m in block[id(target.node)]} - mine
+                        if extra:
+                            mine |= extra
+                            changed = True
+
+        for fi, held, call in held_calls:
+            msg = self._blocking(call)
+            if not msg:
+                for target in resolve_call(call, fi.cls, index):
+                    ops = block[id(target.node)]
+                    if ops:
+                        msg = sorted(ops)[0]
+                        if " (via" not in msg:
+                            msg = f"{msg} (via {target.qualname})"
+                        break
+            if msg:
+                findings.append(Finding(
+                    fi.rel, call.lineno, self.id,
+                    f"{msg} while holding {held} ({fi.qualname})"))
+        return findings
+
+    # -- lock registry --------------------------------------------------
+
+    @staticmethod
+    def _lock_ctor(value: ast.AST) -> Optional[str]:
+        if not isinstance(value, ast.Call):
+            return None
+        f = value.func
+        name = (f.attr if isinstance(f, ast.Attribute)
+                else f.id if isinstance(f, ast.Name) else None)
+        return name if name in ("Lock", "RLock") else None
+
+    def _collect_locks(self, tree: ast.AST) -> None:
+        def record(attr: str, owner: str, kind: str):
+            self.lock_owners.setdefault(attr, set()).add(owner)
+            if kind == "RLock":
+                self.reentrant.add(f"{owner}.{attr}" if owner else attr)
+
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                for sub in ast.walk(node):
+                    if not isinstance(sub, ast.Assign):
+                        continue
+                    kind = self._lock_ctor(sub.value)
+                    if not kind:
+                        continue
+                    for t in sub.targets:
+                        if (isinstance(t, ast.Attribute)
+                                and isinstance(t.value, ast.Name)
+                                and t.value.id == "self"):
+                            record(t.attr, node.name, kind)
+                        elif isinstance(t, ast.Name):
+                            record(t.id, node.name, kind)
+        if isinstance(tree, ast.Module):
+            for node in tree.body:
+                if isinstance(node, ast.Assign):
+                    kind = self._lock_ctor(node.value)
+                    if kind:
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                record(t.id, "", kind)
+
+    def _lock_of(self, expr: ast.AST, cls: Optional[str]) -> Optional[str]:
+        if isinstance(expr, ast.Attribute):
+            attr = expr.attr
+            owners = self.lock_owners.get(attr)
+            if not owners:
+                return None
+            is_self = (isinstance(expr.value, ast.Name)
+                       and expr.value.id == "self")
+            if is_self and cls in owners:
+                return f"{cls}.{attr}"
+            if len(owners) == 1:
+                owner = next(iter(owners))
+                return f"{owner}.{attr}" if owner else attr
+            return None         # ambiguous: skip, don't merge
+        if isinstance(expr, ast.Name):
+            owners = self.lock_owners.get(expr.id)
+            if owners and "" in owners:
+                return expr.id
+            if owners and len(owners) == 1:
+                return f"{next(iter(owners))}.{expr.id}"
+        return None
+
+    # -- statement walk -------------------------------------------------
+
+    def _scan(self, fi: FuncInfo, body, held: List[str],
+              acquired: Set[str], held_calls, with_edges) -> None:
+        for node in body:
+            if isinstance(node, SKIP_NODES):
+                continue
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                got: List[str] = []
+                for item in node.items:
+                    if held:
+                        for c in _walk_calls(item.context_expr):
+                            held_calls.append((fi, held[-1], c))
+                    lock = self._lock_of(item.context_expr, fi.cls)
+                    if lock:
+                        if held:
+                            with_edges.append(
+                                (fi, held[-1], node.lineno, lock))
+                        got.append(lock)
+                        acquired.add(lock)
+                self._scan(fi, node.body, held + got, acquired,
+                           held_calls, with_edges)
+                continue
+            stmt_lists, exprs = [], []
+            for _field, value in ast.iter_fields(node):
+                if isinstance(value, list) and value:
+                    if isinstance(value[0], ast.stmt):
+                        stmt_lists.append(value)
+                    elif isinstance(value[0], ast.excepthandler):
+                        for h in value:
+                            stmt_lists.append(h.body)
+                    else:
+                        exprs.extend(v for v in value
+                                     if isinstance(v, ast.AST))
+                elif isinstance(value, ast.AST):
+                    exprs.append(value)
+            if held:
+                for e in exprs:
+                    for c in _walk_calls(e):
+                        held_calls.append((fi, held[-1], c))
+            for sl in stmt_lists:
+                self._scan(fi, sl, held, acquired, held_calls, with_edges)
+
+    @staticmethod
+    def _own_calls(func: ast.AST):
+        for node in own_statements(func):
+            if isinstance(node, ast.Call):
+                yield node
+
+    # -- analysis -------------------------------------------------------
+
+    def _cycle_edges(self,
+                     edges: Dict[str, Set[str]]) -> List[Tuple[str, str]]:
+        out: List[Tuple[str, str]] = []
+
+        def reaches(frm: str, to: str) -> bool:
+            seen: Set[str] = set()
+            work = [frm]
+            while work:
+                n = work.pop()
+                if n == to:
+                    return True
+                if n in seen:
+                    continue
+                seen.add(n)
+                work.extend(edges.get(n, ()))
+            return False
+
+        for a, succs in sorted(edges.items()):
+            for b in sorted(succs):
+                if a == b:
+                    if a not in self.reentrant:
+                        out.append((a, b))
+                elif reaches(b, a):
+                    out.append((a, b))
+        return out
+
+    @staticmethod
+    def _blocking(call: ast.Call) -> str:
+        f = call.func
+        kwargs = {kw.arg for kw in call.keywords}
+        if isinstance(f, ast.Attribute):
+            root = receiver_root(f.value)
+            recv = (f.value.attr if isinstance(f.value, ast.Attribute)
+                    else f.value.id if isinstance(f.value, ast.Name)
+                    else "")
+            if f.attr == "sleep" and root == "time":
+                return "time.sleep"
+            if f.attr == "join" and any(
+                    k in recv.lower() for k in ("thread", "worker",
+                                                "proc")):
+                return "thread join"
+            if (f.attr == "get" and "timeout" not in kwargs
+                    and len(call.args) < 2
+                    and any(k in recv.lower() for k in ("queue", "_q"))):
+                return "untimed queue.get"
+            if (f.attr == "wait" and not call.args
+                    and "timeout" not in kwargs
+                    and any(k in recv.lower() for k in UNTIMED_WAIT_RECV)):
+                return "untimed .wait()"
+            if f.attr in BLOCKING_SOCKET and isinstance(f.value,
+                                                        (ast.Name,
+                                                         ast.Attribute)):
+                return f"socket {f.attr}"
+            if f.attr == "urlopen":
+                return "urllib urlopen"
+            if root == "subprocess":
+                return f"subprocess.{f.attr}"
+        elif isinstance(f, ast.Name):
+            if f.id in ("urlopen", "create_connection"):
+                return f.id
+        return ""

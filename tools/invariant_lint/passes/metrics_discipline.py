@@ -1,0 +1,166 @@
+"""metrics-discipline: every metric family described and pre-seeded.
+
+The runtime metrics-lint CI job validates a live scrape — but it can
+only see label combos that happened to fire.  This pass closes the gap
+statically: every ``tpu_model_*`` family constructed anywhere via
+``.inc`` / ``.observe`` / ``.gauge_fn`` must be
+
+- **described** — a ``describe(name, help)`` call exists (HELP/TYPE on
+  every series is the scrape contract), and
+- for counters, **pre-seeded** — ``server/metrics.py`` must seed the
+  family at 0 with the *same label-key set* the increment uses, so an
+  idle scrape reads 0, not absent (the label-combo matrices: a
+  ``{class=,cause=}`` increment needs ``{class=,cause=}`` seeds).
+
+Label keys are extracted from the static text of label strings —
+f-string *values* may be dynamic (tenant names), the *keys* never are.
+Two seed idioms are recognised: a literal ``inc(name, 0.0, ...)`` and
+the batch loop ``for n in (names...): X.inc(n, 0.0)``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from ..astutil import fstring_static_text
+from ..core import Finding, Pass, Project
+
+METRIC_METHODS = {"inc", "observe", "gauge_fn", "describe"}
+LABEL_KEY_RE = re.compile(r'(\w+)=')
+
+
+def _label_keys(node: Optional[ast.AST]) -> Optional[FrozenSet[str]]:
+    """Static label-key set of a label argument; None = dynamic."""
+    if node is None:
+        return frozenset()
+    text = fstring_static_text(node)
+    if text is None:
+        return None
+    return frozenset(LABEL_KEY_RE.findall(text))
+
+
+def _metric_calls(tree: ast.AST, prefix: str):
+    """(method, name, name_is_literal, call) for metric-registry calls."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if not isinstance(f, ast.Attribute) or f.attr not in METRIC_METHODS:
+            continue
+        if not node.args:
+            continue
+        first = node.args[0]
+        if (isinstance(first, ast.Constant) and isinstance(first.value, str)
+                and first.value.startswith(prefix)):
+            yield f.attr, first.value, True, node
+        elif isinstance(first, ast.Name):
+            yield f.attr, first.id, False, node
+
+
+class MetricsDisciplinePass(Pass):
+    id = "metrics-discipline"
+    summary = ("metric families described + counters pre-seeded with "
+               "matching label-key combos")
+
+    def run(self, project: Project) -> List[Finding]:
+        cfg = project.config
+        prefix = cfg.metric_prefix
+        described: Set[str] = set()
+        # family -> set of seeded label-key sets
+        seeded: Dict[str, Set[FrozenSet[str]]] = {}
+
+        metrics_src = project.source(cfg.metrics_module)
+        if metrics_src is not None:
+            self._collect_registry(metrics_src.tree, prefix, described,
+                                   seeded)
+        # describe() calls elsewhere also count as descriptions
+        for rel, src in project.sources.items():
+            if rel == cfg.metrics_module:
+                continue
+            for method, name, lit, _node in _metric_calls(src.tree, prefix):
+                if method == "describe" and lit:
+                    described.add(name)
+
+        findings: List[Finding] = []
+        for rel, src in project.sources.items():
+            for method, name, lit, node in _metric_calls(src.tree, prefix):
+                if not lit or method == "describe":
+                    continue
+                if name not in described:
+                    findings.append(Finding(
+                        rel, node.lineno, self.id,
+                        f"metric family {name} is used but never "
+                        f"described — add describe() in "
+                        f"{cfg.metrics_module}"))
+                if method != "inc" or rel == cfg.metrics_module:
+                    continue
+                keys = _label_keys(node.args[2] if len(node.args) > 2
+                                   else self._kw(node, "labels"))
+                combos = seeded.get(name)
+                if not combos:
+                    findings.append(Finding(
+                        rel, node.lineno, self.id,
+                        f"counter {name} is incremented but never "
+                        f"pre-seeded at 0 in {cfg.metrics_module} — an "
+                        f"idle scrape must read 0, not absent"))
+                elif keys is not None and keys not in combos:
+                    shown = ",".join(sorted(keys)) or "<none>"
+                    findings.append(Finding(
+                        rel, node.lineno, self.id,
+                        f"counter {name} incremented with label keys "
+                        f"{{{shown}}} but no pre-seed uses that key set "
+                        f"— seed the full combo matrix in "
+                        f"{cfg.metrics_module}"))
+        return findings
+
+    @staticmethod
+    def _kw(call: ast.Call, name: str) -> Optional[ast.AST]:
+        for kw in call.keywords:
+            if kw.arg == name:
+                return kw.value
+        return None
+
+    def _collect_registry(self, tree: ast.AST, prefix: str,
+                          described: Set[str],
+                          seeded: Dict[str, Set[FrozenSet[str]]]) -> None:
+        zero = (0, 0.0)
+        for method, name, lit, node in _metric_calls(tree, prefix):
+            if not lit:
+                continue
+            if method == "describe":
+                described.add(name)
+            elif method == "inc" and len(node.args) > 1:
+                v = node.args[1]
+                if isinstance(v, ast.Constant) and v.value in zero:
+                    keys = _label_keys(
+                        node.args[2] if len(node.args) > 2
+                        else self._kw(node, "labels"))
+                    seeded.setdefault(name, set()).add(
+                        keys if keys is not None else frozenset())
+        # batch idiom: for _n in ("a", "b", ...): X.inc(_n, 0.0)
+        for loop in ast.walk(tree):
+            if not isinstance(loop, ast.For):
+                continue
+            if not isinstance(loop.target, ast.Name):
+                continue
+            if not isinstance(loop.iter, (ast.Tuple, ast.List)):
+                continue
+            names = [e.value for e in loop.iter.elts
+                     if isinstance(e, ast.Constant)
+                     and isinstance(e.value, str)
+                     and e.value.startswith(prefix)]
+            if not names:
+                continue
+            for node in ast.walk(loop):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "inc" and node.args
+                        and isinstance(node.args[0], ast.Name)
+                        and node.args[0].id == loop.target.id):
+                    keys = _label_keys(
+                        node.args[2] if len(node.args) > 2
+                        else self._kw(node, "labels")) or frozenset()
+                    for n in names:
+                        seeded.setdefault(n, set()).add(keys)
