@@ -2047,6 +2047,397 @@ def measure_coldstart(jax, *, model: str, dtype: str, slots: int,
     return rec
 
 
+class _SeverableProxy:
+    """TCP proxy in front of one in-process replica server. kill()
+    severs every live connection mid-byte and refuses new ones — replica
+    death exactly as the gateway sees it (RST/EOF on the upstream
+    stream), without tearing down the server the other replicas share a
+    process with."""
+
+    def __init__(self, backend_port: int):
+        import socket
+        import threading
+        self._socket = socket
+        self.backend_port = backend_port
+        self.dead = False
+        self._conns: list = []
+        self._lock = threading.Lock()
+        self._srv = socket.create_server(("127.0.0.1", 0))
+        self.port = self._srv.getsockname()[1]
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    def _accept(self):
+        import threading
+        while True:
+            try:
+                c, _ = self._srv.accept()
+            except OSError:
+                return
+            if self.dead:
+                c.close()
+                continue
+            try:
+                b = self._socket.create_connection(
+                    ("127.0.0.1", self.backend_port))
+            except OSError:
+                c.close()
+                continue
+            with self._lock:
+                self._conns.extend((c, b))
+            for src, dst in ((c, b), (b, c)):
+                threading.Thread(target=self._pump, args=(src, dst),
+                                 daemon=True).start()
+
+    def _pump(self, src, dst):
+        try:
+            while True:
+                d = src.recv(65536)
+                if not d:
+                    break
+                dst.sendall(d)
+        except OSError:
+            pass
+        for s in (src, dst):
+            try:
+                s.shutdown(self._socket.SHUT_RDWR)
+            except OSError:
+                pass
+
+    def kill(self):
+        self.dead = True
+        with self._lock:
+            conns, self._conns = self._conns, []
+        for s in conns:
+            try:
+                s.shutdown(self._socket.SHUT_RDWR)
+            except OSError:
+                pass
+            s.close()
+
+    def close(self):
+        self.kill()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+
+def measure_fleet(jax, **kw) -> dict:
+    """Fleet-gateway arm wrapper: the K-replica capture is the only one
+    that compiles IDENTICAL executables from several engines' scheduler
+    threads concurrently in one process, which races the persistent XLA
+    compilation cache (observed as heap corruption / wedged dispatch on
+    the CPU smoke). The capture is a policy gate, not a perf headline —
+    cold compiles are fine, so park the cache for its duration."""
+    cache = getattr(jax.config, "jax_compilation_cache_dir", None)
+    if cache:
+        jax.config.update("jax_compilation_cache_dir", None)
+    try:
+        return _measure_fleet(jax, **kw)
+    finally:
+        if cache:
+            jax.config.update("jax_compilation_cache_dir", cache)
+
+
+def _measure_fleet(jax, *, model: str, dtype: str, slots: int, steps: int,
+                   seq: int, prompt_len: int, paged: bool, mixed: bool,
+                   chunk: int, page_size: int, n_pages: int | None,
+                   platform: str, params_cache: dict | None = None,
+                   env: dict | None = None) -> dict:
+    """Fleet-gateway arm (ISSUE 15): K=4 REAL servers behind the
+    cache-aware gateway vs one replica serving the same shared-system-
+    prompt workload. Two claims gate: (a) the page-aligned prefix-hash
+    routing keeps the fleet's aggregate prefix hit rate >= 0.9x the
+    single-replica rate (round-robin routing shreds it to ~0.7x by
+    cold-starting every radix tree); (b) a replica killed mid-stream
+    fails over with ZERO client-visible error frames and a byte-
+    identical greedy continuation, with the journal drained after.
+    BENCH_ASSERT_FLEET=1 hard-fails the capture on either."""
+    import gc
+    import json as _json
+    import tempfile
+    import threading
+    import urllib.request
+
+    from ollama_operator_tpu.models.config import get_config
+    from ollama_operator_tpu.operator.gateway import Gateway
+    from ollama_operator_tpu.runtime.engine import (EngineConfig,
+                                                    resolve_cache_dtype)
+    from ollama_operator_tpu.runtime.service import LoadedModel
+    from ollama_operator_tpu.server.app import ModelManager, serve
+    from ollama_operator_tpu.server.metrics import GLOBAL as METRICS
+    from ollama_operator_tpu.server.names import ModelName
+
+    on_cpu = platform == "cpu"
+    if on_cpu:
+        dtype = "float32"
+    kv_dtype = resolve_cache_dtype(
+        os.environ.get("BENCH_KV_DTYPE", "float32" if on_cpu else "int8"))
+    cfg = get_config(model)
+    params, param_bytes, dtype = _bench_params(
+        jax, cfg, model, dtype, on_cpu, params_cache)
+    tok = _bench_tokenizer(cfg.vocab_size)
+    name = ModelName.parse("bench").short
+
+    serve_seq = min(seq, cfg.max_seq_len)
+    ps = max(8, min(page_size, serve_seq // 8))
+    # the ISSUE-15 shape: 512-token shared system prompt where the
+    # context allows, half the servable context at smoke scale
+    prefix_len = min(512, serve_seq // 2)
+    tail_len = max(8, min(32, serve_seq // 16))
+    gen_tokens = max(4, min(12, steps // 4))
+    # small decode chunks so the kill lands mid-stream (several frames
+    # per response) even on the tiny smoke model
+    chunk_eff = max(2, min(chunk, serve_seq // 32))
+    kill_tokens = max(24, min(48, serve_seq // 2 - tail_len))
+    # chunk the routing hash to the actual prompt scale: the shared
+    # prefix must span several full chunks or affinity measures nothing
+    hash_chunk = max(16, prefix_len // 4)
+    k_replicas = 4
+    n_req = 12
+    pool = (n_pages
+            or slots * (-(-serve_seq // ps) + 2) + prefix_len // ps)
+    log(f"bench: fleet capture model={model} k={k_replicas} "
+        f"prefix={prefix_len} hash_chunk={hash_chunk} ps={ps}")
+
+    system = ("You are a meticulous TPU serving assistant. "
+              * (prefix_len // 8 + 1))[:prefix_len]
+    tails = [(f"-q{i:02d}" * (tail_len // 4 + 1))[:tail_len]
+             for i in range(n_req + 4)]
+    kill_prompts = [f"kill-{a}-" + "z" * 24 for a in range(3)]
+
+    def make_server():
+        lm = LoadedModel(
+            name, cfg, params, tok,
+            ecfg=EngineConfig(max_slots=slots, max_seq_len=serve_seq,
+                              decode_chunk=chunk_eff, cache_dtype=kv_dtype,
+                              paged=True, page_size=ps, n_pages=pool,
+                              min_prefill_bucket=16))
+        tmp = tempfile.mkdtemp(prefix="bench-fleet-")
+        manager = ModelManager(tmp, serve_models=True, default_keep_alive=-1)
+        manager.loaded = lm
+        httpd = serve(manager, "127.0.0.1", 0)
+        return lm, manager, httpd
+
+    def teardown(lm, manager, httpd):
+        httpd.shutdown()
+        manager.loaded = None
+        lm.unload()
+
+    def generate(base, prompt_text, n_predict, on_frame=None):
+        """One greedy stream; returns (text, error_frames). Greedy makes
+        the output a pure function of the prompt — the bit-identity
+        oracle for cross-replica failover."""
+        req = urllib.request.Request(
+            base + "/api/generate",
+            data=_json.dumps({
+                "model": "bench", "prompt": prompt_text, "stream": True,
+                "options": {"num_predict": n_predict,
+                            "temperature": 0.0}}).encode(),
+            headers={"Content-Type": "application/json"})
+        text, errors, n = [], [], 0
+        with urllib.request.urlopen(req, timeout=600) as resp:
+            for line in resp:
+                if not line.strip():
+                    continue
+                frame = _json.loads(line)
+                if "error" in frame:
+                    errors.append(frame)
+                elif not frame.get("done"):
+                    text.append(frame.get("response") or "")
+                n += 1
+                if on_frame is not None:
+                    on_frame(n)
+        return "".join(text), errors
+
+    def hit_window(fn):
+        h0 = METRICS.get("tpu_model_prefix_hit_tokens_total")
+        m0 = METRICS.get("tpu_model_prefix_miss_tokens_total")
+        fn()
+        hits = METRICS.get("tpu_model_prefix_hit_tokens_total") - h0
+        miss = METRICS.get("tpu_model_prefix_miss_tokens_total") - m0
+        return hits, miss
+
+    # --- arm A: one replica, direct — the hit-rate bar to hold --------
+    lm1, mgr1, httpd1 = make_server()
+    base1 = f"http://127.0.0.1:{httpd1.server_address[1]}"
+    single_errors: list = []
+
+    def run_single():
+        for i in range(n_req):
+            _, errs = generate(base1, system + tails[i], gen_tokens)
+            single_errors.extend(errs)
+
+    s_hits, s_miss = hit_window(run_single)
+    single_rate = s_hits / max(1.0, s_hits + s_miss)
+    # reference texts for the kill phase: any replica must reproduce
+    # these byte-for-byte across a mid-stream failover
+    kill_refs = [generate(base1, p, kill_tokens)[0] for p in kill_prompts]
+    teardown(lm1, mgr1, httpd1)
+    del lm1
+    gc.collect()
+    log(f"bench: fleet single-replica hit_rate={single_rate:.3f}")
+
+    # --- arm B: K replicas behind the gateway -------------------------
+    servers = [make_server() for _ in range(k_replicas)]
+    proxies = [_SeverableProxy(s[2].server_address[1]) for s in servers]
+    proxy_by_name = {f"r{i}": p for i, p in enumerate(proxies)}
+    fleet_env = {
+        "TPU_GATEWAY_HASH_CHUNK": str(hash_chunk),
+        "TPU_GATEWAY_EJECT_FAILURES": "2",
+        "TPU_GATEWAY_EJECT_S": "60",      # a killed replica stays out
+        "TPU_GATEWAY_SLOW_SCRAPE_MS": "30000",  # loaded CPU != slow
+    }
+    saved = {k: os.environ.get(k) for k in fleet_env}
+    os.environ.update(fleet_env)
+    try:
+        gw = Gateway(replicas=[(nm, f"http://127.0.0.1:{p.port}")
+                               for nm, p in proxy_by_name.items()],
+                     port=0, scrape_period_s=0.2)
+    finally:
+        for k, old in saved.items():
+            if old is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = old
+    gw.start()
+
+    def routes(path):
+        return METRICS.get("tpu_model_gateway_routes_total",
+                           f'{{path="{path}"}}')
+
+    def failovers(result):
+        return METRICS.get("tpu_model_gateway_failovers_total",
+                           f'{{result="{result}"}}')
+
+    t0 = time.perf_counter()
+    fleet_errors: list = []
+    r0 = {p: routes(p) for p in ("affinity", "probe", "least_loaded")}
+
+    def run_fleet():
+        for i in range(n_req):
+            _, errs = generate(gw.base_url, system + tails[i], gen_tokens)
+            fleet_errors.extend(errs)
+
+    f_hits, f_miss = hit_window(run_fleet)
+    fleet_rate = f_hits / max(1.0, f_hits + f_miss)
+    route_delta = {p: int(routes(p) - r0[p])
+                   for p in ("affinity", "probe", "least_loaded")}
+    log(f"bench: fleet K={k_replicas} hit_rate={fleet_rate:.3f} "
+        f"routes={route_delta}")
+
+    # --- kill phase: sever the serving replica mid-stream -------------
+    fo0 = {r: failovers(r) for r in ("replayed", "requeued", "errored")}
+    kill_bit_identical = None
+    kill_errors: list = []
+    killed_name = None
+    for attempt, (prompt, ref) in enumerate(zip(kill_prompts, kill_refs)):
+        before = {r["name"]: r["served"] for r in gw.status()["replicas"]}
+        state: dict = {"killed": None}
+
+        def on_frame(n, _before=before, _state=state):
+            if n == 1 and _state["killed"] is None:
+                after = {r["name"]: r["served"]
+                         for r in gw.status()["replicas"]}
+                for nm in after:
+                    if (after[nm] > _before.get(nm, 0)
+                            and not proxy_by_name[nm].dead):
+                        proxy_by_name[nm].kill()
+                        _state["killed"] = nm
+                        return
+
+        text, errs = generate(gw.base_url, prompt, kill_tokens,
+                              on_frame=on_frame)
+        kill_errors.extend(errs)
+        kill_bit_identical = (text == ref)
+        killed_name = state["killed"]
+        if not kill_bit_identical:
+            log(f"bench: fleet kill attempt {attempt} diverged: "
+                f"ref={ref!r} got={text!r}")
+        if failovers("replayed") - fo0["replayed"] >= 1:
+            break
+        # the tiny stream outran the kill (fully pumped before frame 1
+        # was processed) — the severed replica is dead either way, try
+        # the next one; 3 attempts against K=4 always leaves quorum
+        log(f"bench: fleet kill attempt {attempt} raced, retrying")
+    # queued-after-death traffic: affinity still points at the corpse,
+    # so these exercise the unconditional unstarted-request failover
+    post_errors: list = []
+    for i in range(n_req, n_req + 3):
+        _, errs = generate(gw.base_url, system + tails[i], gen_tokens)
+        post_errors.extend(errs)
+    fo_delta = {r: int(failovers(r) - fo0[r])
+                for r in ("replayed", "requeued", "errored")}
+    journal = gw.journal_stats()
+    wall = time.perf_counter() - t0
+
+    gw.stop()
+    for p in proxies:
+        p.close()
+    for lm, manager, httpd in servers:
+        teardown(lm, manager, httpd)
+    del servers
+
+    rec = {
+        "model": model,
+        "mode": "fleet",
+        "k_replicas": k_replicas,
+        "n_requests": n_req,
+        "single_hit_rate": round(single_rate, 3),
+        "fleet_hit_rate": round(fleet_rate, 3),
+        "fleet_vs_single_hit_ratio": (round(fleet_rate / single_rate, 3)
+                                      if single_rate else None),
+        "routes": route_delta,
+        "failovers": fo_delta,
+        "killed_replica": killed_name,
+        "kill_bit_identical": kill_bit_identical,
+        "client_error_frames": (len(single_errors) + len(fleet_errors)
+                                + len(kill_errors) + len(post_errors)),
+        "journal_live": journal["live"],
+        "journal_kept": journal["kept"],
+        "prefix_len": int(prefix_len),
+        "hash_chunk": int(hash_chunk),
+        "gen_tokens": int(gen_tokens),
+        "kill_tokens": int(kill_tokens),
+        "page_size": int(ps),
+        "slots": slots,
+        "dtype": dtype,
+        "paged": True,
+        "seq": int(serve_seq),
+        "wall_s": round(wall, 2),
+    }
+    if env:
+        rec["env"] = dict(env)
+    log(f"bench: fleet capture done: {json.dumps(rec)}")
+    if os.environ.get("BENCH_ASSERT_FLEET") == "1":
+        problems = []
+        ratio = rec["fleet_vs_single_hit_ratio"]
+        if ratio is None or ratio < 0.9:
+            problems.append(f"fleet/single hit ratio {ratio} < 0.9 "
+                            f"(fleet {fleet_rate:.3f} vs single "
+                            f"{single_rate:.3f})")
+        if rec["client_error_frames"]:
+            problems.append(f"{rec['client_error_frames']} client-visible "
+                            f"error frames (want 0)")
+        if not kill_bit_identical:
+            problems.append("failover continuation was not byte-identical")
+        if fo_delta["replayed"] < 1:
+            problems.append("mid-stream kill never exercised replay "
+                            f"failover: {fo_delta}")
+        if fo_delta["errored"]:
+            problems.append(f"{fo_delta['errored']} replayable streams "
+                            f"errored instead of failing over")
+        if journal["live"]:
+            problems.append(f"journal not drained: {journal['live']} "
+                            f"live entries")
+        if problems:
+            raise AssertionError("fleet arm failed: "
+                                 + "; ".join(problems))
+    del params
+    gc.collect()
+    return rec
+
+
 def main() -> None:
     import jax
 
@@ -2134,6 +2525,8 @@ def main() -> None:
                                                 "") == "1",
                      coldstart_arm=os.environ.get("BENCH_COLDSTART_ARM",
                                                   "") == "1",
+                     fleet_arm=os.environ.get("BENCH_FLEET_ARM",
+                                              "") == "1",
                      **knobs)]
     elif platform == "cpu":
         # unpinned CPU smoke: tiny model, but every knob still applies
@@ -2180,6 +2573,13 @@ def main() -> None:
             # gates on it (engine policy, not perf)
             plan.append({**smoke, "coldstart_arm": True, "slots": 2,
                          "seq": 128})
+        if os.environ.get("BENCH_FLEET_ARM", "") == "1":
+            # fleet gateway (ISSUE 15): K=4 real servers behind the
+            # cache-aware gateway — aggregate prefix hit rate must hold
+            # >= 0.9x the single-replica rate, and a replica killed
+            # mid-stream must fail over with zero client error frames,
+            # byte-identical. BENCH_ASSERT_FLEET=1 gates on it
+            plan.append({**smoke, "fleet_arm": True, "slots": 2})
         if os.environ.get("BENCH_SPEC_ARM", "") == "1":
             # fused prompt-lookup speculation (ISSUE 6): lookup /
             # accept_all / reject_all sub-arms on a repetition-heavy
@@ -2319,8 +2719,10 @@ def main() -> None:
         overload_arm = cap.pop("overload_arm", False)
         restart_arm = cap.pop("restart_arm", False)
         coldstart_arm = cap.pop("coldstart_arm", False)
+        fleet_arm = cap.pop("fleet_arm", False)
         try:
-            fn = (measure_coldstart if coldstart_arm
+            fn = (measure_fleet if fleet_arm
+                  else measure_coldstart if coldstart_arm
                   else measure_restart if restart_arm
                   else measure_overload if overload_arm
                   else measure_prefix if prefix_arm
@@ -2364,8 +2766,10 @@ def assemble(captures: list, platform: str, n_devices: int) -> str:
     head = captures[0]
     metric = f"{head['model']}_decode_tok_s_b{head['slots']}"
     baseline = load_baseline(metric)
+    # a pinned arm-only run (e.g. BENCH_MODEL + BENCH_FLEET_ARM) has a
+    # policy capture at the head with no throughput headline
     vs = (head["tok_s"] / baseline[0]
-          if baseline and baseline[0] else 1.0)
+          if baseline and baseline[0] and head.get("tok_s") else 1.0)
     # HTTP-vs-engine serving ratio (ISSUE 1 acceptance: >=85%): pair each
     # http capture with the engine capture of the same config — engine
     # captures are the ones with neither a "surface" nor a "mode" key
@@ -2455,9 +2859,22 @@ def assemble(captures: list, platform: str, n_devices: int) -> str:
             coldstart_speedup = c.get("restore_speedup")
             coldstart_recompiles = c.get("recompiles_after_restore")
             break
+    # fleet gateway (ISSUE 15 acceptance: K=4 aggregate prefix hit rate
+    # >= 0.9x single-replica, zero client-visible error frames across a
+    # mid-stream replica kill, byte-identical failover continuation)
+    fleet_hit_rate = fleet_hit_ratio = fleet_bit_identical = None
+    fleet_errors = fleet_replayed = None
+    for c in captures:
+        if c.get("mode") == "fleet":
+            fleet_hit_rate = c.get("fleet_hit_rate")
+            fleet_hit_ratio = c.get("fleet_vs_single_hit_ratio")
+            fleet_bit_identical = c.get("kill_bit_identical")
+            fleet_errors = c.get("client_error_frames")
+            fleet_replayed = (c.get("failovers") or {}).get("replayed")
+            break
     return json.dumps({
         "metric": metric,
-        "value": head["tok_s"],
+        "value": head.get("tok_s"),
         "unit": "tok/s",
         "vs_baseline": round(vs, 3),
         # which BENCH_r*.json the ratio resolved against (earliest recorded)
@@ -2488,6 +2905,11 @@ def assemble(captures: list, platform: str, n_devices: int) -> str:
         "coldstart_restore_ms": coldstart_restore_ms,
         "coldstart_speedup": coldstart_speedup,
         "coldstart_recompiles": coldstart_recompiles,
+        "fleet_hit_rate": fleet_hit_rate,
+        "fleet_vs_single_hit_ratio": fleet_hit_ratio,
+        "fleet_kill_bit_identical": fleet_bit_identical,
+        "fleet_client_error_frames": fleet_errors,
+        "fleet_failovers_replayed": fleet_replayed,
         "slots": head["slots"],
         "platform": platform,
         "dtype": head["dtype"],

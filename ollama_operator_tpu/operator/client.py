@@ -25,7 +25,9 @@ import urllib.error
 import urllib.request
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
-from ..runtime.faults import FAULTS
+from ..runtime.faults import FAULTS, InjectedFault
+from ..runtime.trace import FLIGHT
+from ..server.metrics import GLOBAL as METRICS
 
 SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
@@ -341,17 +343,39 @@ def fetch_replica_ps(url: str, timeout: float = 2.0) -> Optional[Dict]:
     optimisation, so it must never be able to wedge the control loop —
     short timeout, no retries, every error collapses to None. The
     autoscaler treats a None (unreachable replica) as missing evidence
-    and fails static. `operator.scrape` is the chaos hook: fail modes
-    collapse to None like a real network fault, delay modes stall like
-    a slow pod."""
+    and fails static — but the failure itself must not be silent: each
+    one increments tpu_model_scrape_failures_total{cause} and drops a
+    flight-recorder `scrape_failed` breadcrumb, so a run of
+    autoscale_holds_total{cause="no_data"} is attributable to the
+    network / pod / payload fault that caused it. `operator.scrape` is
+    the chaos hook: fail modes collapse to None like a real network
+    fault, delay modes stall like a slow pod."""
+    body = b""
+    cause = "network"
     try:
         FAULTS.check("operator.scrape")
         req = urllib.request.Request(url, headers={"Accept":
                                                    "application/json"})
         with urllib.request.urlopen(req, timeout=timeout) as resp:
-            return json.loads(resp.read().decode())
-    except Exception:  # noqa: BLE001 — best-effort scrape by design
+            body = resp.read()
+        cause = "parse"
+        return json.loads(body.decode())
+    except InjectedFault as e:
+        _scrape_failed(url, "fault", repr(e))
         return None
+    except urllib.error.HTTPError as e:
+        _scrape_failed(url, "http", f"HTTP {e.code}")
+        return None
+    except Exception as e:  # noqa: BLE001 — best-effort scrape by design
+        _scrape_failed(url, cause, repr(e))
+        return None
+
+
+def _scrape_failed(url: str, cause: str, detail: str) -> None:
+    """Account one lost replica scrape (counter + flight breadcrumb)."""
+    METRICS.inc("tpu_model_scrape_failures_total", 1.0,
+                f'{{cause="{cause}"}}')
+    FLIGHT.record("scrape_failed", url=url, cause=cause, detail=detail)
 
 
 def post_replica_drain(url: str, timeout: float = 2.0) -> bool:

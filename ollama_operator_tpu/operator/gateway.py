@@ -1,0 +1,1121 @@
+"""Fleet gateway: cache-aware routing, circuit breaking, stream failover.
+
+ROADMAP item 1 step 1. `replicas: K` used to be a plain round-robin
+Service: shared prefixes missed ~(K-1)/K of the time and a replica death
+mid-stream was a client-visible error — the one failure PR 9's restart
+replay cannot absorb, because replay is replica-local. This stdlib-only
+HTTP gateway (one per Model, the Service backend built by workload.py,
+drivable in-process against tests/fake_kube.py) closes both gaps:
+
+**Routing law** (cache-aware, deterministic). The routing key is the
+request's prompt text (system + prompt for /api/generate, concatenated
+message contents for /api/chat), hashed in page-aligned chunks of
+``TPU_GATEWAY_HASH_CHUNK`` characters: ``h_i = sha256(h_{i-1} || chunk_i)``
+— a chain, so ``h_i`` names the *entire* prefix through chunk i exactly
+like the radix tree's page-chain identity (PR 4). Resolution order:
+
+1. **affinity** — longest chain hash present in the gateway's affinity
+   table whose replica is routable wins: requests sharing a prefix land
+   where that prefix's KV pages already live;
+2. **probe**  — on a table miss (gateway restart, evicted entry) the
+   request is scattered as a non-mutating ``POST /api/prefix_probe`` to
+   routable replicas and the longest ``matched_tokens`` wins;
+3. **least_loaded** — no cache evidence anywhere: the replica with the
+   fewest active+queued streams from the last ``/api/ps`` scrape (the
+   same admission/utilization blocks PR 10 mirrors into
+   ``status.replicaStats``).
+
+**Health state machine** (per replica): probe → healthy → ejected
+(circuit open) → half_open, fed by the background scrape loop (latency
+vs ``TPU_GATEWAY_SLOW_SCRAPE_MS``, ``/readyz``, ``/api/ps``) and by
+per-request outcomes (connect errors, 5xx). ``TPU_GATEWAY_EJECT_FAILURES``
+consecutive failures open the circuit for ``TPU_GATEWAY_EJECT_S``;
+half-open admits EXACTLY ONE live request — success closes the circuit,
+failure re-opens it. A replica whose /readyz says "draining" (PR 9/11)
+stops receiving work without an ejection: drain is intent, not illness.
+
+**Failover contract** (the journal). Every proxied generation keeps a
+journal entry: prompt, resolved options/seed, class/tenant, emitted
+frame count and a rolling sha256 of the emitted text. When a replica
+dies mid-stream:
+
+- *replayable* streams (PR 9 eligibility: greedy ``temperature==0`` or
+  seeded ``seed>=0``, within ``TPU_RESTART_REPLAY_TOKENS``) are
+  re-dispatched to a healthy replica; the gateway consumes the new
+  stream silently up to the already-emitted offset, verifies the prefix
+  against the rolling hash (bit-identity or bust), and continues on the
+  SAME client response stream — zero client-visible error frames;
+- *queued-but-unstarted* requests (zero frames emitted) fail over
+  unconditionally, eligibility irrelevant;
+- *non-replayable* streams (unseeded sampling) get the classic
+  exactly-once error frame with a computed finite ``retry_after_s``.
+
+Chaos hooks: ``gateway.route`` fires after a replica is picked but
+before dispatch (a fail counts as that replica failing); ``gateway.stream``
+fires per upstream frame (a fail severs the upstream exactly like a
+replica death — the drill the failover machinery is tested by).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+import weakref
+from collections import OrderedDict
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..runtime.faults import FAULTS, InjectedFault
+from ..runtime.trace import FLIGHT
+from ..server.metrics import GLOBAL as METRICS
+from .client import fetch_replica_ps
+
+STATES = ("probe", "healthy", "ejected", "half_open", "draining")
+ROUTABLE = ("healthy", "half_open", "probe")
+
+# Live gateways for the circuit-state gauges: registered once at module
+# import (described + asserted by metrics-lint), summed over instances so
+# tests creating several gateways in one process stay coherent.
+_LIVE: "weakref.WeakSet[Gateway]" = weakref.WeakSet()
+
+
+def _state_total(state: str) -> float:
+    n = 0
+    for gw in list(_LIVE):
+        n += gw.state_counts().get(state, 0)
+    return float(n)
+
+
+for _s in STATES:
+    METRICS.gauge_fn("tpu_model_gateway_replicas",
+                     (lambda s=_s: _state_total(s)),
+                     labels=f'{{state="{_s}"}}')
+
+
+class NoReplicas(Exception):
+    """No routable replica for a request; carries a finite retry hint."""
+
+    def __init__(self, retry_after_s: int):
+        super().__init__("no routable replica")
+        self.retry_after_s = retry_after_s
+
+
+class _ClientGone(Exception):
+    """The CLIENT connection died — abort, nothing left to fail over for."""
+
+
+class _UpstreamDead(Exception):
+    """The upstream replica connection died mid-request."""
+
+
+class _ReplayMismatch(Exception):
+    """A failover continuation diverged from the already-emitted prefix —
+    the bit-identity guarantee cannot be kept, fail the stream instead of
+    silently splicing different text."""
+
+
+class Replica:
+    """One backend server and its health/circuit bookkeeping. All fields
+    are guarded by the owning Gateway's lock."""
+
+    def __init__(self, name: str, url: str):
+        self.name = name
+        self.url = url.rstrip("/")
+        self.state = "probe"
+        self.fails = 0              # consecutive failures
+        self.ejected_until = 0.0
+        self.half_open_busy = False  # the single admitted trial request
+        self.load = 0.0             # active + queued streams (last scrape)
+        self.scrape_ms = 0.0
+        self.last_error = ""
+        self.served = 0             # requests dispatched here
+        self.failed = 0             # dispatches that counted as failures
+
+    def view(self) -> Dict[str, Any]:
+        return {"name": self.name, "url": self.url, "state": self.state,
+                "load": self.load, "scrape_ms": round(self.scrape_ms, 1),
+                "served": self.served, "failed": self.failed,
+                "last_error": self.last_error}
+
+
+def kube_discovery(kube, namespace: str, app: str,
+                   port: int = 11434) -> Callable[[], List[Tuple[str, str]]]:
+    """Replica discovery over a KubeClient-shaped object (the real client
+    or tests/fake_kube.FakeKube): ready pods of the model workload, named
+    by pod name, addressed by podIP. Drain victims are surfaced too — the
+    scrape sees their /readyz say draining and parks them."""
+    def discover() -> List[Tuple[str, str]]:
+        try:
+            pods = kube.list("v1", "Pod", namespace,
+                             label_selector=f"app={app}")
+        except Exception as e:  # noqa: BLE001 — discovery is best-effort
+            FLIGHT.record("gateway_discovery_failed", error=repr(e))
+            return []
+        out = []
+        for pod in sorted(pods, key=lambda p: (p.get("metadata") or {})
+                          .get("name", "")):
+            ip = (pod.get("status") or {}).get("podIP")
+            name = (pod.get("metadata") or {}).get("name", "")
+            if ip and name:
+                out.append((name, f"http://{ip}:{port}"))
+        return out
+    return discover
+
+
+def static_replicas(urls: List[str]) -> List[Tuple[str, str]]:
+    return [(f"replica-{i}", u) for i, u in enumerate(urls)]
+
+
+class Gateway:
+    """One Model's fleet front: routing, circuits, journal, failover."""
+
+    def __init__(self, replicas: Optional[List] = None,
+                 discover: Optional[Callable[[], List[Tuple[str, str]]]]
+                 = None,
+                 host: str = "127.0.0.1", port: Optional[int] = None,
+                 scrape_period_s: Optional[float] = None):
+        e = os.environ
+        self.hash_chunk = max(1, int(e.get("TPU_GATEWAY_HASH_CHUNK", "256")))
+        self.probe_enabled = e.get("TPU_GATEWAY_PROBE", "1") != "0"
+        self.eject_failures = max(1, int(e.get("TPU_GATEWAY_EJECT_FAILURES",
+                                               "3")))
+        self.eject_s = float(e.get("TPU_GATEWAY_EJECT_S", "10"))
+        self.slow_scrape_ms = float(e.get("TPU_GATEWAY_SLOW_SCRAPE_MS",
+                                          "1000"))
+        self.scrape_s = (float(e.get("TPU_GATEWAY_SCRAPE_S", "2"))
+                         if scrape_period_s is None else scrape_period_s)
+        self.hedge_ms = float(e.get("TPU_GATEWAY_HEDGE_MS", "0"))
+        self.journal_keep = max(1, int(e.get("TPU_GATEWAY_JOURNAL", "512")))
+        self.replay_tokens = int(e.get("TPU_RESTART_REPLAY_TOKENS", "65536"))
+        self.host = host
+        self.port = (int(e.get("TPU_GATEWAY_PORT", "11434"))
+                     if port is None else port)
+
+        self._discover = discover
+        self._lock = threading.Lock()
+        self._replicas: "OrderedDict[str, Replica]" = OrderedDict()
+        for item in replicas or []:
+            name, url = (item if isinstance(item, tuple)
+                         else (f"replica-{len(self._replicas)}", item))
+            self._replicas[name] = Replica(name, url)
+        # chain hash -> replica name, LRU-bounded; the gateway-side mirror
+        # of "whose radix tree holds this prefix"
+        self._affinity: "OrderedDict[str, str]" = OrderedDict()
+        self._affinity_keep = 65536
+        # request journal: live entries (streams in flight) + a bounded
+        # ring of finished ones (TPU_GATEWAY_JOURNAL) for post-mortems
+        self._live: Dict[int, Dict[str, Any]] = {}
+        self._done: "OrderedDict[int, Dict[str, Any]]" = OrderedDict()
+        self._rid = 0
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._scrape_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        _LIVE.add(self)
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> "Gateway":
+        """Bind + serve on a background thread; scrape loop too unless
+        scrape_period_s was 0 (tests drive scrape_once() by hand)."""
+        self.refresh_replicas()
+        self.scrape_once()
+        gw = self
+        handler = type("GatewayHandler", (_Handler,), {"gateway": gw})
+        self._httpd = ThreadingHTTPServer((self.host, self.port), handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        threading.Thread(target=self._httpd.serve_forever,
+                         daemon=True).start()
+        if self.scrape_s > 0:
+            self._scrape_thread = threading.Thread(target=self._scrape_loop,
+                                                   daemon=True)
+            self._scrape_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+    @property
+    def base_url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def _scrape_loop(self) -> None:
+        while not self._stop.wait(self.scrape_s):
+            try:
+                self.refresh_replicas()
+                self.scrape_once()
+            except Exception as e:  # noqa: BLE001 — loop must survive
+                FLIGHT.record("gateway_scrape_error", error=repr(e))
+
+    # -- replica set & health -------------------------------------------
+
+    def refresh_replicas(self) -> None:
+        if self._discover is None:
+            return
+        found = self._discover()
+        with self._lock:
+            names = {n for n, _ in found}
+            for name, url in found:
+                if name not in self._replicas:
+                    self._replicas[name] = Replica(name, url)
+                else:
+                    self._replicas[name].url = url.rstrip("/")
+            for name in [n for n in self._replicas if n not in names]:
+                del self._replicas[name]
+
+    def state_counts(self) -> Dict[str, int]:
+        with self._lock:
+            out = {s: 0 for s in STATES}
+            for r in self._replicas.values():
+                out[r.state] = out.get(r.state, 0) + 1
+            return out
+
+    def status(self) -> Dict[str, Any]:
+        with self._lock:
+            reps = [r.view() for r in self._replicas.values()]
+        return {"replicas": reps, "journal": self.journal_stats(),
+                "affinity_entries": len(self._affinity)}
+
+    def journal_stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"live": len(self._live), "kept": len(self._done)}
+
+    def scrape_once(self) -> None:
+        """One health/load pass over every replica: /readyz (latency,
+        drain detection) then /api/ps (load). Feeds the state machine."""
+        with self._lock:
+            targets = list(self._replicas.values())
+            self._tick_circuits_locked()
+        for r in targets:
+            t0 = time.monotonic()
+            ready, draining, err = self._get_readyz(r.url)
+            ms = (time.monotonic() - t0) * 1000.0
+            load = None
+            if ready or draining:
+                load = self._get_load(r.url)
+            with self._lock:
+                if r.name not in self._replicas:
+                    continue
+                r.scrape_ms = ms
+                if load is not None:
+                    r.load = load
+                if draining:
+                    if r.state in ("probe", "healthy"):
+                        r.state = "draining"
+                    continue
+                if not ready:
+                    self._fail_locked(r, "not_ready", err or "readyz failed")
+                elif ms > self.slow_scrape_ms:
+                    self._fail_locked(r, "slow", f"scrape {ms:.0f}ms")
+                else:
+                    # scrape success heals probe/draining; a half-open
+                    # circuit is only closed by its single trial REQUEST
+                    r.fails = 0
+                    r.last_error = ""
+                    if r.state in ("probe", "draining"):
+                        r.state = "healthy"
+
+    def _get_readyz(self, url: str) -> Tuple[bool, bool, str]:
+        try:
+            req = urllib.request.Request(f"{url}/readyz")
+            with urllib.request.urlopen(req, timeout=2.0) as resp:
+                return resp.status == 200, False, ""
+        except urllib.error.HTTPError as e:
+            body = b""
+            try:
+                body = e.read()
+            except Exception:  # noqa: BLE001
+                body = b""
+            if e.code == 503 and b"drain" in body:
+                return False, True, ""
+            return False, False, f"readyz HTTP {e.code}"
+        except Exception as e:  # noqa: BLE001 — network fault = not ready
+            return False, False, repr(e)
+
+    def _get_load(self, url: str) -> Optional[float]:
+        try:
+            req = urllib.request.Request(f"{url}/api/ps")
+            with urllib.request.urlopen(req, timeout=2.0) as resp:
+                body = json.loads(resp.read().decode())
+        except Exception:  # noqa: BLE001 — load is advisory
+            return None
+        load = 0.0
+        for m in (body or {}).get("models") or []:
+            life = m.get("lifecycle") or {}
+            adm = m.get("admission") or {}
+            q = adm.get("queued_by_class") or {}
+            load += float(life.get("active_streams") or 0)
+            load += float(sum(q.values()) if q else 0)
+        return load
+
+    # health feeds (call with lock held) ---------------------------------
+
+    def _tick_circuits_locked(self) -> None:
+        now = time.monotonic()
+        for r in self._replicas.values():
+            if r.state == "ejected" and now >= r.ejected_until:
+                r.state = "half_open"
+                r.half_open_busy = False
+
+    def _fail_locked(self, r: Replica, cause: str, detail: str) -> None:
+        r.fails += 1
+        r.failed += 1
+        r.last_error = detail
+        if r.state == "half_open":
+            METRICS.inc("tpu_model_gateway_half_open_probes_total", 1.0,
+                        '{result="fail"}')
+            self._eject_locked(r, cause)
+        elif r.state in ("probe", "healthy", "draining") \
+                and r.fails >= self.eject_failures:
+            self._eject_locked(r, cause)
+
+    def _eject_locked(self, r: Replica, cause: str) -> None:
+        r.state = "ejected"
+        r.ejected_until = time.monotonic() + self.eject_s
+        r.half_open_busy = False
+        METRICS.inc("tpu_model_gateway_ejections_total", 1.0,
+                    f'{{cause="{cause}"}}')
+        FLIGHT.record("gateway_eject", replica=r.name, cause=cause,
+                      detail=r.last_error, eject_s=self.eject_s)
+
+    def _request_ok(self, name: str) -> None:
+        with self._lock:
+            r = self._replicas.get(name)
+            if r is None:
+                return
+            was_half_open = r.state == "half_open"
+            r.fails = 0
+            r.last_error = ""
+            r.half_open_busy = False
+            if r.state in ("probe", "half_open"):
+                r.state = "healthy"
+            if was_half_open:
+                METRICS.inc("tpu_model_gateway_half_open_probes_total", 1.0,
+                            '{result="ok"}')
+
+    def _request_failed(self, name: str, detail: str) -> None:
+        with self._lock:
+            r = self._replicas.get(name)
+            if r is not None:
+                self._fail_locked(r, "failures", detail)
+
+    # -- routing ---------------------------------------------------------
+
+    def chunk_hashes(self, text: str) -> List[str]:
+        """Chained page-aligned prefix hashes: only FULL chunks hash (the
+        partial tail can't be page-shared by the radix cache either), and
+        hash i commits to every chunk before it, so equal h_i ⇔ equal
+        prefix through chunk i."""
+        h = hashlib.sha256()
+        out = []
+        for i in range(len(text) // self.hash_chunk):
+            chunk = text[i * self.hash_chunk:(i + 1) * self.hash_chunk]
+            h.update(chunk.encode("utf-8", "surrogatepass"))
+            out.append(h.hexdigest())
+        return out
+
+    def _routable_locked(self, exclude: frozenset) -> List[Replica]:
+        self._tick_circuits_locked()
+        cands = [r for r in self._replicas.values()
+                 if r.name not in exclude
+                 and (r.state in ("healthy", "probe")
+                      or (r.state == "half_open" and not r.half_open_busy))]
+        # prefer proven-healthy over unproven; never route to ejected or
+        # draining replicas at all
+        healthy = [r for r in cands if r.state != "probe"]
+        return healthy or cands
+
+    def _retry_after_s(self) -> int:
+        with self._lock:
+            qtotal = sum(r.load for r in self._replicas.values())
+        return int(max(1, min(30, 1 + qtotal)))
+
+    def pick(self, route_key: str, probe_body: Optional[Dict] = None,
+             exclude: frozenset = frozenset()) -> Tuple[str, str]:
+        """The routing law. Returns (replica name, path) and records the
+        request's chain hashes in the affinity table. ``probe_body`` is
+        the upstream /api/prefix_probe payload (None disables step 2 —
+        bench drives the law without HTTP)."""
+        hashes = self.chunk_hashes(route_key)
+        with self._lock:
+            cands = self._routable_locked(exclude)
+            if not cands:
+                raise NoReplicas(int(max(1, min(30, self.eject_s))))
+            names = {r.name for r in cands}
+            chosen, path = None, ""
+            for hx in reversed(hashes):
+                name = self._affinity.get(hx)
+                if name in names:
+                    chosen, path = name, "affinity"
+                    self._affinity.move_to_end(hx)
+                    break
+            probe_targets = ([(r.name, r.url) for r in cands]
+                             if chosen is None and self.probe_enabled
+                             and probe_body is not None and len(cands) > 1
+                             else [])
+        if chosen is None and probe_targets:
+            best = -1
+            payload = json.dumps(probe_body).encode()
+            for name, url in probe_targets:
+                matched = self._probe_one(url, payload)
+                if matched > best:
+                    best, chosen = matched, name
+            if best > 0:
+                path = "probe"
+            else:
+                chosen = None  # nobody has the prefix: fall through
+        with self._lock:
+            cands = self._routable_locked(exclude)
+            if not cands:
+                raise NoReplicas(int(max(1, min(30, self.eject_s))))
+            live = {r.name: r for r in cands}
+            if chosen is None or chosen not in live:
+                chosen = min(live.values(),
+                             key=lambda r: (r.load, r.name)).name
+                path = "least_loaded"
+            r = live[chosen]
+            if r.state == "half_open":
+                r.half_open_busy = True  # the ONE admitted trial
+            r.served += 1
+            for hx in hashes:
+                self._affinity[hx] = chosen
+                self._affinity.move_to_end(hx)
+            while len(self._affinity) > self._affinity_keep:
+                self._affinity.popitem(last=False)
+        METRICS.inc("tpu_model_gateway_routes_total", 1.0,
+                    f'{{path="{path}"}}')
+        return chosen, path
+
+    def _probe_one(self, url: str, payload: bytes) -> int:
+        try:
+            req = urllib.request.Request(
+                f"{url}/api/prefix_probe", data=payload, method="POST",
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=2.0) as resp:
+                body = json.loads(resp.read().decode())
+            return int(body.get("matched_tokens") or 0)
+        except Exception:  # noqa: BLE001 — a probe miss is just no info
+            return -1
+
+    # -- journal ---------------------------------------------------------
+
+    @staticmethod
+    def replayable(options: Optional[Dict]) -> bool:
+        """PR 9 eligibility, resolved from the request options the
+        gateway can see: greedy (temperature == 0) or seeded (seed >= 0).
+        Anything else is sampled from an unseeded RNG on the replica —
+        a re-run cannot reproduce the emitted prefix."""
+        o = options or {}
+        t = o.get("temperature")
+        if t is not None and float(t) == 0.0:
+            return True
+        seed = o.get("seed")
+        return seed is not None and int(seed) >= 0
+
+    def journal_open(self, body: Dict, route_key: str) -> Dict[str, Any]:
+        o = body.get("options") or {}
+        with self._lock:
+            self._rid += 1
+            entry = {
+                "id": self._rid,
+                "model": body.get("model"),
+                "prompt_sha": hashlib.sha256(
+                    route_key.encode("utf-8", "surrogatepass")).hexdigest(),
+                "class": o.get("priority") or o.get("class"),
+                "tenant": o.get("tenant"),
+                "seed": o.get("seed"),
+                "temperature": o.get("temperature"),
+                "replayable": self.replayable(o),
+                "frames": 0,
+                "chars": 0,
+                "hash": hashlib.sha256(),
+                "replica": None,
+                "failovers": 0,
+                "outcome": None,
+            }
+            self._live[entry["id"]] = entry
+            return entry
+
+    def journal_close(self, entry: Dict[str, Any], outcome: str) -> None:
+        entry["outcome"] = outcome
+        with self._lock:
+            self._live.pop(entry["id"], None)
+            kept = dict(entry, hash=entry["hash"].hexdigest())
+            self._done[entry["id"]] = kept
+            while len(self._done) > self.journal_keep:
+                self._done.popitem(last=False)
+
+    # -- the proxied generation (failover core) --------------------------
+
+    def _dispatch(self, url: str, path: str, payload: bytes):
+        """Open the upstream stream. Raises _UpstreamDead on connection
+        errors and retryable statuses; urllib.error.HTTPError with a
+        client-error status propagates (forwarded, never failed over)."""
+        FAULTS.check("gateway.route")
+        timeout = (self.hedge_ms / 1000.0) if self.hedge_ms > 0 else 300.0
+        req = urllib.request.Request(
+            f"{url}{path}", data=payload, method="POST",
+            headers={"Content-Type": "application/json",
+                     "Accept": "application/x-ndjson"})
+        try:
+            return urllib.request.urlopen(req, timeout=timeout)
+        except urllib.error.HTTPError as e:
+            if e.code >= 500 or e.code == 429:
+                raise _UpstreamDead(f"HTTP {e.code}") from e
+            raise
+        except InjectedFault as e:
+            raise  # pragma: no cover — check() fires before urlopen
+        except Exception as e:  # noqa: BLE001 — connect/timeout/refused
+            raise _UpstreamDead(repr(e)) from e
+
+    @staticmethod
+    def _iter_ndjson(resp):
+        buf = b""
+        while True:
+            chunk = resp.read1(65536) if hasattr(resp, "read1") \
+                else resp.read(65536)
+            if not chunk:
+                break
+            buf += chunk
+            while b"\n" in buf:
+                line, buf = buf.split(b"\n", 1)
+                if line.strip():
+                    yield line
+        if buf.strip():
+            yield buf
+
+    def stream_request(self, body: Dict, route_key: str, api_path: str,
+                       extract: Callable[[Dict], Optional[str]],
+                       reframe: Callable[[Dict, str], Dict],
+                       emit: Callable[[bytes], None],
+                       on_commit: Callable[[], None]) -> Dict[str, Any]:
+        """Proxy one generation with journal + cross-replica failover.
+
+        ``extract`` returns a data frame's text piece (None for the final
+        frame), ``reframe`` rewrites a frame's piece (the failover
+        boundary may split inside an upstream frame), ``emit`` writes one
+        NDJSON line to the client (raising _ClientGone when the client is
+        gone), ``on_commit`` sends the 200 + chunked headers exactly once
+        before the first emitted byte. Returns the journal entry.
+
+        Raises NoReplicas / HTTPError only BEFORE anything was emitted
+        (the handler maps them to real HTTP statuses). After commit,
+        failures either fail over invisibly or end with the exactly-once
+        error frame — never an exception to the handler."""
+        entry = self.journal_open(body, route_key)
+        upstream_body = dict(body)
+        upstream_body["stream"] = True
+        payload = json.dumps(upstream_body).encode()
+        probe_body = {k: body[k] for k in
+                      ("model", "prompt", "system", "template", "raw",
+                       "suffix") if k in body} if "prompt" in body else None
+        tried: set = set()
+        budget = max(2 * len(self._replicas) + 2, 4)
+        while True:
+            budget -= 1
+            try:
+                name, _path = self.pick(route_key, probe_body=probe_body,
+                                        exclude=frozenset(tried))
+            except NoReplicas:
+                if entry["frames"] == 0:
+                    if tried:  # everyone tried and failed: widen once
+                        tried = set()
+                        try:
+                            name, _path = self.pick(route_key,
+                                                    probe_body=None)
+                        except NoReplicas:
+                            self.journal_close(entry, "no_replicas")
+                            raise
+                    else:
+                        self.journal_close(entry, "no_replicas")
+                        raise
+                else:
+                    self._stream_error(entry, emit,
+                                       "fleet has no routable replica")
+                    return entry
+            entry["replica"] = name
+            tried.add(name)
+            with self._lock:
+                r = self._replicas.get(name)
+                url = r.url if r is not None else None
+            if url is None:
+                continue
+            try:
+                resp = self._dispatch(url, api_path, payload)
+            except _UpstreamDead as e:
+                self._request_failed(name, str(e))
+                if entry["frames"] == 0:
+                    METRICS.inc("tpu_model_gateway_failovers_total", 1.0,
+                                '{result="requeued"}')
+                    entry["failovers"] += 1
+                    FLIGHT.record("gateway_failover", request=entry["id"],
+                                  replica=name, result="requeued",
+                                  detail=str(e))
+                    if budget > 0:
+                        continue
+                    self.journal_close(entry, "exhausted")
+                    raise NoReplicas(self._retry_after_s()) from e
+                if not self._failover_eligible(entry):
+                    self._stream_error(entry, emit, str(e))
+                    return entry
+                if budget > 0:
+                    continue
+                self._stream_error(entry, emit, "failover budget exhausted")
+                return entry
+            except InjectedFault as e:
+                self._request_failed(name, repr(e))
+                if budget > 0:
+                    continue
+                self.journal_close(entry, "exhausted")
+                raise NoReplicas(self._retry_after_s()) from e
+            except urllib.error.HTTPError:
+                self.journal_close(entry, "rejected")
+                raise
+            try:
+                self._pump(resp, entry, extract, reframe, emit, on_commit)
+            except _ClientGone:
+                self.journal_close(entry, "client_gone")
+                raise
+            except _ReplayMismatch:
+                self._request_failed(name, "replay mismatch")
+                self._stream_error(entry, emit,
+                                   "failover continuation diverged from "
+                                   "the emitted prefix")
+                return entry
+            except Exception as e:  # noqa: BLE001 — upstream died mid-pump
+                self._request_failed(name, repr(e))
+                was_started = entry["frames"] > 0
+                if was_started and not self._failover_eligible(entry):
+                    self._stream_error(entry, emit, repr(e))
+                    return entry
+                result = "replayed" if was_started else "requeued"
+                METRICS.inc("tpu_model_gateway_failovers_total", 1.0,
+                            f'{{result="{result}"}}')
+                entry["failovers"] += 1
+                FLIGHT.record("gateway_failover", request=entry["id"],
+                              replica=name, result=result, detail=repr(e))
+                if budget > 0:
+                    continue
+                self._stream_error(entry, emit, "failover budget exhausted")
+                return entry
+            else:
+                self._request_ok(name)
+                self.journal_close(entry, "ok")
+                return entry
+
+    def _failover_eligible(self, entry: Dict[str, Any]) -> bool:
+        """Mid-stream failover needs PR 9 replay eligibility AND the
+        emitted prefix to fit the replay budget (frames ≈ detokenizer
+        pieces ≥ tokens, so the frame count is a conservative proxy)."""
+        return bool(entry["replayable"]
+                    and entry["frames"] <= self.replay_tokens)
+
+    def _stream_error(self, entry: Dict[str, Any],
+                      emit: Callable[[bytes], None], detail: str) -> None:
+        """The classic exactly-once terminal error frame (PR 9 contract)
+        with a computed finite Retry-After."""
+        retry = self._retry_after_s()
+        METRICS.inc("tpu_model_gateway_failovers_total", 1.0,
+                    '{result="errored"}')
+        FLIGHT.record("gateway_stream_error", request=entry["id"],
+                      replica=entry["replica"], detail=detail,
+                      retry_after_s=retry)
+        self.journal_close(entry, "errored")
+        frame = {"error": f"replica failed mid-stream and the request is "
+                          f"not replayable ({detail})",
+                 "retry_after_s": retry}
+        try:
+            emit(json.dumps(frame).encode() + b"\n")
+        except _ClientGone:
+            pass  # lint: allow(exception-hygiene): client left before the
+            # terminal error frame — nothing further to deliver it to
+
+    def _pump(self, resp, entry: Dict[str, Any],
+              extract: Callable[[Dict], Optional[str]],
+              reframe: Callable[[Dict, str], Dict],
+              emit: Callable[[bytes], None],
+              on_commit: Callable[[], None]) -> None:
+        """Forward one upstream stream to the client. After a failover,
+        ``entry['chars']`` > 0: the fresh upstream regenerates from token
+        zero, so consume silently up to that offset, verify the replayed
+        prefix is BIT-IDENTICAL to what the client already saw (rolling
+        sha256), then splice the remainder onto the same client stream."""
+        skip = entry["chars"]
+        prefix_hex = entry["hash"].hexdigest()
+        verify = hashlib.sha256()
+        acc = 0
+        saw_final = False
+        for line in self._iter_ndjson(resp):
+            FAULTS.check("gateway.stream")
+            frame = json.loads(line)
+            if "error" in frame and "done" not in frame:
+                raise _UpstreamDead(f"upstream error frame: "
+                                    f"{frame['error']!r}")
+            piece = extract(frame)
+            if piece is None:
+                if acc < skip:
+                    raise _ReplayMismatch(
+                        f"replay finished at {acc} < {skip} chars")
+                saw_final = True
+                on_commit()
+                try:
+                    emit(line + b"\n")
+                except (BrokenPipeError, ConnectionResetError) as e:
+                    raise _ClientGone() from e
+                continue
+            if acc + len(piece) <= skip:
+                verify.update(piece.encode("utf-8", "surrogatepass"))
+                acc += len(piece)
+                if acc == skip and verify.hexdigest() != prefix_hex:
+                    raise _ReplayMismatch("replayed prefix hash mismatch")
+                continue
+            if acc < skip:
+                head, piece = piece[:skip - acc], piece[skip - acc:]
+                verify.update(head.encode("utf-8", "surrogatepass"))
+                acc = skip
+                if verify.hexdigest() != prefix_hex:
+                    raise _ReplayMismatch("replayed prefix hash mismatch")
+                frame = reframe(frame, piece)
+                line = json.dumps(frame).encode()
+            acc += len(piece)
+            on_commit()
+            try:
+                emit(line + b"\n")
+            except (BrokenPipeError, ConnectionResetError) as e:
+                raise _ClientGone() from e
+            entry["frames"] += 1
+            entry["chars"] += len(piece)
+            entry["hash"].update(piece.encode("utf-8", "surrogatepass"))
+        if not saw_final:
+            raise _UpstreamDead("upstream closed before the final frame")
+
+    # -- raw proxy (non-journaled endpoints) -----------------------------
+
+    def proxy(self, method: str, path: str, payload: Optional[bytes],
+              exclude: frozenset = frozenset()):
+        """Least-loaded pass-through for endpoints outside the failover
+        contract (pull/show/tags/...). Unstarted requests retry once per
+        replica; the raw response object is handed back to the handler."""
+        tried = set(exclude)
+        last: Optional[Exception] = None
+        for _ in range(max(len(self._replicas), 1)):
+            with self._lock:
+                cands = self._routable_locked(frozenset(tried))
+                if not cands:
+                    break
+                r = min(cands, key=lambda x: (x.load, x.name))
+                name, url = r.name, r.url
+            tried.add(name)
+            req = urllib.request.Request(
+                f"{url}{path}", data=payload, method=method,
+                headers=({"Content-Type": "application/json"}
+                         if payload is not None else {}))
+            try:
+                return urllib.request.urlopen(req, timeout=300.0)
+            except urllib.error.HTTPError as e:
+                if e.code >= 500 or e.code == 429:
+                    self._request_failed(name, f"HTTP {e.code}")
+                    last = e
+                    continue
+                return e  # client error: forward verbatim
+            except Exception as e:  # noqa: BLE001 — connect/timeout
+                self._request_failed(name, repr(e))
+                last = e
+        raise NoReplicas(self._retry_after_s()) from last
+
+    def aggregate_ps(self) -> Dict[str, Any]:
+        """Fleet /api/ps: every replica's models list annotated with the
+        replica name, plus the gateway's own health table."""
+        with self._lock:
+            targets = [(r.name, r.url) for r in self._replicas.values()
+                       if r.state not in ("ejected",)]
+        models = []
+        for name, url in targets:
+            # shares the reconciler's scrape contract: an unreachable
+            # replica is skipped but accounted (scrape_failures{cause})
+            body = fetch_replica_ps(f"{url}/api/ps")
+            if body is None:
+                continue
+            for m in (body or {}).get("models") or []:
+                m = dict(m)
+                m["replica"] = name
+                models.append(m)
+        return {"models": models, "gateway": self.status()}
+
+
+# ---------------------------------------------------------------------------
+# HTTP front
+# ---------------------------------------------------------------------------
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    gateway: Gateway  # injected by Gateway.start()
+
+    def log_message(self, *_a):  # quiet; the journal is the record
+        pass
+
+    # -- plumbing -------------------------------------------------------
+
+    def _json_body(self) -> Dict:
+        n = int(self.headers.get("Content-Length") or 0)
+        raw = self.rfile.read(n) if n else b""
+        if not raw:
+            return {}
+        return json.loads(raw.decode())
+
+    def _send_json(self, obj, status=200,
+                   headers: Optional[Dict[str, str]] = None):
+        data = json.dumps(obj).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _start_stream(self):
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+    def _chunk(self, data: bytes):
+        self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+        self.wfile.flush()
+
+    def _end_stream(self):
+        self.wfile.write(b"0\r\n\r\n")
+        self.wfile.flush()
+
+    # -- GET ------------------------------------------------------------
+
+    def do_GET(self):
+        path = self.path.split("?")[0]
+        gw = self.gateway
+        if path == "/metrics":
+            data = METRICS.render().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+            return
+        if path in ("/healthz", "/livez"):
+            self._send_json({"status": "ok"})
+            return
+        if path == "/readyz":
+            counts = gw.state_counts()
+            routable = sum(counts.get(s, 0) for s in ROUTABLE)
+            if routable > 0:
+                self._send_json({"status": "ok", "replicas": counts})
+            else:
+                self._send_json({"status": "no routable replica",
+                                 "replicas": counts}, 503)
+            return
+        if path == "/gateway/status":
+            self._send_json(gw.status())
+            return
+        if path == "/api/ps":
+            self._send_json(gw.aggregate_ps())
+            return
+        # everything else: pass through to a routable replica
+        try:
+            resp = gw.proxy("GET", self.path, None)
+        except NoReplicas as e:
+            self._send_json({"error": "no routable replica"}, 503,
+                            headers={"Retry-After": str(e.retry_after_s)})
+            return
+        self._forward_response(resp)
+
+    def _forward_response(self, resp):
+        body = resp.read()
+        status = getattr(resp, "status", None) or resp.getcode()
+        self.send_response(status)
+        ctype = resp.headers.get("Content-Type") or "application/json"
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    # -- POST -----------------------------------------------------------
+
+    def do_POST(self):
+        path = self.path.split("?")[0]
+        try:
+            if path == "/api/generate":
+                self._proxy_generation(
+                    path,
+                    extract=lambda f: (None if f.get("done")
+                                       else f.get("response", "")),
+                    reframe=lambda f, t: dict(f, response=t),
+                    final_text_key="response")
+            elif path == "/api/chat":
+                self._proxy_generation(
+                    path,
+                    extract=lambda f: (None if f.get("done")
+                                       else (f.get("message") or {})
+                                       .get("content", "")),
+                    reframe=lambda f, t: dict(
+                        f, message=dict(f.get("message") or {}, content=t)),
+                    final_text_key="message")
+            else:
+                body = self.rfile.read(
+                    int(self.headers.get("Content-Length") or 0))
+                resp = self.gateway.proxy("POST", self.path, body or b"{}")
+                self._stream_through(resp)
+        except NoReplicas as e:
+            self._send_json({"error": "no routable replica"}, 503,
+                            headers={"Retry-After": str(e.retry_after_s)})
+        except urllib.error.HTTPError as e:
+            self._forward_response(e)
+        except _ClientGone:
+            pass  # lint: allow(exception-hygiene): client hung up; there
+            # is no one left to report the abort to
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # lint: allow(exception-hygiene): same — client is gone
+        except Exception as e:  # noqa: BLE001
+            try:
+                self._send_json({"error": f"gateway internal: {e}"}, 500)
+            except (BrokenPipeError, ConnectionResetError):
+                pass  # lint: allow(exception-hygiene): client gone mid-500
+
+    def _stream_through(self, resp):
+        """Chunked pass-through for non-journaled streaming endpoints
+        (/api/pull progress frames etc.)."""
+        status = getattr(resp, "status", None) or resp.getcode()
+        if status != 200:
+            self._forward_response(resp)
+            return
+        self._start_stream()
+        while True:
+            chunk = resp.read1(65536) if hasattr(resp, "read1") \
+                else resp.read(65536)
+            if not chunk:
+                break
+            self._chunk(chunk)
+        self._end_stream()
+
+    def _proxy_generation(self, api_path, extract, reframe, final_text_key):
+        gw = self.gateway
+        body = self._json_body()
+        if api_path == "/api/chat":
+            route_key = "".join((m.get("content") or "")
+                                for m in body.get("messages") or [])
+        else:
+            route_key = ((body.get("system") or "")
+                         + (body.get("prompt") or ""))
+        client_stream = body.get("stream", True)
+        state = {"started": False}
+        if client_stream:
+            def on_commit():
+                if not state["started"]:
+                    state["started"] = True
+                    self._start_stream()
+
+            def emit(line: bytes):
+                try:
+                    self._chunk(line)
+                except (BrokenPipeError, ConnectionResetError) as e:
+                    raise _ClientGone() from e
+
+            try:
+                gw.stream_request(body, route_key, api_path, extract,
+                                  reframe, emit, on_commit)
+            except NoReplicas as e:
+                if state["started"]:
+                    raise  # handler swallows; stream already errored
+                self._send_json(
+                    {"error": "no routable replica"}, 503,
+                    headers={"Retry-After": str(e.retry_after_s)})
+                return
+            if not state["started"]:
+                # upstream produced only a final frame path that never
+                # committed (shouldn't happen) — degrade gracefully
+                self._send_json({"error": "empty upstream stream"}, 502)
+                return
+            self._end_stream()
+        else:
+            # non-streaming client: the gateway still streams upstream
+            # (failover needs frames), aggregates, and answers once
+            pieces: List[str] = []
+            final: Dict[str, Any] = {}
+
+            def on_commit():
+                state["started"] = True
+
+            def emit(line: bytes):
+                frame = json.loads(line)
+                if frame.get("done"):
+                    final.update(frame)
+                elif "error" in frame:
+                    final.update(frame)
+                else:
+                    piece = extract(frame)
+                    if piece:
+                        pieces.append(piece)
+
+            gw.stream_request(body, route_key, api_path, extract, reframe,
+                              emit, on_commit)
+            if "error" in final:
+                retry = final.get("retry_after_s")
+                self._send_json(
+                    {"error": final["error"]}, 502,
+                    headers=({"Retry-After": str(int(retry))}
+                             if retry else None))
+                return
+            text = "".join(pieces)
+            if final_text_key == "message":
+                final["message"] = dict(final.get("message")
+                                        or {"role": "assistant"},
+                                        content=text)
+            else:
+                final[final_text_key] = text
+            self._send_json(final)
+
+
+# ---------------------------------------------------------------------------
+# entrypoint (the gateway Deployment's container runs this module)
+# ---------------------------------------------------------------------------
+
+def _discovery_from_env():
+    e = os.environ
+    urls = e.get("TPU_GATEWAY_REPLICAS")
+    if urls:
+        fixed = static_replicas([u.strip() for u in urls.split(",")
+                                 if u.strip()])
+        return fixed, None
+    selector = e.get("TPU_GATEWAY_SELECTOR")
+    if selector and "/" in selector:
+        namespace, app = selector.split("/", 1)
+        from .client import KubeClient
+        return None, kube_discovery(KubeClient(), namespace, app)
+    raise SystemExit("gateway needs TPU_GATEWAY_REPLICAS (static URLs) or "
+                     "TPU_GATEWAY_SELECTOR (namespace/app)")
+
+
+def main() -> None:
+    replicas, discover = _discovery_from_env()
+    gw = Gateway(replicas=replicas, discover=discover, host="0.0.0.0")
+    gw.start()
+    FLIGHT.record("gateway_started", port=gw.port,
+                  replicas=len(gw._replicas))
+    try:
+        while True:
+            time.sleep(60)
+    except KeyboardInterrupt:
+        gw.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -160,6 +160,35 @@ def new_server_container(
     return container
 
 
+def new_gateway_container(
+    *,
+    namespace: str,
+    app: str,
+    image: str = SERVER_BASE_IMAGE,
+) -> Dict[str, Any]:
+    """The fleet-gateway container (operator/gateway.py): cache-aware
+    router + circuit breaker + stream-failover front for a replicated
+    Model. Runs the same runtime image (the gateway is stdlib-only, the
+    image has it), discovers replicas via the pod label selector, and
+    needs no TPU — it schedules anywhere."""
+    return {
+        "name": "gateway",
+        "image": image,
+        "command": ["python", "-m", "ollama_operator_tpu.operator.gateway"],
+        "env": [
+            {"name": "TPU_GATEWAY_SELECTOR", "value": f"{namespace}/{app}"},
+            {"name": "TPU_GATEWAY_PORT", "value": str(PORT)},
+        ],
+        "ports": [{"name": "http", "containerPort": PORT,
+                   "protocol": "TCP"}],
+        "startupProbe": _probe("/healthz", failure_threshold=30),
+        # ready iff >=1 replica is routable: an all-ejected fleet drops
+        # out of the Service instead of 503ing every request
+        "readinessProbe": _probe("/readyz", failure_threshold=3),
+        "livenessProbe": _probe("/healthz", failure_threshold=3),
+    }
+
+
 def new_puller_container(
     *,
     image: str,

@@ -342,12 +342,28 @@ class ModelReconciler:
                                  f"waiting for {app} readiness")
             return POLL
 
-        # 3) serving Service
+        # 2b) fleet gateway (replicated single-host Models only): ensured
+        # and spec-synced, but NEVER gating — Available tracks the model
+        # replicas; a slow gateway rollout must not mask a serving fleet,
+        # and the gateway itself goes unready when no replica is routable.
+        self._ensure_gateway(model, spec, namespace, image)
+
+        # 3) serving Service (selector SYNCED, not just created: enabling
+        # or disabling the gateway repoints the existing Service)
         svc = workload.build_model_service(model)
-        if self.c.get("v1", "Service", namespace, app) is None:
+        cur_svc = self.c.get("v1", "Service", namespace, app)
+        if cur_svc is None:
             self.c.create(svc)
             self.rec.event(model, "Normal", "ServiceCreated",
                            f"created service {app}")
+            return POLL
+        want_sel = (svc.get("spec") or {}).get("selector") or {}
+        if ((cur_svc.get("spec") or {}).get("selector") or {}) != want_sel:
+            cur_svc.setdefault("spec", {})["selector"] = want_sel
+            self.c.update(cur_svc)
+            self.rec.event(model, "Normal", "ServiceSelectorSynced",
+                           f"service {app} now selects "
+                           f"{want_sel.get('app', app)}")
             return POLL
         if not workload.is_service_ready(self.c, namespace, app):
             return POLL
@@ -386,6 +402,28 @@ class ModelReconciler:
                                         app, cur, stats)
         self.set_available(model)
         return DONE
+
+    def _ensure_gateway(self, model: Dict[str, Any], spec: ModelSpecView,
+                        namespace: str, image: str) -> None:
+        """Ensure (or tear down) the per-Model fleet gateway Deployment.
+        Non-gating by contract: callers never block Available on it."""
+        gw_app = workload.gateway_app_name(spec.name)
+        if not workload.gateway_enabled(spec):
+            if self.c.get("apps/v1", "Deployment", namespace,
+                          gw_app) is not None:
+                self.c.delete("apps/v1", "Deployment", namespace, gw_app)
+                self.rec.event(model, "Normal", "GatewayRemoved",
+                               f"removed fleet gateway {gw_app}")
+            return
+        want = workload.build_gateway_deployment(model, image)
+        workload.stamp_spec_hash(want)
+        cur = self.c.get("apps/v1", "Deployment", namespace, gw_app)
+        if cur is None:
+            self.c.create(want)
+            self.rec.event(model, "Normal", "GatewayCreated",
+                           f"created fleet gateway {gw_app}")
+            return
+        workload.update_model_workload(self.c, self.rec, model, cur, want)
 
     # --- closed-loop fleet control --------------------------------------
     def _autoscale_pass(self, model: Dict[str, Any], spec: ModelSpecView,

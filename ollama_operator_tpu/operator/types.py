@@ -148,6 +148,15 @@ class ModelSpecView:
         return self._spec.get("serverImage")
 
     @property
+    def gateway(self) -> Optional[bool]:
+        """`spec.gateway` tri-state: True forces the fleet gateway on,
+        False forces it off, None (absent) = auto — enabled whenever the
+        Model is a fleet (replicas > 1 or autoscaling), where round-robin
+        Service routing would shred prefix-cache locality."""
+        v = self._spec.get("gateway")
+        return None if v is None else bool(v)
+
+    @property
     def autoscale(self) -> Dict[str, Any]:
         """`spec.autoscale` block (absent = autoscaling off).
 

@@ -43,6 +43,27 @@ def headless_service_name(name: str) -> str:
     return f"{model_app_name(name)}-hosts"
 
 
+def gateway_app_name(name: str) -> str:
+    """The per-Model fleet-gateway Deployment/pod app label."""
+    return f"{model_app_name(name)}-gateway"
+
+
+def gateway_enabled(spec: ModelSpecView) -> bool:
+    """The gateway fronts single-host FLEETS: spec.gateway forces it
+    on/off; absent means auto — on when replicas > 1 or autoscaling is
+    enabled (the cases where the plain Service's random routing shreds
+    prefix-cache locality and a replica death is client-visible).
+    Multi-host slices are one sharded server behind host-0; nothing to
+    route across."""
+    placement = spec.tpu_placement()
+    if placement is not None and placement.multi_host:
+        return False
+    if spec.gateway is not None:
+        return spec.gateway
+    autoscaling = bool((spec.autoscale or {}).get("enabled"))
+    return spec.replicas > 1 or autoscaling
+
+
 # ---------------------------------------------------------------------------
 # image store (namespace singleton): PVC + StatefulSet + Service
 # ---------------------------------------------------------------------------
@@ -250,16 +271,55 @@ def build_headless_service(model: Dict[str, Any]) -> Dict[str, Any]:
     }
 
 
+def build_gateway_deployment(model: Dict[str, Any],
+                             server_image: str = podf.SERVER_BASE_IMAGE
+                             ) -> Dict[str, Any]:
+    """One fleet-gateway Deployment per gatewayed Model (operator/
+    gateway.py): cache-aware routing by prefix hash, per-replica circuit
+    breaking, and zero-error cross-replica stream failover. The model
+    Service's selector is pointed at THIS deployment when the gateway is
+    enabled (build_model_service), so clients keep the same DNS name."""
+    spec = ModelSpecView(model)
+    app = model_app_name(spec.name)
+    gw_app = gateway_app_name(spec.name)
+    gw = podf.new_gateway_container(namespace=spec.namespace, app=app,
+                                    image=server_image)
+    if spec.image_pull_policy:
+        gw["imagePullPolicy"] = spec.image_pull_policy
+    pod_spec: Dict[str, Any] = {"containers": [gw]}
+    if spec.image_pull_secrets:
+        pod_spec["imagePullSecrets"] = copy.deepcopy(spec.image_pull_secrets)
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {
+            "name": gw_app, "namespace": spec.namespace,
+            "labels": {"app": gw_app},
+            "ownerReferences": [owner_reference(model)],
+        },
+        "spec": {
+            "replicas": 1,
+            "selector": {"matchLabels": {"app": gw_app}},
+            "template": {"metadata": {"labels": {"app": gw_app}},
+                         "spec": pod_spec},
+        },
+    }
+
+
 def build_model_service(model: Dict[str, Any]) -> Dict[str, Any]:
     """ClusterIP LB over serving pods (model.go:203-256 equivalent). For
     multi-host, only host-0 carries the `serving` role label so requests
-    land on the process that owns the HTTP front."""
+    land on the process that owns the HTTP front. When the fleet gateway
+    is enabled the Service selects the gateway pod instead — same DNS
+    name, routing-law-aware backend."""
     spec = ModelSpecView(model)
     app = model_app_name(spec.name)
     placement = spec.tpu_placement()
     selector = {"app": app}
     if placement is not None and placement.multi_host:
         selector["apps.kubernetes.io/pod-index"] = "0"
+    elif gateway_enabled(spec):
+        selector = {"app": gateway_app_name(spec.name)}
     return {
         "apiVersion": "v1",
         "kind": "Service",

@@ -42,6 +42,16 @@ Fault points wired through the codebase:
                        like a slow pod — the autoscaler chaos drills
                        assert the control loop holds its last decision
                        (fails static) instead of scaling on the hole
+    gateway.route   -- ``gateway.Gateway`` after a replica has been
+                       picked but before the request is dispatched to
+                       it; an armed fail makes the dispatch attempt
+                       count as a replica failure (circuit feeding),
+                       an armed delay models a slow proxy hop
+    gateway.stream  -- per upstream response chunk inside the gateway's
+                       stream pump; an armed fail severs the upstream
+                       mid-stream exactly like a replica death (the
+                       failover drills ride this), an armed delay
+                       models a stalling replica
 
 Trigger specs (the grammar is intentionally tiny):
 

@@ -296,6 +296,39 @@ declare("TPU_REMEDIATION_BACKOFF_S", "float", 10, "autoscale",
 declare("TPU_REMEDIATION_BACKOFF_CAP_S", "float", 300, "autoscale",
         "remediation backoff ceiling")
 
+# -- gateway ----------------------------------------------------------------
+
+declare("TPU_GATEWAY_PORT", "int", 11434, "gateway",
+        "listen port of the fleet gateway process")
+declare("TPU_GATEWAY_REPLICAS", "str", None, "gateway",
+        "comma-separated replica base URLs (static discovery); unset = "
+        "discover via TPU_GATEWAY_SELECTOR")
+declare("TPU_GATEWAY_SELECTOR", "str", None, "gateway",
+        "namespace/app pod selector for in-cluster replica discovery; "
+        "operator-injected")
+declare("TPU_GATEWAY_HASH_CHUNK", "int", 256, "gateway",
+        "prompt characters per page-aligned prefix-hash chunk in the "
+        "routing law")
+declare("TPU_GATEWAY_PROBE", "bool", 1, "gateway",
+        "0 skips the /api/prefix_probe scatter on an affinity miss "
+        "(route straight to least-loaded)")
+declare("TPU_GATEWAY_EJECT_FAILURES", "int", 3, "gateway",
+        "consecutive request/scrape failures that open a replica's "
+        "circuit")
+declare("TPU_GATEWAY_EJECT_S", "float", 10, "gateway",
+        "seconds a replica's circuit stays open before half-open "
+        "admits one probe request")
+declare("TPU_GATEWAY_SLOW_SCRAPE_MS", "float", 1000, "gateway",
+        "scrape latency above this counts as a health failure")
+declare("TPU_GATEWAY_SCRAPE_S", "float", 2, "gateway",
+        "period of the gateway's background health/load scrape loop")
+declare("TPU_GATEWAY_HEDGE_MS", "float", 0, "gateway",
+        "first-byte wait before a queued-but-unstarted request fails "
+        "over to another replica; 0 = only on replica death")
+declare("TPU_GATEWAY_JOURNAL", "int", 512, "gateway",
+        "completed-request journal entries kept for failover replay "
+        "bookkeeping")
+
 
 def _main() -> None:
     by_sub: Dict[str, List[Knob]] = {}

@@ -1186,6 +1186,7 @@ class Handler(BaseHTTPRequestHandler):
                 "/api/embeddings": self._api_embeddings,
                 "/api/embed": self._api_embed,
                 "/api/drain": self._api_drain,
+                "/api/prefix_probe": self._api_prefix_probe,
                 "/v1/chat/completions": self._oai_chat,
                 "/v1/completions": self._oai_completions,
                 "/v1/embeddings": self._oai_embeddings,
@@ -1559,6 +1560,34 @@ class Handler(BaseHTTPRequestHandler):
             "active_streams": int(getattr(sched, "n_active", 0) or 0),
             "queued": int(getattr(sched, "qsize", 0) or 0),
         })
+
+    def _api_prefix_probe(self, body: Dict):
+        """Non-mutating radix-cache probe for the fleet gateway's
+        cache-aware routing: how many leading tokens of this request's
+        rendered prompt THIS replica could serve from its prefix cache
+        right now. The gateway scatters the probe to healthy replicas on
+        an affinity-table miss and routes to the longest match. Renders
+        the prompt exactly like /api/generate so the probed ids equal
+        the ids the real request would admit with."""
+        model = self._model_arg(body)
+        prompt = body.get("prompt", "")
+        lm = self.manager.require_loaded(model,
+                                         keep_alive=body.get("keep_alive"))
+        raw = bool(body.get("raw", False))
+        text = prompt if raw else lm.render_prompt(
+            prompt, system=body.get("system"),
+            template=body.get("template"), suffix=body.get("suffix"))
+        tok = getattr(lm, "tokenizer", None)
+        engine = getattr(lm, "engine", None)
+        matched = 0
+        n_ids = 0
+        if tok is not None and engine is not None:
+            ids = tok.encode(text, add_bos=tok.add_bos)
+            n_ids = len(ids)
+            if n_ids > 1:
+                matched = int(engine.prefix_probe(ids))
+        self._send_json({"model": model, "matched_tokens": matched,
+                         "prompt_tokens": n_ids})
 
     def _api_embeddings(self, body: Dict):
         lm = self.manager.require_loaded(self._model_arg(body),

@@ -355,6 +355,35 @@ GLOBAL.describe("tpu_model_warm_snapshot_saves_total",
                 "AOT warm-bucket executable cache snapshots persisted "
                 "to the image-store PVC at drain time (scale-to-zero "
                 "fast cold-start)")
+GLOBAL.describe("tpu_model_scrape_failures_total",
+                "Replica /api/ps scrapes the operator lost, by cause "
+                "(cause=fault|http|network|parse): each one is a hole "
+                "in the autoscaler's evidence — correlate with "
+                "tpu_model_autoscale_holds_total{cause=\"no_data\"} to "
+                "attribute fail-static holds (operator/client.py)")
+GLOBAL.describe("tpu_model_gateway_routes_total",
+                "Gateway routing decisions by resolution path "
+                "(path=affinity|probe|least_loaded): affinity = "
+                "prefix-hash table hit, probe = /api/prefix_probe "
+                "scatter won, least_loaded = no cache evidence "
+                "(operator/gateway.py)")
+GLOBAL.describe("tpu_model_gateway_failovers_total",
+                "Streams the gateway moved off a dead replica, by "
+                "outcome (result=replayed|requeued|errored): replayed = "
+                "mid-stream continuation on a healthy replica (zero "
+                "client error frames), requeued = unstarted request "
+                "re-dispatched, errored = non-replayable stream given "
+                "the exactly-once error with Retry-After")
+GLOBAL.describe("tpu_model_gateway_ejections_total",
+                "Replica circuits opened by the gateway health state "
+                "machine, by trigger (cause=failures|slow|not_ready)")
+GLOBAL.describe("tpu_model_gateway_half_open_probes_total",
+                "Half-open circuit probe requests admitted (exactly one "
+                "per eject window), by outcome (result=ok|fail)")
+GLOBAL.describe("tpu_model_gateway_replicas",
+                "Replicas the gateway currently tracks in each health "
+                "state (state=probe|healthy|ejected|half_open|draining) "
+                "— the circuit-state view of the fleet")
 GLOBAL.describe("tpu_model_warm_snapshot_restores_total",
                 "Engine warm-ups served from a persisted warm snapshot "
                 "instead of a from-scratch warm_buckets compile pass — "
@@ -450,6 +479,25 @@ for _cause in ("unreachable", "crash_loop"):
 GLOBAL.inc("tpu_model_remediation_backoff_holds_total", 0.0)
 GLOBAL.inc("tpu_model_warm_snapshot_saves_total", 0.0)
 GLOBAL.inc("tpu_model_warm_snapshot_restores_total", 0.0)
+# fleet gateway (operator/gateway.py) + scrape attribution: failovers and
+# circuit ejections are the rare events the fleet dashboards alert on, so
+# every labelled combination must read 0, not absent, before the first
+# replica ever misbehaves
+for _cause in ("fault", "http", "network", "parse"):
+    GLOBAL.inc("tpu_model_scrape_failures_total", 0.0,
+               f'{{cause="{_cause}"}}')
+for _path in ("affinity", "probe", "least_loaded"):
+    GLOBAL.inc("tpu_model_gateway_routes_total", 0.0,
+               f'{{path="{_path}"}}')
+for _result in ("replayed", "requeued", "errored"):
+    GLOBAL.inc("tpu_model_gateway_failovers_total", 0.0,
+               f'{{result="{_result}"}}')
+for _cause in ("failures", "slow", "not_ready"):
+    GLOBAL.inc("tpu_model_gateway_ejections_total", 0.0,
+               f'{{cause="{_cause}"}}')
+for _result in ("ok", "fail"):
+    GLOBAL.inc("tpu_model_gateway_half_open_probes_total", 0.0,
+               f'{{result="{_result}"}}')
 
 
 class Stopwatch:

@@ -1,0 +1,896 @@
+"""Fleet gateway tests: routing law, circuit breaking, stream failover.
+
+The replicas here are real HTTP servers (FakeReplica) speaking the
+runtime's NDJSON protocol with a DETERMINISTIC generator — the emitted
+text is a pure function of (prompt, seed, temperature), identical on
+every replica, exactly the property PR 9's replay machinery guarantees
+for greedy/seeded streams. That makes the failover contract directly
+checkable: kill replica A mid-stream, let the gateway splice replica B
+onto the same client stream, and compare bytes against an uninterrupted
+reference run.
+
+The chaos drills (-m chaos) ride the gateway.route / gateway.stream
+fault points; drill 9 in CI (kill replica mid-stream under load) runs
+TestChaosDrills::test_drill9_replica_killed_mid_stream_under_load.
+"""
+
+import hashlib
+import json
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from ollama_operator_tpu.operator import gateway as gwmod
+from ollama_operator_tpu.operator.client import fetch_replica_ps
+from ollama_operator_tpu.operator.gateway import Gateway, NoReplicas
+from ollama_operator_tpu.runtime.faults import FAULTS
+from ollama_operator_tpu.runtime.trace import FLIGHT
+from ollama_operator_tpu.server.metrics import GLOBAL as METRICS
+
+
+# ---------------------------------------------------------------------------
+# deterministic fake replica
+# ---------------------------------------------------------------------------
+
+def gen_pieces(key: str, n: int):
+    """The deterministic 'model': piece i is a pure function of the
+    request key and position, so any replica regenerates identical text."""
+    out = []
+    for i in range(n):
+        h = hashlib.sha256(f"{key}|{i}".encode()).hexdigest()
+        out.append(f" {h[:4]}")
+    return out
+
+
+def request_key(body):
+    """What the generated text depends on — greedy ignores the seed
+    (argmax is argmax), seeded sampling depends on it."""
+    if "messages" in body:
+        prompt = "".join((m.get("content") or "")
+                         for m in body.get("messages") or [])
+    else:
+        prompt = (body.get("system") or "") + (body.get("prompt") or "")
+    o = body.get("options") or {}
+    t = float(o.get("temperature", 0.7))
+    if t == 0.0:
+        return f"greedy|{prompt}"
+    return f"sampled|{prompt}|seed={o.get('seed')}"
+
+
+def expected_text(body):
+    o = (body or {}).get("options") or {}
+    return "".join(gen_pieces(request_key(body),
+                              int(o.get("num_predict", 8))))
+
+
+class FakeReplica:
+    """One backend server. Controls: ``ctl['down']`` refuses every
+    request at the socket level (replica death), ``ctl['die_after']``
+    severs the NEXT generate stream after N data frames and then marks
+    the replica down (death mid-stream), ``ctl['draining']`` flips
+    /readyz to the drain 503."""
+
+    def __init__(self):
+        self.ctl = {"down": False, "die_after": None, "draining": False,
+                    "slow_ready_s": 0.0}
+        self.seen = []          # prompts served (prefix_probe evidence)
+        self.served = 0
+        self._lock = threading.Lock()
+        replica = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *_a):
+                pass
+
+            def _down(self):
+                if replica.ctl["down"]:
+                    # hard death: close the socket without a response
+                    self.close_connection = True
+                    self.connection.close()
+                    return True
+                return False
+
+            def _json(self, obj, status=200):
+                data = json.dumps(obj).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                if self._down():
+                    return
+                if self.path == "/readyz":
+                    if replica.ctl["slow_ready_s"]:
+                        import time as _t
+                        _t.sleep(replica.ctl["slow_ready_s"])
+                    if replica.ctl["draining"]:
+                        self._json({"status": "draining"}, 503)
+                    else:
+                        self._json({"status": "ok"})
+                    return
+                if self.path == "/api/ps":
+                    with replica._lock:
+                        active = replica.served
+                    self._json({"models": [{
+                        "name": "phi", "utilization": {"occupancy": 0.1},
+                        "lifecycle": {"state": "serving",
+                                      "active_streams": 0},
+                        "admission": {"queued_by_class": {}},
+                    }]})
+                    return
+                self._json({"error": "not found"}, 404)
+
+            def do_POST(self):
+                if self._down():
+                    return
+                n = int(self.headers.get("Content-Length") or 0)
+                body = json.loads(self.rfile.read(n)) if n else {}
+                if self.path == "/api/prefix_probe":
+                    prompt = ((body.get("system") or "")
+                              + (body.get("prompt") or ""))
+                    best = 0
+                    with replica._lock:
+                        for s in replica.seen:
+                            k = 0
+                            for a, b in zip(s, prompt):
+                                if a != b:
+                                    break
+                                k += 1
+                            best = max(best, k)
+                    self._json({"model": body.get("model"),
+                                "matched_tokens": best // 4,
+                                "prompt_tokens": len(prompt) // 4})
+                    return
+                if self.path in ("/api/generate", "/api/chat"):
+                    self._generate(body)
+                    return
+                self._json({"ok": True})
+
+            def _chunk(self, data):
+                self.wfile.write(f"{len(data):x}\r\n".encode() + data
+                                 + b"\r\n")
+                self.wfile.flush()
+
+            def _generate(self, body):
+                if "messages" in body:
+                    prompt = "".join((m.get("content") or "")
+                                     for m in body.get("messages") or [])
+                else:
+                    prompt = ((body.get("system") or "")
+                              + (body.get("prompt") or ""))
+                o = body.get("options") or {}
+                n = int(o.get("num_predict", 8))
+                pieces = gen_pieces(request_key(body), n)
+                with replica._lock:
+                    replica.seen.append(prompt)
+                    replica.served += 1
+                    die_after = replica.ctl["die_after"]
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                chat = self.path == "/api/chat"
+                for i, piece in enumerate(pieces):
+                    if die_after is not None and i >= die_after:
+                        # replica death mid-stream: no terminal chunk,
+                        # socket torn down, and the replica stays dead
+                        replica.ctl["die_after"] = None
+                        replica.ctl["down"] = True
+                        self.close_connection = True
+                        self.connection.close()
+                        return
+                    if chat:
+                        frame = {"model": body.get("model"),
+                                 "message": {"role": "assistant",
+                                             "content": piece},
+                                 "done": False}
+                    else:
+                        frame = {"model": body.get("model"),
+                                 "response": piece, "done": False}
+                    self._chunk(json.dumps(frame).encode() + b"\n")
+                final = {"model": body.get("model"), "done": True,
+                         "done_reason": "stop", "eval_count": n}
+                if chat:
+                    final["message"] = {"role": "assistant", "content": ""}
+                else:
+                    final["response"] = ""
+                self._chunk(json.dumps(final).encode() + b"\n")
+                self.wfile.write(b"0\r\n\r\n")
+                self.wfile.flush()
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.httpd.daemon_threads = True
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+@pytest.fixture()
+def replicas(request):
+    """Two fake replicas + teardown; ask for more via indirect param."""
+    n = getattr(request, "param", 2)
+    reps = [FakeReplica() for _ in range(n)]
+    yield reps
+    for r in reps:
+        r.stop()
+
+
+@pytest.fixture()
+def gw_env(monkeypatch):
+    """Deterministic gateway knobs for tests: no background scrape, fast
+    circuits, no hedging."""
+    monkeypatch.setenv("TPU_GATEWAY_EJECT_FAILURES", "2")
+    monkeypatch.setenv("TPU_GATEWAY_EJECT_S", "0.05")
+    monkeypatch.setenv("TPU_GATEWAY_SLOW_SCRAPE_MS", "5000")
+    monkeypatch.setenv("TPU_GATEWAY_HASH_CHUNK", "64")
+    return monkeypatch
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    FAULTS.reset()
+
+
+def make_gateway(reps, **kw):
+    kw.setdefault("scrape_period_s", 0)
+    kw.setdefault("port", 0)
+    gw = Gateway(replicas=[(f"rep-{i}", r.url)
+                           for i, r in enumerate(reps)], **kw)
+    return gw
+
+
+def stream_frames(base_url, path, body, timeout=30.0):
+    """POST and parse the NDJSON response into frames; mid-stream socket
+    errors surface as exceptions (the gateway must never let them)."""
+    req = urllib.request.Request(
+        f"{base_url}{path}", data=json.dumps(body).encode(), method="POST",
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        raw = resp.read().decode()
+    return [json.loads(line) for line in raw.splitlines() if line.strip()]
+
+
+def joined_text(frames, chat=False):
+    if chat:
+        return "".join((f.get("message") or {}).get("content", "")
+                       for f in frames if not f.get("done"))
+    return "".join(f.get("response", "") for f in frames
+                   if not f.get("done") and "error" not in f)
+
+
+def metric(name, labels=""):
+    return METRICS.get(name, labels)
+
+
+# ---------------------------------------------------------------------------
+# routing law
+# ---------------------------------------------------------------------------
+
+class TestRouting:
+    def test_chunk_hashes_are_chained_and_page_aligned(self, gw_env):
+        gw = Gateway(replicas=["http://x"], port=0, scrape_period_s=0)
+        a = gw.chunk_hashes("a" * 128)
+        b = gw.chunk_hashes("a" * 128 + "b" * 70)
+        assert len(a) == 2 and len(b) == 3  # partial tail excluded
+        assert b[:2] == a  # shared prefix -> identical chain prefix
+        c = gw.chunk_hashes("c" * 64 + "a" * 64)
+        assert c[1] != a[1]  # chain commits to EVERYTHING before
+
+    def test_affinity_hit_after_first_route(self, gw_env):
+        gw = Gateway(replicas=["http://a", "http://b"], port=0,
+                     scrape_period_s=0)
+        key = "s" * 200
+        name1, path1 = gw.pick(key)
+        assert path1 == "least_loaded"
+        name2, path2 = gw.pick(key)
+        assert (name2, path2) == (name1, "affinity")
+        # a longer prompt sharing the prefix still hits the table
+        name3, path3 = gw.pick(key + "x" * 80)
+        assert (name3, path3) == (name1, "affinity")
+
+    def test_least_loaded_breaks_toward_idle_replica(self, gw_env):
+        gw = Gateway(replicas=["http://a", "http://b"], port=0,
+                     scrape_period_s=0)
+        gw._replicas["replica-0"].load = 5.0
+        name, path = gw.pick("z" * 100)
+        assert (name, path) == ("replica-1", "least_loaded")
+
+    def test_probe_scatter_finds_warm_replica(self, gw_env, replicas):
+        a, b = replicas
+        b.seen.append("s" * 300)  # replica B already served this prefix
+        gw = make_gateway(replicas)
+        name, path = gw.pick("s" * 300,
+                             probe_body={"model": "phi", "prompt": "s" * 300})
+        assert (name, path) == ("rep-1", "probe")
+
+    def test_probe_disabled_by_knob(self, gw_env, replicas):
+        gw_env.setenv("TPU_GATEWAY_PROBE", "0")
+        a, b = replicas
+        b.seen.append("s" * 300)
+        gw = make_gateway(replicas)
+        _, path = gw.pick("s" * 300,
+                          probe_body={"model": "phi", "prompt": "s" * 300})
+        assert path == "least_loaded"
+
+    def test_no_replicas_raises_with_finite_retry(self, gw_env):
+        gw = Gateway(replicas=[], port=0, scrape_period_s=0)
+        with pytest.raises(NoReplicas) as ei:
+            gw.pick("x" * 100)
+        assert 1 <= ei.value.retry_after_s <= 30
+
+
+# ---------------------------------------------------------------------------
+# health state machine / circuit breaking
+# ---------------------------------------------------------------------------
+
+class TestCircuit:
+    def test_scrape_heals_probe_to_healthy(self, gw_env, replicas):
+        gw = make_gateway(replicas)
+        assert gw.state_counts()["probe"] == 2
+        gw.scrape_once()
+        assert gw.state_counts()["healthy"] == 2
+
+    def test_dead_replica_ejects_after_consecutive_failures(self, gw_env,
+                                                            replicas):
+        a, b = replicas
+        a.ctl["down"] = True
+        gw = make_gateway(replicas)
+        before = metric("tpu_model_gateway_ejections_total",
+                        '{cause="not_ready"}')
+        gw.scrape_once()
+        gw.scrape_once()  # EJECT_FAILURES=2
+        counts = gw.state_counts()
+        assert counts["ejected"] == 1 and counts["healthy"] == 1
+        assert metric("tpu_model_gateway_ejections_total",
+                      '{cause="not_ready"}') == before + 1
+        # routing never lands on the open circuit
+        for i in range(6):
+            name, _ = gw.pick(f"q{i}" * 60)
+            assert name == "rep-1"
+
+    def test_draining_replica_is_parked_not_ejected(self, gw_env, replicas):
+        a, b = replicas
+        gw = make_gateway(replicas)
+        gw.scrape_once()
+        a.ctl["draining"] = True
+        before = metric("tpu_model_gateway_ejections_total",
+                        '{cause="not_ready"}')
+        gw.scrape_once()
+        counts = gw.state_counts()
+        assert counts["draining"] == 1
+        assert metric("tpu_model_gateway_ejections_total",
+                      '{cause="not_ready"}') == before
+        # drain ends -> replica returns without ever opening the circuit
+        a.ctl["draining"] = False
+        gw.scrape_once()
+        assert gw.state_counts()["healthy"] == 2
+
+    def test_half_open_admits_exactly_one_probe_request(self, gw_env):
+        import time
+        gw = Gateway(replicas=["http://a"], port=0, scrape_period_s=0)
+        r = gw._replicas["replica-0"]
+        with gw._lock:
+            gw._fail_locked(r, "failures", "boom")
+            gw._fail_locked(r, "failures", "boom")
+        assert r.state == "ejected"
+        with pytest.raises(NoReplicas):
+            gw.pick("x" * 100)  # circuit open: nothing routable
+        time.sleep(0.06)  # EJECT_S=0.05
+        name, _ = gw.pick("x" * 100)  # half-open: the ONE trial
+        assert name == "replica-0" and r.state == "half_open"
+        with pytest.raises(NoReplicas):
+            gw.pick("y" * 100)  # second request denied while trial runs
+        ok_before = metric("tpu_model_gateway_half_open_probes_total",
+                           '{result="ok"}')
+        gw._request_ok("replica-0")
+        assert r.state == "healthy"
+        assert metric("tpu_model_gateway_half_open_probes_total",
+                      '{result="ok"}') == ok_before + 1
+
+    def test_half_open_failure_reopens_circuit(self, gw_env):
+        import time
+        gw = Gateway(replicas=["http://a"], port=0, scrape_period_s=0)
+        r = gw._replicas["replica-0"]
+        with gw._lock:
+            gw._fail_locked(r, "failures", "boom")
+            gw._fail_locked(r, "failures", "boom")
+        time.sleep(0.06)
+        gw.pick("x" * 100)
+        fail_before = metric("tpu_model_gateway_half_open_probes_total",
+                             '{result="fail"}')
+        gw._request_failed("replica-0", "still broken")
+        assert r.state == "ejected"
+        assert metric("tpu_model_gateway_half_open_probes_total",
+                      '{result="fail"}') == fail_before + 1
+
+    def test_slow_scrape_counts_as_failure(self, gw_env, replicas):
+        gw_env.setenv("TPU_GATEWAY_SLOW_SCRAPE_MS", "10")
+        a, b = replicas
+        a.ctl["slow_ready_s"] = 0.05
+        gw = make_gateway(replicas)
+        before = metric("tpu_model_gateway_ejections_total",
+                        '{cause="slow"}')
+        gw.scrape_once()
+        gw.scrape_once()
+        assert gw.state_counts()["ejected"] == 1
+        assert metric("tpu_model_gateway_ejections_total",
+                      '{cause="slow"}') == before + 1
+
+
+# ---------------------------------------------------------------------------
+# stream failover (the zero-error contract)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def served_gw(gw_env, replicas):
+    gw = make_gateway(replicas).start()
+    yield gw, replicas
+    gw.stop()
+
+
+GREEDY = {"temperature": 0, "num_predict": 10}
+SEEDED = {"temperature": 0.9, "seed": 42, "num_predict": 10}
+SAMPLED = {"temperature": 0.9, "num_predict": 10}
+
+
+class TestFailover:
+    def _reference(self, body):
+        return expected_text(body)
+
+    def test_greedy_stream_continues_bit_identically(self, served_gw):
+        gw, (a, b) = served_gw
+        body = {"model": "phi", "prompt": "p" * 200, "options": dict(GREEDY),
+                "stream": True}
+        a.ctl["die_after"] = 4  # least-loaded tiebreak routes to rep-0
+        before = metric("tpu_model_gateway_failovers_total",
+                        '{result="replayed"}')
+        frames = stream_frames(gw.base_url, "/api/generate", body)
+        assert not any("error" in f for f in frames)
+        assert frames[-1].get("done") is True
+        assert joined_text(frames) == self._reference(body)
+        assert metric("tpu_model_gateway_failovers_total",
+                      '{result="replayed"}') == before + 1
+        assert gw.journal_stats()["live"] == 0
+
+    def test_seeded_stream_continues_bit_identically(self, served_gw):
+        gw, (a, b) = served_gw
+        body = {"model": "phi", "prompt": "q" * 200, "options": dict(SEEDED),
+                "stream": True}
+        a.ctl["die_after"] = 3
+        frames = stream_frames(gw.base_url, "/api/generate", body)
+        assert not any("error" in f for f in frames)
+        assert joined_text(frames) == self._reference(body)
+
+    def test_chat_stream_failover(self, served_gw):
+        gw, (a, b) = served_gw
+        body = {"model": "phi",
+                "messages": [{"role": "user", "content": "m" * 200}],
+                "options": dict(GREEDY), "stream": True}
+        a.ctl["die_after"] = 4
+        frames = stream_frames(gw.base_url, "/api/chat", body)
+        assert not any("error" in f for f in frames)
+        assert joined_text(frames, chat=True) == self._reference(body)
+
+    def test_non_replayable_stream_errors_exactly_once(self, served_gw):
+        gw, (a, b) = served_gw
+        body = {"model": "phi", "prompt": "r" * 200,
+                "options": dict(SAMPLED), "stream": True}
+        a.ctl["die_after"] = 4
+        before = metric("tpu_model_gateway_failovers_total",
+                        '{result="errored"}')
+        frames = stream_frames(gw.base_url, "/api/generate", body)
+        errors = [f for f in frames if "error" in f]
+        assert len(errors) == 1  # the classic exactly-once contract
+        assert frames[-1] is errors[0]  # terminal, nothing after it
+        retry = errors[0].get("retry_after_s")
+        assert retry is not None and 1 <= retry <= 30
+        assert metric("tpu_model_gateway_failovers_total",
+                      '{result="errored"}') == before + 1
+        assert gw.journal_stats()["live"] == 0
+
+    def test_unstarted_request_fails_over_unconditionally(self, served_gw):
+        gw, (a, b) = served_gw
+        a.ctl["down"] = True  # dead before a single frame
+        body = {"model": "phi", "prompt": "u" * 200,
+                "options": dict(SAMPLED), "stream": True}
+        before = metric("tpu_model_gateway_failovers_total",
+                        '{result="requeued"}')
+        frames = stream_frames(gw.base_url, "/api/generate", body)
+        assert not any("error" in f for f in frames)
+        assert joined_text(frames) == self._reference(body)
+        assert metric("tpu_model_gateway_failovers_total",
+                      '{result="requeued"}') >= before + 1
+
+    def test_non_streaming_client_survives_failover(self, served_gw):
+        gw, (a, b) = served_gw
+        a.ctl["die_after"] = 4
+        body = {"model": "phi", "prompt": "n" * 200,
+                "options": dict(GREEDY), "stream": False}
+        req = urllib.request.Request(
+            f"{gw.base_url}/api/generate", data=json.dumps(body).encode(),
+            method="POST", headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30.0) as resp:
+            out = json.loads(resp.read().decode())
+        assert out.get("done") is True
+        assert out["response"] == self._reference(body)
+
+    def test_all_replicas_down_is_503_with_retry_after(self, served_gw):
+        gw, (a, b) = served_gw
+        a.ctl["down"] = True
+        b.ctl["down"] = True
+        body = {"model": "phi", "prompt": "d" * 100,
+                "options": dict(GREEDY), "stream": True}
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            stream_frames(gw.base_url, "/api/generate", body)
+        assert ei.value.code == 503
+        assert int(ei.value.headers.get("Retry-After") or 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# journal / endpoints
+# ---------------------------------------------------------------------------
+
+class TestJournalAndEndpoints:
+    def test_journal_ring_is_bounded(self, gw_env, replicas):
+        gw_env.setenv("TPU_GATEWAY_JOURNAL", "3")
+        gw = make_gateway(replicas).start()
+        try:
+            for i in range(6):
+                body = {"model": "phi", "prompt": f"j{i}" * 60,
+                        "options": dict(GREEDY), "stream": True}
+                stream_frames(gw.base_url, "/api/generate", body)
+            stats = gw.journal_stats()
+            assert stats == {"live": 0, "kept": 3}
+        finally:
+            gw.stop()
+
+    def test_journal_entry_records_identity_and_hash(self, gw_env, replicas):
+        gw = make_gateway(replicas).start()
+        try:
+            body = {"model": "phi", "prompt": "h" * 120,
+                    "options": {"temperature": 0, "num_predict": 6,
+                                "priority": "interactive",
+                                "tenant": "acme"},
+                    "stream": True}
+            stream_frames(gw.base_url, "/api/generate", body)
+            entry = next(iter(gw._done.values()))
+            assert entry["class"] == "interactive"
+            assert entry["tenant"] == "acme"
+            assert entry["replayable"] is True
+            want = hashlib.sha256(
+                expected_text(body).encode()).hexdigest()
+            assert entry["hash"] == want
+        finally:
+            gw.stop()
+
+    def test_status_and_readyz_and_aggregate_ps(self, gw_env, replicas):
+        gw = make_gateway(replicas).start()
+        try:
+            gw.scrape_once()
+            st = json.loads(urllib.request.urlopen(
+                f"{gw.base_url}/gateway/status", timeout=5).read())
+            assert len(st["replicas"]) == 2
+            assert all(r["state"] == "healthy" for r in st["replicas"])
+            rz = urllib.request.urlopen(f"{gw.base_url}/readyz", timeout=5)
+            assert rz.status == 200
+            ps = json.loads(urllib.request.urlopen(
+                f"{gw.base_url}/api/ps", timeout=5).read())
+            assert {m["replica"] for m in ps["models"]} == {"rep-0", "rep-1"}
+        finally:
+            gw.stop()
+
+    def test_readyz_503_when_fleet_unroutable(self, gw_env, replicas):
+        for r in replicas:
+            r.ctl["down"] = True
+        gw = make_gateway(replicas)
+        rep = gw._replicas["rep-0"]
+        rep2 = gw._replicas["rep-1"]
+        with gw._lock:
+            for rr in (rep, rep2):
+                gw._fail_locked(rr, "failures", "x")
+                gw._fail_locked(rr, "failures", "x")
+        gw.start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(f"{gw.base_url}/readyz", timeout=5)
+            assert ei.value.code == 503
+        finally:
+            gw.stop()
+
+
+# ---------------------------------------------------------------------------
+# operator scrape-failure accounting (satellite 3)
+# ---------------------------------------------------------------------------
+
+class TestScrapeFailureAccounting:
+    def test_network_failure_counts_and_leaves_breadcrumb(self):
+        before = metric("tpu_model_scrape_failures_total",
+                        '{cause="network"}')
+        seq = FLIGHT.seq
+        out = fetch_replica_ps("http://127.0.0.1:9/api/ps", timeout=0.2)
+        assert out is None
+        assert metric("tpu_model_scrape_failures_total",
+                      '{cause="network"}') == before + 1
+        evs = [e for e in FLIGHT.snapshot()
+               if e["seq"] > seq and e["kind"] == "scrape_failed"]
+        assert evs and evs[-1]["cause"] == "network"
+
+    def test_injected_fault_counts_as_fault(self):
+        FAULTS.arm("operator.scrape", "fail:once")
+        before = metric("tpu_model_scrape_failures_total",
+                        '{cause="fault"}')
+        assert fetch_replica_ps("http://127.0.0.1:9/api/ps") is None
+        assert metric("tpu_model_scrape_failures_total",
+                      '{cause="fault"}') == before + 1
+
+    def test_http_error_counts_as_http(self, replicas):
+        a, _ = replicas
+        before = metric("tpu_model_scrape_failures_total",
+                        '{cause="http"}')
+        assert fetch_replica_ps(f"{a.url}/nope", timeout=2.0) is None
+        assert metric("tpu_model_scrape_failures_total",
+                      '{cause="http"}') == before + 1
+
+
+# ---------------------------------------------------------------------------
+# K=4 fake-kube fleet e2e (CI gateway-smoke drives this)
+# ---------------------------------------------------------------------------
+
+SYSTEM_512_TOK = ("You are a meticulous TPU serving assistant. " * 48)[:2048]
+
+
+@pytest.mark.parametrize("replicas", [4], indirect=True)
+class TestFleetE2E:
+    def test_k4_shared_prefix_fleet_and_replica_kill(self, gw_env, replicas,
+                                                     tmp_path):
+        """The ISSUE acceptance arm: K=4 fleet, every request sharing a
+        512-token system prompt. Cache-aware routing must concentrate the
+        shared prefix (affinity hits ~ a single-replica fleet would get)
+        and a replica kill mid-run must stay invisible to greedy
+        clients. Publishes the per-replica table when GATEWAY_TABLE is
+        set (the CI job summary)."""
+        import os
+        gw = make_gateway(replicas).start()
+        routes_before = {p: metric("tpu_model_gateway_routes_total",
+                                   f'{{path="{p}"}}')
+                         for p in ("affinity", "probe", "least_loaded")}
+        fo_before = {r: metric("tpu_model_gateway_failovers_total",
+                               f'{{result="{r}"}}')
+                     for r in ("replayed", "requeued", "errored")}
+        try:
+            texts = {}
+            for i in range(12):
+                body = {"model": "phi", "system": SYSTEM_512_TOK,
+                        "prompt": f"question {i}: what is step {i}?",
+                        "options": dict(GREEDY), "stream": True}
+                if i == 6:
+                    # kill whichever replica owns the hot prefix,
+                    # mid-stream
+                    hot = max(gw._replicas.values(), key=lambda r: r.served)
+                    idx = int(hot.name.split("-")[1])
+                    replicas[idx].ctl["die_after"] = 3
+                frames = stream_frames(gw.base_url, "/api/generate", body)
+                assert not any("error" in f for f in frames), \
+                    f"request {i} saw an error frame"
+                texts[i] = (joined_text(frames), expected_text(body))
+            for i, (got, want) in texts.items():
+                assert got == want, f"request {i} diverged"
+            routes = {p: metric("tpu_model_gateway_routes_total",
+                                f'{{path="{p}"}}') - routes_before[p]
+                      for p in routes_before}
+            failovers = {r: metric("tpu_model_gateway_failovers_total",
+                                   f'{{result="{r}"}}') - fo_before[r]
+                         for r in fo_before}
+            total = sum(routes.values())
+            # a single replica would hit its own cache on every request
+            # after the first; the fleet must keep >= 0.9 of that
+            # (affinity + probe are both cache hits; the kill forces a
+            # handful of cold re-routes)
+            single_rate = (12 - 1) / 12
+            fleet_rate = (routes["affinity"] + routes["probe"]) / total
+            assert fleet_rate >= 0.9 * single_rate, \
+                f"fleet hit rate {fleet_rate:.2f} < 0.9x single " \
+                f"{single_rate:.2f} (routes={routes})"
+            assert failovers["replayed"] >= 1
+            assert failovers["errored"] == 0
+            assert gw.journal_stats()["live"] == 0
+            table_path = os.environ.get("GATEWAY_TABLE")
+            if table_path:
+                st = gw.status()
+                lines = ["| replica | state | served | failed |",
+                         "|---|---|---|---|"]
+                for r in st["replicas"]:
+                    lines.append(f"| {r['name']} | {r['state']} | "
+                                 f"{r['served']} | {r['failed']} |")
+                lines.append("")
+                lines.append(f"routes: {routes}  failovers: {failovers}  "
+                             f"fleet_hit_rate: {fleet_rate:.3f} "
+                             f"(single-replica {single_rate:.3f})")
+                with open(table_path, "a") as f:
+                    f.write("\n".join(lines) + "\n")
+        finally:
+            gw.stop()
+
+
+# ---------------------------------------------------------------------------
+# chaos drills (gateway.route / gateway.stream fault points)
+# ---------------------------------------------------------------------------
+
+class TestChaosDrills:
+    @pytest.mark.chaos
+    def test_route_fault_requeues_unstarted_request(self, served_gw):
+        gw, (a, b) = served_gw
+        FAULTS.arm("gateway.route", "fail:once")
+        before = metric("tpu_model_gateway_failovers_total",
+                        '{result="requeued"}')
+        body = {"model": "phi", "prompt": "c" * 150,
+                "options": dict(GREEDY), "stream": True}
+        frames = stream_frames(gw.base_url, "/api/generate", body)
+        assert not any("error" in f for f in frames)
+        assert joined_text(frames) == expected_text(body)
+        assert FAULTS.hits("gateway.route") >= 1
+
+    @pytest.mark.chaos
+    def test_stream_fault_persistent_yields_exactly_once_error(self,
+                                                               served_gw):
+        """A fault that keeps severing EVERY upstream stream exhausts the
+        failover budget; the client must still get exactly one terminal
+        error frame — never a broken socket."""
+        gw, _ = served_gw
+        FAULTS.arm("gateway.stream", "fail:after=3")
+        body = {"model": "phi", "prompt": "e" * 150,
+                "options": dict(GREEDY), "stream": True}
+        frames = stream_frames(gw.base_url, "/api/generate", body)
+        errors = [f for f in frames if "error" in f]
+        assert len(errors) == 1 and frames[-1] is errors[0]
+
+    @pytest.mark.chaos
+    @pytest.mark.parametrize("replicas", [4], indirect=True)
+    def test_drill9_replica_killed_mid_stream_under_load(self, gw_env,
+                                                         replicas):
+        """CI chaos-smoke drill 9: kill a replica mid-stream while
+        concurrent greedy streams are in flight — zero client-visible
+        error frames, the failover counter increments, and the journal
+        drains."""
+        gw = make_gateway(replicas).start()
+        fo_before = metric("tpu_model_gateway_failovers_total",
+                           '{result="replayed"}')
+        try:
+            results = {}
+            errors = {}
+
+            def run(i):
+                body = {"model": "phi", "system": SYSTEM_512_TOK,
+                        "prompt": f"load {i}", "options": dict(GREEDY),
+                        "stream": True}
+                try:
+                    frames = stream_frames(gw.base_url, "/api/generate",
+                                           body)
+                    results[i] = (frames, expected_text(body))
+                except Exception as e:  # noqa: BLE001 — collected below
+                    errors[i] = e
+
+            # warm the affinity table so the load concentrates
+            run(-1)
+            hot = max(gw._replicas.values(), key=lambda r: r.served)
+            idx = int(hot.name.split("-")[1])
+            replicas[idx].ctl["die_after"] = 2
+            threads = [threading.Thread(target=run, args=(i,))
+                       for i in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+            assert not errors, f"client-visible failures: {errors}"
+            for i, (frames, want) in results.items():
+                assert not any("error" in f for f in frames), \
+                    f"stream {i} saw an error frame"
+                assert joined_text(frames) == want, f"stream {i} diverged"
+            assert metric("tpu_model_gateway_failovers_total",
+                          '{result="replayed"}') >= fo_before + 1
+            assert gw.journal_stats()["live"] == 0
+        finally:
+            gw.stop()
+
+
+# ---------------------------------------------------------------------------
+# operator wiring
+# ---------------------------------------------------------------------------
+
+class TestOperatorWiring:
+    def _model(self, **spec):
+        spec.setdefault("image", "phi")
+        spec.setdefault("runtime", "cpu")
+        return {"apiVersion": "ollama.ayaka.io/v1", "kind": "Model",
+                "metadata": {"name": "phi", "namespace": "default",
+                             "uid": "u1"},
+                "spec": spec}
+
+    def test_gateway_enabled_gating(self):
+        from ollama_operator_tpu.operator.types import ModelSpecView
+        from ollama_operator_tpu.operator.workload import gateway_enabled
+        assert not gateway_enabled(ModelSpecView(self._model()))
+        assert gateway_enabled(ModelSpecView(self._model(replicas=3)))
+        assert gateway_enabled(ModelSpecView(
+            self._model(autoscale={"enabled": True})))
+        assert gateway_enabled(ModelSpecView(self._model(gateway=True)))
+        assert not gateway_enabled(ModelSpecView(
+            self._model(replicas=3, gateway=False)))
+
+    def test_service_selector_points_at_gateway_when_enabled(self):
+        from ollama_operator_tpu.operator import workload
+        svc = workload.build_model_service(self._model(replicas=3))
+        assert svc["spec"]["selector"] == {"app": "ollama-model-phi-gateway"}
+        svc1 = workload.build_model_service(self._model())
+        assert svc1["spec"]["selector"] == {"app": "ollama-model-phi"}
+
+    def test_gateway_deployment_shape(self):
+        from ollama_operator_tpu.operator import workload
+        dep = workload.build_gateway_deployment(self._model(replicas=2),
+                                                "runtime:test")
+        assert dep["metadata"]["name"] == "ollama-model-phi-gateway"
+        c = dep["spec"]["template"]["spec"]["containers"][0]
+        assert c["command"][-1] == "ollama_operator_tpu.operator.gateway"
+        env = {e["name"]: e["value"] for e in c["env"]}
+        assert env["TPU_GATEWAY_SELECTOR"] == "default/ollama-model-phi"
+        assert "resources" not in c  # no TPU for the gateway
+
+    def test_kube_discovery_lists_ready_pods(self):
+        import sys
+        sys.path.insert(0, "tests")
+        from fake_kube import FakeKube
+        kube = FakeKube()
+        for i, ip in enumerate(["10.0.0.5", "10.0.0.6"]):
+            kube.create({"apiVersion": "v1", "kind": "Pod",
+                         "metadata": {"name": f"pod-{i}",
+                                      "namespace": "default",
+                                      "labels": {"app": "ollama-model-phi"}},
+                         "spec": {}})
+            kube.set_status("v1", "Pod", "default", f"pod-{i}",
+                            {"podIP": ip})
+        disc = gwmod.kube_discovery(kube, "default", "ollama-model-phi",
+                                    port=11434)
+        assert disc() == [("pod-0", "http://10.0.0.5:11434"),
+                          ("pod-1", "http://10.0.0.6:11434")]
+
+    def test_reconciler_creates_gateway_and_repoints_service(self):
+        import sys
+        sys.path.insert(0, "tests")
+        from test_operator_reconciler import (RecordingRecorder, drive,
+                                              make_model)
+        from fake_kube import FakeKube
+        from ollama_operator_tpu.operator.reconciler import ModelReconciler
+        kube = FakeKube()
+        rec = RecordingRecorder()
+        r = ModelReconciler(kube, rec, server_image="runtime:test")
+        make_model(kube, replicas=2)
+        drive(r, kube)
+        gw_dep = kube.get("apps/v1", "Deployment", "default",
+                          "ollama-model-phi-gateway")
+        assert gw_dep is not None
+        assert ("Normal", "GatewayCreated") in rec.events
+        svc = kube.get("v1", "Service", "default", "ollama-model-phi")
+        assert svc["spec"]["selector"] == {"app": "ollama-model-phi-gateway"}
+        # disable the gateway -> deployment removed, selector repointed
+        m = kube.get("ollama.ayaka.io/v1", "Model", "default", "phi")
+        m["spec"]["gateway"] = False
+        kube.update(m)
+        drive(r, kube)
+        assert kube.get("apps/v1", "Deployment", "default",
+                        "ollama-model-phi-gateway") is None
+        svc = kube.get("v1", "Service", "default", "ollama-model-phi")
+        assert svc["spec"]["selector"] == {"app": "ollama-model-phi"}
+        assert ("Normal", "GatewayRemoved") in rec.events
+        assert ("Normal", "ServiceSelectorSynced") in rec.events
