@@ -4,9 +4,9 @@ IMG ?= ghcr.io/ollama-operator-tpu/tpu-runtime:v0.1.0
 BACKEND ?= tpu
 PY ?= python
 
-.PHONY: all test test-fast lint lint-verbose native bench bench-smoke docker-build \
-        docker-build-cpu build-installer install uninstall deploy undeploy \
-        kind-e2e clean
+.PHONY: all test test-fast lint lint-verbose kernel-interpret native bench \
+        bench-smoke docker-build docker-build-cpu build-installer install \
+        uninstall deploy undeploy kind-e2e clean
 
 all: test build-installer
 
@@ -27,6 +27,10 @@ lint:  ## pyflakes (or py_compile) + the invariant linter (tools/invariant_lint)
 lint-verbose:  ## invariant linter incl. suppressed findings + per-pass table
 	$(PY) -m tools.invariant_lint --root . --verbose
 
+kernel-interpret:  ## pallas kernels in interpret mode on CPU: fused paged A/B, int4 pool, device grammar
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_pallas.py tests/test_paged.py \
+	  tests/test_paged_fused.py tests/test_grammar_device.py -q
+
 # (grammar otherwise builds lazily at the first format:"json" request —
 # a latency spike)
 native:  ## build the C++ dequant + grammar libraries
@@ -42,9 +46,10 @@ bench:  ## headline decode-throughput benchmark (one JSON line)
 # BENCH_XLA_CACHE=0: the CPU-backend persistent-cache deserialization
 # path is unstable on some hosts (wrong tokens, then a native crash) —
 # tiny smoke programs recompile in seconds anyway
-bench-smoke:  ## seconds-scale CPU bench: engine + HTTP + mixed + prefix + spec + overload + restart + coldstart arms
+bench-smoke:  ## seconds-scale CPU bench: engine + HTTP + mixed + prefix + spec + overload + restart + coldstart + fused-paged arms
 	JAX_PLATFORMS=cpu BENCH_CHILD=1 BENCH_HTTP=1 BENCH_MIXED_ARM=1 \
-	  BENCH_PREFIX_ARM=1 BENCH_PAGED_ASYNC_ARM=1 BENCH_SPEC_ARM=1 \
+	  BENCH_PREFIX_ARM=1 BENCH_PAGED_ASYNC_ARM=1 BENCH_PAGED_FUSED_ARM=1 \
+	  BENCH_SPEC_ARM=1 \
 	  BENCH_OVERLOAD_ARM=1 BENCH_RESTART_ARM=1 BENCH_COLDSTART_ARM=1 \
 	  BENCH_ASSERT_COLDSTART=1 BENCH_XLA_CACHE=0 \
 	  BENCH_SLOTS=4 BENCH_STEPS=16 BENCH_SEQ=512 BENCH_PROMPT=16 \

@@ -627,8 +627,10 @@ def forward_with_cache(params: Params, cfg: ModelConfig, tokens: jax.Array,
 # paged KV cache (block-table page pool) — SURVEY.md §7 hard-part 2
 # --------------------------------------------------------------------------
 #
-# Pool layout [L, P, KvH, ps, hd] (quant: {"q": int8 pool, "s": [L, P,
-# KvH, ps] f32 scales}); a slot's logical block j lives in physical page
+# Pool layout [L, P, KvH, ps, hd] (int8: {"q": int8 pool, "s": [L, P,
+# KvH, ps] f32 scales}; int4: {"q4": [L, P, KvH, ps//2, hd] nibble-packed
+# pool — two positions per byte, ops/quant_cache.pack_kv4 — same "s"
+# scales}); a slot's logical block j lives in physical page
 # table[slot, j] (runtime/paged.py owns allocation; page 0 is the trash
 # page for bucket-padding writes — mirrored constant below to avoid a
 # models → runtime import cycle).
@@ -678,8 +680,11 @@ def paged_insert(cfg: ModelConfig, k_pool, v_pool, ks, vs, table_row,
     Positions >= n_valid scatter their garbage to the trash page, so
     admissions allocate pages only for real tokens."""
     quant = isinstance(k_pool, dict)
-    arr = k_pool["q"] if quant else k_pool
+    quant4 = quant and "q4" in k_pool
+    arr = (k_pool["q4"] if quant4 else k_pool["q"]) if quant else k_pool
     L, P, KvH, ps, hd = arr.shape
+    if quant4:
+        ps *= 2                               # packed pool: 2 positions/byte
     Tb = ks.shape[3]
     t = jnp.arange(Tb, dtype=jnp.int32)
     pg_row = jnp.where(t < n_valid, table_row[t // ps],
@@ -693,7 +698,30 @@ def paged_insert(cfg: ModelConfig, k_pool, v_pool, ks, vs, table_row,
     def put(pool, vals):                      # vals [L, KvH, Tb(, hd)]
         return pool.at[lx, pgx, hx, offx].set(vals)
 
-    if quant:
+    if quant4:
+        from ..ops import quant_cache as QC
+        kq, ksc = QC.quantize_kv4(ks)     # codes [-7,7] over the TRUE hd
+        vq, vsc = QC.quantize_kv4(vs)
+        # admissions always start at offset 0, so the nibble pairs
+        # (2j, 2j+1) are byte-aligned: pack directly, no read-modify-write.
+        # A pair straddling n_valid writes its garbage high nibble one
+        # position past the slot's length — beyond-length entries are
+        # never attended and the next decode write overwrites the nibble.
+        pg4 = pg_row[0::2]                    # pair page = even member's
+        off4 = (off[0::2]) // 2               # packed byte row in the page
+        pgx4 = pg4[None, None, :]
+        offx4 = off4[None, None, :]
+
+        def put4(pool, vals):                 # vals [L, KvH, Tb//2, hd]
+            return pool.at[lx, pgx4, hx, offx4].set(vals)
+
+        k_pool = {"q4": put4(k_pool["q4"],
+                             QC.pack_kv4(_pad_hd(kq[:, 0], hd))),
+                  "s": put(k_pool["s"], ksc[:, 0])}
+        v_pool = {"q4": put4(v_pool["q4"],
+                             QC.pack_kv4(_pad_hd(vq[:, 0], hd))),
+                  "s": put(v_pool["s"], vsc[:, 0])}
+    elif quant:
         from ..ops import quant_cache as QC
         kq, ksc = QC.quantize_kv(ks)      # quantize over the TRUE hd,
         vq, vsc = QC.quantize_kv(vs)      # then pad codes with zeros
@@ -713,7 +741,12 @@ def _paged_kernel_usable(cfg: ModelConfig, mesh, T: int, KvH: int, ps: int,
     path there is no MHA bail-out: the gather fallback copies every
     attended page per step, so the kernel's direct-DMA path wins for MHA
     too (the dense einsum the old measurement favoured is not available
-    on a paged pool)."""
+    on a paged pool). TPU_PAGED_FUSED=0 forces the gather+einsum
+    reference path — the A/B control for the fused kernel's bandwidth
+    win (bench paged_bw_ratio) and the parity suite's oracle."""
+    import os
+    if os.environ.get("TPU_PAGED_FUSED", "1").lower() in ("0", "false"):
+        return False
     from ..ops.attention import resolve_kernels
     from ..ops.pallas.flash import _lane_ok
     mode = resolve_kernels(cfg.kernels)
@@ -760,8 +793,9 @@ def _paged_attend(cfg: ModelConfig, q, kp, vp, i, tables, lengths, mask,
         interp = resolve_kernels(cfg.kernels) == "interpret"
         if mesh is not None and mesh.size > 1:
             from jax.sharding import PartitionSpec as P
+            qkey = "q4" if (quant and "q4" in kp) else "q"
             pool_spec = P(None, None, "tp", None, None)
-            pool_specs = ({"q": pool_spec, "s": P(None, None, "tp", None)}
+            pool_specs = ({qkey: pool_spec, "s": P(None, None, "tp", None)}
                           if quant else pool_spec)
             qspec = P(None, None, "tp", None)
 
@@ -786,8 +820,19 @@ def _paged_attend(cfg: ModelConfig, q, kp, vp, i, tables, lengths, mask,
     # gather fallback: the pool hd is 128-lane padded; pad q to match
     # (zeros are inert in the score dot) and slice the pad lanes back off
     # the output
+    quant4 = quant and "q4" in kp
     hd_q = q.shape[-1]
-    qp = _pad_hd(q, (kp["q"] if quant else kp).shape[-1])
+    qp = _pad_hd(q, ((kp["q4"] if quant4 else kp["q"]) if quant
+                     else kp).shape[-1])
+    if quant4:
+        from ..ops.quant_cache import attend_hf_q4
+        ps = kp["q4"].shape[3] * 2
+        kw = {"q4": _gather_pages(kp["q4"], i, tbl),
+              "s": _gather_pages(kp["s"], i, tbl, ps=ps)}
+        vw = {"q4": _gather_pages(vp["q4"], i, tbl),
+              "s": _gather_pages(vp["s"], i, tbl, ps=ps)}
+        return attend_hf_q4(qp, kw, vw, mask, scale,
+                            cfg.attn_softcap)[..., :hd_q]
     if quant:
         from ..ops.quant_cache import attend_hf_q
         ps = kp["q"].shape[3]
@@ -802,14 +847,52 @@ def _paged_attend(cfg: ModelConfig, q, kp, vp, i, tables, lengths, mask,
     return attend_hf(qp, kw, vw, mask, scale, cfg.attn_softcap)[..., :hd_q]
 
 
+def _paged_scatter4(pool, i, codes, pg, off):
+    """int4 twin of ``_paged_scatter``: merge per-position codes [-7, 7]
+    ([B, KvH, T, hd]) into the nibble-packed pool at byte row off//2 —
+    read-modify-write, one parity class at a time (even offsets share no
+    byte with other even offsets, so each pass is conflict-free, and the
+    odd pass reads the even pass's merged bytes through the dataflow)."""
+    KvH = codes.shape[1]
+    hx = jnp.arange(KvH)[None, :, None]
+    nib = (codes + 8).astype(jnp.uint8) & 0xF          # code + INT4_BIAS
+    n_rows = pool.shape[3]
+    for parity in (0, 1):
+        sel = (off % 2) == parity                      # [B, T]
+        row = off // 2
+        # unselected positions write out-of-bounds and drop — writing a
+        # stale readback at their (page, row) would race the selected
+        # write that shares the byte
+        rowx = jnp.where(sel, row, n_rows)[:, None, :]
+        pgx = pg[:, None, :]
+        cur = pool[i, pgx, hx, jnp.minimum(rowx, n_rows - 1)
+                   ].astype(jnp.uint8)                 # [B, KvH, T, hd]
+        keep, put = (0xF0, nib) if parity == 0 else (0x0F, nib << 4)
+        new = ((cur & keep) | put).astype(jnp.int8)
+        pool = pool.at[i, pgx, hx, rowx].set(new, mode="drop")
+    return pool
+
+
 def _scatter_kv_pools(kp, vp, i, k, v, pg_w, off_w):
-    """Quantize (int8 pools) and scatter one layer's fresh K/V into the
-    pools at (page, offset) per (row, position) — shared by the dp-manual
-    region and the single-shard paged forward so the write layout can
-    never drift between them."""
+    """Quantize (int8/int4 pools) and scatter one layer's fresh K/V into
+    the pools at (page, offset) per (row, position) — shared by the
+    dp-manual region and the single-shard paged forward so the write
+    layout can never drift between them."""
     quant = isinstance(kp, dict)
-    arr = kp["q"] if quant else kp
+    quant4 = quant and "q4" in kp
+    arr = (kp["q4"] if quant4 else kp["q"]) if quant else kp
     hd_pool = arr.shape[-1]
+    if quant4:
+        from ..ops import quant_cache as QC
+        kq, ksc = QC.quantize_kv4(k)
+        vq, vsc = QC.quantize_kv4(v)
+        kp = {"q4": _paged_scatter4(kp["q4"], i, _pad_hd(kq, hd_pool),
+                                    pg_w, off_w),
+              "s": _paged_scatter(kp["s"], i, ksc, pg_w, off_w)}
+        vp = {"q4": _paged_scatter4(vp["q4"], i, _pad_hd(vq, hd_pool),
+                                    pg_w, off_w),
+              "s": _paged_scatter(vp["s"], i, vsc, pg_w, off_w)}
+        return kp, vp
     if quant:
         from ..ops import quant_cache as QC
         kq, ksc = QC.quantize_kv(k)       # quantize over the TRUE hd,
@@ -837,8 +920,9 @@ def _paged_write_attend_local(cfg: ModelConfig, q, k, v, kp, vp, i, tables,
     indices; on a single device local == global and this is just the
     fused write+attend."""
     quant = isinstance(kp, dict)
-    arr = kp["q"] if quant else kp
-    ps = arr.shape[3]
+    quant4 = quant and "q4" in kp
+    arr = (kp["q4"] if quant4 else kp["q"]) if quant else kp
+    ps = arr.shape[3] * (2 if quant4 else 1)
     NBLK = tables.shape[1]
     bi = jnp.arange(tables.shape[0])[:, None]
     blk_w = positions // ps
@@ -869,8 +953,9 @@ def _paged_write_attend_dp(cfg: ModelConfig, q, k, v, kp, vp, i, tables,
     kernels get from ``ops/attention._sharded_kernel_call``."""
     from jax.sharding import PartitionSpec as P
     quant = isinstance(kp, dict)
+    qkey = "q4" if (quant and "q4" in kp) else "q"
     pool_spec = P(None, "dp", h_ax, None, None)
-    pool_specs = ({"q": pool_spec, "s": P(None, "dp", h_ax, None)}
+    pool_specs = ({qkey: pool_spec, "s": P(None, "dp", h_ax, None)}
                   if quant else pool_spec)
     qspec = P("dp", None, h_ax, None)
     kvspec = P("dp", h_ax, None, None)
@@ -899,11 +984,12 @@ def paged_insert_dp(cfg: ModelConfig, k_pool, v_pool, ks, vs, table_rows,
     only where the slot lives. No collectives, no cross-shard indexing."""
     from jax.sharding import PartitionSpec as P
     quant = isinstance(k_pool, dict)
-    KvH = (k_pool["q"] if quant else k_pool).shape[2]
+    qkey = "q4" if (quant and "q4" in k_pool) else "q"
+    KvH = (k_pool[qkey] if quant else k_pool).shape[2]
     tp = dict(mesh.shape).get("tp", 1)
     h_ax = "tp" if (tp > 1 and KvH % tp == 0) else None
     pool_spec = P(None, "dp", h_ax, None, None)
-    pool_specs = ({"q": pool_spec, "s": P(None, "dp", h_ax, None)}
+    pool_specs = ({qkey: pool_spec, "s": P(None, "dp", h_ax, None)}
                   if quant else pool_spec)
     kvs = P(None, None, h_ax, None, None)
 
@@ -938,8 +1024,9 @@ def paged_extend_dp(params: Params, cfg: ModelConfig, tokens: jax.Array,
     """
     from jax.sharding import PartitionSpec as P
     quant = isinstance(k_pool, dict)
+    qkey = "q4" if (quant and "q4" in k_pool) else "q"
     pool_spec = P(None, "dp", None, None, None)
-    pool_specs = ({"q": pool_spec, "s": P(None, "dp", None, None)}
+    pool_specs = ({qkey: pool_spec, "s": P(None, "dp", None, None)}
                   if quant else pool_spec)
 
     def inner(tokens, kp, vp, trow, lengths, owner):
@@ -974,8 +1061,11 @@ def forward_with_cache_paged(params: Params, cfg: ModelConfig,
     Returns (logits [B, T, V], k_pool, v_pool).
     """
     quant = isinstance(k_pool, dict)
-    k_arr = k_pool["q"] if quant else k_pool
+    quant4 = quant and "q4" in k_pool
+    k_arr = (k_pool["q4"] if quant4 else k_pool["q"]) if quant else k_pool
     L, P, KvH, ps, hd = k_arr.shape
+    if quant4:
+        ps *= 2                               # packed pool: 2 positions/byte
     B, T = tokens.shape
     scale = _attn_scale(cfg)
     positions = lengths[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]

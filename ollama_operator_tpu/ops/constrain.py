@@ -373,8 +373,102 @@ class TokenTable:
         return mask
 
 
+class GrammarTable:
+    """Device-side grammar tables: BFS over the PDA's reachable packed
+    states (up to ``cap``) → a dense mask table + token transition table
+    the decode program can index with a per-slot int32 state.
+
+    ``mask [G, n_words] uint32`` — row g is ``mask_for`` of state g;
+    ``trans [G, V] int32`` — next state id, or -1 when sampling that
+    token leaves the table (state beyond ``cap``, an EOG token, or a
+    grammar-illegal token the mask already excludes). The engine treats
+    -1 as an ESCAPE: the slot freezes for the rest of the dispatch and
+    the scheduler falls back to host-uploaded masks for it
+    (runtime/scheduler.py ``grammar_ack``). EOG escapes are harmless —
+    the request finishes on that token anyway.
+
+    State 0 is the BFS root (``start``). The tables are built once per
+    (TokenTable, start, cap) and cached on the TokenTable; the build
+    simulates only mask-allowed tokens, so it costs G native mask fills
+    plus the allowed-token byte walks. JSON decode typically closes over
+    a handful of abstract states, so a small ``cap`` (default 64 via
+    TPU_GRAMMAR_STATES) covers common nesting depths and everything
+    deeper degrades to the host path, never to wrong output."""
+
+    def __init__(self, table: TokenTable, start: bytes = INITIAL_STATE,
+                 cap: int = 64):
+        self.table = table
+        self.cap = cap
+        V, n_words = table.n_vocab, table.n_words
+        states: List[bytes] = [start]
+        ids = {start: 0}
+        mask_rows: List[np.ndarray] = []
+        trans_rows: List[np.ndarray] = []
+        eog = set(table.eog_ids)
+        i = 0
+        while i < len(states):
+            st = states[i]
+            i += 1
+            mrow = table.mask_for(st)
+            mask_rows.append(mrow)
+            trow = np.full(V, -1, np.int32)
+            allowed = np.nonzero(
+                (mrow[:, None] >> np.arange(32, dtype=np.uint32)) & 1)
+            for tid in (allowed[0] * 32 + allowed[1]):
+                tid = int(tid)
+                if tid >= V or tid in eog:
+                    continue
+                piece = table.pieces[tid]
+                ns = advance_bytes(st, piece) if piece else None
+                if ns is None:
+                    continue
+                nid = ids.get(ns)
+                if nid is None:
+                    if len(states) >= cap:
+                        continue           # beyond cap → escape (-1)
+                    nid = len(states)
+                    ids[ns] = nid
+                    states.append(ns)
+                trow[tid] = nid
+            trans_rows.append(trow)
+        self.states = states
+        self._ids = ids
+        self.n_states = len(states)
+        self.mask = np.stack(mask_rows)                    # [G, n_words]
+        self.trans = np.stack(trans_rows)                  # [G, V]
+
+    @classmethod
+    def for_table(cls, table: TokenTable, start: bytes = INITIAL_STATE,
+                  cap: int = 64) -> "GrammarTable":
+        key = (bytes(start), int(cap))
+        cache = getattr(table, "_grammar_tables", None)
+        if cache is None:
+            cache = table._grammar_tables = {}
+        gt = cache.get(key)
+        if gt is None:
+            gt = cache[key] = cls(table, start, cap)
+        return gt
+
+    def state_id(self, state: Optional[bytes]) -> int:
+        """Table id for an exact packed state, or -1 if it escaped.
+        States from a different machine (e.g. a schema NFA tuple) never
+        match — they stay on host masks."""
+        if state is None:
+            return -1
+        try:
+            return self._ids.get(bytes(state), -1)
+        except (TypeError, ValueError):
+            return -1
+
+
 class JsonConstraint:
     """Per-request JSON grammar state for the engine/scheduler."""
+
+    # packed-bytes PDA state: GrammarTable rows ARE this state space, so
+    # the scheduler may run the constraint from device tables. Schema
+    # constraints (NFA tuple states, per-schema masks) must stay on host
+    # masks — their masks are strictly tighter than the JSON grammar's.
+    grammar_table_ok = True
 
     def __init__(self, table: TokenTable):
         self.table = table

@@ -22,7 +22,10 @@ is the decode step against that pool:
 - **Lane-wise int8 dequant.** For the quantized pool the per-position
   scales multiply the score matrix (``s * k_scale[None, :]``) and the
   probability matrix (``p * v_scale[None, :]``) — both lane-aligned
-  broadcasts, so dequant adds no relayout and page DMAs stay int8. Scales
+  broadcasts, so dequant adds no relayout and page DMAs stay int8. int4
+  pools (``{"q4": ..}``, two positions per byte along the page axis —
+  ops/quant_cache.py) DMA at half that width again and unpack in-register
+  (``_unpack4``) before the dots, same scale algebra. Scales
   ride as [L, P, KvH, 1, ps]: the unit axis keeps the block's trailing
   dims equal to their array dims (Mosaic's (8,128) rule — the 4D spec
   lowers in interpret mode but is rejected by the real TPU lowering).
@@ -61,18 +64,44 @@ from ..attention import NEG_INF, softcap_scores
 from .flash import _lane_ok
 
 
+def _unpack4(kb):
+    """Nibble-packed page rows [..., ps//2, hd] int8 → int4 codes [-7, 7]
+    as int8 [..., ps, hd] (position 2j rides the low nibble —
+    ops/quant_cache.pack_kv4). A register-level shift/mask + sublane
+    interleave; the page DMA itself stays at int4 width, which is the
+    whole bandwidth win."""
+    b = kb.astype(jnp.uint8)
+    lo = (b & 0xF).astype(jnp.int8) - 8
+    hi = ((b >> 4) & 0xF).astype(jnp.int8) - 8
+    st = jnp.stack([lo, hi], axis=-2)          # [..., ps//2, 2, hd]
+    return st.reshape(*kb.shape[:-2], kb.shape[-2] * 2, kb.shape[-1])
+
+
+def _pool_arrs(k_pool, v_pool):
+    """(quant, quant4, k_arr, v_arr) for a plain / {"q","s"} / {"q4","s"}
+    pool pair."""
+    quant = isinstance(k_pool, dict)
+    quant4 = quant and "q4" in k_pool
+    k_arr = (k_pool["q4"] if quant4 else k_pool["q"]) if quant else k_pool
+    v_arr = (v_pool["q4"] if quant4 else v_pool["q"]) if quant else v_pool
+    return quant, quant4, k_arr, v_arr
+
+
 def _paged_kernel(lay_ref, len_ref, tbl_ref, q_ref, k_ref, v_ref, o_ref,
                   acc_ref, m_ref, l_ref, *,
                   scale: float, softcap: float, window: int,
                   ps: int, nblk: int, kvh: int, gp: int, cdt,
-                  quant: bool, ks_ref=None, vs_ref=None):
+                  quant: bool, quant4: bool = False,
+                  ks_ref=None, vs_ref=None):
     # NB: scale blocks span the full (possibly 128-lane-padded) scale
     # array dim; reads below slice the live [: ps] lanes
     """Grid (B, nblk). Block ki covers the slot's logical positions
     [ki*ps, (ki+1)*ps) across ALL KvH heads; the per-head flash updates
     are unrolled below (static python loop — KvH is a trace-time
     constant). With ``quant`` the k/v refs are int8 pages and ks/vs carry
-    the per-position f32 scales."""
+    the per-position f32 scales; with ``quant4`` the pages are
+    nibble-packed ([ps//2, hd] stored rows) and unpack in-register before
+    the dots — ``ps`` is always the LOGICAL page size."""
     b, ki = pl.program_id(0), pl.program_id(1)
     qp = len_ref[b]                        # query's absolute position
 
@@ -97,6 +126,8 @@ def _paged_kernel(lay_ref, len_ref, tbl_ref, q_ref, k_ref, v_ref, o_ref,
             r0 = h * gp
             q = q_ref[0, h, :, :].astype(cdt)                 # [Gp, hd]
             kb = k_ref[0, 0, h, :, :]                         # [ps, hd]
+            if quant4:
+                kb = _unpack4(kb)          # [ps//2, hd] packed → [ps, hd]
             s = jax.lax.dot_general(
                 q, kb.astype(cdt), (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32) * scale   # [Gp, ps]
@@ -113,6 +144,8 @@ def _paged_kernel(lay_ref, len_ref, tbl_ref, q_ref, k_ref, v_ref, o_ref,
             l_ref[r0:r0 + gp, :] = (l_ref[r0:r0 + gp, :] * alpha
                                     + jnp.sum(p, axis=-1, keepdims=True))
             vb = v_ref[0, 0, h, :, :]                         # [ps, hd]
+            if quant4:
+                vb = _unpack4(vb)
             if quant:
                 # fold the per-position v scale into p (lane-aligned)
                 p = p * vs_ref[0, 0, h, 0, :ps][None, :]
@@ -136,8 +169,9 @@ def paged_decode_attention(q, k_pool, v_pool, layer, tables, lengths,
     """Single-token attention against the paged pool.
 
     q        [B, 1, H, hd]
-    k_pool   [L, P, KvH, ps, hd] (bf16/f32) or {"q": int8 pool,
-             "s": [L, P, KvH, ps] f32 scales}
+    k_pool   [L, P, KvH, ps, hd] (bf16/f32), {"q": int8 pool,
+             "s": [L, P, KvH, ps] f32 scales}, or {"q4": nibble-packed
+             [L, P, KvH, ps//2, hd] int8, "s": same scale layout}
     layer    [] / [1] int32 — which L slice to attend
     tables   [B, NBLK] int32 physical page per logical block
     lengths  [B] int32 — query's absolute position per slot
@@ -166,11 +200,10 @@ def paged_decode_attention(q, k_pool, v_pool, layer, tables, lengths,
             sliding_window, nblk=nblk, interpret=interpret)
         if out is not None:
             return out
-    quant = isinstance(k_pool, dict)
-    k_arr = k_pool["q"] if quant else k_pool
-    v_arr = v_pool["q"] if quant else v_pool
+    quant, quant4, k_arr, v_arr = _pool_arrs(k_pool, v_pool)
     B, T, H, hd_q = q.shape
-    L, P, KvH, ps, hd = k_arr.shape
+    L, P, KvH, psq, hd = k_arr.shape
+    ps = psq * 2 if quant4 else psq            # logical vs stored rows
     NBLK = tables.shape[1]
     if T != 1 or H % KvH or not _lane_ok(hd, interpret) or nblk > NBLK:
         return None
@@ -194,11 +227,12 @@ def paged_decode_attention(q, k_pool, v_pool, layer, tables, lengths,
 
     kernel = functools.partial(
         _paged_kernel, scale=scale, softcap=softcap, window=sliding_window,
-        ps=ps, nblk=nblk, kvh=KvH, gp=Gp, cdt=cdt, quant=quant)
+        ps=ps, nblk=nblk, kvh=KvH, gp=Gp, cdt=cdt, quant=quant,
+        quant4=quant4)
     in_specs = [
         pl.BlockSpec((1, KvH, Gp, hd), lambda b, ki, *pref: (b, 0, 0, 0)),
-        pl.BlockSpec((1, 1, KvH, ps, hd), kv_index),
-        pl.BlockSpec((1, 1, KvH, ps, hd), kv_index),
+        pl.BlockSpec((1, 1, KvH, psq, hd), kv_index),
+        pl.BlockSpec((1, 1, KvH, psq, hd), kv_index),
     ]
     args = [qg, k_arr, v_arr]
     if quant:
@@ -209,7 +243,8 @@ def paged_decode_attention(q, k_pool, v_pool, layer, tables, lengths,
                 lay_ref, len_ref, tbl_ref, q_ref, k_ref, v_ref, o_ref,
                 acc_ref, m_ref, l_ref, scale=scale, softcap=softcap,
                 window=sliding_window, ps=ps, nblk=nblk, kvh=KvH, gp=Gp,
-                cdt=cdt, quant=True, ks_ref=ks_ref, vs_ref=vs_ref)
+                cdt=cdt, quant=True, quant4=quant4,
+                ks_ref=ks_ref, vs_ref=vs_ref)
         # scale arrays may be lane-padded past ps (engine pads to the 128
         # tile for the v3 DMA path); the block stays ps wide at block
         # index 0, so only the live lanes are read
@@ -285,13 +320,14 @@ def _prep_paged(q, k_pool, v_pool, tables, nblk: int, interpret: bool):
     """Shared v3/v4 wrapper preamble: shape/tiling guards and the padded
     grouped query. Returns None when the shapes don't tile (the caller
     bails to the next formulation), else
-    (quant, k_arr, v_arr, dims, sp, G, Gp, cdt, qg) with
-    dims = (B, H, hd_q, L, P, KvH, ps, hd)."""
-    quant = isinstance(k_pool, dict)
-    k_arr = k_pool["q"] if quant else k_pool
-    v_arr = v_pool["q"] if quant else v_pool
+    (quant, quant4, k_arr, v_arr, dims, sp, G, Gp, cdt, qg) with
+    dims = (B, H, hd_q, L, P, KvH, ps, hd); ``ps`` is the LOGICAL page
+    size (nibble-packed int4 pools store ps//2 physical rows)."""
+    quant, quant4, k_arr, v_arr = _pool_arrs(k_pool, v_pool)
     B, T, H, hd_q = q.shape
     L, P, KvH, ps, hd = k_arr.shape
+    if quant4:
+        ps *= 2
     NBLK = tables.shape[1]
     if T != 1 or H % KvH or not _lane_ok(hd, interpret) or nblk > NBLK:
         return None
@@ -304,7 +340,7 @@ def _prep_paged(q, k_pool, v_pool, tables, nblk: int, interpret: bool):
     qg = q.reshape(B, KvH, G, hd_q)
     if Gp != G or hd != hd_q:
         qg = jnp.pad(qg, ((0, 0), (0, 0), (0, Gp - G), (0, hd - hd_q)))
-    return (quant, k_arr, v_arr, (B, H, hd_q, L, P, KvH, ps, hd),
+    return (quant, quant4, k_arr, v_arr, (B, H, hd_q, L, P, KvH, ps, hd),
             sp, G, Gp, cdt, qg)
 
 
@@ -316,7 +352,7 @@ def _paged_kernel_v4(nb_ref, slot_ref, page_ref, blk_ref, lay_ref, len_ref,
                      q_ref, k_ref, v_ref, *rest,
                      scale: float, softcap: float, window: int,
                      ps: int, flat_n: int, kvh: int, gp: int, cdt,
-                     quant: bool):
+                     quant: bool, quant4: bool = False):
     """Grid (flat_n,): step n processes LIVE page n of the slot-sorted
     flat list (slot_ref/page_ref/blk_ref scalars; nb_ref[0] = live total).
 
@@ -354,8 +390,11 @@ def _paged_kernel_v4(nb_ref, slot_ref, page_ref, blk_ref, lay_ref, len_ref,
 
     @pl.when(valid)
     def _step():
+        kb, vb = k_ref[0, 0], v_ref[0, 0]
+        if quant4:
+            kb, vb = _unpack4(kb), _unpack4(vb)
         _flash_page_update(
-            q_ref[0], k_ref[0, 0], v_ref[0, 0],
+            q_ref[0], kb, vb,
             ks_ref[0, 0][:, :, :ps] if quant else None,
             vs_ref[0, 0][:, :, :ps] if quant else None,
             m_ref, l_ref, acc_ref,
@@ -385,8 +424,9 @@ def paged_decode_attention_v4(q, k_pool, v_pool, layer, tables, lengths,
     prep = _prep_paged(q, k_pool, v_pool, tables, nblk, interpret)
     if prep is None:
         return None
-    quant, k_arr, v_arr, dims, sp, G, Gp, cdt, qg = prep
+    quant, quant4, k_arr, v_arr, dims, sp, G, Gp, cdt, qg = prep
     B, H, hd_q, L, P, KvH, ps, hd = dims
+    psq = ps // 2 if quant4 else ps            # stored page rows
     flat_n = B * nblk
 
     lengths = lengths.astype(jnp.int32)
@@ -415,8 +455,8 @@ def paged_decode_attention_v4(q, k_pool, v_pool, layer, tables, lengths,
 
     in_specs = [
         pl.BlockSpec((1, KvH, Gp, hd), q_index),
-        pl.BlockSpec((1, 1, KvH, ps, hd), kv_index),
-        pl.BlockSpec((1, 1, KvH, ps, hd), kv_index),
+        pl.BlockSpec((1, 1, KvH, psq, hd), kv_index),
+        pl.BlockSpec((1, 1, KvH, psq, hd), kv_index),
     ]
     args = [qg, k_arr, v_arr]
     if quant:
@@ -428,7 +468,7 @@ def paged_decode_attention_v4(q, k_pool, v_pool, layer, tables, lengths,
     kernel = functools.partial(
         _paged_kernel_v4, scale=scale, softcap=softcap,
         window=sliding_window, ps=ps, flat_n=flat_n, kvh=KvH, gp=Gp,
-        cdt=cdt, quant=quant)
+        cdt=cdt, quant=quant, quant4=quant4)
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -459,7 +499,7 @@ def paged_decode_attention_v4(q, k_pool, v_pool, layer, tables, lengths,
 def _paged_kernel_v3(lay_ref, len_ref, tbl_ref, q_ref, k_hbm, v_hbm, *rest,
                      scale: float, softcap: float, window: int,
                      ps: int, sp: int, kvh: int, gp: int, hd: int, cdt,
-                     quant: bool, depth: int = 2):
+                     quant: bool, quant4: bool = False, depth: int = 2):
     """One grid step per SLOT; the kernel walks only the slot's LIVE pages
     with a depth-2 manually-pipelined DMA (pltpu.make_async_copy), so
 
@@ -547,8 +587,13 @@ def _paged_kernel_v3(lay_ref, len_ref, tbl_ref, q_ref, k_hbm, v_hbm, *rest,
         # dynamic-slot load lowers as an unsupported gather) and
         # lane-padded to sp >= ps (Mosaic DMA tile rule); the unit axis
         # is the broadcast axis and only the live ps lanes multiply
+        kb, vb = kbuf[slot], vbuf[slot]
+        if quant4:
+            # pages land nibble-packed [KvH, ps//2, hd]; unpack after the
+            # (half-width) DMA so HBM traffic stays at int4
+            kb, vb = _unpack4(kb), _unpack4(vb)
         _flash_page_update(
-            qv, kbuf[slot], vbuf[slot],
+            qv, kb, vb,
             ksbuf[slot][:, :, :ps] if quant else None,
             vsbuf[slot][:, :, :ps] if quant else None,
             m_ref, l_ref, acc_ref,
@@ -572,11 +617,16 @@ def paged_decode_attention_v3(q, k_pool, v_pool, layer, tables, lengths,
     prep = _prep_paged(q, k_pool, v_pool, tables, nblk, interpret)
     if prep is None:
         return None
-    quant, k_arr, v_arr, dims, sp, G, Gp, cdt, qg = prep
+    quant, quant4, k_arr, v_arr, dims, sp, G, Gp, cdt, qg = prep
     B, H, hd_q, L, P, KvH, ps, hd = dims
+    psq = ps // 2 if quant4 else ps            # stored page rows
     if quant and not interpret and sp % 128:
         # manual f32 DMAs need a 128-lane minor dim; unpadded scale pools
         # (hand-built tests, older stores) fall back to the v2 grid kernel
+        return None
+    if quant4 and not interpret and psq % 32:
+        # int8 arrays tile (32, 128); half-width int4 pages below that
+        # sublane multiple fall back to the v2 grid kernel
         return None
     # DMA pipeline depth: how many page fetches are in flight ahead of
     # the flash update (2 = classic double buffer). Deeper hides more
@@ -590,8 +640,8 @@ def paged_decode_attention_v3(q, k_pool, v_pool, layer, tables, lengths,
     ]
     args = [qg, k_arr, v_arr]
     scratch = [
-        pltpu.VMEM((depth, KvH, ps, hd), k_arr.dtype),
-        pltpu.VMEM((depth, KvH, ps, hd), v_arr.dtype),
+        pltpu.VMEM((depth, KvH, psq, hd), k_arr.dtype),
+        pltpu.VMEM((depth, KvH, psq, hd), v_arr.dtype),
     ]
     if quant:
         in_specs += [hbm, hbm]
@@ -609,7 +659,7 @@ def paged_decode_attention_v3(q, k_pool, v_pool, layer, tables, lengths,
     kernel = functools.partial(
         _paged_kernel_v3, scale=scale, softcap=softcap,
         window=sliding_window, ps=ps, sp=sp, kvh=KvH, gp=Gp, hd=hd,
-        cdt=cdt, quant=quant, depth=depth)
+        cdt=cdt, quant=quant, quant4=quant4, depth=depth)
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(

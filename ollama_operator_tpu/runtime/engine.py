@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import os
 import time
 from functools import partial
 from typing import Any, Dict, List, Optional, Sequence
@@ -243,22 +244,28 @@ def resolve_kv_dtype_default(backend: str) -> str:
 
 
 CACHE_DTYPES = {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
-                "int8": jnp.int8}
+                "int8": jnp.int8,
+                # "int4" stays a STRING sentinel: there is no 4-bit storage
+                # array — the pool holds nibble-packed int8 ({"q4", "s"},
+                # ops/quant_cache.py) and only the paged cache supports it
+                "int4": "int4"}
 
 
 def resolve_cache_dtype(name_or_dtype) -> Any:
     """Normalise a cache dtype given as a name or jnp dtype; rejects
     anything outside the supported set (a stray dense-int8 cache would
-    silently truncate K/V to ±1)."""
+    silently truncate K/V to ±1). int4 resolves to the string sentinel
+    "int4" (nibble-packed storage has no jnp dtype of its own)."""
     if isinstance(name_or_dtype, str):
         if name_or_dtype not in CACHE_DTYPES:
             raise ValueError(f"cache dtype {name_or_dtype!r}; expected one "
                              f"of {sorted(CACHE_DTYPES)}")
         return CACHE_DTYPES[name_or_dtype]
     dt = jnp.dtype(name_or_dtype)
-    assert dt in (jnp.dtype(t) for t in CACHE_DTYPES.values()), (
-        f"unsupported cache dtype {dt}")
-    return {jnp.dtype(v): v for v in CACHE_DTYPES.values()}[dt]
+    table = {jnp.dtype(v): v for v in CACHE_DTYPES.values()
+             if not isinstance(v, str)}
+    assert dt in table, f"unsupported cache dtype {dt}"
+    return table[dt]
 
 
 def unpack_mask(mask_bits, V: int):
@@ -400,7 +407,14 @@ class Engine:
         if cache_dtype is not ecfg.cache_dtype:
             ecfg = dataclasses.replace(ecfg, cache_dtype=cache_dtype)
             self.ecfg = ecfg
-        self.quant_cache = jnp.dtype(cache_dtype) == jnp.dtype(jnp.int8)
+        self.quant4 = cache_dtype == "int4"
+        self.quant_cache = (self.quant4
+                            or jnp.dtype(cache_dtype) == jnp.dtype(jnp.int8))
+        if self.quant4 and not ecfg.paged:
+            raise ValueError(
+                "cache dtype 'int4' requires the paged cache (the dense "
+                "cache has no nibble-packed layout); set paged=True or "
+                "use int8")
         self.sp_size = mesh.shape.get("sp", 1) if mesh is not None else 1
         if self.sp_size > 1:
             assert self.sp_size & (self.sp_size - 1) == 0, (
@@ -524,7 +538,16 @@ class Engine:
             if self.quant_cache:
                 s_sh = (NamedSharding(mesh, P(None, pg_ax, h_ax, None))
                         if mesh is not None else None)
-                cache_sh = {"q": pool_sh, "s": s_sh}
+                # int4 packs two POSITIONS per byte along the page axis
+                # ("q4" [L, P, KvH, ps//2, hd_pool] — ops/quant_cache.py),
+                # keeping the 128-lane head dim intact for the fused
+                # kernel's page DMAs; scales stay per-position f32
+                qkey = "q4" if self.quant4 else "q"
+                if self.quant4:
+                    assert ps >= 2, "int4 KV needs page_size >= 2"
+                q_shape = (pool_shape[:-2] + (ps // 2, hd_pool)
+                           if self.quant4 else pool_shape)
+                cache_sh = {qkey: pool_sh, "s": s_sh}
                 # scale arrays lane-padded to the 128 tile like the codes'
                 # head dim: the v3 kernel DMAs [KvH, ps] f32 slices per
                 # page, and Mosaic requires the DMA'd minor dim to be a
@@ -534,10 +557,10 @@ class Engine:
                 sp_pool = -(-ps // 128) * 128
                 s_shape = pool_shape[:-2] + (sp_pool,)
                 self.k_cache = {
-                    "q": zeros(pool_shape, jnp.int8, pool_sh),
+                    qkey: zeros(q_shape, jnp.int8, pool_sh),
                     "s": zeros(s_shape, jnp.float32, s_sh)}
                 self.v_cache = {
-                    "q": zeros(pool_shape, jnp.int8, pool_sh),
+                    qkey: zeros(q_shape, jnp.int8, pool_sh),
                     "s": zeros(s_shape, jnp.float32, s_sh)}
             else:
                 cache_sh = pool_sh
@@ -589,6 +612,26 @@ class Engine:
             np.full((B, self.mask_words), 0xFFFFFFFF, np.uint32), slot_sh2)
         self._constrained = np.zeros((B,), bool)
         self._constr_dev = zeros((B,), jnp.int32, slot_sh)
+        # device-resident grammar program (ops/constrain.GrammarTable):
+        # gmask [G, mask_words] holds the packed allowed-token mask per
+        # precomputed automaton state, gtrans [G, V] the successor state
+        # per sampled token (-1 = the walk leaves the table). Each slot
+        # carries a device FSM state: >= 0 device-table mode (its mask is
+        # gmask[gstate], advanced ON DEVICE after sampling — no host
+        # round-trip per token), -1 host-mask mode (mask_bits row, one
+        # token per dispatch), -2 escaped (frozen until the host
+        # re-installs a fresh mask via set_mask).
+        self._gstates_cap = int(os.environ.get("TPU_GRAMMAR_STATES",
+                                               "64"))
+        self._grammar_device = os.environ.get(
+            "TPU_GRAMMAR_DEVICE", "1").lower() not in ("0", "false")
+        self._gmask_dev = self._gr(np.zeros(
+            (self._gstates_cap, self.mask_words), np.uint32))
+        self._gtrans_dev = self._gr(np.full(
+            (self._gstates_cap, V), -1, np.int32))
+        self._gstate = self._g(np.full((B,), -1, np.int32), slot_sh)
+        self._gdev_mode = np.zeros((B,), bool)  # host mirror: gstate >= 0
+        self._gtable_key: Any = None
         self.active = np.zeros((B,), bool)  # host-side mask
         self._active_dev = zeros((B,), jnp.int32, slot_sh)
         # per-slot effective penalty window (≤ W ring capacity)
@@ -891,8 +934,11 @@ class Engine:
 
         def _decode_body(params, k_cache, v_cache, lengths, counts,
                          last_tokens, pring, mu, sp, keys, active,
-                         mask_bits, constrained, rln, attn_len=None,
-                         tables=None):
+                         mask_bits, constrained, rln, gstate, gmask,
+                         gtrans, attn_len=None, tables=None):
+            # escaped slots (gstate == -2) freeze in place: the host has
+            # to re-derive their mask before they may advance again
+            active = active * (gstate != -2).astype(active.dtype)
             if self.paged:
                 ps = self.ecfg.page_size
                 nblk = -(-(attn_len or self.max_seq) // ps)
@@ -908,11 +954,21 @@ class Engine:
                     v_cache=v_cache, lengths=lengths, **kw)
             step_keys = jax.vmap(jax.random.fold_in)(keys, lengths)
             last = logits[:, 0]
-            allowed = unpack_mask(mask_bits, cfg.vocab_size)
+            # device-table slots read their mask straight off the
+            # precomputed grammar table (host rows for everyone else)
+            gdev = gstate >= 0
+            gi = jnp.clip(gstate, 0, gmask.shape[0] - 1)
+            eff_bits = jnp.where(gdev[:, None], gmask[gi], mask_bits)
+            allowed = unpack_mask(eff_bits, cfg.vocab_size)
             last = jnp.where((constrained == 1)[:, None] & ~allowed,
                              sampling.NEG_INF, last)
             toks, mu_new = sampling.sample(last, counts, sp, step_keys,
                                            mu)
+            # advance the device automaton by the sampled token; a -1
+            # transition (walk left the precomputed table) escapes to -2
+            ns = gtrans[gi, toks]
+            ns = jnp.where(ns < 0, jnp.int32(-2), ns)
+            gstate = jnp.where(gdev & (active == 1), ns, gstate)
             mu = jnp.where(active == 1, mu_new, mu)
             B = toks.shape[0]
             bi = jnp.arange(B)
@@ -934,23 +990,26 @@ class Engine:
                               pring.at[bi, slot_pos].set(toks), pring)
             lengths = lengths + active
             last_tokens = jnp.where(active == 1, toks, last_tokens)
+            if slot_sh is not None:
+                gstate = jax.lax.with_sharding_constraint(gstate, slot_sh)
             return (toks, *pin(k_cache, v_cache, lengths, counts,
-                               last_tokens, pring, mu))
+                               last_tokens, pring, mu), gstate)
 
         def _decode(params, k_cache, v_cache, lengths, counts, last_tokens,
                     pring, mu, sp, keys, active, mask_bits, constrained,
-                    rln, tables=None):
+                    rln, gstate, gmask, gtrans, tables=None):
             (toks, k_cache, v_cache, lengths, counts, last_tokens,
-             pring, mu) = _decode_body(params, k_cache, v_cache, lengths,
-                                       counts, last_tokens, pring, mu, sp,
-                                       keys, active, mask_bits,
-                                       constrained, rln, tables=tables)
+             pring, mu, gstate) = _decode_body(
+                 params, k_cache, v_cache, lengths, counts, last_tokens,
+                 pring, mu, sp, keys, active, mask_bits, constrained, rln,
+                 gstate, gmask, gtrans, tables=tables)
             return (toks, k_cache, v_cache, lengths, counts, last_tokens,
-                    pring, mu, keys)
+                    pring, mu, keys, gstate)
 
         def _decode_n(params, k_cache, v_cache, lengths, counts, last_tokens,
                       pring, mu, sp, keys, active, mask_bits, constrained,
-                      rln, n, attn_len, tables=None, budgets=None):
+                      rln, gstate, gmask, gtrans, n, attn_len, tables=None,
+                      budgets=None):
             """n decode steps as ONE device program (lax.scan) — a single
             dispatch + host sync per n tokens per slot. ``attn_len`` is the
             static attended-cache prefix (decode traffic scales with it,
@@ -961,37 +1020,39 @@ class Engine:
 
             ``budgets`` [B] int32 — per-slot step budget: a slot freezes
             (no length advance, no state change) once the step index
-            reaches its budget. Grammar-constrained slots get budget 1 —
+            reaches its budget. HOST-masked grammar slots get budget 1 —
             they need a fresh host-side PDA mask per token — while the
             rest of the batch keeps the full chunk (round-1 weak #5: one
-            format:"json" request used to collapse everyone to n=1)."""
+            format:"json" request used to collapse everyone to n=1).
+            Device-table grammar slots (gstate >= 0) keep the full chunk:
+            their mask refreshes on device from gmask/gtrans."""
             def step(carry, t):
                 (k_cache, v_cache, lengths, counts, last_tokens,
-                 pring, mu) = carry
+                 pring, mu, gstate) = carry
                 act = active if budgets is None else active * (t < budgets)
                 (toks, k_cache, v_cache, lengths, counts, last_tokens,
-                 pring, mu) = _decode_body(params, k_cache, v_cache,
-                                           lengths, counts, last_tokens,
-                                           pring, mu, sp, keys, act,
-                                           mask_bits, constrained, rln,
-                                           attn_len=attn_len,
-                                           tables=tables)
+                 pring, mu, gstate) = _decode_body(
+                     params, k_cache, v_cache, lengths, counts,
+                     last_tokens, pring, mu, sp, keys, act, mask_bits,
+                     constrained, rln, gstate, gmask, gtrans,
+                     attn_len=attn_len, tables=tables)
                 return (k_cache, v_cache, lengths, counts, last_tokens,
-                        pring, mu), toks
+                        pring, mu, gstate), toks
 
             carry = (k_cache, v_cache, lengths, counts, last_tokens, pring,
-                     mu)
+                     mu, gstate)
             carry, toks_n = jax.lax.scan(
                 step, carry, jnp.arange(n, dtype=jnp.int32))
             (k_cache, v_cache, lengths, counts, last_tokens, pring,
-             mu) = carry
+             mu, gstate) = carry
             return (toks_n, k_cache, v_cache, lengths, counts, last_tokens,
-                    pring, mu, keys)
+                    pring, mu, keys, gstate)
 
         def _spec_verify(params, k_cache, v_cache, lengths, counts,
                          last_tokens, pring, mu, sp, keys, active,
-                         mask_bits, constrained, rln, is_greedy, drafts,
-                         attn_len, tables=None):
+                         mask_bits, constrained, rln, gstate, gmask,
+                         gtrans, is_greedy, drafts, attn_len,
+                         tables=None):
             """Speculative verify step (one dispatch): run the cached
             forward over [last_token, draft_0..draft_{k-1}] per slot,
             greedy-accept the longest matching draft prefix (greedy
@@ -1003,6 +1064,8 @@ class Engine:
             a k=0-accepting batch degrades to one normal decode step."""
             B, kk = drafts.shape
             V = cfg.vocab_size
+            # escaped device-grammar slots freeze exactly as in decode
+            active = active * (gstate != -2).astype(active.dtype)
             tokens_in = jnp.concatenate([last_tokens[:, None], drafts], 1)
             kw = {"attn_len": attn_len} if self._bucketed_attn else {}
             if self.paged:
@@ -1021,7 +1084,10 @@ class Engine:
             bi = jnp.arange(B)
             step_keys = jax.vmap(jax.random.fold_in)(keys, lengths)
             l0 = logits[:, 0]
-            allowed = unpack_mask(mask_bits, V)
+            gdev = gstate >= 0
+            gi = jnp.clip(gstate, 0, gmask.shape[0] - 1)
+            eff_bits = jnp.where(gdev[:, None], gmask[gi], mask_bits)
+            allowed = unpack_mask(eff_bits, V)
             l0 = jnp.where((constrained == 1)[:, None] & ~allowed,
                            sampling.NEG_INF, l0)
             sampled0, mu_new = sampling.sample(l0, counts, sp, step_keys,
@@ -1035,6 +1101,16 @@ class Engine:
             n_acc, out = sampling.spec_accept(drafts, greedy, ok,
                                               sampled0, V)
             out = jnp.where((active == 1)[:, None], out, jnp.int32(V))
+            # constrained slots are spec-ineligible (_spec_flags), so they
+            # emit exactly out[:, 0] == sampled0 — advance the device
+            # automaton by that single token
+            tok0 = out[:, 0]
+            ns = gtrans[gi, jnp.clip(tok0, 0, V - 1)]
+            ns = jnp.where(ns < 0, jnp.int32(-2), ns)
+            gstate = jnp.where(gdev & (active == 1) & (tok0 < V),
+                               ns, gstate)
+            if slot_sh is not None:
+                gstate = jax.lax.with_sharding_constraint(gstate, slot_sh)
 
             def push(carry, t):
                 lengths, counts, last_tokens, pring = carry
@@ -1060,7 +1136,7 @@ class Engine:
                 push, (lengths, counts, last_tokens, pring),
                 jnp.arange(kk + 1, dtype=jnp.int32))
             return (out, *pin(k_cache, v_cache, lengths, counts,
-                              last_tokens, pring, mu), keys)
+                              last_tokens, pring, mu), keys, gstate)
 
         def _make_extend_paged(A):
             """Paged prefix-cache continuation, attending only the first
@@ -1198,14 +1274,16 @@ class Engine:
             mu = mu.at[slot].set(0.0)
             return lengths, counts, last_tokens, pring, mu
 
-        def _set_mask(mask_bits, constr, slot, row, flag):
+        def _set_mask(mask_bits, constr, gstate, slot, row, flag, gval):
             mask_bits = mask_bits.at[slot].set(row)
             constr = constr.at[slot].set(flag)
+            gstate = gstate.at[slot].set(gval)
             if slot_sh is not None:
                 wsc = jax.lax.with_sharding_constraint
                 mask_bits = wsc(mask_bits, slot_sh2)
                 constr = wsc(constr, slot_sh)
-            return mask_bits, constr
+                gstate = wsc(gstate, slot_sh)
+            return mask_bits, constr, gstate
 
         # Explicit out_shardings on every state-returning program: wsc
         # inside the trace guides internals, but the JIT BOUNDARY sharding
@@ -1235,8 +1313,8 @@ class Engine:
             repl_sh = NamedSharding(self.mesh, P())
             toksn_sh = NamedSharding(self.mesh, P(None, b_ax))
             tok_outs = (repl_sh,) + state_outs
-            dec_outs = (slot_sh,) + state_outs + (slot_sh,)
-            decn_outs = (toksn_sh,) + state_outs + (slot_sh,)
+            dec_outs = (slot_sh,) + state_outs + (slot_sh, slot_sh)
+            decn_outs = (toksn_sh,) + state_outs + (slot_sh, slot_sh)
         else:
             tok_outs = dec_outs = decn_outs = None
         self._admit_fn = _jit(_admit, (1, 2, 3, 4, 5, 6, 7),
@@ -1261,14 +1339,14 @@ class Engine:
                                            outs=tok_outs)
         self._extend_jits: Dict[int, Any] = {}
         self._extend_execs: Dict[Any, Any] = {}
-        self._decode_fn = _jit(_decode, (1, 2, 3, 4, 5, 6, 7, 9),
+        self._decode_fn = _jit(_decode, (1, 2, 3, 4, 5, 6, 7, 9, 14),
                                outs=dec_outs)
-        self._decode_n_fn = _jit(_decode_n, (1, 2, 3, 4, 5, 6, 7, 9),
-                                 static=(14, 15), outs=decn_outs)
-        spec_outs = (((slot_sh2,) + state_outs + (slot_sh,))
+        self._decode_n_fn = _jit(_decode_n, (1, 2, 3, 4, 5, 6, 7, 9, 14),
+                                 static=(17, 18), outs=decn_outs)
+        spec_outs = (((slot_sh2,) + state_outs + (slot_sh, slot_sh))
                      if state_outs else None)
-        self._spec_fn = _jit(_spec_verify, (1, 2, 3, 4, 5, 6, 7, 9),
-                             static=(16,), outs=spec_outs)
+        self._spec_fn = _jit(_spec_verify, (1, 2, 3, 4, 5, 6, 7, 9, 14),
+                             static=(19,), outs=spec_outs)
         self._spec_execs: Dict[Any, Any] = {}
         self._release_fn = _jit(
             _release, (0, 1, 2, 3, 4),
@@ -1302,8 +1380,8 @@ class Engine:
             _install_key, (0,),
             outs=(slot_sh, self._repl_sh) if slot_sh is not None else None)
         self._set_mask_fn = _jit(
-            _set_mask, (0, 1),
-            outs=(slot_sh2, slot_sh) if slot_sh else None)
+            _set_mask, (0, 1, 2),
+            outs=(slot_sh2, slot_sh, slot_sh) if slot_sh else None)
         # AOT-compiled decode_n executables keyed by (n, attn_bucket) — a
         # bucket crossing must swap programs, never recompile mid-serving
         self._decode_execs: Dict[Any, Any] = {}
@@ -1807,21 +1885,66 @@ class Engine:
         out[:row.shape[0]] = row
         return out
 
-    def set_mask(self, slot: int, row: np.ndarray):
+    def set_mask(self, slot: int, row: np.ndarray, gid: int = -1):
         """Install the packed allowed-token mask for ``slot`` (applies from
-        the next decode step; constrained until release/clear_mask)."""
+        the next decode step; constrained until release/clear_mask).
+
+        ``gid`` >= 0 additionally places the slot in DEVICE-grammar mode:
+        its mask is read from the installed grammar table row ``gid`` and
+        the automaton advances on device every sampled token, so the slot
+        keeps the full decode_n chunk instead of one token per dispatch.
+        The host row still installs as the fallback the device escapes
+        to."""
         self._constrained[slot] = True
-        self.mask_bits, self._constr_dev = self._set_mask_fn(
-            self.mask_bits, self._constr_dev, self._gr(np.int32(slot)),
-            self._gr(self._pad_mask_row(row)), self._gr(np.int32(1)))
+        self._gdev_mode[slot] = gid >= 0
+        (self.mask_bits, self._constr_dev,
+         self._gstate) = self._set_mask_fn(
+            self.mask_bits, self._constr_dev, self._gstate,
+            self._gr(np.int32(slot)), self._gr(self._pad_mask_row(row)),
+            self._gr(np.int32(1)), self._gr(np.int32(gid)))
 
     def clear_mask(self, slot: int):
         if not self._constrained[slot]:
             return
         self._constrained[slot] = False
-        self.mask_bits, self._constr_dev = self._set_mask_fn(
-            self.mask_bits, self._constr_dev, self._gr(np.int32(slot)),
-            self._mask_ones, self._gr(np.int32(0)))
+        self._gdev_mode[slot] = False
+        (self.mask_bits, self._constr_dev,
+         self._gstate) = self._set_mask_fn(
+            self.mask_bits, self._constr_dev, self._gstate,
+            self._gr(np.int32(slot)), self._mask_ones,
+            self._gr(np.int32(0)), self._gr(np.int32(-1)))
+
+    def install_grammar(self, key: Any, mask: np.ndarray,
+                        trans: np.ndarray) -> bool:
+        """Upload a precomputed grammar program (ops/constrain.py
+        GrammarTable.mask/.trans) to the device tables. ``key`` identifies
+        the table; a matching key is a no-op. Returns False — scheduler
+        falls back to host masks — when a DIFFERENT table is live while
+        any slot is still in device mode (swapping it under them would
+        corrupt their automata). Rows/cols beyond the static
+        [TPU_GRAMMAR_STATES, vocab] capacity truncate; transitions into
+        truncated states were already -1 (escape) in the table."""
+        if not self._grammar_device:
+            return False
+        if self._gtable_key == key:
+            return True
+        if self._gdev_mode.any():
+            return False
+        G, V = self._gstates_cap, self.cfg.vocab_size
+        # lint: allow(host-sync-hot-path): grammar tables arrive as host numpy; upload is once per grammar, not per dispatch
+        mask = np.asarray(mask, np.uint32)[:G]
+        trans = np.asarray(trans, np.int32)[:G]  # lint: allow(host-sync-hot-path): host numpy staging for device_put
+        m = np.zeros((G, self.mask_words), np.uint32)
+        m[:mask.shape[0], :min(mask.shape[1], self.mask_words)] = \
+            mask[:, :self.mask_words]
+        t = np.full((G, V), -1, np.int32)
+        t[:trans.shape[0], :min(trans.shape[1], V)] = trans[:, :V]
+        # a transition into a state id beyond capacity escapes
+        t[t >= G] = -1
+        self._gmask_dev = self._gr(m)
+        self._gtrans_dev = self._gr(t)
+        self._gtable_key = key
+        return True
 
     def _tables_dev(self):
         if not self.paged:
@@ -1838,12 +1961,13 @@ class Engine:
                 from .paged import PagesExhausted
                 raise PagesExhausted(f"pool dry; victims {victims}")
         (toks, self.k_cache, self.v_cache, self.lengths, self.counts,
-         self.last_tokens, self.pring, self.mu,
-         self.keys) = self._decode_fn(
+         self.last_tokens, self.pring, self.mu, self.keys,
+         self._gstate) = self._decode_fn(
             self.params, self.k_cache, self.v_cache, self.lengths,
             self.counts, self.last_tokens, self.pring, self.mu, self.sp,
             self.keys, self._active_dev, self.mask_bits, self._constr_dev,
-            self._rln_dev, self._tables_dev())
+            self._rln_dev, self._gstate, self._gmask_dev,
+            self._gtrans_dev, self._tables_dev())
         self._host_lengths[self.active] += 1
         return self._fetch(toks)
 
@@ -1874,7 +1998,8 @@ class Engine:
                 self.params, self.k_cache, self.v_cache, self.lengths,
                 self.counts, self.last_tokens, self.pring, self.mu,
                 self.sp, self.keys, self._active_dev, self.mask_bits,
-                self._constr_dev, self._rln_dev, n, attn_len,
+                self._constr_dev, self._rln_dev, self._gstate,
+                self._gmask_dev, self._gtrans_dev, n, attn_len,
                 self._tables_dev(), budgets).compile()
             self._decode_execs[key] = exe
         return exe
@@ -2420,11 +2545,13 @@ class Engine:
         exe = self._decode_n_exec(n, self._attn_bucket(n))
         budgets = self.step_budgets(n)
         (toks_n, self.k_cache, self.v_cache, self.lengths, self.counts,
-         self.last_tokens, self.pring, self.mu, self.keys) = exe(
+         self.last_tokens, self.pring, self.mu, self.keys,
+         self._gstate) = exe(
             self.params, self.k_cache, self.v_cache, self.lengths,
             self.counts, self.last_tokens, self.pring, self.mu, self.sp,
             self.keys, self._active_dev, self.mask_bits, self._constr_dev,
-            self._rln_dev, self._tables_dev(),
+            self._rln_dev, self._gstate, self._gmask_dev,
+            self._gtrans_dev, self._tables_dev(),
             self._g(budgets, self._slot_sh))
         self._host_lengths[self.active] += budgets[self.active]
         # stamp AFTER the successful launch: a raise above leaves the
@@ -2446,8 +2573,9 @@ class Engine:
                 self.params, self.k_cache, self.v_cache, self.lengths,
                 self.counts, self.last_tokens, self.pring, self.mu,
                 self.sp, self.keys, self._active_dev, self.mask_bits,
-                self._constr_dev, self._rln_dev, flags, drafts, attn_len,
-                self._tables_dev()).compile()
+                self._constr_dev, self._rln_dev, self._gstate,
+                self._gmask_dev, self._gtrans_dev, flags, drafts,
+                attn_len, self._tables_dev()).compile()
             self._spec_execs[key] = exe
         return exe
 
@@ -2501,11 +2629,13 @@ class Engine:
         flags = self._spec_flags()
         exe = self._spec_exec(k, self._attn_bucket(n))
         (toks, self.k_cache, self.v_cache, self.lengths, self.counts,
-         self.last_tokens, self.pring, self.mu, self.keys) = exe(
+         self.last_tokens, self.pring, self.mu, self.keys,
+         self._gstate) = exe(
             self.params, self.k_cache, self.v_cache, self.lengths,
             self.counts, self.last_tokens, self.pring, self.mu, self.sp,
             self.keys, self._active_dev, self.mask_bits, self._constr_dev,
-            self._rln_dev, self._g(flags, self._slot_sh),
+            self._rln_dev, self._gstate, self._gmask_dev,
+            self._gtrans_dev, self._g(flags, self._slot_sh),
             self._g(drafts, self._slot_sh2), self._tables_dev())
         # inactive slots get budget 0, not 1: they neither advance at
         # launch nor emit, so their rollback is exactly zero — a slot
@@ -2527,15 +2657,19 @@ class Engine:
         released since launch are masked out (their lengths were already
         reset), and the clamp keeps a stale ack from ever driving a
         length negative."""
-        rb = np.asarray(rollback, np.int64)
+        rb = np.asarray(rollback, np.int64)  # lint: allow(host-sync-hot-path): rollback vector is host numpy
         rb = np.minimum(np.where(self.active, rb, 0), self._host_lengths)
         self._host_lengths -= rb
 
     def step_budgets(self, n: int) -> np.ndarray:
-        """Per-slot decode-step budget for a chunk of ``n``: constrained
-        slots advance one token per dispatch (their PDA mask refreshes on
-        the host between dispatches); everyone else takes the full chunk."""
-        return np.where(self._constrained, 1, n).astype(np.int32)
+        """Per-slot decode-step budget for a chunk of ``n``: HOST-masked
+        constrained slots advance one token per dispatch (their PDA mask
+        refreshes on the host between dispatches); device-grammar slots
+        and everyone else take the full chunk — the device table refreshes
+        their mask per step, and an on-device escape freezes the slot so
+        the overshoot rolls back through spec_ack."""
+        host_masked = self._constrained & ~self._gdev_mode
+        return np.where(host_masked, 1, n).astype(np.int32)
 
     def release(self, slot: int, park: bool = False):
         """Free ``slot``. With ``park=True`` the KV cache and slot state

@@ -210,8 +210,8 @@ class MirroredEngine:
                 # followers never need to wait a handle to stay
                 # bit-identical
                 "decode_n_launch", "spec_ack", "release", "set_mask",
-                "clear_mask", "warm_buckets", "free_slot_pages",
-                "prepare_decode",
+                "clear_mask", "install_grammar", "warm_buckets",
+                "free_slot_pages", "prepare_decode",
                 # radix prefix cache: stitching/donation/eviction mutate
                 # page refcounts and (for COW) dispatch a page copy, so
                 # every host must replay them in order; prefix_probe is

@@ -67,8 +67,9 @@ declare("TPU_ENGINE_DTYPE", "enum", None, "engine",
         "weight dtype override (bfloat16|bf16|float32|int8|int4); unset = "
         "resolved per model at load")
 declare("TPU_KV_DTYPE", "enum", None, "engine",
-        "KV-cache storage dtype (bfloat16|float32|int8); unset = int8 on "
-        "TPU, float32 on CPU")
+        "KV-cache storage dtype (bfloat16|float32|int8|int4); int4 is "
+        "paged-only (nibble-packed pages); unset = int8 on TPU, float32 "
+        "on CPU")
 declare("TPU_MAX_SLOTS", "int", 0, "engine",
         "continuous-batching slots; 0 = per-model default (32 paged, "
         "8 dense)")
@@ -107,6 +108,9 @@ declare("TPU_PAGED_V4", "bool", 0, "paged",
         "1 opts in to the v4 epoch-fenced paged kernel variant")
 declare("TPU_PAGED_DEPTH", "int", 2, "paged",
         "paged kernel pipeline depth (double-buffering stages)")
+declare("TPU_PAGED_FUSED", "bool", 1, "paged",
+        "0 disables the fused paged-attention pallas kernels entirely "
+        "(gather+einsum reference path; A/B control and parity oracle)")
 
 # -- ops / kernels ----------------------------------------------------------
 
@@ -118,6 +122,13 @@ declare("TPU_MHA_KERNEL", "bool", 0, "ops",
 
 declare("TPU_ASYNC_DISPATCH", "bool", 1, "scheduler",
         "0 disables double-buffered async decode dispatch")
+declare("TPU_GRAMMAR_DEVICE", "bool", 1, "scheduler",
+        "0 disables device-side constrained decode (precomputed grammar "
+        "mask/transition tables indexed by a device-resident FSM state); "
+        "constrained slots then pay one sync dispatch per token")
+declare("TPU_GRAMMAR_STATES", "int", 64, "scheduler",
+        "device grammar-table capacity in automaton states; walks that "
+        "leave the table escape to host masks for that request")
 declare("TPU_PREFILL_CHUNK", "int", None, "scheduler",
         "prefill chunk size in tokens; unset = adaptive per-model choice")
 declare("TPU_PREFIX_CACHE", "bool", 1, "scheduler",

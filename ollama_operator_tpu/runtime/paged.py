@@ -371,9 +371,10 @@ class ShardedPageTable:
         return slot // self._slots_per
 
     # -- epoch fence (delegated per shard) --------------------------------
-    # dp > 1 stays on synchronous dispatch (scheduler gates async off with
-    # cause="paged_dp"), so these only ever see epoch == retired — but the
-    # fence API must exist so engine/conftest code is layout-agnostic.
+    # dp > 1 double-buffers like the flat layout: every shard's table
+    # advances/retires at the same call-stream position (epochs are
+    # global, page quarantines per-shard), so freed pages stay fenced
+    # until the dispatch that captured their block-table row lands.
 
     @property
     def quarantined(self) -> int:

@@ -26,6 +26,7 @@ from typing import Iterator, List, Optional, Sequence
 
 import numpy as np
 
+from ..ops.constrain import GrammarTable
 from ..server.metrics import GLOBAL as METRICS
 from . import accounting
 from . import drafter
@@ -141,6 +142,11 @@ class Request:
         self.prompt_ids = np.asarray(prompt_ids, np.int32)
         self.embeds = embeds          # [n_prompt, D] multimodal embeddings
         self.constraint = constraint  # ops/constrain.py grammar state
+        # device-grammar program for this request's token table: None =
+        # not yet resolved, False = unavailable (capability off, build
+        # failed, or another grammar owns the device tables), else the
+        # installed GrammarTable (see Scheduler._grammar_table)
+        self._gtable = None
         self.opts = opts
         self.max_tokens = max_tokens
         self.eog_ids = eog_ids
@@ -303,27 +309,21 @@ class Scheduler:
             if prefill_chunk and engine.supports_extend else 0)
         # double-buffered async dispatch: launch decode dispatch N+1
         # before materialising N's tokens, so host fan-out/detokenise
-        # overlaps device compute (JAX async dispatch). Grammar is the
-        # ONE remaining sync fallback (a fresh host PDA mask per token);
-        # fused speculation double-buffers with its stages reordered —
-        # see the spec branch in _step. Paged mode double-buffers too:
-        # the page table's epoch fence quarantines freed pages until
-        # the dispatch that captured their block table materialises, so
+        # overlaps device compute (JAX async dispatch). The only
+        # remaining sync fallback is HOST-masked grammar (a fresh host
+        # PDA mask per token — device-table grammar slots ride async,
+        # see _fanout); fused speculation double-buffers with its
+        # stages reordered — see the spec branch in _step. Paged mode
+        # double-buffers too, dp-sharded pools included: the page
+        # table's epoch fence quarantines freed pages until the
+        # dispatch that captured their block table materialises
+        # (ShardedPageTable delegates the fence per shard), so
         # recycling can never corrupt an in-flight program's reads
         # (runtime/paged.py).
-        # Only dp-sharded paged (ShardedPageTable) stays synchronous:
-        # per-shard pools make the pressure-relief stall path ambiguous
-        # about WHICH shard's fence to drain, and no measured deployment
-        # runs paged dp>1 yet.
         if async_dispatch is None:
             async_dispatch = os.environ.get(
                 "TPU_ASYNC_DISPATCH", "1").lower() not in ("0", "false")
-        paged_dp = engine.paged and getattr(engine, "_paged_dp", 1) > 1
-        self.async_dispatch = bool(async_dispatch) and not paged_dp
-        if async_dispatch and paged_dp:
-            METRICS.inc("tpu_model_async_fallback_total", 1.0,
-                        '{cause="paged_dp"}')
-            FLIGHT.record("async_fallback", cause="paged_dp")
+        self.async_dispatch = bool(async_dispatch)
         # epoch of the newest decode handle already materialised — the
         # next launch passes it back as retire= so the engine unfences
         # pages quarantined at or before it (and so followers, which
@@ -338,6 +338,12 @@ class Scheduler:
         # double-buffering — drafted counts feed the acceptance metrics
         # when the handle materialises
         self._pending = None
+        # device-grammar escape bookkeeping: slot → request whose
+        # ALREADY-LAUNCHED next dispatch ran with the slot frozen
+        # (its automaton escaped the device table mid-chunk); that
+        # dispatch's rows for the slot are garbage and its launch-time
+        # length advance rolls back at fan-out (see _fanout)
+        self._gdiscard: dict = {}
         # the waiting line: strict-priority classes + per-tenant WDRR
         # over token budgets + SLO-aware early rejection
         # (runtime/admission.py). Host-side policy state only — nothing
@@ -1005,7 +1011,7 @@ class Scheduler:
                          if req.all_tokens[-1] in req.eog_ids
                          else "length")
         elif req.constraint is not None:
-            self.engine.set_mask(slot, req.constraint.mask_row())
+            self._refresh_mask(slot, req)
 
     def _expired_at_admission(self, req: Request) -> bool:
         """Deadline re-check at the moment a request is about to touch
@@ -1809,6 +1815,55 @@ class Scheduler:
             return val
         raise val
 
+    # -- device-grammar plumbing ------------------------------------------
+
+    def _grammar_table(self, req: Request):
+        """The engine-installed GrammarTable for ``req``'s constraint, or
+        None when device grammar is unavailable for it (engine knob off,
+        table build failed, or a DIFFERENT grammar currently owns the
+        device tables while slots run on it). Resolved once per request
+        and cached on it; GrammarTable.for_table itself caches the BFS
+        per TokenTable, so repeat requests share one table build."""
+        c = req.constraint
+        if (c is None or not getattr(c, "grammar_table_ok", False)
+                or not getattr(self.engine, "_grammar_device", False)):
+            return None
+        if req._gtable is not None:
+            return req._gtable or None
+        try:
+            gt = GrammarTable.for_table(c.table,
+                                        cap=self.engine._gstates_cap)
+        except Exception:  # lint: allow(exception-hygiene): any table-build failure falls back to host masks
+            gt = None
+        if gt is None or not self.engine.install_grammar(
+                ("grammar", id(gt)), gt.mask, gt.trans):
+            req._gtable = False
+            return None
+        req._gtable = gt
+        return gt
+
+    def _refresh_mask(self, slot: int, req: Request):
+        """Install ``req``'s current PDA mask on ``slot``; when the PDA
+        state sits inside the installed device table the slot enters
+        device-grammar mode — the mask then refreshes ON DEVICE per
+        sampled token and the slot keeps the full decode chunk instead
+        of one token per (synchronous) dispatch."""
+        gid = -1
+        gt = self._grammar_table(req)
+        if gt is not None:
+            gid = gt.state_id(req.constraint.state)
+        self.engine.set_mask(slot, req.constraint.mask_row(), gid=gid)
+
+    def _grammar_ack(self, slot: int, over: int):
+        """Roll back a device-grammar slot's launch-time host-length
+        over-advance (the frozen steps after an on-device escape) —
+        same mirrored reconciliation path fused speculation uses."""
+        if over <= 0:
+            return
+        rb = np.zeros((self.engine.n_slots,), np.int64)
+        rb[slot] = over
+        self.engine.spec_ack(rb)
+
     def _wait_handle(self, handle, snapshot=None,
                      drafted=None) -> np.ndarray:
         """Materialise a launched dispatch and reconcile host state: the
@@ -1920,7 +1975,7 @@ class Scheduler:
         handle, snapshot, drafted = self._pending
         self._pending = None
         toks_n = self._wait_handle(handle, snapshot, drafted)
-        self._fanout(toks_n, snapshot)
+        self._fanout(toks_n, snapshot, chunked=drafted is None)
 
     def _decoding(self) -> dict:
         """slot → request for every slot the NEXT decode dispatch will
@@ -1967,15 +2022,18 @@ class Scheduler:
         # chunked decode: ecfg.decode_chunk steps per device round-trip.
         # A slot that stops mid-chunk has its remaining rows discarded
         # (_running[slot] goes None); the over-decoded cache entries are
-        # zeroed by release(). Grammar-constrained slots need a fresh
+        # zeroed by release(). HOST-masked grammar slots need a fresh
         # host-side PDA mask per token, so the engine freezes them after
         # the chunk's FIRST step (per-slot budgets) — they advance one
         # token per dispatch while the rest of the batch keeps the full
         # chunk (round-1 weak #5: one format:"json" request used to drop
-        # everyone to n=1). Only when EVERY active slot is constrained is
+        # everyone to n=1). Device-grammar slots (engine._gdev_mode)
+        # keep the full chunk: their mask refreshes on device from the
+        # installed table. Only when EVERY active slot is host-masked is
         # a 1-step dispatch cheaper.
-        n_steps = (1 if all(r.constraint is not None
-                            for r in decoding.values())
+        gdev = self.engine._gdev_mode
+        n_steps = (1 if all(r.constraint is not None and not gdev[s]
+                            for s, r in decoding.items())
                    else None)
         spec_usable = (self.spec_k > 0 and self.engine.sp_size == 1
                        and not (self.engine.paged
@@ -1992,8 +2050,12 @@ class Scheduler:
         if not decoding:
             self._drain_pending()
             return
-        constrained = any(r.constraint is not None
-                          for r in decoding.values())
+        # only HOST-masked grammar slots force the pipeline empty (fresh
+        # PDA mask per token); device-grammar slots advance their
+        # automaton on device and ride async like everyone else
+        gdev = self.engine._gdev_mode
+        constrained = any(r.constraint is not None and not gdev[s]
+                          for s, r in decoding.items())
         if not self.async_dispatch or constrained:
             # synchronous path: grammar needs a fresh host PDA mask
             # between dispatches, so the pipeline must be empty before
@@ -2038,7 +2100,7 @@ class Scheduler:
                         r.trace.event_at(t0, "dispatch", kind="decode",
                                          sync=True,
                                          dur_ms=round(dur * 1e3, 3))
-            self._fanout(toks_n, decoding)
+            self._fanout(toks_n, decoding, chunked=drafts is None)
             return
         if spec_usable:
             # fused speculation double-buffers with the stages
@@ -2074,11 +2136,13 @@ class Scheduler:
                 # deliver them before the supervisor errors whoever is
                 # left
                 if toks_prev is not None:
-                    self._fanout(toks_prev, prev_snapshot)
+                    self._fanout(toks_prev, prev_snapshot,
+                                 chunked=prev_drafted is None)
                 raise
             self._pending = (handle, decoding, drafted)
             if toks_prev is not None:
-                self._fanout(toks_prev, prev_snapshot)
+                self._fanout(toks_prev, prev_snapshot,
+                             chunked=prev_drafted is None)
             return
         # double-buffered async dispatch: launch dispatch N+1 FIRST,
         # then materialise and fan out dispatch N — detokenise/queue
@@ -2100,9 +2164,10 @@ class Scheduler:
             prev_handle, prev_snapshot, prev_drafted = prev
             toks_n = self._wait_handle(prev_handle, prev_snapshot,
                                        prev_drafted)
-            self._fanout(toks_n, prev_snapshot)
+            self._fanout(toks_n, prev_snapshot,
+                         chunked=prev_drafted is None)
 
-    def _fanout(self, toks_n, snapshot: dict):
+    def _fanout(self, toks_n, snapshot: dict, chunked: bool = True):
         """Deliver one dispatch's token rows [n, B] to the requests in
         ``snapshot`` (slot → request AT LAUNCH time). Under
         double-buffering a slot may have finished, been preempted, or
@@ -2114,8 +2179,27 @@ class Scheduler:
         Per-slot chunk buffers: ONE queue item (and one monotonic stamp)
         per request per dispatch, not per token — at decode_chunk=32 this
         cuts queue/lock traffic on the consumer path 32×, which is the
-        bulk of the HTTP-vs-engine throughput gap (BENCH_r05)."""
+        bulk of the HTTP-vs-engine throughput gap (BENCH_r05).
+
+        Device-grammar slots consume MULTIPLE rows per dispatch: the host
+        mirrors the device automaton through the installed GrammarTable
+        (one trans lookup per token, validated against the exact PDA) and
+        stops consuming at the row where the device escaped the table —
+        later rows were sampled with the slot frozen and are garbage.
+        The escape's launch-time host-length over-advance rolls back via
+        _grammar_ack, the mask re-installs from the exact PDA state
+        (re-entering device mode when that state is back in the table),
+        and the ALREADY-LAUNCHED next dispatch — which ran with the slot
+        still frozen — is marked in _gdiscard so its rows are dropped and
+        its budget acked when IT fans out. ``chunked`` distinguishes full-
+        chunk dispatches from fused-spec ones (budget 1 per constrained
+        slot, reconciled by _wait_handle already — no grammar ack)."""
         pend: dict = {}
+        # lint: allow(host-sync-hot-path): toks_n was fetched by DecodeHandle.wait — shape read of a host array
+        n_rows = int(np.asarray(toks_n).shape[0])
+        # slot → [GrammarTable|None, mirrored device state id] for
+        # device-grammar slots this dispatch; st < 0 = stop consuming
+        gwalk: dict = {}
 
         def _flush(slot: int, req: Request):
             buf = pend.pop(slot, None)
@@ -2138,6 +2222,33 @@ class Scheduler:
                             f'{{tenant="{req.tenant}"}}')
                 req.out.put(("tokens", buf))
 
+        def _walk_start(slot: int, req: Request):
+            """None = host-masked (1-token budget); else [gt, st] with
+            ``st`` the mirrored device automaton state (< 0: discard
+            every row of this dispatch for the slot)."""
+            marked = self._gdiscard.pop(slot, None)
+            if marked is req:
+                # this dispatch launched while the slot sat frozen after
+                # an escape: every row is garbage, and (full-chunk
+                # dispatch) its whole launch budget is overshoot. Spec
+                # dispatches emitted all-sentinel rows for the frozen
+                # slot and _wait_handle already rolled their budget back.
+                if chunked:
+                    self._grammar_ack(slot, n_rows)
+                return [None, -1]
+            if not self.engine._gdev_mode[slot]:
+                return None
+            gt = self._grammar_table(req)
+            if gt is None:
+                return None
+            st = gt.state_id(req.constraint.state)
+            if st < 0:   # host/device bookkeeping diverged: recover
+                if chunked:
+                    self._grammar_ack(slot, n_rows)
+                self._refresh_mask(slot, req)
+                return [gt, -1]
+            return [gt, st]
+
         # lint: allow(host-sync-hot-path): toks_n was fetched by DecodeHandle.wait — the sanctioned sync point
         for row_idx, row in enumerate(np.asarray(toks_n)):
             any_running = False
@@ -2146,8 +2257,16 @@ class Scheduler:
                         or slot in self._prefilling):
                     continue   # slot changed hands since launch
                 any_running = True
-                if req.constraint is not None and row_idx >= 1:
-                    continue  # frozen after its 1-token budget
+                walk = None
+                if req.constraint is not None:
+                    if slot not in gwalk:
+                        gwalk[slot] = _walk_start(slot, req)
+                    walk = gwalk[slot]
+                    if walk is None:
+                        if row_idx >= 1:
+                            continue  # host-masked: frozen after 1 token
+                    elif walk[1] < 0:
+                        continue  # device walk ended: rows are garbage
                 tid = int(row[slot])  # lint: allow(host-sync-hot-path): row is a host array post-wait
                 if tid >= self.engine.cfg.vocab_size:
                     continue   # sentinel padding past the slot's
@@ -2158,6 +2277,8 @@ class Scheduler:
                 if (req.constraint is not None
                         and tid not in req.eog_ids
                         and not req.constraint.advance(tid)):
+                    if walk is not None and chunked:
+                        self._grammar_ack(slot, n_rows - (row_idx + 1))
                     _flush(slot, req)
                     self._finish(slot, req, "stop")
                     continue
@@ -2165,6 +2286,11 @@ class Scheduler:
                     req.stats.t_first_token = time.monotonic()
                 req.all_tokens.append(tid)  # EOG incl.: it's in the cache
                 if tid in req.eog_ids:
+                    if walk is not None and chunked:
+                        # EOG transitions escape on device: the slot
+                        # advanced this row then froze — reconcile the
+                        # chunk's remaining budget before release
+                        self._grammar_ack(slot, n_rows - (row_idx + 1))
                     _flush(slot, req)
                     self._finish(slot, req, "stop")
                     continue
@@ -2183,7 +2309,28 @@ class Scheduler:
                     _flush(slot, req)
                     self._finish(slot, req, "length")
                 elif req.constraint is not None:
-                    self.engine.set_mask(slot, req.constraint.mask_row())
+                    if walk is None:
+                        self._refresh_mask(slot, req)
+                        continue
+                    gt = walk[0]
+                    nid = (int(gt.trans[walk[1], tid])  # lint: allow(host-sync-hot-path): gt.trans is host numpy (GrammarTable)
+                           if tid < gt.trans.shape[1] else -1)
+                    if nid >= 0:
+                        walk[1] = nid   # stay on device: no host mask
+                        continue
+                    # device escaped AFTER emitting this token: the rest
+                    # of the chunk is garbage — reconcile the launch-time
+                    # over-advance, re-install the mask from the exact
+                    # PDA state (re-entering device mode when it is back
+                    # in the table), and mark the already-in-flight next
+                    # dispatch, which ran with the slot still frozen
+                    walk[1] = -1
+                    if chunked:
+                        self._grammar_ack(slot, n_rows - (row_idx + 1))
+                    if (self._pending is not None
+                            and self._pending[1].get(slot) is req):
+                        self._gdiscard[slot] = req
+                    self._refresh_mask(slot, req)
             if not any_running:
                 break
         # end of dispatch: flush every still-running slot's chunk

@@ -42,10 +42,12 @@ def main(argv=None):
                         "group scales)")
     p.add_argument("--kv-dtype", default=os.environ.get("TPU_KV_DTYPE")
                    or None,
-                   choices=["bfloat16", "float32", "int8"],
+                   choices=["bfloat16", "float32", "int8", "int4"],
                    help="KV cache storage (default int8 on TPU — half the "
                         "decode cache traffic, double the context, the "
-                        "measured serving config; float32 on CPU)")
+                        "measured serving config; float32 on CPU; int4 "
+                        "nibble-packs two positions per byte — paged "
+                        "cache only)")
     p.add_argument("--max-slots", type=int,
                    default=int(os.environ.get("TPU_MAX_SLOTS", "0")),
                    help="continuous-batching slots (0 = per-model default:"

@@ -1,0 +1,249 @@
+"""Device-table grammar decode (the cause="grammar" retirement): the
+GrammarTable BFS closure, engine-level device-vs-host bit parity —
+including on-device escapes, the host-length rollback, and re-entry —
+and the chunk-budget split between device-table and host-masked slots.
+
+The scheduler-level acceptance (constrained traffic double-buffering
+with the fallback counter pinned at 0) lives in test_paged_async.py;
+this file pins the mechanism underneath it.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ollama_operator_tpu.models import config as cfglib
+from ollama_operator_tpu.models import decoder
+from ollama_operator_tpu.ops.constrain import (
+    INITIAL_STATE, GrammarTable, JsonConstraint, advance_bytes)
+from ollama_operator_tpu.runtime.engine import Engine, EngineConfig, SlotOptions
+from test_constrain import EOS, PIECES, make_table
+
+CHUNK = 4
+
+
+@pytest.fixture(scope="module")
+def table():
+    return make_table()
+
+
+@pytest.fixture(scope="module")
+def gt(table):
+    return GrammarTable.for_table(table, cap=64)
+
+
+@pytest.fixture(scope="module")
+def params():
+    cfg = cfglib.PRESETS["tiny"]
+    return decoder.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+
+
+def _engine(params):
+    cfg = cfglib.PRESETS["tiny"]
+    return Engine(cfg, params,
+                  ecfg=EngineConfig(max_slots=2, max_seq_len=128,
+                                    cache_dtype=jnp.float32,
+                                    min_prefill_bucket=16,
+                                    decode_chunk=CHUNK))
+
+
+# --- GrammarTable closure ----------------------------------------------------
+
+def test_grammar_table_masks_match_pda(table, gt):
+    """Every tabled row is exactly mask_for of its packed state, and the
+    BFS root is the start state."""
+    assert gt.states[0] == INITIAL_STATE
+    assert 1 < gt.n_states <= 64
+    for g, st in enumerate(gt.states):
+        np.testing.assert_array_equal(gt.mask[g], table.mask_for(st))
+
+
+def test_grammar_table_transitions_exact(table, gt):
+    """trans[g, t] is the id of advance_bytes(state_g, piece_t) for every
+    mask-allowed non-EOG token, and -1 (escape) everywhere else."""
+    for g, st in enumerate(gt.states):
+        allowed = np.asarray(table.mask_for(st))
+        for tid, piece in enumerate(table.pieces):
+            bit = (allowed[tid >> 5] >> np.uint32(tid & 31)) & 1
+            nid = int(gt.trans[g, tid])
+            if not bit or tid in set(table.eog_ids) or not piece:
+                assert nid == -1, (g, tid)
+                continue
+            ns = advance_bytes(st, piece)
+            if nid < 0:
+                # escape: either the PDA rejected it (impossible for a
+                # masked-in token) or the target state is beyond cap
+                assert ns is not None and gt.state_id(ns) == -1, (g, tid)
+            else:
+                assert nid < gt.n_states
+                assert gt.states[nid] == ns, (g, tid)
+
+
+def test_grammar_table_cap_and_cache(table):
+    small = GrammarTable.for_table(table, cap=4)
+    assert small.n_states <= 4
+    assert (small.trans < 4).all()           # never points beyond cap
+    assert small is GrammarTable.for_table(table, cap=4)   # cached
+    assert small is not GrammarTable.for_table(table, cap=64)
+    assert small.state_id(INITIAL_STATE) == 0
+    assert small.state_id(None) == -1
+    assert small.state_id(b"\xff\xff not a state") == -1
+
+
+def test_install_grammar_guards(params, gt, monkeypatch):
+    eng = _engine(params)
+    assert eng.install_grammar(("g", 1), gt.mask, gt.trans)
+    assert eng.install_grammar(("g", 1), gt.mask, gt.trans)   # same key
+    # a DIFFERENT table swaps freely while no slot is in device mode...
+    assert eng.install_grammar(("g", 2), gt.mask, gt.trans)
+    # ...but not under a live device-mode slot
+    eng._gdev_mode[0] = True
+    assert not eng.install_grammar(("g", 3), gt.mask, gt.trans)
+    assert eng.install_grammar(("g", 2), gt.mask, gt.trans)   # still live
+    eng._gdev_mode[0] = False
+    monkeypatch.setattr(eng, "_grammar_device", False)
+    assert not eng.install_grammar(("g", 4), gt.mask, gt.trans)
+
+
+def test_step_budgets_split(params, gt):
+    """Host-masked constrained slots step 1 token per dispatch;
+    device-table slots keep the full chunk."""
+    eng = _engine(params)
+    eng._constrained[0] = True                 # host-masked
+    eng._constrained[1] = True
+    eng._gdev_mode[1] = True                   # device-table
+    np.testing.assert_array_equal(eng.step_budgets(CHUNK), [1, CHUNK])
+
+
+# --- engine device-vs-host bit parity ---------------------------------------
+
+def _host_run(params, table, seed, max_steps=63):
+    """Reference: host PDA mask refreshed every token (1-token budget
+    comes from step_budgets in the scheduler; here we just re-mask per
+    chunk row 0 and step chunk-by-chunk on slot 1)."""
+    eng = _engine(params)
+    opts = SlotOptions(temperature=0.9, seed=seed, repeat_penalty=1.0)
+    c = JsonConstraint(table)
+    first = eng.admit(1, np.array([7, 7], np.int32), opts,
+                      mask_row=c.mask_row())
+    assert c.advance(first)
+    eng.set_mask(1, c.mask_row())
+    out = [int(first)]
+    for _ in range(max_steps):
+        t = int(eng.decode()[1])
+        out.append(t)
+        if t == EOS:
+            break
+        assert c.advance(t), (t, out)
+        eng.set_mask(1, c.mask_row())
+    return out
+
+
+def _device_run(params, table, gt, seed, max_toks=64):
+    """Device-table run with the scheduler's host mirror: consume chunk
+    rows while the device automaton stayed in-table; on escape, roll the
+    over-advance back through spec_ack and re-install the exact mask
+    (re-entering device mode when the PDA state is tabled again)."""
+    eng = _engine(params)
+    assert eng.install_grammar(("parity", id(gt)), gt.mask, gt.trans)
+    opts = SlotOptions(temperature=0.9, seed=seed, repeat_penalty=1.0)
+    c = JsonConstraint(table)
+    first = eng.admit(1, np.array([7, 7], np.int32), opts,
+                      mask_row=c.mask_row())
+    assert c.advance(first)
+    gid = gt.state_id(c.state)
+    assert gid >= 0
+    eng.set_mask(1, c.mask_row(), gid=gid)
+    dev_mode = True
+    out = [int(first)]
+    escapes = 0
+    done = False
+    while not done and len(out) < max_toks:
+        toks = eng.decode_n(CHUNK)
+        if not dev_mode:
+            # HOST-masked chunk: step_budgets froze the slot after row 0
+            # (rows >= 1 are stale-mask resamples, nothing to roll back)
+            t = int(toks[0, 1])
+            out.append(t)
+            if t == EOS:
+                break
+            assert c.advance(t), (t, out)
+            gid = gt.state_id(c.state)
+            dev_mode = gid >= 0
+            eng.set_mask(1, c.mask_row(), gid=gid)
+            continue
+        st = gt.state_id(c.state)
+        for r in range(CHUNK):
+            t = int(toks[r, 1])
+            if t == EOS:
+                out.append(t)
+                done = True
+                break
+            nid = int(gt.trans[st, t]) if st >= 0 else -1
+            assert c.advance(t), (r, t, out)
+            out.append(t)
+            if nid < 0:
+                # device escaped after consuming t: remaining rows are
+                # garbage — reconcile lengths, re-mask, maybe re-enter
+                escapes += 1
+                ns = gt.state_id(c.state)
+                eng.spec_ack(np.array([0, CHUNK - (r + 1)], np.int64))
+                dev_mode = ns >= 0
+                eng.set_mask(1, c.mask_row(), gid=ns if ns >= 0 else -1)
+                break
+            st = nid
+    return out, escapes
+
+
+@pytest.mark.parametrize("seed", [0, 5, 7])
+def test_device_grammar_bit_parity(params, table, gt, seed):
+    ref = _host_run(params, table, seed)
+    got, escapes = _device_run(params, table, gt, seed)
+    assert got == ref, (seed, got, ref)
+    data = b"".join(PIECES[t] for t in got if t != EOS)
+    assert advance_bytes(INITIAL_STATE, data) is not None
+    if got[-1] == EOS:
+        json.loads(data.decode())    # EOS stop ⇒ complete JSON value
+    # seed 5 wanders into an unbounded string tail on this model build —
+    # the escape/rollback/re-entry path MUST be covered, not just the
+    # stay-in-table happy path
+    if seed == 5:
+        assert escapes >= 1
+
+
+def test_escape_freezes_slot_on_device(params, table, gt):
+    """After an in-chunk escape the device automaton reports -2 and the
+    slot's device length matches the host's post-rollback view — the
+    frozen rows never advanced it."""
+    eng = _engine(params)
+    assert eng.install_grammar(("freeze", id(gt)), gt.mask, gt.trans)
+    opts = SlotOptions(temperature=0.9, seed=5, repeat_penalty=1.0)
+    c = JsonConstraint(table)
+    first = eng.admit(1, np.array([7, 7], np.int32), opts,
+                      mask_row=c.mask_row())
+    assert c.advance(first)
+    eng.set_mask(1, c.mask_row(), gid=gt.state_id(c.state))
+    for _ in range(16):
+        toks = eng.decode_n(CHUNK)
+        gstate = int(np.asarray(eng._fetch(eng._gstate))[1])
+        st = gt.state_id(c.state)
+        for r in range(CHUNK):
+            t = int(toks[r, 1])
+            if t == EOS:
+                return            # finished without escaping: fine
+            nid = int(gt.trans[st, t]) if st >= 0 else -1
+            assert c.advance(t)
+            if nid < 0:
+                assert gstate == -2         # frozen on device
+                over = CHUNK - (r + 1)
+                eng.spec_ack(np.array([0, over], np.int64))
+                # frozen rows never advanced the device length: after the
+                # rollback the host mirror agrees with the device
+                lens = np.asarray(eng._fetch(eng.lengths))
+                assert int(lens[1]) == int(eng._host_lengths[1])
+                return
+            st = nid
+    pytest.skip("seed never escaped on this model build")

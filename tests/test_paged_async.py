@@ -297,35 +297,74 @@ def test_async_fallback_counter_preseeded():
         assert f'tpu_model_async_fallback_total{{cause="{cause}"}}' in text
 
 
-def test_paged_dp_stays_sync_and_counts_fallback(params):
-    """dp-sharded paged pools keep synchronous dispatch; the gate is
-    visible as one cause="paged_dp" increment at scheduler build."""
+def test_paged_dp_double_buffers(params):
+    """cause="paged_dp" retired: a dp-sharded paged pool keeps async
+    dispatch (epochs are global, quarantines per-shard — the fence never
+    crosses the shard boundary) and the counter stays at its pre-seeded
+    zero. Streams match the sync arm bit-for-bit."""
     from ollama_operator_tpu.parallel.mesh import MeshPlan, make_mesh
-    mesh = make_mesh(MeshPlan(dp=2), jax.devices()[:2])
-    eng = Engine(XLA, params, mesh=mesh,
-                 ecfg=dataclasses.replace(PAGED, n_pages=8))
+
+    def arm(async_on):
+        mesh = make_mesh(MeshPlan(dp=2), jax.devices()[:2])
+        eng = Engine(XLA, params, mesh=mesh,
+                     ecfg=dataclasses.replace(PAGED, n_pages=8))
+        sched = Scheduler(eng, async_dispatch=async_on)
+        try:
+            assert sched.async_dispatch is async_on
+            out = list(sched.submit(PROMPT, max_tokens=6,
+                                    opts=GREEDY).tokens())
+            _drain(sched)
+            return out
+        finally:
+            sched.shutdown()
+
     before = METRICS.get("tpu_model_async_fallback_total",
                          '{cause="paged_dp"}')
+    assert arm(True) == arm(False)
+    assert METRICS.get("tpu_model_async_fallback_total",
+                       '{cause="paged_dp"}') == before
+
+
+def test_grammar_device_dispatch_stays_async(params):
+    """cause="grammar" retired for device-table grammars: a constrained
+    slot rides the double-buffered chunked dispatch (mask + automaton
+    advance on device) and the fallback counter never moves."""
+    from ollama_operator_tpu.ops.constrain import (
+        INITIAL_STATE, JsonConstraint, advance_bytes)
+    from test_constrain import EOS, PIECES, make_table
+    table = make_table()
+    eng = Engine(XLA, params, ecfg=dataclasses.replace(
+        PAGED, max_seq_len=128))
     sched = Scheduler(eng, async_dispatch=True)
     try:
-        assert not sched.async_dispatch
+        assert sched.async_dispatch
+        before = METRICS.get("tpu_model_async_fallback_total",
+                             '{cause="grammar"}')
+        req = sched.submit([5, 9, 2],
+                           SlotOptions(temperature=0.9, seed=1,
+                                       repeat_penalty=1.0),
+                           max_tokens=24, eog_ids=frozenset([EOS]),
+                           constraint=JsonConstraint(table))
+        toks = list(req.tokens())
+        assert len(toks) >= 1
+        data = b"".join(PIECES[t] for t in toks)
+        assert advance_bytes(INITIAL_STATE, data) is not None
         assert METRICS.get("tpu_model_async_fallback_total",
-                           '{cause="paged_dp"}') == before + 1
-        out = list(sched.submit(PROMPT, max_tokens=4, opts=GREEDY).tokens())
-        assert len(out) == 4
+                           '{cause="grammar"}') == before
         _drain(sched)
     finally:
         sched.shutdown()
 
 
-def test_grammar_dispatch_counts_fallback(params):
-    """A grammar-constrained slot forces per-dispatch sync fallbacks
-    (host PDA mask between dispatches) — visible on the counter while
-    unconstrained traffic keeps double-buffering."""
+def test_grammar_host_fallback_still_counts(params, monkeypatch):
+    """TPU_GRAMMAR_DEVICE=0 reverts constrained slots to the host-masked
+    sync path — and the retired counter proves it is the knob, not a
+    silent regression, by moving again."""
     from ollama_operator_tpu.ops.constrain import JsonConstraint
     from test_constrain import EOS, make_table
     eng = Engine(XLA, params, ecfg=dataclasses.replace(
         PAGED, max_seq_len=128))
+    monkeypatch.setattr(eng, "_grammar_device", False)
     sched = Scheduler(eng, async_dispatch=True)
     try:
         assert sched.async_dispatch
