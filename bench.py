@@ -2464,6 +2464,360 @@ def _measure_fleet(jax, *, model: str, dtype: str, slots: int, steps: int,
     return rec
 
 
+class _StallProxy:
+    """TCP proxy in front of one in-process replica that can WEDGE (not
+    sever) the replica->gateway direction mid-response. arm(n) applies
+    to the next /api/generate connection only: its response pump
+    forwards n socket reads, then blocks until close() — upstream
+    alive-but-silent, the crash shape that leaves the gateway holding
+    an open journal entry with progress and no close record. A sever
+    would instead trigger the gateway's own in-process failover, which
+    is the fleet arm's story, not this one's."""
+
+    def __init__(self, backend_port: int):
+        import socket
+        import threading
+        self._socket = socket
+        self._threading = threading
+        self.backend_port = backend_port
+        self._armed = 0
+        self.last_body_bytes = 0
+        self._stall = threading.Event()
+        self._lock = threading.Lock()
+        self._conns: list = []
+        self._srv = socket.create_server(("127.0.0.1", 0))
+        self.port = self._srv.getsockname()[1]
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    def arm(self, body_bytes: int) -> None:
+        """Wedge the NEXT generate stream after forwarding this many
+        response-BODY bytes (counted past the header terminator, so TCP
+        segmentation cannot move the cut)."""
+        with self._lock:
+            self._armed = body_bytes
+
+    def _accept(self):
+        while True:
+            try:
+                c, _ = self._srv.accept()
+            except OSError:
+                return
+            b = None
+            try:
+                b = self._socket.create_connection(
+                    ("127.0.0.1", self.backend_port))
+                # the request line decides whether the armed stall
+                # applies: scrapes and probes must always flow free
+                first = c.recv(65536)
+                if not first:
+                    raise OSError("empty request")
+                b.sendall(first)
+            except OSError:
+                c.close()
+                if b is not None:
+                    b.close()
+                continue
+            budget = 0
+            is_gen = first.startswith(b"POST /api/generate")
+            if is_gen:
+                with self._lock:
+                    budget, self._armed = self._armed, 0
+            with self._lock:
+                self._conns.extend((c, b))
+            self._threading.Thread(target=self._pump, args=(c, b, 0, False),
+                                   daemon=True).start()
+            self._threading.Thread(target=self._pump,
+                                   args=(b, c, budget, is_gen),
+                                   daemon=True).start()
+
+    def _pump(self, src, dst, budget, track):
+        body = -1            # response-body bytes seen; -1 = in headers
+        hdr = b""
+        try:
+            while True:
+                d = src.recv(65536)
+                if not d:
+                    break
+                if track or budget:
+                    if body < 0:
+                        hdr += d
+                        cut = hdr.find(b"\r\n\r\n")
+                        if cut >= 0:
+                            body = len(hdr) - cut - 4
+                    else:
+                        body += len(d)
+                if budget and body > budget:
+                    # forward only up to the cut, then wedge: the
+                    # gateway has whole frames up to here and a silent,
+                    # still-open upstream after it
+                    keep = len(d) - (body - budget)
+                    if keep > 0:
+                        dst.sendall(d[:keep])
+                    self._stall.wait()
+                    break
+                dst.sendall(d)
+        except OSError:
+            pass
+        if track and body > 0:
+            # the uninterrupted reference stream's wire size — the arm
+            # calibrates its mid-stream cut from this
+            self.last_body_bytes = body
+        for s in (src, dst):
+            try:
+                s.shutdown(self._socket.SHUT_RDWR)
+            except OSError:
+                pass
+
+    def close(self):
+        self._stall.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns, self._conns = self._conns, []
+        for s in conns:
+            try:
+                s.shutdown(self._socket.SHUT_RDWR)
+            except OSError:
+                pass
+            s.close()
+
+
+def measure_gateway_restart(jax, *, model: str, dtype: str, slots: int,
+                            steps: int, seq: int, prompt_len: int,
+                            paged: bool, mixed: bool, chunk: int,
+                            page_size: int, n_pages: int | None,
+                            platform: str, params_cache: dict | None = None,
+                            env: dict | None = None) -> dict:
+    """Gateway crash-recovery arm (ISSUE 17): one REAL replica behind a
+    persisting gateway. The upstream wedges mid-stream (stall, not
+    sever), the gateway process is abandoned with the journal entry
+    open — handler thread still blocked on the silent upstream — and a
+    REPLACEMENT gateway boots from the same append-log. The client
+    reconnects with its request_id and must receive exactly the
+    remaining bytes: zero error frames, prefix + splice byte-identical
+    to an uninterrupted greedy run. BENCH_ASSERT_GATEWAY_RESTART=1
+    hard-fails the capture on any violation."""
+    import gc
+    import json as _json
+    import tempfile
+    import urllib.request
+
+    from ollama_operator_tpu.models.config import get_config
+    from ollama_operator_tpu.operator.gateway import Gateway
+    from ollama_operator_tpu.runtime.engine import (EngineConfig,
+                                                    resolve_cache_dtype)
+    from ollama_operator_tpu.runtime.service import LoadedModel
+    from ollama_operator_tpu.server.app import ModelManager, serve
+    from ollama_operator_tpu.server.metrics import GLOBAL as METRICS
+    from ollama_operator_tpu.server.names import ModelName
+
+    on_cpu = platform == "cpu"
+    if on_cpu:
+        dtype = "float32"
+    kv_dtype = resolve_cache_dtype(
+        os.environ.get("BENCH_KV_DTYPE", "float32" if on_cpu else "int8"))
+    cfg = get_config(model)
+    params, _, dtype = _bench_params(
+        jax, cfg, model, dtype, on_cpu, params_cache)
+    tok = _bench_tokenizer(cfg.vocab_size)
+    name = ModelName.parse("bench").short
+
+    serve_seq = min(seq, cfg.max_seq_len)
+    ps = max(8, min(page_size, serve_seq // 8))
+    # small decode chunks: many frames per response, so the stall lands
+    # mid-stream with real progress journaled on both sides of it
+    chunk_eff = max(2, min(chunk, serve_seq // 32))
+    gen_tokens = max(24, min(48, serve_seq // 4))
+    pool = n_pages or slots * (-(-serve_seq // ps) + 2) + 8
+    log(f"bench: gateway-restart capture model={model} "
+        f"tokens={gen_tokens} chunk={chunk_eff}")
+
+    lm = LoadedModel(
+        name, cfg, params, tok,
+        ecfg=EngineConfig(max_slots=slots, max_seq_len=serve_seq,
+                          decode_chunk=chunk_eff, cache_dtype=kv_dtype,
+                          paged=True, page_size=ps, n_pages=pool,
+                          min_prefill_bucket=16))
+    tmp = tempfile.mkdtemp(prefix="bench-gwrestart-")
+    manager = ModelManager(tmp, serve_models=True, default_keep_alive=-1)
+    manager.loaded = lm
+    httpd = serve(manager, "127.0.0.1", 0)
+    proxy = _StallProxy(httpd.server_address[1])
+
+    persist_path = os.path.join(tmp, "gateway-journal.ndjson")
+    genv = {
+        "TPU_GATEWAY_PERSIST": persist_path,
+        "TPU_GATEWAY_PERSIST_FLUSH_MS": "5",
+        "TPU_GATEWAY_EJECT_FAILURES": "3",
+        "TPU_GATEWAY_EJECT_S": "60",
+        "TPU_GATEWAY_SLOW_SCRAPE_MS": "30000",   # loaded CPU != slow
+    }
+    saved = {k: os.environ.get(k) for k in genv}
+    os.environ.update(genv)
+
+    def boot():
+        gw = Gateway(replicas=[("r0", f"http://127.0.0.1:{proxy.port}")],
+                     port=0, scrape_period_s=0.2)
+        gw.start()
+        return gw
+
+    def stream(base, body, timeout_s=600.0):
+        """One NDJSON stream -> (text, error_frames, stalled, resp). A
+        read timeout marks the wedge: by then every frame the gateway
+        emitted has been drained off the socket, so the captured text
+        is exactly the client-visible prefix."""
+        req = urllib.request.Request(
+            base + "/api/generate", data=_json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        text, errors, stalled, resp = [], [], False, None
+        try:
+            resp = urllib.request.urlopen(req, timeout=timeout_s)
+            for line in resp:
+                if not line.strip():
+                    continue
+                frame = _json.loads(line)
+                if "error" in frame:
+                    errors.append(frame)
+                elif not frame.get("done"):
+                    text.append(frame.get("response") or "")
+        except TimeoutError:
+            stalled = True
+        except OSError as e:
+            if "timed out" in str(e):
+                stalled = True
+            else:
+                raise
+        return "".join(text), errors, stalled, resp
+
+    w0 = METRICS.get("tpu_model_gateway_persist_writes_total")
+    t_wall = time.perf_counter()
+    prompt = "gateway-restart-" + "q" * max(8, prompt_len // 4)
+    opts = {"num_predict": gen_tokens, "temperature": 0.0}
+
+    try:
+        gw1 = boot()
+        # reference: the same greedy request, uninterrupted (no
+        # request_id, so it cannot collide with the resume)
+        ref_text, ref_errors, _, _ = stream(
+            gw1.base_url, {"model": "bench", "prompt": prompt,
+                           "stream": True, "options": opts})
+        # the reference also calibrates the cut: the proxy saw its full
+        # wire size, and 30% of it is safely past the first frame and
+        # well short of the last (the pump records it at upstream EOF,
+        # a beat after the client finishes reading)
+        deadline = time.monotonic() + 5.0
+        while not proxy.last_body_bytes and time.monotonic() < deadline:
+            time.sleep(0.01)
+        if not proxy.last_body_bytes:
+            raise AssertionError("reference stream size never recorded")
+        proxy.arm(max(120, int(proxy.last_body_bytes * 0.3)))
+        body = {"model": "bench", "prompt": prompt, "stream": True,
+                "request_id": "bench-gw-restart-1", "options": opts}
+        prefix_text, prefix_errors, stalled, dangling = stream(
+            gw1.base_url, body, timeout_s=5.0)
+
+        r0 = METRICS.get("tpu_model_gateway_persist_restores_total")
+        f0 = METRICS.get("tpu_model_gateway_failovers_total",
+                         '{result="replayed"}')
+        # the crash: stop() flushes the append-log and kills the scrape
+        # loop but leaves the wedged handler thread blocked on its
+        # silent upstream — the journal entry stays open, no close
+        # record is ever written for it
+        gw1.stop()
+        t_boot = time.perf_counter()
+        gw2 = boot()
+        restore_ms = (time.perf_counter() - t_boot) * 1000.0
+        restored = int(METRICS.get(
+            "tpu_model_gateway_persist_restores_total") - r0)
+        t_res = time.perf_counter()
+        resume_text, resume_errors, resume_stalled, _ = stream(
+            gw2.base_url, body)
+        resume_ms = (time.perf_counter() - t_res) * 1000.0
+        replayed = int(METRICS.get("tpu_model_gateway_failovers_total",
+                                   '{result="replayed"}') - f0)
+        journal = gw2.journal_stats()
+        writes = int(METRICS.get(
+            "tpu_model_gateway_persist_writes_total") - w0)
+        gw2.stop()
+        del dangling
+    finally:
+        for k, old in saved.items():
+            if old is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = old
+        proxy.close()
+        httpd.shutdown()
+        manager.loaded = None
+        lm.unload()
+
+    spliced = prefix_text + resume_text
+    bit_identical = bool(ref_text) and spliced == ref_text
+    stalled_mid_stream = bool(
+        stalled and prefix_text and len(prefix_text) < len(ref_text))
+    wall = time.perf_counter() - t_wall
+
+    rec = {
+        "model": model,
+        "mode": "gateway_restart",
+        "ref_chars": len(ref_text),
+        "prefix_chars": len(prefix_text),
+        "resume_chars": len(resume_text),
+        "stalled_mid_stream": stalled_mid_stream,
+        "bit_identical": bit_identical,
+        "client_error_frames": (len(ref_errors) + len(prefix_errors)
+                                + len(resume_errors)),
+        "resume_stalled": bool(resume_stalled),
+        "persist_writes": writes,
+        "restored_streams": restored,
+        "failovers_replayed": replayed,
+        "journal_live": journal["live"],
+        "restore_ms": round(restore_ms, 1),
+        "resume_ms": round(resume_ms, 1),
+        "gen_tokens": int(gen_tokens),
+        "slots": slots,
+        "dtype": dtype,
+        "paged": True,
+        "seq": int(serve_seq),
+        "wall_s": round(wall, 2),
+    }
+    if env:
+        rec["env"] = dict(env)
+    log(f"bench: gateway-restart capture done: {json.dumps(rec)}")
+    if os.environ.get("BENCH_ASSERT_GATEWAY_RESTART") == "1":
+        problems = []
+        if not stalled_mid_stream:
+            problems.append(
+                f"stall never landed mid-stream (prefix "
+                f"{len(prefix_text)} of {len(ref_text)} chars)")
+        if not bit_identical:
+            problems.append(
+                f"prefix+resume is not byte-identical to the reference "
+                f"(ref={len(ref_text)} spliced={len(spliced)} chars)")
+        if rec["client_error_frames"]:
+            problems.append(f"{rec['client_error_frames']} client-visible "
+                            f"error frames (want 0)")
+        if resume_stalled:
+            problems.append("the resumed stream itself stalled")
+        if restored < 1:
+            problems.append("replacement gateway restored no streams "
+                            "from the persist log")
+        if replayed < 1:
+            problems.append("reconnect never took the replayed-resume "
+                            "path")
+        if journal["live"]:
+            problems.append(f"journal not drained: {journal['live']} "
+                            f"live entries")
+        if problems:
+            raise AssertionError("gateway-restart arm failed: "
+                                 + "; ".join(problems))
+    del params
+    gc.collect()
+    return rec
+
+
 def main() -> None:
     import jax
 
@@ -2553,6 +2907,8 @@ def main() -> None:
                                                   "") == "1",
                      fleet_arm=os.environ.get("BENCH_FLEET_ARM",
                                               "") == "1",
+                     gateway_restart_arm=os.environ.get(
+                         "BENCH_GATEWAY_RESTART_ARM", "") == "1",
                      **knobs)]
     elif platform == "cpu":
         # unpinned CPU smoke: tiny model, but every knob still applies
@@ -2617,6 +2973,14 @@ def main() -> None:
             # mid-stream must fail over with zero client error frames,
             # byte-identical. BENCH_ASSERT_FLEET=1 gates on it
             plan.append({**smoke, "fleet_arm": True, "slots": 2})
+        if os.environ.get("BENCH_GATEWAY_RESTART_ARM", "") == "1":
+            # gateway crash recovery (ISSUE 17): a gateway abandoned
+            # mid-stream with its journal persisted, the replacement
+            # restores from the append-log, and the reconnecting client
+            # gets a byte-identical zero-error splice.
+            # BENCH_ASSERT_GATEWAY_RESTART=1 gates on it
+            plan.append({**smoke, "gateway_restart_arm": True,
+                         "slots": 2})
         if os.environ.get("BENCH_SPEC_ARM", "") == "1":
             # fused prompt-lookup speculation (ISSUE 6): lookup /
             # accept_all / reject_all sub-arms on a repetition-heavy
@@ -2766,8 +3130,10 @@ def main() -> None:
         restart_arm = cap.pop("restart_arm", False)
         coldstart_arm = cap.pop("coldstart_arm", False)
         fleet_arm = cap.pop("fleet_arm", False)
+        gateway_restart_arm = cap.pop("gateway_restart_arm", False)
         try:
-            fn = (measure_fleet if fleet_arm
+            fn = (measure_gateway_restart if gateway_restart_arm
+                  else measure_fleet if fleet_arm
                   else measure_coldstart if coldstart_arm
                   else measure_restart if restart_arm
                   else measure_overload if overload_arm
@@ -2918,6 +3284,20 @@ def assemble(captures: list, platform: str, n_devices: int) -> str:
             fleet_errors = c.get("client_error_frames")
             fleet_replayed = (c.get("failovers") or {}).get("replayed")
             break
+    # gateway crash recovery (ISSUE 17 acceptance: a gateway killed
+    # mid-stream leaves a persisted journal; the replacement restores it
+    # and the reconnecting client's spliced stream is byte-identical
+    # with zero error frames)
+    gwr_bit_identical = gwr_errors = gwr_restored = None
+    gwr_restore_ms = gwr_resume_ms = None
+    for c in captures:
+        if c.get("mode") == "gateway_restart":
+            gwr_bit_identical = c.get("bit_identical")
+            gwr_errors = c.get("client_error_frames")
+            gwr_restored = c.get("restored_streams")
+            gwr_restore_ms = c.get("restore_ms")
+            gwr_resume_ms = c.get("resume_ms")
+            break
     # fused paged-attention A/B (ISSUE 16): pair the TPU_PAGED_FUSED=0
     # reference with the fused capture of the same config — the ratio is
     # tokens-per-HBM-byte (tok_s x bytes/step, the steps cancel), i.e.
@@ -3003,6 +3383,11 @@ def assemble(captures: list, platform: str, n_devices: int) -> str:
         "fleet_kill_bit_identical": fleet_bit_identical,
         "fleet_client_error_frames": fleet_errors,
         "fleet_failovers_replayed": fleet_replayed,
+        "gateway_restart_bit_identical": gwr_bit_identical,
+        "gateway_restart_client_error_frames": gwr_errors,
+        "gateway_restart_restored_streams": gwr_restored,
+        "gateway_restart_restore_ms": gwr_restore_ms,
+        "gateway_restart_resume_ms": gwr_resume_ms,
         "paged_bw_ratio": paged_bw_ratio,
         "paged_fused_recompiles": paged_fused_recompiles,
         "kv_int4_tok_s_ratio": kv_int4_tok_s_ratio,

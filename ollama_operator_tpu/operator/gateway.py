@@ -61,6 +61,7 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import signal
 import socket
 import threading
 import time
@@ -172,6 +173,122 @@ def static_replicas(urls: List[str]) -> List[Tuple[str, str]]:
     return [(f"replica-{i}", u) for i, u in enumerate(urls)]
 
 
+class _FrozenHash:
+    """Stand-in for a journal entry's rolling sha256 restored from the
+    persist log: the live hash object died with the previous gateway
+    process, but _pump only needs ``hexdigest()`` at the skip boundary —
+    where it swaps in the freshly verified hash and the entry is live
+    again. ``update`` before that swap would silently corrupt the
+    bit-identity check, so it is a hard error."""
+
+    def __init__(self, hexdigest: str):
+        self._hex = hexdigest
+
+    def hexdigest(self) -> str:
+        return self._hex
+
+    def update(self, _data) -> None:
+        raise RuntimeError("restored journal hash is frozen until the "
+                           "replayed prefix has been verified")
+
+
+class _PersistLog:
+    """Bounded append-log for the gateway's crash-recovery snapshot
+    (request journal + affinity table), living on the weight-cache
+    volume so it survives gateway pod churn (``TPU_GATEWAY_PERSIST``).
+
+    Records are NDJSON, buffered and fsynced at most once per flush
+    window (``TPU_GATEWAY_PERSIST_FLUSH_MS``) — the journal is advisory
+    recovery state, not a database: losing the final window in a crash
+    only downgrades a resume to the classic exactly-once error frame.
+    The log is bounded by compaction: once enough appends accumulate it
+    is atomically rewritten as a snapshot of the current state."""
+
+    def __init__(self, path: str, flush_window_s: float):
+        self.path = path
+        self.flush_window_s = flush_window_s
+        self._lock = threading.Lock()
+        self._buf: List[str] = []
+        self._last_sync = 0.0
+        self._since_compact = 0
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._f = open(path, "a", encoding="utf-8")
+
+    def read_all(self) -> List[Dict[str, Any]]:
+        """Replay the log left by the previous process (called once,
+        before any append). A torn tail line — the write the crash
+        interrupted — ends the replay; everything before it parsed."""
+        out: List[Dict[str, Any]] = []
+        try:
+            with open(self.path, "r", encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        out.append(json.loads(line))
+                    except ValueError:
+                        break
+        except OSError:
+            return []
+        return out
+
+    def append(self, rec: Dict[str, Any]) -> None:
+        with self._lock:
+            self._buf.append(json.dumps(rec, separators=(",", ":")))
+            self._since_compact += 1
+            now = time.monotonic()
+            if now - self._last_sync >= self.flush_window_s:
+                self._flush_locked(now)
+        METRICS.inc("tpu_model_gateway_persist_writes_total")
+
+    def flush(self) -> None:
+        with self._lock:
+            self._flush_locked(time.monotonic())
+
+    def _flush_locked(self, now: float) -> None:
+        if self._buf:
+            self._f.write("\n".join(self._buf) + "\n")
+            self._buf.clear()
+        try:
+            self._f.flush()
+            os.fsync(self._f.fileno())
+        except (OSError, ValueError) as e:
+            FLIGHT.record("gateway_persist_error", error=repr(e))
+        self._last_sync = now
+
+    def maybe_compact(self, snapshot: Callable[[], List[Dict[str, Any]]],
+                      threshold: int = 16384) -> None:
+        """Atomically rewrite the log as the current state snapshot once
+        ``threshold`` appends have accumulated — this is what keeps the
+        append-log bounded. ``snapshot`` may take the gateway lock;
+        appenders never hold it while appending, so the persist→gateway
+        lock order here is acyclic."""
+        with self._lock:
+            if self._since_compact < threshold:
+                return
+            records = snapshot()
+            tmp = self.path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                for rec in records:
+                    f.write(json.dumps(rec, separators=(",", ":")) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+            self._f.close()
+            os.replace(tmp, self.path)
+            self._f = open(self.path, "a", encoding="utf-8")
+            # buffered records describe state the snapshot already holds
+            self._buf.clear()
+            self._since_compact = 0
+
+    def close(self) -> None:
+        with self._lock:
+            self._flush_locked(time.monotonic())
+            self._f.close()
+
+
 class Gateway:
     """One Model's fleet front: routing, circuits, journal, failover."""
 
@@ -193,6 +310,18 @@ class Gateway:
         self.hedge_ms = float(e.get("TPU_GATEWAY_HEDGE_MS", "0"))
         self.journal_keep = max(1, int(e.get("TPU_GATEWAY_JOURNAL", "512")))
         self.replay_tokens = int(e.get("TPU_RESTART_REPLAY_TOKENS", "65536"))
+        # crash-recovery persistence: "" disables, "1" puts the log on
+        # the weight-cache volume, anything else is an explicit path
+        raw_persist = e.get("TPU_GATEWAY_PERSIST", "")
+        if raw_persist in ("", "0"):
+            self.persist_path = ""
+        elif raw_persist == "1":
+            self.persist_path = os.path.join(
+                e.get("TPU_WEIGHT_CACHE") or ".", "gateway-journal.ndjson")
+        else:
+            self.persist_path = raw_persist
+        self.persist_flush_s = max(
+            0.0, float(e.get("TPU_GATEWAY_PERSIST_FLUSH_MS", "50")) / 1000.0)
         self.host = host
         self.port = (int(e.get("TPU_GATEWAY_PORT", "11434"))
                      if port is None else port)
@@ -216,6 +345,17 @@ class Gateway:
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._scrape_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # streams journaled by the PREVIOUS gateway process, keyed by the
+        # client-supplied request_id, waiting for their client to
+        # reconnect (resume-or-error per the replay eligibility rules)
+        self._restored: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+        self.draining = False
+        self._drain_deadline = 0.0
+        self._persist: Optional[_PersistLog] = None
+        if self.persist_path:
+            self._persist = _PersistLog(self.persist_path,
+                                        self.persist_flush_s)
+            self._restore_from_log()
         _LIVE.add(self)
 
     # -- lifecycle -------------------------------------------------------
@@ -244,6 +384,40 @@ class Gateway:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
+        if self._persist is not None:
+            self._persist.flush()
+
+    def begin_drain(self, timeout_s: Optional[float] = None) -> None:
+        """The gateway's SIGTERM contract, mirroring the PR 9 server
+        drain: stop accepting new generation work (503 + Retry-After;
+        /readyz says draining so the Service parks us), let in-flight
+        proxied streams finish within the drain window
+        (``TPU_DRAIN_TIMEOUT_S``), flush the persist log, return.
+        Streams still live at the deadline stay journaled in the persist
+        log — the next gateway process offers them resume-or-error."""
+        with self._lock:
+            if self.draining:
+                return
+            self.draining = True
+            live = len(self._live)
+        timeout = (float(os.environ.get("TPU_DRAIN_TIMEOUT_S", "30"))
+                   if timeout_s is None else timeout_s)
+        self._drain_deadline = time.monotonic() + timeout
+        METRICS.inc("tpu_model_gateway_drain_total")
+        FLIGHT.record("gateway_drain", live=live, timeout_s=timeout)
+        while time.monotonic() < self._drain_deadline:
+            with self._lock:
+                if not self._live:
+                    break
+            time.sleep(0.05)
+        if self._persist is not None:
+            self._persist.flush()
+
+    def _drain_retry_s(self) -> int:
+        """Retry-After for work shed during drain: past the drain window
+        a replacement gateway should be answering."""
+        remain = self._drain_deadline - time.monotonic()
+        return int(max(1, min(30, remain + 1))) if remain > 0 else 1
 
     @property
     def base_url(self) -> str:
@@ -254,6 +428,8 @@ class Gateway:
             try:
                 self.refresh_replicas()
                 self.scrape_once()
+                if self._persist is not None:
+                    self._persist.maybe_compact(self._snapshot_records)
             except Exception as e:  # noqa: BLE001 — loop must survive
                 FLIGHT.record("gateway_scrape_error", error=repr(e))
 
@@ -440,6 +616,19 @@ class Gateway:
             qtotal = sum(r.load for r in self._replicas.values())
         return int(max(1, min(30, 1 + qtotal)))
 
+    def _remediation_retry_s_locked(self) -> int:
+        """Retry-After while the whole candidate set is mid-remediation
+        (ejected/draining): the shortest remaining ejection timer is the
+        soonest capacity can reappear, so that is the computed hint (the
+        PR 8 shed contract — finite and honest, never a flat guess).
+        Falls back to the full eject window when nothing is on an
+        ejection clock (e.g. every replica is draining)."""
+        now = time.monotonic()
+        remaining = [r.ejected_until - now for r in self._replicas.values()
+                     if r.state == "ejected"]
+        soonest = min(remaining) if remaining else self.eject_s
+        return int(max(1, min(30, soonest + 1)))
+
     def pick(self, route_key: str, probe_body: Optional[Dict] = None,
              exclude: frozenset = frozenset()) -> Tuple[str, str]:
         """The routing law. Returns (replica name, path) and records the
@@ -450,7 +639,7 @@ class Gateway:
         with self._lock:
             cands = self._routable_locked(exclude)
             if not cands:
-                raise NoReplicas(int(max(1, min(30, self.eject_s))))
+                raise NoReplicas(self._remediation_retry_s_locked())
             names = {r.name for r in cands}
             chosen, path = None, ""
             for hx in reversed(hashes):
@@ -477,7 +666,7 @@ class Gateway:
         with self._lock:
             cands = self._routable_locked(exclude)
             if not cands:
-                raise NoReplicas(int(max(1, min(30, self.eject_s))))
+                raise NoReplicas(self._remediation_retry_s_locked())
             live = {r.name: r for r in cands}
             if chosen is None or chosen not in live:
                 chosen = min(live.values(),
@@ -492,6 +681,8 @@ class Gateway:
                 self._affinity.move_to_end(hx)
             while len(self._affinity) > self._affinity_keep:
                 self._affinity.popitem(last=False)
+        if self._persist is not None and hashes:
+            self._persist.append({"t": "aff", "r": chosen, "h": hashes})
         METRICS.inc("tpu_model_gateway_routes_total", 1.0,
                     f'{{path="{path}"}}')
         return chosen, path
@@ -528,6 +719,8 @@ class Gateway:
             self._rid += 1
             entry = {
                 "id": self._rid,
+                "request_id": (str(body["request_id"])
+                               if body.get("request_id") else None),
                 "model": body.get("model"),
                 "prompt_sha": hashlib.sha256(
                     route_key.encode("utf-8", "surrogatepass")).hexdigest(),
@@ -544,7 +737,9 @@ class Gateway:
                 "outcome": None,
             }
             self._live[entry["id"]] = entry
-            return entry
+        if self._persist is not None:
+            self._persist.append(self._entry_rec(entry))
+        return entry
 
     def journal_close(self, entry: Dict[str, Any], outcome: str) -> None:
         entry["outcome"] = outcome
@@ -554,6 +749,134 @@ class Gateway:
             self._done[entry["id"]] = kept
             while len(self._done) > self.journal_keep:
                 self._done.popitem(last=False)
+        if self._persist is not None:
+            self._persist.append({"t": "close", "id": entry["id"],
+                                  "outcome": outcome})
+
+    # -- crash-recovery persistence (TPU_GATEWAY_PERSIST) ----------------
+
+    @staticmethod
+    def _entry_rec(entry: Dict[str, Any]) -> Dict[str, Any]:
+        """The journal snapshot the next process needs to resume-or-error
+        this stream: identity + the resolved eligibility inputs. The raw
+        prompt is deliberately NOT persisted — the reconnecting client
+        re-sends it, and prompt_sha proves it is the same one."""
+        return {"t": "open", "id": entry["id"],
+                "request_id": entry.get("request_id"),
+                "model": entry.get("model"),
+                "prompt_sha": entry["prompt_sha"],
+                "class": entry.get("class"), "tenant": entry.get("tenant"),
+                "seed": entry.get("seed"),
+                "temperature": entry.get("temperature"),
+                "replayable": entry["replayable"]}
+
+    def _persist_progress(self, entry: Dict[str, Any]) -> None:
+        if self._persist is None:
+            return
+        self._persist.append({"t": "prog", "id": entry["id"],
+                              "frames": entry["frames"],
+                              "chars": entry["chars"],
+                              "hash": entry["hash"].hexdigest()})
+
+    def _snapshot_records(self) -> List[Dict[str, Any]]:
+        """Current affinity + live journal + unclaimed restores as
+        persist records: the compaction image — everything a restart
+        needs, nothing more."""
+        with self._lock:
+            by_rep: Dict[str, List[str]] = {}
+            for hx, name in self._affinity.items():
+                by_rep.setdefault(name, []).append(hx)
+            recs: List[Dict[str, Any]] = [
+                {"t": "aff", "r": n, "h": hs}
+                for n, hs in sorted(by_rep.items())]
+            for entry in self._live.values():
+                recs.append(self._entry_rec(entry))
+                if entry["chars"]:
+                    recs.append({"t": "prog", "id": entry["id"],
+                                 "frames": entry["frames"],
+                                 "chars": entry["chars"],
+                                 "hash": entry["hash"].hexdigest()})
+            for rec in self._restored.values():
+                recs.append(dict(rec, t="open"))
+                if rec.get("chars"):
+                    recs.append({"t": "prog", "id": rec["id"],
+                                 "frames": rec["frames"],
+                                 "chars": rec["chars"],
+                                 "hash": rec["hash"]})
+            return recs
+
+    def _restore_from_log(self) -> None:
+        """Replay the append-log left by the previous gateway process:
+        affinity records feed the routing table directly; journal entries
+        that never closed become resume candidates keyed by the client's
+        request_id. Replica HEALTH is deliberately not persisted —
+        start() rebuilds it from scratch by scraping the live fleet."""
+        open_recs: "OrderedDict[int, Dict[str, Any]]" = OrderedDict()
+        max_id = 0
+        for rec in self._persist.read_all():
+            t = rec.get("t")
+            if t == "aff":
+                for hx in rec.get("h") or []:
+                    self._affinity[hx] = rec.get("r")
+                    self._affinity.move_to_end(hx)
+            elif t == "open" and "id" in rec:
+                open_recs[rec["id"]] = dict(rec, frames=0, chars=0, hash="")
+                max_id = max(max_id, int(rec["id"]))
+            elif t == "prog":
+                e = open_recs.get(rec.get("id"))
+                if e is not None:
+                    e.update(frames=rec.get("frames", 0),
+                             chars=rec.get("chars", 0),
+                             hash=rec.get("hash", ""))
+            elif t == "close":
+                open_recs.pop(rec.get("id"), None)
+        while len(self._affinity) > self._affinity_keep:
+            self._affinity.popitem(last=False)
+        self._rid = max_id
+        for rec in open_recs.values():
+            rid = rec.get("request_id")
+            if not rid:
+                continue  # anonymous stream: no way to reconnect to it
+            self._restored[str(rid)] = rec
+            METRICS.inc("tpu_model_gateway_persist_restores_total")
+        while len(self._restored) > self.journal_keep:
+            self._restored.popitem(last=False)
+        if open_recs or max_id:
+            FLIGHT.record("gateway_persist_restore",
+                          streams=len(self._restored), last_id=max_id)
+
+    def _maybe_adopt_restored(self, entry: Dict[str, Any]) -> str:
+        """If the request_id names a stream the previous gateway process
+        journaled mid-flight, adopt its offsets so _pump splices the
+        remainder byte-identically onto this (re)connection. Returns
+        "resume", "error" (restored but not replay-eligible: the
+        exactly-once error frame is owed), or "" (no match)."""
+        rid = entry.get("request_id")
+        if not rid:
+            return ""
+        with self._lock:
+            rec = self._restored.pop(rid, None)
+        if rec is None:
+            return ""
+        if rec.get("prompt_sha") != entry["prompt_sha"]:
+            FLIGHT.record("gateway_resume_mismatch", request_id=rid)
+            return ""  # same id, different prompt: treat as new work
+        entry["frames"] = int(rec.get("frames") or 0)
+        entry["chars"] = int(rec.get("chars") or 0)
+        if entry["chars"] == 0:
+            # journaled but nothing emitted yet: a plain re-dispatch,
+            # eligibility irrelevant (the queued-but-unstarted rule)
+            FLIGHT.record("gateway_resume", request_id=rid, chars=0)
+            return "resume"
+        entry["hash"] = _FrozenHash(rec.get("hash") or "")
+        if not self._failover_eligible(entry):
+            return "error"
+        METRICS.inc("tpu_model_gateway_failovers_total", 1.0,
+                    '{result="replayed"}')
+        entry["failovers"] += 1
+        FLIGHT.record("gateway_resume", request_id=rid,
+                      chars=entry["chars"], frames=entry["frames"])
+        return "resume"
 
     # -- the proxied generation (failover core) --------------------------
 
@@ -613,8 +936,17 @@ class Gateway:
         failures either fail over invisibly or end with the exactly-once
         error frame — never an exception to the handler."""
         entry = self.journal_open(body, route_key)
+        if self._maybe_adopt_restored(entry) == "error":
+            # interrupted by the previous gateway's death and not
+            # replay-eligible: the contract owes exactly one error frame
+            on_commit()
+            self._stream_error(entry, emit,
+                               "stream interrupted by gateway restart "
+                               "and is not replayable")
+            return entry
         upstream_body = dict(body)
         upstream_body["stream"] = True
+        upstream_body.pop("request_id", None)  # gateway-level key only
         payload = json.dumps(upstream_body).encode()
         probe_body = {k: body[k] for k in
                       ("model", "prompt", "system", "template", "raw",
@@ -776,8 +1108,14 @@ class Gateway:
             if acc + len(piece) <= skip:
                 verify.update(piece.encode("utf-8", "surrogatepass"))
                 acc += len(piece)
-                if acc == skip and verify.hexdigest() != prefix_hex:
-                    raise _ReplayMismatch("replayed prefix hash mismatch")
+                if acc == skip:
+                    if verify.hexdigest() != prefix_hex:
+                        raise _ReplayMismatch("replayed prefix hash "
+                                              "mismatch")
+                    # verify holds the identical byte stream — swapping
+                    # it in re-arms an entry whose hash was a frozen
+                    # hexdigest restored from the persist log
+                    entry["hash"] = verify
                 continue
             if acc < skip:
                 head, piece = piece[:skip - acc], piece[skip - acc:]
@@ -785,6 +1123,7 @@ class Gateway:
                 acc = skip
                 if verify.hexdigest() != prefix_hex:
                     raise _ReplayMismatch("replayed prefix hash mismatch")
+                entry["hash"] = verify
                 frame = reframe(frame, piece)
                 line = json.dumps(frame).encode()
             acc += len(piece)
@@ -796,6 +1135,7 @@ class Gateway:
             entry["frames"] += 1
             entry["chars"] += len(piece)
             entry["hash"].update(piece.encode("utf-8", "surrogatepass"))
+            self._persist_progress(entry)
         if not saw_final:
             raise _UpstreamDead("upstream closed before the final frame")
 
@@ -915,6 +1255,11 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json({"status": "ok"})
             return
         if path == "/readyz":
+            if gw.draining:
+                # same drain signature the gateway itself looks for in
+                # replica readyz bodies: intent, not illness
+                self._send_json({"status": "draining"}, 503)
+                return
             counts = gw.state_counts()
             routable = sum(counts.get(s, 0) for s in ROUTABLE)
             if routable > 0:
@@ -952,6 +1297,13 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self):
         path = self.path.split("?")[0]
+        if self.gateway.draining and path in ("/api/generate", "/api/chat"):
+            # begin_drain: finish in-flight streams, shed new work with
+            # a finite hint pointing past the drain window
+            self._send_json(
+                {"error": "gateway draining"}, 503,
+                headers={"Retry-After": str(self.gateway._drain_retry_s())})
+            return
         try:
             if path == "/api/generate":
                 self._proxy_generation(
@@ -1110,11 +1462,23 @@ def main() -> None:
     gw.start()
     FLIGHT.record("gateway_started", port=gw.port,
                   replicas=len(gw._replicas))
+    stop = threading.Event()
+
+    def _on_term(signum, _frame):
+        FLIGHT.record("gateway_sigterm", signal=int(signum))
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _on_term)
     try:
-        while True:
-            time.sleep(60)
+        while not stop.wait(1.0):
+            pass
     except KeyboardInterrupt:
-        gw.stop()
+        pass
+    # SIGTERM / Ctrl-C: stop accepting, finish proxied streams within
+    # the drain window, persist the journal, exit (the PR 9 contract,
+    # gateway edition — preStop in pod.py covers the Service lag)
+    gw.begin_drain()
+    gw.stop()
 
 
 if __name__ == "__main__":

@@ -170,7 +170,14 @@ def new_gateway_container(
     router + circuit breaker + stream-failover front for a replicated
     Model. Runs the same runtime image (the gateway is stdlib-only, the
     image has it), discovers replicas via the pod label selector, and
-    needs no TPU — it schedules anywhere."""
+    needs no TPU — it schedules anywhere.
+
+    Crash recovery: the request journal + affinity table persist to an
+    append-log on the shared weight-cache volume (TPU_GATEWAY_PERSIST),
+    so a replacement gateway pod restores in-flight replayable streams
+    for reconnecting clients. SIGTERM triggers the gateway's own
+    begin_drain (mirroring the server drain contract); the preStop sleep
+    covers Service endpoint deprogramming exactly as for server pods."""
     return {
         "name": "gateway",
         "image": image,
@@ -178,14 +185,33 @@ def new_gateway_container(
         "env": [
             {"name": "TPU_GATEWAY_SELECTOR", "value": f"{namespace}/{app}"},
             {"name": "TPU_GATEWAY_PORT", "value": str(PORT)},
+            {"name": "TPU_WEIGHT_CACHE",
+             "value": f"{STORE_MOUNT}/{CACHE_SUBPATH}"},
+            # "1" = journal to <TPU_WEIGHT_CACHE>/gateway-journal.ndjson
+            {"name": "TPU_GATEWAY_PERSIST", "value": "1"},
+            {"name": "TPU_DRAIN_TIMEOUT_S", "value": str(DRAIN_TIMEOUT_S)},
         ],
         "ports": [{"name": "http", "containerPort": PORT,
                    "protocol": "TCP"}],
+        "volumeMounts": [{
+            # only the RW cache subpath: the gateway needs a durable home
+            # for its journal, not the model blobs
+            "name": VOLUME_NAME,
+            "mountPath": f"{STORE_MOUNT}/{CACHE_SUBPATH}",
+            "subPath": CACHE_SUBPATH,
+            "readOnly": False,
+        }],
         "startupProbe": _probe("/healthz", failure_threshold=30),
         # ready iff >=1 replica is routable: an all-ejected fleet drops
         # out of the Service instead of 503ing every request
         "readinessProbe": _probe("/readyz", failure_threshold=3),
         "livenessProbe": _probe("/healthz", failure_threshold=3),
+        "lifecycle": {
+            "preStop": {
+                "exec": {"command": ["sh", "-c",
+                                     f"sleep {PRESTOP_SLEEP_S}"]},
+            },
+        },
     }
 
 

@@ -286,7 +286,14 @@ def build_gateway_deployment(model: Dict[str, Any],
                                     image=server_image)
     if spec.image_pull_policy:
         gw["imagePullPolicy"] = spec.image_pull_policy
-    pod_spec: Dict[str, Any] = {"containers": [gw]}
+    pod_spec: Dict[str, Any] = {
+        "containers": [gw],
+        # the persist log lives on the same PVC the weight cache uses
+        "volumes": [_store_volume(spec)],
+        # preStop sleep + begin_drain window + persist flush must all fit
+        # before the kubelet SIGKILLs (same geometry as server pods)
+        "terminationGracePeriodSeconds": podf.TERMINATION_GRACE_S,
+    }
     if spec.image_pull_secrets:
         pod_spec["imagePullSecrets"] = copy.deepcopy(spec.image_pull_secrets)
     return {

@@ -1,0 +1,203 @@
+"""Seeded randomized chaos campaigns over the fault-point catalog.
+
+The per-point drills (tests/test_faults.py, tests/test_gateway.py) prove
+each recovery path in isolation; what they cannot prove is that the
+paths COMPOSE — that an engine restart during a gateway failover during
+a scrape delay still converges to a fleet where every client stream
+terminates exactly once and no page leaks. That is what a *campaign*
+checks: from a single integer seed, a deterministic randomized schedule
+of fault events drawn from the full ``FAULTS`` catalog (runtime/
+faults.py) plus fleet-level actions (replica kills/revives, gateway
+crash + journal restore), executed against a real fleet under mixed
+traffic, with global invariants asserted after EVERY event and again at
+quiesce.
+
+Split of responsibilities:
+
+- this module is the generic ENGINE: schedule generation, the
+  inject → traffic → check loop, the chaos counter, and violation
+  reporting. It is stdlib-only (plus the repo's metrics/faults/trace
+  singletons) and knows nothing about servers or gateways.
+- the HARNESS (tools/chaos_campaign builds the real one; tests build
+  small ones) supplies the fleet. Duck-typed protocol:
+
+  - ``fault_points`` — list of catalog point names to draw from
+    (normally every name in ``FAULTS.points()``).
+  - ``actions`` — ordered mapping of action name → ``fn(rng)`` for
+    fleet events the injector cannot express (kill a replica process,
+    crash the gateway, partition the control plane).
+  - ``traffic(rng)`` — drive one round of mixed client traffic.
+  - ``check(final=False)`` — raise ``AssertionError`` on any violated
+    invariant; ``final=True`` runs the expensive quiesce-only checks
+    (journal drained, threads settled, byte-identity ledger).
+  - ``quiesce()`` — let in-flight work finish and revive anything the
+    campaign killed, so the final check sees a settled fleet.
+
+Determinism: the schedule is generated ONE EVENT AT A TIME from a
+``random.Random(seed)`` that nothing else consumes, so the schedule for
+``--events N`` is a strict prefix of the schedule for ``--events M > N``
+— a violation at event k reproduces with ``--seed S --events k``.
+Traffic shapes come from a second generator derived from the seed;
+thread interleavings still vary, which is the point: the INVARIANTS
+must hold on every interleaving, while the *injection sequence* is
+pinned by the seed.
+
+Every fault injection increments
+``tpu_model_chaos_events_total{point=...}`` and records a
+``chaos_inject`` flight event; the engine cross-checks counter against
+schedule after each event, so "counters consistent with the flight
+recorder" is itself a campaign invariant.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from ..server.metrics import GLOBAL as METRICS
+from .faults import FAULTS
+from .trace import FLIGHT
+
+CHAOS_COUNTER = "tpu_model_chaos_events_total"
+
+# Every spec here is SELF-DISARMING (bounded trigger): a campaign must
+# converge back to a healthy fleet, so an unbounded `fail` that poisons
+# every later round is not a legal draw. Delays model slow components
+# (scrape timeouts, watchdog trips); fails model crashes.
+FAULT_SPECS: Sequence[str] = (
+    "fail:once",
+    "fail:n=2",
+    "fail:n=3",
+    "delay:20ms:once",
+    "delay:5ms:n=5",
+)
+
+# fraction of events that arm a fault point (the rest are fleet actions,
+# split uniformly over the harness's action table)
+_FAULT_WEIGHT = 0.7
+
+
+@dataclass(frozen=True)
+class ChaosEvent:
+    """One scheduled injection: either arm ``spec`` at fault ``point``,
+    or invoke the harness action named ``kind``."""
+    idx: int                    # 1-based position in the schedule
+    kind: str                   # "fault" or a harness action name
+    point: str = ""
+    spec: str = ""
+
+    def describe(self) -> str:
+        if self.kind == "fault":
+            return f"fault {self.point} {self.spec}"
+        return f"action {self.kind}"
+
+
+class InvariantViolation(AssertionError):
+    """A global invariant failed during a campaign. Carries everything a
+    human needs for a deterministic repro: the seed, the failing event's
+    index (``--events idx`` replays exactly this prefix), and the
+    minimal event prefix itself."""
+
+    def __init__(self, seed: int, events: List[ChaosEvent], cause: BaseException):
+        self.seed = seed
+        self.events = list(events)
+        self.cause = cause
+        at = events[-1].describe() if events else "quiesce"
+        prefix = "\n".join(f"  {e.idx:3d}. {e.describe()}" for e in events)
+        super().__init__(
+            f"chaos invariant violated at event {len(events)} "
+            f"({at}): {cause}\n"
+            f"repro: python -m tools.chaos_campaign "
+            f"--seed {seed} --events {max(1, len(events))}\n"
+            f"event prefix:\n{prefix}")
+
+
+@dataclass
+class CampaignReport:
+    """What a green campaign proved; rendered into GITHUB_STEP_SUMMARY
+    by the CI job."""
+    seed: int
+    n_events: int
+    faults_by_point: Dict[str, int] = field(default_factory=dict)
+    actions: Dict[str, int] = field(default_factory=dict)
+    traffic_rounds: int = 0
+    checks: int = 0
+
+    def summary_lines(self) -> List[str]:
+        out = [f"seed {self.seed}: {self.n_events} events, "
+               f"{self.traffic_rounds} traffic rounds, "
+               f"{self.checks} invariant checks — green"]
+        for point in sorted(self.faults_by_point):
+            out.append(f"  - fault {point}: "
+                       f"{self.faults_by_point[point]} injected")
+        for name in sorted(self.actions):
+            out.append(f"  - action {name}: {self.actions[name]}")
+        return out
+
+
+def next_event(rng: random.Random, idx: int, points: Sequence[str],
+               actions: Sequence[str]) -> ChaosEvent:
+    """Draw event ``idx``. Consumes ``rng`` only — the schedule prefix
+    property (see module docstring) depends on nothing else touching
+    this generator."""
+    if actions and rng.random() >= _FAULT_WEIGHT:
+        return ChaosEvent(idx=idx, kind=rng.choice(list(actions)))
+    point = rng.choice(list(points))
+    return ChaosEvent(idx=idx, kind="fault", point=point,
+                      spec=rng.choice(list(FAULT_SPECS)))
+
+
+def run_campaign(harness: Any, seed: int, n_events: int,
+                 log: Optional[Callable[[str], None]] = None
+                 ) -> CampaignReport:
+    """Run one campaign; returns a report, or raises
+    :class:`InvariantViolation` with the seed + minimal event prefix."""
+    say = log or (lambda _m: None)
+    points = list(getattr(harness, "fault_points", None)
+                  or [p.name for p in FAULTS.points()])
+    actions: Dict[str, Callable] = dict(getattr(harness, "actions", {}))
+    sched_rng = random.Random(seed)
+    # traffic randomness is seeded but SEPARATE: traffic draws must not
+    # perturb the schedule prefix property
+    traffic_rng = random.Random((seed << 1) ^ 0x5DEECE66D)
+    report = CampaignReport(seed=seed, n_events=n_events)
+    baseline = {p: METRICS.get(CHAOS_COUNTER, f'{{point="{p}"}}')
+                for p in points}
+    executed: List[ChaosEvent] = []
+    try:
+        for i in range(1, n_events + 1):
+            ev = next_event(sched_rng, i, points, list(actions))
+            executed.append(ev)
+            if ev.kind == "fault":
+                FAULTS.arm(ev.point, ev.spec)
+                METRICS.inc(CHAOS_COUNTER, 1.0, f'{{point="{ev.point}"}}')
+                FLIGHT.record("chaos_inject", point=ev.point, spec=ev.spec)
+                report.faults_by_point[ev.point] = \
+                    report.faults_by_point.get(ev.point, 0) + 1
+            else:
+                FLIGHT.record("chaos_action", action=ev.kind)
+                actions[ev.kind](traffic_rng)
+                report.actions[ev.kind] = report.actions.get(ev.kind, 0) + 1
+            say(f"[{i}/{n_events}] {ev.describe()}")
+            harness.traffic(traffic_rng)
+            report.traffic_rounds += 1
+            harness.check(final=False)
+            report.checks += 1
+            # counter ↔ schedule consistency is itself an invariant: the
+            # chaos counter must read exactly what this campaign injected
+            for p, n in report.faults_by_point.items():
+                got = METRICS.get(CHAOS_COUNTER, f'{{point="{p}"}}')
+                assert got == baseline[p] + n, (
+                    f"chaos counter for {p} reads {got}, expected "
+                    f"{baseline[p]} + {n} injected")
+        # quiesce: disarm everything still pending, let the fleet settle,
+        # then run the expensive whole-campaign checks
+        FAULTS.reset()
+        harness.quiesce()
+        harness.check(final=True)
+        report.checks += 1
+    except AssertionError as e:
+        FAULTS.reset()
+        raise InvariantViolation(seed, executed, e) from e
+    return report

@@ -7,51 +7,14 @@ calls ``FAULTS.check("<point>")`` at a named fault point; the check is a
 no-op (one dict lookup on an empty dict) unless a rule has been armed
 for that point via the test API or the ``TPU_FAULTS`` env var.
 
-Fault points wired through the codebase:
-
-    engine.step     -- top of ``Engine.decode_n_launch`` (the decode hot
-                       loop; covers sync ``decode_n`` too, and in
-                       paged+async mode fires BEFORE the launch advances
-                       the dispatch epoch — the chaos drills assert the
-                       restart drains the page quarantine and errors the
-                       in-flight dispatch's owners exactly once)
-    engine.admit    -- top of ``Engine.admit`` (prefill/admission)
-    pages.alloc     -- ``PageTable.grow`` page allocation; an armed fail
-                       makes grow return False (simulated pool
-                       exhaustion), so callers exercise their REAL
-                       dry-pool paths (preempt/evict/cold-fallback)
-    detok.feed      -- service detokeniser feed, per chunk
-    follower.send   -- ``ControlPlane._send`` to each follower conn
-    kube.request    -- ``KubeClient._request`` before the HTTP call
-    admission.predict -- ``admission.predict_queue_wait_s`` (the TTFT
-                       queue model; an armed fail proves the predictor
-                       fails OPEN — requests are admitted and covered
-                       by the deadline machinery, never 500ed)
-    scheduler.replay -- per replayable stream in ``_fail_running``
-                       restart classification; an armed fail forces the
-                       stream down the fail-safe exactly-once error
-                       path (fallback cause="faulted")
-    engine.watchdog -- inside the scheduler's watchdog-bounded dispatch
-                       wait, ON the waiter thread; an armed delay:Nms
-                       simulates a wedged device (the wait stalls, the
-                       watchdog fires, supervised restart + replay)
-    operator.scrape -- ``client.fetch_replica_ps`` before the replica
-                       /api/ps GET; an armed fail collapses the scrape
-                       to None exactly like a network fault (replica
-                       reads as unreachable), an armed delay stalls
-                       like a slow pod — the autoscaler chaos drills
-                       assert the control loop holds its last decision
-                       (fails static) instead of scaling on the hole
-    gateway.route   -- ``gateway.Gateway`` after a replica has been
-                       picked but before the request is dispatched to
-                       it; an armed fail makes the dispatch attempt
-                       count as a replica failure (circuit feeding),
-                       an armed delay models a slow proxy hop
-    gateway.stream  -- per upstream response chunk inside the gateway's
-                       stream pump; an armed fail severs the upstream
-                       mid-stream exactly like a replica death (the
-                       failover drills ride this), an armed delay
-                       models a stalling replica
+Every wired fault point is registered in the introspectable CATALOG
+below (``FAULTS.points()``) with its call-site module and a docstring
+describing what an armed fail/delay simulates.  The fault-catalog lint
+pass (tools/invariant_lint) holds the registry honest: every
+``FAULTS.check`` call site in the tree must be catalogued here and every
+catalogued point documented in both docs trees' fault-point tables, so
+the randomized chaos campaign (runtime/chaos.py) can enumerate the full
+fault surface instead of a hand-maintained list.
 
 Trigger specs (the grammar is intentionally tiny):
 
@@ -71,10 +34,84 @@ Stdlib only; no dependency on jax so the operator can import it too.
 
 from __future__ import annotations
 
+import dataclasses
 import os
 import threading
 import time
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPoint:
+    """One registered fault point: where it is wired and what it models."""
+
+    name: str
+    site: str    # repo-relative module holding the FAULTS.check call
+    doc: str
+
+
+CATALOG: Dict[str, FaultPoint] = {}
+
+
+def point(name: str, site: str, doc: str) -> FaultPoint:
+    """Register a fault point in the catalog (duplicate names are a bug)."""
+    if name in CATALOG:
+        raise ValueError(f"fault point {name!r} already registered")
+    fp = FaultPoint(name, site, " ".join(doc.split()))
+    CATALOG[name] = fp
+    return fp
+
+
+point("engine.step", "ollama_operator_tpu/runtime/engine.py",
+      """Top of Engine.decode_n_launch (the decode hot loop; covers sync
+      decode_n too). In paged+async mode fires BEFORE the launch advances
+      the dispatch epoch — the chaos drills assert the supervised restart
+      drains the page quarantine and errors the in-flight dispatch's
+      owners exactly once.""")
+point("engine.admit", "ollama_operator_tpu/runtime/engine.py",
+      """Top of Engine.admit (prefill/admission). An armed fail is a
+      per-request error, never a loop failure: no restart, next request
+      admits fine.""")
+point("engine.watchdog", "ollama_operator_tpu/runtime/scheduler.py",
+      """Inside the scheduler's watchdog-bounded dispatch wait, ON the
+      waiter thread; an armed delay:Nms simulates a wedged device (the
+      wait stalls, the watchdog fires, supervised restart + replay).""")
+point("scheduler.replay", "ollama_operator_tpu/runtime/scheduler.py",
+      """Per replayable stream in _fail_running restart classification;
+      an armed fail forces the stream down the fail-safe exactly-once
+      error path (fallback cause="faulted").""")
+point("pages.alloc", "ollama_operator_tpu/runtime/paged.py",
+      """PageTable.grow page allocation; an armed fail makes grow return
+      False (simulated pool exhaustion) so callers exercise their REAL
+      dry-pool paths (preempt/evict/cold-fallback).""")
+point("detok.feed", "ollama_operator_tpu/runtime/service.py",
+      """Service detokeniser feed, per chunk; an armed fail errors one
+      stream without touching the engine.""")
+point("admission.predict", "ollama_operator_tpu/runtime/admission.py",
+      """admission.predict_queue_wait_s (the TTFT queue model); an armed
+      fail proves the predictor fails OPEN — requests are admitted and
+      covered by the deadline machinery, never 500ed.""")
+point("follower.send", "ollama_operator_tpu/runtime/follower.py",
+      """ControlPlane broadcast send to each follower conn; an armed fail
+      is caught like a socket error and degrades the world (FollowerLost),
+      an armed delay models a stalled follower eating backpressure.""")
+point("kube.request", "ollama_operator_tpu/operator/client.py",
+      """KubeClient._request before the HTTP call; read-only GETs retry
+      transparently, writes surface the typed ApiError.""")
+point("operator.scrape", "ollama_operator_tpu/operator/client.py",
+      """client.fetch_replica_ps before the replica /api/ps GET; an armed
+      fail collapses the scrape to None exactly like a network fault, an
+      armed delay stalls like a slow pod — the control loops must hold
+      their last decision (fail static) instead of acting on the hole.""")
+point("gateway.route", "ollama_operator_tpu/operator/gateway.py",
+      """After the gateway has picked a replica but before the request is
+      dispatched to it; an armed fail counts as a replica failure
+      (circuit feeding), an armed delay models a slow proxy hop.""")
+point("gateway.stream", "ollama_operator_tpu/operator/gateway.py",
+      """Per upstream response chunk inside the gateway's stream pump; an
+      armed fail severs the upstream mid-stream exactly like a replica
+      death (the failover drills ride this), an armed delay models a
+      stalling replica.""")
 
 
 class InjectedFault(RuntimeError):
@@ -152,6 +189,10 @@ class FaultInjector:
     def hits(self, point: str) -> int:
         with self._lock:
             return self._counts.get(point, 0)
+
+    def points(self) -> List[FaultPoint]:
+        """The full registered fault-point catalog, sorted by name."""
+        return [CATALOG[n] for n in sorted(CATALOG)]
 
     def check(self, point: str) -> None:
         """Call at a fault point. No-op unless a rule is armed for it."""

@@ -41,6 +41,7 @@ import struct
 import sys
 import threading
 import time
+import weakref
 from typing import Any, List, Optional
 
 from ..server.metrics import GLOBAL as METRICS
@@ -52,6 +53,14 @@ from .faults import FAULTS, InjectedFault
 from .trace import FLIGHT
 
 CONTROL_PORT_OFFSET = 1      # coordinator port + 1
+
+# live control planes for the follower-lag gauge: weakly held so a
+# torn-down leader doesn't pin a stale series (same pattern as the
+# gateway's per-state replica gauges)
+_LIVE_CPS: "weakref.WeakSet[ControlPlane]" = weakref.WeakSet()
+METRICS.gauge_fn(
+    "tpu_model_follower_lag_seconds",
+    lambda: max((cp.lag_s for cp in _LIVE_CPS), default=0.0))
 
 
 def log(msg: str) -> None:
@@ -102,6 +111,14 @@ class ControlPlane:
         # half-dispatching and desyncing the survivors
         self.degraded = False
         self.degraded_reason: Optional[str] = None
+        # bounded send backpressure: a follower whose TCP buffer stays
+        # full for longer than this is DEAD, not slow — without the bound
+        # one stalled host wedges every dispatch forever. Sends that
+        # complete but slowly are the SLOW case: dispatch proceeds and
+        # the lag shows up in tpu_model_follower_lag_seconds.
+        self.send_timeout_s = float(
+            os.environ.get("TPU_CP_SEND_TIMEOUT_S", "20"))
+        self.lag_s = 0.0         # slowest send in the latest broadcast
         self._hb_stop = threading.Event()
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -118,6 +135,7 @@ class ControlPlane:
         if heartbeat_s > 0:
             threading.Thread(target=self._heartbeat_loop,
                              daemon=True).start()
+        _LIVE_CPS.add(self)
 
     def _accept_loop(self):
         while len(self._conns) < self.n:
@@ -126,6 +144,8 @@ class ControlPlane:
             except OSError:     # listener closed during shutdown
                 return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            if self.send_timeout_s > 0:
+                conn.settimeout(self.send_timeout_s)
             with self._lock:
                 self._conns.append(conn)
             log(f"follower connected from {addr} "
@@ -165,11 +185,16 @@ class ControlPlane:
                 f"control plane degraded: {self.degraded_reason}")
         self._ready.wait()
         with self._lock:
+            worst = 0.0
             for c in list(self._conns):
+                t0 = time.monotonic()
                 try:
                     FAULTS.check("follower.send")
                     # serialising sends under _lock is the point — the
-                    # per-follower byte streams must not interleave
+                    # per-follower byte streams must not interleave; the
+                    # per-conn send timeout (TPU_CP_SEND_TIMEOUT_S) is
+                    # the backpressure bound, so a stalled follower can
+                    # block a dispatch for at most one window
                     # lint: allow(lock-order): frame send serialised by design
                     _send(c, msg)
                 except (OSError, InjectedFault) as e:
@@ -178,8 +203,21 @@ class ControlPlane:
                     except OSError:
                         pass
                     self._conns.remove(c)
+                    if isinstance(e, socket.timeout):
+                        # slow-vs-dead verdict: the kernel buffer stayed
+                        # full for the whole window — that is a dead (or
+                        # unrecoverably wedged) host, not a slow one
+                        raise self._mark_degraded(
+                            f"follower send exceeded the "
+                            f"{self.send_timeout_s:.0f}s backpressure "
+                            f"bound: {e}") from e
                     raise self._mark_degraded(
                         f"send to follower failed: {e}") from e
+                worst = max(worst, time.monotonic() - t0)
+            # slow-but-alive: the send completed within the bound; the
+            # lag gauge is how operators see a follower eating into the
+            # backpressure window before it ever trips the bound
+            self.lag_s = worst
 
     def close(self):
         self._hb_stop.set()
@@ -277,10 +315,29 @@ def run_follower(manager, host: str, port: int,
         raise ConnectionError(f"leader control port {host}:{port} "
                               f"unreachable")
     sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    # silent-leader watchdog: the leader's heartbeat guarantees traffic
+    # every TPU_CP_HEARTBEAT_S, so a recv gap past this bound means the
+    # leader is dead or partitioned away. Fail static to a CLEAN exit
+    # instead of hanging on the broadcast socket forever — the pod
+    # restarts and rejoins the next world. 0 disables (tests drive the
+    # stream by hand).
+    leader_timeout_s = float(os.environ.get("TPU_CP_LEADER_TIMEOUT_S",
+                                            "60"))
+    if leader_timeout_s > 0:
+        sock.settimeout(leader_timeout_s)
     log(f"joined control stream {host}:{port}")
     engine = None
     while True:
-        msg = _recv(sock)
+        try:
+            msg = _recv(sock)
+        except socket.timeout:
+            # lint: allow(follower-purity): own per-process metrics — local observability, never broadcast back
+            METRICS.inc("tpu_model_leader_lost_total")
+            # lint: allow(follower-purity): own per-process flight ring — local diagnosis, never broadcast back
+            FLIGHT.record("leader_lost", timeout_s=leader_timeout_s)
+            log(f"leader silent for {leader_timeout_s:g}s "
+                f"(TPU_CP_LEADER_TIMEOUT_S) — failing static, clean exit")
+            return
         op = msg[0]
         if op == "ping":
             continue             # leader heartbeat; liveness only

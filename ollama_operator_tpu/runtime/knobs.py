@@ -222,6 +222,13 @@ declare("TPU_DIST_CONTROL", "str", None, "multihost",
         "operator-injected")
 declare("TPU_CP_HEARTBEAT_S", "float", 10, "multihost",
         "control-plane heartbeat period in seconds; 0 disables")
+declare("TPU_CP_LEADER_TIMEOUT_S", "float", 60, "multihost",
+        "follower exits cleanly (fail static) when the leader control "
+        "stream is silent this long; 0 disables the watchdog")
+declare("TPU_CP_SEND_TIMEOUT_S", "float", 20, "multihost",
+        "leader-side per-follower send backpressure bound; a broadcast "
+        "blocked past this counts the follower dead (FollowerLost) "
+        "instead of wedging every dispatch; 0 disables")
 
 # -- lifecycle --------------------------------------------------------------
 
@@ -339,6 +346,14 @@ declare("TPU_GATEWAY_HEDGE_MS", "float", 0, "gateway",
 declare("TPU_GATEWAY_JOURNAL", "int", 512, "gateway",
         "completed-request journal entries kept for failover replay "
         "bookkeeping")
+declare("TPU_GATEWAY_PERSIST", "str", None, "gateway",
+        "crash-recovery journal: unset/0 disables, 1 writes the "
+        "append-log to <TPU_WEIGHT_CACHE>/gateway-journal.ndjson, "
+        "anything else is an explicit log path")
+declare("TPU_GATEWAY_PERSIST_FLUSH_MS", "float", 50, "gateway",
+        "persist-log fsync batching window in ms; a crash loses at most "
+        "this much journal progress (downgrading a resume to the "
+        "exactly-once error frame)")
 
 
 def _main() -> None:

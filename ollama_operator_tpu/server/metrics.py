@@ -389,6 +389,30 @@ GLOBAL.describe("tpu_model_warm_snapshot_restores_total",
                 "instead of a from-scratch warm_buckets compile pass — "
                 "a woken replica's first request must not trip "
                 "tpu_model_recompiles_total")
+GLOBAL.describe("tpu_model_gateway_persist_writes_total",
+                "Journal/affinity snapshot records appended to the "
+                "gateway's crash-recovery log (TPU_GATEWAY_PERSIST), "
+                "fsync batched per flush window")
+GLOBAL.describe("tpu_model_gateway_persist_restores_total",
+                "Journaled streams restored from the persist log at "
+                "gateway restart (each is a request a reconnecting "
+                "client can splice byte-identically)")
+GLOBAL.describe("tpu_model_gateway_drain_total",
+                "Gateway graceful-drain activations (SIGTERM / preStop): "
+                "stop accepting, finish proxied streams, persist, exit")
+GLOBAL.describe("tpu_model_follower_lag_seconds",
+                "Slowest follower's broadcast send lag over the control "
+                "plane (bounded by TPU_CP_SEND_TIMEOUT_S — a follower "
+                "that exceeds the bound is declared dead, not slow)")
+GLOBAL.describe("tpu_model_leader_lost_total",
+                "Follower exits after a silent leader (no control-stream "
+                "traffic, heartbeats included, for longer than "
+                "TPU_CP_LEADER_TIMEOUT_S): fail-static clean exit "
+                "instead of hanging on the broadcast socket")
+GLOBAL.describe("tpu_model_chaos_events_total",
+                "Randomized chaos-campaign fault events injected, by "
+                "fault point (runtime/chaos.py; the label set is the "
+                "full FAULTS catalog)")
 # pre-seed the failure counters at 0: alert rules rate() over these, and
 # a series that first appears AT the first failure hides that failure
 # (the stall/chunk counters likewise: a mixed-load dashboard must read 0,
@@ -498,6 +522,23 @@ for _cause in ("failures", "slow", "not_ready"):
 for _result in ("ok", "fail"):
     GLOBAL.inc("tpu_model_gateway_half_open_probes_total", 0.0,
                f'{{result="{_result}"}}')
+# gateway crash recovery + multi-host partition tolerance: rare-event
+# counters the robustness dashboards alert on — all visible as 0 from
+# the first scrape (tpu_model_follower_lag_seconds is a live gauge the
+# control plane registers, not a counter)
+GLOBAL.inc("tpu_model_gateway_persist_writes_total", 0.0)
+GLOBAL.inc("tpu_model_gateway_persist_restores_total", 0.0)
+GLOBAL.inc("tpu_model_gateway_drain_total", 0.0)
+GLOBAL.inc("tpu_model_leader_lost_total", 0.0)
+# chaos-campaign event counter: one series per registered fault point
+# (this literal list mirrors runtime/faults.py CATALOG; test_faults
+# asserts the two stay in sync)
+for _point in ("admission.predict", "detok.feed", "engine.admit",
+               "engine.step", "engine.watchdog", "follower.send",
+               "gateway.route", "gateway.stream", "kube.request",
+               "operator.scrape", "pages.alloc", "scheduler.replay"):
+    GLOBAL.inc("tpu_model_chaos_events_total", 0.0,
+               f'{{point="{_point}"}}')
 
 
 class Stopwatch:

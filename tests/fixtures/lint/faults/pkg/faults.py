@@ -1,0 +1,11 @@
+"""Fixture fault-point catalog: one healthy point, one stale, one
+undocumented in the zh tree."""
+
+
+def point(name, site, doc):
+    return (name, site, doc)
+
+
+point("fix.ok", "pkg/mod.py", "checked and documented everywhere")
+point("fix.stale", "pkg/mod.py", "registered but never checked")
+point("fix.nodoc", "pkg/mod.py", "checked but missing from docs/zh")

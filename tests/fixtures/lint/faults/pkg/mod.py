@@ -1,0 +1,22 @@
+"""Fixture check sites: a healthy one, an uncatalogued one, a computed
+one, and a suppressed uncatalogued one."""
+
+FAULTS = object()
+
+
+def healthy():
+    FAULTS.check("fix.ok")
+    FAULTS.check("fix.nodoc")
+
+
+def ghost():
+    FAULTS.check("fix.ghost")
+
+
+def computed(name):
+    FAULTS.check(name)
+
+
+def tolerated():
+    # lint: allow(fault-catalog): fixture exercises suppression
+    FAULTS.check("fix.tolerated")

@@ -1,0 +1,96 @@
+"""Seeded chaos-campaign engine (runtime/chaos.py) + the fleet harness
+(tools/chaos_campaign). The schedule's prefix property is what makes a
+red campaign reproducible: `--seed S --events N` replays exactly the
+failing prefix, so the unit tier pins it alongside the repro string and
+a small in-suite campaign against the real fleet harness.
+"""
+
+import random
+
+import pytest
+
+from ollama_operator_tpu.runtime.chaos import (FAULT_SPECS, ChaosEvent,
+                                               InvariantViolation,
+                                               next_event, run_campaign)
+from ollama_operator_tpu.runtime.faults import FAULTS
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    FAULTS.reset()
+
+
+POINTS = ("engine.step", "gateway.route", "pages.alloc")
+ACTIONS = ("kill_replica", "revive_replica")
+
+
+def schedule(seed, n):
+    rng = random.Random(seed)
+    return [next_event(rng, i, POINTS, ACTIONS) for i in range(1, n + 1)]
+
+
+class TestSchedule:
+    def test_prefix_property(self):
+        """The first N events of a longer campaign ARE the N-event
+        campaign — the repro contract of every InvariantViolation."""
+        assert schedule(7, 10)[:5] == schedule(7, 5)
+
+    def test_deterministic_and_seed_sensitive(self):
+        assert schedule(7, 20) == schedule(7, 20)
+        assert schedule(7, 20) != schedule(8, 20)
+
+    def test_events_are_well_formed(self):
+        for ev in schedule(3, 60):
+            if ev.kind == "fault":
+                assert ev.point in POINTS
+                assert ev.spec in FAULT_SPECS
+            else:
+                assert ev.kind in ACTIONS
+                assert ev.point == "" and ev.spec == ""
+
+    def test_mix_includes_faults_and_actions(self):
+        kinds = {ev.kind for ev in schedule(11, 60)}
+        assert "fault" in kinds
+        assert kinds & set(ACTIONS)
+
+    def test_no_actions_means_all_faults(self):
+        rng = random.Random(5)
+        evs = [next_event(rng, i, POINTS, ()) for i in range(1, 30)]
+        assert all(ev.kind == "fault" for ev in evs)
+
+
+class TestInvariantViolation:
+    def test_carries_seed_prefix_and_repro_command(self):
+        events = [ChaosEvent(idx=1, kind="fault", point="engine.step",
+                             spec="fail:once"),
+                  ChaosEvent(idx=2, kind="kill_replica")]
+        err = InvariantViolation(9, events, AssertionError("journal leak"))
+        msg = str(err)
+        assert "--seed 9" in msg and "--events 2" in msg
+        assert "fault engine.step fail:once" in msg
+        assert "action kill_replica" in msg
+        assert "journal leak" in msg
+        assert err.seed == 9 and len(err.events) == 2
+
+
+@pytest.mark.chaos
+def test_small_campaign_against_real_fleet_runs_green(tmp_path):
+    """A short seeded campaign against the real ChaosFleet harness (fake
+    replicas + real gateway + real control plane) completes with every
+    invariant intact and an honest report."""
+    from tools.chaos_campaign.harness import ChaosFleet
+
+    fleet = ChaosFleet(n_replicas=2, persist_dir=str(tmp_path))
+    try:
+        report = run_campaign(fleet, seed=5, n_events=6)
+    finally:
+        fleet.close()
+        FAULTS.reset()
+    assert report.seed == 5 and report.n_events == 6
+    assert report.traffic_rounds == 6
+    assert report.checks == 7                # per-event + final
+    total = sum(report.faults_by_point.values()) \
+        + sum(report.actions.values())
+    assert total == 6
+    assert report.summary_lines()[0].endswith("green")

@@ -193,6 +193,32 @@ def test_kube_request_fault_retried_on_get():
         srv.shutdown()
 
 
+def test_fault_catalog_points_are_complete_and_documented():
+    """Satellite 2: FAULTS.points() is the chaos campaign's draw set —
+    sorted, stable, and every point carries its check site and a doc
+    string (the invariant-lint fault-catalog pass enforces the same
+    contract statically)."""
+    from ollama_operator_tpu.runtime.faults import CATALOG, FAULTS
+    pts = FAULTS.points()
+    assert [p.name for p in pts] == sorted(CATALOG)
+    assert len(pts) >= 12
+    for p in pts:
+        assert p.site, p.name
+        assert p.doc, p.name
+
+
+def test_chaos_metric_preseeds_mirror_fault_catalog():
+    """metrics.py pre-seeds tpu_model_chaos_events_total for every
+    catalogued point (rate() alerts must read 0, not absent, before the
+    first campaign); the literal list there must track the CATALOG."""
+    from ollama_operator_tpu.runtime.faults import FAULTS
+    rendered = METRICS.render()
+    for p in FAULTS.points():
+        series = f'tpu_model_chaos_events_total{{point="{p.name}"}}'
+        assert series in rendered, \
+            f"{series} not pre-seeded in server/metrics.py"
+
+
 def test_retry_transient_backoff_and_classification():
     from ollama_operator_tpu.operator.client import (ApiError, Conflict,
                                                      NotFound,

@@ -178,6 +178,92 @@ def test_heartbeat_pings_and_follower_ignores_them():
         cp.close()
 
 
+def test_silent_leader_fails_static_with_clean_exit(monkeypatch):
+    """Partition drill: a follower whose leader goes silent past
+    TPU_CP_LEADER_TIMEOUT_S must fail static — count the loss, leave a
+    breadcrumb, and EXIT cleanly (the pod restarts and rejoins the next
+    world) instead of hanging on the broadcast socket forever."""
+    from ollama_operator_tpu.server.metrics import GLOBAL as METRICS
+
+    monkeypatch.setenv("TPU_CP_LEADER_TIMEOUT_S", "0.3")
+    lost_before = METRICS.get("tpu_model_leader_lost_total")
+    srv = socket.socket()
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    accepted = []
+
+    def accept():
+        conn, _ = srv.accept()
+        accepted.append(conn)    # accept the join, then say nothing
+
+    threading.Thread(target=accept, daemon=True).start()
+    t = threading.Thread(target=F.run_follower,
+                         args=(None, "127.0.0.1", port), daemon=True)
+    t.start()
+    t.join(timeout=5)
+    try:
+        assert not t.is_alive(), "follower must fail static, not hang"
+        assert METRICS.get("tpu_model_leader_lost_total") \
+            == lost_before + 1
+    finally:
+        for c in accepted:
+            c.close()
+        srv.close()
+
+
+def test_slow_follower_trips_backpressure_bound(monkeypatch):
+    """Slow-vs-dead verdict: a follower that stops draining its socket
+    wedges a dispatch for at most one TPU_CP_SEND_TIMEOUT_S window, then
+    the world degrades with the typed backpressure diagnosis."""
+    import time as _time
+
+    import pytest
+    from ollama_operator_tpu.runtime.errors import FollowerLost
+
+    monkeypatch.setenv("TPU_CP_SEND_TIMEOUT_S", "0.3")
+    port = _free_port()
+    cp = F.ControlPlane(1, port, bind="127.0.0.1", heartbeat_s=0)
+    c1 = socket.create_connection(("127.0.0.1", port))
+    big = ("call", "embed", (b"x" * (1 << 20),), {})
+    t0 = _time.monotonic()
+    try:
+        with pytest.raises(FollowerLost) as ei:
+            # never read from c1: the kernel buffers fill and the send
+            # window expires on a wedged — not merely slow — peer
+            for _ in range(64):
+                cp.broadcast(big)
+        assert "backpressure bound" in str(ei.value)
+        assert cp.degraded
+        assert _time.monotonic() - t0 < 10
+    finally:
+        c1.close()
+        cp.close()
+
+
+def test_follower_lag_gauge_reports_worst_live_lag():
+    """Sends that complete within the bound are the SLOW case: dispatch
+    proceeds and the lag surfaces in tpu_model_follower_lag_seconds so
+    operators see a follower eating into the backpressure window."""
+    from ollama_operator_tpu.server.metrics import GLOBAL as METRICS
+
+    port = _free_port()
+    cp = F.ControlPlane(1, port, bind="127.0.0.1", heartbeat_s=0)
+    c1 = socket.create_connection(("127.0.0.1", port))
+    try:
+        cp.broadcast(("ping",))
+        assert cp.lag_s >= 0.0
+        cp.lag_s = 1.25        # the gauge reads live control planes
+        samples = [ln for ln in METRICS.render().splitlines()
+                   if ln.startswith("tpu_model_follower_lag_seconds")]
+        assert samples, "lag gauge missing from the scrape"
+        assert max(float(ln.split()[-1]) for ln in samples) >= 1.25
+    finally:
+        c1.close()
+        cp.close()
+
+
 def test_heartbeat_detects_silent_follower_death():
     """With no traffic at all, the heartbeat alone must discover a dead
     follower and flip the world degraded — this is the watchdog that

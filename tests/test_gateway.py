@@ -17,6 +17,7 @@ TestChaosDrills::test_drill9_replica_killed_mid_stream_under_load.
 import hashlib
 import json
 import threading
+import time
 import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -75,9 +76,10 @@ class FakeReplica:
 
     def __init__(self):
         self.ctl = {"down": False, "die_after": None, "draining": False,
-                    "slow_ready_s": 0.0}
+                    "slow_ready_s": 0.0, "stall_after": None}
         self.seen = []          # prompts served (prefix_probe evidence)
         self.served = 0
+        self.stall = threading.Event()   # releases wedged streams
         self._lock = threading.Lock()
         replica = self
 
@@ -172,12 +174,19 @@ class FakeReplica:
                     replica.seen.append(prompt)
                     replica.served += 1
                     die_after = replica.ctl["die_after"]
+                    stall_after = replica.ctl["stall_after"]
                 self.send_response(200)
                 self.send_header("Content-Type", "application/x-ndjson")
                 self.send_header("Transfer-Encoding", "chunked")
                 self.end_headers()
                 chat = self.path == "/api/chat"
                 for i, piece in enumerate(pieces):
+                    if stall_after is not None and i >= stall_after:
+                        # gateway-crash drills: wedge mid-stream (socket
+                        # alive, no bytes) until the test releases us
+                        replica.ctl["stall_after"] = None
+                        replica.stall.wait(30.0)
+                        stall_after = None
                     if die_after is not None and i >= die_after:
                         # replica death mid-stream: no terminal chunk,
                         # socket torn down, and the replica stays dead
@@ -894,3 +903,241 @@ class TestOperatorWiring:
         assert svc["spec"]["selector"] == {"app": "ollama-model-phi"}
         assert ("Normal", "GatewayRemoved") in rec.events
         assert ("Normal", "ServiceSelectorSynced") in rec.events
+
+
+# ---------------------------------------------------------------------------
+# crash recovery: persisted journal + restart resume (tentpole)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def persist_env(gw_env, tmp_path):
+    """gw_env plus a persisted journal: every gateway built under this
+    fixture boots from (and appends to) the same append-log, which is
+    exactly the crashed-pod-replacement topology."""
+    path = tmp_path / "gateway-journal.ndjson"
+    gw_env.setenv("TPU_GATEWAY_PERSIST", str(path))
+    gw_env.setenv("TPU_GATEWAY_PERSIST_FLUSH_MS", "5")
+    return path
+
+
+def stream_prefix(base_url, path, body, timeout=2.0):
+    """POST and read until the stream wedges (the gateway is about to be
+    crashed mid-stream); returns the text the client saw so far."""
+    req = urllib.request.Request(
+        f"{base_url}{path}", data=json.dumps(body).encode(), method="POST",
+        headers={"Content-Type": "application/json"})
+    raw = b""
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            while True:
+                d = resp.read(1)
+                if not d:
+                    break
+                raw += d
+    except (TimeoutError, OSError):
+        pass
+    text = ""
+    for line in raw.decode(errors="replace").splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            frame = json.loads(line)
+        except ValueError:
+            continue  # torn tail: the crash landed mid-frame
+        if not frame.get("done") and "error" not in frame:
+            text += frame.get("response", "")
+    return text
+
+
+class TestCrashRecovery:
+    def _crash_mid_stream(self, replicas, body, stall_after=4):
+        """Boot a gateway, wedge the stream after ``stall_after`` frames,
+        capture the client-visible prefix, then crash the gateway (stop
+        without closing live journal entries). Returns the prefix."""
+        for r in replicas:
+            r.ctl["stall_after"] = stall_after
+        gw1 = make_gateway(replicas).start()
+        try:
+            prefix = stream_prefix(gw1.base_url, "/api/generate", body)
+        finally:
+            gw1.stop()  # the crash: live entries stay open in the log
+        for r in replicas:
+            r.ctl["stall_after"] = None
+            r.stall.set()
+        return prefix
+
+    def test_restart_resumes_stream_byte_identically(self, persist_env,
+                                                     replicas):
+        body = {"model": "phi", "prompt": "cr" * 100,
+                "options": dict(GREEDY), "stream": True,
+                "request_id": "rid-restart-1"}
+        restored_before = metric("tpu_model_gateway_persist_restores_total")
+        replayed_before = metric("tpu_model_gateway_failovers_total",
+                                 '{result="replayed"}')
+        prefix = self._crash_mid_stream(replicas, body)
+        want = expected_text(body)
+        assert 0 < len(prefix) < len(want), "crash must land mid-stream"
+        gw2 = make_gateway(replicas).start()
+        try:
+            assert metric("tpu_model_gateway_persist_restores_total") \
+                >= restored_before + 1
+            frames = stream_frames(gw2.base_url, "/api/generate", body)
+            assert not any("error" in f for f in frames)
+            assert frames[-1].get("done") is True
+            # the reconnect got exactly the remainder: prefix + resume
+            # is byte-identical to an uninterrupted run
+            assert prefix + joined_text(frames) == want
+            assert metric("tpu_model_gateway_failovers_total",
+                          '{result="replayed"}') >= replayed_before + 1
+            assert gw2.journal_stats()["live"] == 0
+        finally:
+            gw2.stop()
+
+    def test_non_replayable_restored_stream_errors_exactly_once(
+            self, persist_env, replicas):
+        body = {"model": "phi", "prompt": "nr" * 100,
+                "options": dict(SAMPLED), "stream": True,
+                "request_id": "rid-restart-2"}
+        errored_before = metric("tpu_model_gateway_failovers_total",
+                                '{result="errored"}')
+        prefix = self._crash_mid_stream(replicas, body, stall_after=3)
+        assert prefix  # chars were emitted, so a silent regen would fork
+        gw2 = make_gateway(replicas).start()
+        try:
+            frames = stream_frames(gw2.base_url, "/api/generate", body)
+            errors = [f for f in frames if "error" in f]
+            assert len(errors) == 1 and frames[-1] is errors[0]
+            assert int(errors[0].get("retry_after_s", 0)) >= 1
+            assert metric("tpu_model_gateway_failovers_total",
+                          '{result="errored"}') >= errored_before + 1
+        finally:
+            gw2.stop()
+
+    def test_compaction_snapshot_restores_affinity(self, persist_env,
+                                                   replicas):
+        prompt = "af" * 120
+        body = {"model": "phi", "prompt": prompt, "options": dict(GREEDY),
+                "stream": True}
+        gw1 = make_gateway(replicas).start()
+        try:
+            stream_frames(gw1.base_url, "/api/generate", body)
+            owner = max(gw1._replicas.values(), key=lambda r: r.served).name
+            # affinity records reach disk via compaction; force one
+            gw1._persist.maybe_compact(gw1._snapshot_records, threshold=1)
+        finally:
+            gw1.stop()
+        gw2 = make_gateway(replicas)
+        name, path = gw2.pick(prompt)
+        assert (name, path) == (owner, "affinity")
+
+    def test_stop_flushes_but_keeps_live_entries_open(self, persist_env,
+                                                      replicas):
+        body = {"model": "phi", "prompt": "fl" * 100,
+                "options": dict(GREEDY), "stream": True,
+                "request_id": "rid-flush"}
+        self._crash_mid_stream(replicas, body)
+        recs = [json.loads(line) for line in
+                persist_env.read_text().splitlines() if line.strip()]
+        opens = [r for r in recs if r.get("t") == "open"]
+        closes = [r for r in recs if r.get("t") == "close"]
+        assert opens and not closes  # crashed, not completed
+
+
+# ---------------------------------------------------------------------------
+# drain + remediation-aware Retry-After (tentpole + satellite 1)
+# ---------------------------------------------------------------------------
+
+class TestDrainAndRetryAfter:
+    def test_begin_drain_sheds_with_finite_retry_after(self, persist_env,
+                                                       replicas):
+        drain_before = metric("tpu_model_gateway_drain_total")
+        gw = make_gateway(replicas).start()
+        try:
+            gw.begin_drain(timeout_s=0.2)
+            assert metric("tpu_model_gateway_drain_total") \
+                >= drain_before + 1
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(f"{gw.base_url}/readyz", timeout=5)
+            assert ei.value.code == 503
+            req = urllib.request.Request(
+                f"{gw.base_url}/api/generate",
+                data=json.dumps({"model": "phi", "prompt": "x",
+                                 "options": dict(GREEDY)}).encode(),
+                method="POST",
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=5)
+            assert ei.value.code == 503
+            assert int(ei.value.headers.get("Retry-After", "0")) >= 1
+            assert persist_env.exists()  # drain flushed the journal
+        finally:
+            gw.stop()
+
+    def test_retry_after_tracks_soonest_ejection_expiry(self, gw_env,
+                                                        replicas):
+        """Satellite 1: when every replica is mid-remediation the 503's
+        Retry-After is computed from the shortest remaining ejection
+        timer, not a flat guess."""
+        gw_env.setenv("TPU_GATEWAY_EJECT_S", "7")
+        for r in replicas:
+            r.ctl["down"] = True
+        gw = make_gateway(replicas)
+        with gw._lock:
+            for name in ("rep-0", "rep-1"):
+                rr = gw._replicas[name]
+                gw._fail_locked(rr, "failures", "x")
+                gw._fail_locked(rr, "failures", "x")
+        gw.start()
+        try:
+            req = urllib.request.Request(
+                f"{gw.base_url}/api/generate",
+                data=json.dumps({"model": "phi", "prompt": "y" * 80,
+                                 "options": dict(GREEDY)}).encode(),
+                method="POST",
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=5)
+            assert ei.value.code == 503
+            assert 6 <= int(ei.value.headers["Retry-After"]) <= 8
+        finally:
+            gw.stop()
+
+    def test_watchdog_ejects_wedged_replica_and_stream_fails_over(
+            self, gw_env, replicas):
+        """Satellite 3: a replica that wedges mid-stream trips the hedge
+        watchdog (stream fails over byte-identically within the bound)
+        and its slow scrapes get it ejected."""
+        gw_env.setenv("TPU_GATEWAY_HEDGE_MS", "800")
+        gw_env.setenv("TPU_GATEWAY_SLOW_SCRAPE_MS", "100")
+        gw_env.setenv("TPU_GATEWAY_EJECT_S", "60")
+        a, b = replicas
+        a.ctl["stall_after"] = 3
+        body = {"model": "phi", "prompt": "wd" * 100,
+                "options": dict(GREEDY), "stream": True}
+        replayed_before = metric("tpu_model_gateway_failovers_total",
+                                 '{result="replayed"}')
+        gw = make_gateway(replicas).start()
+        try:
+            t0 = time.monotonic()
+            frames = stream_frames(gw.base_url, "/api/generate", body,
+                                   timeout=30)
+            elapsed = time.monotonic() - t0
+            assert not any("error" in f for f in frames)
+            assert joined_text(frames) == expected_text(body)
+            assert metric("tpu_model_gateway_failovers_total",
+                          '{result="replayed"}') >= replayed_before + 1
+            assert elapsed < 15, f"watchdog bound blown: {elapsed:.1f}s"
+            a.stall.set()
+            # now the wedged replica also answers its health scrape
+            # slowly: two slow passes cross the ejection threshold
+            a.ctl["slow_ready_s"] = 0.4
+            gw.scrape_once()
+            gw.scrape_once()
+            st = json.loads(urllib.request.urlopen(
+                f"{gw.base_url}/gateway/status", timeout=5).read())
+            states = {r["name"]: r["state"] for r in st["replicas"]}
+            assert states["rep-0"] == "ejected"
+        finally:
+            a.stall.set()
+            gw.stop()

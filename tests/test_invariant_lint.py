@@ -17,6 +17,7 @@ from tools.invariant_lint.core import (render_github, render_json,
                                        render_summary_markdown, summarize)
 from tools.invariant_lint.passes import (DeterminismPass,
                                          ExceptionHygienePass,
+                                         FaultCatalogPass,
                                          FollowerPurityPass, HostSyncPass,
                                          KnobRegistryPass, LockOrderPass,
                                          MetricsDisciplinePass)
@@ -37,6 +38,7 @@ def fixture_config(case, **overrides):
         follower_module="pkg/follower.py",
         determinism_modules=("pkg/engine.py",),
         exception_scopes=("pkg",),
+        faults_module="pkg/faults.py",
     )
     defaults.update(overrides)
     return LintConfig(**defaults)
@@ -173,6 +175,35 @@ def test_exception_hygiene_fixture():
     assert None in reasons                                  # the reasonless one
 
 
+# -- fault-catalog ----------------------------------------------------------
+
+def test_fault_catalog_fixture():
+    fs = run_one("faults", FaultCatalogPass())
+    live = unsuppressed(fs)
+    msgs = [f.message for f in live]
+    assert len(live) == 4, msgs
+    assert sum('"fix.ghost" is checked here but not registered' in m
+               for m in msgs) == 1
+    assert sum("computed point name" in m for m in msgs) == 1
+    assert sum('"fix.stale" is registered but no' in m for m in msgs) == 1
+    assert sum('"fix.nodoc" is registered but missing from the docs/zh'
+               in m for m in msgs) == 1
+    # healthy point produced nothing; suppression carries its reason
+    assert not any("fix.ok" in m for m in msgs)
+    supp = [f for f in fs if f.suppressed]
+    assert len(supp) == 1
+    assert supp[0].suppress_reason == "fixture exercises suppression"
+    assert "fix.tolerated" in supp[0].message
+
+
+def test_fault_catalog_finding_anchors():
+    fs = unsuppressed(run_one("faults", FaultCatalogPass()))
+    ghost = [f for f in fs if "fix.ghost" in f.message][0]
+    assert ghost.path == "pkg/mod.py"
+    stale = [f for f in fs if "fix.stale" in f.message][0]
+    assert stale.path == "pkg/faults.py"
+
+
 # -- output formats ---------------------------------------------------------
 
 def test_json_schema_and_renderers():
@@ -196,7 +227,7 @@ def test_json_schema_and_renderers():
 
 def test_pass_ids_unique_and_kebab():
     ids = [p.id for p in ALL_PASSES]
-    assert len(ids) == len(set(ids)) == 7
+    assert len(ids) == len(set(ids)) == 8
     for pid in ids:
         assert pid == pid.lower() and " " not in pid
 
@@ -229,6 +260,18 @@ def test_every_tpu_knob_read_is_declared_and_documented():
     at all on the shipped tree)."""
     fs = run_passes(LintConfig(root=REPO), [KnobRegistryPass()])
     assert not fs, "\n".join(f.render() for f in fs)
+
+
+def test_every_fault_check_site_is_catalogued_and_documented():
+    """Acceptance: every FAULTS.check site in the shipped tree names a
+    registered catalog point, and both docs trees' fault-point tables
+    list every point — so the chaos campaign's `FAULTS.points()` draw
+    really covers every recovery path in the code."""
+    fs = run_passes(LintConfig(root=REPO), [FaultCatalogPass()])
+    assert not fs, "\n".join(f.render() for f in fs)
+    from ollama_operator_tpu.runtime.faults import CATALOG, FAULTS
+    assert [p.name for p in FAULTS.points()] == sorted(CATALOG)
+    assert len(CATALOG) >= 12
 
 
 def test_registry_importable_and_nonempty():

@@ -1,0 +1,13 @@
+"""Seeded randomized chaos campaigns against a real in-process fleet.
+
+``python -m tools.chaos_campaign --seed 7 --events 40`` builds the
+harness (tools/chaos_campaign/harness.py), runs one campaign through the
+generic engine (runtime/chaos.py), and exits non-zero with the seed and
+the minimal event prefix on any invariant violation. The CI
+``chaos-campaign`` job runs several seeds per push and appends each
+report to the step summary.
+"""
+
+from .harness import ChaosFleet, DeterministicReplica, expected_text
+
+__all__ = ["ChaosFleet", "DeterministicReplica", "expected_text"]

@@ -1,0 +1,71 @@
+"""CLI entry: one campaign per --seed, reports to stdout (and
+GITHUB_STEP_SUMMARY when set)."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.chaos_campaign",
+        description="seeded randomized chaos campaign against a real "
+                    "in-process fleet (runtime/chaos.py)")
+    ap.add_argument("--seed", type=int, action="append", required=True,
+                    help="campaign seed (repeatable: one campaign each)")
+    ap.add_argument("--events", type=int, default=40,
+                    help="events per campaign (default 40)")
+    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--engine-canary", action="store_true",
+                    help="ride a real tiny Engine+Scheduler along so the "
+                         "engine-family fault points fire (needs jax)")
+    ap.add_argument("--quiet", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.engine_canary:
+        # CPU determinism for the canary, same as the test tier
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from ollama_operator_tpu.runtime.chaos import (InvariantViolation,
+                                                   run_campaign)
+
+    from .harness import ChaosFleet
+
+    summary_path = os.environ.get("GITHUB_STEP_SUMMARY")
+    say = (lambda _m: None) if args.quiet else \
+        (lambda m: print(m, flush=True))
+    all_lines = []
+    for seed in args.seed:
+        with tempfile.TemporaryDirectory(prefix="chaos-") as td:
+            fleet = ChaosFleet(n_replicas=args.replicas, persist_dir=td,
+                               engine_canary=args.engine_canary)
+            try:
+                report = run_campaign(fleet, seed, args.events, log=say)
+            except InvariantViolation as e:
+                print(f"CHAOS CAMPAIGN FAILED\n{e}", file=sys.stderr,
+                      flush=True)
+                if summary_path:
+                    with open(summary_path, "a") as f:
+                        f.write(f"## chaos campaign seed {seed}: "
+                                f"FAILED\n```\n{e}\n```\n")
+                return 1
+            finally:
+                fleet.close()
+        lines = report.summary_lines()
+        lines.append(f"  - stream outcomes: {fleet.outcomes()}")
+        for ln in lines:
+            print(ln, flush=True)
+        all_lines.extend(lines)
+    if summary_path:
+        with open(summary_path, "a") as f:
+            f.write("## chaos campaigns\n"
+                    + "\n".join(f"- {ln.strip()}" for ln in all_lines)
+                    + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,650 @@
+"""The real chaos-campaign fleet (runtime/chaos.py supplies the engine).
+
+Builds everything the campaign schedule can break, in one process:
+
+- K deterministic HTTP replicas (text is a pure function of the request,
+  so byte-identity is checkable after any number of failovers/resumes);
+- one :class:`~ollama_operator_tpu.operator.gateway.Gateway` in front,
+  scraping them on a fast period, with crash-recovery persistence ON —
+  the ``kill_gateway`` action crashes it and boots a replacement from
+  the same journal;
+- a leader→follower control-plane pair (runtime/follower.py) pinged
+  every round, with a ``partition_leader`` action that goes silent and
+  asserts the follower fails static within TPU_CP_LEADER_TIMEOUT_S;
+- a stub kube apiserver polled through the real retrying KubeClient;
+- optionally a real tiny Engine + Scheduler canary (the same stack the
+  scheduler tests use) so the engine-family fault points
+  (engine.step/admit, pages.alloc, detok.feed, scheduler.replay) fire
+  against real page tables, with the page-accounting invariant checked
+  after every event.
+
+Global invariants (``check``): every finished client stream reached a
+terminal state exactly once — a typed error XOR a complete,
+byte-identical stream; robustness counters are monotonic; live page
+tables pass their accounting check. At quiesce (``check(final=True)``):
+the gateway journal has drained, the epoch quarantine is empty, and the
+thread census is back to within slack of the post-setup baseline.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import http.client
+import http.server
+import json
+import os
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+from ollama_operator_tpu.operator import client as kclient
+from ollama_operator_tpu.operator.gateway import Gateway
+from ollama_operator_tpu.runtime import follower as fol
+from ollama_operator_tpu.server.metrics import GLOBAL as METRICS
+
+# counters that must never decrease while a campaign runs
+MONOTONIC_COUNTERS = (
+    "tpu_model_gateway_persist_writes_total",
+    "tpu_model_gateway_persist_restores_total",
+    "tpu_model_gateway_drain_total",
+    "tpu_model_leader_lost_total",
+    "tpu_model_followers_lost_total",
+    "tpu_model_engine_restarts_total",
+)
+
+# small pool so the affinity/prefix paths actually get repeat prefixes
+_PROMPTS = ("tell me about pod %d", "summarize doc %d please",
+            "translate item %d", "why is replica %d slow")
+
+
+def gen_pieces(key: str, n: int) -> List[str]:
+    """Deterministic 'model': piece i is a pure function of the request
+    key and position — any replica, and any resumed splice, must
+    regenerate identical text."""
+    return [" " + hashlib.sha256(f"{key}|{i}".encode()).hexdigest()[:4]
+            for i in range(n)]
+
+
+def request_key(body: Dict[str, Any]) -> str:
+    prompt = (body.get("system") or "") + (body.get("prompt") or "")
+    o = body.get("options") or {}
+    if float(o.get("temperature", 0.7)) == 0.0:
+        return f"greedy|{prompt}"
+    return f"sampled|{prompt}|seed={o.get('seed')}"
+
+
+def expected_text(body: Dict[str, Any]) -> str:
+    o = body.get("options") or {}
+    return "".join(gen_pieces(request_key(body),
+                              int(o.get("num_predict", 8))))
+
+
+class DeterministicReplica:
+    """One fake backend. ``ctl['down']`` = socket-level death;
+    ``ctl['die_after']`` severs the next stream after N frames and then
+    stays down (death mid-stream, the failover trigger)."""
+
+    def __init__(self) -> None:
+        self.ctl: Dict[str, Any] = {"down": False, "die_after": None}
+        self._lock = threading.Lock()
+        self.seen: List[str] = []
+        replica = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *_a):
+                pass
+
+            def _down(self) -> bool:
+                if replica.ctl["down"]:
+                    self.close_connection = True
+                    self.connection.close()
+                    return True
+                return False
+
+            def _json(self, obj, status=200):
+                data = json.dumps(obj).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                if self._down():
+                    return
+                if self.path == "/readyz":
+                    self._json({"status": "ok"})
+                elif self.path == "/api/ps":
+                    self._json({"models": [{
+                        "name": "chaos", "utilization": {"occupancy": 0.1},
+                        "lifecycle": {"state": "serving",
+                                      "active_streams": 0},
+                        "admission": {"queued_by_class": {}},
+                    }]})
+                else:
+                    self._json({"error": "not found"}, 404)
+
+            def do_POST(self):
+                if self._down():
+                    return
+                n = int(self.headers.get("Content-Length") or 0)
+                body = json.loads(self.rfile.read(n)) if n else {}
+                if self.path == "/api/prefix_probe":
+                    prompt = ((body.get("system") or "")
+                              + (body.get("prompt") or ""))
+                    best = 0
+                    with replica._lock:
+                        for s in replica.seen:
+                            k = 0
+                            for a, b in zip(s, prompt):
+                                if a != b:
+                                    break
+                                k += 1
+                            best = max(best, k)
+                    self._json({"model": body.get("model"),
+                                "matched_tokens": best // 4,
+                                "prompt_tokens": len(prompt) // 4})
+                elif self.path in ("/api/generate", "/api/chat"):
+                    self._generate(body)
+                else:
+                    self._json({"ok": True})
+
+            def _chunk(self, data: bytes):
+                self.wfile.write(f"{len(data):x}\r\n".encode() + data
+                                 + b"\r\n")
+                self.wfile.flush()
+
+            def _generate(self, body):
+                prompt = ((body.get("system") or "")
+                          + (body.get("prompt") or ""))
+                o = body.get("options") or {}
+                n = int(o.get("num_predict", 8))
+                pieces = gen_pieces(request_key(body), n)
+                with replica._lock:
+                    replica.seen.append(prompt)
+                    die_after = replica.ctl["die_after"]
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                for i, piece in enumerate(pieces):
+                    if die_after is not None and i >= die_after:
+                        replica.ctl["die_after"] = None
+                        replica.ctl["down"] = True
+                        self.close_connection = True
+                        self.connection.close()
+                        return
+                    self._chunk(json.dumps(
+                        {"model": body.get("model"), "response": piece,
+                         "done": False}).encode() + b"\n")
+                self._chunk(json.dumps(
+                    {"model": body.get("model"), "response": "",
+                     "done": True, "done_reason": "stop",
+                     "eval_count": n}).encode() + b"\n")
+                self.wfile.write(b"0\r\n\r\n")
+                self.wfile.flush()
+
+        self.httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+                                                     Handler)
+        self.httpd.daemon_threads = True
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+class _StubKube:
+    """Minimal apiserver: answers every GET with one Pod object (the
+    real retrying KubeClient in front of it is what the kube.request
+    fault point exercises)."""
+
+    def __init__(self) -> None:
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *_a):
+                pass
+
+            def do_GET(self):
+                data = json.dumps({
+                    "apiVersion": "v1", "kind": "Pod",
+                    "metadata": {"name": "chaos-0",
+                                 "namespace": "default"}}).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self.httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+                                                     Handler)
+        self.httpd.daemon_threads = True
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+        host, port = self.httpd.server_address
+        self.url = f"http://{host}:{port}"
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+class _Client(threading.Thread):
+    """One end-to-end stream with the reconnect contract a real client
+    follows: transport failures (gateway crash mid-stream) retry with
+    the SAME request_id against the current gateway; typed HTTP errors
+    and in-stream error frames are terminal."""
+
+    ATTEMPTS = 8
+
+    def __init__(self, fleet: "ChaosFleet", body: Dict[str, Any]):
+        super().__init__(daemon=True, name="chaos-client")
+        self.fleet = fleet
+        self.body = body
+        self.expected = expected_text(body)
+        self.outcome: Optional[str] = None   # ok | error | shed | lost
+        self.detail = ""
+        self.terminals = 0
+
+    def _stream_once(self) -> Optional[str]:
+        """One attempt; returns an outcome or None (retry)."""
+        req = urllib.request.Request(
+            f"{self.fleet.base_url}/api/generate",
+            data=json.dumps(self.body).encode(), method="POST",
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=20) as resp:
+                raw = resp.read().decode()
+        except urllib.error.HTTPError as e:
+            # a typed HTTP error is a clean terminal answer; drain and
+            # all-ejected shed must carry Retry-After >= 1 (the computed
+            # remediation hint) — its absence is an invariant violation
+            if e.code in (429, 503):
+                # a shed is NOT a stream terminal: the gateway never
+                # committed a stream, it told the client to come back
+                ra = e.headers.get("Retry-After")
+                try:
+                    ok_hint = ra is not None and int(ra) >= 1
+                except ValueError:
+                    ok_hint = False
+                if not ok_hint:
+                    self.detail = f"503 without usable Retry-After: {ra!r}"
+                    return "lost"
+                return "shed"
+            self.terminals += 1
+            self.detail = f"http {e.code}"
+            return "error"
+        except (urllib.error.URLError, http.client.HTTPException,
+                ConnectionError, socket.timeout, OSError):
+            return None                       # transport: reconnect
+        frames = [json.loads(ln) for ln in raw.splitlines() if ln.strip()]
+        errs = [f for f in frames if f.get("error")]
+        dones = [f for f in frames if f.get("done")]
+        if errs:
+            self.terminals += 1
+            if dones:
+                self.detail = "error frame AND done frame in one stream"
+                return "lost"
+            self.detail = str(errs[0].get("error"))[:200]
+            return "error"
+        if not dones:
+            return None                       # truncated: reconnect
+        self.terminals += 1
+        text = "".join(f.get("response") or "" for f in frames)
+        if text != self.expected:
+            self.detail = (f"byte mismatch: got {text!r} "
+                           f"expected {self.expected!r}")
+            return "lost"
+        return "ok"
+
+    def run(self) -> None:
+        sheds = 0
+        try:
+            for _ in range(self.ATTEMPTS):
+                out = self._stream_once()
+                if out == "shed":
+                    sheds += 1
+                    time.sleep(0.1)       # honor the hint, scaled down
+                    continue
+                if out is not None:
+                    self.outcome = out
+                    return
+                time.sleep(0.1)
+            # never got a terminal: sheds all the way down is a clean
+            # typed answer each time; transport losses are not
+            self.outcome = "shed-exhausted" if sheds else "lost"
+        except Exception as e:  # noqa: BLE001 — a client crash IS a violation
+            self.detail = f"client crashed: {type(e).__name__}: {e}"
+            self.outcome = "lost"
+
+
+class ChaosFleet:
+    """Harness for :func:`ollama_operator_tpu.runtime.chaos.run_campaign`
+    — see the protocol in runtime/chaos.py."""
+
+    def __init__(self, n_replicas: int = 3, persist_dir: str = ".",
+                 engine_canary: bool = False):
+        self._env_prev: Dict[str, Optional[str]] = {}
+        self._set_env({
+            "TPU_GATEWAY_EJECT_FAILURES": "2",
+            "TPU_GATEWAY_EJECT_S": "0.3",
+            "TPU_GATEWAY_SLOW_SCRAPE_MS": "400",
+            "TPU_GATEWAY_PERSIST": os.path.join(
+                persist_dir, "chaos-gateway-journal.ndjson"),
+            "TPU_GATEWAY_PERSIST_FLUSH_MS": "5",
+            "TPU_CP_LEADER_TIMEOUT_S": "0.4",
+            "TPU_CP_SEND_TIMEOUT_S": "5",
+            "TPU_DRAIN_TIMEOUT_S": "5",
+        })
+        self.replicas = [DeterministicReplica() for _ in range(n_replicas)]
+        self._gw_lock = threading.Lock()
+        self.gw = self._boot_gateway()
+        self.kube = _StubKube()
+        self.kc = kclient.KubeClient(self.kube.url, timeout=5)
+        self._cp: Optional[fol.ControlPlane] = None
+        self._fol_thread: Optional[threading.Thread] = None
+        self._boot_control_plane()
+        self.canary = None
+        if engine_canary:
+            self.canary = _EngineCanary()
+        self.ledger: List[_Client] = []
+        self._pending: List[_Client] = []
+        self._counter_floor = {n: METRICS.get(n)
+                               for n in MONOTONIC_COUNTERS}
+        self._seq = 0
+        # thread census AFTER full setup: the final check asserts we
+        # return to within slack of this, so nothing the campaign spawns
+        # (pumps, clients, replacement gateways) may leak
+        self._thread_floor = threading.active_count()
+
+    # -- plumbing --------------------------------------------------------
+
+    def _set_env(self, kv: Dict[str, str]) -> None:
+        for k, v in kv.items():
+            self._env_prev.setdefault(k, os.environ.get(k))
+            os.environ[k] = v
+
+    def _boot_gateway(self) -> Gateway:
+        gw = Gateway(replicas=[(f"rep-{i}", r.url)
+                               for i, r in enumerate(self.replicas)],
+                     scrape_period_s=0.1, port=0)
+        return gw.start()
+
+    def _boot_control_plane(self) -> None:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        self._cp = fol.ControlPlane(1, port, bind="127.0.0.1",
+                                    heartbeat_s=0)
+        t = threading.Thread(
+            target=fol.run_follower, args=(None, "127.0.0.1", port),
+            daemon=True, name="chaos-follower")
+        t.start()
+        self._fol_thread = t
+
+    @property
+    def base_url(self) -> str:
+        with self._gw_lock:
+            return self.gw.base_url
+
+    # -- chaos actions (beyond what FAULTS can express) ------------------
+
+    @property
+    def actions(self) -> Dict[str, Any]:
+        return {
+            "kill_replica": self.kill_replica,
+            "revive_replica": self.revive_replica,
+            "die_mid_stream": self.die_mid_stream,
+            "kill_gateway": self.kill_gateway,
+            "partition_leader": self.partition_leader,
+        }
+
+    def kill_replica(self, rng) -> None:
+        r = rng.choice(self.replicas)
+        r.ctl["down"] = True
+
+    def revive_replica(self, rng) -> None:
+        down = [r for r in self.replicas if r.ctl["down"]]
+        if down:
+            r = rng.choice(down)
+            r.ctl["down"] = False
+            r.ctl["die_after"] = None
+
+    def die_mid_stream(self, rng) -> None:
+        live = [r for r in self.replicas if not r.ctl["down"]]
+        if live:
+            rng.choice(live).ctl["die_after"] = rng.randint(1, 4)
+
+    def kill_gateway(self, rng) -> None:
+        """Crash (no drain — stop() only flushes what the window already
+        buffered) and boot a replacement from the same persist log. Any
+        client mid-stream reconnects with its request_id and must get a
+        byte-identical splice or one clean error frame."""
+        with self._gw_lock:
+            old = self.gw
+            old.stop()
+            self.gw = self._boot_gateway()
+
+    def partition_leader(self, rng) -> None:
+        """Leader goes silent (no close — the socket stays open): the
+        follower must fail static within TPU_CP_LEADER_TIMEOUT_S, then a
+        fresh pair joins (the restarted pod rejoining the next world)."""
+        t = self._fol_thread
+        assert t is not None
+        t.join(timeout=5.0)
+        assert not t.is_alive(), (
+            "follower still blocked on a silent leader after the "
+            "TPU_CP_LEADER_TIMEOUT_S watchdog window")
+        if self._cp is not None:
+            self._cp.close()
+        self._boot_control_plane()
+
+    # -- campaign protocol ----------------------------------------------
+
+    def traffic(self, rng) -> None:
+        # reap finished clients into the ledger
+        still = []
+        for c in self._pending:
+            (still if c.outcome is None else self.ledger).append(c)
+        self._pending = still
+        for _ in range(rng.randint(1, 3)):
+            self._seq += 1
+            kind = rng.choice(("greedy", "seeded", "sampled"))
+            opts: Dict[str, Any] = {"num_predict": rng.randint(4, 10)}
+            if kind == "greedy":
+                opts["temperature"] = 0
+            else:
+                opts["temperature"] = 0.9
+                if kind == "seeded":
+                    opts["seed"] = rng.randint(1, 1 << 20)
+            body = {"model": "chaos",
+                    "prompt": rng.choice(_PROMPTS) % rng.randint(0, 3),
+                    "stream": True, "options": opts,
+                    "request_id": f"chaos-{self._seq}"}
+            c = _Client(self, body)
+            c.start()
+            self._pending.append(c)
+        # control-plane leg: one broadcast (follower.send fires here); a
+        # lost follower degrades the world → model the pod restart
+        cp = self._cp
+        if cp is not None:
+            try:
+                cp.broadcast(("ping",))
+            except fol.FollowerLost:
+                cp.close()
+                if self._fol_thread is not None:
+                    self._fol_thread.join(timeout=5.0)
+                self._boot_control_plane()
+        # operator leg: one reconciler-style read through the retrying
+        # client (kube.request fires inside); an exhausted retry budget
+        # is what the reconcile loop would just retry next pass
+        try:
+            self.kc.get("v1", "Pod", "default", "chaos-0")
+        except kclient.ApiError:
+            pass  # lint: allow(exception-hygiene): retry budget exhausted — next reconcile pass retries
+        if self.canary is not None:
+            self.canary.traffic(rng)
+
+    def check(self, final: bool = False) -> None:
+        for c in list(self.ledger):
+            assert c.terminals <= 1 and c.outcome != "lost", (
+                f"stream {c.body['request_id']} violated "
+                f"exactly-once-terminal: outcome={c.outcome} "
+                f"terminals={c.terminals} {c.detail}")
+        for name in MONOTONIC_COUNTERS:
+            now = METRICS.get(name)
+            assert now >= self._counter_floor[name], (
+                f"{name} went backwards: {self._counter_floor[name]} "
+                f"-> {now}")
+            self._counter_floor[name] = now
+        from ollama_operator_tpu.runtime.paged import live_tables
+        for pt in live_tables():
+            pt.check()
+        if not final:
+            return
+        # quiesce-only invariants
+        for c in self.ledger:
+            assert c.outcome in ("ok", "error", "shed", "shed-exhausted"), (
+                f"stream {c.body['request_id']} never reached a terminal "
+                f"state: {c.outcome} {c.detail}")
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if self.gw.journal_stats()["live"] == 0:
+                break
+            time.sleep(0.05)
+        assert self.gw.journal_stats()["live"] == 0, (
+            f"gateway journal not drained at quiesce: "
+            f"{self.gw.journal_stats()}")
+        for pt in live_tables():
+            assert pt.quarantined == 0, (
+                f"{pt.quarantined} page(s) stuck in epoch quarantine "
+                f"at quiesce")
+        # thread census: transient pumps/clients must have exited (old
+        # gateways' scrape threads need a tick to observe _stop)
+        slack = 6
+        while time.monotonic() < deadline:
+            if threading.active_count() <= self._thread_floor + slack:
+                break
+            time.sleep(0.05)
+        assert threading.active_count() <= self._thread_floor + slack, (
+            f"thread leak: {threading.active_count()} live vs baseline "
+            f"{self._thread_floor} (+{slack} slack): "
+            f"{sorted(t.name for t in threading.enumerate())}")
+
+    def quiesce(self) -> None:
+        for r in self.replicas:
+            r.ctl["down"] = False
+            r.ctl["die_after"] = None
+        for c in self._pending:
+            c.join(timeout=30)
+            # outcome None after the join = a hung stream; the final
+            # check's allowed-outcome assert reports it as a violation
+            self.ledger.append(c)
+        self._pending = []
+        if self.canary is not None:
+            self.canary.quiesce()
+
+    def close(self) -> None:
+        with self._gw_lock:
+            self.gw.stop()
+        if self._cp is not None:
+            self._cp.close()
+        if self._fol_thread is not None:
+            self._fol_thread.join(timeout=5.0)
+        for r in self.replicas:
+            r.stop()
+        self.kube.stop()
+        if self.canary is not None:
+            self.canary.close()
+        for k, v in self._env_prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    # -- report helpers --------------------------------------------------
+
+    def outcomes(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for c in self.ledger:
+            out[c.outcome or "in-flight"] = out.get(c.outcome or
+                                                    "in-flight", 0) + 1
+        return out
+
+
+class _EngineCanary:
+    """A real tiny Engine + Scheduler riding along so the engine-family
+    fault points fire against real page tables. A restart-budget
+    exhaustion (scheduler broken) models the pod restart: rebuild."""
+
+    def __init__(self) -> None:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ollama_operator_tpu.models import config as cfglib
+        from ollama_operator_tpu.models import decoder
+        from ollama_operator_tpu.runtime.engine import (Engine, EngineConfig,
+                                                        SlotOptions)
+        from ollama_operator_tpu.runtime.scheduler import Scheduler
+        self._np = np
+        self._greedy = SlotOptions(temperature=0.0, repeat_penalty=1.0)
+        cfg = cfglib.PRESETS["tiny"]
+        params = decoder.init_params(cfg, jax.random.PRNGKey(0),
+                                     dtype=jnp.float32)
+
+        def build():
+            eng = Engine(cfg, params,
+                         ecfg=EngineConfig(max_slots=2, max_seq_len=64,
+                                           cache_dtype=jnp.float32,
+                                           min_prefill_bucket=16))
+            return Scheduler(eng, restart_backoff=0.01)
+
+        self._build = build
+        self.sched = build()
+        self.rebuilds = 0
+        # prewarm: take the XLA compiles now so the first campaign round
+        # isn't a seconds-long stall that desyncs every timing knob
+        r = self.sched.submit(np.array([1, 2], np.int32), self._greedy,
+                              max_tokens=2)
+        list(r.tokens())
+
+    def traffic(self, rng) -> None:
+        if self.sched.broken:
+            self.sched.shutdown()
+            self.sched = self._build()
+            self.rebuilds += 1
+        toks = self._np.array(
+            [rng.randint(1, 200) for _ in range(rng.randint(2, 6))],
+            self._np.int32)
+        try:
+            r = self.sched.submit(toks, self._greedy,
+                                  max_tokens=rng.randint(2, 5))
+            list(r.tokens())
+        except RuntimeError:
+            pass  # lint: allow(exception-hygiene): injected per-request error — the recovery path under test
+        except Exception as e:  # noqa: BLE001
+            raise AssertionError(
+                f"engine canary saw an untyped failure: "
+                f"{type(e).__name__}: {e}") from e
+
+    def quiesce(self) -> None:
+        if self.sched.broken:
+            self.sched.shutdown()
+            self.sched = self._build()
+            self.rebuilds += 1
+        r = self.sched.submit(self._np.array([7, 8], self._np.int32),
+                              self._greedy, max_tokens=2)
+        assert len(list(r.tokens())) == 2, \
+            "engine canary cannot serve after quiesce"
+
+    def close(self) -> None:
+        self.sched.shutdown()

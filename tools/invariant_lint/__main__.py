@@ -19,7 +19,7 @@ from .passes import ALL_PASSES
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="invariant_lint",
-        description="Project invariant linter (7 AST passes; see "
+        description="Project invariant linter (8 AST passes; see "
                     "CONTRIBUTING.md 'Invariant linter')")
     ap.add_argument("--root", default=None,
                     help="repo root (default: two levels above this "

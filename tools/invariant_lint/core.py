@@ -109,6 +109,10 @@ class LintConfig:
     # metric registry module holding describe() + pre-seed calls
     metrics_module: str = "ollama_operator_tpu/server/metrics.py"
     metric_prefix: str = "tpu_model_"
+    # fault-point catalog module: every FAULTS.check() site must name a
+    # point registered here, and the docs fault-point tables must list
+    # every registered point
+    faults_module: str = "ollama_operator_tpu/runtime/faults.py"
     # host-sync pass: (module rel path, function/method name) roots of
     # the dispatch-critical call graph, and names at which traversal
     # stops (sanctioned materialisation points: DecodeHandle.wait is THE
@@ -171,8 +175,10 @@ class Project:
                 if "__pycache__" in rel:
                     continue
                 rels.append(rel)
-        # the knob registry may live outside code_roots (fixture trees)
-        for extra in (self.config.knobs_module, self.config.metrics_module):
+        # the knob/metric/fault registries may live outside code_roots
+        # (fixture trees)
+        for extra in (self.config.knobs_module, self.config.metrics_module,
+                      self.config.faults_module):
             p = self.config.root / extra
             if p.is_file() and extra not in rels:
                 rels.append(extra)

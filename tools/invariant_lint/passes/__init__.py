@@ -6,6 +6,7 @@ of the definition of done (see CONTRIBUTING.md)."""
 
 from .determinism import DeterminismPass
 from .exception_hygiene import ExceptionHygienePass
+from .fault_catalog import FaultCatalogPass
 from .follower_purity import FollowerPurityPass
 from .host_sync import HostSyncPass
 from .knob_registry import KnobRegistryPass
@@ -15,6 +16,7 @@ from .metrics_discipline import MetricsDisciplinePass
 ALL_PASSES = [
     KnobRegistryPass(),
     MetricsDisciplinePass(),
+    FaultCatalogPass(),
     HostSyncPass(),
     LockOrderPass(),
     FollowerPurityPass(),
@@ -23,5 +25,6 @@ ALL_PASSES = [
 ]
 
 __all__ = ["ALL_PASSES", "KnobRegistryPass", "MetricsDisciplinePass",
-           "HostSyncPass", "LockOrderPass", "FollowerPurityPass",
-           "DeterminismPass", "ExceptionHygienePass"]
+           "FaultCatalogPass", "HostSyncPass", "LockOrderPass",
+           "FollowerPurityPass", "DeterminismPass",
+           "ExceptionHygienePass"]

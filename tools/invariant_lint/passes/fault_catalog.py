@@ -1,0 +1,144 @@
+"""fault-catalog: every FAULTS.check site catalogued and documented.
+
+``runtime/faults.py`` keeps an introspectable catalog of fault points —
+module-level ``point("name", "site", "doc")`` registrations — which is
+what makes a randomized chaos campaign (runtime/chaos.py) possible: the
+schedule is drawn from ``FAULTS.points()``, so a check site missing from
+the catalog is a recovery path chaos can never reach. This pass
+cross-checks four surfaces:
+
+- **uncatalogued check** — code calls ``FAULTS.check("x")`` with a point
+  name the catalog does not register;
+- **non-literal check** — a ``FAULTS.check`` site whose point name is
+  computed: the catalog (and the chaos scheduler behind it) can only
+  enumerate literals;
+- **stale catalog entry** — a registered point with no ``FAULTS.check``
+  site left in the tree (the campaign would arm it forever for nothing);
+- **undocumented point** — a registered point absent from a docs tree's
+  fault-point tables (docs/en AND docs/zh-CN must both list every
+  point, same contract as the knob tables).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from ..core import Finding, Pass, Project
+
+
+class FaultCatalogPass(Pass):
+    id = "fault-catalog"
+    summary = ("FAULTS.check sites registered in the fault-point catalog "
+               "and listed in both docs fault-point tables")
+
+    def run(self, project: Project) -> List[Finding]:
+        cfg = project.config
+        findings: List[Finding] = []
+
+        catalog = self._catalog(project)
+        if not catalog:
+            findings.append(Finding(
+                cfg.faults_module, 1, self.id,
+                "fault-point catalog is missing or registers nothing — "
+                "module-level point(name, site, doc) calls expected"))
+
+        checked: Set[str] = set()
+        for rel, src in project.sources.items():
+            if rel == cfg.faults_module:
+                continue
+            for node in ast.walk(src.tree):
+                name, line, literal = self._check_site(node)
+                if line is None:
+                    continue
+                if not literal:
+                    findings.append(Finding(
+                        rel, line, self.id,
+                        "FAULTS.check with a computed point name — the "
+                        "catalog can only enumerate literal points; "
+                        "inline the name"))
+                    continue
+                checked.add(name)
+                if catalog and name not in catalog:
+                    findings.append(Finding(
+                        rel, line, self.id,
+                        f"fault point \"{name}\" is checked here but not "
+                        f"registered in {cfg.faults_module} — add a "
+                        f"point(\"{name}\", site, doc) entry"))
+
+        for name, line in sorted(catalog.items()):
+            if name not in checked:
+                findings.append(Finding(
+                    cfg.faults_module, line, self.id,
+                    f"fault point \"{name}\" is registered but no "
+                    f"FAULTS.check(\"{name}\") site remains — remove the "
+                    f"stale catalog entry"))
+
+        for root, mentioned in self._docs_mentions(project,
+                                                   catalog).items():
+            for name, line in sorted(catalog.items()):
+                if name not in mentioned:
+                    findings.append(Finding(
+                        cfg.faults_module, line, self.id,
+                        f"fault point \"{name}\" is registered but "
+                        f"missing from the {root} fault-point tables"))
+        return findings
+
+    # -- catalog ---------------------------------------------------------
+
+    def _catalog(self, project: Project) -> Dict[str, int]:
+        src = project.source(project.config.faults_module)
+        if src is None:
+            return {}
+        out: Dict[str, int] = {}
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            name = f.id if isinstance(f, ast.Name) else (
+                f.attr if isinstance(f, ast.Attribute) else None)
+            if name != "point" or not node.args:
+                continue
+            first = node.args[0]
+            if isinstance(first, ast.Constant) and isinstance(first.value,
+                                                              str):
+                out[first.value] = node.lineno
+        return out
+
+    # -- check sites -----------------------------------------------------
+
+    @staticmethod
+    def _check_site(node: ast.AST) -> Tuple[str, int, bool]:
+        """(point name, line, is_literal) for a FAULTS.check call, else
+        ("", None, False)."""
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "check"
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "FAULTS"
+                and node.args):
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                return arg.value, node.lineno, True
+            return "", node.lineno, False
+        return "", None, False
+
+    # -- docs ------------------------------------------------------------
+
+    def _docs_mentions(self, project: Project,
+                       catalog: Dict[str, int]) -> Dict[str, Set[str]]:
+        out: Dict[str, Set[str]] = {}
+        for root in project.config.docs_roots:
+            base = project.config.root / root
+            mentioned: Set[str] = set()
+            if base.is_dir():
+                for md in sorted(base.rglob("*.md")):
+                    try:
+                        text = md.read_text(encoding="utf-8")
+                    except UnicodeDecodeError:
+                        continue
+                    for name in catalog:
+                        if name in text:
+                            mentioned.add(name)
+            out[root] = mentioned
+        return out
