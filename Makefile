@@ -48,7 +48,8 @@ bench:  ## headline decode-throughput benchmark (one JSON line)
 # tiny smoke programs recompile in seconds anyway
 bench-smoke:  ## seconds-scale CPU bench: engine + HTTP + mixed + prefix + spec + overload + restart + coldstart + fused-paged arms
 	JAX_PLATFORMS=cpu BENCH_CHILD=1 BENCH_HTTP=1 BENCH_MIXED_ARM=1 \
-	  BENCH_PREFIX_ARM=1 BENCH_PAGED_ASYNC_ARM=1 BENCH_PAGED_FUSED_ARM=1 \
+	  BENCH_PREFIX_ARM=1 BENCH_TIER_ARMS=1 \
+	  BENCH_PAGED_ASYNC_ARM=1 BENCH_PAGED_FUSED_ARM=1 \
 	  BENCH_SPEC_ARM=1 \
 	  BENCH_OVERLOAD_ARM=1 BENCH_RESTART_ARM=1 BENCH_COLDSTART_ARM=1 \
 	  BENCH_ASSERT_COLDSTART=1 BENCH_XLA_CACHE=0 \

@@ -1486,6 +1486,201 @@ def measure_prefix(jax, *, model: str, dtype: str, slots: int, steps: int,
     # third arm (ISSUE 5): cache on, synchronous dispatch — isolates the
     # epoch-fenced double-buffering win on the radix-hit serving shape
     sync = run_arm(True, overlap=False)
+
+    # tiered-KV arms: a churn shape whose radix working set overflows the
+    # HBM pool (revisits only survive via tier-1 host spill/restitch) and
+    # a fleet shape that round-trips a tier-2 prefix snapshot into a
+    # fresh engine. Separate record keys — the legacy three-arm shape
+    # (cache_on/cache_off/cache_on_sync) stays pinned for dashboards.
+    tier_arms = os.environ.get("BENCH_TIER_ARMS", "1") != "0"
+    churn_on = churn_off = fleet = None
+    if tier_arms:
+        c_pages = 4                       # pages per churn prefix
+        c_prefix_len = c_pages * ps
+        m_prefixes = 4
+        c_rounds = 3
+        c_tail = max(4, min(8, tail_len))
+        c_gen = max(2, min(4, gen_tokens))
+        churn_prefixes = [rng.integers(1, cfg.vocab_size, size=c_prefix_len,
+                                       endpoint=False).astype(np.int32)
+                          for _ in range(m_prefixes)]
+        # single slot; pool retains ~1.5 prefixes of radix residency
+        # beyond the slot's serving need, so the m-prefix working set
+        # (m * c_pages pages) cannot fit — round-robin revisits always
+        # land on the LRU (most evicted) prefix, the maximal-churn shape
+        c_need = -(-(c_prefix_len + c_tail + c_gen) // ps) + 2
+        c_pool = c_need + c_pages + 2
+
+        def _tier_tokens(name):
+            return sum(METRICS.get(name, f'{{tier="{t}"}}')
+                       for t in ("0", "1", "2"))
+
+        def _with_tiering(host_gb: str):
+            saved = {k: os.environ.get(k)
+                     for k in ("TPU_HOST_CACHE_GB",
+                               "TPU_HOST_CACHE_BREAK_EVEN")}
+            os.environ["TPU_HOST_CACHE_GB"] = host_gb
+            # flat 1-token floor: restitch whenever there is anything
+            # to restitch — keeps the arms deterministic across
+            # backends (the FLOPs break-even is platform-dependent)
+            os.environ["TPU_HOST_CACHE_BREAK_EVEN"] = "1"
+            return saved
+
+        def _restore_env(saved):
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+        def run_churn(host_gb: str) -> dict:
+            saved = _with_tiering(host_gb)
+            try:
+                eng = Engine(cfg, params,
+                             ecfg=EngineConfig(max_slots=1, max_seq_len=seq,
+                                               decode_chunk=chunk_eff,
+                                               cache_dtype=kv_dtype,
+                                               paged=True, page_size=ps,
+                                               n_pages=c_pool,
+                                               min_prefill_bucket=16))
+            finally:
+                _restore_env(saved)
+            eng.warm_buckets()
+            sched = Scheduler(eng)
+            try:
+                hit0 = _tier_tokens("tpu_model_tier_hit_tokens_total")
+                miss0 = _tier_tokens("tpu_model_tier_miss_tokens_total")
+                sp0 = METRICS.get("tpu_model_spilled_pages_total")
+                fb0 = METRICS.get("tpu_model_async_fallback_total")
+                ttfts, errors = [], []
+                for rnd in range(c_rounds):
+                    for pfx in churn_prefixes:
+                        tail = rng.integers(1, cfg.vocab_size, size=c_tail,
+                                            endpoint=False).astype(np.int32)
+                        r = sched.submit(list(pfx) + list(tail), greedy,
+                                         max_tokens=c_gen)
+                        try:
+                            for _ in r.chunks():
+                                pass
+                            if rnd:       # revisit rounds only
+                                ttfts.append(r.stats.ttft_s)
+                        except Exception as e:  # noqa: BLE001
+                            errors.append(f"{type(e).__name__}: {e}")
+                        # retire in-flight epochs so the NEXT admission's
+                        # LRU eviction sees a quiescent pool and can
+                        # spill instead of plainly freeing
+                        try:
+                            eng.fence_quiesce()
+                        except Exception:  # noqa: BLE001
+                            pass
+                hits = (_tier_tokens("tpu_model_tier_hit_tokens_total")
+                        - hit0)
+                misses = (_tier_tokens("tpu_model_tier_miss_tokens_total")
+                          - miss0)
+                return {
+                    "host_gb": host_gb,
+                    "hit_tokens": int(hits),
+                    "miss_tokens": int(misses),
+                    "hit_rate": (round(hits / (hits + misses), 3)
+                                 if hits + misses else None),
+                    "spilled_pages": int(METRICS.get(
+                        "tpu_model_spilled_pages_total") - sp0),
+                    "host_pages": int(getattr(eng, "host_cache_pages", 0)),
+                    "ttft_p50_ms": (round(
+                        float(np.percentile(ttfts, 50)) * 1e3, 1)
+                        if ttfts else None),
+                    "async_fallbacks": int(METRICS.get(
+                        "tpu_model_async_fallback_total") - fb0),
+                    "errors": errors or None,
+                }
+            finally:
+                sched.shutdown()
+                for s in range(eng.n_slots):
+                    try:
+                        eng.release(s)
+                    except Exception:
+                        pass
+                del eng
+                gc.collect()
+
+        def run_fleet() -> dict:
+            import tempfile
+
+            from ollama_operator_tpu.gguf import store as gstore
+            saved = _with_tiering("0.5")
+            f_ecfg = EngineConfig(max_slots=1, max_seq_len=seq,
+                                  decode_chunk=chunk_eff,
+                                  cache_dtype=kv_dtype, paged=True,
+                                  page_size=ps, n_pages=c_pool + 4,
+                                  min_prefill_bucket=16)
+            fprefix = rng.integers(1, cfg.vocab_size, size=c_prefix_len,
+                                   endpoint=False).astype(np.int32)
+            out = {"imported_pages": 0, "first_reused_tokens": 0,
+                   "tier2_hit_tokens": 0, "warm_first_hit": False}
+
+            def serve_one(eng, sched):
+                tail = rng.integers(1, cfg.vocab_size, size=c_tail,
+                                    endpoint=False).astype(np.int32)
+                r = sched.submit(list(fprefix) + list(tail), greedy,
+                                 max_tokens=c_gen)
+                for _ in r.chunks():
+                    pass
+                # the prefix is donated into the radix by the scheduler
+                # thread just after the stream ends — wait it out
+                for _ in range(200):
+                    if sched.n_active == 0 and eng.radix_nodes > 0:
+                        break
+                    time.sleep(0.01)
+                return int(getattr(r.stats, "n_reused", 0))
+
+            try:
+                # replica A serves the shared prefix, then "drains": its
+                # hottest prefixes round-trip through the shared volume
+                engA = Engine(cfg, params, ecfg=f_ecfg)
+                engA.warm_buckets()
+                schedA = Scheduler(engA)
+                try:
+                    serve_one(engA, schedA)
+                    blob = engA.export_prefixes()
+                finally:
+                    schedA.shutdown()
+                    del engA
+                    gc.collect()
+                if blob is None:
+                    out["error"] = "export produced no snapshot"
+                    return out
+                with tempfile.TemporaryDirectory() as td:
+                    gstore.save_prefix_snapshot(td, "bench", blob)
+                    blob = gstore.load_prefix_snapshot(td, "bench")
+                # replica B wakes cold, imports the fleet snapshot, and
+                # must answer its FIRST shared-prefix request warm
+                engB = Engine(cfg, params, ecfg=f_ecfg)
+                out["imported_pages"] = int(engB.import_prefixes(blob))
+                engB.warm_buckets()
+                schedB = Scheduler(engB)
+                try:
+                    t2_0 = METRICS.get("tpu_model_tier_hit_tokens_total",
+                                       '{tier="2"}')
+                    out["first_reused_tokens"] = serve_one(engB, schedB)
+                    out["tier2_hit_tokens"] = int(METRICS.get(
+                        "tpu_model_tier_hit_tokens_total", '{tier="2"}')
+                        - t2_0)
+                finally:
+                    schedB.shutdown()
+                    del engB
+                    gc.collect()
+                out["warm_first_hit"] = (out["first_reused_tokens"] > 0
+                                         and out["tier2_hit_tokens"] > 0)
+                return out
+            except Exception as e:  # noqa: BLE001
+                out["error"] = f"{type(e).__name__}: {e}"
+                return out
+            finally:
+                _restore_env(saved)
+
+        churn_on = run_churn("0.5")
+        churn_off = run_churn("0")
+        fleet = run_fleet()
     rec = {
         "model": model,
         "mode": "prefix",
@@ -1515,6 +1710,20 @@ def measure_prefix(jax, *, model: str, dtype: str, slots: int, steps: int,
         "k_concurrent": int(k_conc),
         "seq": seq,
     }
+    if tier_arms:
+        rec["churn_on"] = churn_on
+        rec["churn_off"] = churn_off
+        # hit rate the tiering holds where the tiering-off pool collapses
+        rec["churn_hit_rate"] = churn_on.get("hit_rate")
+        rec["churn_hit_rate_off"] = churn_off.get("hit_rate")
+        # >1 means restitching from host beats recomputing the prefill
+        # the churned pool threw away (TTFT p50 over revisit rounds)
+        rec["churn_ttft_ratio"] = (round(churn_off["ttft_p50_ms"]
+                                         / churn_on["ttft_p50_ms"], 2)
+                                   if churn_on.get("ttft_p50_ms")
+                                   and churn_off.get("ttft_p50_ms")
+                                   else None)
+        rec["fleet"] = fleet
     if env:
         rec["env"] = dict(env)
     log(f"bench: prefix-cache capture done: {json.dumps(rec)}")
@@ -3211,10 +3420,19 @@ def assemble(captures: list, platform: str, n_devices: int) -> str:
     # radix prefix-cache A/B (ISSUE 4 acceptance: hit rate >= 0.7,
     # TTFT p95 ratio >= 2 on TPU): the shared-prefix capture's headlines
     prefix_hit_rate = prefix_ttft_ratio = None
+    # tiered-KV headlines (ISSUE 18): churn hit rate with the host arena
+    # on vs off, off/on TTFT ratio, and the fleet arm's warm-wake verdict
+    churn_hit_rate = churn_hit_rate_off = churn_ttft_ratio = None
+    tier_fleet_warm_hit = None
     for c in captures:
         if c.get("mode") == "prefix":
             prefix_hit_rate = c.get("prefix_hit_rate")
             prefix_ttft_ratio = c.get("prefix_ttft_ratio")
+            churn_hit_rate = c.get("churn_hit_rate")
+            churn_hit_rate_off = c.get("churn_hit_rate_off")
+            churn_ttft_ratio = c.get("churn_ttft_ratio")
+            tier_fleet_warm_hit = (c.get("fleet") or {}).get(
+                "warm_first_hit")
             break
     # paged async dispatch A/B (ISSUE 5): the paged mixed-load capture's
     # sync/async ITL ratio, plus the prefix capture's sync/async TTFT
@@ -3363,6 +3581,10 @@ def assemble(captures: list, platform: str, n_devices: int) -> str:
         "mixed_tok_s_ratio": mixed_tok_s_ratio,
         "prefix_hit_rate": prefix_hit_rate,
         "prefix_ttft_ratio": prefix_ttft_ratio,
+        "churn_hit_rate": churn_hit_rate,
+        "churn_hit_rate_off": churn_hit_rate_off,
+        "churn_ttft_ratio": churn_ttft_ratio,
+        "tier_fleet_warm_hit": tier_fleet_warm_hit,
         "paged_async_itl_ratio": paged_async_itl_ratio,
         "paged_async_ttft_ratio": paged_async_ttft_ratio,
         "spec_tok_s_ratio": spec_tok_s_ratio,

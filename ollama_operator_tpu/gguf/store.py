@@ -134,3 +134,36 @@ def load_warm_snapshot(cache_dir: str, key: str) -> Optional[bytes]:
             return f.read()
     except OSError:
         return None
+
+
+# --- tier-2 prefix snapshots (fleet-shared hot KV prefixes) ----------------
+# Engine.export_prefixes() blobs land beside the warm snapshots on the
+# shared cache volume, keyed by digest + engine geometry + kv dtype
+# (service.prefix_snapshot_key): a just-woken or freshly scaled replica
+# imports the fleet's common system prompts into its host arena and
+# answers its first shared-prefix request as a warm tier-2 hit instead of
+# a cold-prefill storm. Same atomic-write discipline as the warm
+# snapshots — concurrent drains race harmlessly, readers never see a
+# torn file.
+
+def prefix_snapshot_path(cache_dir: str, key: str) -> str:
+    return os.path.join(cache_dir, "prefix", f"{key}.kvsnap")
+
+
+def save_prefix_snapshot(cache_dir: str, key: str, blob: bytes) -> str:
+    path = prefix_snapshot_path(cache_dir, key)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = path + f".tmp.{os.getpid()}.{os.urandom(4).hex()}"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+    os.replace(tmp, path)
+    return path
+
+
+def load_prefix_snapshot(cache_dir: str, key: str) -> Optional[bytes]:
+    path = prefix_snapshot_path(cache_dir, key)
+    try:
+        with open(path, "rb") as f:
+            return f.read()
+    except OSError:
+        return None

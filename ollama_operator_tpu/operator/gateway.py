@@ -653,12 +653,19 @@ class Gateway:
                              and probe_body is not None and len(cands) > 1
                              else [])
         if chosen is None and probe_targets:
-            best = -1
+            # longest match wins; on a matched-length tie prefer the
+            # lowest tier (0 = all-HBM, restitch-free; 1 = host restitch;
+            # 2 = imported fleet-snapshot pages). Tier-aware routing is
+            # what makes affinity valid across a replica wake: the woken
+            # replica imports the fleet prefix snapshot, answers the
+            # probe with matched > 0 at tier 2, and wins shared-prefix
+            # traffic away from a cold cohort instead of starting at 0.
+            best, best_tier = -1, 3
             payload = json.dumps(probe_body).encode()
             for name, url in probe_targets:
-                matched = self._probe_one(url, payload)
-                if matched > best:
-                    best, chosen = matched, name
+                matched, tier = self._probe_one(url, payload)
+                if matched > best or (matched == best and tier < best_tier):
+                    best, best_tier, chosen = matched, tier, name
             if best > 0:
                 path = "probe"
             else:
@@ -687,16 +694,20 @@ class Gateway:
                     f'{{path="{path}"}}')
         return chosen, path
 
-    def _probe_one(self, url: str, payload: bytes) -> int:
+    def _probe_one(self, url: str, payload: bytes) -> Tuple[int, int]:
+        """(matched_tokens, matched_tier) from one replica's probe.
+        Errors return (-1, 3): no match, worse than any real tier.
+        Pre-tiering replicas omit matched_tier and default to 0."""
         try:
             req = urllib.request.Request(
                 f"{url}/api/prefix_probe", data=payload, method="POST",
                 headers={"Content-Type": "application/json"})
             with urllib.request.urlopen(req, timeout=2.0) as resp:
                 body = json.loads(resp.read().decode())
-            return int(body.get("matched_tokens") or 0)
+            return (int(body.get("matched_tokens") or 0),
+                    int(body.get("matched_tier") or 0))
         except Exception:  # noqa: BLE001 — a probe miss is just no info
-            return -1
+            return -1, 3
 
     # -- journal ---------------------------------------------------------
 

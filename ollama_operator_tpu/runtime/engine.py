@@ -500,6 +500,12 @@ class Engine:
             return self._g(np.zeros(shape, dtype), sh)
 
         self._radix = None
+        self._arena = None           # tier-1 host arena (host_cache.py)
+        self._host_page_bytes = 0
+        self.n_spilled_pages = 0     # pages moved HBM → host, lifetime
+        self.last_stitch = None      # per-tier token breakdown of the
+                                     # most recent stitch() (scheduler
+                                     # reads it for the tier metrics)
         if self.paged:
             from .paged import PageTable, ShardedPageTable
             ps = ecfg.page_size
@@ -580,6 +586,20 @@ class Engine:
                     not in ("0", "false")):
                 from .radix import RadixCache
                 self._radix = RadixCache(ps)
+                # tier-1 host arena: radix LRU eviction spills quiescent
+                # pages here instead of freeing them (ISSUE 18). Bounded
+                # by TPU_HOST_CACHE_GB; 0 keeps eviction tierless.
+                from .host_cache import HostArena, host_cache_bytes
+                hc_bytes = host_cache_bytes()
+                if hc_bytes > 0:
+                    def _pg_bytes(tree):
+                        return sum(leaf.nbytes // leaf.shape[1]
+                                   for leaf in
+                                   jax.tree_util.tree_leaves(tree))
+                    self._host_page_bytes = (_pg_bytes(self.k_cache)
+                                             + _pg_bytes(self.v_cache))
+                    self._arena = HostArena(hc_bytes,
+                                            self._host_page_bytes)
         elif self.quant_cache:
             from ..ops.quant_cache import empty_cache
 
@@ -1372,6 +1392,37 @@ class Engine:
                 return k_cache, v_cache
             self._copy_page_fn = _jit(_copy_page, (0, 1),
                                       outs=(cache_sh, cache_sh))
+
+            # tiered KV cache (ISSUE 18): gather slices one page out of
+            # the pool for the host-tier spill (REPLICATED output, so on
+            # a multi-host mesh every host can device_get identical
+            # bytes); upload writes a spilled page's bytes back into a
+            # freshly grown page — an async enqueue that overlaps the
+            # tail prefill, never a host sync.
+            page_repl = (tuple(
+                jax.tree_util.tree_map(lambda _s: self._repl_sh, cache_sh)
+                for _ in range(2)) if slot_sh is not None else None)
+
+            def _gather_page(k_cache, v_cache, src):
+                def g(c):
+                    return jax.lax.dynamic_slice_in_dim(c, src, 1, axis=1)
+                return (jax.tree_util.tree_map(g, k_cache),
+                        jax.tree_util.tree_map(g, v_cache))
+            self._gather_page_fn = _jit(_gather_page, (), outs=page_repl)
+
+            def _upload_page(k_cache, v_cache, kp, vp, dst):
+                def up(c, page):
+                    return jax.lax.dynamic_update_slice_in_dim(
+                        c, page, dst, axis=1)
+                k_cache = jax.tree_util.tree_map(up, k_cache, kp)
+                v_cache = jax.tree_util.tree_map(up, v_cache, vp)
+                if slot_sh is not None:
+                    wsc = jax.lax.with_sharding_constraint
+                    k_cache = wsc(k_cache, cache_sh)
+                    v_cache = wsc(v_cache, cache_sh)
+                return k_cache, v_cache
+            self._upload_page_fn = _jit(_upload_page, (0, 1),
+                                        outs=(cache_sh, cache_sh))
 
         def _install_key(keys, slot, seed):
             k = jax.random.key(seed)
@@ -2350,21 +2401,69 @@ class Engine:
 
     @property
     def radix_pages(self) -> int:
-        """Physical pages pinned by the radix tree (== nodes: one each)."""
-        return self._radix.n_nodes if self._radix is not None else 0
+        """Physical pages pinned by the radix tree (tier-0 nodes only —
+        tier-1 nodes hold host bytes, not pool pages)."""
+        return self._radix.n_pages if self._radix is not None else 0
+
+    @property
+    def radix_hosted(self) -> int:
+        """Radix nodes whose KV lives in the host arena (tier 1)."""
+        return self._radix.n_hosted if self._radix is not None else 0
+
+    # -- tier-1 host arena occupancy (0 everywhere when the tier is off)
+    @property
+    def host_cache_enabled(self) -> bool:
+        return self._arena is not None
+
+    @property
+    def host_cache_used_bytes(self) -> int:
+        return self._arena.used_bytes if self._arena is not None else 0
+
+    @property
+    def host_cache_capacity_bytes(self) -> int:
+        return self._arena.capacity_bytes if self._arena is not None else 0
+
+    @property
+    def host_cache_pages(self) -> int:
+        return self._arena.n_entries if self._arena is not None else 0
+
+    @property
+    def host_page_bytes(self) -> int:
+        """Nominal host bytes per spilled page (0 when the tier is off)."""
+        return self._host_page_bytes
 
     def prefix_probe(self, full_ids) -> int:
         """Non-mutating: how many leading tokens of ``full_ids`` the radix
         cache could serve (full pages + one partial boundary page), capped
         at len-1 so at least one tail token remains to prefill. The
         scheduler uses this to apply its reuse floor and bucket-fit checks
-        BEFORE committing to a stitch. 0 when the cache is off or cold."""
+        BEFORE committing to a stitch. 0 when the cache is off or cold.
+        Tier-1 (host-spilled) pages count as servable — ``stitch`` may
+        still choose to recompute them if the break-even model says the
+        copy is dearer than the prefill."""
+        return self.prefix_probe_tier(full_ids)[0]
+
+    def prefix_probe_tier(self, full_ids):
+        """Tier-aware probe: ``(servable_tokens, tier)`` where ``tier``
+        is the WORST tier on the matched path — 0 = fully HBM-hot,
+        1 = needs a host-arena restitch, 2 = needs a restitch of
+        fleet-snapshot pages. The gateway prefers lower tiers on
+        matched-length ties so affinity stays truthful across replica
+        wake (a just-woken replica answers 2, a hot one 0)."""
         if self._radix is None:
-            return 0
+            return 0, 0
         ids = np.asarray(full_ids)
-        full, _part, q = self._radix.match(ids, int(ids.shape[0]) - 1,
-                                           bump=False)
-        return len(full) * self.ecfg.page_size + q
+        full, part, q = self._radix.match(ids, int(ids.shape[0]) - 1,
+                                          bump=False)
+        tier = 0
+        for n in full:
+            if n.tier != 0:
+                tier = max(tier, 2 if (n.host is not None
+                                       and n.host.snapshot) else 1)
+        if part is not None and q > 0 and part.tier != 0:
+            tier = max(tier, 2 if (part.host is not None
+                                   and part.host.snapshot) else 1)
+        return len(full) * self.ecfg.page_size + q, tier
 
     def stitch(self, slot: int, full_ids, max_reuse: int) -> int:
         """Map the radix cache's longest prefix of ``full_ids`` (at most
@@ -2375,14 +2474,30 @@ class Engine:
         prefill will write the remaining positions of that very page.
         Any pages the slot still held (stale parked prefix) are dropped
         first. Returns the reuse length actually stitched (0 = cold).
-        Raises PagesExhausted when the COW page cannot be allocated — the
+        Raises PagesExhausted when a page cannot be allocated — the
         slot is left with NO pages so the caller can fall back cleanly.
         Deterministic from call order, so follower replay stays in step.
-        """
+
+        Tiered KV cache (ISSUE 18): the matched path splits into a
+        leading tier-0 run (shared read-only, as before) and a tier-1
+        run of host-spilled pages. When the break-even model says the
+        host→HBM copy beats recomputing the run, each tier-1 page is
+        RESTITCHED — a private page is grown, the upload is enqueued
+        (async, overlapping the tail prefill) and the node is promoted
+        back to tier 0 so later requests share the fresh page. Short
+        runs recompute (counted as tiered misses). An armed
+        ``pages.restitch`` fault aborts the stitch into the same clean
+        pageless state as pool exhaustion; already-promoted nodes stay
+        valid because their uploads were already enqueued.
+        ``last_stitch`` records the per-tier token breakdown for the
+        scheduler's metrics."""
         assert self._radix is not None, "radix cache disabled"
         assert not self.active[slot], f"slot {slot} busy"
+        from .faults import InjectedFault
         from .paged import PagesExhausted
         self._pt.release(slot)
+        ls = self.last_stitch = {"t0": 0, "t1": 0, "t2": 0,
+                                 "skip1": 0, "skip2": 0}
         ids = np.asarray(full_ids, np.int32)
         cap = min(int(max_reuse), int(ids.shape[0]) - 1)
         if cap <= 0:
@@ -2391,20 +2506,104 @@ class Engine:
         if not full and q == 0:
             return 0
         ps = self.ecfg.page_size
-        self._pt.map_shared(slot, [n.page for n in full])
-        reuse = len(full) * ps
-        if part is not None and q > 0:
-            if not self._pt.grow(slot, reuse + q):
-                self._pt.release(slot)
-                raise PagesExhausted(
-                    f"no page for the copy-on-write boundary "
-                    f"({self._pt.n_free} free)")
-            dst = self._pt.slot_pages(slot)[-1]
-            self.k_cache, self.v_cache = self._copy_page_fn(
-                self.k_cache, self.v_cache,
-                self._gr(np.int32(part.page)), self._gr(np.int32(dst)))
-            reuse += q
+        # split the matched path: shareable tier-0 run, then the
+        # restitchable tier-1 run (paths are tier0* then tier1*)
+        t0run, t1run = [], []
+        for n in full:
+            if n.tier == 0 and not t1run:
+                t0run.append(n)
+            elif n.tier != 0:
+                t1run.append(n)
+            else:     # tier-0 below tier-1: unreachable by invariant
+                break
+        self._pt.map_shared(slot, [n.page for n in t0run])
+        reuse = len(t0run) * ps
+        ls["t0"] = reuse
+        restitch = False
+        if t1run and self._arena is not None:
+            from .host_cache import worth_restitch
+            restitch = worth_restitch(
+                self.cfg, reuse, len(t1run) * ps,
+                sum(n.host.nbytes for n in t1run))
+        skipped = bool(t1run) and not restitch
+        if skipped:
+            # break-even says recompute: the run stays spilled, the tail
+            # prefill regenerates those positions (a tiered miss)
+            for n in t1run:
+                ls["skip2" if n.host.snapshot else "skip1"] += ps
+            t1run = []
+        # make room for the planned uploads BEFORE enqueuing any of them:
+        # at this point no restitch program is in flight, so eviction can
+        # still spill victims to the host tier (mid-stitch the epoch has
+        # advanced and a dry pool would plainly free them instead). The
+        # probe just bumped the matched path MRU, so LRU victims are
+        # other prefixes — never the run being restitched.
+        need = len(t1run) + (1 if part is not None and q > 0
+                             and not skipped else 0)
+        if need > self._pt.n_free:
+            self.radix_evict(need - self._pt.n_free)
+        try:
+            for node in t1run:
+                was_snap = node.host.snapshot
+                dst = self._upload_host(slot, node.host.kv, reuse + ps)
+                self._pt.pin(dst)
+                self._arena.free(self._radix.mark_promoted(node, dst))
+                reuse += ps
+                ls["t2" if was_snap else "t1"] += ps
+            # boundary page: COW from a tier-0 partial, or a PRIVATE
+            # host upload from a tier-1 partial (no promotion — the tail
+            # prefill writes this page's remaining positions, so the
+            # tree keeps its spilled copy). A skipped tier-1 run makes
+            # the boundary unreachable (its prefix wasn't stitched).
+            if part is not None and q > 0 and not skipped:
+                if part.tier == 0:
+                    if not self._pt.grow(slot, reuse + q):
+                        self._pt.release(slot)
+                        raise PagesExhausted(
+                            f"no page for the copy-on-write boundary "
+                            f"({self._pt.n_free} free)")
+                    dst = self._pt.slot_pages(slot)[-1]
+                    self.k_cache, self.v_cache = self._copy_page_fn(
+                        self.k_cache, self.v_cache,
+                        self._gr(np.int32(part.page)),
+                        self._gr(np.int32(dst)))
+                    reuse += q
+                    ls["t0"] += q
+                elif self._arena is not None:
+                    from .host_cache import worth_restitch
+                    if worth_restitch(self.cfg, reuse, q,
+                                      part.host.nbytes):
+                        self._upload_host(slot, part.host.kv, reuse + q)
+                        reuse += q
+                        ls["t2" if part.host.snapshot else "t1"] += q
+                    else:
+                        ls["skip2" if part.host.snapshot
+                           else "skip1"] += q
+        except InjectedFault as e:
+            # chaos (pages.restitch): abort into the same pageless state
+            # as pool exhaustion — the caller cold-admits cleanly
+            self._pt.release(slot)
+            raise PagesExhausted(f"restitch aborted: {e}")
         return reuse
+
+    def _upload_host(self, slot: int, kv, n_tokens: int) -> int:
+        """Grow one private page for ``slot`` and enqueue the host→HBM
+        upload of a spilled page's bytes into it. The jitted update is
+        async — it overlaps the tail prefill's host-side work and the
+        donated-cache dependency chain orders it before any program
+        that reads the page. Returns the page id."""
+        from .paged import PagesExhausted
+        FAULTS.check("pages.restitch")
+        if not self._pt.grow(slot, n_tokens):
+            self._pt.release(slot)
+            raise PagesExhausted(
+                f"no page for tier-1 restitch ({self._pt.n_free} free)")
+        dst = self._pt.slot_pages(slot)[-1]
+        kp = jax.tree_util.tree_map(self._gr, kv[0])
+        vp = jax.tree_util.tree_map(self._gr, kv[1])
+        self.k_cache, self.v_cache = self._upload_page_fn(
+            self.k_cache, self.v_cache, kp, vp, self._gr(np.int32(dst)))
+        return dst
 
     def donate_prefix(self, slot: int, token_ids) -> int:
         """Insert ``slot``'s full-page-aligned KV prefix for ``token_ids``
@@ -2427,6 +2626,10 @@ class Engine:
                                          self._pt.slot_pages(slot)[:k])
             for node in adopted:
                 self._pt.pin(node.page)
+            if self._arena is not None:
+                # chunks the donor re-materialised while spilled got
+                # promoted back to tier 0: retire their host bytes
+                self._arena.free_all(self._radix.take_dropped_hosts())
         self.release(slot)
         return k * ps
 
@@ -2434,22 +2637,173 @@ class Engine:
         """Evict up to ``n_pages`` least-recently-used radix leaves whose
         pages no slot currently maps, page-by-page (children before
         parents), returning their pages to the pool. Replaces the
-        all-or-nothing parked-slot eviction. Returns pages freed."""
+        all-or-nothing parked-slot eviction. Returns pages freed.
+
+        With the host arena on (TPU_HOST_CACHE_GB > 0) an evicted page
+        is SPILLED to the host tier first — but only while the epoch
+        fence is quiescent (no launched dispatch un-retired: a host copy
+        must never race in-flight device writes) and the arena has room
+        after dropping LRU tier-1 entries. Otherwise the page is plainly
+        freed, pruning any tier-1 descendants with it so every resident
+        path stays rooted. Spill decisions are pure functions of
+        mirrored state, so follower replay spills identically."""
         if self._radix is None:
             return 0
-        pages = self._radix.evict(
-            n_pages, lambda pg: self._pt.shared_refs(pg) == 0)
-        for pg in pages:
-            self._pt.unpin(pg)
-        return len(pages)
+
+        def evictable(pg):
+            return self._pt.shared_refs(pg) == 0
+
+        if self._arena is None:
+            pages = self._radix.evict(n_pages, evictable)
+            for pg in pages:
+                self._pt.unpin(pg)
+            return len(pages)
+        freed = 0
+        while freed < n_pages:
+            node = self._radix.spill_lru(evictable)
+            if node is None:
+                break
+            if self._pt.quiescent and self._spill_node(node):
+                freed += 1
+                continue
+            pages, hosts = self._radix.remove(node)
+            self._arena.free_all(hosts)
+            for pg in pages:
+                self._pt.unpin(pg)
+            freed += len(pages)
+        return freed
+
+    def _spill_node(self, node) -> bool:
+        """Move one radix node's page into the host arena (tier 0 → 1).
+        False = the caller falls back to a plain eviction (arena full
+        even after an LRU drop, or the ``pages.spill`` chaos point
+        fired). Caller guarantees the fence is quiescent, so the
+        ``device_get`` here captures stable bytes; it runs on the
+        admission/eviction path only, never the dispatch hot loop."""
+        from .faults import InjectedFault
+        if not self._arena.room_for(1):
+            self._arena.free_all(self._radix.drop_host_lru(1))
+        if not self._arena.room_for(1):
+            return False
+        try:
+            FAULTS.check("pages.spill")
+        except InjectedFault:
+            return False
+        kp, vp = self._gather_page_fn(self.k_cache, self.v_cache,
+                                      self._gr(np.int32(node.page)))
+        kv = jax.device_get((kp, vp))
+        pg = self._radix.mark_spilled(node, self._arena.store(kv))
+        self._pt.unpin(pg)
+        self.n_spilled_pages += 1
+        METRICS.inc("tpu_model_spilled_pages_total")
+        return True
 
     def radix_reset(self):
         """Drop the whole radix tree (supervised restart: cache contents
-        are unknown after a failed step, so nothing may be reused)."""
+        are unknown after a failed step, so nothing may be reused).
+        Tier-1 state dies with the tree — a restarted engine never
+        restitches bytes whose provenance it can no longer trust."""
         if self._radix is None:
             return
         for pg in self._radix.reset():
             self._pt.unpin(pg)
+        if self._arena is not None:
+            self._arena.clear()
+
+    # ------------------------------------------------------------------
+    # tier-2 fleet prefix snapshots (gguf/store.py persistence)
+    # ------------------------------------------------------------------
+    def export_prefixes(self, max_bytes: int = 64 << 20):
+        """Serialize the hottest radix prefixes (any tier) into a
+        self-contained snapshot blob, most-recently-used first within
+        ``max_bytes`` (a child only ships if its parent made the cut,
+        so every shipped path is rooted). Tier-0 pages are gathered
+        from the pool — the ``device_get`` waits out pending programs,
+        so call this at drain/idle, never on the dispatch path.
+        Read-only and leader-side (NOT mirrored). None when empty."""
+        if self._radix is None or self._radix.n_nodes == 0:
+            return None
+        import pickle
+        from .host_cache import _tree_nbytes
+        nodes = self._radix.walk()     # parents before children (BFS)
+        # parent.stamp >= child.stamp (bumps touch whole paths), and the
+        # stable sort keeps BFS order on ties — parents stay first
+        nodes.sort(key=lambda n: -n.stamp)
+        idx: Dict[int, int] = {}
+        recs: List[Dict[str, Any]] = []
+        budget = int(max_bytes)
+        for node in nodes:
+            at_root = not node.parent.chunk
+            pidx = -1 if at_root else idx.get(id(node.parent), -1)
+            if not at_root and pidx < 0:
+                continue              # parent missed the budget
+            if node.tier == 0:
+                kp, vp = self._gather_page_fn(
+                    self.k_cache, self.v_cache,
+                    self._gr(np.int32(node.page)))
+                kv = jax.device_get((kp, vp))
+            else:
+                kv = node.host.kv
+            nbytes = _tree_nbytes(kv)
+            if nbytes > budget:
+                continue
+            budget -= nbytes
+            idx[id(node)] = len(recs)
+            recs.append({"p": pidx, "c": np.asarray(node.chunk, np.int32),
+                         "k": kv[0], "v": kv[1]})
+        if not recs:
+            return None
+        return pickle.dumps(
+            {"v": 1, "ps": self.ecfg.page_size, "recs": recs}, protocol=4)
+
+    def import_prefixes(self, blob) -> int:
+        """Install a tier-2 fleet snapshot as tier-1 nodes backed by the
+        host arena, stopping at arena capacity. Existing nodes are kept
+        (never downgraded) and reused as parents. MIRRORED: the import
+        mutates replay-relevant tree state, so followers install the
+        identical blob at the identical call-stream position. Returns
+        pages imported (0 when radix/arena off, bad blob, or geometry
+        mismatch — a snapshot is a warm start, never a failure)."""
+        if self._radix is None or self._arena is None or not blob:
+            return 0
+        import pickle
+        try:
+            data = pickle.loads(blob)
+        except Exception:
+            return 0
+        if (not isinstance(data, dict) or data.get("v") != 1
+                or data.get("ps") != self.ecfg.page_size):
+            return 0
+
+        def spec(tree, page_axis1=False):
+            return jax.tree_util.tree_map(
+                lambda a: ((tuple(a.shape[:1]) + (1,) + tuple(a.shape[2:]))
+                           if page_axis1 else tuple(a.shape),
+                           np.dtype(a.dtype)), tree)
+        want = (spec(self.k_cache, True), spec(self.v_cache, True))
+        imported = 0
+        by_idx: List[Any] = []
+        for rec in data.get("recs", ()):
+            p = int(rec.get("p", -1))
+            parent = None
+            if p >= 0:
+                parent = by_idx[p] if 0 <= p < len(by_idx) else None
+                if parent is None:
+                    by_idx.append(None)
+                    continue
+            chunk = tuple(int(t) for t in rec["c"])
+            node = self._radix.child(parent, chunk)
+            if node is None:
+                kv = (rec["k"], rec["v"])
+                if ((spec(kv[0]), spec(kv[1])) != want
+                        or not self._arena.room_for(1)):
+                    by_idx.append(None)
+                    continue
+                node = self._radix.insert_host(
+                    parent, chunk, self._arena.store(kv, snapshot=True))
+                imported += 1
+            by_idx.append(node)
+        return imported
 
     @property
     def quarantined_pages(self) -> int:

@@ -84,6 +84,17 @@ point("pages.alloc", "ollama_operator_tpu/runtime/paged.py",
       """PageTable.grow page allocation; an armed fail makes grow return
       False (simulated pool exhaustion) so callers exercise their REAL
       dry-pool paths (preempt/evict/cold-fallback).""")
+point("pages.spill", "ollama_operator_tpu/runtime/engine.py",
+      """Per page in Engine.radix_evict, before the device gather that
+      moves an evicted radix page's KV bytes to the host arena; an armed
+      fail skips the spill and the page is plainly freed (tierless
+      eviction), never an engine failure.""")
+point("pages.restitch", "ollama_operator_tpu/runtime/engine.py",
+      """Per page in Engine.stitch, before a tier-1 host page is
+      uploaded back into HBM; an armed fail aborts the stitch — the slot
+      is released pageless and the scheduler's existing dry-pool path
+      admits the request as a clean cold prefill (already-promoted pages
+      stay valid: their uploads were enqueued).""")
 point("detok.feed", "ollama_operator_tpu/runtime/service.py",
       """Service detokeniser feed, per chunk; an armed fail errors one
       stream without touching the engine.""")

@@ -255,6 +255,11 @@ class MirroredEngine:
                 # every host must replay them in order; prefix_probe is
                 # read-only and deliberately NOT mirrored
                 "stitch", "donate_prefix", "radix_evict", "radix_reset",
+                # tier-2 prefix snapshot install mutates the radix tree
+                # and the host arena (replay-relevant: later stitches
+                # branch on tier state); export_prefixes is read-only
+                # and deliberately NOT mirrored
+                "import_prefixes",
                 # epoch fence: quiesce blocks on each host's OWN devices
                 # and drains that host's quarantine — replayed at the
                 # same call-stream position, every host's free list

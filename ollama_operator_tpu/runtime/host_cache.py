@@ -1,0 +1,166 @@
+"""Tier-1 host-RAM arena for spilled radix-tree KV pages.
+
+The radix prefix cache (runtime/radix.py) lives in the HBM page pool, so
+a prefix survives exactly as long as page pressure allows — minutes of
+multi-turn chat working set against seconds of HBM residency.  This
+module adds the host tier: when the engine's LRU eviction would free a
+quiescent tree page, it instead gathers the page across all layers
+(one jitted dynamic-slice program), ``device_get``s the bytes into a
+bounded host arena, and tags the radix node ``tier=1``.  A later hit on
+that node *restitches* — a host→HBM ``dynamic_update_slice`` upload is
+enqueued per page (JAX async dispatch overlaps it with the tail
+chunked-prefill), the node is promoted back to tier 0 and its fresh
+page re-enters normal refcount sharing.  SGLang's HiCache / vLLM's CPU
+offload connector play this role in the reference stacks.
+
+The arena is pure host bookkeeping:
+
+- **Bounded** by ``TPU_HOST_CACHE_GB`` (fractional GiB accepted; 0
+  disables the tier entirely and eviction frees pages exactly as
+  before).  When ``store`` would overflow, the engine first drops
+  least-recently-used tier-1 entries; if the arena is still full the
+  page is plainly freed.
+- **Accounted** by real bytes (``sum(leaf.nbytes)`` of the gathered
+  page tree), so int8/int4 quantised pools automatically fit ~4-8x more
+  spilled pages than f32 pools.
+- **Deterministic**: spill/restitch decisions depend only on mirrored
+  host state (epoch fence, tree stamps) and environment knobs, so
+  multi-host follower replay takes identical branches at identical
+  call-stream positions.
+
+Break-even model (PR 10's FLOPs accounting): restitching ``n`` tokens
+costs ``n_bytes / (TPU_HOST_CACHE_BW_GBPS · 1e9)`` seconds of DMA;
+recomputing them costs ``prefill_flops(cfg, start, n) / peak`` seconds
+of device time.  Short prefixes recompute (the prefill is cheaper than
+the copy below the crossover); long prefixes restitch.
+``TPU_HOST_CACHE_BREAK_EVEN`` overrides the model with a flat token
+floor ("restitch runs of >= K tokens"); on hosts with no detectable
+peak (CPU meshes, ``TPU_PEAK_FLOPS`` unset) the copy always wins above
+the engine's normal reuse floor, which keeps CI deterministic.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, List, Optional, Tuple
+
+from .accounting import detect_peak_flops, prefill_flops
+
+
+def host_cache_bytes(env: Optional[str] = None) -> int:
+    """Arena capacity in bytes from ``TPU_HOST_CACHE_GB`` (0 = off).
+    Fractional values are honoured so tests can build arenas a few
+    pages wide."""
+    raw = env if env is not None else os.environ.get("TPU_HOST_CACHE_GB",
+                                                     "0")
+    try:
+        gb = float(raw or 0)
+    except ValueError:
+        return 0
+    return max(int(gb * (1 << 30)), 0)
+
+
+class HostEntry:
+    """One spilled page: the gathered (k, v) numpy trees + accounting.
+
+    ``snapshot`` marks entries imported from a tier-2 fleet snapshot
+    (gguf/store prefix snapshots) rather than spilled locally — the
+    scheduler attributes their hits to ``tier="2"`` in the metrics."""
+
+    __slots__ = ("kv", "nbytes", "snapshot")
+
+    def __init__(self, kv: Tuple[Any, Any], nbytes: int,
+                 snapshot: bool = False):
+        self.kv = kv
+        self.nbytes = nbytes
+        self.snapshot = snapshot
+
+
+def _tree_nbytes(tree: Any) -> int:
+    import jax
+    return sum(int(leaf.nbytes) for leaf in jax.tree_util.tree_leaves(tree))
+
+
+class HostArena:
+    """Bounded byte-accounted store of spilled KV pages.
+
+    The arena never walks the radix tree itself — LRU order lives in the
+    tree's stamps, and the engine asks the tree which tier-1 entries to
+    drop under pressure.  This object only owns capacity accounting, so
+    ``clear()`` (supervised restart, radix_reset) is O(1): entries die
+    with their nodes."""
+
+    def __init__(self, capacity_bytes: int, page_bytes: int):
+        assert capacity_bytes > 0
+        self.capacity_bytes = int(capacity_bytes)
+        # nominal per-page footprint, used for room checks BEFORE the
+        # gather runs (actual accounting uses each entry's real bytes)
+        self.page_bytes = max(int(page_bytes), 1)
+        self._used = 0
+        self._n = 0
+
+    @property
+    def used_bytes(self) -> int:
+        return self._used
+
+    @property
+    def n_entries(self) -> int:
+        return self._n
+
+    def room_for(self, n_pages: int = 1) -> bool:
+        return self._used + n_pages * self.page_bytes <= self.capacity_bytes
+
+    def store(self, kv: Tuple[Any, Any], snapshot: bool = False
+              ) -> HostEntry:
+        nbytes = _tree_nbytes(kv)
+        entry = HostEntry(kv, nbytes, snapshot)
+        self._used += nbytes
+        self._n += 1
+        return entry
+
+    def free(self, entry: Optional[HostEntry]):
+        if entry is None:
+            return
+        self._used -= entry.nbytes
+        self._n -= 1
+        assert self._used >= 0 and self._n >= 0, "host arena double free"
+        entry.kv = None  # type: ignore[assignment]
+
+    def free_all(self, entries: List[Optional[HostEntry]]):
+        for e in entries:
+            self.free(e)
+
+    def clear(self):
+        """Drop all accounting (the tree holding the entries was reset)."""
+        self._used = 0
+        self._n = 0
+
+
+def worth_restitch(cfg, start: int, n_tokens: int, n_bytes: int) -> bool:
+    """Copy-vs-recompute break-even for a tier-1 run of ``n_tokens``
+    tokens (``n_bytes`` of spilled KV) beginning at absolute position
+    ``start``.  True = upload the pages; False = let the tail prefill
+    recompute them.  Pure function of (args, env), identical on every
+    host of a replica."""
+    if n_tokens <= 0:
+        return False
+    floor = 0
+    try:
+        floor = int(os.environ.get("TPU_HOST_CACHE_BREAK_EVEN", "0") or 0)
+    except ValueError:
+        floor = 0
+    if floor > 0:
+        return n_tokens >= floor
+    peak, _kind = detect_peak_flops()
+    if peak <= 0:
+        # no meaningful device peak (CPU smoke): a memcpy always beats
+        # re-running the transformer, so restitch whenever the engine's
+        # reuse floor admitted the run at all
+        return True
+    try:
+        bw = float(os.environ.get("TPU_HOST_CACHE_BW_GBPS", "8") or 8)
+    except ValueError:
+        bw = 8.0
+    copy_s = n_bytes / max(bw, 1e-3) / 1e9
+    recompute_s = prefill_flops(cfg, start, n_tokens) / peak
+    return copy_s < recompute_s

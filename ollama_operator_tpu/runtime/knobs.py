@@ -135,6 +135,22 @@ declare("TPU_PREFIX_CACHE", "bool", 1, "scheduler",
         "0 disables the radix prefix cache")
 declare("TPU_MIN_PREFIX_REUSE", "int", 16, "scheduler",
         "minimum shared-token run before the prefix cache reuses pages")
+declare("TPU_HOST_CACHE_GB", "float", 0, "scheduler",
+        "tier-1 host-RAM arena size in GiB for spilled radix KV pages "
+        "(fractional OK); 0 disables tiering and eviction frees pages")
+declare("TPU_HOST_CACHE_BW_GBPS", "float", 8, "scheduler",
+        "assumed host-to-HBM copy bandwidth in GB/s for the "
+        "restitch-vs-recompute break-even model")
+declare("TPU_HOST_CACHE_BREAK_EVEN", "int", 0, "scheduler",
+        "flat token floor overriding the break-even model: restitch "
+        "spilled runs of >= this many tokens, recompute shorter ones; "
+        "0 = use the FLOPs/bandwidth model")
+declare("TPU_HOST_CACHE_SNAPSHOT", "bool", 1, "scheduler",
+        "0 disables tier-2 prefix snapshots (export at drain, import "
+        "at load) on the shared weight-cache volume")
+declare("TPU_HOST_CACHE_SNAPSHOT_MB", "int", 64, "scheduler",
+        "byte budget for an exported tier-2 prefix snapshot "
+        "(most-recently-used prefixes first)")
 declare("TPU_PRIORITY_PREEMPT", "bool", 1, "scheduler",
         "0 disables priority preemption of running low-priority slots")
 declare("TPU_DISPATCH_WATCHDOG_MS", "int", None, "scheduler",

@@ -208,6 +208,17 @@ class PageTable:
         """Pages parked in the epoch quarantine (not yet allocatable)."""
         return len(self._quarantine)
 
+    @property
+    def quiescent(self) -> bool:
+        """True when every launched dispatch has retired — no in-flight
+        program can still read or write ANY page through a captured
+        block table. This is the gate for spilling a page's bytes to the
+        host tier (ISSUE 18): a host copy taken while a dispatch is in
+        flight could race the device writes; a quiescent copy cannot.
+        Pure mirrored host state, so followers take identical spill
+        branches at identical call-stream positions."""
+        return self._epoch <= self._retired
+
     def advance_epoch(self) -> int:
         """Stamp one launched dispatch; returns its epoch. Pages freed
         from now on quarantine under this stamp until it retires."""
@@ -379,6 +390,10 @@ class ShardedPageTable:
     @property
     def quarantined(self) -> int:
         return sum(pt.quarantined for pt in self._pts)
+
+    @property
+    def quiescent(self) -> bool:
+        return all(pt.quiescent for pt in self._pts)
 
     def advance_epoch(self) -> int:
         return max(pt.advance_epoch() for pt in self._pts)

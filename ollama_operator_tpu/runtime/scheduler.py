@@ -873,9 +873,19 @@ class Scheduler:
         if want < self.min_prefix_reuse:
             return 0
         try:
+            t0 = time.perf_counter()
             got = self.engine.stitch(slot, ids, want)
+            ls = getattr(self.engine, "last_stitch", None)
             if got:
-                req.trace.event("stitch", slot=slot, reused=got)
+                # per-tier breakdown rides the request to _post_admit
+                # (metrics attribution); restitch latency is observed
+                # enqueue-side — the uploads themselves overlap the tail
+                # prefill asynchronously
+                req._tier_stitch = ls
+                if ls and (ls["t1"] or ls["t2"]):
+                    METRICS.observe("tpu_model_restitch_seconds",
+                                    time.perf_counter() - t0)
+                req.trace.event("stitch", slot=slot, reused=got, tiers=ls)
             return got
         except PagesExhausted:
             if self._pending is not None or self.engine.quarantined_pages:
@@ -998,6 +1008,26 @@ class Scheduler:
         METRICS.inc("tpu_model_prefix_hit_tokens_total", float(n_re))
         METRICS.inc("tpu_model_prefix_miss_tokens_total",
                     float(len(req.admit_ids) - n_re))
+        # tiered attribution of the same tokens (ISSUE 18): which tier
+        # served the reuse (0 = HBM-shared, 1 = host restitch, 2 =
+        # fleet-snapshot restitch); misses split into never-cached
+        # tokens (tier 0) and spilled tokens the break-even model chose
+        # to recompute (tier 1/2)
+        ls = getattr(req, "_tier_stitch", None) or {}
+        t12 = ls.get("t1", 0) + ls.get("t2", 0)
+        skip = ls.get("skip1", 0) + ls.get("skip2", 0)
+        for tier, n in (("0", max(n_re - t12, 0)),
+                        ("1", ls.get("t1", 0)), ("2", ls.get("t2", 0))):
+            if n:
+                METRICS.inc("tpu_model_tier_hit_tokens_total", float(n),
+                            f'{{tier="{tier}"}}')
+        for tier, n in (("0", len(req.admit_ids) - n_re - skip),
+                        ("1", ls.get("skip1", 0)),
+                        ("2", ls.get("skip2", 0))):
+            if n > 0:
+                METRICS.inc("tpu_model_tier_miss_tokens_total", float(n),
+                            f'{{tier="{tier}"}}')
+        req._tier_stitch = None
         self._running[slot] = req
         # grammar check before emitting (see _fanout)
         if (req.constraint is not None
@@ -1060,6 +1090,7 @@ class Scheduler:
                 # shared mappings back): fall back to a COLD admit once —
                 # a genuinely dry pool raises again and requeues below
                 reuse_len = 0
+                req._tier_stitch = None
                 first = self.engine.admit(slot, req.admit_ids, req.opts,
                                           embeds=req.embeds,
                                           mask_row=mask_row)
@@ -1125,6 +1156,7 @@ class Scheduler:
                 # the chunked prefill once (stitch/extend rolled the
                 # shared mappings back)
                 reuse_len, end = 0, self.prefill_chunk
+                req._tier_stitch = None
                 self.engine.admit(slot, ids[:end])
             req.stats.n_reused = reuse_len
             # park between pieces: cache and lengths stay, the slot goes

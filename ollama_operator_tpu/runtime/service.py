@@ -247,6 +247,13 @@ class LoadedModel:
         if not follower and _os.environ.get("TPU_WARM_BUCKETS", "1") != "0":
             if not self._restore_warm_snapshot():
                 self.engine.warm_buckets()
+        # tier-2 prefix snapshot: seed the host arena with the fleet's
+        # shared hot prefixes so this replica's first shared-prefix
+        # request is a warm tier-2 hit instead of a cold prefill
+        # (import_prefixes is MIRRORED — followers replay the same
+        # import and the trees stay bit-identical)
+        if not follower:
+            self._restore_prefix_snapshot()
         # followers replay engine calls from the control stream — they
         # never schedule on their own
         self.scheduler = None if follower else Scheduler(self.engine)
@@ -283,6 +290,17 @@ class LoadedModel:
             METRICS.gauge_fn("tpu_model_radix_pages",
                              lambda: (lm := wself()) is not None
                              and lm.engine.radix_pages or 0)
+        if getattr(self.engine, "host_cache_enabled", False):
+            # tier-1 host-arena occupancy: bytes and whole KV pages the
+            # spilled radix subtrees hold in pinned host RAM (the spill /
+            # tier-hit counters live in the scheduler path and survive
+            # unload, keeping Prometheus rate() semantics intact)
+            METRICS.gauge_fn("tpu_model_host_cache_bytes",
+                             lambda: (lm := wself()) is not None
+                             and lm.engine.host_cache_used_bytes or 0)
+            METRICS.gauge_fn("tpu_model_host_cache_pages",
+                             lambda: (lm := wself()) is not None
+                             and lm.engine.host_cache_pages or 0)
         # per-program dispatch latency (launch → tokens on host), one
         # labelled gauge per program kind: decode-chunk, one-shot admit,
         # extend (prefix reuse / chunked-prefill pieces), spec verify —
@@ -369,6 +387,65 @@ class LoadedModel:
         except Exception:  # noqa: BLE001 — never let a snapshot fail a drain
             return False
         METRICS.inc("tpu_model_warm_snapshot_saves_total", 1.0)
+        return True
+
+    # ------------------------------------------------------------------
+    # tier-2 prefix snapshots (fleet-shared hot KV prefixes): the hottest
+    # radix subtrees persist on the shared weight-cache volume across pod
+    # generations — saved at drain time, imported into the host arena at
+    # load so a just-woken replica answers shared-prefix traffic warm
+    # ------------------------------------------------------------------
+    def prefix_snapshot_key(self) -> str:
+        """Serving-identity hash the prefix snapshot is keyed by: KV
+        pages are only valid for the exact digest + engine geometry
+        (page size, kv dtype, head layout all live in ecfg) + jax
+        backend that produced them."""
+        import hashlib
+        import jax
+        payload = "|".join([
+            self.digest or self.name, repr(self.ecfg), jax.__version__,
+            jax.default_backend(), "prefix-v1"])
+        return hashlib.sha256(payload.encode()).hexdigest()[:24]
+
+    def _restore_prefix_snapshot(self) -> bool:
+        """Try to seed the host arena from a persisted prefix snapshot;
+        False means cold (never an error — the snapshot is an
+        optimisation, not a dependency)."""
+        import os as _os
+        if (self._warm_cache_dir is None
+                or _os.environ.get("TPU_HOST_CACHE_SNAPSHOT", "1") == "0"
+                or not getattr(self.engine, "host_cache_enabled", False)):
+            return False
+        from ..gguf.store import load_prefix_snapshot
+        try:
+            blob = load_prefix_snapshot(self._warm_cache_dir,
+                                        self.prefix_snapshot_key())
+            if blob is None:
+                return False
+            n = self.engine.import_prefixes(blob)
+        except Exception:  # noqa: BLE001 — corrupt/incompatible snapshot
+            return False
+        return n > 0
+
+    def save_prefix_snapshot(self) -> bool:
+        """Persist the hottest prefixes (drain path, beside the warm
+        snapshot). Best-effort — never lets a snapshot fail a drain."""
+        import os as _os
+        if (self.follower or self._warm_cache_dir is None
+                or _os.environ.get("TPU_HOST_CACHE_SNAPSHOT", "1") == "0"
+                or not getattr(self.engine, "radix_enabled", False)):
+            return False
+        from ..gguf.store import save_prefix_snapshot
+        try:
+            budget = int(_os.environ.get("TPU_HOST_CACHE_SNAPSHOT_MB",
+                                         "64") or "64") << 20
+            blob = self.engine.export_prefixes(budget)
+            if blob is None:
+                return False
+            save_prefix_snapshot(self._warm_cache_dir,
+                                 self.prefix_snapshot_key(), blob)
+        except Exception:  # noqa: BLE001 — never let a snapshot fail a drain
+            return False
         return True
 
     # ------------------------------------------------------------------
@@ -784,6 +861,9 @@ class LoadedModel:
         if getattr(self.engine, "radix_enabled", False):
             METRICS.remove_gauge("tpu_model_radix_nodes")
             METRICS.remove_gauge("tpu_model_radix_pages")
+        if getattr(self.engine, "host_cache_enabled", False):
+            METRICS.remove_gauge("tpu_model_host_cache_bytes")
+            METRICS.remove_gauge("tpu_model_host_cache_pages")
         for _kind in ("decode", "admit", "extend", "spec"):
             METRICS.remove_gauge("tpu_model_dispatch_ms",
                                  labels=f'{{program="{_kind}"}}')

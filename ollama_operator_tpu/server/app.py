@@ -347,6 +347,11 @@ class ModelManager:
             lm.scheduler.begin_drain()
             if not already and hasattr(lm, "save_warm_snapshot"):
                 lm.save_warm_snapshot()
+            # hottest KV prefixes ride along to the shared volume: the
+            # next wake (any replica of this digest) imports them and
+            # serves shared-prefix traffic as warm tier-2 hits
+            if not already and hasattr(lm, "save_prefix_snapshot"):
+                lm.save_prefix_snapshot()
 
     def drain(self, timeout_s: Optional[float] = None) -> int:
         """Graceful drain for SIGTERM: begin_drain(), then let the
@@ -674,6 +679,25 @@ class ModelManager:
                     "radix_pages": (int(lm.engine.radix_pages)
                                     if getattr(lm, "engine", None)
                                     is not None else 0),
+                    # tiered residency: HBM pages (tier 0) vs spilled
+                    # pages pinned in the host arena (tier 1/2), plus the
+                    # arena byte occupancy against its capacity — all 0
+                    # when TPU_HOST_CACHE_GB is unset
+                    "tiers": {
+                        "hbm_pages": (int(lm.engine.radix_pages)
+                                      if getattr(lm, "engine", None)
+                                      is not None else 0),
+                        "host_pages": (int(lm.engine.host_cache_pages)
+                                       if getattr(lm, "engine", None)
+                                       is not None else 0),
+                        "host_bytes": (int(lm.engine.host_cache_used_bytes)
+                                       if getattr(lm, "engine", None)
+                                       is not None else 0),
+                        "host_capacity_bytes": (
+                            int(lm.engine.host_cache_capacity_bytes)
+                            if getattr(lm, "engine", None)
+                            is not None else 0),
+                    },
                 },
                 # fused prompt-lookup speculation: process-lifetime
                 # drafted/accepted token counters (same series /metrics
@@ -1581,13 +1605,22 @@ class Handler(BaseHTTPRequestHandler):
         engine = getattr(lm, "engine", None)
         matched = 0
         n_ids = 0
+        tier = 0
         if tok is not None and engine is not None:
             ids = tok.encode(text, add_bos=tok.add_bos)
             n_ids = len(ids)
             if n_ids > 1:
-                matched = int(engine.prefix_probe(ids))
+                if hasattr(engine, "prefix_probe_tier"):
+                    # worst tier on the matched path: 0 = all-HBM
+                    # (restitch-free), 1 = host restitch needed, 2 = the
+                    # match includes imported fleet-snapshot pages — the
+                    # gateway prefers lower tiers on matched-length ties
+                    matched, tier = engine.prefix_probe_tier(ids)
+                    matched, tier = int(matched), int(tier)
+                else:
+                    matched = int(engine.prefix_probe(ids))
         self._send_json({"model": model, "matched_tokens": matched,
-                         "prompt_tokens": n_ids})
+                         "matched_tier": tier, "prompt_tokens": n_ids})
 
     def _api_embeddings(self, body: Dict):
         lm = self.manager.require_loaded(self._model_arg(body),

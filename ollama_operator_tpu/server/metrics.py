@@ -89,6 +89,13 @@ class Metrics:
                 self._hists[k] = Histogram()
             self._hists[k].observe(v)
 
+    def seed_histogram(self, name: str, labels: str = ""):
+        """Materialise an empty histogram so its buckets scrape as 0,
+        not absent — the histogram analog of the inc(name, 0.0)
+        counter pre-seeds below."""
+        with self._lock:
+            self._hists.setdefault(self._key(name, labels), Histogram())
+
     def hist_totals(self, name: str) -> Tuple[int, float]:
         """(observation count, value sum) aggregated across every label
         set of a histogram — e.g. total device busy-seconds across all
@@ -194,7 +201,34 @@ GLOBAL.describe("tpu_model_radix_nodes",
                 "Radix prefix-cache tree nodes resident (one cached "
                 "page_size token chunk each)")
 GLOBAL.describe("tpu_model_radix_pages",
-                "Physical KV pages pinned by the radix prefix cache")
+                "Physical KV pages pinned by the radix prefix cache "
+                "(tier-0 nodes; spilled nodes hold host bytes instead)")
+GLOBAL.describe("tpu_model_tier_hit_tokens_total",
+                "Prompt tokens served from the tiered KV cache at "
+                "admission, by serving tier: 0 = HBM-resident radix "
+                "pages shared in place, 1 = host-arena pages restitched "
+                "by async host-to-HBM copy, 2 = fleet-snapshot pages "
+                "restitched after import")
+GLOBAL.describe("tpu_model_tier_miss_tokens_total",
+                "Prompt tokens prefilled at admission, by missed tier: "
+                "0 = never cached (cold), 1/2 = spilled pages the "
+                "copy-vs-recompute break-even model chose to recompute "
+                "instead of restitch")
+GLOBAL.describe("tpu_model_spilled_pages_total",
+                "Radix KV pages spilled from HBM to the tier-1 host "
+                "arena on LRU eviction (quiescent pages only; a plain "
+                "eviction under fence pressure does not count)")
+GLOBAL.describe("tpu_model_restitch_seconds",
+                "Stitch-call latency histogram for admissions that "
+                "restitched at least one host-tier page (enqueue-side: "
+                "the host-to-HBM uploads themselves run async, "
+                "overlapped with the tail prefill)")
+GLOBAL.describe("tpu_model_host_cache_bytes",
+                "Tier-1 host arena occupancy in bytes (live gauge; 0 "
+                "when TPU_HOST_CACHE_GB is unset)")
+GLOBAL.describe("tpu_model_host_cache_pages",
+                "Spilled KV pages resident in the tier-1 host arena "
+                "(live gauge)")
 GLOBAL.describe("tpu_model_async_fallback_total",
                 "Decode dispatches that fell back to synchronous while "
                 "TPU_ASYNC_DISPATCH was on: per-dispatch for grammar "
@@ -425,6 +459,7 @@ for _name in ("tpu_model_engine_restarts_total",
               "tpu_model_prefill_chunks_total",
               "tpu_model_prefix_hit_tokens_total",
               "tpu_model_prefix_miss_tokens_total",
+              "tpu_model_spilled_pages_total",
               "tpu_model_spec_drafted_tokens_total",
               "tpu_model_spec_accepted_tokens_total",
               # traffic counters: an idle (or freshly-restarted) server
@@ -458,6 +493,17 @@ for _cause in ("nondeterministic", "multimodal", "over_budget",
 # the async-fallback counter is labelled, so pre-seed every cause — an
 # alert on rate(cause="grammar") must read 0, not absent, while async
 # dispatch is running clean
+# tiered KV cache: the full 3-tier hit/miss matrix must read 0, not
+# absent, before the first admission — the churn dashboards compute
+# per-tier hit rates from these from the very first scrape
+for _tier in ("0", "1", "2"):
+    GLOBAL.inc("tpu_model_tier_hit_tokens_total", 0.0,
+               f'{{tier="{_tier}"}}')
+    GLOBAL.inc("tpu_model_tier_miss_tokens_total", 0.0,
+               f'{{tier="{_tier}"}}')
+# the restitch histogram likewise: a latency dashboard over a server
+# that has never restitched must read empty buckets, not "no data"
+GLOBAL.seed_histogram("tpu_model_restitch_seconds")
 for _cause in ("grammar", "spec", "paged_dp"):
     GLOBAL.inc("tpu_model_async_fallback_total", 0.0,
                f'{{cause="{_cause}"}}')
@@ -536,7 +582,8 @@ GLOBAL.inc("tpu_model_leader_lost_total", 0.0)
 for _point in ("admission.predict", "detok.feed", "engine.admit",
                "engine.step", "engine.watchdog", "follower.send",
                "gateway.route", "gateway.stream", "kube.request",
-               "operator.scrape", "pages.alloc", "scheduler.replay"):
+               "operator.scrape", "pages.alloc", "pages.restitch",
+               "pages.spill", "scheduler.replay"):
     GLOBAL.inc("tpu_model_chaos_events_total", 0.0,
                f'{{point="{_point}"}}')
 

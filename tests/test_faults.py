@@ -219,6 +219,21 @@ def test_chaos_metric_preseeds_mirror_fault_catalog():
             f"{series} not pre-seeded in server/metrics.py"
 
 
+def test_tier_metric_preseeds_cover_the_matrix():
+    """metrics.py pre-seeds the tiered-KV hit/miss matrix (tier 0/1/2),
+    the spill counter, and the restitch histogram so dashboards read 0,
+    not absent, on engines that never spill."""
+    rendered = METRICS.render()
+    for fam in ("tpu_model_tier_hit_tokens_total",
+                "tpu_model_tier_miss_tokens_total"):
+        for tier in ("0", "1", "2"):
+            series = f'{fam}{{tier="{tier}"}}'
+            assert series in rendered, f"{series} not pre-seeded"
+    assert "\ntpu_model_spilled_pages_total " in "\n" + rendered
+    assert "tpu_model_restitch_seconds_bucket" in rendered
+    assert "tpu_model_restitch_seconds_count 0" in rendered
+
+
 def test_retry_transient_backoff_and_classification():
     from ollama_operator_tpu.operator.client import (ApiError, Conflict,
                                                      NotFound,

@@ -1,0 +1,460 @@
+"""Tiered KV cache: host-RAM spill, async restitch, fleet snapshots.
+
+ISSUE 18 coverage: HostArena byte accounting and the break-even model,
+spill-on-evict only taking epoch-quiescent pages, restitched streams
+bit-identical to recomputed ones (greedy AND seeded, engine-level AND
+through the async/sync scheduler, cross-checked against a dense
+engine), LRU host-entry drop under arena pressure, probe tier
+transitions, the tier-2 export/import snapshot round-trip (plus the
+gguf/store persistence), supervised restart dropping tier-1 cleanly,
+and the pages.{spill,restitch} chaos drills (a failed spill is a plain
+eviction; a failed restitch is a clean cold fallback with no leaks).
+"""
+
+import dataclasses
+import pickle
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ollama_operator_tpu.models import decoder
+from ollama_operator_tpu.models.config import PRESETS
+from ollama_operator_tpu.runtime.engine import Engine, EngineConfig, SlotOptions
+from ollama_operator_tpu.runtime.faults import FAULTS
+from ollama_operator_tpu.runtime.host_cache import (HostArena,
+                                                    host_cache_bytes,
+                                                    worth_restitch)
+from ollama_operator_tpu.runtime.scheduler import Scheduler
+from ollama_operator_tpu.server.metrics import GLOBAL as METRICS
+
+BASE = PRESETS["tiny"]
+XLA = dataclasses.replace(BASE, kernels="xla")
+GREEDY = SlotOptions(temperature=0.0)
+SEEDED = SlotOptions(temperature=0.9, top_k=40)
+DENSE = EngineConfig(max_slots=4, max_seq_len=64, cache_dtype=jnp.float32,
+                     min_prefill_bucket=16)
+PAGED = dataclasses.replace(DENSE, paged=True, page_size=8)
+
+PREFIX = np.arange(1, 25, dtype=np.int32)          # 24 tokens = 3 pages
+FULL = np.concatenate([PREFIX, np.array([70, 71, 72], np.int32)])
+DONOR = np.concatenate([PREFIX, np.array([60, 61], np.int32)])
+PROMPT = np.array([3, 1, 4, 1, 5, 9, 2, 6], np.int32)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return decoder.init_params(BASE, jax.random.key(0), jnp.float32)
+
+
+@pytest.fixture()
+def arena_env(monkeypatch):
+    """Tier-1 arena on (~32 tiny-preset pages) for engines built after."""
+    monkeypatch.setenv("TPU_HOST_CACHE_GB", "0.001")
+
+
+def _gen(eng, slot, full, opts, n):
+    """Cold admission + n decode steps on one slot (slot left active)."""
+    first = eng.admit(slot, np.asarray(full, np.int32), opts)
+    return [first] + [int(eng.decode()[slot]) for _ in range(n)]
+
+
+def _drain(sched, deadline_s=5.0):
+    t1 = time.monotonic() + deadline_s
+    while ((sched.n_active or sched.engine.quarantined_pages)
+           and time.monotonic() < t1):
+        time.sleep(0.01)
+    assert sched.n_active == 0
+    assert sched.engine.quarantined_pages == 0
+
+
+def _seed_spilled_prefix(eng):
+    """Donate the 3-page PREFIX, then spill all of it to the host tier."""
+    toks = _gen(eng, 0, DONOR, GREEDY, 2)
+    eng.donate_prefix(0, list(DONOR) + toks[:-1])
+    assert eng.radix_pages == 3
+    assert eng.radix_evict(10) >= 3
+    assert eng.radix_pages == 0 and eng.radix_hosted == 3
+    assert eng.host_cache_pages == 3
+
+
+# ---------------------------------------------------------------------------
+# host accounting units (no engine)
+# ---------------------------------------------------------------------------
+
+def test_host_cache_bytes_parsing():
+    assert host_cache_bytes("0.5") == 1 << 29
+    assert host_cache_bytes("2") == 2 << 30
+    assert host_cache_bytes("0") == 0
+    assert host_cache_bytes("") == 0
+    assert host_cache_bytes("-1") == 0
+    assert host_cache_bytes("junk") == 0
+
+
+def test_host_arena_accounting():
+    page = ({"k": np.zeros((2, 8), np.float32)},
+            {"v": np.zeros((2, 8), np.float32)})   # 128 bytes
+    arena = HostArena(capacity_bytes=300, page_bytes=128)
+    assert arena.room_for(2) and not arena.room_for(3)
+    e1 = arena.store(page)
+    assert e1.nbytes == 128 and arena.used_bytes == 128
+    assert arena.n_entries == 1
+    e2 = arena.store(page, snapshot=True)
+    assert e2.snapshot and not e1.snapshot
+    assert not arena.room_for(1)                   # 256 + 128 > 300
+    arena.free(e1)
+    assert arena.used_bytes == 128 and e1.kv is None
+    arena.free(None)                               # tolerated no-op
+    arena.free_all([e2, None])
+    assert arena.used_bytes == 0 and arena.n_entries == 0
+    e3 = arena.store(page)
+    arena.clear()                                  # O(1) reset path
+    assert arena.used_bytes == 0 and arena.n_entries == 0
+    assert e3.kv is not None                       # entries die with nodes
+
+
+def test_worth_restitch_floor_and_cpu_default(monkeypatch):
+    monkeypatch.setenv("TPU_HOST_CACHE_BREAK_EVEN", "32")
+    assert worth_restitch(BASE, 0, 32, 10 ** 12)   # floor met: bytes moot
+    assert not worth_restitch(BASE, 0, 31, 1)
+    monkeypatch.delenv("TPU_HOST_CACHE_BREAK_EVEN")
+    # CPU mesh: no detectable peak -> the copy always beats recompute
+    assert worth_restitch(BASE, 0, 8, 1 << 30)
+    assert not worth_restitch(BASE, 0, 0, 0)       # empty run never uploads
+
+
+def test_arena_disabled_without_knob(params):
+    eng = Engine(XLA, params, ecfg=PAGED)
+    assert not eng.host_cache_enabled
+    assert eng.host_cache_pages == 0
+    toks = _gen(eng, 0, DONOR, GREEDY, 2)
+    eng.donate_prefix(0, list(DONOR) + toks[:-1])
+    sp0 = eng.n_spilled_pages
+    assert eng.radix_evict(10) >= 3                # classic tierless evict
+    assert eng.n_spilled_pages == sp0 and eng.radix_hosted == 0
+
+
+# ---------------------------------------------------------------------------
+# engine: spill -> restitch parity (greedy + seeded, vs dense reference)
+# ---------------------------------------------------------------------------
+
+def test_spill_restitch_stream_parity(params, arena_env):
+    """Restitched streams must be bit-identical to recomputed ones —
+    greedy and derived-seed sampling — and the paged tiered engine must
+    match a dense (non-paged, cache-free) engine on the same prompt."""
+    eng = Engine(XLA, params, ecfg=PAGED)
+    dense = Engine(XLA, params, ecfg=DENSE)
+    assert eng.host_cache_enabled and eng.host_page_bytes > 0
+    cold = {}
+    for key, opts in (("g", GREEDY), ("s", SEEDED)):
+        cold[key] = _gen(eng, 0, FULL, opts, 3)
+        eng.release(0)                             # no donation: stays cold
+        ref = _gen(dense, 0, FULL, opts, 3)
+        dense.release(0)
+        assert cold[key] == ref, f"paged-vs-dense cold drift ({key})"
+    assert eng.radix_nodes == 0
+    toks = _gen(eng, 0, DONOR, GREEDY, 2)
+    eng.donate_prefix(0, list(DONOR) + toks[:-1])
+    for key, opts in (("g", GREEDY), ("s", SEEDED)):
+        sp0 = eng.n_spilled_pages
+        m0 = METRICS.get("tpu_model_spilled_pages_total")
+        assert eng.radix_evict(10) >= 3            # quiescent: all spill
+        assert eng.n_spilled_pages - sp0 == 3
+        assert METRICS.get("tpu_model_spilled_pages_total") - m0 == 3
+        assert eng.radix_pages == 0 and eng.host_cache_pages == 3
+        assert eng.host_cache_used_bytes == 3 * eng.host_page_bytes
+        want, tier = eng.prefix_probe_tier(FULL)
+        assert want >= 24 and tier == 1
+        got = eng.stitch(0, FULL, want)
+        assert got >= 24
+        ls = eng.last_stitch
+        assert ls["t1"] >= 24 and ls["skip1"] == 0 and ls["t2"] == 0
+        first = eng.extend(0, FULL, got, opts)
+        out = [first] + [int(eng.decode()[0]) for _ in range(3)]
+        assert out == cold[key], f"restitched stream drift ({key})"
+        eng.release(0)
+        # the run was promoted back: pure-HBM path, arena drained
+        want, tier = eng.prefix_probe_tier(FULL)
+        assert tier == 0 and want >= 24
+        assert eng.host_cache_pages == 0 and eng.radix_pages == 3
+    eng._pt.check()
+
+
+def test_spill_requires_quiescent_pool(params, arena_env):
+    """Eviction with a decode in flight must NOT spill (the gather would
+    race the launched program): pages are plainly freed through the
+    epoch quarantine, and the same eviction after the fence spills."""
+    eng = Engine(XLA, params, ecfg=PAGED)
+    toks = _gen(eng, 0, DONOR, GREEDY, 2)
+    eng.donate_prefix(0, list(DONOR) + toks[:-1])
+    _gen(eng, 1, PROMPT, GREEDY, 1)
+    handle = eng.decode_n_launch(2)                # epoch opens, unretired
+    assert not eng._pt.quiescent
+    sp0 = eng.n_spilled_pages
+    assert eng.radix_evict(10) >= 3                # frees, must not spill
+    assert eng.n_spilled_pages == sp0
+    assert eng.host_cache_pages == 0 and eng.radix_nodes == 0
+    handle.wait()
+    eng.fence_quiesce()
+    eng.release(1)
+    assert eng._pt.quiescent
+    toks = _gen(eng, 0, DONOR, GREEDY, 2)
+    eng.donate_prefix(0, list(DONOR) + toks[:-1])
+    assert eng.radix_evict(10) >= 3                # fenced: now it spills
+    assert eng.n_spilled_pages - sp0 == 3
+    assert eng.host_cache_pages == 3
+    eng.radix_reset()
+    eng._pt.check()
+
+
+def test_host_lru_drop_under_arena_pressure(params, arena_env):
+    """An arena narrower than the spill set drops least-recently-used
+    tier-1 entries to admit new spills — occupancy never exceeds
+    capacity and the byte accounting stays exact."""
+    eng = Engine(XLA, params, ecfg=PAGED)
+    # shrink the arena to 2.5 pages (env gave a generous one)
+    eng._arena = HostArena(int(2.5 * eng.host_page_bytes),
+                           eng.host_page_bytes)
+    toks = _gen(eng, 0, DONOR, GREEDY, 2)
+    eng.donate_prefix(0, list(DONOR) + toks[:-1])
+    assert eng.radix_evict(10) >= 3
+    assert eng.host_cache_pages == 2               # LRU leaf made room
+    assert eng.radix_hosted == 2 and eng.radix_pages == 0
+    assert eng.host_cache_used_bytes <= eng.host_cache_capacity_bytes
+    # the surviving 16-token run still restitches and serves
+    want, tier = eng.prefix_probe_tier(FULL)
+    assert want == 16 and tier == 1
+    got = eng.stitch(0, FULL, want)
+    assert got == 16
+    eng.release(0)
+    eng._pt.check()
+
+
+def test_break_even_floor_skips_short_runs(params, arena_env, monkeypatch):
+    """A flat TPU_HOST_CACHE_BREAK_EVEN floor above the run length makes
+    the stitch recompute instead: the run stays spilled, skips are
+    counted by provenance, and the recomputed stream is identical."""
+    monkeypatch.setenv("TPU_HOST_CACHE_BREAK_EVEN", "1000")
+    eng = Engine(XLA, params, ecfg=PAGED)
+    cold = _gen(eng, 0, FULL, GREEDY, 3)
+    eng.release(0)
+    _seed_spilled_prefix(eng)
+    want, tier = eng.prefix_probe_tier(FULL)
+    assert want >= 24 and tier == 1
+    assert eng.stitch(0, FULL, want) == 0          # whole run under floor
+    ls = eng.last_stitch
+    assert ls["skip1"] == 24 and ls["t1"] == 0
+    assert eng.radix_hosted == 3                   # run stays spilled
+    out = _gen(eng, 0, FULL, GREEDY, 3)            # clean cold recompute
+    assert out == cold
+    eng.release(0)
+    eng._pt.check()
+
+
+# ---------------------------------------------------------------------------
+# scheduler: async/sync restitch parity + tier metrics
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("overlap", [True, False], ids=["async", "sync"])
+def test_scheduler_restitch_parity(params, arena_env, overlap):
+    """Through the real scheduler (double-buffered AND forced-sync): a
+    spilled prefix restitches transparently, the stream is bit-identical
+    to the cold one, and the tier-1 hit tokens land in the metrics."""
+    eng = Engine(XLA, params, ecfg=PAGED)
+    sched = Scheduler(eng, async_dispatch=overlap)
+    try:
+        out1 = list(sched.submit(FULL, max_tokens=4, opts=GREEDY).tokens())
+        _drain(sched)
+        assert eng.radix_pages == 3                # donated on finish
+        eng.fence_quiesce()                        # retire the last epoch
+        assert eng.radix_evict(10) >= 3
+        assert eng.host_cache_pages == 3
+        h0 = METRICS.get("tpu_model_tier_hit_tokens_total", '{tier="1"}')
+        fb0 = METRICS.get("tpu_model_async_fallback_total")
+        r2 = sched.submit(FULL, max_tokens=4, opts=GREEDY)
+        out2 = list(r2.tokens())
+        assert r2.error is None and out2 == out1
+        assert r2.stats.n_reused >= 24
+        _drain(sched)
+        assert (METRICS.get("tpu_model_tier_hit_tokens_total",
+                            '{tier="1"}') - h0) >= 24
+        # restitch never forces the dispatch loop out of double-buffering
+        assert METRICS.get("tpu_model_async_fallback_total") == fb0
+        assert eng.free_pages == eng._pt.data_pages - eng.radix_pages
+        eng._pt.check()
+    finally:
+        sched.shutdown()
+
+
+@pytest.mark.chaos
+def test_restart_drops_host_tier_cleanly(params, arena_env, monkeypatch):
+    """A supervised engine restart rebuilds device state, so the host
+    tier must die with the tree: no arena residue, no pinned pages, and
+    serving re-populates both tiers afterwards."""
+    monkeypatch.setenv("TPU_RESTART_REPLAY_MAX", "0")
+    eng = Engine(XLA, params, ecfg=PAGED)
+    sched = Scheduler(eng, restart_backoff=0.001)
+    try:
+        r1 = sched.submit(FULL, max_tokens=4, opts=GREEDY)
+        assert len(list(r1.tokens())) == 4
+        _drain(sched)
+        eng.fence_quiesce()
+        assert eng.radix_evict(10) >= 3
+        assert eng.host_cache_pages == 3
+        FAULTS.arm("engine.step", "fail:once")
+        r2 = sched.submit(PROMPT, max_tokens=4, opts=GREEDY)
+        with pytest.raises(RuntimeError):
+            list(r2.tokens())
+        t1 = time.monotonic() + 5
+        while sched.n_restarts < 1 and time.monotonic() < t1:
+            time.sleep(0.01)
+        assert sched.n_restarts >= 1 and not sched.broken
+        assert eng.radix_nodes == 0 and eng.radix_hosted == 0
+        assert eng.host_cache_pages == 0
+        assert eng.host_cache_used_bytes == 0
+        assert eng.free_pages == eng._pt.data_pages
+        r3 = sched.submit(FULL, max_tokens=4, opts=GREEDY)
+        assert len(list(r3.tokens())) == 4
+    finally:
+        sched.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# chaos: pages.spill / pages.restitch
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_pages_spill_fault_is_a_plain_eviction(params, arena_env):
+    """An armed pages.spill fault must degrade a spill to the tierless
+    eviction path: the page is freed, nothing lands in the arena, and
+    the next (disarmed) eviction spills normally."""
+    eng = Engine(XLA, params, ecfg=PAGED)
+    toks = _gen(eng, 0, DONOR, GREEDY, 2)
+    eng.donate_prefix(0, list(DONOR) + toks[:-1])
+    FAULTS.arm("pages.spill", "fail:once")
+    free0 = eng.free_pages
+    assert eng.radix_evict(1) == 1                 # freed, not spilled
+    assert eng.free_pages == free0 + 1
+    assert eng.host_cache_pages == 0 and eng.n_spilled_pages == 0
+    assert eng.radix_evict(1) == 1                 # disarmed: spills
+    assert eng.host_cache_pages == 1 and eng.n_spilled_pages == 1
+    eng.radix_reset()
+    eng._pt.check()
+
+
+@pytest.mark.chaos
+def test_pages_restitch_fault_falls_back_cold(params, arena_env):
+    """CI chaos drill: a restitch failing mid-stitch must fall back to a
+    clean cold prefill — bit-identical stream, zero reuse reported, no
+    leaked pages, page-table check() clean."""
+    eng = Engine(XLA, params, ecfg=PAGED)
+    sched = Scheduler(eng)
+    try:
+        out1 = list(sched.submit(FULL, max_tokens=4, opts=GREEDY).tokens())
+        _drain(sched)
+        eng.fence_quiesce()
+        assert eng.radix_evict(10) >= 3            # spill the donated run
+        assert eng.host_cache_pages == 3
+        FAULTS.arm("pages.restitch", "fail:once")
+        r2 = sched.submit(FULL, max_tokens=4, opts=GREEDY)
+        out2 = list(r2.tokens())
+        assert r2.error is None
+        assert out2 == out1                        # cold fallback stream
+        assert r2.stats.n_reused == 0              # it really went cold
+        _drain(sched)
+        assert eng.free_pages == eng._pt.data_pages - eng.radix_pages
+        eng._pt.check()
+        # recovery: the next hit restitches for real
+        r3 = sched.submit(FULL, max_tokens=4, opts=GREEDY)
+        assert list(r3.tokens()) == out1
+        assert r3.stats.n_reused >= 16
+    finally:
+        sched.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# tier 2: fleet prefix snapshots
+# ---------------------------------------------------------------------------
+
+def test_prefix_snapshot_round_trip(params, arena_env, tmp_path):
+    """export_prefixes -> gguf/store persistence -> import_prefixes into
+    a fresh engine: nodes arrive as tier-2 host entries, the probe says
+    tier 2, the stitched stream is bit-identical, and a geometry
+    mismatch or re-import is refused without side effects."""
+    from ollama_operator_tpu.gguf import store as gstore
+    eng = Engine(XLA, params, ecfg=PAGED)
+    cold = _gen(eng, 0, FULL, GREEDY, 3)
+    eng.release(0)
+    toks = _gen(eng, 0, DONOR, GREEDY, 2)
+    eng.donate_prefix(0, list(DONOR) + toks[:-1])
+    blob = eng.export_prefixes()
+    assert blob is not None
+    gstore.save_prefix_snapshot(str(tmp_path), "k1", blob)
+    assert gstore.load_prefix_snapshot(str(tmp_path), "missing") is None
+    blob = gstore.load_prefix_snapshot(str(tmp_path), "k1")
+    fresh = Engine(XLA, params, ecfg=PAGED)
+    assert fresh.import_prefixes(blob) == 3
+    assert fresh.radix_hosted == 3 and fresh.radix_pages == 0
+    assert fresh.host_cache_pages == 3
+    assert fresh.import_prefixes(blob) == 0        # idempotent re-import
+    want, tier = fresh.prefix_probe_tier(FULL)
+    assert want >= 24 and tier == 2
+    h2 = METRICS.get("tpu_model_tier_hit_tokens_total", '{tier="2"}')
+    got = fresh.stitch(0, FULL, want)
+    assert got >= 24
+    ls = fresh.last_stitch
+    assert ls["t2"] >= 24 and ls["t1"] == 0        # snapshot provenance
+    first = fresh.extend(0, FULL, got, GREEDY)
+    out = [first] + [int(fresh.decode()[0]) for _ in range(3)]
+    assert out == cold                             # warm replica parity
+    fresh.release(0)
+    assert METRICS.get("tpu_model_tier_hit_tokens_total",
+                       '{tier="2"}') == h2         # engine-level: no attrib
+    # geometry guard: a snapshot from a different page size is refused
+    data = pickle.loads(blob)
+    data["ps"] = 16
+    assert fresh.import_prefixes(pickle.dumps(data)) == 0
+    assert fresh.import_prefixes(b"corrupt") == 0
+    fresh._pt.check()
+    eng._pt.check()
+
+
+def test_snapshot_export_respects_byte_budget(params, arena_env):
+    """The export budget is honoured greedily MRU-first: a budget below
+    one page yields no snapshot, a one-page budget ships exactly the
+    root chunk (children only ship when their parent made the cut)."""
+    eng = Engine(XLA, params, ecfg=PAGED)
+    toks = _gen(eng, 0, DONOR, GREEDY, 2)
+    eng.donate_prefix(0, list(DONOR) + toks[:-1])
+    assert eng.export_prefixes(max_bytes=1) is None
+    one = eng.export_prefixes(max_bytes=eng.host_page_bytes + 4096)
+    assert one is not None
+    fresh = Engine(XLA, params, ecfg=PAGED)
+    assert fresh.import_prefixes(one) == 1         # rooted single chunk
+    want, tier = fresh.prefix_probe_tier(FULL)
+    assert want == 8 and tier == 2
+    fresh._pt.check()
+
+
+def test_scheduler_attributes_tier2_hits(params, arena_env):
+    """A just-woken replica's first shared-prefix request through the
+    scheduler must be a warm tier-2 hit in the metrics matrix."""
+    eng = Engine(XLA, params, ecfg=PAGED)
+    toks = _gen(eng, 0, DONOR, GREEDY, 2)
+    eng.donate_prefix(0, list(DONOR) + toks[:-1])
+    blob = eng.export_prefixes()
+    fresh = Engine(XLA, params, ecfg=PAGED)
+    assert fresh.import_prefixes(blob) == 3
+    sched = Scheduler(fresh)
+    try:
+        h2 = METRICS.get("tpu_model_tier_hit_tokens_total", '{tier="2"}')
+        r = sched.submit(FULL, max_tokens=4, opts=GREEDY)
+        assert len(list(r.tokens())) == 4 and r.error is None
+        assert r.stats.n_reused >= 24              # warm first request
+        _drain(sched)
+        assert (METRICS.get("tpu_model_tier_hit_tokens_total",
+                            '{tier="2"}') - h2) >= 24
+    finally:
+        sched.shutdown()
