@@ -2673,6 +2673,331 @@ def _measure_fleet(jax, *, model: str, dtype: str, slots: int, steps: int,
     return rec
 
 
+def measure_disagg(jax, **kw) -> dict:
+    """Disagg arm wrapper: same persistent-cache hazard as the fleet
+    arm (several identical engines compiling concurrently in-process)."""
+    cache = getattr(jax.config, "jax_compilation_cache_dir", None)
+    if cache:
+        jax.config.update("jax_compilation_cache_dir", None)
+    try:
+        return _measure_disagg(jax, **kw)
+    finally:
+        if cache:
+            jax.config.update("jax_compilation_cache_dir", cache)
+
+
+def _measure_disagg(jax, *, model: str, dtype: str, slots: int, steps: int,
+                    seq: int, prompt_len: int, paged: bool, mixed: bool,
+                    chunk: int, page_size: int, n_pages: int | None,
+                    platform: str, params_cache: dict | None = None,
+                    env: dict | None = None) -> dict:
+    """Disaggregated prefill/decode arm (ISSUE 20): steady decode load,
+    then the same decode load under a long-prompt prefill burst — once
+    against a unified 2-replica fleet, once against a 1-prefill +
+    1-decode split. The claim that gates: the split keeps decode ITL
+    p99 ~flat under the burst (prefill compute lands on the other
+    pool), the handoff streams are byte-identical to the unified
+    references, real KV pages moved over /api/kv_export -> /api/kv_import,
+    and tpu_model_async_fallback_total stays 0 throughout.
+    BENCH_ASSERT_DISAGG=1 hard-fails on the policy invariants and on
+    the (grace-adjusted) disagg ITL ratio ceiling."""
+    import gc
+    import json as _json
+    import tempfile
+    import threading
+    import urllib.request
+
+    from ollama_operator_tpu.models.config import get_config
+    from ollama_operator_tpu.operator.gateway import Gateway
+    from ollama_operator_tpu.runtime.engine import (EngineConfig,
+                                                    resolve_cache_dtype)
+    from ollama_operator_tpu.runtime.service import LoadedModel
+    from ollama_operator_tpu.server.app import ModelManager, serve
+    from ollama_operator_tpu.server.metrics import GLOBAL as METRICS
+    from ollama_operator_tpu.server.names import ModelName
+
+    on_cpu = platform == "cpu"
+    if on_cpu:
+        dtype = "float32"
+    kv_dtype = resolve_cache_dtype(
+        os.environ.get("BENCH_KV_DTYPE", "float32" if on_cpu else "int8"))
+    cfg = get_config(model)
+    params, param_bytes, dtype = _bench_params(
+        jax, cfg, model, dtype, on_cpu, params_cache)
+    tok = _bench_tokenizer(cfg.vocab_size)
+    name = ModelName.parse("bench").short
+
+    serve_seq = min(seq, cfg.max_seq_len)
+    ps = max(8, min(page_size, serve_seq // 8))
+    burst_prompt_len = min(512, serve_seq // 2)
+    chunk_eff = max(2, min(chunk, serve_seq // 32))
+    decode_tokens = max(16, min(48, steps))
+    n_decode = 3          # concurrent interactive decode streams
+    n_burst = 4           # long-prompt prefill requests in the burst
+    pool = (n_pages
+            or slots * (-(-serve_seq // ps) + 2) + burst_prompt_len // ps)
+    log(f"bench: disagg capture model={model} burst_prompt="
+        f"{burst_prompt_len} decode_tokens={decode_tokens} ps={ps}")
+
+    burst_system = ("Summarize the following operations report. "
+                    * (burst_prompt_len // 8 + 1))[:burst_prompt_len]
+    decode_prompts = [f"chat-{i}-" + "t" * 24 for i in range(n_decode)]
+    burst_tails = [(f"-b{i:02d}" * 8)[:24] for i in range(n_burst)]
+
+    def make_server():
+        lm = LoadedModel(
+            name, cfg, params, tok,
+            ecfg=EngineConfig(max_slots=slots, max_seq_len=serve_seq,
+                              decode_chunk=chunk_eff, cache_dtype=kv_dtype,
+                              paged=True, page_size=ps, n_pages=pool,
+                              min_prefill_bucket=16))
+        tmp = tempfile.mkdtemp(prefix="bench-disagg-")
+        manager = ModelManager(tmp, serve_models=True, default_keep_alive=-1)
+        manager.loaded = lm
+        httpd = serve(manager, "127.0.0.1", 0)
+        return lm, manager, httpd
+
+    def teardown(servers):
+        for lm, manager, httpd in servers:
+            httpd.shutdown()
+            manager.loaded = None
+            lm.unload()
+
+    def stream(base, prompt_text, n_predict, record):
+        """One greedy stream; fills ``record`` with text/errors (greedy
+        = the cross-arm bit-identity oracle)."""
+        req = urllib.request.Request(
+            base + "/api/generate",
+            data=_json.dumps({
+                "model": "bench", "prompt": prompt_text, "stream": True,
+                "options": {"num_predict": n_predict,
+                            "temperature": 0.0}}).encode(),
+            headers={"Content-Type": "application/json"})
+        text, errors = [], []
+        with urllib.request.urlopen(req, timeout=600) as resp:
+            for line in resp:
+                if not line.strip():
+                    continue
+                frame = _json.loads(line)
+                if "error" in frame:
+                    errors.append(frame)
+                elif not frame.get("done"):
+                    text.append(frame.get("response") or "")
+        record["text"] = "".join(text)
+        record["errors"] = errors
+
+    def itl_snap():
+        return METRICS.hist_buckets("tpu_model_itl_seconds")
+
+    def itl_p99_ms(before, after):
+        """Interpolated p99 (histogram_quantile style) of the decode
+        ITL observations made between two hist_buckets snapshots. The
+        random-byte bench tokenizer defeats client-side frame timing
+        (the incremental detokenizer buffers invalid UTF-8 until the
+        stream ends), so the engine's chunk-normalized ITL histogram is
+        the cadence a real client would see."""
+        bounds, b0 = before
+        delta = [a - b for a, b in zip(after[1], b0)]
+        n = sum(delta)
+        if not n:
+            return None
+        rank, cum, lo = 0.99 * n, 0, 0.0
+        for i, c in enumerate(delta):
+            if cum + c >= rank and c:
+                hi = bounds[i] if i < len(bounds) else bounds[-1] * 2
+                return round((lo + (hi - lo) * (rank - cum) / c) * 1e3, 2)
+            cum += c
+            if i < len(bounds):
+                lo = bounds[i]
+        return round(bounds[-1] * 2 * 1e3, 2)
+
+    def run_phase(base, burst: bool):
+        """n_decode interactive streams under an ITL-histogram window,
+        optionally with the prefill burst riding along. Returns
+        (decode_records, burst_records, itl_p99_ms)."""
+        recs = [{} for _ in range(n_decode)]
+        brecs = [{} for _ in range(n_burst)] if burst else []
+        ts = [threading.Thread(target=stream,
+                               args=(base, decode_prompts[i],
+                                     decode_tokens, recs[i]))
+              for i in range(n_decode)]
+        bs = [threading.Thread(target=stream,
+                               args=(base, burst_system + burst_tails[i],
+                                     2, brecs[i]))
+              for i in range(len(brecs))]
+        snap0 = itl_snap()
+        for t in ts:
+            t.start()
+        for t in bs:                     # burst lands on live decode load
+            t.start()
+        for t in ts + bs:
+            t.join()
+        return recs, brecs, itl_p99_ms(snap0, itl_snap())
+
+    def run_arm(pools: list | None):
+        """Boot a 2-replica fleet (split when ``pools``), run steady
+        then burst, tear down. Returns the arm record."""
+        servers = [make_server() for _ in range(2)]
+        # the handoff timeout is read per-request, so the overrides stay
+        # in place for the whole arm (unlike the fleet arm's
+        # construction-time-only knobs)
+        arm_env = {
+            "TPU_GATEWAY_EJECT_FAILURES": "3",
+            "TPU_GATEWAY_EJECT_S": "60",
+            "TPU_GATEWAY_SLOW_SCRAPE_MS": "30000",
+            "TPU_DISAGG_HANDOFF_TIMEOUT_S": "60",
+        }
+        saved = {k: os.environ.get(k) for k in arm_env}
+        os.environ.update(arm_env)
+        try:
+            reps = [(f"r{i}",
+                     f"http://127.0.0.1:{s[2].server_address[1]}")
+                    + ((pools[i],) if pools else ())
+                    for i, s in enumerate(servers)]
+            gw = Gateway(replicas=reps, port=0, scrape_period_s=0.2)
+            gw.start()
+            t0 = time.perf_counter()
+            warm, _, _ = run_phase(gw.base_url, burst=False)  # compile pass
+            steady, _, steady_p99 = run_phase(gw.base_url, burst=False)
+            burst, brecs, burst_p99 = run_phase(gw.base_url, burst=True)
+            wall = time.perf_counter() - t0
+            journal = gw.journal_stats()
+            gw.stop()
+        finally:
+            for k, old in saved.items():
+                if old is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = old
+        teardown(servers)
+        del servers
+        gc.collect()
+        # CPU smoke grace: both pools share ONE host CPU in-process, so
+        # a burst steals decode cycles the split architecture isolates
+        # on real hardware — allow one decode-chunk quantum of absolute
+        # per-token headroom (the overload arm's TTFT-grace precedent)
+        grace_ms = 50.0 if on_cpu else 0.0
+        ratio = (round(max(burst_p99 - grace_ms, steady_p99)
+                       / max(steady_p99, 1e-6), 2)
+                 if burst_p99 is not None and steady_p99 else None)
+        return {
+            "steady_itl_p99_ms": steady_p99,
+            "burst_itl_p99_ms": burst_p99,
+            "itl_p99_ratio": ratio,
+            "itl_p99_ratio_raw": (round(burst_p99 / max(steady_p99, 1e-6), 2)
+                                  if burst_p99 and steady_p99 else None),
+            "decode_texts": {decode_prompts[i]: steady[i]["text"]
+                             for i in range(n_decode)},
+            "burst_texts": {burst_tails[i]: brecs[i]["text"]
+                            for i in range(n_burst)} if brecs else {},
+            "error_frames": sum(len(r["errors"])
+                                for rs in (warm, steady, burst, brecs)
+                                for r in rs),
+            "journal_live": journal["live"],
+            "wall_s": round(wall, 2),
+        }
+
+    def handoffs(result):
+        return METRICS.get("tpu_model_disagg_handoffs_total",
+                           f'{{result="{result}"}}')
+
+    fallback0 = METRICS.get("tpu_model_async_fallback_total")
+    unified = run_arm(None)
+    log(f"bench: disagg unified arm itl_ratio={unified['itl_p99_ratio']}")
+
+    h0 = {r: handoffs(r)
+          for r in ("transferred", "replayed", "unified_fallback")}
+    pages0 = METRICS.get("tpu_model_kv_transfer_pages_total")
+    bytes0 = METRICS.get("tpu_model_kv_transfer_bytes_total")
+    disagg = run_arm(["prefill", "decode"])
+    h_delta = {r: int(handoffs(r) - h0[r])
+               for r in ("transferred", "replayed", "unified_fallback")}
+    pages_moved = int(METRICS.get("tpu_model_kv_transfer_pages_total")
+                      - pages0)
+    bytes_moved = int(METRICS.get("tpu_model_kv_transfer_bytes_total")
+                      - bytes0)
+    fallback_delta = int(METRICS.get("tpu_model_async_fallback_total")
+                         - fallback0)
+    log(f"bench: disagg split arm itl_ratio={disagg['itl_p99_ratio']} "
+        f"handoffs={h_delta} pages={pages_moved}")
+
+    # bit-identity: every disagg stream (handoff splice included) must
+    # reproduce the unified arm's bytes — greedy text is a pure function
+    # of the prompt, so any splice seam shows up as a diff
+    mismatched = sorted(
+        k for k in unified["decode_texts"]
+        if disagg["decode_texts"].get(k) != unified["decode_texts"][k])
+    mismatched += sorted(
+        k for k in unified["burst_texts"]
+        if disagg["burst_texts"].get(k) != unified["burst_texts"][k])
+
+    rec = {
+        "model": model,
+        "mode": "disagg",
+        "n_decode_streams": n_decode,
+        "n_burst_requests": n_burst,
+        "decode_tokens": int(decode_tokens),
+        "burst_prompt_len": int(burst_prompt_len),
+        "unified_itl_steady_p99_ms": unified["steady_itl_p99_ms"],
+        "unified_itl_burst_p99_ms": unified["burst_itl_p99_ms"],
+        "unified_itl_p99_ratio": unified["itl_p99_ratio"],
+        "disagg_itl_steady_p99_ms": disagg["steady_itl_p99_ms"],
+        "disagg_itl_burst_p99_ms": disagg["burst_itl_p99_ms"],
+        "disagg_itl_p99_ratio": disagg["itl_p99_ratio"],
+        "disagg_itl_p99_ratio_raw": disagg["itl_p99_ratio_raw"],
+        "handoffs": h_delta,
+        "kv_transfer_pages": pages_moved,
+        "kv_transfer_bytes": bytes_moved,
+        "async_fallbacks": fallback_delta,
+        "handoff_bit_identical": not mismatched,
+        "mismatched_streams": mismatched,
+        "client_error_frames": (unified["error_frames"]
+                                + disagg["error_frames"]),
+        "journal_live": unified["journal_live"] + disagg["journal_live"],
+        "pool_replicas": {"prefill": 1, "decode": 1},
+        "page_size": int(ps),
+        "slots": slots,
+        "dtype": dtype,
+        "paged": True,
+        "seq": int(serve_seq),
+        "wall_s": round(unified["wall_s"] + disagg["wall_s"], 2),
+    }
+    if env:
+        rec["env"] = dict(env)
+    log(f"bench: disagg capture done: {json.dumps(rec)}")
+    if os.environ.get("BENCH_ASSERT_DISAGG") == "1":
+        problems = []
+        ratio = rec["disagg_itl_p99_ratio"]
+        ceiling = float(os.environ.get("BENCH_DISAGG_RATIO_MAX", "2.0"))
+        if ratio is None or ratio > ceiling:
+            problems.append(
+                f"disagg decode ITL p99 ratio {ratio} > {ceiling} "
+                f"(steady={rec['disagg_itl_steady_p99_ms']}ms "
+                f"burst={rec['disagg_itl_burst_p99_ms']}ms)")
+        if mismatched:
+            problems.append(f"handoff streams diverged from unified "
+                            f"references: {mismatched}")
+        if rec["client_error_frames"]:
+            problems.append(f"{rec['client_error_frames']} client-visible "
+                            f"error frames (want 0)")
+        if h_delta["transferred"] < 1:
+            problems.append(f"no handoff ever moved KV pages: {h_delta}")
+        if pages_moved < 1:
+            problems.append("kv_transfer_pages_total never moved")
+        if fallback_delta:
+            problems.append(f"tpu_model_async_fallback_total moved by "
+                            f"{fallback_delta} (want 0)")
+        if rec["journal_live"]:
+            problems.append(f"journal not drained: {rec['journal_live']} "
+                            f"live entries")
+        if problems:
+            raise AssertionError("disagg arm failed: "
+                                 + "; ".join(problems))
+    del params
+    gc.collect()
+    return rec
+
+
 class _StallProxy:
     """TCP proxy in front of one in-process replica that can WEDGE (not
     sever) the replica->gateway direction mid-response. arm(n) applies
@@ -3118,6 +3443,8 @@ def main() -> None:
                                               "") == "1",
                      gateway_restart_arm=os.environ.get(
                          "BENCH_GATEWAY_RESTART_ARM", "") == "1",
+                     disagg_arm=os.environ.get("BENCH_DISAGG_ARM",
+                                               "") == "1",
                      **knobs)]
     elif platform == "cpu":
         # unpinned CPU smoke: tiny model, but every knob still applies
@@ -3190,6 +3517,14 @@ def main() -> None:
             # BENCH_ASSERT_GATEWAY_RESTART=1 gates on it
             plan.append({**smoke, "gateway_restart_arm": True,
                          "slots": 2})
+        if os.environ.get("BENCH_DISAGG_ARM", "") == "1":
+            # disaggregated prefill/decode (ISSUE 20): steady decode load
+            # vs the same load under a long-prompt prefill burst, unified
+            # 2-replica fleet vs a 1+1 pool split — decode ITL p99 must
+            # stay ~flat under the burst, handoff streams byte-identical
+            # to the unified references, real KV pages moved, and
+            # async_fallback_total 0. BENCH_ASSERT_DISAGG=1 gates on it
+            plan.append({**smoke, "disagg_arm": True, "slots": 2})
         if os.environ.get("BENCH_SPEC_ARM", "") == "1":
             # fused prompt-lookup speculation (ISSUE 6): lookup /
             # accept_all / reject_all sub-arms on a repetition-heavy
@@ -3340,8 +3675,10 @@ def main() -> None:
         coldstart_arm = cap.pop("coldstart_arm", False)
         fleet_arm = cap.pop("fleet_arm", False)
         gateway_restart_arm = cap.pop("gateway_restart_arm", False)
+        disagg_arm = cap.pop("disagg_arm", False)
         try:
-            fn = (measure_gateway_restart if gateway_restart_arm
+            fn = (measure_disagg if disagg_arm
+                  else measure_gateway_restart if gateway_restart_arm
                   else measure_fleet if fleet_arm
                   else measure_coldstart if coldstart_arm
                   else measure_restart if restart_arm
@@ -3516,6 +3853,19 @@ def assemble(captures: list, platform: str, n_devices: int) -> str:
             gwr_restore_ms = c.get("restore_ms")
             gwr_resume_ms = c.get("resume_ms")
             break
+    # disaggregated prefill/decode (ISSUE 20 acceptance: decode ITL p99
+    # stays ~flat under a prefill burst, handoff streams byte-identical
+    # to the unified references, real pages moved, async_fallback 0)
+    disagg_itl_ratio = disagg_bit_identical = disagg_handoffs = None
+    disagg_pages = disagg_errors = None
+    for c in captures:
+        if c.get("mode") == "disagg":
+            disagg_itl_ratio = c.get("disagg_itl_p99_ratio")
+            disagg_bit_identical = c.get("handoff_bit_identical")
+            disagg_handoffs = (c.get("handoffs") or {}).get("transferred")
+            disagg_pages = c.get("kv_transfer_pages")
+            disagg_errors = c.get("client_error_frames")
+            break
     # fused paged-attention A/B (ISSUE 16): pair the TPU_PAGED_FUSED=0
     # reference with the fused capture of the same config — the ratio is
     # tokens-per-HBM-byte (tok_s x bytes/step, the steps cancel), i.e.
@@ -3610,6 +3960,11 @@ def assemble(captures: list, platform: str, n_devices: int) -> str:
         "gateway_restart_restored_streams": gwr_restored,
         "gateway_restart_restore_ms": gwr_restore_ms,
         "gateway_restart_resume_ms": gwr_resume_ms,
+        "disagg_itl_p99_ratio": disagg_itl_ratio,
+        "disagg_handoff_bit_identical": disagg_bit_identical,
+        "disagg_handoffs_transferred": disagg_handoffs,
+        "disagg_kv_transfer_pages": disagg_pages,
+        "disagg_client_error_frames": disagg_errors,
         "paged_bw_ratio": paged_bw_ratio,
         "paged_fused_recompiles": paged_fused_recompiles,
         "kv_int4_tok_s_ratio": kv_int4_tok_s_ratio,

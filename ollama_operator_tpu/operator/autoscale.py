@@ -82,10 +82,18 @@ class Policy:
     flap_hold_s: float = 180.0
     remediation_backoff_s: float = 10.0
     remediation_backoff_cap_s: float = 300.0
+    # which demand signal drives hot/cold (disaggregated pools scale on
+    # different physics): "both" (unified fleets — occupancy OR backlog),
+    # "backlog" (prefill pool: queued prompt tokens / TTFT risk), or
+    # "occupancy" (decode pool: slot occupancy)
+    signal: str = "both"
 
 
-def resolve_policy(spec_block: Dict[str, Any]) -> Policy:
-    """Merge `spec.autoscale` over `TPU_AUTOSCALE_*` env defaults."""
+def resolve_policy(spec_block: Dict[str, Any],
+                   signal: str = "both") -> Policy:
+    """Merge `spec.autoscale` over `TPU_AUTOSCALE_*` env defaults.
+    ``signal`` is the default demand signal (a ``signal`` field in the
+    spec block still wins)."""
     b = spec_block or {}
 
     def pick_f(key: str, env: str, default: float) -> float:
@@ -139,8 +147,36 @@ def resolve_policy(spec_block: Dict[str, Any]) -> Policy:
         remediation_backoff_cap_s=pick_f("remediationBackoffCapSeconds",
                                          "TPU_REMEDIATION_BACKOFF_CAP_S",
                                          300.0),
+        signal=str(b.get("signal") or signal),
     )
     return pol
+
+
+def pool_policy(autoscale_block: Dict[str, Any],
+                pool_block: Dict[str, Any], pool: str) -> Policy:
+    """Resolved policy for one disaggregated pool: the pool's block in
+    ``spec.disaggregate`` wins over the Model's ``spec.autoscale``, with
+    per-pool env floors (``TPU_DISAGG_PREFILL_MIN`` /
+    ``TPU_DISAGG_PREFILL_MAX`` / ``TPU_DISAGG_DECODE_MIN`` /
+    ``TPU_DISAGG_DECODE_MAX``) and the pool's native demand signal:
+    the prefill pool scales on queued backlog tokens, the decode pool
+    on slot occupancy."""
+    merged = dict(autoscale_block or {})
+    merged.update({k: v for k, v in (pool_block or {}).items()
+                   if v is not None})
+    if pool == "prefill":
+        sig = "backlog"
+        lo = _env_i("TPU_DISAGG_PREFILL_MIN", 1)
+        hi = _env_i("TPU_DISAGG_PREFILL_MAX", 4)
+    else:
+        sig = "occupancy"
+        lo = _env_i("TPU_DISAGG_DECODE_MIN", 1)
+        hi = _env_i("TPU_DISAGG_DECODE_MAX", 8)
+    if merged.get("minReplicas") is None:
+        merged["minReplicas"] = lo
+    if merged.get("maxReplicas") is None:
+        merged["maxReplicas"] = hi
+    return resolve_policy(merged, signal=sig)
 
 
 @dataclasses.dataclass
@@ -316,11 +352,23 @@ class Autoscaler:
         if obs.ttft_slo_ms > 0 and obs.backlog_tokens > 0:
             gp = max(obs.goodput_tok_s, 1e-6)
             slo_risk = (obs.backlog_tokens / gp) * 1000.0 > obs.ttft_slo_ms
-        hot = (obs.occupancy >= policy.target_occupancy
-               or obs.backlog_tokens > per_rep
-               or slo_risk)
-        cold = (obs.occupancy <= policy.low_occupancy
-                and obs.queue_depth == 0 and obs.backlog_tokens == 0)
+        occ_hot = obs.occupancy >= policy.target_occupancy
+        backlog_hot = obs.backlog_tokens > per_rep or slo_risk
+        if policy.signal == "backlog":
+            # prefill pool: demand is the queued prompt-token backlog;
+            # occupancy of 1-token decode slots says nothing here
+            hot = backlog_hot
+            cold = obs.queue_depth == 0 and obs.backlog_tokens == 0
+        elif policy.signal == "occupancy":
+            # decode pool: demand is slot occupancy; backlog queues on
+            # the prefill pool, not here
+            hot = occ_hot
+            cold = (obs.occupancy <= policy.low_occupancy
+                    and obs.queue_depth == 0)
+        else:
+            hot = occ_hot or backlog_hot
+            cold = (obs.occupancy <= policy.low_occupancy
+                    and obs.queue_depth == 0 and obs.backlog_tokens == 0)
         st.hot_streak = st.hot_streak + 1 if hot else 0
         st.cold_streak = st.cold_streak + 1 if cold else 0
         if obs.busy:

@@ -50,10 +50,35 @@ dies mid-stream:
 - *non-replayable* streams (unseeded sampling) get the classic
   exactly-once error frame with a computed finite ``retry_after_s``.
 
+**Disaggregated serving** (ISSUE 20, ``TPU_DISAGG``). When the fleet is
+split into a prefill pool and a decode pool (pod label
+``ollama.ayaka.io/pool``), a replayable generation runs as a PLANNED
+failover built from the exact machinery above: the request is first
+dispatched to a prefill replica with ``options.disagg_prefill=true``
+(the replica prefill + emits ONE token, parks the prompt's KV in its
+radix tree, and finishes with ``done_reason:"handoff"``); the gateway
+holds that final frame, asks a decode replica to pull the KV pages
+straight from the prefill replica (``/api/kv_import`` with
+``source=<prefill url>``; the replica-to-replica pull is paced by
+``TPU_DISAGG_TRANSFER_MB_S``), then re-dispatches the FULL request to
+the decode pool — `_pump`'s skip-and-verify splice consumes the decode
+replica's regenerated prefix silently (bit-identity or bust) and
+continues on the same client connection. Every degraded rung is a rung
+of the existing ladder: transfer failed → the decode replica simply
+re-prefills (journal replay); prefill replica died mid-handoff →
+replay/requeue on the decode pool; decode pool empty → unified serving
+on any routable replica. ``tpu_model_disagg_handoffs_total{result}``
+counts the rung taken; non-replayable streams skip the handoff and are
+served directly by the decode pool. The ``gateway.handoff`` fault point
+fires between the held handoff frame and the KV transfer — the chaos
+drills kill the orchestration there and assert zero client-visible
+error frames.
+
 Chaos hooks: ``gateway.route`` fires after a replica is picked but
 before dispatch (a fail counts as that replica failing); ``gateway.stream``
 fires per upstream frame (a fail severs the upstream exactly like a
-replica death — the drill the failover machinery is tested by).
+replica death — the drill the failover machinery is tested by);
+``gateway.handoff`` fires mid-handoff (see above).
 """
 
 from __future__ import annotations
@@ -79,6 +104,10 @@ from .client import fetch_replica_ps
 
 STATES = ("probe", "healthy", "ejected", "half_open", "draining")
 ROUTABLE = ("healthy", "half_open", "probe")
+# pod label carrying a replica's pool in a disaggregated fleet
+# ("prefill" / "decode"; absent = unified)
+POOL_LABEL = "ollama.ayaka.io/pool"
+POOLS = ("unified", "prefill", "decode")
 
 # Live gateways for the circuit-state gauges: registered once at module
 # import (described + asserted by metrics-lint), summed over instances so
@@ -97,6 +126,19 @@ for _s in STATES:
     METRICS.gauge_fn("tpu_model_gateway_replicas",
                      (lambda s=_s: _state_total(s)),
                      labels=f'{{state="{_s}"}}')
+
+
+def _pool_total(pool: str) -> float:
+    n = 0
+    for gw in list(_LIVE):
+        n += gw.pool_counts().get(pool, 0)
+    return float(n)
+
+
+for _p in POOLS:
+    METRICS.gauge_fn("tpu_model_disagg_pool_replicas",
+                     (lambda p=_p: _pool_total(p)),
+                     labels=f'{{pool="{_p}"}}')
 
 
 class NoReplicas(Exception):
@@ -125,9 +167,10 @@ class Replica:
     """One backend server and its health/circuit bookkeeping. All fields
     are guarded by the owning Gateway's lock."""
 
-    def __init__(self, name: str, url: str):
+    def __init__(self, name: str, url: str, pool: str = ""):
         self.name = name
         self.url = url.rstrip("/")
+        self.pool = pool            # "" unified, else "prefill"/"decode"
         self.state = "probe"
         self.fails = 0              # consecutive failures
         self.ejected_until = 0.0
@@ -140,6 +183,7 @@ class Replica:
 
     def view(self) -> Dict[str, Any]:
         return {"name": self.name, "url": self.url, "state": self.state,
+                "pool": self.pool or "unified",
                 "load": self.load, "scrape_ms": round(self.scrape_ms, 1),
                 "served": self.served, "failed": self.failed,
                 "last_error": self.last_error}
@@ -150,8 +194,10 @@ def kube_discovery(kube, namespace: str, app: str,
     """Replica discovery over a KubeClient-shaped object (the real client
     or tests/fake_kube.FakeKube): ready pods of the model workload, named
     by pod name, addressed by podIP. Drain victims are surfaced too — the
-    scrape sees their /readyz say draining and parks them."""
-    def discover() -> List[Tuple[str, str]]:
+    scrape sees their /readyz say draining and parks them. A pod labeled
+    ``ollama.ayaka.io/pool`` joins that pool (disaggregated fleets);
+    unlabeled pods are the unified fleet."""
+    def discover() -> List[Tuple[str, str, str]]:
         try:
             pods = kube.list("v1", "Pod", namespace,
                              label_selector=f"app={app}")
@@ -163,8 +209,10 @@ def kube_discovery(kube, namespace: str, app: str,
                           .get("name", "")):
             ip = (pod.get("status") or {}).get("podIP")
             name = (pod.get("metadata") or {}).get("name", "")
+            pool = ((pod.get("metadata") or {}).get("labels")
+                    or {}).get(POOL_LABEL, "")
             if ip and name:
-                out.append((name, f"http://{ip}:{port}"))
+                out.append((name, f"http://{ip}:{port}", pool))
         return out
     return discover
 
@@ -330,9 +378,15 @@ class Gateway:
         self._lock = threading.Lock()
         self._replicas: "OrderedDict[str, Replica]" = OrderedDict()
         for item in replicas or []:
-            name, url = (item if isinstance(item, tuple)
-                         else (f"replica-{len(self._replicas)}", item))
-            self._replicas[name] = Replica(name, url)
+            pool = ""
+            if isinstance(item, tuple):
+                if len(item) == 3:
+                    name, url, pool = item
+                else:
+                    name, url = item
+            else:
+                name, url = f"replica-{len(self._replicas)}", item
+            self._replicas[name] = Replica(name, url, pool)
         # chain hash -> replica name, LRU-bounded; the gateway-side mirror
         # of "whose radix tree holds this prefix"
         self._affinity: "OrderedDict[str, str]" = OrderedDict()
@@ -438,14 +492,16 @@ class Gateway:
     def refresh_replicas(self) -> None:
         if self._discover is None:
             return
-        found = self._discover()
+        found = [(item if len(item) == 3 else (item[0], item[1], ""))
+                 for item in self._discover()]
         with self._lock:
-            names = {n for n, _ in found}
-            for name, url in found:
+            names = {n for n, _, _ in found}
+            for name, url, pool in found:
                 if name not in self._replicas:
-                    self._replicas[name] = Replica(name, url)
+                    self._replicas[name] = Replica(name, url, pool)
                 else:
                     self._replicas[name].url = url.rstrip("/")
+                    self._replicas[name].pool = pool
             for name in [n for n in self._replicas if n not in names]:
                 del self._replicas[name]
 
@@ -455,6 +511,28 @@ class Gateway:
             for r in self._replicas.values():
                 out[r.state] = out.get(r.state, 0) + 1
             return out
+
+    def pool_counts(self) -> Dict[str, int]:
+        """Replicas per pool (the per-pool fleet gauges; ejected
+        replicas still count — pool membership is topology, not health)."""
+        with self._lock:
+            out = {p: 0 for p in POOLS}
+            for r in self._replicas.values():
+                p = r.pool or "unified"
+                out[p] = out.get(p, 0) + 1
+            return out
+
+    def _disagg_active(self) -> bool:
+        """Disaggregated routing is live when TPU_DISAGG allows it
+        ("auto"/"1"; "0" kills it) AND both pools currently have a
+        routable replica — a half-provisioned split serves unified, so
+        rollout/rollback of the pool topology is never an outage."""
+        if os.environ.get("TPU_DISAGG", "auto") == "0":
+            return False
+        with self._lock:
+            pools = {r.pool for r in self._replicas.values()
+                     if r.state in ROUTABLE}
+        return "prefill" in pools and "decode" in pools
 
     def status(self) -> Dict[str, Any]:
         with self._lock:
@@ -600,10 +678,12 @@ class Gateway:
             out.append(h.hexdigest())
         return out
 
-    def _routable_locked(self, exclude: frozenset) -> List[Replica]:
+    def _routable_locked(self, exclude: frozenset,
+                         pool: Optional[str] = None) -> List[Replica]:
         self._tick_circuits_locked()
         cands = [r for r in self._replicas.values()
                  if r.name not in exclude
+                 and (pool is None or r.pool == pool)
                  and (r.state in ("healthy", "probe")
                       or (r.state == "half_open" and not r.half_open_busy))]
         # prefer proven-healthy over unproven; never route to ejected or
@@ -630,14 +710,18 @@ class Gateway:
         return int(max(1, min(30, soonest + 1)))
 
     def pick(self, route_key: str, probe_body: Optional[Dict] = None,
-             exclude: frozenset = frozenset()) -> Tuple[str, str]:
+             exclude: frozenset = frozenset(),
+             pool: Optional[str] = None) -> Tuple[str, str]:
         """The routing law. Returns (replica name, path) and records the
         request's chain hashes in the affinity table. ``probe_body`` is
         the upstream /api/prefix_probe payload (None disables step 2 —
-        bench drives the law without HTTP)."""
+        bench drives the law without HTTP). ``pool`` restricts the
+        candidate set to one disagg pool (affinity entries pointing at
+        out-of-pool replicas are simply not routable candidates, so the
+        law degrades to probe/least-loaded within the pool)."""
         hashes = self.chunk_hashes(route_key)
         with self._lock:
-            cands = self._routable_locked(exclude)
+            cands = self._routable_locked(exclude, pool)
             if not cands:
                 raise NoReplicas(self._remediation_retry_s_locked())
             names = {r.name for r in cands}
@@ -671,7 +755,7 @@ class Gateway:
             else:
                 chosen = None  # nobody has the prefix: fall through
         with self._lock:
-            cands = self._routable_locked(exclude)
+            cands = self._routable_locked(exclude, pool)
             if not cands:
                 raise NoReplicas(self._remediation_retry_s_locked())
             live = {r.name: r for r in cands}
@@ -746,6 +830,7 @@ class Gateway:
                 "replica": None,
                 "failovers": 0,
                 "outcome": None,
+                "handoff_result": None,   # disagg: rung taken, if any
             }
             self._live[entry["id"]] = entry
         if self._persist is not None:
@@ -962,14 +1047,54 @@ class Gateway:
         probe_body = {k: body[k] for k in
                       ("model", "prompt", "system", "template", "raw",
                        "suffix") if k in body} if "prompt" in body else None
+        # disaggregated serving (see module docstring): the prefill leg
+        # runs first and decides which pool the main loop serves from
+        serve_pool: Optional[str] = None
+        if self._disagg_active():
+            if entry["chars"] == 0 and entry["replayable"]:
+                try:
+                    outcome = self._disagg_prefill(
+                        body, route_key, api_path, entry, extract,
+                        reframe, emit, on_commit, probe_body)
+                except _ClientGone:
+                    self.journal_close(entry, "client_gone")
+                    raise
+                if outcome == "done":
+                    # the stream genuinely finished during prefill (EOG
+                    # or stop sequence on the first token): no handoff
+                    self.journal_close(entry, "ok")
+                    return entry
+                entry["handoff_result"] = outcome
+                METRICS.inc("tpu_model_disagg_handoffs_total", 1.0,
+                            f'{{result="{outcome}"}}')
+                serve_pool = (None if outcome == "unified_fallback"
+                              else "decode")
+            else:
+                # non-replayable (or resumed) streams skip the handoff
+                # and live on the decode pool: prefill replicas are
+                # reserved for prefill work
+                serve_pool = "decode"
         tried: set = set()
         budget = max(2 * len(self._replicas) + 2, 4)
         while True:
             budget -= 1
             try:
                 name, _path = self.pick(route_key, probe_body=probe_body,
-                                        exclude=frozenset(tried))
+                                        exclude=frozenset(tried),
+                                        pool=serve_pool)
             except NoReplicas:
+                if serve_pool is not None:
+                    # the decode pool lost its last routable replica:
+                    # downgrade THIS stream to unified serving rather
+                    # than erroring it — pool topology is never worth a
+                    # client-visible failure
+                    serve_pool = None
+                    if entry.get("handoff_result") is None:
+                        entry["handoff_result"] = "unified_fallback"
+                        METRICS.inc("tpu_model_disagg_handoffs_total", 1.0,
+                                    '{result="unified_fallback"}')
+                    if budget > 0:
+                        continue
                 if entry["frames"] == 0:
                     if tried:  # everyone tried and failed: widen once
                         tried = set()
@@ -1056,6 +1181,106 @@ class Gateway:
                 self.journal_close(entry, "ok")
                 return entry
 
+    def _disagg_prefill(self, body: Dict, route_key: str, api_path: str,
+                        entry: Dict[str, Any],
+                        extract: Callable[[Dict], Optional[str]],
+                        reframe: Callable[[Dict, str], Dict],
+                        emit: Callable[[bytes], None],
+                        on_commit: Callable[[], None],
+                        probe_body: Optional[Dict]) -> str:
+        """The prefill leg of a disaggregated handoff. Dispatches the
+        request to a prefill replica with ``options.disagg_prefill``
+        injected, streams its frames (prefill + first token) to the
+        client, holds the ``done_reason:"handoff"`` final frame, then
+        asks a decode replica to pull the KV pages straight from the
+        prefill replica. Returns the rung taken:
+
+        - ``"done"``: the stream finished for real during prefill —
+          the final frame was emitted, nothing left to serve;
+        - ``"transferred"``: KV pages landed on the decode replica; the
+          caller serves the full request from the decode pool and the
+          splice skips the already-emitted chars;
+        - ``"replayed"``: no KV moved (export/import/transfer failed,
+          prefill replica died mid-handoff, injected gateway.handoff
+          fault) — the decode pool re-prefills; same splice;
+        - ``"unified_fallback"``: no routable prefill replica — the
+          caller serves unified.
+
+        Every rung keeps the client stream intact; only _ClientGone
+        propagates."""
+        try:
+            name, _ = self.pick(route_key, probe_body=probe_body,
+                                pool="prefill")
+        except NoReplicas:
+            return "unified_fallback"
+        with self._lock:
+            r = self._replicas.get(name)
+            prefill_url = r.url if r is not None else None
+        if prefill_url is None:
+            return "unified_fallback"
+        entry["replica"] = name
+        upstream = dict(body)
+        upstream["stream"] = True
+        upstream.pop("request_id", None)
+        upstream["options"] = dict(upstream.get("options") or {},
+                                   disagg_prefill=True)
+        payload = json.dumps(upstream).encode()
+        try:
+            resp = self._dispatch(prefill_url, api_path, payload)
+            held = self._pump(
+                resp, entry, extract, reframe, emit, on_commit,
+                intercept_final=lambda f:
+                    f.get("done_reason") == "handoff")
+        except _ClientGone:
+            raise
+        except Exception as e:  # noqa: BLE001 — prefill replica failed
+            # mid-handoff (or a legacy replica 400ed the option): the
+            # decode pool replays/requeues whatever was emitted — the
+            # client never sees this
+            self._request_failed(name, repr(e))
+            FLIGHT.record("gateway_handoff_failed", request=entry["id"],
+                          replica=name, detail=repr(e))
+            return "replayed"
+        self._request_ok(name)
+        if held is None:
+            return "done"
+        try:
+            # the drill point: between the held handoff frame and the
+            # KV transfer dispatch
+            FAULTS.check("gateway.handoff")
+            dec_name, _p = self.pick(route_key, probe_body=None,
+                                     pool="decode")
+            with self._lock:
+                r = self._replicas.get(dec_name)
+                dec_url = r.url if r is not None else None
+            if dec_url is None:
+                return "replayed"
+            fwd = {k: body[k] for k in
+                   ("model", "prompt", "system", "template", "suffix",
+                    "raw", "context", "messages", "tools", "keep_alive")
+                   if body.get(k) is not None}
+            fwd["source"] = prefill_url
+            timeout = float(os.environ.get("TPU_DISAGG_HANDOFF_TIMEOUT_S",
+                                           "30") or 30)
+            req = urllib.request.Request(
+                f"{dec_url}/api/kv_import", data=json.dumps(fwd).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                res = json.loads(resp.read().decode())
+            pages = int(res.get("imported_pages") or 0)
+        except Exception as e:  # noqa: BLE001 — incl. NoReplicas and
+            # injected gateway.handoff faults
+            # any transfer trouble is a soft downgrade: the decode pool
+            # re-prefills from the prompt (journal replay), losing only
+            # the transfer win, never the stream
+            FLIGHT.record("gateway_kv_transfer_failed",
+                          request=entry["id"], prefill=name,
+                          detail=repr(e))
+            return "replayed"
+        FLIGHT.record("gateway_handoff", request=entry["id"], prefill=name,
+                      decode=dec_name, pages=pages, chars=entry["chars"])
+        return "transferred" if pages > 0 else "replayed"
+
     def _failover_eligible(self, entry: Dict[str, Any]) -> bool:
         """Mid-stream failover needs PR 9 replay eligibility AND the
         emitted prefix to fit the replay budget (frames ≈ detokenizer
@@ -1087,17 +1312,26 @@ class Gateway:
               extract: Callable[[Dict], Optional[str]],
               reframe: Callable[[Dict, str], Dict],
               emit: Callable[[bytes], None],
-              on_commit: Callable[[], None]) -> None:
+              on_commit: Callable[[], None],
+              intercept_final: Optional[Callable[[Dict], bool]] = None
+              ) -> Optional[Dict[str, Any]]:
         """Forward one upstream stream to the client. After a failover,
         ``entry['chars']`` > 0: the fresh upstream regenerates from token
         zero, so consume silently up to that offset, verify the replayed
         prefix is BIT-IDENTICAL to what the client already saw (rolling
-        sha256), then splice the remainder onto the same client stream."""
+        sha256), then splice the remainder onto the same client stream.
+
+        ``intercept_final`` (the disagg handoff hook): a predicate over
+        the upstream's final frame — when it answers True the frame is
+        HELD (returned, not emitted) so the caller can continue the same
+        client stream on another replica. Returns the held frame, or
+        None when the stream completed normally."""
         skip = entry["chars"]
         prefix_hex = entry["hash"].hexdigest()
         verify = hashlib.sha256()
         acc = 0
         saw_final = False
+        held: Optional[Dict[str, Any]] = None
         for line in self._iter_ndjson(resp):
             FAULTS.check("gateway.stream")
             frame = json.loads(line)
@@ -1110,6 +1344,9 @@ class Gateway:
                     raise _ReplayMismatch(
                         f"replay finished at {acc} < {skip} chars")
                 saw_final = True
+                if intercept_final is not None and intercept_final(frame):
+                    held = frame
+                    continue
                 on_commit()
                 try:
                     emit(line + b"\n")
@@ -1149,6 +1386,7 @@ class Gateway:
             self._persist_progress(entry)
         if not saw_final:
             raise _UpstreamDead("upstream closed before the final frame")
+        return held
 
     # -- raw proxy (non-journaled endpoints) -----------------------------
 

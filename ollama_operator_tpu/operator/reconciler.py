@@ -202,6 +202,10 @@ class ModelReconciler:
                 continue
             entry = {"pod": (pod.get("metadata") or {}).get("name", ""),
                      "ip": ip}
+            pool = ((pod.get("metadata") or {}).get("labels")
+                    or {}).get(workload.POOL_LABEL)
+            if pool:  # disaggregated fleets scale per pool (ISSUE 20)
+                entry["pool"] = pool
             if workload.pod_is_drain_victim(pod):
                 entry["drainRequested"] = True
             body = self.ps_fetch(f"http://{ip}:{PORT}/api/ps")
@@ -269,78 +273,102 @@ class ModelReconciler:
                                          workload.IMAGE_STORE_SERVICE):
             return POLL
 
-        # 2) model workload (Deployment, or StatefulSet for multi-host)
+        # 2) model workload (Deployment, or StatefulSet for multi-host;
+        #    a disaggregated Model gets TWO pool Deployments — ISSUE 20)
         placement = spec.tpu_placement()
         multi_host = placement is not None and placement.multi_host
+        disagg = workload.disagg_enabled(spec)
         app = workload.model_app_name(name)
         image = spec.server_image or self.server_image  # per-CR pin wins
         # autoscaling (single-host Deployments only: a multi-host replica
-        # group is ONE jax.distributed world; its size is the topology)
+        # group is ONE jax.distributed world; its size is the topology).
+        # Disaggregated pools scale per-pool inside _sync_pools instead.
         policy = autoscale.resolve_policy(spec.autoscale)
-        scaling = policy.enabled and not multi_host
+        scaling = policy.enabled and not multi_host and not disagg
         asc_status = (model.get("status") or {}).get("autoscale") or {}
-        if scaling and asc_status.get("desiredReplicas") is not None:
-            # adopt the persisted count so an operator restart fails
-            # static (keeps the fleet size) instead of snapping to spec
-            self.scaler.seed_desired((namespace, name),
-                                     int(asc_status["desiredReplicas"]))
-        if multi_host:
-            want = workload.build_model_statefulset(model, image)
-            workload._ensure(self.c, workload.build_headless_service(model))
+        if disagg:
+            r = self._sync_pools(model, spec, namespace, app, image)
+            if r is not None:
+                return r
         else:
-            want = workload.build_model_deployment(model, image)
-        workload.stamp_spec_hash(want)
-        cur = self.c.get("apps/v1", want["kind"], namespace, app)
-        if scaling:
-            desired0 = self.scaler.desired((namespace, name))
-            if desired0 is None:
-                desired0 = spec.replicas
-            cur_replicas = (int((cur.get("spec") or {}).get("replicas")
-                                or 0) if cur is not None else None)
-            # Growth syncs through the normal ladder; shrink ONLY via the
-            # drain protocol (_scale_down_step decrements after the
-            # victim's streams finish — never let the plain replica sync
-            # kill a serving pod).
-            if cur_replicas is None or desired0 >= cur_replicas:
-                want["spec"]["replicas"] = max(0, int(desired0))
+            if not multi_host:
+                # disable transition: tear the pool Deployments back down
+                # BEFORE the unified fleet resyncs (their pods share the
+                # app label; two owners must never coexist)
+                for pool in workload.DISAGG_POOLS:
+                    pname = workload.pool_app_name(name, pool)
+                    if self.c.get("apps/v1", "Deployment", namespace,
+                                  pname) is not None:
+                        self.c.delete("apps/v1", "Deployment", namespace,
+                                      pname)
+                        self.rec.event(model, "Normal", "WorkloadUnified",
+                                       f"removed pool deployment {pname}")
+                        return POLL
+            if scaling and asc_status.get("desiredReplicas") is not None:
+                # adopt the persisted count so an operator restart fails
+                # static (keeps the fleet size) instead of snapping to spec
+                self.scaler.seed_desired((namespace, name),
+                                         int(asc_status["desiredReplicas"]))
+            if multi_host:
+                want = workload.build_model_statefulset(model, image)
+                workload._ensure(self.c,
+                                 workload.build_headless_service(model))
             else:
-                want["spec"]["replicas"] = cur_replicas
-        if cur is None:
-            self.c.create(want)
-            self.rec.event(model, "Normal", "WorkloadCreated",
-                           f"created {want['kind']} {app}")
-            self.set_progressing(model, "WorkloadCreated",
-                                 f"waiting for {app}")
-            return POLL
-        if workload.update_model_workload(self.c, self.rec, model, cur, want):
-            return POLL
-
-        # replica failure surfacing (the reference never does this) +
-        # crash-loop remediation when the control loop owns the fleet
-        failure = workload.deployment_replica_failure(cur)
-        if failure:
+                want = workload.build_model_deployment(model, image)
+            workload.stamp_spec_hash(want)
+            cur = self.c.get("apps/v1", want["kind"], namespace, app)
             if scaling:
-                self._remediate_crash_loop(model, policy, namespace, app)
-            self.set_replica_failure(model, failure)
-            return POLL
+                desired0 = self.scaler.desired((namespace, name))
+                if desired0 is None:
+                    desired0 = spec.replicas
+                cur_replicas = (int((cur.get("spec") or {}).get("replicas")
+                                    or 0) if cur is not None else None)
+                # Growth syncs through the normal ladder; shrink ONLY via
+                # the drain protocol (_scale_down_step decrements after the
+                # victim's streams finish — never let the plain replica
+                # sync kill a serving pod).
+                if cur_replicas is None or desired0 >= cur_replicas:
+                    want["spec"]["replicas"] = max(0, int(desired0))
+                else:
+                    want["spec"]["replicas"] = cur_replicas
+            if cur is None:
+                self.c.create(want)
+                self.rec.event(model, "Normal", "WorkloadCreated",
+                               f"created {want['kind']} {app}")
+                self.set_progressing(model, "WorkloadCreated",
+                                     f"waiting for {app}")
+                return POLL
+            if workload.update_model_workload(self.c, self.rec, model, cur,
+                                              want):
+                return POLL
 
-        want_ready = placement.hosts if multi_host else spec.replicas
-        if scaling:
-            # readiness tracks the autoscaler's intent, not spec.replicas;
-            # drain victims are intentionally not-ready and must not read
-            # as "workload not ready" (that would wedge the shrink)
-            want_ready = max(0, int(want["spec"].get("replicas") or 0)
-                             - len(asc_status.get("draining") or []))
-        if multi_host:
-            ready = workload.is_statefulset_ready(self.c, namespace, app,
-                                                  want=want_ready)
-        else:
-            ready = workload.is_deployment_ready(self.c, namespace, app,
-                                                 want=want_ready)
-        if not ready:
-            self.set_progressing(model, "WorkloadNotReady",
-                                 f"waiting for {app} readiness")
-            return POLL
+            # replica failure surfacing (the reference never does this) +
+            # crash-loop remediation when the control loop owns the fleet
+            failure = workload.deployment_replica_failure(cur)
+            if failure:
+                if scaling:
+                    self._remediate_crash_loop(model, policy, namespace, app)
+                self.set_replica_failure(model, failure)
+                return POLL
+
+            want_ready = placement.hosts if multi_host else spec.replicas
+            if scaling:
+                # readiness tracks the autoscaler's intent, not
+                # spec.replicas; drain victims are intentionally not-ready
+                # and must not read as "workload not ready" (that would
+                # wedge the shrink)
+                want_ready = max(0, int(want["spec"].get("replicas") or 0)
+                                 - len(asc_status.get("draining") or []))
+            if multi_host:
+                ready = workload.is_statefulset_ready(self.c, namespace,
+                                                      app, want=want_ready)
+            else:
+                ready = workload.is_deployment_ready(self.c, namespace,
+                                                     app, want=want_ready)
+            if not ready:
+                self.set_progressing(model, "WorkloadNotReady",
+                                     f"waiting for {app} readiness")
+                return POLL
 
         # 2b) fleet gateway (replicated single-host Models only): ensured
         # and spec-synced, but NEVER gating — Available tracks the model
@@ -368,15 +396,22 @@ class ModelReconciler:
         if not workload.is_service_ready(self.c, namespace, app):
             return POLL
 
-        # 4) status replica mirror (model_controller.go:240-273)
-        cur = self.c.get("apps/v1", want["kind"], namespace, app) or cur
-        st = cur.get("status") or {}
-        mirrored = {
-            "replicas": int(st.get("replicas") or 0),
-            "readyReplicas": int(st.get("readyReplicas") or 0),
-            "availableReplicas": int(st.get("availableReplicas") or 0),
-            "unavailableReplicas": int(st.get("unavailableReplicas") or 0),
-        }
+        # 4) status replica mirror (model_controller.go:240-273); a
+        # disaggregated Model mirrors the SUM over both pool Deployments
+        mirrored = {"replicas": 0, "readyReplicas": 0,
+                    "availableReplicas": 0, "unavailableReplicas": 0}
+        if disagg:
+            for pool in workload.DISAGG_POOLS:
+                d = self.c.get("apps/v1", "Deployment", namespace,
+                               workload.pool_app_name(name, pool))
+                st = (d or {}).get("status") or {}
+                for k in mirrored:
+                    mirrored[k] += int(st.get(k) or 0)
+        else:
+            cur = self.c.get("apps/v1", want["kind"], namespace, app) or cur
+            st = cur.get("status") or {}
+            for k in mirrored:
+                mirrored[k] = int(st.get(k) or 0)
         status_obj = model.setdefault("status", {})
         if any(status_obj.get(k) != v for k, v in mirrored.items()):
             status_obj.update(mirrored)
@@ -397,11 +432,103 @@ class ModelReconciler:
                 status_obj["replicaStats"] = {"scrapedAt": _now(),
                                               "replicas": stats}
                 self._write_status(model)
+        if disagg:
+            # per-pool control loops: prefill scales on backlog tokens,
+            # decode on slot occupancy (autoscale.pool_policy)
+            dis = spec.disaggregate
+            any_scaling = False
+            for pool in workload.DISAGG_POOLS:
+                ppolicy = autoscale.pool_policy(spec.autoscale,
+                                                dis.get(pool) or {}, pool)
+                if not ppolicy.enabled:
+                    continue
+                any_scaling = True
+                dep = self.c.get("apps/v1", "Deployment", namespace,
+                                 workload.pool_app_name(name, pool))
+                if dep is None:
+                    return POLL
+                pstats = [e for e in stats if e.get("pool") == pool]
+                self._autoscale_pass(model, spec, ppolicy, namespace, app,
+                                     dep, pstats, pool=pool)
+            if any_scaling:
+                return POLL
+            self.set_available(model)
+            return DONE
         if scaling:
             return self._autoscale_pass(model, spec, policy, namespace,
                                         app, cur, stats)
         self.set_available(model)
         return DONE
+
+    def _sync_pools(self, model: Dict[str, Any], spec: ModelSpecView,
+                    namespace: str, app: str,
+                    image: str) -> Optional[Result]:
+        """Ladder step 2 for a disaggregated Model (ISSUE 20): two pool
+        Deployments (prefill/decode) instead of the unified one, each
+        sized by its own control loop. Returns a Result to short-circuit
+        the ladder, or None when both pools are synced and ready."""
+        # enable transition: tear the unified Deployment down FIRST — its
+        # pods share the app label with the pool pods, and two owners for
+        # one fleet selector must never coexist
+        if self.c.get("apps/v1", "Deployment", namespace, app) is not None:
+            self.c.delete("apps/v1", "Deployment", namespace, app)
+            self.rec.event(model, "Normal", "WorkloadSplit",
+                           f"splitting {app} into prefill/decode pools")
+            self.set_progressing(model, "WorkloadSplit",
+                                 "splitting fleet into pools")
+            return POLL
+        dis = spec.disaggregate
+        asc_all = (model.get("status") or {}).get("autoscale") or {}
+        for pool in workload.DISAGG_POOLS:
+            pname = workload.pool_app_name(spec.name, pool)
+            ppolicy = autoscale.pool_policy(spec.autoscale,
+                                            dis.get(pool) or {}, pool)
+            key = (namespace, f"{spec.name}/{pool}")
+            asc = asc_all.get(pool) or {}
+            if ppolicy.enabled and asc.get("desiredReplicas") is not None:
+                self.scaler.seed_desired(key, int(asc["desiredReplicas"]))
+            want = workload.build_pool_deployment(model, pool, image)
+            workload.stamp_spec_hash(want)
+            cur = self.c.get("apps/v1", "Deployment", namespace, pname)
+            if ppolicy.enabled:
+                desired0 = self.scaler.desired(key)
+                if desired0 is None:
+                    desired0 = workload.pool_replicas(spec, pool)
+                cur_replicas = (int((cur.get("spec") or {}).get("replicas")
+                                    or 0) if cur is not None else None)
+                # same split as the unified ladder: grow via the normal
+                # replica sync, shrink ONLY via the drain protocol
+                if cur_replicas is None or desired0 >= cur_replicas:
+                    want["spec"]["replicas"] = max(0, int(desired0))
+                else:
+                    want["spec"]["replicas"] = cur_replicas
+            if cur is None:
+                self.c.create(want)
+                self.rec.event(model, "Normal", "WorkloadCreated",
+                               f"created Deployment {pname}")
+                self.set_progressing(model, "WorkloadCreated",
+                                     f"waiting for {pname}")
+                return POLL
+            if workload.update_model_workload(self.c, self.rec, model,
+                                              cur, want):
+                return POLL
+            failure = workload.deployment_replica_failure(cur)
+            if failure:
+                if ppolicy.enabled:
+                    self._remediate_crash_loop(model, ppolicy, namespace,
+                                               app, pool=pool)
+                self.set_replica_failure(model, f"{pool}: {failure}")
+                return POLL
+            want_ready = int((cur.get("spec") or {}).get("replicas") or 0)
+            if ppolicy.enabled:
+                want_ready = max(0, want_ready
+                                 - len(asc.get("draining") or []))
+            if not workload.is_deployment_ready(self.c, namespace, pname,
+                                                want=want_ready):
+                self.set_progressing(model, "WorkloadNotReady",
+                                     f"waiting for {pname} readiness")
+                return POLL
+        return None
 
     def _ensure_gateway(self, model: Dict[str, Any], spec: ModelSpecView,
                         namespace: str, image: str) -> None:
@@ -428,12 +555,16 @@ class ModelReconciler:
     # --- closed-loop fleet control --------------------------------------
     def _autoscale_pass(self, model: Dict[str, Any], spec: ModelSpecView,
                         policy: "autoscale.Policy", namespace: str, app: str,
-                        dep: Dict[str, Any], stats: list) -> Result:
+                        dep: Dict[str, Any], stats: list,
+                        pool: str = "") -> Result:
         """One control-loop step on the converged ladder: remediate broken
         replicas, run the damped control law, actuate (grow via the
         normal replica sync; shrink strictly drain-first). Always POLLs —
-        the autoscaled Model is a live loop, not a settled object."""
-        key = (namespace, spec.name)
+        the autoscaled Model is a live loop, not a settled object.
+        With ``pool`` set this is one disagg pool's loop: its own state
+        key, pool-filtered stats from the caller, and status nested under
+        status.autoscale.<pool>."""
+        key = (namespace, f"{spec.name}/{pool}" if pool else spec.name)
         status_obj = model.setdefault("status", {})
         cur_replicas = int((dep.get("spec") or {}).get("replicas") or 0)
 
@@ -452,7 +583,9 @@ class ModelReconciler:
                 obs = dataclasses.replace(obs, stale_cause="stale")
 
         anns = (model.get("metadata") or {}).get("annotations") or {}
-        wake = workload.WAKE_ANNOTATION in anns
+        # scale-from-zero wake stays a whole-Model affair; pool loops
+        # never sleep the fleet (pool min floors are >= 1 by default)
+        wake = not pool and workload.WAKE_ANNOTATION in anns
         decision = self.scaler.observe(key, policy, obs, wake=wake)
         if wake and decision.action == "wake":
             self._clear_wake(model)
@@ -465,41 +598,52 @@ class ModelReconciler:
             self._clear_wake(model)
         desired = decision.desired
 
-        pending_drains = list((status_obj.get("autoscale") or {})
-                              .get("draining") or [])
+        asc = status_obj.get("autoscale") or {}
+        if pool:
+            asc = asc.get(pool) or {}
+        pending_drains = list(asc.get("draining") or [])
         if desired < cur_replicas or pending_drains:
             # a marked victim is doomed (PR 9 drain is one-way): finish
             # its removal even if the law flipped back up meanwhile —
             # the next pass re-grows with a fresh pod
             return self._scale_down_step(model, policy, namespace, app,
-                                         dep, stats, desired, decision)
+                                         dep, stats, desired, decision,
+                                         pool=pool)
         if desired > cur_replicas:
             dep.setdefault("spec", {})["replicas"] = desired
             self.c.update(dep)
             self.rec.event(model, "Normal", "AutoscaleUp",
-                           f"{cur_replicas} -> {desired} replicas "
-                           f"({decision.reason})")
-            self._update_autoscale_status(model, desired, decision, [])
+                           f"{pool + ': ' if pool else ''}{cur_replicas}"
+                           f" -> {desired} replicas ({decision.reason})")
+            self._update_autoscale_status(model, desired, decision, [],
+                                          pool=pool)
             return POLL
 
-        self._update_autoscale_status(model, desired, decision, [])
+        self._update_autoscale_status(model, desired, decision, [],
+                                      pool=pool)
         self.set_available(model)
         return POLL
 
     def _scale_down_step(self, model: Dict[str, Any],
                          policy: "autoscale.Policy", namespace: str,
                          app: str, dep: Dict[str, Any], stats: list,
-                         desired: int, decision: "autoscale.Decision"
-                         ) -> Result:
+                         desired: int, decision: "autoscale.Decision",
+                         pool: str = "") -> Result:
         """Drain-first shrink, re-entrant across polls: mark one victim,
         tell its server to drain (readyz flips, streams finish), and only
         shrink the Deployment once the victim reports zero active work.
-        Zero client-visible error frames by construction."""
+        Zero client-visible error frames by construction. With ``pool``
+        set, only that pool's pods are candidates (the app label is
+        fleet-wide; the pool label narrows it)."""
         try:
             pods = self.c.list("v1", "Pod", namespace,
                                label_selector=f"app={app}")
         except Exception:  # noqa: BLE001 — retry next poll
             return POLL
+        if pool:
+            pods = [p for p in pods
+                    if ((p.get("metadata") or {}).get("labels") or {})
+                    .get(workload.POOL_LABEL) == pool]
         pods = sorted(pods, key=lambda p: (p.get("metadata") or {})
                       .get("name", ""))
         by_name = {e.get("pod"): e for e in stats or []}
@@ -562,7 +706,8 @@ class ModelReconciler:
                 self.c.delete("v1", "Pod", namespace, vname)
                 self.rec.event(model, "Normal", "AutoscaleDown",
                                f"removed drained replica {vname}")
-        self._update_autoscale_status(model, desired, decision, pending)
+        self._update_autoscale_status(model, desired, decision, pending,
+                                      pool=pool)
         return POLL
 
     def _remediate_unreachable(self, model: Dict[str, Any],
@@ -597,17 +742,22 @@ class ModelReconciler:
 
     def _remediate_crash_loop(self, model: Dict[str, Any],
                               policy: "autoscale.Policy", namespace: str,
-                              app: str) -> bool:
+                              app: str, pool: str = "") -> bool:
         """Replace ONE crash-looping pod under the same backoff gate.
         Detected from pod containerStatuses (not scrapes — a crash-looping
         pod has no server to scrape), triggered by the Deployment's
         ReplicaFailure condition in the ladder."""
-        key = (namespace, ModelSpecView(model).name)
+        mname = ModelSpecView(model).name
+        key = (namespace, f"{mname}/{pool}" if pool else mname)
         try:
             pods = self.c.list("v1", "Pod", namespace,
                                label_selector=f"app={app}")
         except Exception:  # noqa: BLE001 — retry next poll
             return False
+        if pool:
+            pods = [p for p in pods
+                    if ((p.get("metadata") or {}).get("labels") or {})
+                    .get(workload.POOL_LABEL) == pool]
         looping = []
         for p in sorted(pods, key=lambda p: (p.get("metadata") or {})
                         .get("name", "")):
@@ -632,12 +782,15 @@ class ModelReconciler:
 
     def _update_autoscale_status(self, model: Dict[str, Any], desired: int,
                                  decision: "autoscale.Decision",
-                                 draining: list) -> None:
+                                 draining: list, pool: str = "") -> None:
         """Persist the control loop's intent in status.autoscale (the
         fail-static anchor across operator restarts) — written only on
-        change so steady passes don't churn resourceVersions."""
+        change so steady passes don't churn resourceVersions. Pool loops
+        nest under status.autoscale.<pool> so each survives restarts
+        independently."""
         status_obj = model.setdefault("status", {})
-        prev = status_obj.get("autoscale") or {}
+        top = status_obj.get("autoscale") or {}
+        prev = (top.get(pool) or {}) if pool else top
         new = {"desiredReplicas": desired,
                "lastAction": decision.action,
                "lastReason": decision.reason,
@@ -649,7 +802,10 @@ class ModelReconciler:
                 or prev.get("desiredReplicas") != desired):
             new["lastActionAt"] = _now()
         if new != prev:
-            status_obj["autoscale"] = new
+            if pool:
+                status_obj["autoscale"] = dict(top, **{pool: new})
+            else:
+                status_obj["autoscale"] = new
             self._write_status(model)
 
     def _clear_wake(self, model: Dict[str, Any]) -> None:

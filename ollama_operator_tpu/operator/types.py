@@ -157,6 +157,30 @@ class ModelSpecView:
         return None if v is None else bool(v)
 
     @property
+    def disaggregate(self) -> Dict[str, Any]:
+        """`spec.disaggregate`: split the fleet into a prefill pool and
+        a decode pool with direct KV page transfer at first token
+        (ISSUE 20). Absent/false = today's unified fleet, untouched.
+        ``true`` enables with defaults; a dict form carries per-pool
+        blocks::
+
+            disaggregate:
+              enabled: true
+              prefill: {minReplicas: 1, maxReplicas: 4}
+              decode:  {minReplicas: 2, maxReplicas: 8}
+
+        Returns {} when off, else a dict with at least
+        ``{"enabled": True}`` (the bool form normalizes to that)."""
+        v = self._spec.get("disaggregate")
+        if not v:
+            return {}
+        if v is True:
+            return {"enabled": True}
+        if isinstance(v, dict):
+            return {} if v.get("enabled") is False else dict(v, enabled=True)
+        return {"enabled": True}
+
+    @property
     def autoscale(self) -> Dict[str, Any]:
         """`spec.autoscale` block (absent = autoscaling off).
 

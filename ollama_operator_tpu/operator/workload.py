@@ -48,16 +48,57 @@ def gateway_app_name(name: str) -> str:
     return f"{model_app_name(name)}-gateway"
 
 
+# Pod label carrying a replica's disagg pool; must match what the
+# gateway's kube discovery reads (operator/gateway.py POOL_LABEL).
+POOL_LABEL = "ollama.ayaka.io/pool"
+DISAGG_POOLS = ("prefill", "decode")
+
+
+def pool_app_name(name: str, pool: str) -> str:
+    """Deployment name for one disagg pool (`ollama-model-<name>-prefill`
+    / `-decode`). Pods keep the shared ``app`` label — discovery, the
+    Service, and the utilization scrape see one fleet — and add
+    POOL_LABEL for pool-aware routing/scaling."""
+    return f"{model_app_name(name)}-{pool}"
+
+
+def disagg_enabled(spec: ModelSpecView) -> bool:
+    """Disaggregated prefill/decode pools (`spec.disaggregate`,
+    ISSUE 20). Single-host fleets only: a multi-host slice is one
+    sharded server — there is no fleet to split."""
+    placement = spec.tpu_placement()
+    if placement is not None and placement.multi_host:
+        return False
+    return bool(spec.disaggregate)
+
+
+def pool_replicas(spec: ModelSpecView, pool: str) -> int:
+    """Seed replica count for one pool: explicit
+    ``disaggregate.<pool>.replicas`` wins; defaults keep the total near
+    ``spec.replicas`` (prefill 1, decode the rest) because decode slots
+    dominate steady-state demand."""
+    block = (spec.disaggregate.get(pool) or {})
+    r = block.get("replicas")
+    if r is not None:
+        return max(0, int(r))
+    if pool == "prefill":
+        return 1
+    return max(1, spec.replicas - 1)
+
+
 def gateway_enabled(spec: ModelSpecView) -> bool:
     """The gateway fronts single-host FLEETS: spec.gateway forces it
     on/off; absent means auto — on when replicas > 1 or autoscaling is
     enabled (the cases where the plain Service's random routing shreds
     prefix-cache locality and a replica death is client-visible).
     Multi-host slices are one sharded server behind host-0; nothing to
-    route across."""
+    route across. A disaggregated fleet ALWAYS has the gateway: it is
+    the handoff orchestrator."""
     placement = spec.tpu_placement()
     if placement is not None and placement.multi_host:
         return False
+    if disagg_enabled(spec):
+        return True
     if spec.gateway is not None:
         return spec.gateway
     autoscaling = bool((spec.autoscale or {}).get("enabled"))
@@ -148,20 +189,29 @@ def build_store_service(namespace: str) -> Dict[str, Any]:
 def _pod_template(model: Dict[str, Any], spec: ModelSpecView,
                   server_image: str,
                   placement: Optional[TpuPlacement],
-                  multihost_sts: Optional[str] = None) -> Dict[str, Any]:
+                  multihost_sts: Optional[str] = None,
+                  pool: Optional[str] = None) -> Dict[str, Any]:
     name = spec.name
     labels = {"app": model_app_name(name)}
+    extra_env: Optional[list] = None
+    if multihost_sts and placement:
+        extra_env = (
+            [{"name": "TPU_DIST_STS_NAME", "value": multihost_sts}]
+            + podf.multihost_env(headless_service_name(name),
+                                 spec.namespace, placement.hosts,
+                                 placement.chips_per_host))
+    if pool:
+        # the shared app label keeps discovery/Service/scrape fleet-wide;
+        # the pool label is what the gateway routes on
+        labels[POOL_LABEL] = pool
+        extra_env = (extra_env or []) + [
+            {"name": "TPU_DISAGG_ROLE", "value": pool}]
     server = podf.new_server_container(
         read_only=True, image=server_image, model=spec.image,
         placement=placement, context_length=spec.context_length,
         quantization=spec.quantization,
         tp=spec.sharding.get("tp", 0),
-        extra_env=(
-            [{"name": "TPU_DIST_STS_NAME", "value": multihost_sts}]
-            + podf.multihost_env(headless_service_name(name),
-                                 spec.namespace, placement.hosts,
-                                 placement.chips_per_host)
-            if multihost_sts and placement else None),
+        extra_env=extra_env,
     )
     if spec.image_pull_policy:  # honored, unlike the reference (§2.1 gaps)
         server["imagePullPolicy"] = spec.image_pull_policy
@@ -213,6 +263,35 @@ def build_model_deployment(model: Dict[str, Any],
             "replicas": spec.replicas,
             "selector": {"matchLabels": {"app": app}},
             "template": _pod_template(model, spec, server_image, placement),
+        },
+    }
+
+
+def build_pool_deployment(model: Dict[str, Any], pool: str,
+                          server_image: str = podf.SERVER_BASE_IMAGE
+                          ) -> Dict[str, Any]:
+    """One disagg pool's Deployment (ISSUE 20): named
+    ``ollama-model-<name>-<pool>``, selector narrowed by POOL_LABEL so
+    the prefill and decode Deployments coexist under the shared ``app``
+    label without fighting over pods. The server container gets
+    ``TPU_DISAGG_ROLE=<pool>`` so replicas can report their role."""
+    spec = ModelSpecView(model)
+    placement = spec.tpu_placement()
+    app = model_app_name(spec.name)
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {
+            "name": pool_app_name(spec.name, pool),
+            "namespace": spec.namespace,
+            "labels": {"app": app, POOL_LABEL: pool},
+            "ownerReferences": [owner_reference(model)],
+        },
+        "spec": {
+            "replicas": pool_replicas(spec, pool),
+            "selector": {"matchLabels": {"app": app, POOL_LABEL: pool}},
+            "template": _pod_template(model, spec, server_image, placement,
+                                      pool=pool),
         },
     }
 

@@ -2723,8 +2723,7 @@ class Engine:
         Read-only and leader-side (NOT mirrored). None when empty."""
         if self._radix is None or self._radix.n_nodes == 0:
             return None
-        import pickle
-        from .host_cache import _tree_nbytes
+        from . import kv_wire
         nodes = self._radix.walk()     # parents before children (BFS)
         # parent.stamp >= child.stamp (bumps touch whole paths), and the
         # stable sort keeps BFS order on ties — parents stay first
@@ -2737,24 +2736,28 @@ class Engine:
             pidx = -1 if at_root else idx.get(id(node.parent), -1)
             if not at_root and pidx < 0:
                 continue              # parent missed the budget
-            if node.tier == 0:
-                kp, vp = self._gather_page_fn(
-                    self.k_cache, self.v_cache,
-                    self._gr(np.int32(node.page)))
-                kv = jax.device_get((kp, vp))
-            else:
-                kv = node.host.kv
-            nbytes = _tree_nbytes(kv)
+            kv = self._page_kv(node)
+            nbytes = kv_wire.kv_nbytes(kv)
             if nbytes > budget:
                 continue
             budget -= nbytes
             idx[id(node)] = len(recs)
-            recs.append({"p": pidx, "c": np.asarray(node.chunk, np.int32),
-                         "k": kv[0], "v": kv[1]})
+            recs.append(kv_wire.record(pidx, node.chunk, kv))
         if not recs:
             return None
-        return pickle.dumps(
-            {"v": 1, "ps": self.ecfg.page_size, "recs": recs}, protocol=4)
+        return kv_wire.encode(recs, self.ecfg.page_size)
+
+    def _page_kv(self, node):
+        """One radix node's KV bytes on host: tier-0 pages are gathered
+        from the pool (the ``device_get`` waits out pending programs —
+        callers fence or run at drain/idle), spilled tiers already hold
+        host bytes."""
+        if node.tier == 0:
+            kp, vp = self._gather_page_fn(
+                self.k_cache, self.v_cache,
+                self._gr(np.int32(node.page)))
+            return jax.device_get((kp, vp))
+        return node.host.kv
 
     def import_prefixes(self, blob) -> int:
         """Install a tier-2 fleet snapshot as tier-1 nodes backed by the
@@ -2766,28 +2769,19 @@ class Engine:
         mismatch — a snapshot is a warm start, never a failure)."""
         if self._radix is None or self._arena is None or not blob:
             return 0
-        import pickle
+        from . import kv_wire
         try:
-            data = pickle.loads(blob)
-        except Exception:
+            recs = kv_wire.decode(blob, self.ecfg.page_size)
+        except kv_wire.WireError:
             return 0
-        if (not isinstance(data, dict) or data.get("v") != 1
-                or data.get("ps") != self.ecfg.page_size):
-            return 0
-
-        def spec(tree, page_axis1=False):
-            return jax.tree_util.tree_map(
-                lambda a: ((tuple(a.shape[:1]) + (1,) + tuple(a.shape[2:]))
-                           if page_axis1 else tuple(a.shape),
-                           np.dtype(a.dtype)), tree)
-        want = (spec(self.k_cache, True), spec(self.v_cache, True))
+        want = kv_wire.cache_spec(self.k_cache, self.v_cache)
         imported = 0
         by_idx: List[Any] = []
-        for rec in data.get("recs", ()):
+        for rec in recs:
             p = int(rec.get("p", -1))
             parent = None
             if p >= 0:
-                parent = by_idx[p] if 0 <= p < len(by_idx) else None
+                parent = by_idx[p]    # decode guarantees p < this index
                 if parent is None:
                     by_idx.append(None)
                     continue
@@ -2795,7 +2789,7 @@ class Engine:
             node = self._radix.child(parent, chunk)
             if node is None:
                 kv = (rec["k"], rec["v"])
-                if ((spec(kv[0]), spec(kv[1])) != want
+                if (kv_wire.kv_spec(kv) != want
                         or not self._arena.room_for(1)):
                     by_idx.append(None)
                     continue
@@ -2803,6 +2797,95 @@ class Engine:
                     parent, chunk, self._arena.store(kv, snapshot=True))
                 imported += 1
             by_idx.append(node)
+        return imported
+
+    # ------------------------------------------------------------------
+    # disaggregated prefill→decode KV transfer (ISSUE 20)
+    # ------------------------------------------------------------------
+    def export_request_kv(self, full_ids,
+                          max_bytes: int = 64 << 20) -> Optional[bytes]:
+        """Serialize the radix-cached KV chain for one request's token
+        ids (the prefill side of a disagg handoff). Only FULL quiescent
+        pages ship — the epoch fence runs first so the gathers can never
+        race an in-flight program; the partial boundary page travels as
+        a token tail the decode side re-extends through chunked prefill
+        (bit-identical by construction). A byte-budget cut stops at the
+        cut (never skips) so the shipped chain stays rooted. None when
+        the radix cache is off or holds nothing for these ids.
+        Leader-side only — callers gate on single-host serving."""
+        if self._radix is None:
+            return None
+        FAULTS.check("pages.export")
+        from . import kv_wire
+        # lint: allow(host-sync-hot-path): token ids arrive as host lists
+        ids = np.asarray(full_ids, np.int32)
+        if int(ids.shape[0]) < 2:
+            return None
+        full, _part, _q = self._radix.match(ids, int(ids.shape[0]) - 1,
+                                            bump=False)
+        if not full:
+            return None
+        self.fence_quiesce()
+        budget = int(max_bytes)
+        recs: List[Dict[str, Any]] = []
+        for i, node in enumerate(full):
+            kv = self._page_kv(node)
+            nbytes = kv_wire.kv_nbytes(kv)
+            if nbytes > budget:
+                break
+            budget -= nbytes
+            recs.append(kv_wire.record(i - 1, node.chunk, kv))
+        if not recs:
+            return None
+        return kv_wire.encode(recs, self.ecfg.page_size)
+
+    def import_request_kv(self, blob) -> int:
+        """Install a transferred request chain into the LIVE pool and
+        radix tree at tier 0 (the decode side of a disagg handoff):
+        each page is uploaded into a freshly pinned pool page and
+        grafted via ``insert_page``, so the very next stitch serves the
+        prefix HBM-hot. Chunks already resident at tier 0 are kept;
+        spilled chunks are promoted onto the transferred bytes. Stops
+        (keeping the rooted prefix) at a geometry mismatch or a dry
+        pool after one eviction attempt per page. Returns pages
+        imported/promoted; 0 on a bad blob — a transfer is a warm
+        start, never a failure (the caller re-prefills the miss)."""
+        if self._radix is None or not blob:
+            return 0
+        FAULTS.check("pages.import")
+        from . import kv_wire
+        try:
+            recs = kv_wire.decode(blob, self.ecfg.page_size)
+        except kv_wire.WireError:
+            return 0
+        want = kv_wire.cache_spec(self.k_cache, self.v_cache)
+        parent = None
+        imported = 0
+        for i, rec in enumerate(recs):
+            if int(rec.get("p", -1)) != i - 1:
+                break         # a request transfer is ONE rooted chain
+            chunk = tuple(int(t) for t in rec["c"])
+            node = self._radix.child(parent, chunk)
+            if node is not None and node.tier == 0:
+                parent = node
+                continue      # already HBM-hot here: nothing to upload
+            kv = (rec["k"], rec["v"])
+            if kv_wire.kv_spec(kv) != want:
+                break
+            if not self._pt.n_free:
+                self.radix_evict(1)
+            pg = self._pt.alloc_pinned()
+            if pg is None:
+                break         # pool dry: keep the rooted prefix we got
+            kp = jax.tree_util.tree_map(self._gr, kv[0])
+            vp = jax.tree_util.tree_map(self._gr, kv[1])
+            self.k_cache, self.v_cache = self._upload_page_fn(
+                self.k_cache, self.v_cache, kp, vp, self._gr(np.int32(pg)))
+            parent = self._radix.insert_page(parent, chunk, pg)
+            imported += 1
+        if imported and self._arena is not None:
+            # promotions over spilled chunks retired their host bytes
+            self._arena.free_all(self._radix.take_dropped_hosts())
         return imported
 
     @property

@@ -123,6 +123,24 @@ point("gateway.stream", "ollama_operator_tpu/operator/gateway.py",
       armed fail severs the upstream mid-stream exactly like a replica
       death (the failover drills ride this), an armed delay models a
       stalling replica.""")
+point("pages.export", "ollama_operator_tpu/runtime/engine.py",
+      """Top of Engine.export_request_kv, before any page is gathered
+      for a disagg handoff; an armed fail surfaces as a failed
+      /api/kv_export — the gateway downgrades the handoff to journal
+      replay on the decode pool, never a client error. An armed delay
+      models a slow transfer link.""")
+point("pages.import", "ollama_operator_tpu/runtime/engine.py",
+      """Top of Engine.import_request_kv, before any page is allocated
+      on the decode side of a disagg transfer; an armed fail leaves
+      the page table untouched (check() stays clean) and the decode
+      replica simply re-prefills the prompt — a transfer is a warm
+      start, never a correctness dependency.""")
+point("gateway.handoff", "ollama_operator_tpu/operator/gateway.py",
+      """Between the prefill replica's first-token handoff frame and
+      the decode-pool KV import dispatch; an armed fail kills the
+      handoff orchestration mid-flight — replayable streams must fall
+      back to journal replay on the decode pool with zero client error
+      frames, an armed delay models a saturated transfer link.""")
 
 
 class InjectedFault(RuntimeError):

@@ -371,6 +371,39 @@ declare("TPU_GATEWAY_PERSIST_FLUSH_MS", "float", 50, "gateway",
         "this much journal progress (downgrading a resume to the "
         "exactly-once error frame)")
 
+# -- disaggregated prefill/decode pools (ISSUE 20) --------------------------
+
+declare("TPU_DISAGG", "enum", "auto", "disagg",
+        "gateway disaggregation gate: auto (default) hands off whenever "
+        "both a prefill and a decode replica are routable, 0 disables "
+        "routing-level disaggregation even if pool Deployments exist")
+declare("TPU_DISAGG_ROLE", "enum", None, "disagg",
+        "this replica's pool, set by the operator on pool Deployments "
+        "(prefill|decode); unset = unified replica. Informational on "
+        "the server (surfaced in /api/ps lifecycle) — routing is the "
+        "gateway's job")
+declare("TPU_DISAGG_HANDOFF_TIMEOUT_S", "float", 30, "disagg",
+        "bound on one prefill->decode handoff leg (the gateway's "
+        "/api/kv_import call and the decode replica's pull from the "
+        "prefill replica); expiry downgrades the handoff to journal "
+        "replay on the decode pool — never a client error")
+declare("TPU_DISAGG_TRANSFER_MB_S", "float", 0, "disagg",
+        "KV page transfer pacing in MB/s applied on the export side's "
+        "chunked writes; 0 = unthrottled (page copies already ride the "
+        "host arena, not HBM bandwidth)")
+declare("TPU_DISAGG_PREFILL_MIN", "int", 1, "disagg",
+        "prefill pool autoscale floor when spec.disaggregate.prefill "
+        "sets no minReplicas")
+declare("TPU_DISAGG_PREFILL_MAX", "int", 4, "disagg",
+        "prefill pool autoscale ceiling when spec.disaggregate.prefill "
+        "sets no maxReplicas (prefill scales on queued backlog tokens)")
+declare("TPU_DISAGG_DECODE_MIN", "int", 1, "disagg",
+        "decode pool autoscale floor when spec.disaggregate.decode "
+        "sets no minReplicas")
+declare("TPU_DISAGG_DECODE_MAX", "int", 8, "disagg",
+        "decode pool autoscale ceiling when spec.disaggregate.decode "
+        "sets no maxReplicas (decode scales on slot occupancy)")
+
 
 def _main() -> None:
     by_sub: Dict[str, List[Knob]] = {}

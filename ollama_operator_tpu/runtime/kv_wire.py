@@ -1,0 +1,121 @@
+"""Shared wire format for serialized KV page records.
+
+One codec, two consumers: tier-2 fleet prefix snapshots (engine
+``export_prefixes``/``import_prefixes``, persisted via gguf/store.py)
+and the disaggregated prefill→decode KV transfer (engine
+``export_request_kv``/``import_request_kv`` over ``/api/kv_export`` /
+``/api/kv_import``).  Before ISSUE 20 the format lived inline in the
+snapshot methods; factoring it here puts the version guard and every
+geometry/corruption check in ONE place, so the two paths cannot drift
+into almost-compatible blobs.
+
+A blob is ``pickle`` protocol 4 of::
+
+    {"v": WIRE_VERSION, "ps": <page_size>, "recs": [record, ...]}
+
+where each record is ``{"p": parent_index, "c": np.int32 token chunk,
+"k": k_page, "v": v_page}``.  ``p`` indexes an EARLIER record in the
+same blob (-1 = child of the radix root), so every decodable path is
+rooted by construction.  ``k``/``v`` are per-layer trees of one-page
+arrays (page axis 1 kept, length 1) exactly as gathered from the paged
+pool — geometry is checked against the importing engine's cache spec
+record-by-record, because a blob may legitimately mix importable and
+foreign records (e.g. a fleet snapshot from a differently-sharded
+replica).
+
+``decode`` raises :class:`WireError` on anything structurally wrong
+(bad pickle, wrong version, page-size mismatch, malformed record
+list); callers that treat a bad blob as "no warm start" catch it and
+carry on.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+WIRE_VERSION = 1
+
+
+class WireError(ValueError):
+    """A KV wire blob failed a structural or version check."""
+
+
+def spec(tree: Any, page_axis1: bool = False):
+    """Shape/dtype signature of a KV tree.  ``page_axis1`` collapses
+    axis 1 (the page axis of the pooled cache) to 1 so a full cache's
+    spec compares equal to a single gathered page's."""
+    import jax
+    return jax.tree_util.tree_map(
+        lambda a: ((tuple(a.shape[:1]) + (1,) + tuple(a.shape[2:]))
+                   if page_axis1 else tuple(a.shape),
+                   np.dtype(a.dtype)), tree)
+
+
+def cache_spec(k_cache: Any, v_cache: Any):
+    """The signature one exported page must match to be importable
+    into an engine holding ``k_cache``/``v_cache``."""
+    return (spec(k_cache, True), spec(v_cache, True))
+
+
+def kv_spec(kv: Tuple[Any, Any]):
+    """Signature of one ``(k_page, v_page)`` record payload."""
+    return (spec(kv[0]), spec(kv[1]))
+
+
+def kv_nbytes(kv: Tuple[Any, Any]) -> int:
+    """Payload bytes of one record (budget accounting)."""
+    import jax
+    return sum(int(leaf.nbytes) for leaf in jax.tree_util.tree_leaves(kv))
+
+
+def record(parent_idx: int, chunk: Any, kv: Tuple[Any, Any]
+           ) -> Dict[str, Any]:
+    """Build one wire record: ``chunk`` is the page's token ids,
+    ``parent_idx`` the index of its parent record in the same blob
+    (-1 = root child)."""
+    return {"p": int(parent_idx), "c": np.asarray(chunk, np.int32),
+            "k": kv[0], "v": kv[1]}
+
+
+def encode(recs: List[Dict[str, Any]], page_size: int) -> bytes:
+    """Serialize records into a self-contained versioned blob."""
+    return pickle.dumps(
+        {"v": WIRE_VERSION, "ps": int(page_size), "recs": recs},
+        protocol=4)
+
+
+def decode(blob: bytes, page_size: int) -> List[Dict[str, Any]]:
+    """Parse + validate a blob for an engine with ``page_size`` pages.
+    Returns the record list; raises :class:`WireError` on corruption,
+    version skew, or page-geometry mismatch.  Per-record KV geometry
+    is NOT checked here (records may individually miss the importer's
+    cache spec — see module docstring); use :func:`kv_spec` against
+    :func:`cache_spec` at the import site."""
+    if not blob:
+        raise WireError("empty blob")
+    try:
+        data = pickle.loads(blob)
+    except Exception as e:  # noqa: BLE001 — any unpickle failure is corruption
+        raise WireError(f"undecodable blob: {type(e).__name__}: {e}")
+    if not isinstance(data, dict):
+        raise WireError(f"blob root is {type(data).__name__}, not dict")
+    v = data.get("v")
+    if v != WIRE_VERSION:
+        raise WireError(f"wire version {v!r}, want {WIRE_VERSION}")
+    ps = data.get("ps")
+    if ps != page_size:
+        raise WireError(f"page size {ps!r}, want {page_size}")
+    recs = data.get("recs")
+    if not isinstance(recs, list):
+        raise WireError("recs is not a list")
+    for i, rec in enumerate(recs):
+        if not isinstance(rec, dict) or "c" not in rec \
+                or "k" not in rec or "v" not in rec:
+            raise WireError(f"record {i} malformed")
+        p = int(rec.get("p", -1))
+        if p >= i:
+            raise WireError(f"record {i} parent {p} not an earlier record")
+    return recs

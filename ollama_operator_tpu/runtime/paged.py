@@ -182,6 +182,20 @@ class PageTable:
         owned.clear()
         self.tables[slot, :] = TRASH_PAGE
 
+    def alloc_pinned(self) -> Optional[int]:
+        """Allocate one page owned solely by the radix tree (rc = pins
+        = 1, no slot mapping): the disagg KV import uploads transferred
+        bytes into it and grafts it into the tree, with no slot in the
+        picture. ``check()`` stays clean (rc == mappings + pins).
+        Returns None on a dry pool — the caller evicts or stops."""
+        if not self._free:
+            return None
+        pg = self._free.pop()
+        assert self._rc[pg] == 0, f"free page {pg} had rc {self._rc[pg]}"
+        self._rc[pg] = 1
+        self._pins[pg] = 1
+        return pg
+
     def pin(self, pg: int):
         """Take a radix-tree reference on a live page: it survives the
         owning slot's release until ``unpin``."""

@@ -323,6 +323,35 @@ class RadixCache:
         self._n += 1
         return nn
 
+    def insert_page(self, parent: Optional[_Node], chunk: Tuple[int, ...],
+                    page: int) -> _Node:
+        """Attach (or promote) ONE tier-0 node under ``parent`` (None =
+        root) backed by ``page`` — the disagg KV import's graft. The
+        caller already holds the page's pin (``alloc_pinned``). An
+        existing tier-1 child is promoted onto ``page`` and its host
+        entry lands in ``take_dropped_hosts`` (the import walks parents
+        first, so the tier0*-then-tier1* path invariant is preserved);
+        an existing tier-0 child is a caller bug — the fresh page would
+        leak its pin."""
+        node = parent or self._root
+        stamp = self._tick()
+        child = node.children.get(chunk)
+        if child is None:
+            child = _Node(chunk, int(page), node, stamp)
+            node.children[chunk] = child
+            self._n += 1
+            self._n_t0 += 1
+            return child
+        assert child.tier != 0, "insert_page over a tier-0 node"
+        child.page = int(page)
+        child.tier = 0
+        self._n_t0 += 1
+        if child.host is not None:
+            self._dropped_hosts.append(child.host)
+            child.host = None
+        child.stamp = stamp
+        return child
+
     def walk(self) -> List[_Node]:
         """Every resident node, parents strictly before children (BFS) —
         the snapshot exporter's traversal order."""

@@ -395,6 +395,10 @@ class Scheduler:
         # conversation hot in a llama.cpp slot; here any shared prefix —
         # system prompt, earlier chat turns — is reusable)
         self._parked: dict = {}
+        # exclusive tasks (disagg KV export/import): closures drained at
+        # the top of _step, ON the scheduler thread, so page gathers and
+        # radix grafts never race a dispatch (run_exclusive)
+        self._tasks: List = []
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._lock = threading.Lock()
@@ -413,6 +417,57 @@ class Scheduler:
         self._thread.start()
 
     # ------------------------------------------------------------------
+    def run_exclusive(self, fn, timeout_s: float = 30.0):
+        """Run ``fn()`` on the scheduler thread, between steps, and
+        return its result (re-raising its exception).  The disagg KV
+        export/import paths ride this: they touch the page table, the
+        radix tree, and the KV pool, none of which may be mutated while
+        a dispatch is being assembled.  The scheduler drains queued
+        tasks at the top of every ``_step`` — under load that is after
+        the in-flight dispatch lands; idle, the wake event pops the
+        0.05s wait immediately.  Raises TimeoutError if the scheduler
+        thread is wedged (or broken) past ``timeout_s``; the task is
+        then abandoned (a late run finds its waiter gone and discards
+        the result via the ``dead`` flag)."""
+        done = threading.Event()
+        cell: dict = {"dead": False}
+
+        def task():
+            try:
+                r = fn()
+                if not cell["dead"]:
+                    cell["r"] = r
+            except BaseException as e:  # noqa: BLE001 — relayed to caller
+                if not cell["dead"]:
+                    cell["e"] = e
+            finally:
+                done.set()
+
+        with self._lock:
+            if self.broken:
+                raise SchedulerBroken(
+                    "scheduler stopped after repeated engine failures")
+            self._tasks.append(task)
+        self._wake.set()
+        if not done.wait(timeout_s):
+            cell["dead"] = True
+            raise TimeoutError(
+                f"scheduler did not run exclusive task in {timeout_s}s")
+        if "e" in cell:
+            raise cell["e"]
+        return cell.get("r")
+
+    def _run_tasks(self):
+        """Drain queued exclusive tasks (scheduler thread only).  A task
+        raising is the task's problem — relayed to its waiter by the
+        wrapper, never a scheduler failure."""
+        if not self._tasks:
+            return
+        with self._lock:
+            tasks, self._tasks = self._tasks, []
+        for t in tasks:
+            t()
+
     def _tokens_done(self) -> float:
         """Tokens the engine has pushed through so far (prompt +
         generated), live — the numerator of the queue model's observed
@@ -706,6 +761,10 @@ class Scheduler:
         return {
             "state": ("broken" if self.broken
                       else "draining" if self.draining else "serving"),
+            # disagg pool role stamped by the operator on pool
+            # Deployments; "" = unified replica (routing is the
+            # gateway's job — this is the observable, not the switch)
+            "pool": os.environ.get("TPU_DISAGG_ROLE", ""),
             # live work counters: the operator's drain-first scale-down
             # polls these to know when a victim replica is empty
             "active_streams": self.n_active,
@@ -2017,6 +2076,12 @@ class Scheduler:
                 if r is not None and s not in self._prefilling}
 
     def _step(self):
+        if self._tasks:
+            # exclusive tasks see a quiet pipeline: land any in-flight
+            # dispatch first so a KV import's cache upload never races a
+            # decode reading the same buffers
+            self._drain_pending()
+            self._run_tasks()
         self._shed_expired()
         self._throttle_over_limit()
         self._preempt_for_priority()

@@ -449,6 +449,39 @@ class LoadedModel:
         return True
 
     # ------------------------------------------------------------------
+    # disaggregated prefill→decode handoff (ISSUE 20): the prefill
+    # replica exports the request's quiescent KV pages; the decode
+    # replica imports them as a radix warm start. Both run on the
+    # scheduler thread (run_exclusive) so the page gathers / grafts
+    # never race a dispatch. Multi-host slices are gated out the same
+    # way multimodal is: the paged radix pool is leader-local.
+    # ------------------------------------------------------------------
+    def kv_export(self, ids: List[int],
+                  max_bytes: int = 64 << 20) -> Optional[bytes]:
+        """Serialize the KV pages covering ``ids``'s radix prefix.
+        None means nothing exportable (dense engine, no prefix parked,
+        multi-host) — the gateway downgrades to journal replay, so this
+        is a soft answer, never an error."""
+        if self.control_plane is not None or self.follower:
+            return None
+        if not getattr(self.engine, "radix_enabled", False):
+            return None
+        return self.scheduler.run_exclusive(
+            lambda: self.engine.export_request_kv(ids, max_bytes))
+
+    def kv_import(self, blob: bytes) -> int:
+        """Graft a transferred KV blob into this replica's radix tree;
+        returns pages imported (0 = nothing usable: the decode side
+        simply re-prefills — a transfer is a warm start, never a
+        correctness dependency)."""
+        if self.control_plane is not None or self.follower:
+            return 0
+        if not getattr(self.engine, "radix_enabled", False):
+            return 0
+        return self.scheduler.run_exclusive(
+            lambda: self.engine.import_request_kv(blob))
+
+    # ------------------------------------------------------------------
     # multimodal (llava): image bytes → projected embeddings → spliced
     # prompt embedding sequence handed to the engine's embeds admission
     # ------------------------------------------------------------------
@@ -624,7 +657,17 @@ class LoadedModel:
                     f"model {self.name} has no vision projector; it cannot "
                     f"accept images")
             ids, embeds = self.splice_images(ids, images)
+        # disagg prefill-only mode (gateway-injected option, ISSUE 20):
+        # the prefill replica runs prefill + ONE decoded token — enough
+        # to commit the first frame — then finishes; the scheduler's
+        # finish path parks the prompt's KV in the radix tree, which is
+        # exactly what /api/kv_export ships to the decode pool.
+        # merge_options ignores unknown keys, so the flag never reaches
+        # SlotOptions (same contract as options.trace).
+        prefill_only = bool((options or {}).get("disagg_prefill"))
         max_new = min(num_predict, self.engine.max_seq - len(ids) - 1)
+        if prefill_only:
+            max_new = min(max_new, 1)
         if max_new < 1:
             raise BadRequest(
                 f"prompt of {len(ids)} tokens leaves no room to generate "
@@ -656,11 +699,11 @@ class LoadedModel:
         # must not leak into it (they would re-enter as garbage tokens)
         return _OwnedStream(
             self._stream(req, stops, context_ids, max_new, t0, cancel_event,
-                         want_timings),
+                         want_timings, prefill_only),
             req)
 
     def _stream(self, req, stops, ids, max_new, t0, cancel_event,
-                want_timings: bool = False
+                want_timings: bool = False, prefill_only: bool = False
                 ) -> Iterator[Tuple[str, Optional[GenerateResult]]]:
         sd = StreamDecoder(self.tokenizer)
         sm = StopMatcher(stops)
@@ -712,6 +755,12 @@ class LoadedModel:
             result.done_reason = ("stop"
                                   if sm.hit or st.n_generated < max_new
                                   else "length")
+            if prefill_only and result.done_reason == "length":
+                # cut at the injected 1-token cap, not a real completion:
+                # the gateway keys its handoff on this reason. A genuine
+                # "stop" (first token was EOG / a stop sequence) stays
+                # "stop" — the stream is actually done, no handoff needed.
+                result.done_reason = "handoff"
         result.context = ids + all_ids
         METRICS.inc("tpu_model_requests_total")
         METRICS.inc("tpu_model_generated_tokens_total", st.n_generated)

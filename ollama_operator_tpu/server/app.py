@@ -1211,6 +1211,8 @@ class Handler(BaseHTTPRequestHandler):
                 "/api/embed": self._api_embed,
                 "/api/drain": self._api_drain,
                 "/api/prefix_probe": self._api_prefix_probe,
+                "/api/kv_export": self._api_kv_export,
+                "/api/kv_import": self._api_kv_import,
                 "/v1/chat/completions": self._oai_chat,
                 "/v1/completions": self._oai_completions,
                 "/v1/embeddings": self._oai_embeddings,
@@ -1621,6 +1623,119 @@ class Handler(BaseHTTPRequestHandler):
                     matched = int(engine.prefix_probe(ids))
         self._send_json({"model": model, "matched_tokens": matched,
                          "matched_tier": tier, "prompt_tokens": n_ids})
+
+    # -- disaggregated prefill→decode KV transfer (ISSUE 20) -----------
+    def _request_ids(self, lm, body: Dict):
+        """Token ids exactly as /api/generate (or /api/chat, when the
+        body carries ``messages``) would admit them — the KV transfer is
+        keyed by the request's real admitted ids, so rendering must not
+        drift from the serving paths."""
+        if body.get("messages") is not None:
+            text = lm.render_chat(body.get("messages") or [],
+                                  template=body.get("template"),
+                                  tools=body.get("tools"))
+            ids = []
+        else:
+            prompt = body.get("prompt", "")
+            text = prompt if body.get("raw") else lm.render_prompt(
+                prompt, system=body.get("system"),
+                template=body.get("template"), suffix=body.get("suffix"))
+            ids = list(body.get("context") or [])
+        tok = lm.tokenizer
+        return ids + tok.encode(text, add_bos=(not ids) and tok.add_bos)
+
+    def _api_kv_export(self, body: Dict):
+        """Serve the KV pages covering this request's prompt prefix as
+        one octet-stream blob (runtime/kv_wire.py format). 404 = nothing
+        exportable here (dense engine, prefix not parked, multi-host) —
+        the puller treats any non-200 as "re-prefill instead", so this
+        endpoint never invents an error frame. Writes are paced to
+        TPU_DISAGG_TRANSFER_MB_S (0 = unthrottled) so a big transfer
+        cannot starve co-resident decode traffic of NIC bandwidth."""
+        model = self._model_arg(body)
+        lm = self.manager.require_loaded(model,
+                                         keep_alive=body.get("keep_alive"))
+        if not hasattr(lm, "kv_export"):
+            self._send_json({"error": "kv export unsupported"}, 404)
+            return
+        ids = self._request_ids(lm, body)
+        max_bytes = int(body.get("max_bytes") or (64 << 20))
+        try:
+            blob = lm.kv_export(ids, max_bytes)
+        except Exception as e:  # noqa: BLE001 — incl. injected pages.export
+            # faults: a failed export is a soft downgrade for the caller
+            # (journal replay / cold prefill), so answer 503, not 500
+            self._send_json({"error": f"kv export failed: {e}"}, 503)
+            return
+        if not blob:
+            self._send_json({"error": "no exportable prefix"}, 404)
+            return
+        rate = float(os.environ.get("TPU_DISAGG_TRANSFER_MB_S", "0") or 0)
+        self.send_response(200)
+        self.send_header("Content-Type", "application/octet-stream")
+        self.send_header("Content-Length", str(len(blob)))
+        self.end_headers()
+        step = 256 << 10
+        t0 = time.monotonic()
+        for off in range(0, len(blob), step):
+            self.wfile.write(blob[off:off + step])
+            if rate > 0:
+                # sleep until the bytes sent so far fit under the cap
+                ahead = ((off + step) / (rate * (1 << 20))
+                         - (time.monotonic() - t0))
+                if ahead > 0:
+                    time.sleep(min(ahead, 1.0))
+        self.wfile.flush()
+
+    def _api_kv_import(self, body: Dict):
+        """Pull a request's KV blob straight from the prefill replica
+        named by ``source`` and graft it into this replica's radix tree
+        (direct replica-to-replica transfer; the gateway only
+        orchestrates). Always answers JSON with ``imported_pages`` —
+        0 with a 2xx still means "go ahead and serve, you'll just
+        re-prefill", which is why import failures are 5xx only when the
+        pull itself broke."""
+        model = self._model_arg(body)
+        lm = self.manager.require_loaded(model,
+                                         keep_alive=body.get("keep_alive"))
+        source = body.get("source")
+        if not source:
+            raise ApiError(400, "missing 'source'")
+        fwd = {k: body[k] for k in
+               ("model", "prompt", "system", "template", "suffix", "raw",
+                "context", "messages", "tools", "keep_alive", "max_bytes")
+               if body.get(k) is not None}
+        timeout = float(os.environ.get("TPU_DISAGG_HANDOFF_TIMEOUT_S",
+                                       "30") or 30)
+        import urllib.request
+        req = urllib.request.Request(
+            source.rstrip("/") + "/api/kv_export",
+            data=json.dumps(fwd).encode(),
+            headers={"Content-Type": "application/json"})
+        t0 = time.monotonic()
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                blob = resp.read()
+        except Exception as e:  # noqa: BLE001 — network/HTTP/timeout
+            self._send_json({"error": f"kv pull failed: {e}",
+                             "imported_pages": 0}, 502)
+            return
+        try:
+            pages = lm.kv_import(blob)
+        except Exception as e:  # noqa: BLE001 — incl. injected
+            # pages.import faults: page table untouched, caller serves
+            # the request cold
+            self._send_json({"error": f"kv import failed: {e}",
+                             "imported_pages": 0}, 503)
+            return
+        dt = time.monotonic() - t0
+        if pages:
+            METRICS.inc("tpu_model_kv_transfer_pages_total", float(pages))
+            METRICS.inc("tpu_model_kv_transfer_bytes_total",
+                        float(len(blob)))
+            METRICS.observe("tpu_model_kv_transfer_seconds", dt)
+        self._send_json({"imported_pages": pages, "bytes": len(blob),
+                         "seconds": dt})
 
     def _api_embeddings(self, body: Dict):
         lm = self.manager.require_loaded(self._model_arg(body),

@@ -96,6 +96,21 @@ class Metrics:
         with self._lock:
             self._hists.setdefault(self._key(name, labels), Histogram())
 
+    def hist_buckets(self, name: str,
+                     labels: str = "") -> Tuple[Tuple[float, ...],
+                                                Tuple[int, ...]]:
+        """(bucket upper bounds, per-bucket counts incl. the +Inf
+        overflow slot) for one histogram — a snapshot callers can delta
+        across a measurement window and feed to histogram_quantile-style
+        interpolation (bench.py's ITL phases). Empty histogram renders
+        as the default buckets with zero counts."""
+        with self._lock:
+            h = self._hists.get(self._key(name, labels))
+            if h is None:
+                return (Histogram.DEFAULT_BUCKETS,
+                        (0,) * (len(Histogram.DEFAULT_BUCKETS) + 1))
+            return h.buckets, tuple(h.counts)
+
     def hist_totals(self, name: str) -> Tuple[int, float]:
         """(observation count, value sum) aggregated across every label
         set of a histogram — e.g. total device busy-seconds across all
@@ -447,6 +462,31 @@ GLOBAL.describe("tpu_model_chaos_events_total",
                 "Randomized chaos-campaign fault events injected, by "
                 "fault point (runtime/chaos.py; the label set is the "
                 "full FAULTS catalog)")
+GLOBAL.describe("tpu_model_disagg_handoffs_total",
+                "Disaggregated prefill->decode handoffs at the gateway, "
+                "by outcome (result=transferred|replayed|"
+                "unified_fallback): transferred = KV pages moved and the "
+                "decode pool continued the stream, replayed = transfer "
+                "failed and the journal replay path re-prefilled on "
+                "decode, unified_fallback = no decode replica routable "
+                "so the request served unified — every rung is "
+                "bit-identical to the client (ISSUE 20)")
+GLOBAL.describe("tpu_model_kv_transfer_pages_total",
+                "KV pages imported over replica-to-replica transfer "
+                "(/api/kv_import pull from the prefill replica)")
+GLOBAL.describe("tpu_model_kv_transfer_bytes_total",
+                "Wire bytes of KV page payload imported over "
+                "replica-to-replica transfer (pre-decode, i.e. the "
+                "kv_wire blob size; bounded per-export by "
+                "TPU_DISAGG_TRANSFER_MB_S pacing)")
+GLOBAL.describe("tpu_model_kv_transfer_seconds",
+                "End-to-end KV transfer latency histogram per handoff "
+                "(decode-side: pull from prefill + upload + radix "
+                "graft); only transfers that imported >0 pages observe")
+GLOBAL.describe("tpu_model_disagg_pool_replicas",
+                "Replicas the gateway tracks per disagg pool "
+                "(pool=unified|prefill|decode); unified fleets read "
+                "everything under pool=\"unified\"")
 # pre-seed the failure counters at 0: alert rules rate() over these, and
 # a series that first appears AT the first failure hides that failure
 # (the stall/chunk counters likewise: a mixed-load dashboard must read 0,
@@ -576,13 +616,23 @@ GLOBAL.inc("tpu_model_gateway_persist_writes_total", 0.0)
 GLOBAL.inc("tpu_model_gateway_persist_restores_total", 0.0)
 GLOBAL.inc("tpu_model_gateway_drain_total", 0.0)
 GLOBAL.inc("tpu_model_leader_lost_total", 0.0)
+# disaggregated serving (ISSUE 20): every handoff rung pre-seeded — the
+# acceptance dashboards alert on replayed/unified_fallback rates, and a
+# fleet that has never handed off must read 0, not absent
+for _result in ("transferred", "replayed", "unified_fallback"):
+    GLOBAL.inc("tpu_model_disagg_handoffs_total", 0.0,
+               f'{{result="{_result}"}}')
+GLOBAL.inc("tpu_model_kv_transfer_pages_total", 0.0)
+GLOBAL.inc("tpu_model_kv_transfer_bytes_total", 0.0)
+GLOBAL.seed_histogram("tpu_model_kv_transfer_seconds")
 # chaos-campaign event counter: one series per registered fault point
 # (this literal list mirrors runtime/faults.py CATALOG; test_faults
 # asserts the two stay in sync)
 for _point in ("admission.predict", "detok.feed", "engine.admit",
                "engine.step", "engine.watchdog", "follower.send",
-               "gateway.route", "gateway.stream", "kube.request",
-               "operator.scrape", "pages.alloc", "pages.restitch",
+               "gateway.handoff", "gateway.route", "gateway.stream",
+               "kube.request", "operator.scrape", "pages.alloc",
+               "pages.export", "pages.import", "pages.restitch",
                "pages.spill", "scheduler.replay"):
     GLOBAL.inc("tpu_model_chaos_events_total", 0.0,
                f'{{point="{_point}"}}')

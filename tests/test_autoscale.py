@@ -499,6 +499,160 @@ def make_fleet(spec_autoscale=DIURNAL_SPEC, **model_kw):
     return kube, rec, harness, clock, recon
 
 
+# -- disaggregated pools (ISSUE 20) -------------------------------------
+
+class _PoolReplica(_Replica):
+    """A pool-labeled fake server: prefill replicas report prompt-token
+    backlog (their slots turn over every tick, so occupancy is noise),
+    decode replicas report slot occupancy (their backlog queues
+    upstream) — the two native demand signals pool_policy scales on."""
+
+    PROMPT_TOKENS = 256
+
+    def __init__(self, pod: str, ip: str, pool: str):
+        super().__init__(pod, ip)
+        self.pool = pool
+
+    def ps_body(self):
+        body = super().ps_body()
+        m = body["models"][0]
+        if self.pool == "prefill":
+            nq = len(self.queued)
+            m["utilization"]["occupancy"] = 0.0
+            m["admission"]["backlog_tokens_by_class"] = (
+                {"default": self.PROMPT_TOKENS * nq} if nq else {})
+        else:
+            m["admission"]["queued_by_class"] = {}
+            m["admission"]["backlog_tokens_by_class"] = {}
+        return body
+
+
+class PoolFleetHarness(FleetHarness):
+    """FleetHarness over a split fleet: two pool Deployments share the
+    fleet-wide app label, pods carry workload.POOL_LABEL, and a request
+    flows prefill slot -> KV handoff -> decode slot (the ISSUE 20
+    pipeline at control-plane granularity)."""
+
+    def __init__(self, kube: FakeKube, name="phi", namespace="default"):
+        super().__init__(kube, name, namespace)
+        self.pool_apps = {p: workload.pool_app_name(name, p)
+                          for p in workload.DISAGG_POOLS}
+        self.decode_pending = []   # prefilled, awaiting a decode slot
+
+    def _spawn_pool(self, pool: str):
+        self._seq += 1
+        pod = f"{self.pool_apps[pool]}-{self._seq:04d}"
+        ip = f"10.1.0.{self._seq}"
+        self.kube.create({
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": pod, "namespace": self.namespace,
+                         "labels": {"app": self.app,
+                                    workload.POOL_LABEL: pool}},
+            "status": {"phase": "Running", "podIP": ip}})
+        r = _PoolReplica(pod, ip, pool)
+        if pool == "decode":          # replayed streams are decode work
+            while self.replay_pool:
+                s = self.replay_pool.pop()
+                (r.active if len(r.active) < r.CAP else r.queued).append(s)
+                self.replayed += 1
+        self.by_pod[pod], self.by_ip[ip] = r, r
+
+    def pool_count(self, pool: str) -> int:
+        return sum(1 for r in self.by_pod.values() if r.pool == pool)
+
+    def sync(self):
+        pods = self.kube.list("v1", "Pod", self.namespace,
+                              label_selector=f"app={self.app}")
+        names = {(p.get("metadata") or {}).get("name") for p in pods}
+        for pod_name in list(self.by_pod):
+            if pod_name not in names:
+                r = self.by_pod.pop(pod_name)
+                self.by_ip.pop(r.ip, None)
+                if r.alive and not r.draining:
+                    self.error_frames += len(r.active) + len(r.queued)
+        for pool, papp in self.pool_apps.items():
+            dep = self.kube.get("apps/v1", "Deployment",
+                                self.namespace, papp)
+            if dep is None:
+                continue
+            want = int(dep["spec"].get("replicas", 1) or 0)
+            while self.pool_count(pool) < want:
+                self._spawn_pool(pool)
+            members = [r for r in self.by_pod.values() if r.pool == pool]
+            ready = sum(1 for r in members if not r.draining)
+            self.kube.set_status(
+                "apps/v1", "Deployment", self.namespace, papp,
+                {"replicas": len(members), "readyReplicas": ready,
+                 "availableReplicas": ready})
+
+    def targets(self, pool=None):
+        return [r for r in self.by_pod.values()
+                if r.alive and not r.draining
+                and (pool is None or r.pool == pool)]
+
+    def route(self):
+        for pool, queue in (("prefill", self.pending),
+                            ("decode", self.decode_pending)):
+            ts = self.targets(pool)
+            if not ts:
+                continue
+            while queue:
+                t = min(ts, key=lambda r: len(r.active) + len(r.queued))
+                s = queue.pop(0)
+                (t.active if len(t.active) < t.CAP else t.queued).append(s)
+
+    def step(self):
+        for r in self.by_pod.values():
+            if not r.alive:
+                continue
+            if r.pool == "prefill":
+                # a prefill slot turns over every tick: the finished
+                # prompt hands its KV pages off to the decode pool
+                self.decode_pending.extend(r.active)
+                r.active = []
+                while r.queued and len(r.active) < r.CAP:
+                    r.active.append(r.queued.pop(0))
+            else:
+                self.completed += sum(1 for s in r.active if s.left <= 1)
+                for s in r.active:
+                    s.left -= 1
+                r.active = [s for s in r.active if s.left > 0]
+                while r.queued and len(r.active) < r.CAP:
+                    r.active.append(r.queued.pop(0))
+        self.route()
+
+    @property
+    def in_flight(self) -> int:
+        return super().in_flight + len(self.decode_pending)
+
+
+DISAGG_DIURNAL = {
+    "enabled": True,
+    # small per-replica backlog bar so the fake fleet's queues register
+    # as demand at test scale
+    "prefill": {"minReplicas": 1, "maxReplicas": 3,
+                "backlogTokensPerReplica": 512},
+    "decode": {"minReplicas": 1, "maxReplicas": 4},
+}
+
+
+def make_pool_fleet():
+    kube = FakeKube()
+    rec = RecordingRecorder()
+    harness = PoolFleetHarness(kube)
+    # pool loops never sleep the fleet — drop the idle TTL so the quiet
+    # tail parks both pools at their floors instead of racing a
+    # whole-Model scale-to-zero that disagg doesn't do
+    make_model(kube, autoscale=dict(DIURNAL_SPEC, idleTTLSeconds=0),
+               disaggregate=copy.deepcopy(DISAGG_DIURNAL))
+    clock = Clock()
+    recon = ModelReconciler(kube, rec, server_image="runtime:test",
+                            ps_fetch=harness.ps_fetch,
+                            drain_post=harness.drain_post,
+                            autoscaler=Autoscaler(now=clock))
+    return kube, rec, harness, clock, recon
+
+
 # -- end-to-end: the diurnal cycle --------------------------------------
 
 class TestFleetAutoscaling:
@@ -555,6 +709,59 @@ class TestFleetAutoscaling:
         assert max(e["replicas"] for e in timeline) <= 4
 
         out = os.environ.get("AUTOSCALE_TIMELINE")
+        if out:
+            with open(out, "w") as f:
+                json.dump(timeline, f)
+
+    def test_disagg_diurnal_per_pool_counts(self):
+        """ISSUE 20: the diurnal cycle on a DISAGGREGATED fleet — two
+        pool Deployments under independent control loops (prefill on
+        queued prompt-token backlog, decode on slot occupancy). The
+        timeline records per-pool replica counts; the error-frame
+        contract is unchanged: splitting the fleet must never cost a
+        client a stream."""
+        kube, rec, harness, clock, recon = make_pool_fleet()
+        assert boot(recon, kube, harness) == POLL
+        assert harness.pool_count("prefill") == 1
+        assert harness.pool_count("decode") == 1
+
+        timeline = []
+
+        def run(ticks, load_fn):
+            for i in range(ticks):
+                harness.offer(load_fn(i))
+                tick(recon, harness, clock)
+                timeline.append({
+                    "t": clock.t, "in_flight": harness.in_flight,
+                    "prefill": harness.pool_count("prefill"),
+                    "decode": harness.pool_count("decode")})
+
+        # morning: prompt-heavy pressure — backlog queues on the
+        # prefill pool, handoffs fill decode slots; BOTH pools grow,
+        # each on its own signal
+        run(14, lambda i: max(0, 16 - harness.in_flight))
+        assert max(e["prefill"] for e in timeline) >= 2
+        assert max(e["decode"] for e in timeline) >= 2
+
+        # afternoon trickle, then a quiet tail: both pools shrink
+        # drain-first back to their floors (pool loops never sleep the
+        # fleet — floors are >= 1)
+        run(26, lambda i: 1 if i % 2 == 0 else 0)
+        run(8, lambda i: 0)
+        assert harness.pool_count("prefill") == 1
+        assert harness.pool_count("decode") == 1
+
+        assert harness.error_frames == 0
+        assert harness.completed == harness.offered
+        assert max(e["prefill"] for e in timeline) <= 3
+        assert max(e["decode"] for e in timeline) <= 4
+        # per-pool intent survives in nested status.autoscale.<pool>
+        m = kube.get(API_VERSION, KIND, "default", "phi")
+        asc = m["status"]["autoscale"]
+        for pool in workload.DISAGG_POOLS:
+            assert asc[pool]["desiredReplicas"] == 1, (pool, asc)
+
+        out = os.environ.get("AUTOSCALE_POOL_TIMELINE")
         if out:
             with open(out, "w") as f:
                 json.dump(timeline, f)

@@ -1,0 +1,446 @@
+"""Disaggregated prefill/decode serving (ISSUE 20).
+
+Coverage, bottom-up:
+
+- the kv_wire codec: round-trip fidelity, the version guard, and every
+  structural rejection (a bad blob is "no warm start", never a crash);
+- engine-level KV transfer parity: a prefill engine exports a request's
+  radix pages, a decode engine imports them and continues — the client
+  stream (prefill's first token + decode's continuation) must be
+  bit-identical to a unified single-engine run, greedy AND seeded,
+  cross-checked against a dense engine; the no-transfer arms (dense
+  export, cold decode re-prefill, already-warm decode) land on the
+  same bytes; byte-budget cuts keep the shipped chain rooted; the
+  pages.{export,import} fault points leave page tables clean;
+- gateway-level handoff: the prefill leg streams the first token, the
+  decode pool serves the splice — transferred / replayed (prefill dies
+  before the handoff frame, or exactly at the KV export pull) /
+  unified_fallback all keep the client stream byte-identical with zero
+  error frames, with tpu_model_disagg_handoffs_total telling the truth;
+- the chaos drill: a seeded campaign over the pooled fleet where
+  kill_prefill_mid_handoff fires stays green — journal drained, every
+  stream terminal exactly once (run_campaign's final check).
+"""
+
+import dataclasses
+import pickle
+
+import numpy as np
+import pytest
+
+from ollama_operator_tpu.runtime import kv_wire
+from ollama_operator_tpu.runtime.faults import FAULTS, InjectedFault
+from ollama_operator_tpu.server.metrics import GLOBAL as METRICS
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    FAULTS.reset()
+
+
+def metric(name, labels=""):
+    return METRICS.get(name, labels)
+
+
+# ---------------------------------------------------------------------------
+# kv_wire codec (no engine, no jax)
+# ---------------------------------------------------------------------------
+
+def _rec(parent, chunk, fill):
+    kv = ({"l0": np.full((1, 1, 2, 4), fill, np.float32)},
+          {"l0": np.full((1, 1, 2, 4), -fill, np.float32)})
+    return kv_wire.record(parent, chunk, kv)
+
+
+class TestWireCodec:
+    def test_round_trip(self):
+        recs = [_rec(-1, [1, 2, 3, 4], 1.0), _rec(0, [5, 6, 7, 8], 2.0)]
+        blob = kv_wire.encode(recs, page_size=4)
+        out = kv_wire.decode(blob, page_size=4)
+        assert len(out) == 2
+        assert [r["p"] for r in out] == [-1, 0]
+        np.testing.assert_array_equal(out[1]["c"],
+                                      np.array([5, 6, 7, 8], np.int32))
+        np.testing.assert_array_equal(out[0]["k"]["l0"],
+                                      recs[0]["k"]["l0"])
+        assert kv_wire.kv_spec((out[0]["k"], out[0]["v"])) == \
+            kv_wire.kv_spec((recs[0]["k"], recs[0]["v"]))
+
+    def test_kv_nbytes_counts_both_trees(self):
+        r = _rec(-1, [1], 1.0)
+        assert kv_wire.kv_nbytes((r["k"], r["v"])) == 2 * 8 * 4
+
+    @pytest.mark.parametrize("blob", [
+        b"",
+        b"not a pickle at all",
+        pickle.dumps([1, 2, 3]),                               # root not dict
+        pickle.dumps({"v": 999, "ps": 4, "recs": []}),         # version skew
+        pickle.dumps({"v": kv_wire.WIRE_VERSION, "ps": 8,
+                      "recs": []}),                            # page-size skew
+        pickle.dumps({"v": kv_wire.WIRE_VERSION, "ps": 4,
+                      "recs": {"not": "a list"}}),
+        pickle.dumps({"v": kv_wire.WIRE_VERSION, "ps": 4,
+                      "recs": [{"p": -1}]}),                   # malformed rec
+    ])
+    def test_structural_rejections(self, blob):
+        with pytest.raises(kv_wire.WireError):
+            kv_wire.decode(blob, page_size=4)
+
+    def test_forward_parent_rejected(self):
+        # a record may only point at an EARLIER record: every decodable
+        # chain is rooted by construction
+        recs = [_rec(0, [1], 1.0)]
+        blob = kv_wire.encode(recs, page_size=4)
+        with pytest.raises(kv_wire.WireError):
+            kv_wire.decode(blob, page_size=4)
+
+
+# ---------------------------------------------------------------------------
+# engine-level transfer parity (real tiny engines, CPU jax)
+# ---------------------------------------------------------------------------
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from ollama_operator_tpu.models import decoder  # noqa: E402
+from ollama_operator_tpu.models.config import PRESETS  # noqa: E402
+from ollama_operator_tpu.runtime.engine import (Engine,  # noqa: E402
+                                                EngineConfig, SlotOptions)
+
+XLA = dataclasses.replace(PRESETS["tiny"], kernels="xla")
+GREEDY = SlotOptions(temperature=0.0)
+SEEDED = SlotOptions(temperature=0.9, top_k=40, seed=7)
+DENSE = EngineConfig(max_slots=4, max_seq_len=64, cache_dtype=jnp.float32,
+                     min_prefill_bucket=16)
+PAGED = dataclasses.replace(DENSE, paged=True, page_size=8)
+
+PROMPT = np.arange(1, 25, dtype=np.int32)        # 24 tokens = 3 full pages
+N_STEPS = 4
+
+
+@pytest.fixture(scope="module")
+def params():
+    return decoder.init_params(XLA, jax.random.key(0), jnp.float32)
+
+
+def _gen(eng, slot, full, opts, n):
+    first = eng.admit(slot, np.asarray(full, np.int32), opts)
+    return [first] + [int(eng.decode()[slot]) for _ in range(n)]
+
+
+def _export_blob(eng, opts):
+    """Run the prefill side on ``eng``: admit PROMPT, take the first
+    token, park the prompt pages, export the request chain. Returns
+    (first_token, blob)."""
+    first = eng.admit(0, PROMPT, opts)
+    eng.donate_prefix(0, list(PROMPT))
+    blob = eng.export_request_kv(list(PROMPT) + [first])
+    return first, blob
+
+
+@pytest.mark.parametrize("key,opts", [("greedy", GREEDY), ("seeded", SEEDED)])
+def test_handoff_stream_parity(params, key, opts):
+    """The disagg client stream — prefill replica's first token, then
+    the decode replica's continuation over transferred pages — must be
+    bit-identical to a unified run (and to a dense engine: the paged
+    transfer machinery may not perturb sampling)."""
+    ref_eng = Engine(XLA, params, ecfg=PAGED)
+    ref = _gen(ref_eng, 0, PROMPT, opts, N_STEPS)
+    dense_ref = _gen(Engine(XLA, params, ecfg=DENSE), 0, PROMPT, opts,
+                     N_STEPS)
+    assert ref == dense_ref, f"paged-vs-dense unified drift ({key})"
+
+    pre = Engine(XLA, params, ecfg=PAGED)
+    first, blob = _export_blob(pre, opts)
+    assert first == ref[0], f"prefill first-token drift ({key})"
+    assert blob is not None
+    assert len(kv_wire.decode(blob, PAGED.page_size)) == 3
+
+    dec = Engine(XLA, params, ecfg=PAGED)
+    assert dec.import_request_kv(blob) == 3
+    assert dec.radix_pages == 3
+    want, tier = dec.prefix_probe_tier(PROMPT)
+    assert tier == 0 and want >= 16          # at least the full pages
+    got = dec.stitch(0, PROMPT, want)
+    assert got >= 16                         # the transfer really served
+    out = [dec.extend(0, PROMPT, got, opts)] \
+        + [int(dec.decode()[0]) for _ in range(N_STEPS)]
+    assert out == ref, f"transferred stream drift ({key})"
+    for eng in (pre, dec):
+        eng._pt.check()
+
+
+@pytest.mark.parametrize("key,opts", [("greedy", GREEDY), ("seeded", SEEDED)])
+def test_replay_without_transfer_is_bit_identical(params, key, opts):
+    """The 'replayed' rung: no pages moved (transfer failed, dense
+    engine, cold decode replica) — the decode side re-prefills from the
+    prompt and must land on the same bytes."""
+    ref = _gen(Engine(XLA, params, ecfg=PAGED), 0, PROMPT, opts, N_STEPS)
+    cold = _gen(Engine(XLA, params, ecfg=PAGED), 0, PROMPT, opts, N_STEPS)
+    assert cold == ref, f"cold replay drift ({key})"
+
+
+def test_dense_engine_export_is_a_soft_none(params):
+    """A dense engine has no page pool: export answers None (the
+    gateway downgrades to replay), never an error."""
+    eng = Engine(XLA, params, ecfg=DENSE)
+    _gen(eng, 0, PROMPT, GREEDY, 1)
+    assert eng.export_request_kv(list(PROMPT)) is None
+
+
+def test_export_without_parked_prefix_is_none(params):
+    eng = Engine(XLA, params, ecfg=PAGED)
+    assert eng.export_request_kv(list(PROMPT)) is None
+
+
+def test_import_rejects_garbage_and_geometry_skew(params):
+    """A bad blob imports 0 pages and leaves the table untouched — a
+    transfer is a warm start, never a correctness dependency."""
+    eng = Engine(XLA, params, ecfg=PAGED)
+    free0 = eng.free_pages
+    assert eng.import_request_kv(b"") == 0
+    assert eng.import_request_kv(b"garbage bytes") == 0
+    # structurally valid blob whose page geometry misses this engine
+    blob = kv_wire.encode([_rec(-1, list(range(PAGED.page_size)), 1.0)],
+                          PAGED.page_size)
+    assert eng.import_request_kv(blob) == 0
+    assert eng.free_pages == free0 and eng.radix_pages == 0
+    eng._pt.check()
+
+
+def test_byte_budget_cut_keeps_rooted_chain(params):
+    """An export that hits its byte budget stops at the cut (never
+    skips a page): the shipped chain stays rooted and imports as a
+    usable shorter prefix."""
+    pre = Engine(XLA, params, ecfg=PAGED)
+    _first, blob = _export_blob(pre, GREEDY)
+    recs = kv_wire.decode(blob, PAGED.page_size)
+    per_page = kv_wire.kv_nbytes((recs[0]["k"], recs[0]["v"]))
+    cut = pre.export_request_kv(list(PROMPT), max_bytes=2 * per_page + 64)
+    short = kv_wire.decode(cut, PAGED.page_size)
+    assert len(short) == 2
+    assert [r["p"] for r in short] == [-1, 0]
+    dec = Engine(XLA, params, ecfg=PAGED)
+    assert dec.import_request_kv(cut) == 2
+    want, tier = dec.prefix_probe_tier(PROMPT)
+    assert tier == 0 and want == 16
+    dec._pt.check()
+
+
+def test_import_skips_pages_already_resident(params):
+    """A decode replica that already holds the prefix HBM-hot keeps its
+    own pages (nothing uploaded) and still serves the stream."""
+    pre = Engine(XLA, params, ecfg=PAGED)
+    _first, blob = _export_blob(pre, GREEDY)
+    dec = Engine(XLA, params, ecfg=PAGED)
+    warm_first, _ = _export_blob(dec, GREEDY)   # parks the same prefix
+    assert dec.radix_pages == 3
+    free0 = dec.free_pages
+    assert dec.import_request_kv(blob) == 0     # all resident: no uploads
+    assert dec.free_pages == free0 and dec.radix_pages == 3
+    ref = _gen(Engine(XLA, params, ecfg=PAGED), 0, PROMPT, GREEDY, N_STEPS)
+    got = dec.stitch(0, PROMPT, 16)
+    out = [dec.extend(0, PROMPT, got, GREEDY)] \
+        + [int(dec.decode()[0]) for _ in range(N_STEPS)]
+    assert out == ref and warm_first == ref[0]
+    dec._pt.check()
+
+
+def test_pages_export_fault_raises_before_any_gather(params):
+    """An armed pages.export fault surfaces as a typed error before any
+    page is touched — the serving layer maps it to a 503 and the
+    gateway downgrades the handoff."""
+    pre = Engine(XLA, params, ecfg=PAGED)
+    first, _ = _export_blob(pre, GREEDY)
+    FAULTS.arm("pages.export", "fail:once")
+    with pytest.raises(InjectedFault):
+        pre.export_request_kv(list(PROMPT) + [first])
+    # disarmed: the very next export works
+    assert pre.export_request_kv(list(PROMPT) + [first]) is not None
+    pre._pt.check()
+
+
+def test_pages_import_fault_leaves_table_untouched(params):
+    pre = Engine(XLA, params, ecfg=PAGED)
+    _first, blob = _export_blob(pre, GREEDY)
+    dec = Engine(XLA, params, ecfg=PAGED)
+    free0 = dec.free_pages
+    FAULTS.arm("pages.import", "fail:once")
+    with pytest.raises(InjectedFault):
+        dec.import_request_kv(blob)
+    assert dec.free_pages == free0 and dec.radix_pages == 0
+    assert dec.import_request_kv(blob) == 3     # disarmed: imports fine
+    dec._pt.check()
+
+
+# ---------------------------------------------------------------------------
+# gateway-level handoff (pooled fake replicas, real Gateway)
+# ---------------------------------------------------------------------------
+
+from ollama_operator_tpu.operator.gateway import Gateway  # noqa: E402
+from tools.chaos_campaign.harness import (DeterministicReplica,  # noqa: E402
+                                          expected_text)
+
+GW_GREEDY = {"temperature": 0, "num_predict": 8}
+GW_SEEDED = {"temperature": 0.9, "seed": 42, "num_predict": 8}
+GW_SAMPLED = {"temperature": 0.9, "num_predict": 8}
+
+
+@pytest.fixture()
+def pool_fleet(monkeypatch):
+    monkeypatch.setenv("TPU_GATEWAY_EJECT_FAILURES", "2")
+    monkeypatch.setenv("TPU_GATEWAY_EJECT_S", "0.05")
+    monkeypatch.setenv("TPU_GATEWAY_SLOW_SCRAPE_MS", "5000")
+    monkeypatch.setenv("TPU_DISAGG_HANDOFF_TIMEOUT_S", "5")
+    reps = [DeterministicReplica(pool=p)
+            for p in ("prefill", "decode", "decode")]
+    gw = Gateway(replicas=[(f"rep-{i}", r.url, r.pool)
+                           for i, r in enumerate(reps)],
+                 scrape_period_s=0, port=0).start()
+    yield gw, reps
+    gw.stop()
+    for r in reps:
+        r.stop()
+
+
+def stream_frames(base_url, body):
+    import json
+    import urllib.request
+    req = urllib.request.Request(
+        f"{base_url}/api/generate", data=json.dumps(body).encode(),
+        method="POST", headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        raw = resp.read().decode()
+    import json as _j
+    return [_j.loads(ln) for ln in raw.splitlines() if ln.strip()]
+
+
+def assert_clean_stream(frames, body):
+    assert not any("error" in f for f in frames), frames
+    dones = [f for f in frames if f.get("done")]
+    assert len(dones) == 1 and frames[-1] is dones[0]
+    text = "".join(f.get("response") or "" for f in frames)
+    assert text == expected_text(body)
+
+
+class TestGatewayHandoff:
+    @pytest.mark.parametrize("opts", [GW_GREEDY, GW_SEEDED],
+                             ids=["greedy", "seeded"])
+    def test_transferred_stream_is_bit_identical(self, pool_fleet, opts):
+        gw, (pre, d1, d2) = pool_fleet
+        body = {"model": "phi", "prompt": "handoff " * 20,
+                "options": dict(opts), "stream": True}
+        before = metric("tpu_model_disagg_handoffs_total",
+                        '{result="transferred"}')
+        frames = stream_frames(gw.base_url, body)
+        assert_clean_stream(frames, body)
+        assert metric("tpu_model_disagg_handoffs_total",
+                      '{result="transferred"}') == before + 1
+        # the prefill replica really took the prefill leg, and a decode
+        # replica re-served the full request for the splice
+        assert pre.seen and pre.seen[0].startswith("handoff")
+        assert any(r.seen for r in (d1, d2))
+        assert gw.journal_stats()["live"] == 0
+
+    def test_prefill_death_before_handoff_frame_replays(self, pool_fleet):
+        """The acceptance drill, timing 1: first token out, stream
+        severed before the handoff frame — journal replay on the decode
+        pool, zero client error frames."""
+        gw, (pre, d1, d2) = pool_fleet
+        pre.ctl["die_after"] = 1
+        body = {"model": "phi", "prompt": "mid-flight " * 20,
+                "options": dict(GW_GREEDY), "stream": True}
+        before = metric("tpu_model_disagg_handoffs_total",
+                        '{result="replayed"}')
+        frames = stream_frames(gw.base_url, body)
+        assert_clean_stream(frames, body)
+        assert metric("tpu_model_disagg_handoffs_total",
+                      '{result="replayed"}') == before + 1
+        assert gw.journal_stats()["live"] == 0
+
+    def test_prefill_death_at_export_pull_replays(self, pool_fleet):
+        """Timing 2: the handoff frame arrived but the prefill replica
+        is a corpse by the time the decode replica pulls its pages —
+        the import 502s and the stream replays, still byte-identical."""
+        gw, (pre, d1, d2) = pool_fleet
+        pre.ctl["export_down"] = True
+        body = {"model": "phi", "prompt": "corpse pull " * 20,
+                "options": dict(GW_SEEDED), "stream": True}
+        before = metric("tpu_model_disagg_handoffs_total",
+                        '{result="replayed"}')
+        frames = stream_frames(gw.base_url, body)
+        assert_clean_stream(frames, body)
+        assert metric("tpu_model_disagg_handoffs_total",
+                      '{result="replayed"}') == before + 1
+
+    def test_injected_gateway_handoff_fault_replays(self, pool_fleet):
+        gw, _reps = pool_fleet
+        FAULTS.arm("gateway.handoff", "fail:once")
+        body = {"model": "phi", "prompt": "drill " * 20,
+                "options": dict(GW_GREEDY), "stream": True}
+        before = metric("tpu_model_disagg_handoffs_total",
+                        '{result="replayed"}')
+        frames = stream_frames(gw.base_url, body)
+        assert_clean_stream(frames, body)
+        assert metric("tpu_model_disagg_handoffs_total",
+                      '{result="replayed"}') == before + 1
+
+    def test_decode_pool_loss_downgrades_to_unified(self, pool_fleet):
+        """A non-replayable stream skips the handoff and lives on the
+        decode pool; when that pool is gone it downgrades to unified
+        serving (the prefill replica picks it up) — pool topology is
+        never worth a client-visible failure."""
+        gw, (pre, d1, d2) = pool_fleet
+        d1.ctl["down"] = True
+        d2.ctl["down"] = True
+        body = {"model": "phi", "prompt": "fallback " * 20,
+                "options": dict(GW_SAMPLED), "stream": True}
+        before = metric("tpu_model_disagg_handoffs_total",
+                        '{result="unified_fallback"}')
+        frames = stream_frames(gw.base_url, body)
+        assert_clean_stream(frames, body)
+        assert metric("tpu_model_disagg_handoffs_total",
+                      '{result="unified_fallback"}') == before + 1
+        # unified serving = the full request, no disagg_prefill cap
+        assert pre.seen and pre.seen[-1].startswith("fallback")
+
+    def test_kill_switch_serves_unified(self, pool_fleet, monkeypatch):
+        monkeypatch.setenv("TPU_DISAGG", "0")
+        gw, _reps = pool_fleet
+        body = {"model": "phi", "prompt": "plain " * 20,
+                "options": dict(GW_GREEDY), "stream": True}
+        befores = {r: metric("tpu_model_disagg_handoffs_total",
+                             f'{{result="{r}"}}')
+                   for r in ("transferred", "replayed", "unified_fallback")}
+        frames = stream_frames(gw.base_url, body)
+        assert_clean_stream(frames, body)
+        for r, b in befores.items():
+            assert metric("tpu_model_disagg_handoffs_total",
+                          f'{{result="{r}"}}') == b
+
+
+# ---------------------------------------------------------------------------
+# the chaos drill: a pooled campaign with mid-handoff prefill kills
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_disagg_campaign_with_mid_handoff_kills_runs_green(tmp_path):
+    """Seed 8 fires kill_prefill_mid_handoff against the pooled fleet;
+    the campaign must stay green: every stream terminal exactly once
+    (byte-identical when complete — zero error frames for replayable
+    traffic), gateway journal drained at quiesce, thread census flat."""
+    from ollama_operator_tpu.runtime.chaos import run_campaign
+    from tools.chaos_campaign.harness import ChaosFleet
+
+    fleet = ChaosFleet(n_replicas=3, persist_dir=str(tmp_path), disagg=True)
+    try:
+        report = run_campaign(fleet, seed=8, n_events=10)
+    finally:
+        fleet.close()
+        FAULTS.reset()
+    assert report.actions.get("kill_prefill_mid_handoff", 0) >= 1
+    out = fleet.outcomes()
+    assert out.get("ok", 0) > 0
+    assert not out.get("lost") and not out.get("in-flight")
+    assert report.summary_lines()[0].endswith("green")

@@ -871,8 +871,8 @@ class TestOperatorWiring:
                             {"podIP": ip})
         disc = gwmod.kube_discovery(kube, "default", "ollama-model-phi",
                                     port=11434)
-        assert disc() == [("pod-0", "http://10.0.0.5:11434"),
-                          ("pod-1", "http://10.0.0.6:11434")]
+        assert disc() == [("pod-0", "http://10.0.0.5:11434", ""),
+                          ("pod-1", "http://10.0.0.6:11434", "")]
 
     def test_reconciler_creates_gateway_and_repoints_service(self):
         import sys

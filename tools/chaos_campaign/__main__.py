@@ -22,6 +22,9 @@ def main(argv=None) -> int:
     ap.add_argument("--engine-canary", action="store_true",
                     help="ride a real tiny Engine+Scheduler along so the "
                          "engine-family fault points fire (needs jax)")
+    ap.add_argument("--disagg", action="store_true",
+                    help="split the fleet into prefill/decode pools and "
+                         "add the kill_prefill_mid_handoff action")
     ap.add_argument("--quiet", action="store_true")
     args = ap.parse_args(argv)
 
@@ -41,7 +44,8 @@ def main(argv=None) -> int:
     for seed in args.seed:
         with tempfile.TemporaryDirectory(prefix="chaos-") as td:
             fleet = ChaosFleet(n_replicas=args.replicas, persist_dir=td,
-                               engine_canary=args.engine_canary)
+                               engine_canary=args.engine_canary,
+                               disagg=args.disagg)
             try:
                 report = run_campaign(fleet, seed, args.events, log=say)
             except InvariantViolation as e:

@@ -85,10 +85,17 @@ def expected_text(body: Dict[str, Any]) -> str:
 class DeterministicReplica:
     """One fake backend. ``ctl['down']`` = socket-level death;
     ``ctl['die_after']`` severs the next stream after N frames and then
-    stays down (death mid-stream, the failover trigger)."""
+    stays down (death mid-stream, the failover trigger);
+    ``ctl['export_down']`` makes the replica die exactly when the KV
+    export pull arrives (a prefill replica killed mid-handoff).
+    ``pool`` marks a disagg role: a prefill-pool replica honors the
+    gateway's ``options.disagg_prefill`` cap and finishes with
+    ``done_reason: "handoff"`` after the first token."""
 
-    def __init__(self) -> None:
-        self.ctl: Dict[str, Any] = {"down": False, "die_after": None}
+    def __init__(self, pool: str = "") -> None:
+        self.ctl: Dict[str, Any] = {"down": False, "die_after": None,
+                                    "export_down": False}
+        self.pool = pool
         self._lock = threading.Lock()
         self.seen: List[str] = []
         replica = self
@@ -151,8 +158,49 @@ class DeterministicReplica:
                                 "prompt_tokens": len(prompt) // 4})
                 elif self.path in ("/api/generate", "/api/chat"):
                     self._generate(body)
+                elif self.path == "/api/kv_export":
+                    self._kv_export(body)
+                elif self.path == "/api/kv_import":
+                    self._kv_import(body)
                 else:
                     self._json({"ok": True})
+
+            def _kv_export(self, body):
+                if replica.ctl["export_down"]:
+                    # the drill: prefill replica dies exactly when the
+                    # decode replica comes to pull its pages
+                    replica.ctl["export_down"] = False
+                    replica.ctl["down"] = True
+                    self.close_connection = True
+                    self.connection.close()
+                    return
+                prompt = ((body.get("system") or "")
+                          + (body.get("prompt") or ""))
+                blob = hashlib.sha256(prompt.encode()).digest() * 8
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "application/octet-stream")
+                self.send_header("Content-Length", str(len(blob)))
+                self.end_headers()
+                self.wfile.write(blob)
+
+            def _kv_import(self, body):
+                src = body.get("source") or ""
+                fwd = {k: body.get(k) for k in ("model", "prompt",
+                                                "system") if body.get(k)}
+                try:
+                    pull = urllib.request.Request(
+                        f"{src}/api/kv_export",
+                        data=json.dumps(fwd).encode(),
+                        headers={"Content-Type": "application/json"})
+                    with urllib.request.urlopen(pull, timeout=5) as r:
+                        blob = r.read()
+                except Exception:  # noqa: BLE001 — source died mid-pull
+                    self._json({"error": "kv pull failed",
+                                "imported_pages": 0}, 502)
+                    return
+                self._json({"imported_pages": max(1, len(blob) // 64),
+                            "bytes": len(blob)})
 
             def _chunk(self, data: bytes):
                 self.wfile.write(f"{len(data):x}\r\n".encode() + data
@@ -164,7 +212,12 @@ class DeterministicReplica:
                           + (body.get("prompt") or ""))
                 o = body.get("options") or {}
                 n = int(o.get("num_predict", 8))
+                # the gateway's disagg prefill leg caps at one token and
+                # expects done_reason "handoff" (options.disagg_prefill)
+                prefill_only = bool(o.get("disagg_prefill"))
                 pieces = gen_pieces(request_key(body), n)
+                if prefill_only:
+                    pieces = pieces[:1]
                 with replica._lock:
                     replica.seen.append(prompt)
                     die_after = replica.ctl["die_after"]
@@ -182,10 +235,21 @@ class DeterministicReplica:
                     self._chunk(json.dumps(
                         {"model": body.get("model"), "response": piece,
                          "done": False}).encode() + b"\n")
+                if prefill_only and die_after is not None:
+                    # killed mid-handoff: the first token went out but
+                    # the handoff frame never arrives — the gateway must
+                    # downgrade to journal replay on the decode pool
+                    replica.ctl["die_after"] = None
+                    replica.ctl["down"] = True
+                    self.close_connection = True
+                    self.connection.close()
+                    return
                 self._chunk(json.dumps(
                     {"model": body.get("model"), "response": "",
-                     "done": True, "done_reason": "stop",
-                     "eval_count": n}).encode() + b"\n")
+                     "done": True,
+                     "done_reason": ("handoff" if prefill_only
+                                     else "stop"),
+                     "eval_count": len(pieces)}).encode() + b"\n")
                 self.wfile.write(b"0\r\n\r\n")
                 self.wfile.flush()
 
@@ -331,7 +395,7 @@ class ChaosFleet:
     — see the protocol in runtime/chaos.py."""
 
     def __init__(self, n_replicas: int = 3, persist_dir: str = ".",
-                 engine_canary: bool = False):
+                 engine_canary: bool = False, disagg: bool = False):
         self._env_prev: Dict[str, Optional[str]] = {}
         self._set_env({
             "TPU_GATEWAY_EJECT_FAILURES": "2",
@@ -343,8 +407,14 @@ class ChaosFleet:
             "TPU_CP_LEADER_TIMEOUT_S": "0.4",
             "TPU_CP_SEND_TIMEOUT_S": "5",
             "TPU_DRAIN_TIMEOUT_S": "5",
+            "TPU_DISAGG_HANDOFF_TIMEOUT_S": "5",
         })
-        self.replicas = [DeterministicReplica() for _ in range(n_replicas)]
+        self.disagg = disagg
+        # disagg mode: one prefill replica, the rest decode — the
+        # handoff machinery (and its death drills) fire on real traffic
+        pools = ((["prefill"] + ["decode"] * max(1, n_replicas - 1))
+                 if disagg else [""] * n_replicas)
+        self.replicas = [DeterministicReplica(pool=p) for p in pools]
         self._gw_lock = threading.Lock()
         self.gw = self._boot_gateway()
         self.kube = _StubKube()
@@ -373,7 +443,7 @@ class ChaosFleet:
             os.environ[k] = v
 
     def _boot_gateway(self) -> Gateway:
-        gw = Gateway(replicas=[(f"rep-{i}", r.url)
+        gw = Gateway(replicas=[(f"rep-{i}", r.url, r.pool)
                                for i, r in enumerate(self.replicas)],
                      scrape_period_s=0.1, port=0)
         return gw.start()
@@ -399,13 +469,16 @@ class ChaosFleet:
 
     @property
     def actions(self) -> Dict[str, Any]:
-        return {
+        out = {
             "kill_replica": self.kill_replica,
             "revive_replica": self.revive_replica,
             "die_mid_stream": self.die_mid_stream,
             "kill_gateway": self.kill_gateway,
             "partition_leader": self.partition_leader,
         }
+        if self.disagg:
+            out["kill_prefill_mid_handoff"] = self.kill_prefill_mid_handoff
+        return out
 
     def kill_replica(self, rng) -> None:
         r = rng.choice(self.replicas)
@@ -417,11 +490,29 @@ class ChaosFleet:
             r = rng.choice(down)
             r.ctl["down"] = False
             r.ctl["die_after"] = None
+            r.ctl["export_down"] = False
 
     def die_mid_stream(self, rng) -> None:
         live = [r for r in self.replicas if not r.ctl["down"]]
         if live:
             rng.choice(live).ctl["die_after"] = rng.randint(1, 4)
+
+    def kill_prefill_mid_handoff(self, rng) -> None:
+        """The disagg acceptance drill: a prefill replica dies in the
+        middle of a handoff. Two timings, both of which must downgrade
+        to journal replay on the decode pool with zero client error
+        frames: before the handoff frame (first token out, stream
+        severed) or at the KV export pull (decode replica's import
+        finds a corpse)."""
+        live = [r for r in self.replicas
+                if r.pool == "prefill" and not r.ctl["down"]]
+        if not live:
+            return
+        r = rng.choice(live)
+        if rng.random() < 0.5:
+            r.ctl["die_after"] = 1
+        else:
+            r.ctl["export_down"] = True
 
     def kill_gateway(self, rng) -> None:
         """Crash (no drain — stop() only flushes what the window already
@@ -543,6 +634,7 @@ class ChaosFleet:
         for r in self.replicas:
             r.ctl["down"] = False
             r.ctl["die_after"] = None
+            r.ctl["export_down"] = False
         for c in self._pending:
             c.join(timeout=30)
             # outcome None after the join = a hung stream; the final
